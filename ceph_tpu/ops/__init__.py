@@ -1,0 +1,1 @@
+"""Device math for ceph_tpu: GF(2^w) arithmetic, coding matrices, kernels."""

@@ -1,0 +1,15 @@
+"""Test config: force an 8-device virtual CPU mesh (no TPU needed for CI).
+
+Sharding/mesh tests exercise the multi-chip code paths on
+``--xla_force_host_platform_device_count=8`` per the build contract; real-TPU
+runs happen via bench.py / the driver.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,88 @@
+"""Coding-matrix properties: systematic MDS, all-ones rows, decode inverses."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ops import matrices as mx
+from ceph_tpu.ops.gf import gf
+
+RNG = np.random.default_rng(7)
+
+
+def _assert_mds(parity: np.ndarray, k: int, w: int):
+    """Every k-subset of [I; P] rows must be invertible (MDS property)."""
+    G = gf(w)
+    m = parity.shape[0]
+    rows = list(range(k + m))
+    # exhaustive for small k+m, sampled otherwise
+    subsets = list(itertools.combinations(rows, k))
+    if len(subsets) > 200:
+        idx = RNG.choice(len(subsets), size=200, replace=False)
+        subsets = [subsets[i] for i in idx]
+    for sub in subsets:
+        M = np.zeros((k, k), dtype=np.int64)
+        for r, row in enumerate(sub):
+            if row < k:
+                M[r, row] = 1
+            else:
+                M[r, :] = parity[row - k, :]
+        G.invert_matrix(M)  # raises if singular
+
+
+@pytest.mark.parametrize("k,m,w", [(2, 1, 8), (3, 2, 8), (4, 2, 8), (8, 3, 8), (10, 4, 8), (4, 2, 16)])
+def test_vandermonde_mds_and_xor_row(k, m, w):
+    P = mx.rs_vandermonde(k, m, w)
+    assert P.shape == (m, k)
+    assert np.all(P[0] == 1), "first parity row must be all ones (XOR path)"
+    assert np.all(P > 0)
+    _assert_mds(P, k, w)
+
+
+@pytest.mark.parametrize("k", [2, 4, 8, 10])
+def test_r6(k):
+    P = mx.rs_r6(k, 8)
+    assert np.all(P[0] == 1)
+    G = gf(8)
+    for j in range(k):
+        assert P[1, j] == G.pow(2, j)
+    _assert_mds(P, k, 8)
+
+
+@pytest.mark.parametrize("k,m,w", [(2, 1, 8), (3, 2, 8), (8, 3, 8), (10, 4, 8)])
+def test_cauchy_mds(k, m, w):
+    P = mx.cauchy_original(k, m, w)
+    _assert_mds(P, k, w)
+    Pg = mx.cauchy_good(k, m, w)
+    assert np.all(Pg[0] == 1)
+    _assert_mds(Pg, k, w)
+    # "good" must not be worse than original in bitmatrix ones
+    G = gf(w)
+    ones = lambda M: sum(G.n_ones(int(v)) for v in M.flat)
+    assert ones(Pg) <= ones(P)
+
+
+@pytest.mark.parametrize("k,m", [(2, 1), (8, 3), (10, 4)])
+def test_isa_matrices(k, m):
+    P = mx.isa_rs_vandermonde(k, m)
+    assert np.all(P[0] == 1)
+    _assert_mds(P, k, 8)
+    Pc = mx.isa_cauchy(k, m)
+    _assert_mds(Pc, k, 8)
+
+
+def test_decode_matrix_recovers():
+    """R @ survivors == original data for random erasure patterns."""
+    G = gf(8)
+    k, m, w = 8, 3, 8
+    P = mx.rs_vandermonde(k, m, w)
+    data = RNG.integers(0, 256, size=(k, 64)).astype(np.uint8)
+    parity = G.matmul_region(P, data)
+    full = np.concatenate([data, parity], axis=0)
+    for _ in range(10):
+        erased = set(RNG.choice(k + m, size=m, replace=False).tolist())
+        present = [r for r in range(k + m) if r not in erased][:k]
+        R = mx.decode_matrix(P, k, w, present)
+        rec = G.matmul_region(R, full[present])
+        assert np.array_equal(rec, data)
