@@ -1,0 +1,20 @@
+"""Codec "model families": erasure-code interface, registry, and plugins.
+
+The analog of reference:src/erasure-code/ — plugins here are Python modules
+(`ceph_tpu.models.<name>` or external, loaded by dotted path) that register
+factories with :class:`ceph_tpu.models.registry.ErasureCodePluginRegistry`,
+mirroring the dlopen registry contract
+(reference:src/erasure-code/ErasureCodePlugin.cc:26-149).
+"""
+
+from .interface import ErasureCodeInterface
+from .base import ErasureCode
+from .registry import ErasureCodePlugin, ErasureCodePluginRegistry, instance
+
+__all__ = [
+    "ErasureCodeInterface",
+    "ErasureCode",
+    "ErasureCodePlugin",
+    "ErasureCodePluginRegistry",
+    "instance",
+]

@@ -1,0 +1,273 @@
+"""Matrix- and bitmatrix-based codecs over the TPU GF kernels.
+
+Two concrete engines shared by the jerasure/isa/lrc/shec plugins:
+
+- :class:`MatrixErasureCode` — byte-wise GF(2^w) matmul codes
+  (reed_sol_van / reed_sol_r6_op / ISA-L RS), the TPU analog of
+  jerasure_matrix_encode/decode (reference:src/erasure-code/jerasure/
+  ErasureCodeJerasure.cc:175,183).
+- :class:`BitmatrixErasureCode` — packet-XOR codes (cauchy_orig /
+  cauchy_good / liberation family), the TPU analog of
+  jerasure_schedule_encode / jerasure_schedule_decode_lazy
+  (reference:ErasureCodeJerasure.cc:279,288): each chunk is w packets of
+  ``packetsize`` bytes (repeated in blocks); parity packets are XORs of
+  data packets selected by the bit-matrix.
+
+Decode matrices are built on host by inverting the survivor submatrix and
+are cached per erasure signature, mirroring the ISA-L table cache
+(reference:src/erasure-code/isa/ErasureCodeIsaTableCache.cc:278-331).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+
+from ..ops import matrices as mx
+from ..ops.gf import gf
+from ..ops.gf_jax import make_bitmatrix_matmul, make_gf_matmul, make_xor_parity
+from .base import ErasureCode
+from .interface import ErasureCodeValidationError
+
+
+def _maybe_jit(fn):
+    # CEPH_TPU_NO_JIT=1 runs kernels eagerly — used by the (CPU) test suite
+    # where hundreds of distinct decode matrices would each trigger a
+    # compile; production/bench paths always jit.
+    if os.environ.get("CEPH_TPU_NO_JIT") == "1":
+        return fn
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=512)
+def _jit_matmul(matrix_key: tuple, w: int):
+    matrix = np.array(matrix_key, dtype=np.int64)
+    if matrix.shape[0] == 1 and np.all(matrix == 1):
+        return _maybe_jit(make_xor_parity())
+    return _maybe_jit(make_gf_matmul(matrix, w))
+
+
+@functools.lru_cache(maxsize=512)
+def _jit_bitmatmul(bm_key: bytes, rows: int, cols: int):
+    bm = np.frombuffer(bm_key, dtype=np.uint8).reshape(rows, cols)
+    return _maybe_jit(make_bitmatrix_matmul(bm))
+
+
+def _mkey(matrix: np.ndarray) -> tuple:
+    return tuple(tuple(int(v) for v in row) for row in np.asarray(matrix))
+
+
+class MatrixErasureCode(ErasureCode):
+    """Systematic code defined by an [m, k] GF(2^w) parity matrix."""
+
+    def __init__(self, k: int, m: int, w: int, matrix: np.ndarray):
+        super().__init__()
+        self.k = k
+        self.m = m
+        self.w = w
+        if w not in (8, 16):
+            raise ErasureCodeValidationError(f"matrix codec supports w=8/16, got {w}")
+        self.matrix = np.asarray(matrix, dtype=np.int64)
+        assert self.matrix.shape == (m, k)
+        self._decode_cache: dict[tuple, tuple] = {}
+
+    def init(self, profile: Mapping[str, str]) -> None:
+        self._profile = dict(profile)
+
+    # -- encode -------------------------------------------------------------
+
+    def encode_chunks(self, data_chunks: np.ndarray) -> np.ndarray:
+        fn = _jit_matmul(_mkey(self.matrix), self.w)
+        return np.asarray(fn(np.asarray(data_chunks, dtype=np.uint8)))
+
+    # -- decode -------------------------------------------------------------
+
+    def _recovery_matrix(
+        self, present: tuple[int, ...], missing: tuple[int, ...]
+    ) -> np.ndarray:
+        """[len(missing), len(present)] GF matrix rebuilding missing rows."""
+        key = (present, missing)
+        cached = self._decode_cache.get(key)
+        if cached is not None:
+            return cached
+        G = gf(self.w)
+        use = list(present)[: self.k]
+        R = mx.decode_matrix(self.matrix, self.k, self.w, use)  # data = R @ surv
+        rows = []
+        for r in missing:
+            if r < self.k:
+                rows.append(R[r])
+            else:
+                rows.append(G.matmul(self.matrix[r - self.k][None, :], R)[0])
+        RM = np.stack(rows)
+        # widen to all present columns (zeros for unused survivors)
+        if len(present) > self.k:
+            full = np.zeros((len(missing), len(present)), dtype=np.int64)
+            for c, p in enumerate(use):
+                full[:, list(present).index(p)] = RM[:, c]
+            RM = full
+        self._decode_cache[key] = RM
+        return RM
+
+    def decode_chunks(
+        self, present: Sequence[int], chunks: np.ndarray, missing: Sequence[int]
+    ) -> np.ndarray:
+        present = tuple(present)
+        missing = tuple(missing)
+        if len(present) < self.k:
+            raise IOError(
+                f"cannot decode: {len(present)} chunks available, need {self.k}"
+            )
+        RM = self._recovery_matrix(present, missing)
+        fn = _jit_matmul(_mkey(RM), self.w)
+        return np.asarray(fn(np.asarray(chunks, dtype=np.uint8)))
+
+
+class BitmatrixErasureCode(ErasureCode):
+    """Packet-XOR code from an [m*w, k*w] GF(2) bit-matrix.
+
+    ``packetsize`` must be a multiple of 4 (uint32 lanes); chunks are
+    blocks of w*packetsize bytes.
+    """
+
+    def __init__(
+        self, k: int, m: int, w: int, matrix: np.ndarray, packetsize: int,
+        bitmatrix: np.ndarray | None = None,
+    ):
+        super().__init__()
+        self.k = k
+        self.m = m
+        self.w = w
+        if packetsize <= 0 or packetsize % 4 != 0:
+            raise ErasureCodeValidationError(
+                f"packetsize must be a positive multiple of 4, got {packetsize}"
+            )
+        self.packetsize = packetsize
+        self.matrix = None if matrix is None else np.asarray(matrix, dtype=np.int64)
+        if bitmatrix is not None:
+            self.bitmatrix = np.asarray(bitmatrix, dtype=np.uint8)
+        else:
+            self.bitmatrix = gf(w).matrix_to_bitmatrix(self.matrix)
+        assert self.bitmatrix.shape == (m * w, k * w)
+        self._decode_cache: dict[tuple, np.ndarray] = {}
+
+    def init(self, profile: Mapping[str, str]) -> None:
+        self._profile = dict(profile)
+
+    def get_alignment(self) -> int:
+        return self.w * self.packetsize
+
+    # -- packet layout: [n, C] -> [n*w, B*ps] --------------------------------
+
+    def _to_packets(self, chunks: np.ndarray) -> np.ndarray:
+        n, C = chunks.shape
+        wps = self.w * self.packetsize
+        if C % wps != 0:
+            raise ErasureCodeValidationError(
+                f"chunk size {C} not a multiple of w*packetsize={wps}"
+            )
+        B = C // wps
+        x = chunks.reshape(n, B, self.w, self.packetsize)
+        x = np.transpose(x, (0, 2, 1, 3))  # [n, w, B, ps]
+        return np.ascontiguousarray(x).reshape(n * self.w, B * self.packetsize)
+
+    def _from_packets(self, packets: np.ndarray, n: int) -> np.ndarray:
+        nw, BP = packets.shape
+        assert nw == n * self.w
+        B = BP // self.packetsize
+        x = packets.reshape(n, self.w, B, self.packetsize)
+        x = np.transpose(x, (0, 2, 1, 3))
+        return np.ascontiguousarray(x).reshape(n, B * self.w * self.packetsize)
+
+    # -- encode / decode ------------------------------------------------------
+
+    def encode_chunks(self, data_chunks: np.ndarray) -> np.ndarray:
+        pk = self._to_packets(np.asarray(data_chunks, dtype=np.uint8))
+        fn = _jit_bitmatmul(
+            self.bitmatrix.tobytes(), *self.bitmatrix.shape
+        )
+        out = np.asarray(fn(pk))
+        return self._from_packets(out, self.m)
+
+    def _recovery_bitmatrix(
+        self, present: tuple[int, ...], missing: tuple[int, ...]
+    ) -> np.ndarray:
+        key = (present, missing)
+        cached = self._decode_cache.get(key)
+        if cached is not None:
+            return cached
+        w = self.w
+        # Build survivor generator bitmatrix [len(present)*w, k*w] and invert
+        # the GF(2) system for the first k survivors, matching
+        # jerasure_schedule_decode_lazy's bitmatrix inversion.
+        use = list(present)[: self.k]
+        rows = []
+        eye = np.eye(self.k * w, dtype=np.uint8)
+        for r in use:
+            if r < self.k:
+                rows.append(eye[r * w : (r + 1) * w])
+            else:
+                rows.append(self.bitmatrix[(r - self.k) * w : (r - self.k + 1) * w])
+        Gb = np.concatenate(rows, axis=0)  # [k*w, k*w]
+        Rb = _gf2_invert(Gb)  # data_bits = Rb @ survivor_bits
+        out_rows = []
+        for r in missing:
+            if r < self.k:
+                out_rows.append(Rb[r * w : (r + 1) * w])
+            else:
+                pr = self.bitmatrix[(r - self.k) * w : (r - self.k + 1) * w]
+                out_rows.append((pr.astype(np.int64) @ Rb.astype(np.int64)) % 2)
+        RM = np.concatenate(out_rows, axis=0).astype(np.uint8)  # [|miss|*w, k*w]
+        # widen to all present packet-columns
+        if len(present) > self.k:
+            full = np.zeros((RM.shape[0], len(present) * w), dtype=np.uint8)
+            for c, p in enumerate(use):
+                idx = list(present).index(p)
+                full[:, idx * w : (idx + 1) * w] = RM[:, c * w : (c + 1) * w]
+            RM = full
+        self._decode_cache[key] = RM
+        return RM
+
+    def decode_chunks(
+        self, present: Sequence[int], chunks: np.ndarray, missing: Sequence[int]
+    ) -> np.ndarray:
+        present = tuple(present)
+        missing = tuple(missing)
+        if len(present) < self.k:
+            raise IOError(
+                f"cannot decode: {len(present)} chunks available, need {self.k}"
+            )
+        RM = self._recovery_bitmatrix(present, missing)
+        pk = self._to_packets(np.asarray(chunks, dtype=np.uint8))
+        fn = _jit_bitmatmul(RM.tobytes(), *RM.shape)
+        out = np.asarray(fn(pk))
+        return self._from_packets(out, len(missing))
+
+
+def _gf2_invert(M: np.ndarray) -> np.ndarray:
+    """Invert a square matrix over GF(2) (uint8 0/1)."""
+    M = M.astype(np.uint8).copy()
+    n = M.shape[0]
+    assert M.shape == (n, n)
+    inv = np.eye(n, dtype=np.uint8)
+    for col in range(n):
+        piv = None
+        for r in range(col, n):
+            if M[r, col]:
+                piv = r
+                break
+        if piv is None:
+            raise ValueError("singular bitmatrix over GF(2)")
+        if piv != col:
+            M[[col, piv]] = M[[piv, col]]
+            inv[[col, piv]] = inv[[piv, col]]
+        mask = M[:, col].copy()
+        mask[col] = 0
+        rows = np.nonzero(mask)[0]
+        M[rows] ^= M[col]
+        inv[rows] ^= inv[col]
+    return inv

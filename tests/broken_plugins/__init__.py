@@ -1,0 +1,2 @@
+"""Deliberately broken plugins for registry error-path tests
+(analog of reference:src/test/erasure-code/ErasureCodePlugin*.cc fixtures)."""

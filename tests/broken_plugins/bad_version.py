@@ -1,0 +1,3 @@
+__erasure_code_version__ = "some-other-version"
+def __erasure_code_init__(name, registry):
+    return None

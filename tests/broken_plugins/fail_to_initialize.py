@@ -1,0 +1,4 @@
+from ceph_tpu.models.registry import PLUGIN_VERSION
+__erasure_code_version__ = PLUGIN_VERSION
+def __erasure_code_init__(name, registry):
+    raise RuntimeError("deliberate init failure")

@@ -1,0 +1,3 @@
+from ceph_tpu.models.registry import PLUGIN_VERSION
+__erasure_code_version__ = PLUGIN_VERSION
+# no __erasure_code_init__

@@ -3,13 +3,26 @@
 Sharding/mesh tests exercise the multi-chip code paths on
 ``--xla_force_host_platform_device_count=8`` per the build contract; real-TPU
 runs happen via bench.py / the driver.
+
+NOTE: this container's sitecustomize imports jax and pins
+``jax_platforms=axon`` (the TPU tunnel) before any of our code runs, so the
+``JAX_PLATFORMS`` env var is read too late — we must override via
+``jax.config.update`` instead.  XLA_FLAGS still must be set before the cpu
+client is instantiated (it is: no backend exists yet at conftest time).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# codec kernels run eagerly in tests (hundreds of distinct decode matrices
+# would each jit-compile); dedicated jit/sharding tests opt back in locally
+os.environ.setdefault("CEPH_TPU_NO_JIT", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,63 @@
+"""Registry semantics incl. broken-plugin error paths.
+
+Mirrors reference:src/test/erasure-code/TestErasureCodePlugin.cc driven by
+the ErasureCodePlugin{FailToInitialize,FailToRegister,MissingEntryPoint,
+MissingVersion}.cc fixtures.
+"""
+
+import pytest
+
+from ceph_tpu.models.registry import (
+    ErasureCodePluginError,
+    ErasureCodePluginRegistry,
+)
+
+BROKEN_DIR = "tests.broken_plugins"
+
+
+@pytest.fixture
+def reg():
+    return ErasureCodePluginRegistry()
+
+
+def test_factory_loads_and_caches(reg):
+    codec = reg.factory("jerasure", {"technique": "reed_sol_van"})
+    assert codec.get_chunk_count() == 3  # default k=2 m=1
+    assert reg.get("jerasure") is not None
+    # second factory call reuses the registered plugin
+    p1 = reg.get("jerasure")
+    reg.factory("jerasure", {"technique": "reed_sol_van"})
+    assert reg.get("jerasure") is p1
+
+
+def test_preload(reg):
+    reg.preload("jerasure isa example")
+    for name in ("jerasure", "isa", "example"):
+        assert reg.get(name) is not None
+
+
+def test_load_missing_plugin(reg):
+    with pytest.raises(ErasureCodePluginError, match="dlopen"):
+        reg.factory("does_not_exist", {})
+
+
+@pytest.mark.parametrize(
+    "name,match",
+    [
+        ("fail_to_initialize", "failed"),
+        ("fail_to_register", "did not register"),
+        ("missing_entry_point", "entry point"),
+        ("missing_version", "__erasure_code_version__"),
+        ("bad_version", "!= expected"),
+    ],
+)
+def test_broken_plugins(reg, name, match):
+    with pytest.raises(ErasureCodePluginError, match=match):
+        reg.factory(name, {}, directory=BROKEN_DIR)
+
+
+def test_double_registration(reg):
+    reg.preload("example")
+    plugin = reg.get("example")
+    with pytest.raises(ErasureCodePluginError, match="already registered"):
+        reg.add("example", plugin)
