@@ -1,0 +1,164 @@
+"""North-star benchmark: RS(8,3) encode + single-chunk reconstruct GB/s.
+
+The TPU-native equivalent of ``ceph_erasure_code_benchmark`` on the
+BASELINE.md config-2 workload (isa-l RS k=8 m=3, 1 MiB stripe; metric
+GB/s = data bytes processed / seconds, per
+reference:qa/workunits/erasure-code/bench.sh:166).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N}
+
+``value`` is the combined encode+reconstruct throughput on the TPU (data
+bytes / total time for one encode pass plus one reconstruct pass).
+``vs_baseline`` is the ratio vs the same workload on this host's native
+single-thread C++ engine (native/ec_cpu.cc -O3 -march=native — the
+reference's gf-complete/ISA-L engine class), measured in the same run.
+
+Usage: python bench.py [--platform cpu] [--json-only]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+K, M, W = 8, 3, 8
+OBJECT_SIZE = 1 << 20  # 1 MiB stripe
+CHUNK = OBJECT_SIZE // K  # 128 KiB
+BATCH_OBJECTS = 64  # fill the chip: 64 MiB data per device call
+ERASED = [0]  # single-chunk reconstruct, per BASELINE config 2
+_OPTS = {"batch": BATCH_OBJECTS, "min_iters": 10, "min_seconds": 2.0}
+
+
+def _bench_loop(fn, *args, min_iters=None, min_seconds=None):
+    min_iters = min_iters or _OPTS["min_iters"]
+    min_seconds = min_seconds or _OPTS["min_seconds"]
+    fn(*args)  # warmup / compile
+    fn(*args)
+    t0 = time.perf_counter()
+    iters = 0
+    while True:
+        fn(*args)
+        iters += 1
+        dt = time.perf_counter() - t0
+        if iters >= min_iters and dt >= min_seconds:
+            return dt / iters
+
+
+def bench_tpu(platform: str | None):
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    import jax.numpy as jnp
+
+    from ceph_tpu.ops import matrices as mx
+    from ceph_tpu.ops.gf_jax import make_gf_matmul
+    from ceph_tpu.parallel.distributed import _recovery_rows
+
+    dev = jax.devices()[0]
+    P = mx.isa_rs_vandermonde(K, M)  # the isa-l RS matrix (BASELINE config 2)
+    present = [r for r in range(K + M) if r not in ERASED]
+    RM = _recovery_rows(P, K, W, present, list(ERASED))
+    enc = jax.jit(make_gf_matmul(P, W))
+    dec = jax.jit(make_gf_matmul(RM, W))
+
+    n = _OPTS["batch"] * CHUNK
+    rng = np.random.default_rng(0)
+    data = jax.device_put(
+        rng.integers(0, 256, size=(K, n), dtype=np.uint8), dev
+    )
+    data_bytes = K * n
+
+    def encode_once(d):
+        jax.block_until_ready(enc(d))
+
+    t_encode = _bench_loop(encode_once, data)
+
+    parity = enc(data)
+    surv = jax.device_put(
+        np.concatenate([np.asarray(data), np.asarray(parity)])[present[:K]], dev
+    )
+
+    def decode_once(s):
+        jax.block_until_ready(dec(s))
+
+    t_decode = _bench_loop(decode_once, surv)
+
+    gbps_encode = data_bytes / t_encode / 1e9
+    gbps_decode = data_bytes / t_decode / 1e9
+    gbps_combined = 2 * data_bytes / (t_encode + t_decode) / 1e9
+    return {
+        "platform": str(dev),
+        "encode_gbps": gbps_encode,
+        "reconstruct_gbps": gbps_decode,
+        "combined_gbps": gbps_combined,
+    }
+
+
+def bench_native():
+    from ceph_tpu.ops import matrices as mx
+    from ceph_tpu.ops.gf import gf
+    from ceph_tpu.parallel.distributed import _recovery_rows
+    from ceph_tpu.utils import native
+
+    P = mx.isa_rs_vandermonde(K, M)
+    present = [r for r in range(K + M) if r not in ERASED]
+    RM = _recovery_rows(P, K, W, present, list(ERASED))
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, size=(K, CHUNK), dtype=np.uint8)  # one object
+    data_bytes = data.size
+
+    t_encode = _bench_loop(lambda: native.encode(P, data), min_seconds=1.0)
+    parity = native.encode(P, data)
+    surv = np.concatenate([data, parity])[present[:K]]
+    t_decode = _bench_loop(lambda: native.encode(RM, surv), min_seconds=1.0)
+
+    return {
+        "encode_gbps": data_bytes / t_encode / 1e9,
+        "reconstruct_gbps": data_bytes / t_decode / 1e9,
+        "combined_gbps": 2 * data_bytes / (t_encode + t_decode) / 1e9,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default=None, help="override jax platform (e.g. cpu)")
+    ap.add_argument("--json-only", action="store_true")
+    ap.add_argument("--batch", type=int, default=BATCH_OBJECTS,
+                    help="objects per device call (64 = 64 MiB data)")
+    ap.add_argument("--quick", action="store_true", help="short timing loops")
+    args = ap.parse_args()
+    _OPTS["batch"] = args.batch
+    if args.quick:
+        _OPTS["min_iters"], _OPTS["min_seconds"] = 3, 0.3
+
+    cpu = bench_native()
+    tpu = bench_tpu(args.platform)
+
+    result = {
+        "metric": "RS(8,3) 1MiB-stripe encode+reconstruct throughput (TPU)",
+        "value": round(tpu["combined_gbps"], 3),
+        "unit": "GB/s",
+        "vs_baseline": round(tpu["combined_gbps"] / cpu["combined_gbps"], 3),
+    }
+    if not args.json_only:
+        print(
+            f"# tpu: encode {tpu['encode_gbps']:.2f} GB/s, "
+            f"reconstruct {tpu['reconstruct_gbps']:.2f} GB/s on {tpu['platform']}",
+            file=sys.stderr,
+        )
+        print(
+            f"# native cpu baseline: encode {cpu['encode_gbps']:.2f} GB/s, "
+            f"reconstruct {cpu['reconstruct_gbps']:.2f} GB/s (single thread)",
+            file=sys.stderr,
+        )
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -34,19 +34,13 @@ import numpy as np
 
 from .gf import PRIM_POLY
 
-# packed-lane constants per w: (low-bits mask, high-bit units, reduction poly),
-# polynomials derived from the single source of truth in gf.py
+# packed-lane constants per w: (low-bits mask, high-bit units, reduction
+# poly), polynomials derived from the single source of truth in gf.py.
+# Plain python ints (NOT jnp arrays): creating a device array at import time
+# would initialize the backend on module import.
 _PACK = {
-    8: (
-        jnp.uint32(0x7F7F7F7F),
-        jnp.uint32(0x01010101),
-        jnp.uint32(PRIM_POLY[8] & 0xFF),
-    ),
-    16: (
-        jnp.uint32(0x7FFF7FFF),
-        jnp.uint32(0x00010001),
-        jnp.uint32(PRIM_POLY[16] & 0xFFFF),
-    ),
+    8: (0x7F7F7F7F, 0x01010101, PRIM_POLY[8] & 0xFF),
+    16: (0x7FFF7FFF, 0x00010001, PRIM_POLY[16] & 0xFFFF),
 }
 
 

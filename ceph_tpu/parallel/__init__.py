@@ -1,0 +1,22 @@
+"""Device-mesh parallelism for the EC engine.
+
+Ceph's parallelism axes (SURVEY.md §2 parallelism note) re-expressed as a
+JAX mesh:
+
+- ``pg``    — placement-group/data parallelism: different stripes (objects)
+  on different chips; encode is embarrassingly parallel here (the analog of
+  objects→PGs→OSDs placement sharding).
+- ``shard`` — code sharding: the k+m chunk rows of a stripe distributed
+  across chips with positionally-distinct roles (the analog of
+  crush_choose_indep + shard_id_t); reconstruction all-gathers surviving
+  rows over ICI.
+
+The distributed backend is XLA collectives over ICI/DCN — the messenger
+analog for bulk data (SURVEY.md §5.8) — while control-plane traffic uses
+:mod:`ceph_tpu.rados`'s TCP messenger.
+"""
+
+from .mesh import make_mesh
+from .distributed import make_ec_step
+
+__all__ = ["make_mesh", "make_ec_step"]

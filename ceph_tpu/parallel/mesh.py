@@ -1,0 +1,37 @@
+"""Mesh construction helpers."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    n_devices: int | None = None,
+    shard_parallelism: int | None = None,
+    axis_names: tuple[str, str] = ("pg", "shard"),
+) -> Mesh:
+    """2-D mesh (pg, shard) over the first ``n_devices`` devices.
+
+    ``shard_parallelism`` is the size of the chunk-sharding axis (must
+    divide both n_devices and, at use sites, the k of the code); default:
+    largest power of two <= min(4, n_devices).
+    """
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    if shard_parallelism is None:
+        shard_parallelism = 1
+        while (
+            shard_parallelism * 2 <= 4
+            and n % (shard_parallelism * 2) == 0
+        ):
+            shard_parallelism *= 2
+    if n % shard_parallelism != 0:
+        raise ValueError(
+            f"shard_parallelism={shard_parallelism} does not divide {n} devices"
+        )
+    grid = np.array(devices).reshape(n // shard_parallelism, shard_parallelism)
+    return Mesh(grid, axis_names)

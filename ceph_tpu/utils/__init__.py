@@ -1,0 +1,1 @@
+"""Runtime utilities: native library loader, config, counters, logging."""

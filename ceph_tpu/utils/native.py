@@ -1,0 +1,99 @@
+"""ctypes loader + wrapper for the native C++ engine (native/ec_cpu.cc).
+
+Builds on first use (g++ -O3 -march=native) into native/build/.  This is
+the host-side codec used as the CPU baseline in bench.py and as an
+independent oracle for the TPU kernels (both implement the same doubling
+scheme, so parity bytes must agree exactly with each other and with the
+numpy table-based oracle).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pathlib
+import subprocess
+import threading
+
+import numpy as np
+
+_ROOT = pathlib.Path(__file__).resolve().parent.parent.parent
+_SRC = _ROOT / "native" / "ec_cpu.cc"
+_BUILD = _ROOT / "native" / "build"
+_SO = _BUILD / "libec_cpu.so"
+
+_lock = threading.Lock()
+_lib = None
+
+
+def build(force: bool = False) -> pathlib.Path:
+    """Compile the native library if needed; returns the .so path."""
+    if _SO.exists() and not force:
+        if _SO.stat().st_mtime >= _SRC.stat().st_mtime:
+            return _SO
+    _BUILD.mkdir(parents=True, exist_ok=True)
+    cmd = [
+        "g++", "-O3", "-march=native", "-funroll-loops", "-shared", "-fPIC",
+        "-std=c++17", str(_SRC), "-o", str(_SO),
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+    return _SO
+
+
+def lib() -> ctypes.CDLL:
+    global _lib
+    with _lock:
+        if _lib is None:
+            so = build()
+            _lib = ctypes.CDLL(str(so))
+            _lib.gf8_encode_flat.argtypes = [
+                ctypes.POINTER(ctypes.c_int), ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8),
+                ctypes.c_int64,
+            ]
+            _lib.gf16_encode_flat.argtypes = _lib.gf8_encode_flat.argtypes
+            _lib.gf8_mul_region.argtypes = [
+                ctypes.c_uint8, ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ]
+            _lib.xor_region.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8),
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ]
+        return _lib
+
+
+def _u8ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def encode(matrix: np.ndarray, data: np.ndarray, w: int = 8) -> np.ndarray:
+    """Native single-thread GF matmul: data [k, n] uint8 -> parity [m, n]."""
+    L = lib()
+    matrix = np.ascontiguousarray(matrix, dtype=np.int32)
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    m, k = matrix.shape
+    assert data.shape[0] == k and data.shape[1] % 8 == 0
+    parity = np.empty((m, data.shape[1]), dtype=np.uint8)
+    fn = L.gf8_encode_flat if w == 8 else L.gf16_encode_flat
+    fn(
+        matrix.ctypes.data_as(ctypes.POINTER(ctypes.c_int)), k, m,
+        _u8ptr(data), _u8ptr(parity), data.shape[1],
+    )
+    return parity
+
+
+def mul_region(c: int, src: np.ndarray) -> np.ndarray:
+    L = lib()
+    src = np.ascontiguousarray(src, dtype=np.uint8)
+    dst = np.empty_like(src)
+    L.gf8_mul_region(c, _u8ptr(src), _u8ptr(dst), src.size)
+    return dst
+
+
+def xor_region(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    L = lib()
+    a = np.ascontiguousarray(a, dtype=np.uint8)
+    b = np.ascontiguousarray(b, dtype=np.uint8)
+    dst = np.empty_like(a)
+    L.xor_region(_u8ptr(a), _u8ptr(b), _u8ptr(dst), a.size)
+    return dst

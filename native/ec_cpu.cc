@@ -1,0 +1,132 @@
+// Native CPU erasure-code engine for ceph_tpu.
+//
+// Role: the host-side reference/baseline codec the TPU kernels are measured
+// against (the reference gets this from gf-complete/ISA-L's SIMD paths;
+// reference:src/erasure-code/jerasure/CMakeLists.txt:11-66). Portable C++
+// (auto-vectorized by -O3 -march=native), single thread, GF(2^8)/GF(2^16):
+//
+// - gf8_encode: parity[m][n] = GF matmul of matrix[m][k] with data[k][n],
+//   via the same shift-xor doubling scheme as the TPU kernel, on uint64
+//   lanes (8 bytes per op), so CPU and TPU produce identical bytes by
+//   construction.
+// - gf8_mul_region / xor_region: building blocks for tests and the
+//   crc/scrub paths.
+//
+// Exposed with C linkage for ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+// GF(2^8), poly 0x11d — lane-parallel double on uint64 (8 byte lanes)
+static inline uint64_t gf8_double64(uint64_t x) {
+  uint64_t high = (x >> 7) & 0x0101010101010101ULL;
+  return ((x & 0x7f7f7f7f7f7f7f7fULL) << 1) ^ (high * 0x1dULL);
+}
+
+static inline uint64_t gf16_double64(uint64_t x) {
+  uint64_t high = (x >> 15) & 0x0001000100010001ULL;
+  return ((x & 0x7fff7fff7fff7fffULL) << 1) ^ (high * 0x100bULL);
+}
+
+}  // namespace
+
+extern "C" {
+
+// parity[m][n] = matrix[m][k] (GF(2^8) elements) * data rows; n % 8 == 0.
+// data: k pointers to n-byte chunks; parity: m pointers to n-byte chunks.
+void gf8_encode(const int* matrix, int k, int m, const uint8_t* const* data,
+                uint8_t* const* parity, int64_t n) {
+  // powers[j][b] = 2^b * data[j], built lazily per 8-byte block to stay in
+  // registers/cache: process in blocks of BLK bytes.
+  constexpr int64_t BLK = 4096;
+  uint64_t powbuf[8][BLK / 8];
+  for (int64_t off = 0; off < n; off += BLK) {
+    int64_t len = (n - off < BLK) ? (n - off) : BLK;
+    int64_t words = len / 8;
+    // zero parity accumulators for this block
+    for (int i = 0; i < m; ++i) std::memset(parity[i] + off, 0, len);
+    for (int j = 0; j < k; ++j) {
+      // which powers of 2 does column j need?
+      int needed = 0;
+      for (int i = 0; i < m; ++i) needed |= matrix[i * k + j];
+      if (!needed) continue;
+      const uint64_t* src = reinterpret_cast<const uint64_t*>(data[j] + off);
+      int maxb = 0;
+      for (int b = 7; b >= 0; --b)
+        if (needed & (1 << b)) { maxb = b; break; }
+      // build doubling chain
+      for (int64_t w = 0; w < words; ++w) powbuf[0][w] = src[w];
+      for (int b = 1; b <= maxb; ++b)
+        for (int64_t w = 0; w < words; ++w)
+          powbuf[b][w] = gf8_double64(powbuf[b - 1][w]);
+      for (int i = 0; i < m; ++i) {
+        int c = matrix[i * k + j];
+        if (!c) continue;
+        uint64_t* dst = reinterpret_cast<uint64_t*>(parity[i] + off);
+        for (int b = 0; b <= maxb; ++b)
+          if (c & (1 << b))
+            for (int64_t w = 0; w < words; ++w) dst[w] ^= powbuf[b][w];
+      }
+    }
+  }
+}
+
+// Flat-layout convenience wrapper: data [k*n], parity out [m*n].
+void gf8_encode_flat(const int* matrix, int k, int m, const uint8_t* data,
+                     uint8_t* parity, int64_t n) {
+  const uint8_t* dptr[32];
+  uint8_t* pptr[32];
+  for (int j = 0; j < k; ++j) dptr[j] = data + j * n;
+  for (int i = 0; i < m; ++i) pptr[i] = parity + i * n;
+  gf8_encode(matrix, k, m, dptr, pptr, n);
+}
+
+void gf8_mul_region(uint8_t c, const uint8_t* src, uint8_t* dst, int64_t n) {
+  const uint64_t* s = reinterpret_cast<const uint64_t*>(src);
+  uint64_t* d = reinterpret_cast<uint64_t*>(dst);
+  int64_t words = n / 8;
+  uint64_t pow[8];
+  for (int64_t w = 0; w < words; ++w) {
+    uint64_t acc = 0, p = s[w];
+    for (int b = 0; b < 8; ++b) {
+      if (c & (1 << b)) acc ^= p;
+      p = gf8_double64(p);
+    }
+    d[w] = acc;
+  }
+  (void)pow;
+}
+
+void xor_region(const uint8_t* a, const uint8_t* b, uint8_t* dst, int64_t n) {
+  const uint64_t* x = reinterpret_cast<const uint64_t*>(a);
+  const uint64_t* y = reinterpret_cast<const uint64_t*>(b);
+  uint64_t* d = reinterpret_cast<uint64_t*>(dst);
+  for (int64_t w = 0; w < n / 8; ++w) d[w] = x[w] ^ y[w];
+}
+
+// GF(2^16) variant (elements little-endian uint16; n bytes, n % 8 == 0)
+void gf16_encode_flat(const int* matrix, int k, int m, const uint8_t* data,
+                      uint8_t* parity, int64_t n) {
+  int64_t words = n / 8;
+  for (int i = 0; i < m; ++i) {
+    uint64_t* dst = reinterpret_cast<uint64_t*>(parity + i * n);
+    std::memset(dst, 0, n);
+    for (int j = 0; j < k; ++j) {
+      int c = matrix[i * k + j];
+      if (!c) continue;
+      const uint64_t* src = reinterpret_cast<const uint64_t*>(data + j * n);
+      for (int64_t w = 0; w < words; ++w) {
+        uint64_t acc = 0, p = src[w];
+        for (int b = 0; b < 16; ++b) {
+          if (c & (1 << b)) acc ^= p;
+          p = gf16_double64(p);
+        }
+        dst[w] ^= acc;
+      }
+    }
+  }
+}
+
+}  // extern "C"
