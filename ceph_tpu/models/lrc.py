@@ -1,0 +1,314 @@
+"""LRC plugin: locally-repairable layered code.
+
+Behavior mirror of reference:src/erasure-code/lrc/ErasureCodeLrc.{h,cc}:
+
+- profile is either a JSON ``layers`` list + ``mapping`` string
+  (layers_parse, :131) or the ``k/m/l`` shorthand expanded to a global
+  layer + per-group local layers (parse_kml, :281 — same expansion
+  strings);
+- each Layer has a chunks_map over the full chunk space (D=data in layer,
+  c=coding in layer, _=not in layer) and an inner codec (default jerasure
+  reed_sol_van) with the layer's own k/m (:76-95);
+- encode runs layers in order on their chunk subsets (:727), so local
+  layers protect global parities too;
+- decode iterates layers repeatedly, reusing chunks recovered by previous
+  layers until the wanted erasures are gone (:765);
+- minimum_to_decode walks layers in reverse, preferring a single local
+  -layer read set (:555).
+
+Crush ruleset-steps from the profile are parsed and stored for the
+placement layer (create_ruleset analog lives with CRUSH, not here).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Mapping, Sequence
+
+import numpy as np
+
+from .base import ErasureCode
+from .interface import ErasureCodeValidationError
+from .registry import ErasureCodePlugin, PLUGIN_VERSION, instance
+
+__erasure_code_version__ = PLUGIN_VERSION
+
+DEFAULT_INNER = {"plugin": "jerasure", "technique": "reed_sol_van"}
+
+
+class Layer:
+    def __init__(self, chunks_map: str, profile: Mapping[str, str]):
+        self.chunks_map = chunks_map
+        self.data = [i for i, ch in enumerate(chunks_map) if ch == "D"]
+        self.coding = [i for i, ch in enumerate(chunks_map) if ch == "c"]
+        self.chunks = self.data + self.coding
+        self.chunks_as_set = set(self.chunks)
+        prof = dict(DEFAULT_INNER)
+        prof.update(profile)
+        prof["k"] = str(len(self.data))
+        prof["m"] = str(len(self.coding))
+        plugin = prof.pop("plugin")
+        self.erasure_code = instance().factory(plugin, prof)
+
+
+def _parse_layer_profile(spec) -> dict:
+    """Second element of a layer entry: '' | 'k=v k=v' | JSON object."""
+    if spec is None or spec == "":
+        return {}
+    if isinstance(spec, dict):
+        return {str(k): str(v) for k, v in spec.items()}
+    out = {}
+    for tok in str(spec).split():
+        if "=" not in tok:
+            raise ErasureCodeValidationError(
+                f"layer profile token {tok!r} is not k=v"
+            )
+        key, val = tok.split("=", 1)
+        out[key] = val
+    return out
+
+
+class LrcErasureCode(ErasureCode):
+    def __init__(self):
+        super().__init__()
+        self.layers: list[Layer] = []
+        self.mapping = ""  # global D/_ string
+        self.ruleset_steps: list[tuple[str, str, int]] = []
+
+    # -- profile ------------------------------------------------------------
+
+    def init(self, profile: Mapping[str, str]) -> None:
+        profile = dict(profile)
+        if "k" in profile or "m" in profile or "l" in profile:
+            self._parse_kml(profile)
+        if "layers" not in profile:
+            raise ErasureCodeValidationError(
+                "LRC profile needs either layers+mapping or k/m/l"
+            )
+        if "mapping" not in profile:
+            raise ErasureCodeValidationError("LRC profile needs a mapping string")
+        self.mapping = profile["mapping"]
+        try:
+            descr = json.loads(profile["layers"])
+        except json.JSONDecodeError as e:
+            raise ErasureCodeValidationError(
+                f"layers is not valid JSON: {e}"
+            ) from e
+        if not isinstance(descr, list) or not descr:
+            raise ErasureCodeValidationError("layers must be a non-empty list")
+        self.layers = []
+        for entry in descr:
+            if not isinstance(entry, list) or not entry:
+                raise ErasureCodeValidationError(
+                    f"layer entry {entry!r} must be [chunks_map, profile]"
+                )
+            cmap = entry[0]
+            prof = _parse_layer_profile(entry[1] if len(entry) > 1 else "")
+            if len(cmap) != len(self.mapping):
+                raise ErasureCodeValidationError(
+                    f"layer map {cmap!r} length != mapping {self.mapping!r} length"
+                )
+            self.layers.append(Layer(cmap, prof))
+        self.k = sum(1 for ch in self.mapping if ch == "D")
+        self.m = len(self.mapping) - self.k
+        self.chunk_mapping = [i for i, ch in enumerate(self.mapping) if ch == "D"]
+        # every non-data position must be coding in exactly one layer
+        covered: set[int] = set()
+        for layer in self.layers:
+            dup = covered & set(layer.coding)
+            if dup:
+                raise ErasureCodeValidationError(
+                    f"chunk positions {sorted(dup)} are coding in multiple layers"
+                )
+            covered |= set(layer.coding)
+        missing = set(range(len(self.mapping))) - set(self.chunk_mapping) - covered
+        if missing:
+            raise ErasureCodeValidationError(
+                f"chunk positions {sorted(missing)} are neither data nor coding"
+            )
+        self._profile = dict(profile)
+
+    def _parse_kml(self, profile: dict) -> None:
+        for banned in ("mapping", "layers"):
+            if banned in profile:
+                raise ErasureCodeValidationError(
+                    f"the {banned} parameter cannot be set when k/m/l are set"
+                )
+        k = self.to_int("k", profile, -1)
+        m = self.to_int("m", profile, -1)
+        l = self.to_int("l", profile, -1)
+        if -1 in (k, m, l):
+            raise ErasureCodeValidationError("all of k, m, l must be set")
+        if (k + m) % l:
+            raise ErasureCodeValidationError("k + m must be a multiple of l")
+        groups = (k + m) // l
+        if k % groups or m % groups:
+            raise ErasureCodeValidationError(
+                "k and m must be multiples of (k + m) / l"
+            )
+        kg, mg = k // groups, m // groups
+        profile["mapping"] = ("D" * kg + "_" * mg + "_") * groups
+        layers = [["".join(("D" * kg + "c" * mg + "_") * groups), ""]]
+        for i in range(groups):
+            row = "".join(
+                ("D" * l + "c") if i == j else ("_" * (l + 1))
+                for j in range(groups)
+            )
+            layers.append([row, ""])
+        profile["layers"] = json.dumps(layers)
+        locality = profile.get("ruleset-locality", "")
+        failure_domain = profile.get("ruleset-failure-domain", "host")
+        if locality:
+            self.ruleset_steps = [
+                ("choose", locality, groups),
+                ("chooseleaf", failure_domain, l + 1),
+            ]
+        else:
+            self.ruleset_steps = [("chooseleaf", failure_domain, 0)]
+
+    # -- geometry -----------------------------------------------------------
+
+    def get_chunk_count(self) -> int:
+        return len(self.mapping)
+
+    def get_data_chunk_count(self) -> int:
+        return self.k
+
+    def get_alignment(self) -> int:
+        return max(
+            [128] + [layer.erasure_code.get_alignment() for layer in self.layers]
+        )
+
+    def get_chunk_size(self, stripe_width: int) -> int:
+        align = self.get_alignment()
+        per = (stripe_width + self.k - 1) // self.k
+        return (per + align - 1) // align * align
+
+    # -- encode -------------------------------------------------------------
+
+    def encode(
+        self, want_to_encode: Sequence[int], data: bytes | np.ndarray
+    ) -> dict[int, np.ndarray]:
+        chunks = self.encode_prepare(data)  # [k, C]
+        n = self.get_chunk_count()
+        C = chunks.shape[1]
+        full = np.zeros((n, C), dtype=np.uint8)
+        full[self.chunk_mapping] = chunks
+        for layer in self.layers:
+            parity = layer.erasure_code.encode_chunks(full[layer.data])
+            full[layer.coding] = parity
+        return {i: full[i] for i in want_to_encode}
+
+    def encode_chunks(self, data_chunks: np.ndarray) -> np.ndarray:
+        n = self.get_chunk_count()
+        C = data_chunks.shape[1]
+        full = np.zeros((n, C), dtype=np.uint8)
+        full[self.chunk_mapping] = np.asarray(data_chunks, dtype=np.uint8)
+        for layer in self.layers:
+            full[layer.coding] = layer.erasure_code.encode_chunks(full[layer.data])
+        coding_positions = [
+            i for i in range(n) if i not in set(self.chunk_mapping)
+        ]
+        return full[coding_positions]
+
+    # -- decode -------------------------------------------------------------
+
+    def minimum_to_decode(
+        self, want_to_read: Sequence[int], available: Sequence[int]
+    ) -> list[int]:
+        want = set(want_to_read)
+        avail = set(available)
+        erasures_not_recovered = set(range(self.get_chunk_count())) - avail
+        erasures_want = want & erasures_not_recovered
+        if not erasures_want:
+            return sorted(want)
+        minimum: set[int] = set()
+        for layer in reversed(self.layers):
+            layer_want = want & layer.chunks_as_set
+            if not layer_want:
+                continue
+            layer_erasures_want = layer_want & erasures_want
+            if not layer_erasures_want:
+                minimum |= layer_want
+                continue
+            erasures = layer.chunks_as_set & erasures_not_recovered
+            if len(erasures) > layer.erasure_code.get_coding_chunk_count():
+                continue  # too many for this layer; hope an upper layer helps
+            minimum |= layer.chunks_as_set - erasures_not_recovered
+            erasures_not_recovered -= erasures
+            erasures_want -= erasures
+        if erasures_want:
+            raise IOError(
+                f"cannot decode chunks {sorted(erasures_want)} from {sorted(avail)}"
+            )
+        minimum |= want & avail
+        minimum -= set(range(self.get_chunk_count())) - avail
+        return sorted(minimum)
+
+    def decode(
+        self, want_to_read: Sequence[int], chunks: Mapping[int, np.ndarray]
+    ) -> dict[int, np.ndarray]:
+        want = list(want_to_read)
+        have: dict[int, np.ndarray] = {
+            i: np.asarray(c, dtype=np.uint8) for i, c in chunks.items()
+        }
+        missing_want = [i for i in want if i not in have]
+        if not missing_want:
+            return {i: have[i] for i in want}
+        # iterate layers until no progress (reference :765)
+        progress = True
+        while progress and any(i not in have for i in want):
+            progress = False
+            for layer in reversed(self.layers):
+                layer_missing = [i for i in layer.chunks if i not in have]
+                if not layer_missing:
+                    continue
+                inner = layer.erasure_code
+                if len(layer_missing) > inner.get_coding_chunk_count():
+                    continue
+                present_local = [
+                    pos for pos, gi in enumerate(layer.chunks) if gi in have
+                ]
+                missing_local = [
+                    pos for pos, gi in enumerate(layer.chunks) if gi not in have
+                ]
+                if len(present_local) < inner.get_data_chunk_count():
+                    continue
+                try:
+                    stacked = np.stack([have[layer.chunks[p]] for p in present_local])
+                    rebuilt = inner.decode_chunks(
+                        present_local, stacked, missing_local
+                    )
+                except (IOError, ValueError):
+                    continue
+                for j, pos in enumerate(missing_local):
+                    have[layer.chunks[pos]] = np.asarray(rebuilt[j])
+                progress = True
+        still = [i for i in want if i not in have]
+        if still:
+            raise IOError(f"cannot decode chunks {still}")
+        return {i: have[i] for i in want}
+
+    def decode_chunks(
+        self, present: Sequence[int], chunks: np.ndarray, missing: Sequence[int]
+    ) -> np.ndarray:
+        got = self.decode(
+            list(missing),
+            {r: chunks[i] for i, r in enumerate(present)},
+        )
+        return np.stack([got[r] for r in missing])
+
+    def decode_concat(self, chunks: Mapping[int, np.ndarray]) -> bytes:
+        decoded = self.decode(self.chunk_mapping, chunks)
+        return b"".join(bytes(decoded[i]) for i in self.chunk_mapping)
+
+
+class ErasureCodePluginLrc(ErasureCodePlugin):
+    def factory(self, profile: Mapping[str, str]):
+        codec = LrcErasureCode()
+        codec.init(profile)
+        return codec
+
+
+def __erasure_code_init__(name: str, registry) -> None:
+    registry.add(name, ErasureCodePluginLrc())

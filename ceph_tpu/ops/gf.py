@@ -188,6 +188,58 @@ class GF:
                     inv[r, j] ^= self.mul(f, int(inv[col, j]))
         return inv
 
+    def solve(self, A: np.ndarray, T: np.ndarray) -> np.ndarray | None:
+        """Solve X @ A = T over GF(2^w); None if inconsistent.
+
+        A: [a, k] (rows spanning), T: [t, k].  Returns X [t, a] with free
+        variables set to 0 and pivots preferred in *earlier* rows of A (so
+        callers can bias which rows get used by ordering A).  This is the
+        engine behind non-MDS decode (SHEC's decoding-matrix search,
+        reference:src/erasure-code/shec/ErasureCodeShec.cc:547).
+        """
+        A = np.asarray(A, dtype=np.int64)
+        T = np.asarray(T, dtype=np.int64)
+        a, k = A.shape
+        t = T.shape[0]
+        assert T.shape[1] == k
+        # Gaussian elimination on [A^T | T^T]: k rows, a+t cols
+        M = np.concatenate([A.T, T.T], axis=1).astype(np.int64)
+        pivots: list[tuple[int, int]] = []  # (row_of_M, col<a)
+        row = 0
+        for col in range(a):
+            if row >= k:
+                break
+            piv = None
+            for r in range(row, k):
+                if M[r, col] != 0:
+                    piv = r
+                    break
+            if piv is None:
+                continue
+            if piv != row:
+                M[[row, piv]] = M[[piv, row]]
+            pv = int(M[row, col])
+            if pv != 1:
+                pinv = self.inv(pv)
+                for j in range(col, a + t):
+                    M[row, j] = self.mul(int(M[row, j]), pinv)
+            for r in range(k):
+                if r != row and M[r, col] != 0:
+                    f = int(M[r, col])
+                    for j in range(col, a + t):
+                        M[r, j] ^= self.mul(f, int(M[row, j]))
+            pivots.append((row, col))
+            row += 1
+        # consistency: rows of M beyond the pivot rows must have zero target
+        for r in range(row, k):
+            if np.any(M[r, a:] != 0):
+                return None
+        X = np.zeros((t, a), dtype=np.int64)
+        for prow, pcol in pivots:
+            for j in range(t):
+                X[j, pcol] = M[prow, a + j]
+        return X
+
     # -- bit-matrix support (cauchy/liberation family) ----------------------
 
     def bitmatrix_of(self, c: int) -> np.ndarray:
