@@ -1,0 +1,472 @@
+"""CRUSH map model + builder (CrushWrapper / builder.c analog).
+
+Pure-Python description of the placement hierarchy: devices (ids >= 0),
+buckets (ids < 0) of five algorithms, rules of interpreted steps, and the
+tunables that version the mapping behavior
+(reference:src/crush/crush.h:229-370, builder reference:src/crush/
+builder.c, C++ wrapper reference:src/crush/CrushWrapper.h).
+
+Derived bucket state (list cumulative sums, tree node weights, straw
+lengths) is computed at construction exactly as ``crush_make_bucket``
+does, so a map built here maps bit-identically to one built by the
+reference builder — verified against golden fixtures in
+tests/golden/crush_golden.json.
+
+All weights are 16.16 fixed point (0x10000 == 1.0).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Iterable, Sequence
+
+# bucket algorithms (reference:crush.h:140-190)
+CRUSH_BUCKET_UNIFORM = 1
+CRUSH_BUCKET_LIST = 2
+CRUSH_BUCKET_TREE = 3
+CRUSH_BUCKET_STRAW = 4
+CRUSH_BUCKET_STRAW2 = 5
+
+# rule step opcodes (reference:crush.h:55-69)
+CRUSH_RULE_NOOP = 0
+CRUSH_RULE_TAKE = 1
+CRUSH_RULE_CHOOSE_FIRSTN = 2
+CRUSH_RULE_CHOOSE_INDEP = 3
+CRUSH_RULE_EMIT = 4
+CRUSH_RULE_CHOOSELEAF_FIRSTN = 6
+CRUSH_RULE_CHOOSELEAF_INDEP = 7
+CRUSH_RULE_SET_CHOOSE_TRIES = 8
+CRUSH_RULE_SET_CHOOSELEAF_TRIES = 9
+CRUSH_RULE_SET_CHOOSE_LOCAL_TRIES = 10
+CRUSH_RULE_SET_CHOOSE_LOCAL_FALLBACK_TRIES = 11
+CRUSH_RULE_SET_CHOOSELEAF_VARY_R = 12
+CRUSH_RULE_SET_CHOOSELEAF_STABLE = 13
+
+# sentinel outputs (reference:crush.h:33-37)
+CRUSH_ITEM_UNDEF = 0x7FFFFFFE
+CRUSH_ITEM_NONE = 0x7FFFFFFF
+
+# rule types (pool replication strategy; reference:osd/osd_types.h pg_pool_t)
+RULE_TYPE_REPLICATED = 1
+RULE_TYPE_ERASURE = 3
+
+
+@dataclass
+class Bucket:
+    """Common bucket header (reference:crush.h:229)."""
+
+    id: int  # negative
+    type: int  # user-defined level (host/rack/root...)
+    alg: int
+    items: list[int]
+    weight: int = 0  # 16.16 total
+    hash: int = 0  # CRUSH_HASH_RJENKINS1
+
+    @property
+    def size(self) -> int:
+        return len(self.items)
+
+
+@dataclass
+class UniformBucket(Bucket):
+    """All items share one weight; O(1) perm choose (reference:crush.h:243)."""
+
+    item_weight: int = 0
+
+
+@dataclass
+class ListBucket(Bucket):
+    """Linear scan with cumulative sums (reference:crush.h:252)."""
+
+    item_weights: list[int] = field(default_factory=list)
+    sum_weights: list[int] = field(default_factory=list)  # cumulative 0..i
+
+
+@dataclass
+class TreeBucket(Bucket):
+    """Binary weight tree; items at odd nodes (reference:crush.h:261)."""
+
+    num_nodes: int = 0
+    node_weights: list[int] = field(default_factory=list)
+
+
+@dataclass
+class StrawBucket(Bucket):
+    """Legacy straw: precomputed straw lengths (reference:crush.h:271)."""
+
+    item_weights: list[int] = field(default_factory=list)
+    straws: list[int] = field(default_factory=list)  # 16.16
+
+
+@dataclass
+class Straw2Bucket(Bucket):
+    """straw2: ln-draw selection, weights used directly (crush.h:280)."""
+
+    item_weights: list[int] = field(default_factory=list)
+
+
+@dataclass
+class RuleStep:
+    op: int
+    arg1: int = 0
+    arg2: int = 0
+
+
+@dataclass
+class Rule:
+    """A placement rule (reference:crush.h:91): mask + step program."""
+
+    ruleset: int
+    type: int = RULE_TYPE_REPLICATED
+    min_size: int = 1
+    max_size: int = 10
+    steps: list[RuleStep] = field(default_factory=list)
+
+    def step(self, op: int, arg1: int = 0, arg2: int = 0) -> "Rule":
+        self.steps.append(RuleStep(op, arg1, arg2))
+        return self
+
+
+@dataclass
+class Tunables:
+    """Mapping-behavior knobs (reference:crush.h:319-370).
+
+    Defaults are the legacy (argonaut) values ``crush_create`` sets
+    (reference:builder.c:25-35); use the profile constructors for the
+    modern ones.
+    """
+
+    choose_local_tries: int = 2
+    choose_local_fallback_tries: int = 5
+    choose_total_tries: int = 19
+    chooseleaf_descend_once: int = 0
+    chooseleaf_vary_r: int = 0
+    chooseleaf_stable: int = 0
+    straw_calc_version: int = 0
+
+    @classmethod
+    def legacy(cls) -> "Tunables":
+        return cls()
+
+    @classmethod
+    def bobtail(cls) -> "Tunables":
+        return cls(0, 0, 50, 1, 0, 0, 0)
+
+    @classmethod
+    def firefly(cls) -> "Tunables":
+        return cls(0, 0, 50, 1, 1, 0, 1)
+
+    @classmethod
+    def jewel(cls) -> "Tunables":
+        """aka "optimal" at the reference version."""
+        return cls(0, 0, 50, 1, 1, 1, 1)
+
+
+class CrushMap:
+    """The placement map: buckets + rules + tunables + name tables.
+
+    Combines ``crush_map`` (reference:crush.h:299) with the builder and
+    the name/type bookkeeping of ``CrushWrapper``
+    (reference:src/crush/CrushWrapper.h).
+    """
+
+    def __init__(self, tunables: Tunables | None = None):
+        self.buckets: dict[int, Bucket] = {}  # id (negative) -> bucket
+        self.rules: list[Rule | None] = []
+        self.tunables = tunables or Tunables.jewel()
+        self.type_names: dict[int, str] = {0: "osd"}
+        self.item_names: dict[int, str] = {}
+
+    # -- structure queries -------------------------------------------------
+    @property
+    def max_buckets(self) -> int:
+        return max((-b for b in self.buckets), default=0)
+
+    @property
+    def max_devices(self) -> int:
+        md = 0
+        for b in self.buckets.values():
+            for i in b.items:
+                if i >= 0:
+                    md = max(md, i + 1)
+        return md
+
+    @property
+    def max_rules(self) -> int:
+        return len(self.rules)
+
+    def devices(self) -> list[int]:
+        out = set()
+        for b in self.buckets.values():
+            out.update(i for i in b.items if i >= 0)
+        return sorted(out)
+
+    # -- builder -----------------------------------------------------------
+    def _next_bucket_id(self) -> int:
+        i = -1
+        while i in self.buckets:
+            i -= 1
+        return i
+
+    def make_bucket(
+        self,
+        alg: int,
+        type: int,
+        items: Sequence[int],
+        weights: Sequence[int],
+        bucket_id: int | None = None,
+        name: str | None = None,
+    ) -> int:
+        """Create a bucket with derived state, add it, return its id.
+
+        Mirrors crush_make_bucket + crush_add_bucket
+        (reference:builder.c:368,595,833,1070).
+        """
+        if bucket_id is None:
+            bucket_id = self._next_bucket_id()
+        if bucket_id >= 0 or bucket_id in self.buckets:
+            raise ValueError(f"bad bucket id {bucket_id}")
+        items = list(items)
+        weights = list(weights)
+        if len(items) != len(weights):
+            raise ValueError("items/weights length mismatch")
+
+        if alg == CRUSH_BUCKET_UNIFORM:
+            iw = weights[0] if weights else 0
+            if any(w != iw for w in weights):
+                raise ValueError("uniform bucket requires equal weights")
+            b: Bucket = UniformBucket(
+                bucket_id, type, alg, items, iw * len(items), item_weight=iw
+            )
+        elif alg == CRUSH_BUCKET_LIST:
+            sums, acc = [], 0
+            for w in weights:
+                acc += w
+                sums.append(acc)
+            b = ListBucket(
+                bucket_id, type, alg, items, acc,
+                item_weights=weights, sum_weights=sums,
+            )
+        elif alg == CRUSH_BUCKET_TREE:
+            b = self._make_tree(bucket_id, type, items, weights)
+        elif alg == CRUSH_BUCKET_STRAW:
+            straws = calc_straws(weights, self.tunables.straw_calc_version)
+            b = StrawBucket(
+                bucket_id, type, alg, items, sum(weights),
+                item_weights=weights, straws=straws,
+            )
+        elif alg == CRUSH_BUCKET_STRAW2:
+            b = Straw2Bucket(
+                bucket_id, type, alg, items, sum(weights),
+                item_weights=weights,
+            )
+        else:
+            raise ValueError(f"unknown bucket alg {alg}")
+
+        self.buckets[bucket_id] = b
+        if name:
+            self.item_names[bucket_id] = name
+        return bucket_id
+
+    @staticmethod
+    def _make_tree(bucket_id, type, items, weights) -> TreeBucket:
+        """Binary tree layout: item i at node 2i+1, internal nodes sum
+        children (reference:builder.c:320 calc_depth, :368)."""
+        size = len(items)
+        if size == 0:
+            return TreeBucket(bucket_id, type, CRUSH_BUCKET_TREE, [], 0)
+        depth = 1
+        t = size - 1
+        while t:
+            t >>= 1
+            depth += 1
+        num_nodes = 1 << depth
+        node_weights = [0] * num_nodes
+
+        def fill(n: int) -> int:
+            if n & 1:  # terminal
+                i = n >> 1
+                node_weights[n] = weights[i] if i < size else 0
+            else:
+                h = 0
+                m = n
+                while (m & 1) == 0:
+                    h += 1
+                    m >>= 1
+                node_weights[n] = fill(n - (1 << (h - 1))) + fill(
+                    n + (1 << (h - 1))
+                )
+            return node_weights[n]
+
+        total = fill(num_nodes >> 1)
+        return TreeBucket(
+            bucket_id, type, CRUSH_BUCKET_TREE, list(items), total,
+            num_nodes=num_nodes, node_weights=node_weights,
+        )
+
+    def add_rule(self, rule: Rule, ruleno: int | None = None) -> int:
+        if ruleno is None:
+            ruleno = len(self.rules)
+        while len(self.rules) <= ruleno:
+            self.rules.append(None)
+        self.rules[ruleno] = rule
+        return ruleno
+
+    def find_rule(self, ruleset: int, type: int, size: int) -> int:
+        """reference:mapper.c:41."""
+        for i, r in enumerate(self.rules):
+            if (r and r.ruleset == ruleset and r.type == type
+                    and r.min_size <= size <= r.max_size):
+                return i
+        return -1
+
+    def add_simple_rule(
+        self,
+        root_id: int,
+        fault_domain_type: int,
+        rule_type: int = RULE_TYPE_REPLICATED,
+        ruleset: int | None = None,
+        indep: bool = False,
+        max_size: int = 10,
+    ) -> int:
+        """CrushWrapper::add_simple_ruleset analog: take root, chooseleaf
+        across ``fault_domain_type``, emit."""
+        if ruleset is None:
+            used = {r.ruleset for r in self.rules if r}
+            ruleset = 0
+            while ruleset in used:
+                ruleset += 1
+        op = CRUSH_RULE_CHOOSELEAF_INDEP if indep else CRUSH_RULE_CHOOSELEAF_FIRSTN
+        if fault_domain_type == 0:
+            op = CRUSH_RULE_CHOOSE_INDEP if indep else CRUSH_RULE_CHOOSE_FIRSTN
+        r = Rule(ruleset, rule_type, 1, max_size)
+        if indep:
+            r.step(CRUSH_RULE_SET_CHOOSELEAF_TRIES, 5)
+        r.step(CRUSH_RULE_TAKE, root_id)
+        r.step(op, 0, fault_domain_type)
+        r.step(CRUSH_RULE_EMIT)
+        return self.add_rule(r)
+
+    # -- convenience constructors -----------------------------------------
+    @classmethod
+    def flat(
+        cls,
+        n_devices: int,
+        weight: float = 1.0,
+        alg: int = CRUSH_BUCKET_STRAW2,
+        tunables: Tunables | None = None,
+    ) -> "CrushMap":
+        """One root bucket holding n devices — the vstart dev-cluster shape."""
+        m = cls(tunables)
+        w = int(weight * 0x10000)
+        m.type_names[1] = "root"
+        m.make_bucket(alg, 1, range(n_devices), [w] * n_devices,
+                      name="default")
+        return m
+
+    @classmethod
+    def hierarchical(
+        cls,
+        hosts: "list[Sequence[int]] | dict[str, Sequence[int]]",
+        alg: int = CRUSH_BUCKET_STRAW2,
+        tunables: Tunables | None = None,
+    ) -> "CrushMap":
+        """hosts: list of device-id lists (or dict name -> list). Builds
+        host buckets under one straw2 root, types osd=0/host=1/root=2."""
+        m = cls(tunables)
+        m.type_names.update({1: "host", 2: "root"})
+        if isinstance(hosts, dict):
+            named = list(hosts.items())
+        else:
+            named = [(f"host{i}", devs) for i, devs in enumerate(hosts)]
+        host_ids, host_weights = [], []
+        for name, devs in named:
+            w = [0x10000] * len(devs)
+            hid = m.make_bucket(alg, 1, devs, w, name=name)
+            host_ids.append(hid)
+            host_weights.append(m.buckets[hid].weight)
+        m.make_bucket(alg, 2, host_ids, host_weights, name="default")
+        return m
+
+    def root_id(self, name: str = "default") -> int:
+        for bid, n in self.item_names.items():
+            if n == name:
+                return bid
+        # fall back: the bucket that is nobody's child
+        children = {i for b in self.buckets.values() for i in b.items}
+        roots = [bid for bid in self.buckets if bid not in children]
+        if len(roots) == 1:
+            return roots[0]
+        raise KeyError(name)
+
+    def get_weights(self, out: Iterable[int] = (), reweight: dict[int, float] | None = None) -> list[int]:
+        """Device in/out weight vector for do_rule (OSDMap osd_weight analog).
+
+        Full-in (0x10000) for every device, 0 for ``out`` ones, scaled by
+        ``reweight`` fractions.
+        """
+        w = [0x10000] * self.max_devices
+        for d in out:
+            w[d] = 0
+        for d, f in (reweight or {}).items():
+            w[d] = int(f * 0x10000)
+        return w
+
+
+def calc_straws(weights: Sequence[int], version: int = 0) -> list[int]:
+    """Straw lengths for legacy straw buckets (reference:builder.c:440).
+
+    Reverse-sorts by weight then scales each straw so that draw
+    probabilities match the weight ratios; version 1 fixes the
+    equal-weight/zero-weight accounting (straw_calc_version tunable).
+    """
+    size = len(weights)
+    straws = [0] * size
+    # insertion sort producing the reference's exact order for ties
+    reverse = [0] * size
+    if size:
+        reverse[0] = 0
+    for i in range(1, size):
+        j = 0
+        while j < i:
+            if weights[i] < weights[reverse[j]]:
+                for k in range(i, j, -1):
+                    reverse[k] = reverse[k - 1]
+                reverse[j] = i
+                break
+            j += 1
+        if j == i:
+            reverse[i] = i
+
+    numleft = size
+    straw = 1.0
+    wbelow = 0.0
+    lastw = 0.0
+    i = 0
+    while i < size:
+        if weights[reverse[i]] == 0:
+            straws[reverse[i]] = 0
+            i += 1
+            if version >= 1:
+                numleft -= 1
+            continue
+        straws[reverse[i]] = int(straw * 0x10000)
+        i += 1
+        if i == size:
+            break
+        if version == 0 and weights[reverse[i]] == weights[reverse[i - 1]]:
+            continue
+        wbelow += (weights[reverse[i - 1]] - lastw) * numleft
+        if version == 0:
+            j = i
+            while j < size and weights[reverse[j]] == weights[reverse[i]]:
+                numleft -= 1
+                j += 1
+        else:
+            numleft -= 1
+        wnext = numleft * (weights[reverse[i]] - weights[reverse[i - 1]])
+        pbelow = wbelow / (wbelow + wnext)
+        straw *= math.pow(1.0 / pbelow, 1.0 / numleft)
+        lastw = weights[reverse[i - 1]]
+    return straws

@@ -171,18 +171,10 @@ class LrcErasureCode(ErasureCode):
     def get_chunk_count(self) -> int:
         return len(self.mapping)
 
-    def get_data_chunk_count(self) -> int:
-        return self.k
-
     def get_alignment(self) -> int:
         return max(
             [128] + [layer.erasure_code.get_alignment() for layer in self.layers]
         )
-
-    def get_chunk_size(self, stripe_width: int) -> int:
-        align = self.get_alignment()
-        per = (stripe_width + self.k - 1) // self.k
-        return (per + align - 1) // align * align
 
     # -- encode -------------------------------------------------------------
 
@@ -206,9 +198,8 @@ class LrcErasureCode(ErasureCode):
         full[self.chunk_mapping] = np.asarray(data_chunks, dtype=np.uint8)
         for layer in self.layers:
             full[layer.coding] = layer.erasure_code.encode_chunks(full[layer.data])
-        coding_positions = [
-            i for i in range(n) if i not in set(self.chunk_mapping)
-        ]
+        data_positions = set(self.chunk_mapping)
+        coding_positions = [i for i in range(n) if i not in data_positions]
         return full[coding_positions]
 
     # -- decode -------------------------------------------------------------
@@ -222,26 +213,33 @@ class LrcErasureCode(ErasureCode):
         erasures_want = want & erasures_not_recovered
         if not erasures_want:
             return sorted(want)
+        # iterate layers to a fixed point, exactly like decode() (reference
+        # :765): a layer may only become decodable after another layer
+        # recovered one of its chunks (e.g. global recovers a data chunk,
+        # then the local layer rebuilds its parity).  Locals come first
+        # (reversed), so a single-local-group read wins when possible.
         minimum: set[int] = set()
-        for layer in reversed(self.layers):
-            layer_want = want & layer.chunks_as_set
-            if not layer_want:
-                continue
-            layer_erasures_want = layer_want & erasures_want
-            if not layer_erasures_want:
-                minimum |= layer_want
-                continue
-            erasures = layer.chunks_as_set & erasures_not_recovered
-            if len(erasures) > layer.erasure_code.get_coding_chunk_count():
-                continue  # too many for this layer; hope an upper layer helps
-            minimum |= layer.chunks_as_set - erasures_not_recovered
-            erasures_not_recovered -= erasures
-            erasures_want -= erasures
+        progress = True
+        while erasures_want and progress:
+            progress = False
+            for layer in reversed(self.layers):
+                erasures = layer.chunks_as_set & erasures_not_recovered
+                if not erasures:
+                    continue
+                if len(erasures) > layer.erasure_code.get_coding_chunk_count():
+                    continue  # too many for this layer this round
+                minimum |= layer.chunks_as_set - erasures_not_recovered
+                erasures_not_recovered -= erasures
+                erasures_want -= erasures
+                progress = True
+                if not erasures_want:
+                    break
         if erasures_want:
             raise IOError(
                 f"cannot decode chunks {sorted(erasures_want)} from {sorted(avail)}"
             )
         minimum |= want & avail
+        # recovered-in-flight chunks are reconstructed, not read
         minimum -= set(range(self.get_chunk_count())) - avail
         return sorted(minimum)
 

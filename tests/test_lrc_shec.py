@@ -25,12 +25,15 @@ def make(plugin, profile):
 class TestLrc:
     def test_kml_generation(self):
         codec = make("lrc", {"k": "4", "m": "2", "l": "3"})
-        # groups = (4+2)/3 = 2 -> mapping DD__ DD__ with group width l+1
+        # groups = (4+2)/3 = 2 -> group width l+1 = 4, 2 data + 2 parity each
         assert codec.get_chunk_count() == 8
         assert codec.get_data_chunk_count() == 4
-        assert codec.mapping == "DD___DD___"[: codec.get_chunk_count()] or True
-        # layer 0 global, layers 1..2 local
+        assert codec.mapping == "DD__DD__"
+        # layer 0 global (DDc_DDc_), layers 1..2 local (DDDc / ____DDDc)
         assert len(codec.layers) == 3
+        assert codec.layers[0].chunks_map == "DDc_DDc_"
+        assert codec.layers[1].chunks_map == "DDDc____"
+        assert codec.layers[2].chunks_map == "____DDDc"
 
     def test_kml_validation(self):
         with pytest.raises(ErasureCodeValidationError):
@@ -73,20 +76,10 @@ class TestLrc:
             assert np.array_equal(dec[i], enc[i])
 
     def test_explicit_layers(self):
-        layers = json.dumps(
-            [
-                ["DDc_DDc_", ""],
-                ["DDDc____"[:8], ""],
-            ]
-        )
-        # positions: 0,1 D; 2 c; 3 ...: craft a simple 2-layer code
+        # one explicit layer covering every position: k=4 m=4 inner code
         profile = {
-            "mapping": "DD__DD__"[:8],
-            "layers": json.dumps(
-                [
-                    ["DDccDDcc"[:8], ""],
-                ]
-            ),
+            "mapping": "DD__DD__",
+            "layers": json.dumps([["DDccDDcc", ""]]),
         }
         # mapping has 4 D, layer covers all positions: k=4 m=4 inner
         codec = make("lrc", profile)
@@ -101,6 +94,34 @@ class TestLrc:
                 "lrc",
                 {"mapping": "DD__", "layers": json.dumps([["DDc_", ""]])},
             )
+
+    def test_minimum_to_decode_iterates_layers(self):
+        """Patterns needing global-then-local recovery must not raise.
+
+        Losing a data chunk plus its group's local parity ({0, 3}) defeats
+        the local layer alone (2 erasures > its m=1), but the global layer
+        recovers chunk 0 and then the local layer rebuilds parity 3 —
+        minimum_to_decode must iterate to that fixed point like decode().
+        """
+        codec = make("lrc", {"k": "4", "m": "2", "l": "3"})
+        n = codec.get_chunk_count()
+        payload = RNG.integers(0, 256, size=4096, dtype=np.uint8).tobytes()
+        enc = codec.encode(range(n), payload)
+        for lost in itertools.combinations(range(n), 2):
+            avail = [i for i in range(n) if i not in lost]
+            try:
+                dec = codec.decode(list(lost), {i: enc[i] for i in avail})
+            except IOError:
+                with pytest.raises(IOError):
+                    codec.minimum_to_decode(list(lost), avail)
+                continue
+            # recoverable => minimum_to_decode agrees and its read set
+            # really is sufficient
+            minimum = codec.minimum_to_decode(list(lost), avail)
+            assert set(minimum) <= set(avail), lost
+            dec2 = codec.decode(list(lost), {i: enc[i] for i in minimum})
+            for i in lost:
+                assert np.array_equal(dec2[i], enc[i]), lost
 
     def test_unrecoverable(self):
         codec = make("lrc", {"k": "4", "m": "2", "l": "3"})
