@@ -127,9 +127,9 @@ class ErasureCode(ErasureCodeInterface):
 
     def encode_prepare(self, data: bytes | np.ndarray) -> np.ndarray:
         """Zero-pad + split object bytes into a [k, chunk_size] uint8 array."""
-        buf = np.frombuffer(bytes(data), dtype=np.uint8) if isinstance(
-            data, (bytes, bytearray, memoryview)
-        ) else np.asarray(data, dtype=np.uint8).reshape(-1)
+        from ..utils.buffers import as_u8
+
+        buf = as_u8(data)
         chunk = self.get_chunk_size(buf.size)
         padded = np.zeros(self.k * chunk, dtype=np.uint8)
         padded[: buf.size] = buf

@@ -1,0 +1,1 @@
+"""OSD-side data path: stripe algebra, cluster map, PG/EC backend."""

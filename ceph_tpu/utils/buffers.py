@@ -1,0 +1,19 @@
+"""Byte-buffer coercion shared across codec/stripe/crc paths.
+
+The framework's bufferlist analog is just contiguous uint8 numpy arrays
+(reference keeps refcounted bufferlists, src/include/buffer.h; on TPU we
+want flat host arrays that device_put without a copy).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def as_u8(data) -> np.ndarray:
+    """Coerce bytes-like or array-like to a contiguous flat uint8 array."""
+    if isinstance(data, np.ndarray):
+        return np.ascontiguousarray(data, dtype=np.uint8).reshape(-1)
+    if isinstance(data, (bytes, bytearray, memoryview)):
+        return np.frombuffer(bytes(data), dtype=np.uint8)
+    return np.ascontiguousarray(np.asarray(data, dtype=np.uint8)).reshape(-1)

@@ -59,6 +59,10 @@ def lib() -> ctypes.CDLL:
                 ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8),
                 ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
             ]
+            _lib.crc32c_sw.argtypes = [
+                ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ]
+            _lib.crc32c_sw.restype = ctypes.c_uint32
         return _lib
 
 
@@ -80,6 +84,17 @@ def encode(matrix: np.ndarray, data: np.ndarray, w: int = 8) -> np.ndarray:
         _u8ptr(data), _u8ptr(parity), data.shape[1],
     )
     return parity
+
+
+def crc32c(crc: int, data: bytes | np.ndarray) -> int:
+    """crc32c (Castagnoli) with ceph_crc32c semantics: seed used raw, no
+    pre/post inversion, so crcs compose across appends."""
+    from .buffers import as_u8
+
+    buf = as_u8(data)
+    if buf.size == 0:
+        return crc & 0xFFFFFFFF
+    return int(lib().crc32c_sw(crc & 0xFFFFFFFF, _u8ptr(buf), buf.size))
 
 
 def mul_region(c: int, src: np.ndarray) -> np.ndarray:

@@ -130,3 +130,48 @@ void gf16_encode_flat(const int* matrix, int k, int m, const uint8_t* data,
 }
 
 }  // extern "C"
+
+// crc32c (Castagnoli, reflected poly 0x82F63B78), slicing-by-8.
+// Same semantics as the reference's ceph_crc32c(crc, data, len): the seed is
+// used as-is with no pre/post inversion (callers conventionally pass -1), so
+// crcs compose: crc(a+b) = crc32c(crc32c(seed, a), b).
+// reference:src/common/crc32c.h / common/crc32c_sctp.c (software path).
+namespace {
+
+struct Crc32cTables {
+  uint32_t t[8][256];
+  Crc32cTables() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int j = 0; j < 8; ++j) c = (c & 1) ? (c >> 1) ^ 0x82F63B78u : c >> 1;
+      t[0][i] = c;
+    }
+    for (uint32_t i = 0; i < 256; ++i)
+      for (int s = 1; s < 8; ++s)
+        t[s][i] = (t[s - 1][i] >> 8) ^ t[0][t[s - 1][i] & 0xff];
+  }
+};
+static const Crc32cTables kCrcTab;
+
+}  // namespace
+
+extern "C" {
+
+uint32_t crc32c_sw(uint32_t crc, const uint8_t* data, int64_t n) {
+  const uint32_t (*T)[256] = kCrcTab.t;
+  while (n >= 8) {
+    uint64_t word;
+    std::memcpy(&word, data, 8);
+    word ^= crc;
+    crc = T[7][word & 0xff] ^ T[6][(word >> 8) & 0xff] ^
+          T[5][(word >> 16) & 0xff] ^ T[4][(word >> 24) & 0xff] ^
+          T[3][(word >> 32) & 0xff] ^ T[2][(word >> 40) & 0xff] ^
+          T[1][(word >> 48) & 0xff] ^ T[0][(word >> 56) & 0xff];
+    data += 8;
+    n -= 8;
+  }
+  while (n-- > 0) crc = (crc >> 8) ^ T[0][(crc ^ *data++) & 0xff];
+  return crc;
+}
+
+}  // extern "C"
