@@ -99,7 +99,17 @@ def encode(
             f"data size {buf.size} not a multiple of stripe_width {sinfo.stripe_width}"
         )
     k, m = ec_impl.get_data_chunk_count(), ec_impl.get_coding_chunk_count()
-    assert k == sinfo.k
+    if k != sinfo.k:
+        raise ValueError(f"codec k={k} != stripe k={sinfo.k}")
+    # chunk_size must respect the codec's alignment (w*packetsize for
+    # bitmatrix codecs) or the batched layout would packetize across stripe
+    # boundaries and diverge from the reference per-stripe bytes.
+    align = getattr(ec_impl, "get_alignment", lambda: 1)()
+    if sinfo.chunk_size % align != 0:
+        raise ValueError(
+            f"chunk_size {sinfo.chunk_size} not a multiple of codec "
+            f"alignment {align}"
+        )
     S = buf.size // sinfo.stripe_width
     cs = sinfo.chunk_size
     # [S, k, cs] -> [k, S*cs]: shard i's buffer is its chunk from each stripe
@@ -181,10 +191,10 @@ class HashInfo:
             raise ValueError(
                 f"append at {old_size} but total_chunk_size={self.total_chunk_size}"
             )
-        if len(to_append) != len(self.cumulative_shard_hashes):
+        if sorted(to_append) != list(range(len(self.cumulative_shard_hashes))):
             raise ValueError(
-                f"append covers {sorted(to_append)} but HashInfo tracks "
-                f"{len(self.cumulative_shard_hashes)} shards"
+                f"append covers shards {sorted(to_append)} but HashInfo tracks "
+                f"0..{len(self.cumulative_shard_hashes) - 1}"
             )
         sizes = {np.asarray(v).size for v in to_append.values()}
         if len(sizes) != 1:

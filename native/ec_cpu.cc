@@ -159,6 +159,7 @@ extern "C" {
 
 uint32_t crc32c_sw(uint32_t crc, const uint8_t* data, int64_t n) {
   const uint32_t (*T)[256] = kCrcTab.t;
+#if defined(__BYTE_ORDER__) && __BYTE_ORDER__ == __ORDER_LITTLE_ENDIAN__
   while (n >= 8) {
     uint64_t word;
     std::memcpy(&word, data, 8);
@@ -170,6 +171,7 @@ uint32_t crc32c_sw(uint32_t crc, const uint8_t* data, int64_t n) {
     data += 8;
     n -= 8;
   }
+#endif  // big-endian hosts take the bytewise loop for all input
   while (n-- > 0) crc = (crc >> 8) ^ T[0][(crc ^ *data++) & 0xff];
   return crc;
 }

@@ -1,0 +1,120 @@
+"""Benchmark CLI, sweep, and parity non-regression corpus checks.
+
+The corpus check is the framework's analog of the reference's
+ceph-erasure-code-corpus gate (reference:src/test/erasure-code/
+ceph_erasure_code_non_regression.cc:226): any kernel/matrix change that
+alters output bytes fails here.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from ceph_tpu.tools import ec_benchmark, ec_non_regression
+
+CORPUS = pathlib.Path(__file__).parent / "golden" / "ec_corpus"
+
+
+class TestBenchmarkCLI:
+    def run_cli(self, *argv):
+        out = subprocess.run(
+            [sys.executable, "-m", "ceph_tpu.tools.ec_benchmark", *argv],
+            capture_output=True, text=True, cwd=str(pathlib.Path(__file__).parent.parent),
+            env={"PATH": "/usr/bin:/bin", "CEPH_TPU_NO_JIT": "1",
+                 "HOME": "/root"},
+        )
+        assert out.returncode == 0, out.stderr
+        return out.stdout.strip()
+
+    def test_encode_output_format(self):
+        line = self.run_cli(
+            "--plugin", "jerasure", "--parameter", "k=2", "--parameter", "m=1",
+            "--parameter", "technique=reed_sol_van",
+            "--workload", "encode", "--size", "4096", "--iterations", "3",
+        )
+        seconds, kib = line.split("\t")
+        assert float(seconds) > 0
+        assert int(kib) == 4096 * 3 // 1024
+
+    def test_decode_random_erasures(self):
+        line = self.run_cli(
+            "--plugin", "jerasure", "--parameter", "k=4", "--parameter", "m=2",
+            "--parameter", "technique=reed_sol_van",
+            "--workload", "decode", "--size", "4096", "--iterations", "4",
+            "--erasures", "2",
+        )
+        seconds, kib = line.split("\t")
+        assert int(kib) == 16
+
+    def test_decode_exhaustive_inprocess(self):
+        args = ec_benchmark.parse_args([
+            "--plugin", "jerasure", "--parameter", "k=2", "--parameter", "m=1",
+            "--parameter", "technique=reed_sol_van",
+            "--workload", "decode", "--size", "2048", "--iterations", "3",
+            "--erasures", "1", "--erasures-generation", "exhaustive",
+        ])
+        from ceph_tpu.models import registry
+        codec = registry.instance().factory(
+            "jerasure", ec_benchmark.make_profile(args.parameter))
+        elapsed, total = ec_benchmark.run_decode(codec, args)
+        assert total == 2048 * 3
+
+    def test_batched_encode(self):
+        args = ec_benchmark.parse_args([
+            "--plugin", "isa", "--parameter", "k=8", "--parameter", "m=3",
+            "--workload", "encode", "--size", "8192", "--iterations", "2",
+            "--batch", "4",
+        ])
+        from ceph_tpu.models import registry
+        codec = registry.instance().factory(
+            "isa", ec_benchmark.make_profile(args.parameter))
+        elapsed, total = ec_benchmark.run_encode(codec, args)
+        assert total == 8192 * 2 * 4
+
+    def test_bad_parameter_rejected(self):
+        with pytest.raises(SystemExit):
+            ec_benchmark.make_profile(["notkv"])
+
+
+class TestSweep:
+    def test_quick_sweep_cells(self, capsys):
+        from ceph_tpu.tools import bench_sweep
+        bench_sweep.main(["--quick", "--size", "2048", "--workloads", "encode"])
+        lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+        # 2 plugins x 2 techniques x 2 k-values x 1 workload
+        assert len(lines) == 8
+        for cell in lines:
+            assert "error" not in cell, cell
+            assert cell["gbps"] > 0
+
+
+class TestNonRegressionCorpus:
+    def test_corpus_exists(self):
+        assert CORPUS.is_dir()
+        assert len(list(CORPUS.iterdir())) >= 10
+
+    @pytest.mark.parametrize(
+        "d", sorted(CORPUS.iterdir()), ids=lambda d: d.name
+    )
+    def test_parity_bytes_stable(self, d):
+        ec_non_regression.check(d)
+
+    def test_check_detects_regression(self, tmp_path):
+        # corrupt a copied corpus entry; check must fail
+        import shutil
+
+        src = CORPUS / "jerasure-4096-k=2-m=1-technique=reed_sol_van"
+        dst = tmp_path / src.name
+        shutil.copytree(src, dst)
+        manifest = json.loads((dst / "manifest.json").read_text())
+        import base64
+
+        chunk = bytearray(base64.b64decode(manifest["chunks"]["2"]))
+        chunk[0] ^= 0xFF
+        manifest["chunks"]["2"] = base64.b64encode(bytes(chunk)).decode()
+        (dst / "manifest.json").write_text(json.dumps(manifest))
+        with pytest.raises(SystemExit, match="differ"):
+            ec_non_regression.check(dst)
