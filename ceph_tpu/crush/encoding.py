@@ -1,0 +1,79 @@
+"""CrushMap ⇄ plain-dict encoding.
+
+The reference ships binary encode/decode on ``CrushWrapper``
+(reference:src/crush/CrushWrapper.h encode/decode) so maps travel inside
+OSDMap epochs and crushtool files.  Here the wire form is a JSON-able
+dict (the messenger layer does the byte framing); the shape is stable and
+covers every bucket variant, rules, tunables, and name tables.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from .map import (
+    Bucket,
+    CrushMap,
+    ListBucket,
+    Rule,
+    RuleStep,
+    StrawBucket,
+    Straw2Bucket,
+    TreeBucket,
+    Tunables,
+    UniformBucket,
+    CRUSH_BUCKET_LIST,
+    CRUSH_BUCKET_STRAW,
+    CRUSH_BUCKET_STRAW2,
+    CRUSH_BUCKET_TREE,
+    CRUSH_BUCKET_UNIFORM,
+)
+
+_BUCKET_CLASSES = {
+    CRUSH_BUCKET_UNIFORM: UniformBucket,
+    CRUSH_BUCKET_LIST: ListBucket,
+    CRUSH_BUCKET_TREE: TreeBucket,
+    CRUSH_BUCKET_STRAW: StrawBucket,
+    CRUSH_BUCKET_STRAW2: Straw2Bucket,
+}
+
+
+def crush_to_dict(cmap: CrushMap) -> dict:
+    return {
+        "tunables": dataclasses.asdict(cmap.tunables),
+        "buckets": [dataclasses.asdict(b) for b in cmap.buckets.values()],
+        "rules": [
+            None if r is None else {
+                "ruleset": r.ruleset,
+                "type": r.type,
+                "min_size": r.min_size,
+                "max_size": r.max_size,
+                "steps": [[s.op, s.arg1, s.arg2] for s in r.steps],
+            }
+            for r in cmap.rules
+        ],
+        "type_names": {str(k): v for k, v in cmap.type_names.items()},
+        "item_names": {str(k): v for k, v in cmap.item_names.items()},
+    }
+
+
+def crush_from_dict(d: dict) -> CrushMap:
+    cmap = CrushMap(Tunables(**d["tunables"]))
+    for bd in d["buckets"]:
+        cls = _BUCKET_CLASSES.get(bd["alg"], Bucket)
+        fields = {f.name for f in dataclasses.fields(cls)}
+        bucket = cls(**{k: v for k, v in bd.items() if k in fields})
+        cmap.buckets[bucket.id] = bucket
+    for rd in d["rules"]:
+        if rd is None:
+            cmap.rules.append(None)
+            continue
+        rule = Rule(
+            ruleset=rd["ruleset"], type=rd["type"],
+            min_size=rd["min_size"], max_size=rd["max_size"],
+            steps=[RuleStep(*s) for s in rd["steps"]],
+        )
+        cmap.rules.append(rule)
+    cmap.type_names = {int(k): v for k, v in d["type_names"].items()}
+    cmap.item_names = {int(k): v for k, v in d["item_names"].items()}
+    return cmap

@@ -79,6 +79,15 @@ class ErasureCode(ErasureCodeInterface):
         """Per-chunk byte alignment; subclasses may tighten (e.g. packets)."""
         return TPU_ALIGN
 
+    def batch_alignment(self) -> int:
+        """Chunk-size granularity at which batching many stripes into one
+        [k, S*chunk] call is byte-identical to a per-stripe loop.
+
+        1 for columnwise (matrix) codecs; packetized codecs override with
+        w*packetsize so packets never span stripe boundaries.
+        """
+        return 1
+
     def get_chunk_size(self, stripe_width: int) -> int:
         align = self.get_alignment()
         per = (stripe_width + self.k - 1) // self.k

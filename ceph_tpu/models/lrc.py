@@ -176,6 +176,14 @@ class LrcErasureCode(ErasureCode):
             [128] + [layer.erasure_code.get_alignment() for layer in self.layers]
         )
 
+    def batch_alignment(self) -> int:
+        import math
+
+        out = 1
+        for layer in self.layers:
+            out = math.lcm(out, layer.erasure_code.batch_alignment())
+        return out
+
     # -- encode -------------------------------------------------------------
 
     def encode(

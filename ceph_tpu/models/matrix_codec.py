@@ -161,6 +161,9 @@ class BitmatrixErasureCode(ErasureCode):
     def get_alignment(self) -> int:
         return self.w * self.packetsize
 
+    def batch_alignment(self) -> int:
+        return self.w * self.packetsize
+
     # -- packet layout: [n, C] -> [n*w, B*ps] --------------------------------
 
     def _to_packets(self, chunks: np.ndarray) -> np.ndarray:

@@ -84,6 +84,19 @@ class StripeInfo:
 # -- batched stripe math -----------------------------------------------------
 
 
+def _check_batch_alignment(sinfo: StripeInfo, ec_impl) -> None:
+    """Packetized (bitmatrix) codecs need chunk_size % (w*packetsize) == 0 or
+    batched packets would span stripe boundaries and diverge from the
+    reference per-stripe bytes; columnwise matrix codecs are exact at any
+    chunk size (batch_alignment == 1)."""
+    align = getattr(ec_impl, "batch_alignment", lambda: 1)()
+    if sinfo.chunk_size % align != 0:
+        raise ValueError(
+            f"chunk_size {sinfo.chunk_size} not a multiple of codec "
+            f"batch alignment {align}"
+        )
+
+
 def encode(
     sinfo: StripeInfo, ec_impl: ErasureCodeInterface, data: bytes | np.ndarray
 ) -> dict[int, np.ndarray]:
@@ -101,15 +114,7 @@ def encode(
     k, m = ec_impl.get_data_chunk_count(), ec_impl.get_coding_chunk_count()
     if k != sinfo.k:
         raise ValueError(f"codec k={k} != stripe k={sinfo.k}")
-    # chunk_size must respect the codec's alignment (w*packetsize for
-    # bitmatrix codecs) or the batched layout would packetize across stripe
-    # boundaries and diverge from the reference per-stripe bytes.
-    align = getattr(ec_impl, "get_alignment", lambda: 1)()
-    if sinfo.chunk_size % align != 0:
-        raise ValueError(
-            f"chunk_size {sinfo.chunk_size} not a multiple of codec "
-            f"alignment {align}"
-        )
+    _check_batch_alignment(sinfo, ec_impl)
     S = buf.size // sinfo.stripe_width
     cs = sinfo.chunk_size
     # [S, k, cs] -> [k, S*cs]: shard i's buffer is its chunk from each stripe
@@ -146,6 +151,7 @@ def decode(
             f"shard buffer size {shard_len} not a multiple of "
             f"chunk_size {sinfo.chunk_size}"
         )
+    _check_batch_alignment(sinfo, ec_impl)
     if want is None:
         want = list(range(ec_impl.get_data_chunk_count()))
     return ec_impl.decode(list(want), {i: np.asarray(chunks[i]) for i in present})

@@ -1,0 +1,478 @@
+"""Epoch-versioned cluster map: pools, devices, EC profiles, PG addressing.
+
+TPU-framework re-expression of ``OSDMap`` (reference:src/osd/OSDMap.{h,cc})
+and ``pg_pool_t`` (reference:src/osd/osd_types.{h,cc}).  The addressing
+pipeline is bit-identical to the reference:
+
+  object name ──rjenkins──▶ ps ──stable_mod──▶ pg ──pps──▶ crush ──▶ osds
+  (hash_key, osd_types.cc:1325)   (raw_pg_to_pg :1348)
+  (raw_pg_to_pps :1357)           (_pg_to_raw_osds OSDMap.cc:1555)
+
+then `_raw_to_up_osds` (down/dne filtering — EC pools keep positional
+CRUSH_ITEM_NONE holes), `_apply_primary_affinity`, and pg_temp /
+primary_temp overrides compose `pg_to_up_acting_osds`
+(reference:OSDMap.h:693).
+
+Maps are plain picklable/JSON-able state so the MON can publish them over
+the wire; epochs only ever grow.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Mapping, Sequence
+
+from ..crush import (
+    CRUSH_ITEM_NONE,
+    RULE_TYPE_ERASURE,
+    RULE_TYPE_REPLICATED,
+    CrushMap,
+)
+from ..crush.hashes import crush_hash32_2
+from ..crush.mapper import crush_do_rule
+from ..utils.str_hash import CEPH_STR_HASH_RJENKINS, ceph_str_hash
+
+# pool types (reference:osd/osd_types.h pg_pool_t TYPE_*)
+POOL_TYPE_REPLICATED = 1
+POOL_TYPE_ERASURE = 3
+
+# osd state bits (reference:include/rados.h CEPH_OSD_*)
+CEPH_OSD_UP = 1
+CEPH_OSD_EXISTS = 2
+
+# in-weight fixed point (reference:include/rados.h CEPH_OSD_IN/OUT)
+CEPH_OSD_IN = 0x10000
+CEPH_OSD_OUT = 0
+
+# primary affinity fixed point (reference:include/rados.h)
+CEPH_OSD_MAX_PRIMARY_AFFINITY = 0x10000
+CEPH_OSD_DEFAULT_PRIMARY_AFFINITY = 0x10000
+
+FLAG_HASHPSPOOL = 1  # reference:pg_pool_t::FLAG_HASHPSPOOL
+
+
+def ceph_stable_mod(x: int, b: int, bmask: int) -> int:
+    """reference:include/rados.h:84 — stable hash bucketing under pg_num
+    growth (splitting only remaps children, never reshuffles)."""
+    if (x & bmask) < b:
+        return x & bmask
+    return x & (bmask >> 1)
+
+
+def _cbits(x: int) -> int:
+    return x.bit_length()
+
+
+@dataclass(frozen=True)
+class PGid:
+    """pg_t: (pool, seed) (reference:osd/osd_types.h)."""
+
+    pool: int
+    seed: int
+
+    def __str__(self) -> str:
+        return f"{self.pool}.{self.seed:x}"
+
+    @classmethod
+    def parse(cls, s: str) -> "PGid":
+        pool, seed = s.split(".")
+        return cls(int(pool), int(seed, 16))
+
+
+@dataclass(frozen=True)
+class SPGid:
+    """spg_t: shard-qualified pg for EC (reference:osd/osd_types.h)."""
+
+    pgid: PGid
+    shard: int = -1  # NO_SHARD for replicated
+
+    def __str__(self) -> str:
+        if self.shard < 0:
+            return str(self.pgid)
+        return f"{self.pgid}s{self.shard}"
+
+    @classmethod
+    def parse(cls, s: str) -> "SPGid":
+        if "s" in s.split(".", 1)[1]:
+            pg, shard = s.rsplit("s", 1)
+            return cls(PGid.parse(pg), int(shard))
+        return cls(PGid.parse(s))
+
+
+@dataclass
+class Pool:
+    """pg_pool_t subset the data path needs (reference:osd_types.h:1225+)."""
+
+    id: int
+    name: str
+    type: int = POOL_TYPE_REPLICATED
+    size: int = 3  # k+m for EC
+    min_size: int = 2
+    pg_num: int = 8
+    pgp_num: int = 8
+    crush_ruleset: int = 0
+    object_hash: int = CEPH_STR_HASH_RJENKINS
+    flags: int = FLAG_HASHPSPOOL
+    erasure_code_profile: str = ""
+    stripe_width: int = 0
+
+    @property
+    def pg_num_mask(self) -> int:
+        return (1 << _cbits(self.pg_num - 1)) - 1
+
+    @property
+    def pgp_num_mask(self) -> int:
+        return (1 << _cbits(self.pgp_num - 1)) - 1
+
+    def is_erasure(self) -> bool:
+        return self.type == POOL_TYPE_ERASURE
+
+    def can_shift_osds(self) -> bool:
+        """Replicated sets compact; EC sets are positional
+        (reference:osd_types.h:1460)."""
+        return self.type == POOL_TYPE_REPLICATED
+
+    def hash_key(self, key: str | bytes, nspace: str = "") -> int:
+        """reference:osd_types.cc:1325."""
+        if isinstance(key, str):
+            key = key.encode()
+        if nspace:
+            key = nspace.encode() + b"\x1f" + key
+        return ceph_str_hash(self.object_hash, key)
+
+    def raw_pg_to_pg(self, pg: PGid) -> PGid:
+        """reference:osd_types.cc:1348."""
+        return PGid(pg.pool, ceph_stable_mod(pg.seed, self.pg_num, self.pg_num_mask))
+
+    def raw_pg_to_pps(self, pg: PGid) -> int:
+        """Placement seed fed to crush (reference:osd_types.cc:1357)."""
+        if self.flags & FLAG_HASHPSPOOL:
+            return crush_hash32_2(
+                ceph_stable_mod(pg.seed, self.pgp_num, self.pgp_num_mask),
+                pg.pool,
+            )
+        return ceph_stable_mod(pg.seed, self.pgp_num, self.pgp_num_mask) + pg.pool
+
+
+def build_simple(n_osds: int, crush: CrushMap | None = None) -> "OSDMap":
+    """Dev-cluster map: flat crush, all osds existing+up+in
+    (OSDMap::build_simple analog)."""
+    m = OSDMap(crush or CrushMap.flat(n_osds))
+    m.epoch = 1
+    m.set_max_osd(n_osds)
+    for osd in range(n_osds):
+        m.mark_up(osd)
+        m.mark_in(osd)
+    return m
+
+
+class OSDMap:
+    """The cluster map (reference:src/osd/OSDMap.h)."""
+
+    def __init__(self, crush: CrushMap | None = None):
+        self.epoch = 0
+        self.fsid = ""
+        self.crush = crush or CrushMap()
+        self.max_osd = 0
+        self.osd_state: list[int] = []  # CEPH_OSD_UP|EXISTS bits
+        self.osd_weight: list[int] = []  # in-weight, 0..0x10000
+        self.osd_primary_affinity: list[int] | None = None
+        self.osd_addrs: dict[int, str] = {}  # osd id -> "host:port"
+        self.pools: dict[int, Pool] = {}
+        self.pool_name: dict[str, int] = {}
+        self.erasure_code_profiles: dict[str, dict[str, str]] = {}
+        self.pg_temp: dict[PGid, list[int]] = {}
+        self.primary_temp: dict[PGid, int] = {}
+
+    # -- device lifecycle ----------------------------------------------------
+
+    def set_max_osd(self, n: int) -> None:
+        self.max_osd = n
+        while len(self.osd_state) < n:
+            self.osd_state.append(0)
+            self.osd_weight.append(CEPH_OSD_OUT)
+
+    def exists(self, osd: int) -> bool:
+        return 0 <= osd < self.max_osd and bool(
+            self.osd_state[osd] & CEPH_OSD_EXISTS
+        )
+
+    def is_up(self, osd: int) -> bool:
+        return self.exists(osd) and bool(self.osd_state[osd] & CEPH_OSD_UP)
+
+    def is_down(self, osd: int) -> bool:
+        return not self.is_up(osd)
+
+    def is_in(self, osd: int) -> bool:
+        return self.exists(osd) and self.osd_weight[osd] > 0
+
+    def is_out(self, osd: int) -> bool:
+        return not self.is_in(osd)
+
+    def create_osd(self, osd: int, addr: str = "") -> None:
+        if osd >= self.max_osd:
+            self.set_max_osd(osd + 1)
+        self.osd_state[osd] |= CEPH_OSD_EXISTS
+        if addr:
+            self.osd_addrs[osd] = addr
+
+    def mark_up(self, osd: int, addr: str = "") -> None:
+        self.create_osd(osd, addr)
+        self.osd_state[osd] |= CEPH_OSD_UP
+
+    def mark_down(self, osd: int) -> None:
+        if 0 <= osd < self.max_osd:
+            self.osd_state[osd] &= ~CEPH_OSD_UP
+
+    def mark_in(self, osd: int, weight: int = CEPH_OSD_IN) -> None:
+        self.create_osd(osd)
+        self.osd_weight[osd] = weight
+
+    def mark_out(self, osd: int) -> None:
+        if 0 <= osd < self.max_osd:
+            self.osd_weight[osd] = CEPH_OSD_OUT
+
+    def get_addr(self, osd: int) -> str | None:
+        return self.osd_addrs.get(osd)
+
+    # -- pools / EC profiles -------------------------------------------------
+
+    def add_pool(self, pool: Pool) -> None:
+        self.pools[pool.id] = pool
+        self.pool_name[pool.name] = pool.id
+
+    def lookup_pool(self, name: str) -> Pool | None:
+        pid = self.pool_name.get(name)
+        return None if pid is None else self.pools[pid]
+
+    def set_erasure_code_profile(self, name: str, profile: Mapping[str, str]) -> None:
+        self.erasure_code_profiles[name] = dict(profile)
+
+    def get_erasure_code_profile(self, name: str) -> dict[str, str]:
+        return dict(self.erasure_code_profiles.get(name, {}))
+
+    # -- addressing pipeline -------------------------------------------------
+
+    def object_locator_to_pg(self, name: str, pool_id: int,
+                             nspace: str = "") -> PGid:
+        """Raw pg (un-modded seed) for an object (reference:OSDMap.cc:1506)."""
+        pool = self.pools[pool_id]
+        ps = pool.hash_key(name, nspace)
+        return PGid(pool_id, ps)
+
+    def _pg_to_raw_osds(self, pool: Pool, pg: PGid) -> list[int]:
+        """reference:OSDMap.cc:1555 — crush placement with pps seed."""
+        ruleno = self.crush.find_rule(pool.crush_ruleset, pool.type, pool.size)
+        if ruleno < 0:
+            return []
+        pps = pool.raw_pg_to_pps(pg)
+        # the weight vector is the OSDMap's in/out weights, not crush
+        # weights — out devices get probabilistically rejected in is_out
+        # (reference passes osd_weight into do_rule, OSDMap.cc:1567)
+        return crush_do_rule(
+            self.crush, ruleno, pps, pool.size, list(self.osd_weight)
+        )
+
+    def _raw_to_up_osds(self, pool: Pool, raw: Sequence[int]) -> tuple[list[int], int]:
+        """Down/dne filtering (reference:OSDMap.cc _raw_to_up_osds)."""
+        if pool.can_shift_osds():
+            up = [o for o in raw if o != CRUSH_ITEM_NONE and self.is_up(o)]
+            return up, (up[0] if up else -1)
+        up = []
+        primary = -1
+        for o in raw:
+            if o == CRUSH_ITEM_NONE or not self.is_up(o):
+                up.append(CRUSH_ITEM_NONE)
+            else:
+                up.append(o)
+        for o in up:
+            if o != CRUSH_ITEM_NONE:
+                primary = o
+                break
+        return up, primary
+
+    def _apply_primary_affinity(self, seed: int, pool: Pool,
+                                osds: list[int], primary: int) -> tuple[list[int], int]:
+        """reference:OSDMap.cc _apply_primary_affinity."""
+        pa = self.osd_primary_affinity
+        if pa is None:
+            return osds, primary
+        if not any(
+            o != CRUSH_ITEM_NONE and pa[o] != CEPH_OSD_DEFAULT_PRIMARY_AFFINITY
+            for o in osds
+        ):
+            return osds, primary
+        pos = -1
+        for i, o in enumerate(osds):
+            if o == CRUSH_ITEM_NONE:
+                continue
+            a = pa[o]
+            if a < CEPH_OSD_MAX_PRIMARY_AFFINITY and (
+                crush_hash32_2(seed, o) >> 16
+            ) >= a:
+                if pos < 0:
+                    pos = i
+            else:
+                pos = i
+                break
+        if pos < 0:
+            return osds, primary
+        primary = osds[pos]
+        if pool.can_shift_osds() and pos > 0:
+            osds = [primary] + osds[:pos] + osds[pos + 1 :]
+        return osds, primary
+
+    def _get_temp_osds(self, pool: Pool, pg: PGid) -> tuple[list[int], int]:
+        """pg_temp / primary_temp overrides (reference:OSDMap.cc)."""
+        temp = self.pg_temp.get(pg, [])
+        temp_pg = [o for o in temp if pool.can_shift_osds() and self.is_up(o)] \
+            if pool.can_shift_osds() else [
+                o if (o == CRUSH_ITEM_NONE or self.is_up(o)) else CRUSH_ITEM_NONE
+                for o in temp
+            ]
+        temp_primary = self.primary_temp.get(pg, -1)
+        if temp_primary < 0 and temp_pg:
+            temp_primary = next(
+                (o for o in temp_pg if o != CRUSH_ITEM_NONE), -1
+            )
+        return temp_pg, temp_primary
+
+    def pg_to_up_acting_osds(
+        self, pg: PGid
+    ) -> tuple[list[int], int, list[int], int]:
+        """(up, up_primary, acting, acting_primary) — reference:OSDMap.h:693."""
+        pool = self.pools.get(pg.pool)
+        if pool is None:
+            return [], -1, [], -1
+        mpg = pool.raw_pg_to_pg(pg)
+        raw = self._pg_to_raw_osds(pool, mpg)
+        up, up_primary = self._raw_to_up_osds(pool, raw)
+        up, up_primary = self._apply_primary_affinity(
+            pool.raw_pg_to_pps(mpg) & 0xFFFFFFFF, pool, up, up_primary
+        )
+        temp_pg, temp_primary = self._get_temp_osds(pool, mpg)
+        acting = temp_pg if temp_pg else list(up)
+        acting_primary = temp_primary if temp_primary >= 0 else up_primary
+        if self.primary_temp.get(mpg, -1) >= 0:
+            acting_primary = self.primary_temp[mpg]
+        return list(up), up_primary, acting, acting_primary
+
+    def object_to_acting(
+        self, name: str, pool_id: int, nspace: str = ""
+    ) -> tuple[PGid, list[int], int]:
+        """Convenience: name -> (pg, acting set, primary)."""
+        raw = self.object_locator_to_pg(name, pool_id, nspace)
+        pool = self.pools[pool_id]
+        pg = pool.raw_pg_to_pg(raw)
+        _, _, acting, primary = self.pg_to_up_acting_osds(raw)
+        return pg, acting, primary
+
+    def pgs_of_pool(self, pool_id: int) -> list[PGid]:
+        pool = self.pools[pool_id]
+        return [PGid(pool_id, s) for s in range(pool.pg_num)]
+
+    # -- pool creation (reference: mon/OSDMonitor.cc prepare_new_pool) -------
+
+    def _next_pool_id(self) -> int:
+        return max(self.pools, default=0) + 1
+
+    def create_replicated_pool(
+        self, name: str, size: int = 3, pg_num: int = 8,
+        fault_domain_type: int = 0,
+    ) -> Pool:
+        root = self.crush.root_id()
+        ruleset = len([r for r in self.crush.rules if r])
+        self.crush.add_simple_rule(
+            root, fault_domain_type, RULE_TYPE_REPLICATED, ruleset=ruleset,
+        )
+        pool = Pool(
+            id=self._next_pool_id(), name=name, type=POOL_TYPE_REPLICATED,
+            size=size, min_size=max(1, size - 1), pg_num=pg_num,
+            pgp_num=pg_num, crush_ruleset=ruleset,
+        )
+        self.add_pool(pool)
+        return pool
+
+    def create_erasure_pool(
+        self, name: str, profile_name: str, pg_num: int = 8,
+        fault_domain_type: int = 0, stripe_unit: int = 4096,
+    ) -> Pool:
+        """Create an EC pool from a stored profile.
+
+        Validates the profile by instantiating the plugin — exactly what the
+        MON does before accepting a profile
+        (reference:mon/OSDMonitor.cc:4590-4600) — and derives size=k+m and
+        stripe_width=k*stripe_unit.
+        """
+        from ..models import registry
+
+        profile = self.get_erasure_code_profile(profile_name)
+        if not profile:
+            raise ValueError(f"no erasure-code profile named {profile_name!r}")
+        plugin = profile.get("plugin", "jerasure")
+        codec = registry.instance().factory(plugin, profile)
+        k = codec.get_data_chunk_count()
+        km = codec.get_chunk_count()
+        root = self.crush.root_id()
+        ruleset = len([r for r in self.crush.rules if r])
+        self.crush.add_simple_rule(
+            root, fault_domain_type, RULE_TYPE_ERASURE, ruleset=ruleset,
+            indep=True, max_size=km,
+        )
+        pool = Pool(
+            id=self._next_pool_id(), name=name, type=POOL_TYPE_ERASURE,
+            size=km, min_size=k + 1 if km > k + 1 else k, pg_num=pg_num,
+            pgp_num=pg_num, crush_ruleset=ruleset,
+            erasure_code_profile=profile_name,
+            stripe_width=k * stripe_unit,
+        )
+        self.add_pool(pool)
+        return pool
+
+    # -- wire form (reference: OSDMap::encode/decode) ------------------------
+
+    def to_dict(self) -> dict:
+        from ..crush.encoding import crush_to_dict
+        from dataclasses import asdict
+
+        return {
+            "epoch": self.epoch,
+            "fsid": self.fsid,
+            "crush": crush_to_dict(self.crush),
+            "max_osd": self.max_osd,
+            "osd_state": list(self.osd_state),
+            "osd_weight": list(self.osd_weight),
+            "osd_primary_affinity": self.osd_primary_affinity,
+            "osd_addrs": {str(k): v for k, v in self.osd_addrs.items()},
+            "pools": {str(pid): asdict(p) for pid, p in self.pools.items()},
+            "erasure_code_profiles": self.erasure_code_profiles,
+            "pg_temp": {str(pg): osds for pg, osds in self.pg_temp.items()},
+            "primary_temp": {str(pg): o for pg, o in self.primary_temp.items()},
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "OSDMap":
+        from ..crush.encoding import crush_from_dict
+
+        m = cls(crush_from_dict(d["crush"]))
+        m.epoch = d["epoch"]
+        m.fsid = d.get("fsid", "")
+        m.max_osd = d["max_osd"]
+        m.osd_state = list(d["osd_state"])
+        m.osd_weight = list(d["osd_weight"])
+        m.osd_primary_affinity = d.get("osd_primary_affinity")
+        m.osd_addrs = {int(k): v for k, v in d.get("osd_addrs", {}).items()}
+        for pid, pd in d["pools"].items():
+            pool = Pool(**pd)
+            m.pools[int(pid)] = pool
+            m.pool_name[pool.name] = int(pid)
+        m.erasure_code_profiles = {
+            k: dict(v) for k, v in d.get("erasure_code_profiles", {}).items()
+        }
+        m.pg_temp = {
+            PGid.parse(s): list(osds) for s, osds in d.get("pg_temp", {}).items()
+        }
+        m.primary_temp = {
+            PGid.parse(s): o for s, o in d.get("primary_temp", {}).items()
+        }
+        return m

@@ -70,7 +70,7 @@ def main(argv=None) -> int:
     grid = {2: [1], 4: [2]} if args.quick else K2MS
     iterations = 10 if args.quick else args.iterations
     for plugin, techs in PLUGINS.items():
-        for tech_name, technique in techs.items():
+        for tech_name in techs:
             for k, ms in grid.items():
                 for m in ms:
                     for workload in args.workloads.split(","):
