@@ -1,0 +1,17 @@
+"""Local object stores.
+
+TPU-native re-expression of the reference's ObjectStore layer
+(reference:src/os/ObjectStore.h): a transactional per-collection object
+store with byte extents, xattrs, and omap, consumed by the OSD data path.
+"""
+
+from .objectstore import ObjectId, CollectionId, ObjectStore, Transaction
+from .memstore import MemStore
+
+__all__ = [
+    "ObjectId",
+    "CollectionId",
+    "ObjectStore",
+    "Transaction",
+    "MemStore",
+]

@@ -1,0 +1,220 @@
+"""ObjectStore contract: collections, objects, transactions.
+
+The reference's ``ObjectStore`` (reference:src/os/ObjectStore.h) is a
+transactional API over collections of objects, where each object carries a
+byte payload (sparse extents), xattrs, and an omap (sorted key/value map).
+Writes are grouped into ``Transaction``s applied atomically with
+on_applied/on_commit callbacks (reference:ObjectStore.h queue_transactions).
+
+Re-design choices for the TPU framework:
+
+- Object payloads are held as contiguous ``bytearray``s (host memory is the
+  staging area for device batches; the EC backend hands whole shard extents
+  to one device call, so sparse-extent trees buy nothing here).
+- Transactions are an op list replayed under a single store lock —
+  sequencers collapse to that lock because the asyncio runtime already
+  serializes the OSD's apply path.
+- Object identity: ``ObjectId(name, shard)`` inside ``CollectionId(pg,
+  shard)`` — the (g)hobject_t / coll_t essentials (pool+hash live in the
+  collection's pg string, e.g. "1.3s2" mirroring spg_t).
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Callable, Iterable, Mapping, Sequence
+
+NO_SHARD = -1  # shard_id_t::NO_SHARD — replicated pools / whole objects
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class ObjectId:
+    """Object name within a collection (hobject_t essentials)."""
+
+    name: str
+    shard: int = NO_SHARD
+
+    def __str__(self) -> str:
+        return self.name if self.shard == NO_SHARD else f"{self.name}s{self.shard}"
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class CollectionId:
+    """Collection = one PG shard's objects, or the 'meta' collection
+    (coll_t, reference:src/osd/osd_types.h coll_t)."""
+
+    pg: str  # "1.3" (replicated), "1.3s2" (EC shard), or "meta"
+
+    def __str__(self) -> str:
+        return self.pg
+
+
+META_COLL = CollectionId("meta")
+
+
+class Transaction:
+    """Ordered op list applied atomically (reference:ObjectStore.h Transaction).
+
+    Op encoding is (opname, args...) tuples; ``ObjectStore.apply`` replays
+    them. The subset implemented is what the OSD data path uses: collection
+    lifecycle, object write/zero/truncate/remove/clone, xattr and omap ops.
+    """
+
+    def __init__(self):
+        self.ops: list[tuple] = []
+
+    # -- collection lifecycle
+    def create_collection(self, cid: CollectionId) -> "Transaction":
+        self.ops.append(("create_collection", cid))
+        return self
+
+    def remove_collection(self, cid: CollectionId) -> "Transaction":
+        self.ops.append(("remove_collection", cid))
+        return self
+
+    # -- object data
+    def touch(self, cid: CollectionId, oid: ObjectId) -> "Transaction":
+        self.ops.append(("touch", cid, oid))
+        return self
+
+    def write(
+        self, cid: CollectionId, oid: ObjectId, offset: int, data: bytes
+    ) -> "Transaction":
+        self.ops.append(("write", cid, oid, offset, bytes(data)))
+        return self
+
+    def zero(
+        self, cid: CollectionId, oid: ObjectId, offset: int, length: int
+    ) -> "Transaction":
+        self.ops.append(("zero", cid, oid, offset, length))
+        return self
+
+    def truncate(self, cid: CollectionId, oid: ObjectId, size: int) -> "Transaction":
+        self.ops.append(("truncate", cid, oid, size))
+        return self
+
+    def remove(self, cid: CollectionId, oid: ObjectId) -> "Transaction":
+        self.ops.append(("remove", cid, oid))
+        return self
+
+    def clone(
+        self, cid: CollectionId, src: ObjectId, dst: ObjectId
+    ) -> "Transaction":
+        self.ops.append(("clone", cid, src, dst))
+        return self
+
+    # -- xattrs
+    def setattr(
+        self, cid: CollectionId, oid: ObjectId, key: str, value: bytes
+    ) -> "Transaction":
+        self.ops.append(("setattr", cid, oid, key, bytes(value)))
+        return self
+
+    def rmattr(self, cid: CollectionId, oid: ObjectId, key: str) -> "Transaction":
+        self.ops.append(("rmattr", cid, oid, key))
+        return self
+
+    # -- omap
+    def omap_setkeys(
+        self, cid: CollectionId, oid: ObjectId, kv: Mapping[str, bytes]
+    ) -> "Transaction":
+        self.ops.append(
+            ("omap_setkeys", cid, oid, {k: bytes(v) for k, v in kv.items()})
+        )
+        return self
+
+    def omap_rmkeys(
+        self, cid: CollectionId, oid: ObjectId, keys: Sequence[str]
+    ) -> "Transaction":
+        self.ops.append(("omap_rmkeys", cid, oid, list(keys)))
+        return self
+
+    def omap_clear(self, cid: CollectionId, oid: ObjectId) -> "Transaction":
+        self.ops.append(("omap_clear", cid, oid))
+        return self
+
+    def append(self, other: "Transaction") -> "Transaction":
+        self.ops.extend(other.ops)
+        return self
+
+    def empty(self) -> bool:
+        return not self.ops
+
+    def __len__(self) -> int:
+        return len(self.ops)
+
+
+class ObjectStore(abc.ABC):
+    """Transactional object store (reference:src/os/ObjectStore.h).
+
+    Reads are immediate; mutations go through :meth:`queue_transaction`.
+    """
+
+    # -- lifecycle
+    @abc.abstractmethod
+    def mount(self) -> None: ...
+
+    @abc.abstractmethod
+    def umount(self) -> None: ...
+
+    @abc.abstractmethod
+    def mkfs(self) -> None: ...
+
+    # -- mutation
+    @abc.abstractmethod
+    def apply(self, txn: Transaction) -> None:
+        """Apply every op atomically; raise on the first failing op."""
+
+    def queue_transaction(
+        self,
+        txn: Transaction,
+        on_applied: Callable[[], None] | None = None,
+        on_commit: Callable[[], None] | None = None,
+    ) -> None:
+        """Apply + fire callbacks (reference queue_transactions contract;
+        backends with a real journal may defer on_commit)."""
+        self.apply(txn)
+        if on_applied:
+            on_applied()
+        if on_commit:
+            on_commit()
+
+    # -- reads
+    @abc.abstractmethod
+    def exists(self, cid: CollectionId, oid: ObjectId) -> bool: ...
+
+    @abc.abstractmethod
+    def read(
+        self, cid: CollectionId, oid: ObjectId, offset: int = 0, length: int = -1
+    ) -> bytes:
+        """length == -1 means to end of object; raises KeyError if absent."""
+
+    @abc.abstractmethod
+    def stat(self, cid: CollectionId, oid: ObjectId) -> int:
+        """Object size in bytes; raises KeyError if absent."""
+
+    @abc.abstractmethod
+    def getattr(self, cid: CollectionId, oid: ObjectId, key: str) -> bytes: ...
+
+    @abc.abstractmethod
+    def getattrs(self, cid: CollectionId, oid: ObjectId) -> dict[str, bytes]: ...
+
+    @abc.abstractmethod
+    def omap_get(self, cid: CollectionId, oid: ObjectId) -> dict[str, bytes]: ...
+
+    @abc.abstractmethod
+    def omap_get_keys(
+        self, cid: CollectionId, oid: ObjectId, keys: Iterable[str]
+    ) -> dict[str, bytes]: ...
+
+    # -- enumeration
+    @abc.abstractmethod
+    def list_collections(self) -> list[CollectionId]: ...
+
+    @abc.abstractmethod
+    def collection_exists(self, cid: CollectionId) -> bool: ...
+
+    @abc.abstractmethod
+    def list_objects(self, cid: CollectionId) -> list[ObjectId]:
+        """Sorted object listing (collection_list)."""

@@ -1,0 +1,188 @@
+"""ObjectStore / MemStore tests.
+
+Mirrors the reference's store unit-test intents (reference:src/test/objectstore/
+store_test.cc semantics: touch/write/zero/truncate/clone, xattr + omap
+round-trips, collection lifecycle) on the in-memory backend
+(reference:src/os/memstore/MemStore.h:32).
+"""
+
+import pytest
+
+from ceph_tpu.store import CollectionId, MemStore, ObjectId, Transaction
+
+
+@pytest.fixture
+def store():
+    s = MemStore()
+    s.mkfs()
+    s.mount()
+    yield s
+    s.umount()
+
+
+CID = CollectionId("1.0s0")
+OID = ObjectId("obj", shard=0)
+
+
+def _mkcoll(store, cid=CID):
+    store.apply(Transaction().create_collection(cid))
+
+
+def test_collection_lifecycle(store):
+    assert not store.collection_exists(CID)
+    _mkcoll(store)
+    assert store.collection_exists(CID)
+    assert store.list_collections() == [CID]
+    store.apply(Transaction().remove_collection(CID))
+    assert not store.collection_exists(CID)
+
+
+def test_write_read_extends(store):
+    _mkcoll(store)
+    store.apply(Transaction().write(CID, OID, 0, b"hello"))
+    assert store.read(CID, OID) == b"hello"
+    # overwrite middle + extend with hole
+    store.apply(Transaction().write(CID, OID, 3, b"XY").write(CID, OID, 8, b"Z"))
+    assert store.read(CID, OID) == b"helXY\x00\x00\x00Z"
+    assert store.stat(CID, OID) == 9
+    assert store.read(CID, OID, 3, 2) == b"XY"
+    assert store.read(CID, OID, 8) == b"Z"
+
+
+def test_zero_truncate_remove(store):
+    _mkcoll(store)
+    store.apply(Transaction().write(CID, OID, 0, b"abcdef"))
+    store.apply(Transaction().zero(CID, OID, 1, 2))
+    assert store.read(CID, OID) == b"a\x00\x00def"
+    store.apply(Transaction().truncate(CID, OID, 2))
+    assert store.read(CID, OID) == b"a\x00"
+    store.apply(Transaction().truncate(CID, OID, 4))  # extend with zeros
+    assert store.read(CID, OID) == b"a\x00\x00\x00"
+    store.apply(Transaction().remove(CID, OID))
+    assert not store.exists(CID, OID)
+    with pytest.raises(KeyError):
+        store.read(CID, OID)
+
+
+def test_touch_and_clone(store):
+    _mkcoll(store)
+    store.apply(Transaction().touch(CID, OID))
+    assert store.exists(CID, OID)
+    assert store.stat(CID, OID) == 0
+    store.apply(
+        Transaction()
+        .write(CID, OID, 0, b"payload")
+        .setattr(CID, OID, "a", b"1")
+        .omap_setkeys(CID, OID, {"k": b"v"})
+    )
+    dst = ObjectId("obj-clone", shard=0)
+    store.apply(Transaction().clone(CID, OID, dst))
+    assert store.read(CID, dst) == b"payload"
+    assert store.getattr(CID, dst, "a") == b"1"
+    assert store.omap_get(CID, dst) == {"k": b"v"}
+    # clone is a copy, not a reference
+    store.apply(Transaction().write(CID, OID, 0, b"PAYLOAD"))
+    assert store.read(CID, dst) == b"payload"
+
+
+def test_xattrs(store):
+    _mkcoll(store)
+    store.apply(
+        Transaction().setattr(CID, OID, "hinfo_key", b"\x01\x02").setattr(CID, OID, "_", b"oi")
+    )
+    assert store.getattr(CID, OID, "hinfo_key") == b"\x01\x02"
+    assert store.getattrs(CID, OID) == {"hinfo_key": b"\x01\x02", "_": b"oi"}
+    store.apply(Transaction().rmattr(CID, OID, "_"))
+    assert store.getattrs(CID, OID) == {"hinfo_key": b"\x01\x02"}
+
+
+def test_omap(store):
+    _mkcoll(store)
+    store.apply(Transaction().omap_setkeys(CID, OID, {"b": b"2", "a": b"1", "c": b"3"}))
+    assert store.omap_get(CID, OID) == {"a": b"1", "b": b"2", "c": b"3"}
+    assert store.omap_get_keys(CID, OID, ["a", "zz"]) == {"a": b"1"}
+    store.apply(Transaction().omap_rmkeys(CID, OID, ["a", "b"]))
+    assert store.omap_get(CID, OID) == {"c": b"3"}
+    store.apply(Transaction().omap_clear(CID, OID))
+    assert store.omap_get(CID, OID) == {}
+
+
+def test_list_objects_sorted(store):
+    _mkcoll(store)
+    t = Transaction()
+    for name in ["zeta", "alpha", "mid"]:
+        t.touch(CID, ObjectId(name, shard=0))
+    store.apply(t)
+    assert [o.name for o in store.list_objects(CID)] == ["alpha", "mid", "zeta"]
+
+
+def test_missing_collection_raises(store):
+    with pytest.raises(KeyError):
+        store.apply(Transaction().touch(CID, OID))
+    with pytest.raises(KeyError):
+        store.list_objects(CID)
+
+
+def test_transaction_atomic_under_single_apply(store):
+    """All ops of one txn are visible together (single-lock replay)."""
+    _mkcoll(store)
+    t = (
+        Transaction()
+        .write(CID, OID, 0, b"data")
+        .setattr(CID, OID, "v", b"1")
+        .omap_setkeys(CID, OID, {"log": b"entry"})
+    )
+    assert len(t) == 3
+    store.apply(t)
+    assert store.read(CID, OID) == b"data"
+    assert store.getattr(CID, OID, "v") == b"1"
+
+
+def test_failed_transaction_rolls_back(store):
+    """apply is all-or-nothing: a failing op undoes every prior op."""
+    _mkcoll(store)
+    store.apply(Transaction().write(CID, OID, 0, b"orig").setattr(CID, OID, "a", b"1"))
+    bad = (
+        Transaction()
+        .write(CID, OID, 0, b"NEWDATA")
+        .touch(CID, ObjectId("side", shard=0))
+        .rmattr(CID, ObjectId("missing", shard=0), "k")  # fails: object absent
+    )
+    with pytest.raises(KeyError):
+        store.apply(bad)
+    assert store.read(CID, OID) == b"orig"
+    assert not store.exists(CID, ObjectId("side", shard=0))
+    # collection-level rollback: failed txn that created a collection
+    with pytest.raises(KeyError):
+        store.apply(
+            Transaction()
+            .create_collection(CollectionId("9.9"))
+            .rmattr(CID, ObjectId("missing", shard=0), "k")
+        )
+    assert not store.collection_exists(CollectionId("9.9"))
+
+
+def test_unmounted_store_rejects_io():
+    s = MemStore()
+    s.mkfs()
+    with pytest.raises(RuntimeError):
+        s.apply(Transaction().create_collection(CID))
+    with pytest.raises(RuntimeError):
+        s.list_collections()
+    s.mount()
+    s.apply(Transaction().create_collection(CID))
+    s.umount()
+    with pytest.raises(RuntimeError):
+        s.read(CID, OID)
+
+
+def test_queue_transaction_callbacks(store):
+    _mkcoll(store)
+    fired = []
+    store.queue_transaction(
+        Transaction().write(CID, OID, 0, b"x"),
+        on_applied=lambda: fired.append("applied"),
+        on_commit=lambda: fired.append("commit"),
+    )
+    assert fired == ["applied", "commit"]
+    assert store.read(CID, OID) == b"x"
