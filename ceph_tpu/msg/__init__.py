@@ -1,0 +1,25 @@
+"""Cluster communication layer.
+
+TPU-native re-expression of the reference messenger (reference:src/msg/):
+a `Messenger`/`Connection`/`Dispatcher` triple carrying typed messages
+(reference:src/msg/Message.h, reference:src/messages/) with crc-checked
+framing (reference:src/msg/Messenger.cc:51-64).  The transport is asyncio
+TCP — the role DPDK/RDMA stacks play in the reference is played here by
+the host NIC for control traffic, while bulk shard math rides the device
+mesh (ICI collectives, see ceph_tpu.parallel.distributed).
+"""
+
+from .message import Message, decode_frame, encode_frame, register
+from . import messages
+from .messenger import AsyncMessenger, Connection, Dispatcher
+
+__all__ = [
+    "Message",
+    "messages",
+    "encode_frame",
+    "decode_frame",
+    "register",
+    "AsyncMessenger",
+    "Connection",
+    "Dispatcher",
+]

@@ -1,0 +1,118 @@
+"""Message envelope + wire framing.
+
+The reference gives every message a typed header, a JSON-able midsection and
+raw data segments, each crc32c-protected (reference:src/msg/Message.h,
+crc flags reference:src/msg/Messenger.cc:51-64).  The frame here:
+
+    [4B magic "CTPU"] [4B header_len BE] [header JSON] [blobs...] [4B crc BE]
+
+Header = ``{"type", "seq", "fields", "blob_lens"}``; ``fields`` is the
+JSON-able message body, ``blobs`` carry bulk bytes (chunk data) untouched
+by JSON.  crc32c (same polynomial as the reference, via the native lib)
+covers header+blobs.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, Type
+
+import numpy as np
+
+from ..utils import native
+
+MAGIC = b"CTPU"
+CRC_SEED = 0xFFFFFFFF
+
+_REGISTRY: dict[str, Type["Message"]] = {}
+
+
+def register(cls: Type["Message"]) -> Type["Message"]:
+    """Class decorator: route frames of ``cls.TYPE`` to ``cls`` on decode
+    (the role of the reference's decode_message type switch,
+    reference:src/msg/Message.cc)."""
+    if not cls.TYPE:
+        raise ValueError(f"{cls.__name__} has no TYPE")
+    if cls.TYPE in _REGISTRY:
+        raise ValueError(f"duplicate message type {cls.TYPE!r}")
+    _REGISTRY[cls.TYPE] = cls
+    return cls
+
+
+class Message:
+    """Base message: subclasses set TYPE and FIELDS (json-able attribute
+    names); bulk bytes go in ``blobs`` (list of bytes)."""
+
+    TYPE = ""
+    FIELDS: tuple[str, ...] = ()
+
+    def __init__(self, **kw: Any):
+        self.blobs: list[bytes] = [bytes(b) for b in kw.pop("blobs", [])]
+        for f in self.FIELDS:
+            setattr(self, f, kw.pop(f, None))
+        if kw:
+            raise TypeError(f"{type(self).__name__}: unknown fields {sorted(kw)}")
+
+    def fields(self) -> dict[str, Any]:
+        return {f: getattr(self, f) for f in self.FIELDS}
+
+    @classmethod
+    def from_fields(cls, fields: dict[str, Any], blobs: list[bytes]) -> "Message":
+        return cls(blobs=blobs, **fields)
+
+    def __repr__(self) -> str:
+        fs = ", ".join(f"{f}={getattr(self, f)!r}" for f in self.FIELDS)
+        return f"{type(self).__name__}({fs}, blobs={[len(b) for b in self.blobs]})"
+
+
+class BadFrame(ValueError):
+    """Corrupt or malformed frame (bad magic / crc / header)."""
+
+
+def encode_frame(msg: Message, seq: int = 0) -> bytes:
+    header = json.dumps(
+        {
+            "type": msg.TYPE,
+            "seq": seq,
+            "fields": msg.fields(),
+            "blob_lens": [len(b) for b in msg.blobs],
+        },
+        separators=(",", ":"),
+    ).encode()
+    buf = bytearray()
+    buf += MAGIC
+    buf += struct.pack(">I", len(header))
+    buf += header
+    for b in msg.blobs:
+        buf += b
+    crc = native.crc32c(
+        CRC_SEED, np.frombuffer(memoryview(buf)[8:], dtype=np.uint8)
+    )
+    buf += struct.pack(">I", crc)
+    return bytes(buf)
+
+
+def decode_frame(frame: bytes) -> tuple[Message, int]:
+    """Inverse of :func:`encode_frame`: returns (message, seq)."""
+    if len(frame) < 12 or frame[:4] != MAGIC:
+        raise BadFrame("bad magic")
+    (hlen,) = struct.unpack(">I", frame[4:8])
+    body = frame[8:-4]
+    (crc,) = struct.unpack(">I", frame[-4:])
+    want = native.crc32c(CRC_SEED, np.frombuffer(body, dtype=np.uint8))
+    if crc != want:
+        raise BadFrame(f"crc mismatch: got {crc:#x} want {want:#x}")
+    if hlen > len(body):
+        raise BadFrame("truncated header")
+    header = json.loads(body[:hlen])
+    cls = _REGISTRY.get(header["type"])
+    if cls is None:
+        raise BadFrame(f"unknown message type {header['type']!r}")
+    blobs, off = [], hlen
+    for n in header["blob_lens"]:
+        blobs.append(bytes(body[off : off + n]))
+        off += n
+    if off != len(body):
+        raise BadFrame("blob length mismatch")
+    return cls.from_fields(header["fields"], blobs), header["seq"]

@@ -1,0 +1,271 @@
+"""Typed cluster messages (reference:src/messages/ — the ~150 M*.h set,
+narrowed to what the mini-RADOS data/control path uses).
+
+Bulk chunk payloads ride in frame blobs; metadata rides in JSON fields.
+``encode_txn``/``decode_txn`` put a whole shard-local ObjectStore
+Transaction on the wire — the exact role of ``ECSubWrite::transaction``
+(reference:src/messages/MOSDECSubOpWrite.h, reference:src/osd/ECMsgTypes.h).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..store import CollectionId, ObjectId, Transaction
+from .message import Message, register
+
+# -- transaction wire form ---------------------------------------------------
+
+
+def encode_txn(txn: Transaction) -> tuple[list, list[bytes]]:
+    """Transaction -> (json-able op list, blobs). Bytes args (write data,
+    xattr values, omap values) are hoisted into blobs, referenced by index."""
+    ops_out: list[Any] = []
+    blobs: list[bytes] = []
+
+    def blob(b: bytes) -> int:
+        blobs.append(bytes(b))
+        return len(blobs) - 1
+
+    for op in txn.ops:
+        name = op[0]
+        if name in ("create_collection", "remove_collection"):
+            ops_out.append([name, op[1].pg])
+        elif name == "clone":
+            (_, cid, src, dst) = op
+            ops_out.append([name, cid.pg, [src.name, src.shard], [dst.name, dst.shard]])
+        elif name in ("touch", "remove"):
+            (_, cid, oid) = op
+            ops_out.append([name, cid.pg, [oid.name, oid.shard]])
+        elif name == "write":
+            (_, cid, oid, offset, data) = op
+            ops_out.append([name, cid.pg, [oid.name, oid.shard], offset, blob(data)])
+        elif name in ("zero", "truncate"):
+            ops_out.append([name, op[1].pg, [op[2].name, op[2].shard], *op[3:]])
+        elif name == "setattr":
+            (_, cid, oid, key, value) = op
+            ops_out.append([name, cid.pg, [oid.name, oid.shard], key, blob(value)])
+        elif name == "rmattr":
+            (_, cid, oid, key) = op
+            ops_out.append([name, cid.pg, [oid.name, oid.shard], key])
+        elif name == "omap_setkeys":
+            (_, cid, oid, kv) = op
+            ops_out.append(
+                [name, cid.pg, [oid.name, oid.shard],
+                 {k: blob(v) for k, v in kv.items()}]
+            )
+        elif name == "omap_rmkeys":
+            (_, cid, oid, keys) = op
+            ops_out.append([name, cid.pg, [oid.name, oid.shard], list(keys)])
+        elif name == "omap_clear":
+            (_, cid, oid) = op
+            ops_out.append([name, cid.pg, [oid.name, oid.shard]])
+        else:
+            raise ValueError(f"cannot encode transaction op {name!r}")
+    return ops_out, blobs
+
+
+def decode_txn(ops_in: list, blobs: list[bytes]) -> Transaction:
+    txn = Transaction()
+
+    def oid(o) -> ObjectId:
+        return ObjectId(o[0], o[1])
+
+    for op in ops_in:
+        name = op[0]
+        if name in ("create_collection", "remove_collection"):
+            getattr(txn, name)(CollectionId(op[1]))
+        elif name == "clone":
+            txn.clone(CollectionId(op[1]), oid(op[2]), oid(op[3]))
+        elif name in ("touch", "remove", "omap_clear"):
+            getattr(txn, name)(CollectionId(op[1]), oid(op[2]))
+        elif name == "write":
+            txn.write(CollectionId(op[1]), oid(op[2]), op[3], blobs[op[4]])
+        elif name in ("zero", "truncate"):
+            getattr(txn, name)(CollectionId(op[1]), oid(op[2]), *op[3:])
+        elif name == "setattr":
+            txn.setattr(CollectionId(op[1]), oid(op[2]), op[3], blobs[op[4]])
+        elif name == "rmattr":
+            txn.rmattr(CollectionId(op[1]), oid(op[2]), op[3])
+        elif name == "omap_setkeys":
+            txn.omap_setkeys(
+                CollectionId(op[1]), oid(op[2]),
+                {k: blobs[i] for k, i in op[3].items()},
+            )
+        elif name == "omap_rmkeys":
+            txn.omap_rmkeys(CollectionId(op[1]), oid(op[2]), op[3])
+        else:
+            raise ValueError(f"cannot decode transaction op {name!r}")
+    return txn
+
+
+# -- heartbeat / liveness ----------------------------------------------------
+
+
+@register
+class MPing(Message):
+    """reference:src/messages/MOSDPing.h (PING)."""
+
+    TYPE = "ping"
+    FIELDS = ("stamp", "epoch")
+
+
+@register
+class MPingReply(Message):
+    """reference:src/messages/MOSDPing.h (PING_REPLY)."""
+
+    TYPE = "ping_reply"
+    FIELDS = ("stamp", "epoch")
+
+
+# -- mon control plane -------------------------------------------------------
+
+
+@register
+class MMonCommand(Message):
+    """Operator/admin command to the mon (reference:src/messages/MMonCommand.h);
+    ``cmd`` is a dict like {"prefix": "osd pool create", ...}."""
+
+    TYPE = "mon_command"
+    FIELDS = ("tid", "cmd")
+
+
+@register
+class MMonCommandReply(Message):
+    TYPE = "mon_command_reply"
+    FIELDS = ("tid", "code", "status", "out")
+
+
+@register
+class MMonGetMap(Message):
+    """Map subscription: send maps newer than ``have`` and keep me posted
+    (reference:src/messages/MMonGetOSDMap.h + MMonSubscribe.h)."""
+
+    TYPE = "mon_get_map"
+    FIELDS = ("have",)
+
+
+@register
+class MOSDMapMsg(Message):
+    """OSDMap epoch push (reference:src/messages/MOSDMap.h); full map as
+    dict in ``osdmap``."""
+
+    TYPE = "osd_map"
+    FIELDS = ("epoch", "osdmap")
+
+
+@register
+class MOSDBoot(Message):
+    """OSD announces itself up (reference:src/messages/MOSDBoot.h)."""
+
+    TYPE = "osd_boot"
+    FIELDS = ("osd_id", "addr")
+
+
+@register
+class MOSDFailure(Message):
+    """Failure report to the mon (reference:src/messages/MOSDFailure.h)."""
+
+    TYPE = "osd_failure"
+    FIELDS = ("target_osd", "reporter", "epoch")
+
+
+# -- client <-> OSD ----------------------------------------------------------
+
+
+@register
+class MOSDOp(Message):
+    """Client object op (reference:src/messages/MOSDOp.h).
+
+    ``ops`` = list of {"op": name, ...args}; write-class payloads ride in
+    blobs in op order (blob index in the op's "data" key).
+    """
+
+    TYPE = "osd_op"
+    FIELDS = ("tid", "epoch", "pool", "oid", "ops")
+
+
+@register
+class MOSDOpReply(Message):
+    """reference:src/messages/MOSDOpReply.h. Per-op outputs in ``out``
+    (json-able); read payloads in blobs (blob index in out entry)."""
+
+    TYPE = "osd_op_reply"
+    FIELDS = ("tid", "result", "epoch", "out")
+
+
+# -- EC shard sub-ops --------------------------------------------------------
+
+
+@register
+class MOSDECSubOpWrite(Message):
+    """Primary -> shard: apply this shard-local transaction + log entries
+    (reference:src/messages/MOSDECSubOpWrite.h, ECSubWrite in
+    reference:src/osd/ECMsgTypes.h). ``txn`` per encode_txn (blobs shared
+    with the frame); ``log`` = json-able pg_log entries; ``at_version`` /
+    ``trim_to`` version pairs."""
+
+    TYPE = "ec_sub_op_write"
+    FIELDS = ("pgid", "tid", "from_osd", "shard", "txn", "log", "at_version", "trim_to")
+
+
+@register
+class MOSDECSubOpWriteReply(Message):
+    TYPE = "ec_sub_op_write_reply"
+    FIELDS = ("pgid", "tid", "shard", "result")
+
+
+@register
+class MOSDECSubOpRead(Message):
+    """Primary -> shard chunk read (reference:src/messages/MOSDECSubOpRead.h);
+    ``reads`` = [{"oid": [name, shard], "offset": o, "length": l}],
+    ``attrs``: also return xattrs."""
+
+    TYPE = "ec_sub_op_read"
+    FIELDS = ("pgid", "tid", "shard", "reads", "attrs")
+
+
+@register
+class MOSDECSubOpReadReply(Message):
+    """Chunk data in blobs (index in each reads entry's "data"); per-read
+    errors inline (reference:src/messages/MOSDECSubOpReadReply.h)."""
+
+    TYPE = "ec_sub_op_read_reply"
+    FIELDS = ("pgid", "tid", "shard", "reads", "attrs", "errors")
+
+
+# -- replicated sub-ops ------------------------------------------------------
+
+
+@register
+class MOSDRepOp(Message):
+    """Primary -> replica whole-op transaction
+    (reference:src/messages/MOSDRepOp.h)."""
+
+    TYPE = "rep_op"
+    FIELDS = ("pgid", "tid", "from_osd", "txn", "log", "at_version")
+
+
+@register
+class MOSDRepOpReply(Message):
+    TYPE = "rep_op_reply"
+    FIELDS = ("pgid", "tid", "from_osd", "result")
+
+
+# -- recovery ----------------------------------------------------------------
+
+
+@register
+class MOSDPGPush(Message):
+    """Recovery push of a rebuilt shard/object (reference:src/messages/
+    MOSDPGPush.h); ``pushes`` = [{"oid": [n,s], "data": blobidx, "attrs":
+    {k: blobidx}, "version": v}]."""
+
+    TYPE = "pg_push"
+    FIELDS = ("pgid", "tid", "from_osd", "pushes")
+
+
+@register
+class MOSDPGPushReply(Message):
+    TYPE = "pg_push_reply"
+    FIELDS = ("pgid", "tid", "from_osd", "results")

@@ -1,0 +1,239 @@
+"""Asyncio messenger: Connection / Dispatcher / AsyncMessenger.
+
+The reference's AsyncMessenger (reference:src/msg/async/AsyncMessenger.h)
+runs an epoll event loop per worker with a Dispatcher fast-dispatch path;
+here a single asyncio loop per process plays that role.  Kept from the
+reference's design: the entity banner handshake, per-connection ordered
+send queue, crc-checked frames, dispatcher callbacks on message arrival
+and connection reset, and connection caching by peer address
+(reference:src/msg/Messenger.cc:24 create, Connection semantics).
+Dropped by design: lossy/resetcheck policy matrix and throttles — the
+mini-cluster's clients resend on map change like the Objecter does, which
+is the only recovery path the reference ultimately relies on either.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import struct
+from typing import Optional
+
+from .message import BadFrame, Message, decode_frame, encode_frame
+
+_LEN = struct.Struct(">I")
+logger = logging.getLogger("ceph_tpu.msg")
+
+
+class Dispatcher:
+    """Receiver interface (reference:src/msg/Dispatcher.h)."""
+
+    async def ms_dispatch(self, conn: "Connection", msg: Message) -> None:
+        raise NotImplementedError
+
+    def ms_handle_reset(self, conn: "Connection") -> None:
+        """Peer closed / connection failed (reference ms_handle_reset)."""
+
+
+class Connection:
+    """One ordered, crc-checked message stream to a peer."""
+
+    def __init__(
+        self,
+        messenger: "AsyncMessenger",
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+    ):
+        self.messenger = messenger
+        self._reader = reader
+        self._writer = writer
+        self.peer_name: str = "?"
+        self.peer_addr: str = ""
+        self._send_seq = 0
+        self._sendq: asyncio.Queue[Optional[bytes]] = asyncio.Queue()
+        self._tasks: list[asyncio.Task] = []
+        self._closed = False
+
+    def send(self, msg: Message) -> None:
+        """Queue a message; delivery is in send order (never blocks)."""
+        if self._closed:
+            return
+        self._send_seq += 1
+        frame = encode_frame(msg, self._send_seq)
+        self._sendq.put_nowait(frame)
+
+    async def _writer_loop(self) -> None:
+        try:
+            while True:
+                buf = await self._sendq.get()
+                if buf is None:
+                    break
+                self._writer.write(_LEN.pack(len(buf)))
+                self._writer.write(buf)
+                await self._writer.drain()
+        except (ConnectionError, asyncio.CancelledError, OSError):
+            pass
+
+    async def _reader_loop(self) -> None:
+        try:
+            while True:
+                hdr = await self._reader.readexactly(_LEN.size)
+                (n,) = _LEN.unpack(hdr)
+                frame = await self._reader.readexactly(n)
+                msg, _seq = decode_frame(frame)
+                try:
+                    await self.messenger._dispatch(self, msg)
+                except Exception:
+                    # a handler bug must not tear down the peer link
+                    logger.exception(
+                        "%s: dispatcher failed on %s from %s",
+                        self.messenger.name, msg.TYPE, self.peer_name,
+                    )
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        except BadFrame:
+            pass  # corrupt peer: drop the connection (reference fault path)
+        except asyncio.CancelledError:
+            raise
+        finally:
+            await self.close()
+            self.messenger._handle_reset(self)
+
+    async def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._sendq.put_nowait(None)
+        try:
+            self._writer.close()
+            await self._writer.wait_closed()
+        except (ConnectionError, OSError):
+            pass
+
+    def __repr__(self) -> str:
+        return f"Connection(to={self.peer_name}@{self.peer_addr})"
+
+
+class AsyncMessenger:
+    """Entity endpoint: listen and/or connect, dispatch inbound messages.
+
+    ``name`` is the entity name ("mon.0", "osd.3", "client.1").
+    """
+
+    def __init__(self, name: str, dispatcher: Dispatcher):
+        self.name = name
+        self.dispatcher = dispatcher
+        self.addr: str = ""
+        self._server: asyncio.AbstractServer | None = None
+        self._conns: dict[str, Connection] = {}  # outbound, keyed by peer addr
+        self._pending: dict[str, asyncio.Future] = {}  # in-flight connects
+        self._all: set[Connection] = set()
+        self._stopped = False
+
+    # -- lifecycle
+    async def bind(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        """Listen; returns the bound "host:port" address."""
+        self._server = await asyncio.start_server(self._accept, host, port)
+        h, p = self._server.sockets[0].getsockname()[:2]
+        self.addr = f"{h}:{p}"
+        return self.addr
+
+    async def shutdown(self) -> None:
+        self._stopped = True
+        if self._server is not None:
+            self._server.close()
+        conns = list(self._all)
+        for conn in conns:
+            await conn.close()
+            for t in conn._tasks:
+                t.cancel()
+        me = asyncio.current_task()
+        for conn in conns:
+            for t in conn._tasks:
+                if t is me:
+                    continue
+                try:
+                    await t
+                except (asyncio.CancelledError, Exception):
+                    pass
+        if self._server is not None:
+            # 3.12+: wait_closed blocks until accepted transports are gone,
+            # so it must come after the connection teardown above
+            await self._server.wait_closed()
+        self._all.clear()
+        self._conns.clear()
+
+    # -- connections
+    async def _accept(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        conn = Connection(self, reader, writer)
+        try:
+            banner = json.loads((await reader.readline()).decode())
+            conn.peer_name = banner["entity"]
+            conn.peer_addr = banner.get("addr", "")
+            writer.write(
+                json.dumps({"entity": self.name, "addr": self.addr}).encode() + b"\n"
+            )
+            await writer.drain()
+        except (ValueError, KeyError, ConnectionError, OSError):
+            writer.close()
+            return
+        self._start(conn)
+
+    async def connect(self, addr: str, peer_name: str = "?") -> Connection:
+        """Get (or open) the cached connection to ``addr``; concurrent
+        callers share one in-flight connect (no duplicate streams)."""
+        conn = self._conns.get(addr)
+        if conn is not None and not conn._closed:
+            return conn
+        pending = self._pending.get(addr)
+        if pending is not None:
+            return await asyncio.shield(pending)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[addr] = fut
+        try:
+            conn = await self._open(addr, peer_name)
+            fut.set_result(conn)
+            return conn
+        except BaseException as e:
+            fut.set_exception(e)
+            fut.exception()  # mark retrieved for lone waiters
+            raise
+        finally:
+            del self._pending[addr]
+
+    async def _open(self, addr: str, peer_name: str) -> Connection:
+        host, port = addr.rsplit(":", 1)
+        reader, writer = await asyncio.open_connection(host, int(port))
+        conn = Connection(self, reader, writer)
+        conn.peer_addr = addr
+        conn.peer_name = peer_name
+        writer.write(
+            json.dumps({"entity": self.name, "addr": self.addr}).encode() + b"\n"
+        )
+        await writer.drain()
+        banner = json.loads((await reader.readline()).decode())
+        conn.peer_name = banner["entity"]
+        self._conns[addr] = conn
+        self._start(conn)
+        return conn
+
+    def _start(self, conn: Connection) -> None:
+        self._all.add(conn)
+        conn._tasks = [
+            asyncio.ensure_future(conn._reader_loop()),
+            asyncio.ensure_future(conn._writer_loop()),
+        ]
+
+    # -- dispatch plumbing
+    async def _dispatch(self, conn: Connection, msg: Message) -> None:
+        await self.dispatcher.ms_dispatch(conn, msg)
+
+    def _handle_reset(self, conn: Connection) -> None:
+        self._all.discard(conn)
+        if self._conns.get(conn.peer_addr) is conn:
+            del self._conns[conn.peer_addr]
+        if not self._stopped:
+            self.dispatcher.ms_handle_reset(conn)

@@ -55,26 +55,34 @@ class MemStore(ObjectStore):
         (undo snapshots are taken lazily per touched collection/object)."""
         with self._lock:
             self._assert_mounted()
-            undo_colls: dict[CollectionId, dict[ObjectId, _Object] | None] = {}
-            undo_objs: dict[tuple[CollectionId, ObjectId], _Object | None] = {}
+            # ordered undo log, one entry per first touch; rollback replays it
+            # in reverse so a later snapshot never clobbers an earlier one
+            # (e.g. remove_collection + create_collection + write of an oid
+            # that existed in the old collection)
+            undo: list[tuple] = []
+            seen_colls: set[CollectionId] = set()
+            seen_objs: set[tuple[CollectionId, ObjectId]] = set()
 
             def snap_coll(cid: CollectionId) -> None:
-                if cid not in undo_colls:
-                    coll = self._colls.get(cid)
-                    undo_colls[cid] = dict(coll) if coll is not None else None
+                if cid in seen_colls:
+                    return
+                seen_colls.add(cid)
+                coll = self._colls.get(cid)
+                undo.append(("coll", cid, dict(coll) if coll is not None else None))
 
             def snap_obj(cid: CollectionId, oid: ObjectId) -> None:
                 key = (cid, oid)
-                if key in undo_objs:
+                if key in seen_objs:
                     return
+                seen_objs.add(key)
                 coll = self._colls.get(cid)
                 obj = coll.get(oid) if coll is not None else None
                 if obj is None:
-                    undo_objs[key] = None
+                    undo.append(("obj", cid, oid, None))
                 else:
                     cp = _Object()
                     cp.clone_from(obj)
-                    undo_objs[key] = cp
+                    undo.append(("obj", cid, oid, cp))
 
             try:
                 for op in txn.ops:
@@ -87,19 +95,22 @@ class MemStore(ObjectStore):
                             snap_obj(op[1], op[3])
                     self._apply_op(op)
             except Exception:
-                for cid, members in undo_colls.items():
-                    if members is None:
-                        self._colls.pop(cid, None)
+                for entry in reversed(undo):
+                    if entry[0] == "coll":
+                        _, cid, members = entry
+                        if members is None:
+                            self._colls.pop(cid, None)
+                        else:
+                            self._colls[cid] = members
                     else:
-                        self._colls[cid] = members
-                for (cid, oid), obj in undo_objs.items():
-                    coll = self._colls.get(cid)
-                    if coll is None:
-                        continue
-                    if obj is None:
-                        coll.pop(oid, None)
-                    else:
-                        coll[oid] = obj
+                        _, cid, oid, obj = entry
+                        coll = self._colls.get(cid)
+                        if coll is None:
+                            continue
+                        if obj is None:
+                            coll.pop(oid, None)
+                        else:
+                            coll[oid] = obj
                 raise
 
     def _coll(self, cid: CollectionId) -> dict[ObjectId, _Object]:

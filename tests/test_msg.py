@@ -1,0 +1,283 @@
+"""Messenger tests: framing, transaction wire form, asyncio transport.
+
+Mirrors the reference's messenger unit intents (reference:src/test/msgr/
+test_msgr.cc: connect/accept, ordered delivery, fault on corrupt frames)
+on the asyncio transport.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.msg import (
+    AsyncMessenger,
+    Dispatcher,
+    Message,
+    decode_frame,
+    encode_frame,
+    messages,
+)
+from ceph_tpu.msg.message import BadFrame
+from ceph_tpu.store import CollectionId, ObjectId, Transaction
+
+
+# -- framing -----------------------------------------------------------------
+
+
+def test_frame_roundtrip():
+    m = messages.MOSDOp(
+        tid=7, epoch=3, pool=1, oid="foo",
+        ops=[{"op": "write", "offset": 0, "length": 5, "data": 0}],
+        blobs=[b"hello"],
+    )
+    out, seq = decode_frame(encode_frame(m, seq=42))
+    assert isinstance(out, messages.MOSDOp)
+    assert seq == 42
+    assert out.tid == 7 and out.pool == 1 and out.oid == "foo"
+    assert out.ops == m.ops
+    assert out.blobs == [b"hello"]
+
+
+def test_frame_multiple_blobs_and_empty():
+    m = messages.MOSDECSubOpReadReply(
+        pgid="1.0", tid=1, shard=2,
+        reads=[{"data": 0}, {"data": 1}], attrs={}, errors=[],
+        blobs=[b"\x00" * 4096, b""],
+    )
+    out, _ = decode_frame(encode_frame(m))
+    assert out.blobs == [b"\x00" * 4096, b""]
+
+
+def test_frame_crc_detects_corruption():
+    m = messages.MPing(stamp=1.5, epoch=2)
+    frame = bytearray(encode_frame(m))
+    frame[len(frame) // 2] ^= 0xFF
+    with pytest.raises(BadFrame):
+        decode_frame(bytes(frame))
+
+
+def test_frame_bad_magic():
+    with pytest.raises(BadFrame):
+        decode_frame(b"XXXX" + b"\x00" * 20)
+
+
+def test_unknown_type_rejected():
+    class MUnknown(Message):
+        TYPE = "nope_not_registered"
+        FIELDS = ("x",)
+
+    with pytest.raises(BadFrame):
+        decode_frame(encode_frame(MUnknown(x=1)))
+
+
+def test_unknown_field_rejected():
+    with pytest.raises(TypeError):
+        messages.MPing(stamp=1, bogus=2)
+
+
+# -- transaction wire form ---------------------------------------------------
+
+
+def test_txn_roundtrip():
+    cid = CollectionId("1.0s1")
+    oid = ObjectId("obj", shard=1)
+    txn = (
+        Transaction()
+        .create_collection(cid)
+        .touch(cid, oid)
+        .write(cid, oid, 128, b"chunkdata")
+        .zero(cid, oid, 0, 16)
+        .truncate(cid, oid, 256)
+        .setattr(cid, oid, "hinfo_key", b"\x01\x02")
+        .rmattr(cid, oid, "old")
+        .omap_setkeys(cid, oid, {"k1": b"v1", "k2": b"v2"})
+        .omap_rmkeys(cid, oid, ["k1"])
+        .omap_clear(cid, oid)
+        .clone(cid, oid, ObjectId("obj2", shard=1))
+        .remove(cid, oid)
+        .remove_collection(cid)
+    )
+    ops, blobs = messages.encode_txn(txn)
+    back = messages.decode_txn(ops, blobs)
+    assert back.ops == txn.ops
+
+
+def test_txn_rides_in_message():
+    cid = CollectionId("2.3s0")
+    oid = ObjectId("x", shard=0)
+    txn = Transaction().write(cid, oid, 0, b"\xaa" * 512).setattr(cid, oid, "h", b"v")
+    ops, blobs = messages.encode_txn(txn)
+    m = messages.MOSDECSubOpWrite(
+        pgid="2.3", tid=9, from_osd=0, shard=0, txn=ops,
+        log=[], at_version=[1, 4], trim_to=[0, 0], blobs=blobs,
+    )
+    out, _ = decode_frame(encode_frame(m))
+    assert messages.decode_txn(out.txn, out.blobs).ops == txn.ops
+    assert out.at_version == [1, 4]
+
+
+# -- asyncio transport -------------------------------------------------------
+
+
+class Collector(Dispatcher):
+    def __init__(self):
+        self.got: list[tuple[str, Message]] = []
+        self.resets: list[str] = []
+        self.event = asyncio.Event()
+
+    async def ms_dispatch(self, conn, msg):
+        self.got.append((conn.peer_name, msg))
+        self.event.set()
+
+    def ms_handle_reset(self, conn):
+        self.resets.append(conn.peer_name)
+
+
+class Echo(Dispatcher):
+    async def ms_dispatch(self, conn, msg):
+        conn.send(messages.MPingReply(stamp=msg.stamp, epoch=msg.epoch))
+
+    def ms_handle_reset(self, conn):
+        pass
+
+
+async def _wait(pred, timeout=5.0):
+    async with asyncio.timeout(timeout):
+        while not pred():
+            await asyncio.sleep(0.005)
+
+
+def test_ping_pong_over_loopback():
+    async def main():
+        server_disp = Echo()
+        server = AsyncMessenger("osd.0", server_disp)
+        addr = await server.bind()
+
+        client_disp = Collector()
+        client = AsyncMessenger("client.1", client_disp)
+        conn = await client.connect(addr)
+        assert conn.peer_name == "osd.0"
+        for i in range(10):
+            conn.send(messages.MPing(stamp=float(i), epoch=1))
+        await _wait(lambda: len(client_disp.got) == 10)
+        # ordered delivery
+        assert [m.stamp for _, m in client_disp.got] == [float(i) for i in range(10)]
+        assert all(n == "osd.0" for n, _ in client_disp.got)
+        await client.shutdown()
+        await server.shutdown()
+
+    asyncio.run(main())
+
+
+def test_large_blob_transfer():
+    async def main():
+        disp = Collector()
+        server = AsyncMessenger("osd.1", disp)
+        addr = await server.bind()
+        client = AsyncMessenger("client.2", Collector())
+        conn = await client.connect(addr)
+        payload = bytes(range(256)) * (1 << 14)  # 4 MiB
+        conn.send(
+            messages.MOSDECSubOpWrite(
+                pgid="1.0", tid=1, from_osd=0, shard=3, txn=[],
+                log=[], at_version=[1, 1], trim_to=[0, 0], blobs=[payload],
+            )
+        )
+        await _wait(lambda: disp.got)
+        name, msg = disp.got[0]
+        assert name == "client.2"
+        assert msg.blobs[0] == payload
+        await client.shutdown()
+        await server.shutdown()
+
+    asyncio.run(main())
+
+
+def test_connection_cached_and_reset_callback():
+    async def main():
+        server = AsyncMessenger("osd.2", Echo())
+        addr = await server.bind()
+        disp = Collector()
+        client = AsyncMessenger("client.3", disp)
+        c1 = await client.connect(addr)
+        c2 = await client.connect(addr)
+        assert c1 is c2
+        await server.shutdown()  # peer dies -> client sees reset
+        await _wait(lambda: disp.resets)
+        assert disp.resets == ["osd.2"]
+        # reconnect after reset opens a fresh connection
+        server2 = AsyncMessenger("osd.2", Echo())
+        addr2 = await server2.bind()
+        c4 = await client.connect(addr2)
+        assert c4 is not c1
+        await client.shutdown()
+        await server2.shutdown()
+
+    asyncio.run(main())
+
+
+def test_concurrent_connect_shares_one_stream():
+    """Racing connect() calls must not open duplicate connections."""
+
+    async def main():
+        server = AsyncMessenger("osd.5", Echo())
+        addr = await server.bind()
+        client = AsyncMessenger("client.9", Collector())
+        conns = await asyncio.gather(*[client.connect(addr) for _ in range(8)])
+        assert all(c is conns[0] for c in conns)
+        assert len(server._all) == 1
+        await client.shutdown()
+        await server.shutdown()
+
+    asyncio.run(main())
+
+
+def test_dispatcher_exception_keeps_connection_alive():
+    """A handler bug on one message must not drop the peer link."""
+
+    class Flaky(Dispatcher):
+        def __init__(self):
+            self.ok = []
+
+        async def ms_dispatch(self, conn, msg):
+            if msg.stamp == 0.0:
+                raise KeyError("handler bug")
+            self.ok.append(msg.stamp)
+
+        def ms_handle_reset(self, conn):
+            pass
+
+    async def main():
+        disp = Flaky()
+        server = AsyncMessenger("osd.6", disp)
+        addr = await server.bind()
+        client = AsyncMessenger("client.10", Collector())
+        conn = await client.connect(addr)
+        conn.send(messages.MPing(stamp=0.0, epoch=1))  # triggers handler bug
+        conn.send(messages.MPing(stamp=1.0, epoch=1))  # must still arrive
+        await _wait(lambda: disp.ok)
+        assert disp.ok == [1.0]
+        await client.shutdown()
+        await server.shutdown()
+
+    asyncio.run(main())
+
+
+def test_bidirectional_entities():
+    """Two messengers each bound and connected to each other (OSD<->OSD)."""
+
+    async def main():
+        d_a, d_b = Collector(), Collector()
+        a = AsyncMessenger("osd.0", d_a)
+        b = AsyncMessenger("osd.1", d_b)
+        addr_a = await a.bind()
+        addr_b = await b.bind()
+        (await a.connect(addr_b)).send(messages.MPing(stamp=1.0, epoch=1))
+        (await b.connect(addr_a)).send(messages.MPing(stamp=2.0, epoch=1))
+        await _wait(lambda: d_a.got and d_b.got)
+        assert d_b.got[0][1].stamp == 1.0
+        assert d_a.got[0][1].stamp == 2.0
+        await a.shutdown()
+        await b.shutdown()
+
+    asyncio.run(main())

@@ -162,6 +162,23 @@ def test_failed_transaction_rolls_back(store):
     assert not store.collection_exists(CollectionId("9.9"))
 
 
+def test_rollback_collection_recreate_preserves_original_objects(store):
+    """remove_collection + create_collection + write(old oid) + fail must
+    restore the original object (ordered undo log, replayed in reverse)."""
+    _mkcoll(store)
+    store.apply(Transaction().write(CID, OID, 0, b"orig"))
+    bad = (
+        Transaction()
+        .remove_collection(CID)
+        .create_collection(CID)
+        .write(CID, OID, 0, b"NEW")
+        .rmattr(CID, ObjectId("missing", shard=0), "k")  # fails
+    )
+    with pytest.raises(KeyError):
+        store.apply(bad)
+    assert store.read(CID, OID) == b"orig"
+
+
 def test_unmounted_store_rejects_io():
     s = MemStore()
     s.mkfs()
