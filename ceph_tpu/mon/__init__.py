@@ -1,0 +1,11 @@
+"""Control plane: the monitor.
+
+MON-lite per the build plan (SURVEY.md §7 step 5): a single authoritative
+map service — the role of the reference monitor quorum
+(reference:src/mon/Monitor.cc, OSDMonitor.cc) without Paxos; the map
+mutation/validation/publish semantics follow OSDMonitor.
+"""
+
+from .monitor import Monitor
+
+__all__ = ["Monitor"]

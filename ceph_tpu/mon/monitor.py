@@ -1,0 +1,301 @@
+"""Monitor: authoritative OSDMap service.
+
+Re-expression of the reference control plane for the mini-cluster:
+
+- map mutations bump the epoch and are pushed to every subscriber
+  (reference OSDMonitor maintains the map inside Paxos and clients
+  subscribe via MMonSubscribe; here the mon is a single process so the
+  Paxos log collapses to in-process mutation order —
+  reference:src/mon/OSDMonitor.cc).
+- OSD boot reports mark the osd up (reference:src/mon/OSDMonitor.cc
+  prepare_boot); failure reports from peers mark it down once enough
+  distinct reporters agree (reference:src/mon/OSDMonitor.cc
+  prepare_failure / check_failure, reporter aggregation).
+- EC profile commands validate by instantiating the codec before
+  accepting the profile (reference:src/mon/OSDMonitor.cc:4305-4341 set/
+  get/ls/rm, validation :4590-4600).
+- a connection reset from a booted OSD is treated as an immediate
+  failure signal (the mini-cluster analog of heartbeat-grace expiry —
+  the TCP FIN arrives faster than any ping schedule on loopback).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any
+
+from ..crush.map import CrushMap
+from ..models import registry
+from ..msg import AsyncMessenger, Connection, Dispatcher, messages
+from ..msg.message import Message
+from ..osd.osdmap import OSDMap
+
+logger = logging.getLogger("ceph_tpu.mon")
+
+EINVAL = 22
+ENOENT = 2
+EEXIST = 17
+
+DEFAULT_EC_PROFILE = {
+    # reference:src/common/config_opts.h:677 osd_pool_default_erasure_code_profile
+    "plugin": "jerasure",
+    "technique": "reed_sol_van",
+    "k": "2",
+    "m": "1",
+}
+
+
+class Monitor(Dispatcher):
+    """Single-process map authority + command endpoint."""
+
+    def __init__(
+        self,
+        name: str = "mon.0",
+        max_osds: int = 16,
+        failure_min_reporters: int = 1,
+    ):
+        self.name = name
+        self.messenger = AsyncMessenger(name, self)
+        self.failure_min_reporters = failure_min_reporters
+        self.osdmap = OSDMap(CrushMap.flat(max_osds))
+        self.osdmap.set_max_osd(max_osds)
+        self.osdmap.epoch = 1
+        self.osdmap.set_erasure_code_profile("default", DEFAULT_EC_PROFILE)
+        self._subs: set[Connection] = set()
+        self._boot_conns: dict[int, Connection] = {}  # osd id -> its conn
+        self._failure_reports: dict[int, set[int]] = {}  # target -> reporters
+        self.addr = ""
+
+    # -- lifecycle
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        self.addr = await self.messenger.bind(host, port)
+        return self.addr
+
+    async def stop(self) -> None:
+        await self.messenger.shutdown()
+
+    # -- dispatch
+    async def ms_dispatch(self, conn: Connection, msg: Message) -> None:
+        if isinstance(msg, messages.MOSDBoot):
+            self._handle_boot(conn, msg)
+        elif isinstance(msg, messages.MOSDFailure):
+            self._handle_failure(msg)
+        elif isinstance(msg, messages.MMonGetMap):
+            self._subs.add(conn)
+            if msg.have is None or msg.have < self.osdmap.epoch:
+                self._send_map(conn)
+        elif isinstance(msg, messages.MMonCommand):
+            code, status, out = self.handle_command(msg.cmd)
+            conn.send(
+                messages.MMonCommandReply(
+                    tid=msg.tid, code=code, status=status, out=out
+                )
+            )
+        elif isinstance(msg, messages.MPing):
+            conn.send(messages.MPingReply(stamp=msg.stamp, epoch=self.osdmap.epoch))
+
+    def ms_handle_reset(self, conn: Connection) -> None:
+        self._subs.discard(conn)
+        for osd, c in list(self._boot_conns.items()):
+            if c is conn:
+                del self._boot_conns[osd]
+                if self.osdmap.is_up(osd):
+                    logger.info("%s: osd.%d connection reset -> down", self.name, osd)
+                    self.osdmap.mark_down(osd)
+                    self._publish()
+
+    def _valid_osd_id(self, osd) -> bool:
+        return isinstance(osd, int) and 0 <= osd < self.osdmap.max_osd
+
+    # -- osd lifecycle
+    def _handle_boot(self, conn: Connection, msg: messages.MOSDBoot) -> None:
+        osd = msg.osd_id
+        if not self._valid_osd_id(osd):
+            logger.warning("%s: rejecting boot with bad osd id %r", self.name, osd)
+            return
+        # a reboot of an operator-out osd must NOT mark it back in
+        # (reference mon_osd_auto_mark_in=false semantics); only a
+        # first-ever boot auto-ins the device
+        first_boot = not self.osdmap.exists(osd)
+        self.osdmap.mark_up(osd, addr=msg.addr)
+        if first_boot or self.osdmap.is_in(osd):
+            self.osdmap.mark_in(osd)
+        self._boot_conns[osd] = conn
+        self._subs.add(conn)
+        self._failure_reports.pop(osd, None)
+        logger.info("%s: osd.%d booted at %s", self.name, osd, msg.addr)
+        self._publish()
+
+    def _handle_failure(self, msg: messages.MOSDFailure) -> None:
+        target = msg.target_osd
+        if not self._valid_osd_id(target) or not self.osdmap.is_up(target):
+            return
+        reporters = self._failure_reports.setdefault(target, set())
+        reporters.add(msg.reporter)
+        if len(reporters) >= self.failure_min_reporters:
+            logger.info(
+                "%s: osd.%d marked down (%d reporters)",
+                self.name, target, len(reporters),
+            )
+            self.osdmap.mark_down(target)
+            del self._failure_reports[target]
+            self._publish()
+
+    # -- map distribution
+    def _publish(self) -> None:
+        self.osdmap.epoch += 1
+        for conn in list(self._subs):
+            self._send_map(conn)
+
+    def _send_map(self, conn: Connection) -> None:
+        conn.send(
+            messages.MOSDMapMsg(epoch=self.osdmap.epoch, osdmap=self.osdmap.to_dict())
+        )
+
+    # -- commands (reference:src/mon/MonCommands.h subset)
+    def handle_command(self, cmd: dict) -> tuple[int, str, Any]:
+        prefix = cmd.get("prefix", "")
+        try:
+            handler = {
+                "osd erasure-code-profile set": self._cmd_ec_profile_set,
+                "osd erasure-code-profile get": self._cmd_ec_profile_get,
+                "osd erasure-code-profile ls": self._cmd_ec_profile_ls,
+                "osd erasure-code-profile rm": self._cmd_ec_profile_rm,
+                "osd pool create": self._cmd_pool_create,
+                "osd pool ls": self._cmd_pool_ls,
+                "osd pool rm": self._cmd_pool_rm,
+                "osd dump": self._cmd_osd_dump,
+                "osd down": self._cmd_osd_down,
+                "osd out": self._cmd_osd_out,
+                "osd in": self._cmd_osd_in,
+                "status": self._cmd_status,
+            }.get(prefix)
+            if handler is None:
+                return -EINVAL, f"unknown command {prefix!r}", None
+            return handler(cmd)
+        except Exception as e:  # command errors must not kill the mon
+            logger.exception("%s: command %r failed", self.name, prefix)
+            return -EINVAL, str(e), None
+
+    def _cmd_ec_profile_set(self, cmd: dict) -> tuple[int, str, Any]:
+        name = cmd["name"]
+        profile = {str(k): str(v) for k, v in cmd.get("profile", {}).items()}
+        if name in self.osdmap.erasure_code_profiles:
+            existing = self.osdmap.erasure_code_profiles[name]
+            if existing == profile:
+                return 0, "", None
+            # an in-use profile can never be altered, even with force —
+            # pools bake size/stripe_width from it at create time
+            for pool in self.osdmap.pools.values():
+                if pool.erasure_code_profile == name:
+                    return (
+                        -EINVAL,
+                        f"profile {name!r} is in use by pool {pool.name!r}",
+                        None,
+                    )
+            if not cmd.get("force"):
+                return (
+                    -EEXIST,
+                    f"profile {name!r} exists with different parameters",
+                    None,
+                )
+        # validate by instantiating the codec (reference:OSDMonitor.cc:4590)
+        plugin = profile.get("plugin", "jerasure")
+        try:
+            registry.instance().factory(plugin, dict(profile))
+        except Exception as e:
+            return -EINVAL, f"invalid profile: {e}", None
+        self.osdmap.set_erasure_code_profile(name, profile)
+        self._publish()
+        return 0, "", None
+
+    def _cmd_ec_profile_get(self, cmd: dict) -> tuple[int, str, Any]:
+        name = cmd["name"]
+        if name not in self.osdmap.erasure_code_profiles:
+            return -ENOENT, f"no profile {name!r}", None
+        return 0, "", self.osdmap.get_erasure_code_profile(name)
+
+    def _cmd_ec_profile_ls(self, cmd: dict) -> tuple[int, str, Any]:
+        return 0, "", sorted(self.osdmap.erasure_code_profiles)
+
+    def _cmd_ec_profile_rm(self, cmd: dict) -> tuple[int, str, Any]:
+        name = cmd["name"]
+        if name not in self.osdmap.erasure_code_profiles:
+            return -ENOENT, f"no profile {name!r}", None
+        for pool in self.osdmap.pools.values():
+            if pool.erasure_code_profile == name:
+                return -EINVAL, f"profile {name!r} is in use by pool {pool.name!r}", None
+        del self.osdmap.erasure_code_profiles[name]
+        self._publish()
+        return 0, "", None
+
+    def _cmd_pool_create(self, cmd: dict) -> tuple[int, str, Any]:
+        name = cmd["pool"]
+        existing = self.osdmap.lookup_pool(name)
+        if existing is not None:
+            return 0, f"pool {name!r} already exists", {"pool_id": existing.id}
+        pg_num = int(cmd.get("pg_num", 8))
+        if cmd.get("pool_type", "replicated") == "erasure":
+            profile = cmd.get("erasure_code_profile", "default")
+            pool = self.osdmap.create_erasure_pool(
+                name, profile, pg_num=pg_num,
+                stripe_unit=int(cmd.get("stripe_unit", 4096)),
+            )
+        else:
+            pool = self.osdmap.create_replicated_pool(
+                name, size=int(cmd.get("size", 3)), pg_num=pg_num
+            )
+        self._publish()
+        return 0, "", {"pool_id": pool.id}
+
+    def _cmd_pool_ls(self, cmd: dict) -> tuple[int, str, Any]:
+        return 0, "", sorted(p.name for p in self.osdmap.pools.values())
+
+    def _cmd_pool_rm(self, cmd: dict) -> tuple[int, str, Any]:
+        pool = self.osdmap.lookup_pool(cmd["pool"])
+        if pool is None:
+            return -ENOENT, f"no pool {cmd['pool']!r}", None
+        del self.osdmap.pools[pool.id]
+        del self.osdmap.pool_name[pool.name]
+        self._publish()
+        return 0, "", None
+
+    def _cmd_osd_dump(self, cmd: dict) -> tuple[int, str, Any]:
+        return 0, "", self.osdmap.to_dict()
+
+    def _cmd_osd_down(self, cmd: dict) -> tuple[int, str, Any]:
+        osd = int(cmd["id"])
+        if not self._valid_osd_id(osd):
+            return -EINVAL, f"bad osd id {osd}", None
+        self.osdmap.mark_down(osd)
+        self._publish()
+        return 0, "", None
+
+    def _cmd_osd_out(self, cmd: dict) -> tuple[int, str, Any]:
+        osd = int(cmd["id"])
+        if not self._valid_osd_id(osd):
+            return -EINVAL, f"bad osd id {osd}", None
+        self.osdmap.mark_out(osd)
+        self._publish()
+        return 0, "", None
+
+    def _cmd_osd_in(self, cmd: dict) -> tuple[int, str, Any]:
+        osd = int(cmd["id"])
+        if not self._valid_osd_id(osd):
+            return -EINVAL, f"bad osd id {osd}", None
+        self.osdmap.mark_in(osd)
+        self._publish()
+        return 0, "", None
+
+    def _cmd_status(self, cmd: dict) -> tuple[int, str, Any]:
+        m = self.osdmap
+        up = sum(1 for o in range(m.max_osd) if m.is_up(o))
+        inn = sum(1 for o in range(m.max_osd) if m.is_in(o))
+        return 0, "", {
+            "epoch": m.epoch,
+            "num_osds": sum(1 for o in range(m.max_osd) if m.exists(o)),
+            "num_up_osds": up,
+            "num_in_osds": inn,
+            "pools": sorted(p.name for p in m.pools.values()),
+        }

@@ -1,0 +1,288 @@
+"""Monitor tests: boot/failure lifecycle, EC profile commands, map push.
+
+Mirrors the reference's OSDMonitor semantics (reference:src/mon/
+OSDMonitor.cc: prepare_boot, prepare_failure, erasure-code-profile
+set/get/ls/rm with plugin validation :4305-4341,:4590-4600).
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.mon import Monitor
+from ceph_tpu.msg import AsyncMessenger, Dispatcher, messages
+from ceph_tpu.osd.osdmap import OSDMap
+
+
+class Client(Dispatcher):
+    """Minimal mon client: command round-trips + map collection."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.messenger = AsyncMessenger(name, self)
+        self.maps: list[int] = []
+        self.osdmap = None
+        self.replies: dict[int, messages.MMonCommandReply] = {}
+        self._tid = 0
+
+    async def ms_dispatch(self, conn, msg):
+        if isinstance(msg, messages.MOSDMapMsg):
+            self.maps.append(msg.epoch)
+            self.osdmap = OSDMap.from_dict(msg.osdmap)
+        elif isinstance(msg, messages.MMonCommandReply):
+            self.replies[msg.tid] = msg
+
+    def ms_handle_reset(self, conn):
+        pass
+
+    async def command(self, conn, cmd: dict, timeout=5.0):
+        self._tid += 1
+        tid = self._tid
+        conn.send(messages.MMonCommand(tid=tid, cmd=cmd))
+        async with asyncio.timeout(timeout):
+            while tid not in self.replies:
+                await asyncio.sleep(0.005)
+        r = self.replies.pop(tid)
+        return r.code, r.status, r.out
+
+
+async def _wait(pred, timeout=5.0):
+    async with asyncio.timeout(timeout):
+        while not pred():
+            await asyncio.sleep(0.005)
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+def test_boot_marks_up_and_publishes():
+    async def main():
+        mon = Monitor(max_osds=4)
+        addr = await mon.start()
+        cl = Client("client.1")
+        conn = await cl.messenger.connect(addr)
+        conn.send(messages.MMonGetMap(have=0))
+        await _wait(lambda: cl.osdmap is not None)
+        assert not cl.osdmap.is_up(0)
+
+        osd = Client("osd.0")
+        oconn = await osd.messenger.connect(addr)
+        oconn.send(messages.MOSDBoot(osd_id=0, addr="127.0.0.1:7000"))
+        await _wait(lambda: cl.osdmap is not None and cl.osdmap.is_up(0))
+        assert cl.osdmap.get_addr(0) == "127.0.0.1:7000"
+        assert cl.osdmap.is_in(0)
+
+        # osd connection reset -> marked down, epoch bumped
+        before = cl.osdmap.epoch
+        await osd.messenger.shutdown()
+        await _wait(lambda: cl.osdmap.epoch > before and cl.osdmap.is_down(0))
+        await cl.messenger.shutdown()
+        await mon.stop()
+
+    run(main())
+
+
+def test_failure_reports_mark_down():
+    async def main():
+        mon = Monitor(max_osds=4, failure_min_reporters=2)
+        addr = await mon.start()
+        osds = []
+        for i in range(3):
+            c = Client(f"osd.{i}")
+            conn = await c.messenger.connect(addr)
+            conn.send(messages.MOSDBoot(osd_id=i, addr=f"127.0.0.1:{7000+i}"))
+            osds.append((c, conn))
+        await _wait(lambda: all(mon.osdmap.is_up(i) for i in range(3)))
+
+        # one reporter is not enough
+        osds[1][1].send(messages.MOSDFailure(target_osd=0, reporter=1, epoch=1))
+        await asyncio.sleep(0.05)
+        assert mon.osdmap.is_up(0)
+        # second distinct reporter trips it
+        osds[2][1].send(messages.MOSDFailure(target_osd=0, reporter=2, epoch=1))
+        await _wait(lambda: mon.osdmap.is_down(0))
+        for c, _ in osds:
+            await c.messenger.shutdown()
+        await mon.stop()
+
+    run(main())
+
+
+def test_ec_profile_commands():
+    async def main():
+        mon = Monitor()
+        addr = await mon.start()
+        cl = Client("client.2")
+        conn = await cl.messenger.connect(addr)
+
+        code, _, out = await cl.command(conn, {"prefix": "osd erasure-code-profile ls"})
+        assert code == 0 and out == ["default"]
+
+        code, _, _ = await cl.command(conn, {
+            "prefix": "osd erasure-code-profile set", "name": "rs83",
+            "profile": {"plugin": "jerasure", "technique": "reed_sol_van",
+                        "k": "8", "m": "3"},
+        })
+        assert code == 0
+        code, _, out = await cl.command(
+            conn, {"prefix": "osd erasure-code-profile get", "name": "rs83"})
+        assert code == 0 and out["k"] == "8"
+
+        # invalid profile rejected by codec validation
+        code, status, _ = await cl.command(conn, {
+            "prefix": "osd erasure-code-profile set", "name": "bad",
+            "profile": {"plugin": "jerasure", "k": "0", "m": "1"},
+        })
+        assert code != 0
+        # unknown plugin rejected
+        code, _, _ = await cl.command(conn, {
+            "prefix": "osd erasure-code-profile set", "name": "bad2",
+            "profile": {"plugin": "nonexistent"},
+        })
+        assert code != 0
+        # redefinition with different params without force -> EEXIST
+        code, _, _ = await cl.command(conn, {
+            "prefix": "osd erasure-code-profile set", "name": "rs83",
+            "profile": {"plugin": "jerasure", "k": "4", "m": "2"},
+        })
+        assert code != 0
+
+        code, _, out = await cl.command(conn, {"prefix": "osd erasure-code-profile ls"})
+        assert out == ["default", "rs83"]
+        code, _, _ = await cl.command(
+            conn, {"prefix": "osd erasure-code-profile rm", "name": "rs83"})
+        assert code == 0
+        await cl.messenger.shutdown()
+        await mon.stop()
+
+    run(main())
+
+
+def test_pool_create_and_profile_in_use():
+    async def main():
+        mon = Monitor(max_osds=8)
+        addr = await mon.start()
+        cl = Client("client.3")
+        conn = await cl.messenger.connect(addr)
+        conn.send(messages.MMonGetMap(have=0))
+
+        code, _, out = await cl.command(conn, {
+            "prefix": "osd pool create", "pool": "ecpool",
+            "pool_type": "erasure", "erasure_code_profile": "default",
+            "pg_num": 8,
+        })
+        assert code == 0
+        pool_id = out["pool_id"]
+        await _wait(lambda: cl.osdmap is not None
+                    and cl.osdmap.lookup_pool("ecpool") is not None)
+        pool = cl.osdmap.lookup_pool("ecpool")
+        assert pool.id == pool_id and pool.is_erasure()
+        assert pool.size == 3  # k=2 m=1 default profile
+        assert pool.stripe_width == 2 * 4096
+
+        # profile now in use -> rm refused
+        code, status, _ = await cl.command(
+            conn, {"prefix": "osd erasure-code-profile rm", "name": "default"})
+        assert code != 0 and "in use" in status
+
+        code, _, out = await cl.command(conn, {"prefix": "osd pool ls"})
+        assert out == ["ecpool"]
+
+        code, _, out = await cl.command(conn, {"prefix": "status"})
+        assert out["pools"] == ["ecpool"]
+
+        code, _, _ = await cl.command(conn, {"prefix": "osd pool rm", "pool": "ecpool"})
+        assert code == 0
+        await cl.messenger.shutdown()
+        await mon.stop()
+
+    run(main())
+
+
+def test_boot_respects_operator_out_and_bad_ids():
+    async def main():
+        mon = Monitor(max_osds=4)
+        addr = await mon.start()
+        cl = Client("client.5")
+        conn = await cl.messenger.connect(addr)
+
+        osd = Client("osd.0")
+        oconn = await osd.messenger.connect(addr)
+        oconn.send(messages.MOSDBoot(osd_id=0, addr="127.0.0.1:7000"))
+        await _wait(lambda: mon.osdmap.is_up(0))
+        assert mon.osdmap.is_in(0)
+
+        # operator outs it; a reboot must NOT mark it back in
+        code, _, _ = await cl.command(conn, {"prefix": "osd out", "id": 0})
+        assert code == 0
+        await osd.messenger.shutdown()
+        await _wait(lambda: mon.osdmap.is_down(0))
+        osd2 = Client("osd.0")
+        oconn2 = await osd2.messenger.connect(addr)
+        oconn2.send(messages.MOSDBoot(osd_id=0, addr="127.0.0.1:7000"))
+        await _wait(lambda: mon.osdmap.is_up(0))
+        assert mon.osdmap.is_out(0)
+
+        # malicious / bogus ids are rejected without corrupting state
+        state_before = list(mon.osdmap.osd_state)
+        oconn2.send(messages.MOSDBoot(osd_id=-1, addr="x"))
+        oconn2.send(messages.MOSDBoot(osd_id=10**9, addr="x"))
+        oconn2.send(messages.MOSDFailure(target_osd=-1, reporter=0, epoch=1))
+        await asyncio.sleep(0.05)
+        assert mon.osdmap.max_osd == 4
+        assert list(mon.osdmap.osd_state) == state_before
+        code, _, _ = await cl.command(conn, {"prefix": "osd down", "id": -1})
+        assert code != 0
+
+        await osd2.messenger.shutdown()
+        await cl.messenger.shutdown()
+        await mon.stop()
+
+    run(main())
+
+
+def test_profile_set_in_use_refused_and_rm_missing_enoent():
+    async def main():
+        mon = Monitor(max_osds=4)
+        addr = await mon.start()
+        cl = Client("client.6")
+        conn = await cl.messenger.connect(addr)
+        code, _, out = await cl.command(conn, {
+            "prefix": "osd pool create", "pool": "p", "pool_type": "erasure"})
+        assert code == 0
+        # force-overwrite of in-use profile refused
+        code, status, _ = await cl.command(conn, {
+            "prefix": "osd erasure-code-profile set", "name": "default",
+            "force": True,
+            "profile": {"plugin": "jerasure", "k": "8", "m": "3"},
+        })
+        assert code != 0 and "in use" in status
+        # idempotent pool create returns the id
+        code, _, out2 = await cl.command(conn, {
+            "prefix": "osd pool create", "pool": "p", "pool_type": "erasure"})
+        assert code == 0 and out2["pool_id"] == out["pool_id"]
+        # rm of a missing profile is ENOENT, not silent success
+        epoch = mon.osdmap.epoch
+        code, _, _ = await cl.command(
+            conn, {"prefix": "osd erasure-code-profile rm", "name": "ghost"})
+        assert code != 0
+        assert mon.osdmap.epoch == epoch  # no spurious publish
+        await cl.messenger.shutdown()
+        await mon.stop()
+
+    run(main())
+
+
+def test_unknown_command():
+    async def main():
+        mon = Monitor()
+        addr = await mon.start()
+        cl = Client("client.4")
+        conn = await cl.messenger.connect(addr)
+        code, status, _ = await cl.command(conn, {"prefix": "bogus nonsense"})
+        assert code != 0 and "unknown command" in status
+        await cl.messenger.shutdown()
+        await mon.stop()
+
+    run(main())
