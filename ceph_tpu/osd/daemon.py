@@ -1,0 +1,778 @@
+"""The OSD daemon: client op engine + EC/replicated backends.
+
+Re-expression of the reference OSD data path (reference:src/osd/OSD.cc,
+PrimaryLogPG.cc, PGBackend.{h,cc}) for the asyncio mini-cluster:
+
+- boot: connect to the mon, announce (MOSDBoot), subscribe to maps
+  (reference:src/osd/OSD.cc:2051 init / MOSDBoot flow).
+- client ops arrive as MOSDOp on the primary
+  (reference:src/osd/OSD.cc:6107 ms_fast_dispatch →
+  PrimaryLogPG::do_op/do_osd_ops :4150); each op runs as its own asyncio
+  task — the role of the sharded op workqueue (reference:src/osd/OSD.cc:1692).
+- the EC write pipeline batches ALL stripes of an object into one codec
+  device call (ceph_tpu.osd.ec_util.encode), fans per-shard transactions
+  out as MOSDECSubOpWrite, self-delivers its own shard, and completes the
+  client op when every present shard has committed
+  (reference:src/osd/ECBackend.cc:1389 submit_transaction → :1902-1926
+  shard fan-out → :878 handle_sub_write → :1946 try_finish_rmw).
+- EC reads pick the cheapest shard set via minimum_to_decode, verify each
+  shard's cumulative crc32c against its HashInfo xattr, reconstruct if
+  any data shard is missing, and retry with the remaining shards on
+  error (reference:src/osd/ECBackend.cc:2187 objects_read_and_reconstruct,
+  :1438 get_min_avail_to_read_shards, :941/:994-1008 handle_sub_read +
+  crc check, :2239 send_all_remaining_reads).
+- replicated pools fan whole transactions to the acting set
+  (reference:src/osd/ReplicatedBackend.cc MOSDRepOp flow).
+- heartbeats: periodic pings to peer OSDs; a silent peer past the grace
+  is reported to the mon (reference:src/osd/OSD.cc:4104-4245).
+
+Positional shard roles come from the acting set: acting[i] serves shard i
+(crush_choose_indep positional stability, reference:src/crush/mapper.c:612).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import Any
+
+import numpy as np
+
+from ..models import registry
+from ..msg import AsyncMessenger, Connection, Dispatcher, messages
+from ..msg.message import Message
+from ..store import CollectionId, MemStore, ObjectId, ObjectStore, Transaction
+from ..utils import native
+from . import ec_util
+from .ec_util import HashInfo, StripeInfo
+from .osdmap import CRUSH_ITEM_NONE, OSDMap, PGid, Pool, POOL_TYPE_ERASURE
+from .pg_log import Eversion, PGLogEntry, add_log_entry_to_txn
+
+logger = logging.getLogger("ceph_tpu.osd")
+
+ENOENT = 2
+EIO = 5
+EAGAIN = 11
+EINVAL = 22
+
+OI_KEY = "_"  # object-info xattr (reference OI_ATTR)
+SUBOP_TIMEOUT = 30.0
+
+
+class _Waiter:
+    """Gathers sub-op replies for one in-flight primary op."""
+
+    def __init__(self, pending: set[int]):
+        self.pending = set(pending)
+        self.results: dict[int, int] = {}
+        self.event = asyncio.Event()
+        if not self.pending:
+            self.event.set()
+
+    def complete(self, shard: int, result: int) -> None:
+        if shard in self.pending:
+            self.pending.discard(shard)
+            self.results[shard] = result
+            if not self.pending:
+                self.event.set()
+
+
+class _ReadWaiter:
+    """Gathers MOSDECSubOpReadReply chunks."""
+
+    def __init__(self, pending: set[int]):
+        self.pending = set(pending)
+        self.data: dict[int, bytes] = {}
+        self.attrs: dict[int, dict] = {}
+        self.errors: dict[int, int] = {}
+        self.event = asyncio.Event()
+        if not self.pending:
+            self.event.set()
+
+    def complete(
+        self, shard: int, data: bytes | None, attrs: dict | None, err: int
+    ) -> None:
+        if shard not in self.pending:
+            return
+        self.pending.discard(shard)
+        if err:
+            self.errors[shard] = err
+        else:
+            self.data[shard] = data if data is not None else b""
+            self.attrs[shard] = attrs or {}
+        if not self.pending:
+            self.event.set()
+
+
+class OSD(Dispatcher):
+    """One object-storage daemon."""
+
+    def __init__(
+        self,
+        osd_id: int,
+        mon_addr: str,
+        store: ObjectStore | None = None,
+        heartbeat_interval: float = 0.0,
+        heartbeat_grace: float = 3.0,
+    ):
+        self.osd_id = osd_id
+        self.name = f"osd.{osd_id}"
+        self.mon_addr = mon_addr
+        self.messenger = AsyncMessenger(self.name, self)
+        self.store = store or MemStore()
+        self.osdmap: OSDMap | None = None
+        self.addr = ""
+        self.heartbeat_interval = heartbeat_interval
+        self.heartbeat_grace = heartbeat_grace
+        self._codecs: dict[int, tuple[Any, StripeInfo]] = {}
+        self._tid = 0
+        self._write_waiters: dict[int, _Waiter] = {}
+        self._read_waiters: dict[int, _ReadWaiter] = {}
+        self._pg_versions: dict[str, Eversion] = {}
+        self._tasks: set[asyncio.Task] = set()
+        self._hb_task: asyncio.Task | None = None
+        self._hb_last: dict[int, float] = {}
+        self._map_event = asyncio.Event()
+        self._stopping = False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        try:
+            self.store.mount()
+        except Exception:
+            self.store.mkfs()
+            self.store.mount()
+        self.addr = await self.messenger.bind(host, port)
+        mon = await self.messenger.connect(self.mon_addr, "mon.0")
+        mon.send(messages.MMonGetMap(have=0))
+        mon.send(messages.MOSDBoot(osd_id=self.osd_id, addr=self.addr))
+        async with asyncio.timeout(10):
+            await self._map_event.wait()
+        if self.heartbeat_interval > 0:
+            self._hb_task = asyncio.ensure_future(self._heartbeat_loop())
+        return self.addr
+
+    async def stop(self) -> None:
+        self._stopping = True
+        if self._hb_task:
+            self._hb_task.cancel()
+        for t in list(self._tasks):
+            t.cancel()
+        await self.messenger.shutdown()
+        self.store.umount()
+
+    # -- dispatch ------------------------------------------------------------
+
+    async def ms_dispatch(self, conn: Connection, msg: Message) -> None:
+        if isinstance(msg, messages.MOSDMapMsg):
+            self._handle_map(msg)
+        elif isinstance(msg, messages.MOSDOp):
+            # run as a task: the op blocks on shard round-trips and must not
+            # stall the connection reader (sharded op queue analog)
+            t = asyncio.ensure_future(self._handle_client_op(conn, msg))
+            self._tasks.add(t)
+            t.add_done_callback(self._tasks.discard)
+        elif isinstance(msg, messages.MOSDECSubOpWrite):
+            self._handle_sub_write(conn, msg)
+        elif isinstance(msg, messages.MOSDECSubOpWriteReply):
+            w = self._write_waiters.get(msg.tid)
+            if w:
+                w.complete(msg.shard, msg.result)
+        elif isinstance(msg, messages.MOSDECSubOpRead):
+            self._handle_sub_read(conn, msg)
+        elif isinstance(msg, messages.MOSDECSubOpReadReply):
+            w = self._read_waiters.get(msg.tid)
+            if w:
+                err = msg.errors[0] if msg.errors else 0
+                data = msg.blobs[0] if msg.blobs else b""
+                w.complete(msg.shard, data, msg.attrs, err)  # attrs: flat {key: str}
+        elif isinstance(msg, messages.MOSDRepOp):
+            self._handle_rep_op(conn, msg)
+        elif isinstance(msg, messages.MOSDRepOpReply):
+            w = self._write_waiters.get(msg.tid)
+            if w:
+                w.complete(msg.from_osd, msg.result)
+        elif isinstance(msg, messages.MPing):
+            conn.send(messages.MPingReply(stamp=msg.stamp, epoch=self._epoch()))
+        elif isinstance(msg, messages.MPingReply):
+            self._hb_last[self._peer_osd_id(conn)] = time.monotonic()
+
+    def ms_handle_reset(self, conn: Connection) -> None:
+        pass  # failure detection is heartbeat + mon-side conn reset
+
+    def _peer_osd_id(self, conn: Connection) -> int:
+        name = conn.peer_name
+        if name.startswith("osd."):
+            try:
+                return int(name.split(".", 1)[1])
+            except ValueError:
+                pass
+        return -1
+
+    def _epoch(self) -> int:
+        return self.osdmap.epoch if self.osdmap else 0
+
+    def _handle_map(self, msg: messages.MOSDMapMsg) -> None:
+        if self.osdmap is not None and msg.epoch <= self.osdmap.epoch:
+            return
+        self.osdmap = OSDMap.from_dict(msg.osdmap)
+        self._codecs.clear()  # pools/profiles may have changed
+        self._map_event.set()
+
+    # -- codec / placement helpers --------------------------------------------
+
+    def _pool_codec(self, pool: Pool) -> tuple[Any, StripeInfo]:
+        cached = self._codecs.get(pool.id)
+        if cached is not None:
+            return cached
+        profile = self.osdmap.get_erasure_code_profile(pool.erasure_code_profile)
+        plugin = profile.get("plugin", "jerasure")
+        codec = registry.instance().factory(plugin, profile)
+        chunk = codec.get_chunk_size(pool.stripe_width)
+        sinfo = StripeInfo(
+            stripe_width=chunk * codec.get_data_chunk_count(), chunk_size=chunk
+        )
+        self._codecs[pool.id] = (codec, sinfo)
+        return codec, sinfo
+
+    def _acting(self, pg: PGid, pool: Pool) -> tuple[list[int], int]:
+        _up, _upp, acting, primary = self.osdmap.pg_to_up_acting_osds(pg)
+        return acting, primary
+
+    def _new_tid(self) -> int:
+        self._tid += 1
+        return self._tid
+
+    # -- client op engine (reference:PrimaryLogPG::do_osd_ops) ----------------
+
+    async def _handle_client_op(self, conn: Connection, msg: messages.MOSDOp) -> None:
+        try:
+            result, out, blobs = await self._execute_op(msg)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            logger.exception("%s: op tid=%s failed", self.name, msg.tid)
+            result, out, blobs = -EIO, [{"error": str(e)}], []
+        conn.send(
+            messages.MOSDOpReply(
+                tid=msg.tid, result=result, epoch=self._epoch(), out=out,
+                blobs=blobs,
+            )
+        )
+
+    async def _execute_op(
+        self, msg: messages.MOSDOp
+    ) -> tuple[int, list, list[bytes]]:
+        if self.osdmap is None:
+            return -EAGAIN, [{"error": "no map"}], []
+        pool = self.osdmap.pools.get(msg.pool)
+        if pool is None:
+            return -ENOENT, [{"error": f"no pool {msg.pool}"}], []
+        pg = self.osdmap.object_locator_to_pg(msg.oid, msg.pool)
+        acting, primary = self._acting(pg, pool)
+        if primary != self.osd_id:
+            # client raced a map change; it must re-target
+            return -EAGAIN, [{"error": "not primary", "primary": primary}], []
+        if pool.type == POOL_TYPE_ERASURE:
+            return await self._ec_execute(pg, pool, acting, msg)
+        return await self._rep_execute(pg, pool, acting, msg)
+
+    # ======================= EC backend =====================================
+
+    def _shard_cid(self, pg: PGid, shard: int) -> CollectionId:
+        return CollectionId(f"{pg}s{shard}")
+
+    def _next_version(self, pg: PGid) -> Eversion:
+        prev = self._pg_versions.get(str(pg), Eversion())
+        v = Eversion(self._epoch(), prev.version + 1)
+        self._pg_versions[str(pg)] = v
+        return v
+
+    async def _ec_execute(
+        self, pg: PGid, pool: Pool, acting: list[int], msg: messages.MOSDOp
+    ) -> tuple[int, list, list[bytes]]:
+        out: list = []
+        blobs: list[bytes] = []
+        for op in msg.ops:
+            name = op["op"]
+            if name == "writefull":
+                data = msg.blobs[op["data"]]
+                r = await self._ec_write_full(pg, pool, acting, msg.oid, data)
+                out.append({"rval": r})
+                if r < 0:
+                    return r, out, blobs
+            elif name == "delete":
+                r = await self._ec_delete(pg, pool, acting, msg.oid)
+                out.append({"rval": r})
+                if r < 0:
+                    return r, out, blobs
+            elif name == "read":
+                r, data = await self._ec_read(pg, pool, acting, msg.oid)
+                if r < 0:
+                    out.append({"rval": r})
+                    return r, out, blobs
+                off = op.get("offset", 0)
+                ln = op.get("length", 0)
+                data = data[off : off + ln] if ln else data[off:]
+                out.append({"rval": 0, "data": len(blobs)})
+                blobs.append(data)
+            elif name == "stat":
+                r, size = await self._ec_stat(pg, pool, acting, msg.oid)
+                out.append({"rval": r, "size": size})
+                if r < 0:
+                    return r, out, blobs
+            else:
+                out.append({"rval": -EINVAL, "error": f"bad op {name!r}"})
+                return -EINVAL, out, blobs
+        return 0, out, blobs
+
+    async def _ec_write_full(
+        self, pg: PGid, pool: Pool, acting: list[int], oid: str, data: bytes
+    ) -> int:
+        codec, sinfo = self._pool_codec(pool)
+        k, km = codec.get_data_chunk_count(), codec.get_chunk_count()
+        present = [
+            (s, o) for s, o in enumerate(acting[:km]) if o != CRUSH_ITEM_NONE
+        ]
+        if len(present) < pool.min_size:
+            return -EAGAIN  # degraded below min_size: cannot accept writes
+        padded = sinfo.pad_to_stripe(data) if data else b"\x00" * sinfo.stripe_width
+        shards = ec_util.encode(sinfo, codec, padded)
+        hinfo = HashInfo(km)
+        hinfo.append(0, shards)
+        hinfo_b = json.dumps(hinfo.to_dict()).encode()
+        version = self._next_version(pg)
+        # version in the object info lets readers reject stale shards a
+        # degraded write skipped (reference object_info_t user_version)
+        oi_b = json.dumps(
+            {"size": len(data), "version": version.to_list()}
+        ).encode()
+        entry = PGLogEntry("modify", oid, version, Eversion())
+
+        tid = self._new_tid()
+        waiter = _Waiter({s for s, _ in present})
+        self._write_waiters[tid] = waiter
+        try:
+            for shard, osd in present:
+                cid = self._shard_cid(pg, shard)
+                soid = ObjectId(oid, shard)
+                chunk = shards[shard].tobytes()
+                txn = (
+                    Transaction()
+                    .create_collection(cid)
+                    .remove(cid, soid)
+                    .write(cid, soid, 0, chunk)
+                    .setattr(cid, soid, HashInfo.XATTR_KEY, hinfo_b)
+                    .setattr(cid, soid, OI_KEY, oi_b)
+                )
+                await self._send_sub_write(tid, pg, shard, osd, txn, entry)
+            async with asyncio.timeout(SUBOP_TIMEOUT):
+                await waiter.event.wait()
+        except TimeoutError:
+            logger.warning("%s: ec write tid=%d timed out on %s",
+                           self.name, tid, waiter.pending)
+            return -EIO
+        finally:
+            del self._write_waiters[tid]
+        if any(r != 0 for r in waiter.results.values()):
+            return -EIO
+        return 0
+
+    async def _ec_delete(
+        self, pg: PGid, pool: Pool, acting: list[int], oid: str
+    ) -> int:
+        codec, _ = self._pool_codec(pool)
+        km = codec.get_chunk_count()
+        present = [
+            (s, o) for s, o in enumerate(acting[:km]) if o != CRUSH_ITEM_NONE
+        ]
+        if not present:
+            return -EAGAIN
+        version = self._next_version(pg)
+        entry = PGLogEntry("delete", oid, version, Eversion())
+        tid = self._new_tid()
+        waiter = _Waiter({s for s, _ in present})
+        self._write_waiters[tid] = waiter
+        try:
+            for shard, osd in present:
+                cid = self._shard_cid(pg, shard)
+                txn = (
+                    Transaction()
+                    .create_collection(cid)
+                    .remove(cid, ObjectId(oid, shard))
+                )
+                await self._send_sub_write(tid, pg, shard, osd, txn, entry)
+            async with asyncio.timeout(SUBOP_TIMEOUT):
+                await waiter.event.wait()
+        except TimeoutError:
+            return -EIO
+        finally:
+            del self._write_waiters[tid]
+        if any(r != 0 for r in waiter.results.values()):
+            return -EIO
+        return 0
+
+    async def _send_sub_write(
+        self,
+        tid: int,
+        pg: PGid,
+        shard: int,
+        osd: int,
+        txn: Transaction,
+        entry: PGLogEntry,
+    ) -> None:
+        if osd == self.osd_id:
+            # self-delivery (reference:ECBackend.cc:878 handle_sub_write)
+            r = self._apply_sub_write(txn, str(pg), shard, [entry])
+            self._write_waiters[tid].complete(shard, r)
+            return
+        addr = self.osdmap.get_addr(osd)
+        ops, blobs = messages.encode_txn(txn)
+        conn = await self.messenger.connect(addr, f"osd.{osd}")
+        conn.send(
+            messages.MOSDECSubOpWrite(
+                pgid=str(pg), tid=tid, from_osd=self.osd_id, shard=shard,
+                txn=ops, log=[entry.to_dict()],
+                at_version=entry.version.to_list(), trim_to=[0, 0], blobs=blobs,
+            )
+        )
+
+    def _apply_sub_write(
+        self,
+        txn: Transaction,
+        pgid: str,
+        shard: int,
+        entries: list[PGLogEntry],
+    ) -> int:
+        """Append the log entries to the shard's pgmeta in the SAME
+        transaction as the data, then commit — the crash-consistency
+        contract (reference:ECBackend.cc:908-938 log_operation +
+        queue_transactions)."""
+        cid = CollectionId(f"{pgid}s{shard}" if shard >= 0 else pgid)
+        for entry in entries:
+            add_log_entry_to_txn(txn, cid, shard, entry)
+        try:
+            self.store.apply(txn)
+            return 0
+        except Exception:
+            logger.exception("%s: sub-write apply failed", self.name)
+            return -EIO
+
+    def _handle_sub_write(self, conn: Connection, msg: messages.MOSDECSubOpWrite) -> None:
+        txn = messages.decode_txn(msg.txn, msg.blobs)
+        entries = [PGLogEntry.from_dict(d) for d in msg.log]
+        r = self._apply_sub_write(txn, msg.pgid, msg.shard, entries)
+        conn.send(
+            messages.MOSDECSubOpWriteReply(
+                pgid=msg.pgid, tid=msg.tid, shard=msg.shard, result=r
+            )
+        )
+
+    # -- EC read path ---------------------------------------------------------
+
+    async def _ec_read(
+        self, pg: PGid, pool: Pool, acting: list[int], oid: str
+    ) -> tuple[int, bytes]:
+        codec, sinfo = self._pool_codec(pool)
+        k, km = codec.get_data_chunk_count(), codec.get_chunk_count()
+        want = list(range(k))
+        available = {
+            s: o for s, o in enumerate(acting[:km]) if o != CRUSH_ITEM_NONE
+        }
+        failed: set[int] = set()
+        for _attempt in range(km):  # each retry excludes newly-failed shards
+            usable = [s for s in available if s not in failed]
+            try:
+                to_read = codec.minimum_to_decode(want, usable)
+            except Exception:
+                return -EIO, b""
+            shard_data, shard_attrs, errs = await self._read_shards(
+                pg, oid, {s: available[s] for s in to_read}
+            )
+            failed |= set(errs)
+            # crc verification (reference:ECBackend.cc:994-1008) + version
+            # agreement: a rejoined shard that missed a degraded overwrite
+            # passes its own (stale) crc, so shards must also agree on the
+            # object version before their chunks may be mixed
+            chunks: dict[int, np.ndarray] = {}
+            ois: dict[int, dict] = {}
+            for s, data in shard_data.items():
+                attrs = shard_attrs.get(s, {})
+                hinfo_raw = attrs.get(HashInfo.XATTR_KEY)
+                if hinfo_raw is not None:
+                    hinfo = HashInfo.from_dict(json.loads(hinfo_raw))
+                    crc = native.crc32c(
+                        ec_util.CRC_SEED, np.frombuffer(data, dtype=np.uint8)
+                    )
+                    if crc != hinfo.get_chunk_hash(s):
+                        logger.warning(
+                            "%s: shard %d of %s failed crc", self.name, s, oid
+                        )
+                        failed.add(s)
+                        continue
+                oi_raw = attrs.get(OI_KEY)
+                if oi_raw is not None:
+                    ois[s] = json.loads(oi_raw)
+                chunks[s] = np.frombuffer(data, dtype=np.uint8)
+            newest = max(
+                (tuple(oi.get("version", [0, 0])) for oi in ois.values()),
+                default=(0, 0),
+            )
+            size: int | None = None
+            for s in list(chunks):
+                oi = ois.get(s)
+                ver = tuple(oi.get("version", [0, 0])) if oi else (0, 0)
+                if ver < newest:
+                    logger.warning(
+                        "%s: shard %d of %s is stale (%s < %s)",
+                        self.name, s, oid, ver, newest,
+                    )
+                    failed.add(s)
+                    del chunks[s]
+                elif oi is not None:
+                    size = oi["size"]
+            if errs and all(e == -ENOENT for e in errs.values()) and not chunks:
+                return -ENOENT, b""  # object absent on every shard asked
+            if set(to_read) <= set(chunks):
+                logical = ec_util.decode_concat(sinfo, codec, chunks)
+                return 0, logical[: size if size is not None else len(logical)]
+            # else: a shard failed mid-read — loop retries with survivors
+        return -EIO, b""
+
+    async def _ec_stat(
+        self, pg: PGid, pool: Pool, acting: list[int], oid: str
+    ) -> tuple[int, int]:
+        """Object logical size from any shard's object-info xattr."""
+        codec, _ = self._pool_codec(pool)
+        km = codec.get_chunk_count()
+        available = {
+            s: o for s, o in enumerate(acting[:km]) if o != CRUSH_ITEM_NONE
+        }
+        _data, attrs, errs = await self._read_shards(
+            pg, oid, available, want_data=False
+        )
+        ois = [
+            json.loads(a[OI_KEY]) for a in attrs.values() if OI_KEY in a
+        ]
+        if not ois:
+            if errs and all(e == -ENOENT for e in errs.values()):
+                return -ENOENT, 0
+            return -EIO, 0
+        newest = max(ois, key=lambda oi: tuple(oi.get("version", [0, 0])))
+        return 0, newest["size"]
+
+    async def _read_shards(
+        self,
+        pg: PGid,
+        oid: str,
+        targets: dict[int, int],
+        want_data: bool = True,
+    ) -> tuple[dict[int, bytes], dict[int, dict], dict[int, int]]:
+        """Fetch whole shard extents (+xattrs) from `targets` {shard: osd}."""
+        tid = self._new_tid()
+        waiter = _ReadWaiter(set(targets))
+        self._read_waiters[tid] = waiter
+        try:
+            for shard, osd in targets.items():
+                if osd == self.osd_id:
+                    data, attrs, err = self._local_shard_read(
+                        pg, shard, oid, want_data
+                    )
+                    waiter.complete(shard, data, attrs, err)
+                    continue
+                addr = self.osdmap.get_addr(osd)
+                conn = await self.messenger.connect(addr, f"osd.{osd}")
+                conn.send(
+                    messages.MOSDECSubOpRead(
+                        pgid=str(pg), tid=tid, shard=shard,
+                        reads=[{"oid": [oid, shard], "offset": 0, "length": -1,
+                                "want_data": want_data}],
+                        attrs=True,
+                    )
+                )
+            try:
+                async with asyncio.timeout(SUBOP_TIMEOUT):
+                    await waiter.event.wait()
+            except TimeoutError:
+                for shard in list(waiter.pending):
+                    waiter.complete(shard, None, None, -EIO)
+            return waiter.data, waiter.attrs, waiter.errors
+        finally:
+            del self._read_waiters[tid]
+
+    def _local_shard_read(
+        self, pg: PGid, shard: int, oid: str, want_data: bool = True
+    ) -> tuple[bytes, dict, int]:
+        cid = self._shard_cid(pg, shard)
+        soid = ObjectId(oid, shard)
+        try:
+            data = self.store.read(cid, soid) if want_data else b""
+            attrs = {
+                k: v.decode() for k, v in self.store.getattrs(cid, soid).items()
+            }
+            return data, attrs, 0
+        except KeyError:
+            return b"", {}, -ENOENT
+        except Exception:
+            logger.exception("%s: shard read failed", self.name)
+            return b"", {}, -EIO
+
+    def _handle_sub_read(self, conn: Connection, msg: messages.MOSDECSubOpRead) -> None:
+        rd = msg.reads[0]
+        oid, shard = rd["oid"]
+        pg = PGid.parse(msg.pgid)
+        data, attrs, err = self._local_shard_read(
+            pg, shard, oid, rd.get("want_data", True)
+        )
+        conn.send(
+            messages.MOSDECSubOpReadReply(
+                pgid=msg.pgid, tid=msg.tid, shard=msg.shard,
+                reads=[{"data": 0}], attrs=attrs,
+                errors=[err] if err else [], blobs=[data],
+            )
+        )
+
+    # ======================= replicated backend ==============================
+
+    async def _rep_execute(
+        self, pg: PGid, pool: Pool, acting: list[int], msg: messages.MOSDOp
+    ) -> tuple[int, list, list[bytes]]:
+        cid = CollectionId(str(pg))
+        oid = ObjectId(msg.oid)
+        out: list = []
+        blobs: list[bytes] = []
+        txn = Transaction().create_collection(cid)
+        mutates = False
+        for op in msg.ops:
+            name = op["op"]
+            if name == "writefull":
+                data = msg.blobs[op["data"]]
+                txn.remove(cid, oid).write(cid, oid, 0, data)
+                txn.setattr(cid, oid, OI_KEY,
+                            json.dumps({"size": len(data)}).encode())
+                mutates = True
+                out.append({"rval": 0})
+            elif name == "write":
+                data = msg.blobs[op["data"]]
+                txn.write(cid, oid, op.get("offset", 0), data)
+                mutates = True
+                out.append({"rval": 0})
+            elif name == "delete":
+                txn.remove(cid, oid)
+                mutates = True
+                out.append({"rval": 0})
+            elif name == "read":
+                try:
+                    ln = op.get("length", -1) or -1
+                    data = self.store.read(cid, oid, op.get("offset", 0), ln)
+                except KeyError:
+                    out.append({"rval": -ENOENT})
+                    return -ENOENT, out, blobs
+                out.append({"rval": 0, "data": len(blobs)})
+                blobs.append(data)
+            elif name == "stat":
+                try:
+                    size = self.store.stat(cid, oid)
+                except KeyError:
+                    out.append({"rval": -ENOENT, "size": 0})
+                    return -ENOENT, out, blobs
+                out.append({"rval": 0, "size": size})
+            else:
+                out.append({"rval": -EINVAL})
+                return -EINVAL, out, blobs
+        if mutates:
+            r = await self._rep_commit(pg, acting, txn, msg.oid)
+            if r < 0:
+                return r, out, blobs
+        return 0, out, blobs
+
+    async def _rep_commit(
+        self, pg: PGid, acting: list[int], txn: Transaction, oid: str
+    ) -> int:
+        version = self._next_version(pg)
+        entry = PGLogEntry("modify", oid, version, Eversion())
+        replicas = [o for o in acting if o != CRUSH_ITEM_NONE]
+        tid = self._new_tid()
+        waiter = _Waiter(set(replicas))
+        self._write_waiters[tid] = waiter
+        ops, blobs = messages.encode_txn(txn)
+        try:
+            for osd in replicas:
+                if osd == self.osd_id:
+                    waiter.complete(
+                        osd, self._apply_sub_write(txn, str(pg), -1, [entry])
+                    )
+                    continue
+                conn = await self.messenger.connect(
+                    self.osdmap.get_addr(osd), f"osd.{osd}"
+                )
+                conn.send(
+                    messages.MOSDRepOp(
+                        pgid=str(pg), tid=tid, from_osd=self.osd_id,
+                        txn=ops, log=[entry.to_dict()],
+                        at_version=entry.version.to_list(), blobs=blobs,
+                    )
+                )
+            async with asyncio.timeout(SUBOP_TIMEOUT):
+                await waiter.event.wait()
+        except TimeoutError:
+            return -EIO
+        finally:
+            del self._write_waiters[tid]
+        if any(r != 0 for r in waiter.results.values()):
+            return -EIO
+        return 0
+
+    def _handle_rep_op(self, conn: Connection, msg: messages.MOSDRepOp) -> None:
+        txn = messages.decode_txn(msg.txn, msg.blobs)
+        entries = [PGLogEntry.from_dict(d) for d in msg.log]
+        r = self._apply_sub_write(txn, msg.pgid, -1, entries)
+        conn.send(
+            messages.MOSDRepOpReply(
+                pgid=msg.pgid, tid=msg.tid, from_osd=self.osd_id, result=r
+            )
+        )
+
+    # ======================= heartbeats ======================================
+
+    async def _heartbeat_loop(self) -> None:
+        """reference:src/osd/OSD.cc:4104-4245 heartbeat + failure_queue."""
+        try:
+            while not self._stopping:
+                await asyncio.sleep(self.heartbeat_interval)
+                if self.osdmap is None:
+                    continue
+                now = time.monotonic()
+                for osd in range(self.osdmap.max_osd):
+                    if osd == self.osd_id or not self.osdmap.is_up(osd):
+                        continue
+                    addr = self.osdmap.get_addr(osd)
+                    if not addr:
+                        continue
+                    last = self._hb_last.setdefault(osd, now)
+                    if now - last > self.heartbeat_grace:
+                        logger.info(
+                            "%s: peer osd.%d silent for %.1fs -> reporting",
+                            self.name, osd, now - last,
+                        )
+                        mon = await self.messenger.connect(self.mon_addr, "mon.0")
+                        mon.send(
+                            messages.MOSDFailure(
+                                target_osd=osd, reporter=self.osd_id,
+                                epoch=self._epoch(),
+                            )
+                        )
+                        self._hb_last[osd] = now  # back off further reports
+                        continue
+                    try:
+                        conn = await self.messenger.connect(addr, f"osd.{osd}")
+                        conn.send(
+                            messages.MPing(stamp=now, epoch=self._epoch())
+                        )
+                    except OSError:
+                        self._hb_last.setdefault(osd, now - 2 * self.heartbeat_grace)
+        except asyncio.CancelledError:
+            pass

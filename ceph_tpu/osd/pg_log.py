@@ -1,0 +1,96 @@
+"""PG log: per-PG ordered mutation record.
+
+Re-expression of the reference pg log (reference:src/osd/PGLog.{h,cc},
+``pg_log_entry_t`` in reference:src/osd/osd_types.h): every mutation the
+primary applies gets a monotonically increasing ``eversion_t``
+(map-epoch, version) and is recorded on every shard in the same
+ObjectStore transaction as the data (reference:src/osd/ECBackend.cc:908-938)
+— this is what makes divergence detectable and resumable after restarts
+(design: reference:doc/dev/osd_internals/erasure_coding/ecbackend.rst:9-27).
+
+The log lives in the omap of the per-shard ``_pgmeta_`` object, keyed so
+lexicographic omap order == version order.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+from ..store import CollectionId, ObjectId, Transaction
+
+PGMETA_NAME = "_pgmeta_"
+
+
+def meta_oid(shard: int) -> ObjectId:
+    return ObjectId(PGMETA_NAME, shard)
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Eversion:
+    """(map epoch, version) — reference eversion_t."""
+
+    epoch: int = 0
+    version: int = 0
+
+    def key(self) -> str:
+        return f"{self.epoch:010d}.{self.version:012d}"
+
+    def to_list(self) -> list[int]:
+        return [self.epoch, self.version]
+
+    @classmethod
+    def from_list(cls, v) -> "Eversion":
+        return cls(int(v[0]), int(v[1]))
+
+
+@dataclasses.dataclass
+class PGLogEntry:
+    """reference pg_log_entry_t essentials: op, object, version chain."""
+
+    op: str  # "modify" | "delete"
+    oid: str
+    version: Eversion
+    prior_version: Eversion
+
+    def to_dict(self) -> dict:
+        return {
+            "op": self.op,
+            "oid": self.oid,
+            "version": self.version.to_list(),
+            "prior_version": self.prior_version.to_list(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PGLogEntry":
+        return cls(
+            op=d["op"],
+            oid=d["oid"],
+            version=Eversion.from_list(d["version"]),
+            prior_version=Eversion.from_list(d["prior_version"]),
+        )
+
+
+def add_log_entry_to_txn(
+    txn: Transaction, cid: CollectionId, shard: int, entry: PGLogEntry
+) -> None:
+    """Record the entry in the shard's pgmeta omap inside ``txn`` — same
+    transaction as the data writes, the crash-consistency contract."""
+    txn.omap_setkeys(
+        cid,
+        meta_oid(shard),
+        {entry.version.key(): json.dumps(entry.to_dict()).encode()},
+    )
+
+
+def read_log(store, cid: CollectionId, shard: int) -> list[PGLogEntry]:
+    """Load the shard's log in version order (mount/peering path)."""
+    try:
+        omap = store.omap_get(cid, meta_oid(shard))
+    except KeyError:
+        return []
+    return [
+        PGLogEntry.from_dict(json.loads(v))
+        for k, v in sorted(omap.items())
+        if "." in k
+    ]

@@ -1,0 +1,12 @@
+"""Client object API + mini-cluster harness.
+
+The librados subset (reference:src/librados/ RadosClient/IoCtxImpl +
+reference:src/osdc/Objecter.cc op targeting/resend) and a vstart-style
+in-process cluster (reference:src/vstart.sh,
+reference:src/test/erasure-code/test-erasure-code.sh run_mon/run_osd).
+"""
+
+from .client import IoCtx, RadosClient, RadosError
+from .cluster import MiniCluster
+
+__all__ = ["RadosClient", "IoCtx", "RadosError", "MiniCluster"]

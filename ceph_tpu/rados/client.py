@@ -1,0 +1,233 @@
+"""RadosClient / IoCtx: the client object API.
+
+Re-expression of the reference client stack: ``RadosClient`` bootstraps
+mon connection + map subscription (reference:src/librados/RadosClient.cc
+connect), ``IoCtx`` scopes ops to a pool (reference:src/librados/
+IoCtxImpl.cc), and ``operate`` plays the Objecter: compute the target
+from the current OSDMap (object -> pg -> acting primary,
+reference:src/osdc/Objecter.cc _calc_target), send the MOSDOp, and
+re-target + resend when the map changes, the primary rejects us, or the
+connection resets (reference:src/osdc/Objecter.cc op_submit :2192,
+resend on handle_osd_map).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+from typing import Any
+
+from ..msg import AsyncMessenger, Connection, Dispatcher, messages
+from ..msg.message import Message
+from ..osd.osdmap import OSDMap
+
+logger = logging.getLogger("ceph_tpu.rados")
+
+_client_counter = itertools.count(1)
+
+ENOENT = 2
+EAGAIN = 11
+
+
+class RadosError(OSError):
+    def __init__(self, code: int, msg: str = ""):
+        super().__init__(abs(code), msg or f"rados error {code}")
+        self.code = code
+
+
+class RadosClient(Dispatcher):
+    """Cluster handle: mon session + map + op submission."""
+
+    def __init__(self, mon_addr: str, name: str | None = None,
+                 op_timeout: float = 10.0, max_retries: int = 8):
+        self.name = name or f"client.{next(_client_counter)}"
+        self.mon_addr = mon_addr
+        self.messenger = AsyncMessenger(self.name, self)
+        self.osdmap: OSDMap | None = None
+        self.op_timeout = op_timeout
+        self.max_retries = max_retries
+        self._tid = itertools.count(1)
+        self._op_futs: dict[int, asyncio.Future] = {}
+        self._fut_conns: dict[int, Connection] = {}
+        self._map_waiters: list[asyncio.Future] = []
+
+    # -- lifecycle
+    async def connect(self) -> "RadosClient":
+        mon = await self.messenger.connect(self.mon_addr, "mon.0")
+        mon.send(messages.MMonGetMap(have=0))
+        async with asyncio.timeout(10):
+            while self.osdmap is None:
+                await self._wait_for_map_change(-1, 10.0)
+        return self
+
+    async def shutdown(self) -> None:
+        await self.messenger.shutdown()
+
+    # -- dispatch
+    async def ms_dispatch(self, conn: Connection, msg: Message) -> None:
+        if isinstance(msg, messages.MOSDMapMsg):
+            if self.osdmap is None or msg.epoch > self.osdmap.epoch:
+                self.osdmap = OSDMap.from_dict(msg.osdmap)
+                for fut in self._map_waiters:
+                    if not fut.done():
+                        fut.set_result(None)
+                self._map_waiters.clear()
+        elif isinstance(msg, (messages.MOSDOpReply, messages.MMonCommandReply)):
+            fut = self._op_futs.pop(msg.tid, None)
+            self._fut_conns.pop(msg.tid, None)
+            if fut is not None and not fut.done():
+                fut.set_result(msg)
+
+    def ms_handle_reset(self, conn: Connection) -> None:
+        # fail in-flight ops on this conn fast so operate() can re-target
+        for tid, c in list(self._fut_conns.items()):
+            if c is conn:
+                fut = self._op_futs.pop(tid, None)
+                del self._fut_conns[tid]
+                if fut is not None and not fut.done():
+                    fut.set_exception(ConnectionResetError(f"{conn} reset"))
+
+    async def _wait_for_map_change(self, have_epoch: int, timeout: float) -> None:
+        if self.osdmap is not None and self.osdmap.epoch > have_epoch:
+            return
+        fut = asyncio.get_running_loop().create_future()
+        self._map_waiters.append(fut)
+        try:
+            async with asyncio.timeout(timeout):
+                await fut
+        except TimeoutError:
+            pass
+
+    # -- mon commands
+    async def command(self, cmd: dict) -> tuple[int, str, Any]:
+        tid = next(self._tid)
+        fut = asyncio.get_running_loop().create_future()
+        self._op_futs[tid] = fut
+        conn = await self.messenger.connect(self.mon_addr, "mon.0")
+        self._fut_conns[tid] = conn
+        conn.send(messages.MMonCommand(tid=tid, cmd=cmd))
+        async with asyncio.timeout(self.op_timeout):
+            reply = await fut
+        return reply.code, reply.status, reply.out
+
+    # -- pools
+    async def create_pool(self, name: str, pool_type: str = "replicated",
+                          **kw) -> int:
+        code, status, out = await self.command(
+            {"prefix": "osd pool create", "pool": name,
+             "pool_type": pool_type, **kw}
+        )
+        if code < 0:
+            raise RadosError(code, status)
+        await self.wait_for_pool(name)
+        return out["pool_id"]
+
+    async def wait_for_pool(self, name: str, timeout: float = 10.0) -> None:
+        async with asyncio.timeout(timeout):
+            while self.osdmap is None or self.osdmap.lookup_pool(name) is None:
+                have = self.osdmap.epoch if self.osdmap else -1
+                await self._wait_for_map_change(have, timeout)
+
+    def io_ctx(self, pool_name: str) -> "IoCtx":
+        pool = self.osdmap.lookup_pool(pool_name) if self.osdmap else None
+        if pool is None:
+            raise RadosError(-ENOENT, f"no pool {pool_name!r}")
+        return IoCtx(self, pool_name)
+
+    # -- op submission (Objecter)
+    async def operate(
+        self, pool_name: str, oid: str, ops: list[dict], blobs: list[bytes]
+    ) -> messages.MOSDOpReply:
+        last_err: Exception | None = None
+        for attempt in range(self.max_retries):
+            epoch = self.osdmap.epoch
+            pool = self.osdmap.lookup_pool(pool_name)
+            if pool is None:
+                raise RadosError(-ENOENT, f"no pool {pool_name!r}")
+            pg = self.osdmap.object_locator_to_pg(oid, pool.id)
+            _up, _upp, _acting, primary = self.osdmap.pg_to_up_acting_osds(pg)
+            addr = self.osdmap.get_addr(primary) if primary >= 0 else None
+            if primary < 0 or not addr:
+                await self._wait_for_map_change(epoch, self.op_timeout)
+                continue
+            tid = next(self._tid)
+            fut = asyncio.get_running_loop().create_future()
+            self._op_futs[tid] = fut
+            try:
+                conn = await self.messenger.connect(addr, f"osd.{primary}")
+                self._fut_conns[tid] = conn
+                conn.send(
+                    messages.MOSDOp(
+                        tid=tid, epoch=epoch, pool=pool.id, oid=oid,
+                        ops=ops, blobs=blobs,
+                    )
+                )
+                async with asyncio.timeout(self.op_timeout):
+                    reply = await fut
+            except (ConnectionError, OSError, TimeoutError) as e:
+                self._op_futs.pop(tid, None)
+                self._fut_conns.pop(tid, None)
+                last_err = e
+                logger.info(
+                    "%s: op %s/%s to osd.%d failed (%s); re-targeting",
+                    self.name, pool_name, oid, primary, type(e).__name__,
+                )
+                await self._wait_for_map_change(epoch, 2.0)
+                continue
+            if reply.result == -EAGAIN:
+                # wrong primary (map race) — wait for a newer map and retry
+                await self._wait_for_map_change(epoch, self.op_timeout)
+                continue
+            return reply
+        raise RadosError(-EAGAIN, f"op to {pool_name}/{oid} exhausted retries"
+                         ) from last_err
+
+
+class IoCtx:
+    """Pool-scoped object operations (reference:src/librados/IoCtxImpl.cc)."""
+
+    def __init__(self, client: RadosClient, pool_name: str):
+        self.client = client
+        self.pool_name = pool_name
+
+    async def write_full(self, oid: str, data: bytes) -> None:
+        reply = await self.client.operate(
+            self.pool_name, oid,
+            [{"op": "writefull", "data": 0}], [bytes(data)],
+        )
+        if reply.result < 0:
+            raise RadosError(reply.result, f"write_full {oid}")
+
+    async def write(self, oid: str, data: bytes, offset: int = 0) -> None:
+        reply = await self.client.operate(
+            self.pool_name, oid,
+            [{"op": "write", "offset": offset, "data": 0}], [bytes(data)],
+        )
+        if reply.result < 0:
+            raise RadosError(reply.result, f"write {oid}")
+
+    async def read(self, oid: str, offset: int = 0, length: int = 0) -> bytes:
+        reply = await self.client.operate(
+            self.pool_name, oid,
+            [{"op": "read", "offset": offset, "length": length}], [],
+        )
+        if reply.result < 0:
+            raise RadosError(reply.result, f"read {oid}")
+        return reply.blobs[reply.out[0]["data"]]
+
+    async def remove(self, oid: str) -> None:
+        reply = await self.client.operate(
+            self.pool_name, oid, [{"op": "delete"}], []
+        )
+        if reply.result < 0:
+            raise RadosError(reply.result, f"remove {oid}")
+
+    async def stat(self, oid: str) -> int:
+        """Returns object size."""
+        reply = await self.client.operate(
+            self.pool_name, oid, [{"op": "stat"}], []
+        )
+        if reply.result < 0:
+            raise RadosError(reply.result, f"stat {oid}")
+        return reply.out[0]["size"]

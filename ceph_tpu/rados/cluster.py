@@ -1,0 +1,91 @@
+"""MiniCluster: in-process mon + N OSDs on loopback.
+
+The vstart / ceph-helpers analog (reference:src/vstart.sh,
+reference:qa/workunits/ceph-helpers.sh run_mon/run_osd): every daemon is
+an asyncio entity in this process, network is real loopback TCP, stores
+are per-OSD MemStores that survive daemon restarts (kill_osd keeps the
+store so restart_osd replays the reference's restart-and-rejoin flow).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..mon import Monitor
+from ..osd.daemon import OSD
+from ..store import MemStore, ObjectStore
+from .client import RadosClient
+
+
+class MiniCluster:
+    def __init__(
+        self,
+        n_osds: int = 3,
+        heartbeat_interval: float = 0.0,
+        failure_min_reporters: int = 1,
+    ):
+        self.n_osds = n_osds
+        self.heartbeat_interval = heartbeat_interval
+        self.mon = Monitor(
+            max_osds=n_osds, failure_min_reporters=failure_min_reporters
+        )
+        self.stores: list[ObjectStore] = [MemStore() for _ in range(n_osds)]
+        self.osds: dict[int, OSD] = {}
+        self._clients: list[RadosClient] = []
+
+    async def start(self) -> "MiniCluster":
+        await self.mon.start()
+        for i in range(self.n_osds):
+            await self.start_osd(i)
+        return self
+
+    async def start_osd(self, osd_id: int) -> OSD:
+        if osd_id in self.osds:
+            raise RuntimeError(f"osd.{osd_id} already running")
+        store = self.stores[osd_id]
+        osd = OSD(
+            osd_id, self.mon.addr, store=store,
+            heartbeat_interval=self.heartbeat_interval,
+        )
+        await osd.start()
+        self.osds[osd_id] = osd
+        return osd
+
+    async def kill_osd(self, osd_id: int) -> None:
+        """Hard-stop a daemon (store survives for restart_osd)."""
+        osd = self.osds.pop(osd_id)
+        await osd.stop()
+
+    async def restart_osd(self, osd_id: int) -> OSD:
+        if osd_id in self.osds:
+            await self.kill_osd(osd_id)
+        return await self.start_osd(osd_id)
+
+    async def wait_for_osd_down(self, osd_id: int, timeout: float = 10.0) -> None:
+        async with asyncio.timeout(timeout):
+            while self.mon.osdmap.is_up(osd_id):
+                await asyncio.sleep(0.005)
+
+    async def wait_for_osd_up(self, osd_id: int, timeout: float = 10.0) -> None:
+        async with asyncio.timeout(timeout):
+            while not self.mon.osdmap.is_up(osd_id):
+                await asyncio.sleep(0.005)
+
+    async def client(self, **kw) -> RadosClient:
+        cl = await RadosClient(self.mon.addr, **kw).connect()
+        self._clients.append(cl)
+        return cl
+
+    async def stop(self) -> None:
+        for cl in self._clients:
+            await cl.shutdown()
+        self._clients.clear()
+        for osd_id in list(self.osds):
+            await self.kill_osd(osd_id)
+        await self.mon.stop()
+
+    async def __aenter__(self) -> "MiniCluster":
+        return await self.start()
+
+    async def __aexit__(self, *exc) -> None:
+        await self.stop()
