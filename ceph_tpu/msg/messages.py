@@ -256,6 +256,28 @@ class MOSDRepOpReply(Message):
 
 
 @register
+class MOSDPGScan(Message):
+    """Primary -> shard: report your objects + log for this PG shard
+    (reference:src/messages/MOSDPGScan.h + the GetInfo/GetLog peering
+    exchanges, reference:src/osd/PG.h:1654 RecoveryMachine).
+
+    ``shard`` is the reply routing key; ``store_shard`` names the shard
+    collection to scan (-1 = replicated whole-PG collection)."""
+
+    TYPE = "pg_scan"
+    FIELDS = ("pgid", "tid", "shard", "store_shard", "from_osd")
+
+
+@register
+class MOSDPGScanReply(Message):
+    """``objects`` = {name: {"version": [e,v], "size": n}};
+    ``log`` = json-able pg_log entries in version order."""
+
+    TYPE = "pg_scan_reply"
+    FIELDS = ("pgid", "tid", "shard", "objects", "log")
+
+
+@register
 class MOSDPGPush(Message):
     """Recovery push of a rebuilt shard/object (reference:src/messages/
     MOSDPGPush.h); ``pushes`` = [{"oid": [n,s], "data": blobidx, "attrs":

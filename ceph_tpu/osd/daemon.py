@@ -61,49 +61,75 @@ OI_KEY = "_"  # object-info xattr (reference OI_ATTR)
 SUBOP_TIMEOUT = 30.0
 
 
-class _Waiter:
-    """Gathers sub-op replies for one in-flight primary op."""
+class WaiterBase:
+    """Gather-N-replies primitive shared by write/read/scan waiters.
 
-    def __init__(self, pending: set[int]):
+    ``members`` maps each pending key to the osd serving it, so a
+    connection reset can fail exactly the keys that peer owed us
+    (``fail_member``); subclasses define what a failure completion is.
+    """
+
+    def __init__(self, pending: set[int], members: dict[int, int] | None = None):
         self.pending = set(pending)
-        self.results: dict[int, int] = {}
+        self.members = dict(members or {})
         self.event = asyncio.Event()
         if not self.pending:
             self.event.set()
 
+    def _finish(self, key: int) -> bool:
+        if key not in self.pending:
+            return False
+        self.pending.discard(key)
+        if not self.pending:
+            self.event.set()
+        return True
+
+    def fail_key(self, key: int) -> None:
+        raise NotImplementedError
+
+    def fail_member(self, osd_id: int) -> None:
+        for key in list(self.pending):
+            if self.members.get(key) == osd_id:
+                self.fail_key(key)
+
+
+class _Waiter(WaiterBase):
+    """Sub-write ack gatherer."""
+
+    def __init__(self, pending, members=None):
+        super().__init__(pending, members)
+        self.results: dict[int, int] = {}
+
     def complete(self, shard: int, result: int) -> None:
-        if shard in self.pending:
-            self.pending.discard(shard)
+        if self._finish(shard):
             self.results[shard] = result
-            if not self.pending:
-                self.event.set()
+
+    def fail_key(self, key: int) -> None:
+        self.complete(key, -EIO)
 
 
-class _ReadWaiter:
-    """Gathers MOSDECSubOpReadReply chunks."""
+class _ReadWaiter(WaiterBase):
+    """MOSDECSubOpReadReply chunk gatherer."""
 
-    def __init__(self, pending: set[int]):
-        self.pending = set(pending)
+    def __init__(self, pending, members=None):
+        super().__init__(pending, members)
         self.data: dict[int, bytes] = {}
         self.attrs: dict[int, dict] = {}
         self.errors: dict[int, int] = {}
-        self.event = asyncio.Event()
-        if not self.pending:
-            self.event.set()
 
     def complete(
         self, shard: int, data: bytes | None, attrs: dict | None, err: int
     ) -> None:
-        if shard not in self.pending:
+        if not self._finish(shard):
             return
-        self.pending.discard(shard)
         if err:
             self.errors[shard] = err
         else:
             self.data[shard] = data if data is not None else b""
             self.attrs[shard] = attrs or {}
-        if not self.pending:
-            self.event.set()
+
+    def fail_key(self, key: int) -> None:
+        self.complete(key, None, None, -EIO)
 
 
 class OSD(Dispatcher):
@@ -131,11 +157,15 @@ class OSD(Dispatcher):
         self._write_waiters: dict[int, _Waiter] = {}
         self._read_waiters: dict[int, _ReadWaiter] = {}
         self._pg_versions: dict[str, Eversion] = {}
+        self._pg_locks: dict[str, asyncio.Lock] = {}
         self._tasks: set[asyncio.Task] = set()
         self._hb_task: asyncio.Task | None = None
         self._hb_last: dict[int, float] = {}
         self._map_event = asyncio.Event()
         self._stopping = False
+        from .recovery import RecoveryManager
+
+        self.recovery = RecoveryManager(self)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -153,10 +183,13 @@ class OSD(Dispatcher):
             await self._map_event.wait()
         if self.heartbeat_interval > 0:
             self._hb_task = asyncio.ensure_future(self._heartbeat_loop())
+        self.recovery.start()
+        self.recovery.kick()  # reconcile whatever the map says we lead
         return self.addr
 
     async def stop(self) -> None:
         self._stopping = True
+        self.recovery.stop()
         if self._hb_task:
             self._hb_task.cancel()
         for t in list(self._tasks):
@@ -195,13 +228,26 @@ class OSD(Dispatcher):
             w = self._write_waiters.get(msg.tid)
             if w:
                 w.complete(msg.from_osd, msg.result)
+        elif isinstance(msg, messages.MOSDPGScan):
+            self.recovery.handle_scan(conn, msg)
+        elif isinstance(msg, messages.MOSDPGScanReply):
+            self.recovery.handle_scan_reply(msg)
         elif isinstance(msg, messages.MPing):
             conn.send(messages.MPingReply(stamp=msg.stamp, epoch=self._epoch()))
         elif isinstance(msg, messages.MPingReply):
             self._hb_last[self._peer_osd_id(conn)] = time.monotonic()
 
     def ms_handle_reset(self, conn: Connection) -> None:
-        pass  # failure detection is heartbeat + mon-side conn reset
+        # fail every in-flight sub-op this peer owed us so primary ops and
+        # recovery scans re-plan promptly instead of waiting out timeouts
+        peer = self._peer_osd_id(conn)
+        if peer < 0:
+            return
+        for w in list(self._write_waiters.values()):
+            w.fail_member(peer)
+        for w in list(self._read_waiters.values()):
+            w.fail_member(peer)
+        self.recovery.fail_member(peer)
 
     def _peer_osd_id(self, conn: Connection) -> int:
         name = conn.peer_name
@@ -221,6 +267,7 @@ class OSD(Dispatcher):
         self.osdmap = OSDMap.from_dict(msg.osdmap)
         self._codecs.clear()  # pools/profiles may have changed
         self._map_event.set()
+        self.recovery.kick()  # acting sets may have changed
 
     # -- codec / placement helpers --------------------------------------------
 
@@ -237,10 +284,6 @@ class OSD(Dispatcher):
         )
         self._codecs[pool.id] = (codec, sinfo)
         return codec, sinfo
-
-    def _acting(self, pg: PGid, pool: Pool) -> tuple[list[int], int]:
-        _up, _upp, acting, primary = self.osdmap.pg_to_up_acting_osds(pg)
-        return acting, primary
 
     def _new_tid(self) -> int:
         self._tid += 1
@@ -271,8 +314,10 @@ class OSD(Dispatcher):
         pool = self.osdmap.pools.get(msg.pool)
         if pool is None:
             return -ENOENT, [{"error": f"no pool {msg.pool}"}], []
-        pg = self.osdmap.object_locator_to_pg(msg.oid, msg.pool)
-        acting, primary = self._acting(pg, pool)
+        # the modded pg (raw seed folded onto pg_num) names collections and
+        # the version stream — reference:OSDMap raw_pg_to_pg; using the raw
+        # pg would give every object its own phantom PG
+        pg, acting, primary = self.osdmap.object_to_acting(msg.oid, msg.pool)
         if primary != self.osd_id:
             # client raced a map change; it must re-target
             return -EAGAIN, [{"error": "not primary", "primary": primary}], []
@@ -284,6 +329,16 @@ class OSD(Dispatcher):
 
     def _shard_cid(self, pg: PGid, shard: int) -> CollectionId:
         return CollectionId(f"{pg}s{shard}")
+
+    def pg_lock(self, pg: PGid) -> asyncio.Lock:
+        """Per-PG mutation lock: serializes client mutations and recovery
+        pushes on the primary (the role of the reference's PG lock,
+        reference:src/osd/PG.h lock())."""
+        key = str(pg)
+        lock = self._pg_locks.get(key)
+        if lock is None:
+            lock = self._pg_locks[key] = asyncio.Lock()
+        return lock
 
     def _next_version(self, pg: PGid) -> Eversion:
         prev = self._pg_versions.get(str(pg), Eversion())
@@ -332,6 +387,12 @@ class OSD(Dispatcher):
     async def _ec_write_full(
         self, pg: PGid, pool: Pool, acting: list[int], oid: str, data: bytes
     ) -> int:
+        async with self.pg_lock(pg):
+            return await self._ec_write_full_locked(pg, pool, acting, oid, data)
+
+    async def _ec_write_full_locked(
+        self, pg: PGid, pool: Pool, acting: list[int], oid: str, data: bytes
+    ) -> int:
         codec, sinfo = self._pool_codec(pool)
         k, km = codec.get_data_chunk_count(), codec.get_chunk_count()
         present = [
@@ -353,7 +414,7 @@ class OSD(Dispatcher):
         entry = PGLogEntry("modify", oid, version, Eversion())
 
         tid = self._new_tid()
-        waiter = _Waiter({s for s, _ in present})
+        waiter = _Waiter({s for s, _ in present}, dict(present))
         self._write_waiters[tid] = waiter
         try:
             for shard, osd in present:
@@ -384,6 +445,12 @@ class OSD(Dispatcher):
     async def _ec_delete(
         self, pg: PGid, pool: Pool, acting: list[int], oid: str
     ) -> int:
+        async with self.pg_lock(pg):
+            return await self._ec_delete_locked(pg, pool, acting, oid)
+
+    async def _ec_delete_locked(
+        self, pg: PGid, pool: Pool, acting: list[int], oid: str
+    ) -> int:
         codec, _ = self._pool_codec(pool)
         km = codec.get_chunk_count()
         present = [
@@ -394,7 +461,7 @@ class OSD(Dispatcher):
         version = self._next_version(pg)
         entry = PGLogEntry("delete", oid, version, Eversion())
         tid = self._new_tid()
-        waiter = _Waiter({s for s, _ in present})
+        waiter = _Waiter({s for s, _ in present}, dict(present))
         self._write_waiters[tid] = waiter
         try:
             for shard, osd in present:
@@ -431,7 +498,12 @@ class OSD(Dispatcher):
             return
         addr = self.osdmap.get_addr(osd)
         ops, blobs = messages.encode_txn(txn)
-        conn = await self.messenger.connect(addr, f"osd.{osd}")
+        try:
+            conn = await self.messenger.connect(addr, f"osd.{osd}")
+        except (ConnectionError, OSError):
+            # peer died before the map said so: fail this shard, not the op
+            self._write_waiters[tid].complete(shard, -EIO)
+            return
         conn.send(
             messages.MOSDECSubOpWrite(
                 pgid=str(pg), tid=tid, from_osd=self.osd_id, shard=shard,
@@ -570,24 +642,35 @@ class OSD(Dispatcher):
         oid: str,
         targets: dict[int, int],
         want_data: bool = True,
+        store_shard: int | None = None,
     ) -> tuple[dict[int, bytes], dict[int, dict], dict[int, int]]:
-        """Fetch whole shard extents (+xattrs) from `targets` {shard: osd}."""
+        """Fetch whole shard extents (+xattrs) from `targets` {key: osd}.
+
+        Keys are shard ids for EC; for replicated fan-out pass
+        ``store_shard=-1`` so every member reads the whole-PG collection
+        while replies still route by key.
+        """
         tid = self._new_tid()
-        waiter = _ReadWaiter(set(targets))
+        waiter = _ReadWaiter(set(targets), dict(targets))
         self._read_waiters[tid] = waiter
         try:
-            for shard, osd in targets.items():
+            for key, osd in targets.items():
+                shard = key if store_shard is None else store_shard
                 if osd == self.osd_id:
                     data, attrs, err = self._local_shard_read(
                         pg, shard, oid, want_data
                     )
-                    waiter.complete(shard, data, attrs, err)
+                    waiter.complete(key, data, attrs, err)
                     continue
                 addr = self.osdmap.get_addr(osd)
-                conn = await self.messenger.connect(addr, f"osd.{osd}")
+                try:
+                    conn = await self.messenger.connect(addr, f"osd.{osd}")
+                except (ConnectionError, OSError):
+                    waiter.complete(key, None, None, -EIO)
+                    continue
                 conn.send(
                     messages.MOSDECSubOpRead(
-                        pgid=str(pg), tid=tid, shard=shard,
+                        pgid=str(pg), tid=tid, shard=key,
                         reads=[{"oid": [oid, shard], "offset": 0, "length": -1,
                                 "want_data": want_data}],
                         attrs=True,
@@ -606,7 +689,8 @@ class OSD(Dispatcher):
     def _local_shard_read(
         self, pg: PGid, shard: int, oid: str, want_data: bool = True
     ) -> tuple[bytes, dict, int]:
-        cid = self._shard_cid(pg, shard)
+        # shard -1 = replicated whole-object read from the PG collection
+        cid = self._shard_cid(pg, shard) if shard >= 0 else CollectionId(str(pg))
         soid = ObjectId(oid, shard)
         try:
             data = self.store.read(cid, soid) if want_data else b""
@@ -646,23 +730,33 @@ class OSD(Dispatcher):
         blobs: list[bytes] = []
         txn = Transaction().create_collection(cid)
         mutates = False
+        log_op = "modify"
+        try:
+            projected_size = self.store.stat(cid, oid)
+        except KeyError:
+            projected_size = 0
         for op in msg.ops:
             name = op["op"]
             if name == "writefull":
                 data = msg.blobs[op["data"]]
                 txn.remove(cid, oid).write(cid, oid, 0, data)
-                txn.setattr(cid, oid, OI_KEY,
-                            json.dumps({"size": len(data)}).encode())
+                projected_size = len(data)
                 mutates = True
+                log_op = "modify"
                 out.append({"rval": 0})
             elif name == "write":
                 data = msg.blobs[op["data"]]
-                txn.write(cid, oid, op.get("offset", 0), data)
+                off = op.get("offset", 0)
+                txn.write(cid, oid, off, data)
+                projected_size = max(projected_size, off + len(data))
                 mutates = True
+                log_op = "modify"
                 out.append({"rval": 0})
             elif name == "delete":
                 txn.remove(cid, oid)
+                projected_size = 0
                 mutates = True
+                log_op = "delete"
                 out.append({"rval": 0})
             elif name == "read":
                 try:
@@ -684,19 +778,41 @@ class OSD(Dispatcher):
                 out.append({"rval": -EINVAL})
                 return -EINVAL, out, blobs
         if mutates:
-            r = await self._rep_commit(pg, acting, txn, msg.oid)
+            r = await self._rep_commit(
+                pg, acting, txn, msg.oid, log_op, projected_size
+            )
             if r < 0:
                 return r, out, blobs
         return 0, out, blobs
 
     async def _rep_commit(
-        self, pg: PGid, acting: list[int], txn: Transaction, oid: str
+        self, pg: PGid, acting: list[int], txn: Transaction, oid: str,
+        log_op: str = "modify", projected_size: int = 0,
+    ) -> int:
+        async with self.pg_lock(pg):
+            return await self._rep_commit_locked(
+                pg, acting, txn, oid, log_op, projected_size
+            )
+
+    async def _rep_commit_locked(
+        self, pg: PGid, acting: list[int], txn: Transaction, oid: str,
+        log_op: str, projected_size: int,
     ) -> int:
         version = self._next_version(pg)
-        entry = PGLogEntry("modify", oid, version, Eversion())
+        entry = PGLogEntry(log_op, oid, version, Eversion())
+        if log_op != "delete":
+            # keep the OI version current on every mutation so recovery's
+            # freshness checks can trust it (analog of object_info_t)
+            cid = CollectionId(str(pg))
+            txn.setattr(
+                cid, ObjectId(oid), OI_KEY,
+                json.dumps(
+                    {"size": projected_size, "version": version.to_list()}
+                ).encode(),
+            )
         replicas = [o for o in acting if o != CRUSH_ITEM_NONE]
         tid = self._new_tid()
-        waiter = _Waiter(set(replicas))
+        waiter = _Waiter(set(replicas), {o: o for o in replicas})
         self._write_waiters[tid] = waiter
         ops, blobs = messages.encode_txn(txn)
         try:
@@ -706,9 +822,13 @@ class OSD(Dispatcher):
                         osd, self._apply_sub_write(txn, str(pg), -1, [entry])
                     )
                     continue
-                conn = await self.messenger.connect(
-                    self.osdmap.get_addr(osd), f"osd.{osd}"
-                )
+                try:
+                    conn = await self.messenger.connect(
+                        self.osdmap.get_addr(osd), f"osd.{osd}"
+                    )
+                except (ConnectionError, OSError):
+                    waiter.complete(osd, -EIO)
+                    continue
                 conn.send(
                     messages.MOSDRepOp(
                         pgid=str(pg), tid=tid, from_osd=self.osd_id,

@@ -1,0 +1,504 @@
+"""Log-based recovery: peering-lite + shard backfill.
+
+Re-expression of the reference recovery flow (reference:src/osd/PG.h:1654
+RecoveryMachine Peering/GetInfo/GetLog/GetMissing/Active/Recovering and
+reference:src/osd/ECBackend.cc:520 continue_recovery_op) for the
+mini-cluster:
+
+1. On every map epoch change, the primary of each PG scans the acting
+   shards (MOSDPGScan): each reports its object set (name -> version/size)
+   and its pg log tail.
+2. Logs are merged into the authoritative per-object state — newest
+   version wins, a delete entry at the newest version wins over older
+   modifies (the authoritative-log selection of
+   reference:src/osd/PGLog.cc merge_log, collapsed to last-writer-wins
+   because the single primary serializes all writes).
+3. Divergence repair:
+   - a shard missing an object (or holding a stale version) gets the
+     object's chunk rebuilt — the primary reads+decodes the object from
+     the healthy shards (the §3.3 reconstruct path,
+     reference:src/osd/ECBackend.cc:376 handle_recovery_read_complete ->
+     ECUtil::decode), re-encodes (one batched device call), and pushes
+     the shard's chunk as a normal sub-write transaction
+     (reference: RecoveryOp WRITING state / MOSDPGPush);
+   - a shard holding an object the authoritative log says is deleted
+     gets a remove transaction (reference: divergent-entry rollback,
+     reference:doc/dev/osd_internals/erasure_coding/ecbackend.rst:9-27).
+
+Replicated PGs recover the same way with whole-object pushes
+(reference:src/osd/ReplicatedBackend.cc pull/push).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+
+from ..msg import messages
+from ..store import CollectionId, ObjectId, Transaction
+from .ec_util import HashInfo
+from . import ec_util
+from .osdmap import CRUSH_ITEM_NONE, PGid, Pool, POOL_TYPE_ERASURE
+from .pg_log import Eversion, PGLogEntry, read_log
+
+logger = logging.getLogger("ceph_tpu.osd.recovery")
+
+OI_KEY = "_"
+ENOENT = 2
+
+
+class RecoveryManager:
+    """Drives recovery for the PGs this OSD currently leads."""
+
+    def __init__(self, osd):
+        self.osd = osd
+        self._scan_waiters: dict[int, "_ScanWaiter"] = {}
+        self._task: asyncio.Task | None = None
+        self._wakeup = asyncio.Event()
+        self._retry_needed = False
+        self.recoveries_done = 0  # observable progress counter
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.ensure_future(self._loop())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+
+    def kick(self) -> None:
+        """Called on every new map epoch."""
+        self._wakeup.set()
+
+    def fail_member(self, osd_id: int) -> None:
+        """A peer's connection reset: release scans it owed us."""
+        for w in list(self._scan_waiters.values()):
+            w.fail_member(osd_id)
+        self._retry_needed = True
+
+    # -- scan plumbing --------------------------------------------------------
+
+    def handle_scan(self, conn, msg: messages.MOSDPGScan) -> None:
+        """Shard side: report objects + log for one PG shard."""
+        objects, log = self._local_scan(msg.pgid, msg.store_shard)
+        conn.send(
+            messages.MOSDPGScanReply(
+                pgid=msg.pgid, tid=msg.tid, shard=msg.shard,
+                objects=objects, log=log,
+            )
+        )
+
+    def handle_scan_reply(self, msg: messages.MOSDPGScanReply) -> None:
+        w = self._scan_waiters.get(msg.tid)
+        if w:
+            w.complete(msg.shard, msg.objects, msg.log)
+
+    def _local_scan(self, pgid: str, shard: int) -> tuple[dict, list]:
+        store = self.osd.store
+        cid = CollectionId(f"{pgid}s{shard}" if shard >= 0 else pgid)
+        objects: dict[str, dict] = {}
+        try:
+            oids = store.list_objects(cid)
+        except KeyError:
+            return {}, []
+        log_entries = read_log(store, cid, shard)
+        # last applied version per object comes from the shard's own log —
+        # replicated partial writes never rewrite the OI xattr, and EC
+        # recovery pushes carry the authoritative version in their entry
+        last_ver: dict[str, list[int]] = {}
+        for e in log_entries:
+            last_ver[e.oid] = e.version.to_list()
+        for oid in oids:
+            if oid.name == "_pgmeta_":
+                continue
+            try:
+                oi = json.loads(store.getattr(cid, oid, OI_KEY))
+            except KeyError:
+                oi = {}
+            version = max(
+                tuple(oi.get("version", [0, 0])),
+                tuple(last_ver.get(oid.name, (0, 0))),
+            )
+            objects[oid.name] = {
+                "version": list(version),
+                "size": oi.get("size", 0),
+            }
+        log = [e.to_dict() for e in log_entries]
+        return objects, log
+
+    # -- the recovery loop ----------------------------------------------------
+
+    async def _loop(self) -> None:
+        try:
+            while True:
+                await self._wakeup.wait()
+                self._wakeup.clear()
+                self._retry_needed = False
+                try:
+                    await self._recover_all()
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    logger.exception("%s: recovery pass failed", self.osd.name)
+                    self._retry_needed = True
+                if self._retry_needed and not self._wakeup.is_set():
+                    # partial pass (peer raced away): back off and retry
+                    await asyncio.sleep(0.5)
+                    self._wakeup.set()
+        except asyncio.CancelledError:
+            pass
+
+    async def _recover_all(self) -> None:
+        osd = self.osd
+        if osd.osdmap is None:
+            return
+        for pool in list(osd.osdmap.pools.values()):
+            for pg in osd.osdmap.pgs_of_pool(pool.id):
+                _up, _upp, acting, primary = osd.osdmap.pg_to_up_acting_osds(pg)
+                if primary != osd.osd_id:
+                    continue
+                try:
+                    await self._recover_pg(pg, pool, acting)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    logger.exception(
+                        "%s: recovery of pg %s failed", osd.name, pg
+                    )
+                    self._retry_needed = True
+
+    async def _recover_pg(self, pg: PGid, pool: Pool, acting: list[int]) -> None:
+        osd = self.osd
+        erasure = pool.type == POOL_TYPE_ERASURE
+        if erasure:
+            shards = {
+                s: o for s, o in enumerate(acting) if o != CRUSH_ITEM_NONE
+            }
+        else:
+            # replicated: every member plays the same role; key by osd id
+            shards = {o: o for o in acting if o != CRUSH_ITEM_NONE}
+        if not shards:
+            return
+
+        scans = await self._scan_shards(pg, shards, erasure)
+        if scans is None:
+            return
+        authoritative = self._merge(scans)
+
+        for oid, state in authoritative.items():
+            if state["op"] == "delete":
+                await self._propagate_delete(pg, pool, erasure, shards, scans,
+                                             oid, state)
+            else:
+                await self._repair_object(pg, pool, erasure, shards, scans,
+                                          oid, state, acting)
+
+    async def _scan_shards(
+        self, pg: PGid, shards: dict[int, int], erasure: bool
+    ) -> dict[int, tuple[dict, list]] | None:
+        """{shard_key: (objects, log)} from every member, local fast path."""
+        osd = self.osd
+        tid = osd._new_tid()
+        waiter = _ScanWaiter(set(shards), dict(shards))
+        self._scan_waiters[tid] = waiter
+        try:
+            for key, member in shards.items():
+                shard_field = key if erasure else -1
+                if member == osd.osd_id:
+                    objects, log = self._local_scan(str(pg), shard_field)
+                    waiter.complete(key, objects, log)
+                    continue
+                addr = osd.osdmap.get_addr(member)
+                if not addr:
+                    waiter.complete(key, {}, [])
+                    continue
+                try:
+                    conn = await osd.messenger.connect(addr, f"osd.{member}")
+                except (ConnectionError, OSError):
+                    # stale map: member already dead; a newer epoch re-kicks
+                    waiter.complete(key, {}, [])
+                    self._retry_needed = True
+                    continue
+                conn.send(
+                    messages.MOSDPGScan(
+                        pgid=str(pg), tid=tid, shard=key,
+                        store_shard=shard_field, from_osd=osd.osd_id,
+                    )
+                )
+            try:
+                async with asyncio.timeout(10.0):
+                    await waiter.event.wait()
+            except TimeoutError:
+                logger.warning("%s: scan of %s timed out", osd.name, pg)
+                self._retry_needed = True
+                return None
+            return waiter.results
+        finally:
+            del self._scan_waiters[tid]
+
+    @staticmethod
+    def _merge(scans: dict[int, tuple[dict, list]]) -> dict[str, dict]:
+        """Authoritative per-object state from merged logs + object sets.
+
+        Log entries carry (op, version); object listings carry the version
+        actually stored. Newest version wins; delete-at-newest wins.
+        """
+        state: dict[str, dict] = {}
+
+        def consider(oid: str, op: str, version: list[int]) -> None:
+            cur = state.get(oid)
+            if (
+                cur is None
+                or tuple(version) > tuple(cur["version"])
+                # at equal version a delete log entry beats the listing of
+                # a not-yet-removed object (no resurrection on ties)
+                or (tuple(version) == tuple(cur["version"]) and op == "delete")
+            ):
+                state[oid] = {"op": op, "version": list(version)}
+
+        for _shard, (objects, log) in scans.items():
+            for oid, info in objects.items():
+                consider(oid, "modify", info["version"])
+            for e in log:
+                consider(e["oid"], e["op"], e["version"])
+        return state
+
+    async def _fresh_versions(
+        self, pg: PGid, erasure: bool, shards: dict[int, int], oid: str
+    ) -> tuple[dict[int, tuple], dict[int, int]]:
+        """Revalidation read (attrs only) of every member's copy of ``oid``.
+
+        Returns ({key: version currently stored}, {key: errno}); call under
+        the pg lock so the answer can't be invalidated by a client op.
+        """
+        osd = self.osd
+        _d, attrs, errs = await osd._read_shards(
+            pg, oid, dict(shards), want_data=False,
+            store_shard=None if erasure else -1,
+        )
+        vers: dict[int, tuple] = {}
+        for k, a in attrs.items():
+            if OI_KEY in a:
+                vers[k] = tuple(json.loads(a[OI_KEY]).get("version", [0, 0]))
+            else:
+                vers[k] = (0, 0)
+        return vers, errs
+
+    async def _propagate_delete(
+        self, pg: PGid, pool: Pool, erasure: bool,
+        shards: dict[int, int], scans: dict[int, tuple[dict, list]],
+        oid: str, state: dict,
+    ) -> None:
+        osd = self.osd
+        async with osd.pg_lock(pg):
+            vers, errs = await self._fresh_versions(pg, erasure, shards, oid)
+            if vers and max(vers.values()) > tuple(state["version"]):
+                return  # re-created after the scan: nothing to delete
+            entry = PGLogEntry(
+                "delete", oid, Eversion.from_list(state["version"]), Eversion()
+            )
+            for key in vers:  # the members that still hold the object
+                member = shards[key]
+                shard_field = key if erasure else -1
+                cid = CollectionId(f"{pg}s{key}" if erasure else str(pg))
+                soid = ObjectId(oid, key if erasure else -1)
+                txn = Transaction().create_collection(cid).remove(cid, soid)
+                logger.info(
+                    "%s: recovery removing resurrected %s from osd.%d",
+                    osd.name, soid, member,
+                )
+                if await self._push_txn(pg, shard_field, member, txn, entry):
+                    self.recoveries_done += 1
+
+    async def _repair_object(
+        self, pg: PGid, pool: Pool, erasure: bool,
+        shards: dict[int, int], scans: dict[int, tuple[dict, list]],
+        oid: str, state: dict, acting: list[int],
+    ) -> None:
+        # cheap pre-filter on scan-era data; the real decision re-reads
+        # fresh state under the pg lock (a client op may have raced)
+        scan_stale = any(
+            tuple(
+                scans.get(key, ({}, []))[0].get(oid, {}).get("version", [-1, -1])
+            ) != tuple(state["version"])
+            for key in shards
+        )
+        if not scan_stale:
+            return
+        osd = self.osd
+        async with osd.pg_lock(pg):
+            vers, errs = await self._fresh_versions(pg, erasure, shards, oid)
+            if not vers:
+                return  # gone everywhere: the delete path owns this case
+            want_version = max(vers.values())
+            stale: dict[int, int] = {}
+            for key, member in shards.items():
+                if vers.get(key) == want_version:
+                    continue
+                if key in errs and errs[key] != -ENOENT:
+                    # member unreachable right now: retry pass later
+                    self._retry_needed = True
+                    continue
+                stale[key] = member
+            if not stale:
+                return
+            await self._push_repairs(
+                pg, pool, erasure, shards, oid, list(want_version), stale,
+                acting, vers,
+            )
+
+    async def _push_repairs(
+        self, pg: PGid, pool: Pool, erasure: bool, shards: dict[int, int],
+        oid: str, version: list[int], stale: dict[int, int],
+        acting: list[int], vers: dict[int, tuple],
+    ) -> None:
+        osd = self.osd
+        entry = PGLogEntry(
+            "modify", oid, Eversion.from_list(version), Eversion()
+        )
+        if erasure:
+            # reconstruct the logical object, re-encode, push stale chunks
+            # (one batched device call rebuilds every missing shard)
+            codec, sinfo = osd._pool_codec(pool)
+            r, data = await osd._ec_read(pg, pool, acting, oid)
+            if r < 0:
+                logger.warning(
+                    "%s: cannot recover %s/%s (read err %d)",
+                    osd.name, pg, oid, r,
+                )
+                self._retry_needed = True
+                return
+            padded = (
+                sinfo.pad_to_stripe(data) if data else b"\x00" * sinfo.stripe_width
+            )
+            shard_bufs = ec_util.encode(sinfo, codec, padded)
+            km = codec.get_chunk_count()
+            hinfo = HashInfo(km)
+            hinfo.append(0, shard_bufs)
+            hinfo_b = json.dumps(hinfo.to_dict()).encode()
+            oi_b = json.dumps(
+                {"size": len(data), "version": version}
+            ).encode()
+            for key, member in stale.items():
+                cid = CollectionId(f"{pg}s{key}")
+                soid = ObjectId(oid, key)
+                chunk = shard_bufs[key].tobytes()
+                txn = (
+                    Transaction()
+                    .create_collection(cid)
+                    .remove(cid, soid)
+                    .write(cid, soid, 0, chunk)
+                    .setattr(cid, soid, HashInfo.XATTR_KEY, hinfo_b)
+                    .setattr(cid, soid, OI_KEY, oi_b)
+                )
+                logger.info(
+                    "%s: recovering %s shard %d -> osd.%d (v%s)",
+                    osd.name, soid, key, member, version,
+                )
+                if await self._push_txn(pg, key, member, txn, entry):
+                    self.recoveries_done += 1
+        else:
+            # replicated: push the whole object from a healthy member
+            cid = CollectionId(str(pg))
+            soid = ObjectId(oid)
+            healthy = [k for k, v in vers.items() if list(v) == version]
+            data = attrs = None
+            for k in healthy:
+                if shards[k] == osd.osd_id:
+                    try:
+                        data = osd.store.read(cid, soid)
+                        attrs = osd.store.getattrs(cid, soid)
+                    except KeyError:
+                        continue
+                    break
+            if data is None:
+                for k in healthy:  # remote pull
+                    d, a, errs = await osd._read_shards(
+                        pg, oid, {-1: shards[k]}
+                    )
+                    if -1 in d and -1 not in errs:
+                        data = d[-1]
+                        attrs = {
+                            ak: av.encode() for ak, av in a.get(-1, {}).items()
+                        }
+                        break
+            if data is None:
+                logger.warning(
+                    "%s: cannot recover %s/%s (no healthy replica)",
+                    osd.name, pg, oid,
+                )
+                self._retry_needed = True
+                return
+            for key, member in stale.items():
+                txn = (
+                    Transaction()
+                    .create_collection(cid)
+                    .remove(cid, soid)
+                    .write(cid, soid, 0, bytes(data))
+                )
+                for ak, av in (attrs or {}).items():
+                    txn.setattr(cid, soid, ak, av)
+                logger.info(
+                    "%s: recovering %s -> osd.%d (v%s)",
+                    osd.name, soid, member, version,
+                )
+                if await self._push_txn(pg, -1, member, txn, entry):
+                    self.recoveries_done += 1
+
+    async def _push_txn(
+        self, pg: PGid, shard: int, member: int, txn: Transaction,
+        entry: PGLogEntry,
+    ) -> bool:
+        """Recovery pushes ride the normal sub-write path (same durability
+        contract: log entry + data in one transaction). Returns success;
+        a failed push flags the pass for retry."""
+        osd = self.osd
+        tid = osd._new_tid()
+        from .daemon import _Waiter
+
+        waiter = _Waiter({shard}, {shard: member})
+        osd._write_waiters[tid] = waiter
+        try:
+            await osd._send_sub_write(tid, pg, shard, member, txn, entry)
+            async with asyncio.timeout(10.0):
+                await waiter.event.wait()
+        except TimeoutError:
+            logger.warning(
+                "%s: recovery push to osd.%d timed out", osd.name, member
+            )
+            self._retry_needed = True
+            return False
+        finally:
+            del osd._write_waiters[tid]
+        if any(r != 0 for r in waiter.results.values()):
+            logger.warning(
+                "%s: recovery push to osd.%d failed %s",
+                osd.name, member, waiter.results,
+            )
+            self._retry_needed = True
+            return False
+        return True
+
+
+class _ScanWaiter:
+    def __init__(self, pending: set[int], members: dict[int, int] | None = None):
+        self.pending = set(pending)
+        self.members = dict(members or {})
+        self.results: dict[int, tuple[dict, list]] = {}
+        self.event = asyncio.Event()
+        if not self.pending:
+            self.event.set()
+
+    def complete(self, shard: int, objects: dict, log: list) -> None:
+        if shard in self.pending:
+            self.pending.discard(shard)
+            self.results[shard] = (objects, log)
+            if not self.pending:
+                self.event.set()
+
+    def fail_member(self, osd_id: int) -> None:
+        for key in list(self.pending):
+            if self.members.get(key) == osd_id:
+                self.complete(key, {}, [])

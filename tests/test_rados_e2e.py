@@ -55,8 +55,7 @@ def test_replicated_data_on_all_replicas():
             io = cl.io_ctx("rep")
             await io.write_full("o", b"payload")
             pool = cl.osdmap.lookup_pool("rep")
-            pg = cl.osdmap.object_locator_to_pg("o", pool.id)
-            _, _, acting, _ = cl.osdmap.pg_to_up_acting_osds(pg)
+            pg, acting, primary = cl.osdmap.object_to_acting("o", pool.id)
             cid = CollectionId(str(pg))
             for osd in acting:
                 st = cluster.stores[osd]
@@ -98,8 +97,7 @@ def test_ec_chunks_land_on_positional_shards():
             io = cl.io_ctx("ecpool")
             await io.write_full("obj", PAYLOAD)
             pool = cl.osdmap.lookup_pool("ecpool")
-            pg = cl.osdmap.object_locator_to_pg("obj", pool.id)
-            _, _, acting, _ = cl.osdmap.pg_to_up_acting_osds(pg)
+            pg, acting, primary = cl.osdmap.object_to_acting("obj", pool.id)
             assert len(acting) == 3  # k+m
             seen_sizes = set()
             for shard, osd in enumerate(acting):
@@ -133,8 +131,7 @@ def test_ec_degraded_read_after_shard_kill():
             await io.write_full("obj", PAYLOAD)
 
             pool = cl.osdmap.lookup_pool("ecpool")
-            pg = cl.osdmap.object_locator_to_pg("obj", pool.id)
-            _, _, acting, primary = cl.osdmap.pg_to_up_acting_osds(pg)
+            pg, acting, primary = cl.osdmap.object_to_acting("obj", pool.id)
             victim = next(o for o in acting if o != primary)
             await cluster.kill_osd(victim)
             await cluster.wait_for_osd_down(victim)
@@ -154,8 +151,7 @@ def test_ec_primary_failover():
             await io.write_full("obj", PAYLOAD)
 
             pool = cl.osdmap.lookup_pool("ecpool")
-            pg = cl.osdmap.object_locator_to_pg("obj", pool.id)
-            _, _, _, primary = cl.osdmap.pg_to_up_acting_osds(pg)
+            pg, _acting, primary = cl.osdmap.object_to_acting("obj", pool.id)
             await cluster.kill_osd(primary)
             await cluster.wait_for_osd_down(primary)
             assert await io.read("obj") == PAYLOAD
@@ -182,8 +178,7 @@ def test_ec_k4m2_two_failures():
             await io.write_full("big", big)
 
             pool = cl.osdmap.lookup_pool("ec42")
-            pg = cl.osdmap.object_locator_to_pg("big", pool.id)
-            _, _, acting, primary = cl.osdmap.pg_to_up_acting_osds(pg)
+            pg, acting, primary = cl.osdmap.object_to_acting("big", pool.id)
             victims = [o for o in acting if o != primary][:2]
             for v in victims:
                 await cluster.kill_osd(v)
@@ -201,8 +196,7 @@ def test_ec_write_refused_below_min_size():
             io = cl.io_ctx("ecpool")
             await io.write_full("obj", b"data")
             pool = cl.osdmap.lookup_pool("ecpool")
-            pg = cl.osdmap.object_locator_to_pg("obj", pool.id)
-            _, _, acting, primary = cl.osdmap.pg_to_up_acting_osds(pg)
+            pg, acting, primary = cl.osdmap.object_to_acting("obj", pool.id)
             # kill both non-primary shards -> only 1 left < min_size=2
             for o in acting:
                 if o != primary:
@@ -229,8 +223,7 @@ def test_ec_object_not_found_and_delete_all_shards():
                 await io.read("obj")
             # shards really gone from every store
             pool = cl.osdmap.lookup_pool("ecpool")
-            pg = cl.osdmap.object_locator_to_pg("obj", pool.id)
-            _, _, acting, _ = cl.osdmap.pg_to_up_acting_osds(pg)
+            pg, acting, primary = cl.osdmap.object_to_acting("obj", pool.id)
             for shard, osd in enumerate(acting):
                 assert not cluster.stores[osd].exists(
                     CollectionId(f"{pg}s{shard}"), ObjectId("obj", shard)
@@ -251,8 +244,7 @@ def test_ec_corrupt_chunk_detected_and_reconstructed():
             io = cl.io_ctx("ecpool")
             await io.write_full("obj", PAYLOAD)
             pool = cl.osdmap.lookup_pool("ecpool")
-            pg = cl.osdmap.object_locator_to_pg("obj", pool.id)
-            _, _, acting, _ = cl.osdmap.pg_to_up_acting_osds(pg)
+            pg, acting, primary = cl.osdmap.object_to_acting("obj", pool.id)
             # corrupt shard 0's chunk in place (bypassing the OSD)
             store = cluster.stores[acting[0]]
             cid = CollectionId(f"{pg}s0")
@@ -275,8 +267,7 @@ def test_ec_corrupt_remote_chunk_detected():
             io = cl.io_ctx("ecpool")
             await io.write_full("obj", PAYLOAD)
             pool = cl.osdmap.lookup_pool("ecpool")
-            pg = cl.osdmap.object_locator_to_pg("obj", pool.id)
-            _, _, acting, primary = cl.osdmap.pg_to_up_acting_osds(pg)
+            pg, acting, primary = cl.osdmap.object_to_acting("obj", pool.id)
             from ceph_tpu.store import Transaction
             for shard, osd in enumerate(acting):
                 if osd != primary:  # corrupt every REMOTE shard one at a time
@@ -305,8 +296,7 @@ def test_ec_stale_shard_rejected_after_degraded_overwrite():
             v2 = bytes([2]) * 8192
             await io.write_full("obj", v1)
             pool = cl.osdmap.lookup_pool("ecpool")
-            pg = cl.osdmap.object_locator_to_pg("obj", pool.id)
-            _, _, acting, primary = cl.osdmap.pg_to_up_acting_osds(pg)
+            pg, acting, primary = cl.osdmap.object_to_acting("obj", pool.id)
             victim = next(o for o in acting if o != primary)
             await cluster.kill_osd(victim)
             await cluster.wait_for_osd_down(victim)
@@ -330,8 +320,7 @@ def test_ec_delete_propagates_shard_failure():
             io = cl.io_ctx("ecpool")
             await io.write_full("obj", PAYLOAD)
             pool = cl.osdmap.lookup_pool("ecpool")
-            pg = cl.osdmap.object_locator_to_pg("obj", pool.id)
-            _, _, acting, primary = cl.osdmap.pg_to_up_acting_osds(pg)
+            pg, acting, primary = cl.osdmap.object_to_acting("obj", pool.id)
             victim_osd = next(o for o in acting if o != primary)
             store = cluster.stores[victim_osd]
             orig_apply = store.apply
@@ -382,8 +371,7 @@ def test_osd_restart_serves_old_data():
             io = cl.io_ctx("ecpool")
             await io.write_full("obj", PAYLOAD)
             pool = cl.osdmap.lookup_pool("ecpool")
-            pg = cl.osdmap.object_locator_to_pg("obj", pool.id)
-            _, _, acting, primary = cl.osdmap.pg_to_up_acting_osds(pg)
+            pg, acting, primary = cl.osdmap.object_to_acting("obj", pool.id)
             victim = next(o for o in acting if o != primary)
             await cluster.kill_osd(victim)
             await cluster.wait_for_osd_down(victim)
