@@ -31,7 +31,7 @@ def encode_txn(txn: Transaction) -> tuple[list, list[bytes]]:
         name = op[0]
         if name in ("create_collection", "remove_collection"):
             ops_out.append([name, op[1].pg])
-        elif name == "clone":
+        elif name in ("clone", "try_stash", "stash_restore"):
             (_, cid, src, dst) = op
             ops_out.append([name, cid.pg, [src.name, src.shard], [dst.name, dst.shard]])
         elif name in ("touch", "remove"):
@@ -75,8 +75,8 @@ def decode_txn(ops_in: list, blobs: list[bytes]) -> Transaction:
         name = op[0]
         if name in ("create_collection", "remove_collection"):
             getattr(txn, name)(CollectionId(op[1]))
-        elif name == "clone":
-            txn.clone(CollectionId(op[1]), oid(op[2]), oid(op[3]))
+        elif name in ("clone", "try_stash", "stash_restore"):
+            getattr(txn, name)(CollectionId(op[1]), oid(op[2]), oid(op[3]))
         elif name in ("touch", "remove", "omap_clear"):
             getattr(txn, name)(CollectionId(op[1]), oid(op[2]))
         elif name == "write":
@@ -206,7 +206,8 @@ class MOSDECSubOpWrite(Message):
     ``trim_to`` version pairs."""
 
     TYPE = "ec_sub_op_write"
-    FIELDS = ("pgid", "tid", "from_osd", "shard", "txn", "log", "at_version", "trim_to")
+    FIELDS = ("pgid", "tid", "from_osd", "shard", "txn", "log", "at_version",
+              "trim_to", "epoch")
 
 
 @register
@@ -243,7 +244,7 @@ class MOSDRepOp(Message):
     (reference:src/messages/MOSDRepOp.h)."""
 
     TYPE = "rep_op"
-    FIELDS = ("pgid", "tid", "from_osd", "txn", "log", "at_version")
+    FIELDS = ("pgid", "tid", "from_osd", "txn", "log", "at_version", "epoch")
 
 
 @register

@@ -168,6 +168,9 @@ class AsyncMessenger:
     async def _accept(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
+        if self._stopped:
+            writer.close()
+            return
         conn = Connection(self, reader, writer)
         try:
             banner = json.loads((await reader.readline()).decode())
@@ -185,6 +188,8 @@ class AsyncMessenger:
     async def connect(self, addr: str, peer_name: str = "?") -> Connection:
         """Get (or open) the cached connection to ``addr``; concurrent
         callers share one in-flight connect (no duplicate streams)."""
+        if self._stopped:
+            raise ConnectionResetError(f"{self.name}: messenger is shut down")
         conn = self._conns.get(addr)
         if conn is not None and not conn._closed:
             return conn
@@ -221,6 +226,13 @@ class AsyncMessenger:
         return conn
 
     def _start(self, conn: Connection) -> None:
+        if self._stopped:
+            # a handshake that finished while shutdown() was tearing down
+            # would otherwise register AFTER the teardown snapshot and keep
+            # the server's wait_closed() blocked forever
+            conn._closed = True
+            conn._writer.close()
+            return
         self._all.add(conn)
         conn._tasks = [
             asyncio.ensure_future(conn._reader_loop()),

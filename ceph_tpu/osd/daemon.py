@@ -44,11 +44,17 @@ from ..models import registry
 from ..msg import AsyncMessenger, Connection, Dispatcher, messages
 from ..msg.message import Message
 from ..store import CollectionId, MemStore, ObjectId, ObjectStore, Transaction
-from ..utils import native
-from . import ec_util
-from .ec_util import HashInfo, StripeInfo
+from . import ec_transaction, ec_util
+from .ec_util import StripeHashes, StripeInfo
 from .osdmap import CRUSH_ITEM_NONE, OSDMap, PGid, Pool, POOL_TYPE_ERASURE
-from .pg_log import Eversion, PGLogEntry, add_log_entry_to_txn
+from .pg_log import (
+    Eversion,
+    PGLogEntry,
+    add_log_entry_to_txn,
+    is_stash_name,
+    stash_name,
+    trim_stashes_to_txn,
+)
 
 logger = logging.getLogger("ceph_tpu.osd")
 
@@ -56,6 +62,7 @@ ENOENT = 2
 EIO = 5
 EAGAIN = 11
 EINVAL = 22
+ESTALE = 116
 
 OI_KEY = "_"  # object-info xattr (reference OI_ATTR)
 SUBOP_TIMEOUT = 30.0
@@ -142,12 +149,14 @@ class OSD(Dispatcher):
         store: ObjectStore | None = None,
         heartbeat_interval: float = 0.0,
         heartbeat_grace: float = 3.0,
+        subop_timeout: float = SUBOP_TIMEOUT,
     ):
         self.osd_id = osd_id
         self.name = f"osd.{osd_id}"
         self.mon_addr = mon_addr
         self.messenger = AsyncMessenger(self.name, self)
         self.store = store or MemStore()
+        self.subop_timeout = subop_timeout
         self.osdmap: OSDMap | None = None
         self.addr = ""
         self.heartbeat_interval = heartbeat_interval
@@ -157,6 +166,7 @@ class OSD(Dispatcher):
         self._write_waiters: dict[int, _Waiter] = {}
         self._read_waiters: dict[int, _ReadWaiter] = {}
         self._pg_versions: dict[str, Eversion] = {}
+        self._pg_committed: dict[str, Eversion] = {}  # roll-forward watermark
         self._pg_locks: dict[str, asyncio.Lock] = {}
         self._tasks: set[asyncio.Task] = set()
         self._hb_task: asyncio.Task | None = None
@@ -353,9 +363,11 @@ class OSD(Dispatcher):
         blobs: list[bytes] = []
         for op in msg.ops:
             name = op["op"]
-            if name == "writefull":
-                data = msg.blobs[op["data"]]
-                r = await self._ec_write_full(pg, pool, acting, msg.oid, data)
+            if name in ("writefull", "write", "append", "zero", "truncate"):
+                data = (
+                    msg.blobs[op["data"]] if op.get("data") is not None else b""
+                )
+                r = await self._ec_mutate(pg, pool, acting, msg.oid, name, op, data)
                 out.append({"rval": r})
                 if r < 0:
                     return r, out, blobs
@@ -365,13 +377,12 @@ class OSD(Dispatcher):
                 if r < 0:
                     return r, out, blobs
             elif name == "read":
-                r, data = await self._ec_read(pg, pool, acting, msg.oid)
+                off = int(op.get("offset", 0))
+                ln = int(op.get("length", 0)) or -1
+                r, data = await self._ec_read(pg, pool, acting, msg.oid, off, ln)
                 if r < 0:
                     out.append({"rval": r})
                     return r, out, blobs
-                off = op.get("offset", 0)
-                ln = op.get("length", 0)
-                data = data[off : off + ln] if ln else data[off:]
                 out.append({"rval": 0, "data": len(blobs)})
                 blobs.append(data)
             elif name == "stat":
@@ -384,34 +395,125 @@ class OSD(Dispatcher):
                 return -EINVAL, out, blobs
         return 0, out, blobs
 
-    async def _ec_write_full(
-        self, pg: PGid, pool: Pool, acting: list[int], oid: str, data: bytes
+    # -- EC mutation pipeline (RMW) -------------------------------------------
+
+    async def _ec_mutate(
+        self, pg: PGid, pool: Pool, acting: list[int], oid: str,
+        opname: str, op: dict, data: bytes,
     ) -> int:
         async with self.pg_lock(pg):
-            return await self._ec_write_full_locked(pg, pool, acting, oid, data)
+            return await self._ec_mutate_locked(
+                pg, pool, acting, oid, opname, op, data
+            )
 
-    async def _ec_write_full_locked(
-        self, pg: PGid, pool: Pool, acting: list[int], oid: str, data: bytes
+    async def _ec_mutate_locked(
+        self, pg: PGid, pool: Pool, acting: list[int], oid: str,
+        opname: str, op: dict, data: bytes,
     ) -> int:
+        """One EC object mutation, planned and committed under the PG lock.
+
+        The reference pipelines writes through waiting_state/waiting_reads/
+        waiting_commit with an in-flight extent cache
+        (reference:src/osd/ECBackend.h:549-551, start_rmw cc:1697,
+        reference:src/osd/ExtentCache.h:1); the PG lock serializes ops here
+        so the stages run inline: plan (ECTransaction::get_write_plan
+        analog) -> read+decode old partial stripes -> re-encode the whole
+        will_write extent in ONE batched device call -> stash+write
+        fan-out -> all-present commit -> trim watermark.
+
+        Rollback safety: every shard transaction stashes the pre-write
+        object (``try_stash``) so an interrupted fan-out leaves the old
+        version restorable; recovery rolls back any version that fewer
+        than k shards committed (the pg-log rollback design,
+        reference:doc/dev/osd_internals/erasure_coding/ecbackend.rst).
+        """
         codec, sinfo = self._pool_codec(pool)
         k, km = codec.get_data_chunk_count(), codec.get_chunk_count()
         present = [
             (s, o) for s, o in enumerate(acting[:km]) if o != CRUSH_ITEM_NONE
         ]
-        if len(present) < pool.min_size:
+        if len(present) < max(pool.min_size, k):
             return -EAGAIN  # degraded below min_size: cannot accept writes
-        padded = sinfo.pad_to_stripe(data) if data else b"\x00" * sinfo.stripe_width
-        shards = ec_util.encode(sinfo, codec, padded)
-        hinfo = HashInfo(km)
-        hinfo.append(0, shards)
-        hinfo_b = json.dumps(hinfo.to_dict()).encode()
+        available = dict(present)
+        oi, hashes, vers, meta_errs = await self._ec_meta(pg, oid, available)
+        if any(e != -ENOENT for e in meta_errs.values()):
+            # a shard's state is UNKNOWN (not merely absent): planning a
+            # partial write against a possibly-stale oi could silently
+            # truncate or fork the object — back off and let the client
+            # retry once the map/peers settle
+            return -EAGAIN
+        old_size = int(oi["size"]) if oi else 0
+        prior = Eversion.from_list(oi["version"]) if oi else Eversion()
+        if oi is not None and opname != "writefull":
+            # partial ops must only stamp shards that are up to date: a
+            # stale/rejoined shard stamped with the new version+crc table
+            # would pass version checks while holding old bytes in its
+            # untouched stripes, becoming invisible to recovery (the
+            # reference routes writes around 'missing' shards and lets
+            # recovery push them forward, reference:src/osd/ECBackend.cc
+            # recovery path). Stale shards keep their old version here, so
+            # version-based repair still finds them.
+            newest = tuple(prior.to_list())
+            present = [(s, o) for s, o in present if vers.get(s) == newest]
+            if len(present) < max(pool.min_size, k):
+                return -EAGAIN
+
+        if opname == "writefull":
+            offset = 0
+            plan = ec_transaction.plan_write_full(sinfo, old_size, len(data))
+        elif opname == "write":
+            offset = int(op.get("offset", 0))
+            plan = ec_transaction.plan_write(sinfo, old_size, offset, len(data))
+        elif opname == "append":
+            offset = old_size
+            plan = ec_transaction.plan_append(sinfo, old_size, len(data))
+        elif opname == "zero":
+            offset = int(op.get("offset", 0))
+            length = int(op.get("length", 0))
+            data = b"\x00" * length
+            plan = ec_transaction.plan_write(sinfo, old_size, offset, length)
+        elif opname == "truncate":
+            size = int(op.get("size", op.get("offset", 0)))
+            plan = ec_transaction.plan_truncate(sinfo, old_size, size)
+            offset = plan.will_write[0]
+            data = b""
+        else:
+            return -EINVAL
+
+        # fetch + decode the partially-covered old stripes (≤ 2 extents)
+        old_exts: dict[int, bytes] = {}
+        for eoff, elen in plan.to_read:
+            r, old = await self._ec_read(pg, pool, acting, oid, eoff, elen)
+            if r < 0 and r != -ENOENT:
+                return r
+            old_exts[eoff] = old
+
+        # re-encode the will_write extent: one batched device call
+        shard_bufs = None
+        c_off = 0
+        if plan.will_write[1] > 0:
+            buf = ec_transaction.merge_extents(plan, sinfo, old_exts, offset, data)
+            shard_bufs = ec_util.encode(sinfo, codec, buf)
+            c_off = sinfo.aligned_logical_offset_to_chunk_offset(plan.will_write[0])
+
+        # per-stripe crc table + object info (overwrite-safe HashInfo)
+        if opname == "writefull" or hashes is None or (
+            hashes.chunk_size != sinfo.chunk_size
+        ):
+            hashes = StripeHashes(km, sinfo.chunk_size)
+        if shard_bufs is not None:
+            hashes.set_range(plan.will_write[0] // sinfo.stripe_width, shard_bufs)
+        hashes.truncate_stripes(
+            sinfo.logical_to_next_stripe_offset(plan.new_size) // sinfo.stripe_width
+        )
+        hinfo_b = json.dumps(hashes.to_dict()).encode()
+
         version = self._next_version(pg)
-        # version in the object info lets readers reject stale shards a
-        # degraded write skipped (reference object_info_t user_version)
         oi_b = json.dumps(
-            {"size": len(data), "version": version.to_list()}
+            {"size": plan.new_size, "version": version.to_list()}
         ).encode()
-        entry = PGLogEntry("modify", oid, version, Eversion())
+        sname = stash_name(oid, version)
+        entry = PGLogEntry("modify", oid, version, prior, stash=sname)
 
         tid = self._new_tid()
         waiter = _Waiter({s for s, _ in present}, dict(present))
@@ -420,26 +522,31 @@ class OSD(Dispatcher):
             for shard, osd in present:
                 cid = self._shard_cid(pg, shard)
                 soid = ObjectId(oid, shard)
-                chunk = shards[shard].tobytes()
                 txn = (
                     Transaction()
                     .create_collection(cid)
-                    .remove(cid, soid)
-                    .write(cid, soid, 0, chunk)
-                    .setattr(cid, soid, HashInfo.XATTR_KEY, hinfo_b)
-                    .setattr(cid, soid, OI_KEY, oi_b)
+                    .try_stash(cid, soid, ObjectId(sname, shard))
                 )
-                await self._send_sub_write(tid, pg, shard, osd, txn, entry)
-            async with asyncio.timeout(SUBOP_TIMEOUT):
+                if plan.shard_truncate is not None:
+                    txn.truncate(cid, soid, plan.shard_truncate)
+                if shard_bufs is not None:
+                    txn.write(cid, soid, c_off, shard_bufs[shard].tobytes())
+                txn.setattr(cid, soid, StripeHashes.XATTR_KEY, hinfo_b)
+                txn.setattr(cid, soid, OI_KEY, oi_b)
+                await self._send_sub_write(tid, pg, shard, osd, txn, [entry])
+            async with asyncio.timeout(self.subop_timeout):
                 await waiter.event.wait()
         except TimeoutError:
-            logger.warning("%s: ec write tid=%d timed out on %s",
-                           self.name, tid, waiter.pending)
+            logger.warning("%s: ec %s tid=%d timed out on %s",
+                           self.name, opname, tid, waiter.pending)
             return -EIO
         finally:
             del self._write_waiters[tid]
         if any(r != 0 for r in waiter.results.values()):
+            if any(r == -ESTALE for r in waiter.results.values()):
+                return -EAGAIN  # we are a demoted primary; client re-targets
             return -EIO
+        self._mark_committed(pg, version, present)
         return 0
 
     async def _ec_delete(
@@ -459,28 +566,61 @@ class OSD(Dispatcher):
         if not present:
             return -EAGAIN
         version = self._next_version(pg)
-        entry = PGLogEntry("delete", oid, version, Eversion())
+        sname = stash_name(oid, version)
+        entry = PGLogEntry("delete", oid, version, Eversion(), stash=sname)
         tid = self._new_tid()
         waiter = _Waiter({s for s, _ in present}, dict(present))
         self._write_waiters[tid] = waiter
         try:
             for shard, osd in present:
                 cid = self._shard_cid(pg, shard)
+                soid = ObjectId(oid, shard)
                 txn = (
                     Transaction()
                     .create_collection(cid)
-                    .remove(cid, ObjectId(oid, shard))
+                    .try_stash(cid, soid, ObjectId(sname, shard))
+                    .remove(cid, soid)
                 )
-                await self._send_sub_write(tid, pg, shard, osd, txn, entry)
-            async with asyncio.timeout(SUBOP_TIMEOUT):
+                await self._send_sub_write(tid, pg, shard, osd, txn, [entry])
+            async with asyncio.timeout(self.subop_timeout):
                 await waiter.event.wait()
         except TimeoutError:
             return -EIO
         finally:
             del self._write_waiters[tid]
         if any(r != 0 for r in waiter.results.values()):
+            if any(r == -ESTALE for r in waiter.results.values()):
+                return -EAGAIN
             return -EIO
+        self._mark_committed(pg, version, present)
         return 0
+
+    # -- commit watermark / stash trim ----------------------------------------
+
+    def _mark_committed(
+        self, pg: PGid, version: Eversion, present: list[tuple[int, int]]
+    ) -> None:
+        """All present shards committed ``version``: advance the PG's
+        roll-forward watermark and eagerly tell shards to drop rollback
+        stashes ≤ it (the reference's roll_forward_to,
+        reference:src/osd/ECBackend.cc:1389 submit_transaction). The next
+        sub-op piggybacks the watermark anyway, so a lost trim only
+        delays space reclaim."""
+        key = str(pg)
+        if self._pg_committed.get(key, Eversion()) < version:
+            self._pg_committed[key] = version
+        for shard, osd in present:
+            t = asyncio.ensure_future(self._send_trim(pg, shard, osd))
+            self._tasks.add(t)
+            t.add_done_callback(self._tasks.discard)
+
+    async def _send_trim(self, pg: PGid, shard: int, osd: int) -> None:
+        try:
+            await self._send_sub_write(0, pg, shard, osd, Transaction(), [])
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            pass  # best-effort; the watermark rides the next sub-op too
 
     async def _send_sub_write(
         self,
@@ -489,12 +629,15 @@ class OSD(Dispatcher):
         shard: int,
         osd: int,
         txn: Transaction,
-        entry: PGLogEntry,
+        entries: list[PGLogEntry],
     ) -> None:
+        trim_to = self._pg_committed.get(str(pg), Eversion())
         if osd == self.osd_id:
             # self-delivery (reference:ECBackend.cc:878 handle_sub_write)
-            r = self._apply_sub_write(txn, str(pg), shard, [entry])
-            self._write_waiters[tid].complete(shard, r)
+            r = self._apply_sub_write(txn, str(pg), shard, entries, trim_to)
+            w = self._write_waiters.get(tid)
+            if w:
+                w.complete(shard, r)
             return
         addr = self.osdmap.get_addr(osd)
         ops, blobs = messages.encode_txn(txn)
@@ -502,13 +645,16 @@ class OSD(Dispatcher):
             conn = await self.messenger.connect(addr, f"osd.{osd}")
         except (ConnectionError, OSError):
             # peer died before the map said so: fail this shard, not the op
-            self._write_waiters[tid].complete(shard, -EIO)
+            w = self._write_waiters.get(tid)
+            if w:
+                w.complete(shard, -EIO)
             return
         conn.send(
             messages.MOSDECSubOpWrite(
                 pgid=str(pg), tid=tid, from_osd=self.osd_id, shard=shard,
-                txn=ops, log=[entry.to_dict()],
-                at_version=entry.version.to_list(), trim_to=[0, 0], blobs=blobs,
+                txn=ops, log=[e.to_dict() for e in entries],
+                at_version=entries[-1].version.to_list() if entries else None,
+                trim_to=trim_to.to_list(), epoch=self._epoch(), blobs=blobs,
             )
         )
 
@@ -518,14 +664,20 @@ class OSD(Dispatcher):
         pgid: str,
         shard: int,
         entries: list[PGLogEntry],
+        trim_to: Eversion | None = None,
     ) -> int:
         """Append the log entries to the shard's pgmeta in the SAME
         transaction as the data, then commit — the crash-consistency
         contract (reference:ECBackend.cc:908-938 log_operation +
-        queue_transactions)."""
+        queue_transactions). ``trim_to`` additionally drops rollback
+        stashes for fully-committed entries."""
         cid = CollectionId(f"{pgid}s{shard}" if shard >= 0 else pgid)
         for entry in entries:
             add_log_entry_to_txn(txn, cid, shard, entry)
+        if trim_to is not None and trim_to > Eversion():
+            trim_stashes_to_txn(self.store, cid, shard, trim_to, txn)
+        if txn.empty():
+            return 0
         try:
             self.store.apply(txn)
             return 0
@@ -533,10 +685,32 @@ class OSD(Dispatcher):
             logger.exception("%s: sub-write apply failed", self.name)
             return -EIO
 
+    def _gate_subop(self, pgid: str, epoch: int | None, from_osd: int | None) -> int:
+        """Reject sub-ops from a demoted primary: a sender on an older map
+        epoch is only honored if it is STILL the acting primary for the PG
+        in OUR map — otherwise a stale primary racing a map change could
+        clobber data written by the new one (the reference gates sub-ops
+        on same-interval checks via the op epoch)."""
+        if epoch is None or from_osd is None or self.osdmap is None:
+            return 0  # legacy/internal senders: no gate
+        if epoch >= self._epoch():
+            return 0  # sender at least as current as us
+        try:
+            pg = PGid.parse(pgid.split("s", 1)[0])
+            _up, _upp, _acting, primary = self.osdmap.pg_to_up_acting_osds(pg)
+        except Exception:
+            return -ESTALE
+        return 0 if from_osd == primary else -ESTALE
+
     def _handle_sub_write(self, conn: Connection, msg: messages.MOSDECSubOpWrite) -> None:
-        txn = messages.decode_txn(msg.txn, msg.blobs)
-        entries = [PGLogEntry.from_dict(d) for d in msg.log]
-        r = self._apply_sub_write(txn, msg.pgid, msg.shard, entries)
+        r = self._gate_subop(msg.pgid, msg.epoch, msg.from_osd)
+        if r == 0:
+            txn = messages.decode_txn(msg.txn, msg.blobs)
+            entries = [PGLogEntry.from_dict(d) for d in msg.log]
+            trim_to = (
+                Eversion.from_list(msg.trim_to) if msg.trim_to else None
+            )
+            r = self._apply_sub_write(txn, msg.pgid, msg.shard, entries, trim_to)
         conn.send(
             messages.MOSDECSubOpWriteReply(
                 pgid=msg.pgid, tid=msg.tid, shard=msg.shard, result=r
@@ -545,15 +719,65 @@ class OSD(Dispatcher):
 
     # -- EC read path ---------------------------------------------------------
 
+    async def _ec_meta(
+        self, pg: PGid, oid: str, available: dict[int, int]
+    ) -> tuple[dict | None, StripeHashes | None, dict[int, tuple], dict[int, int]]:
+        """Newest object info + crc table from the shards' xattrs (one
+        attrs-only round trip) — the planner's hash_infos input
+        (reference:src/osd/ECTransaction.h:26-33 WritePlan.hash_infos).
+        Returns (oi, hashes, per-shard versions, per-shard errnos); callers
+        must distinguish absent-everywhere from unreachable via ``errs``."""
+        _d, attrs, errs = await self._read_shards(
+            pg, oid, dict(available), want_data=False
+        )
+        oi: dict | None = None
+        hashes: StripeHashes | None = None
+        vers: dict[int, tuple] = {}
+        newest = (0, 0)
+        for s, a in attrs.items():
+            raw = a.get(OI_KEY)
+            if raw is None:
+                vers[s] = (0, 0)
+                continue
+            o = json.loads(raw)
+            v = tuple(o.get("version", [0, 0]))
+            vers[s] = v
+            if v >= newest:
+                newest = v
+                oi = o
+                hraw = a.get(StripeHashes.XATTR_KEY)
+                hashes = None
+                if hraw is not None:
+                    try:
+                        hashes = StripeHashes.from_dict(json.loads(hraw))
+                    except Exception:
+                        hashes = None
+        return oi, hashes, vers, errs
+
     async def _ec_read(
-        self, pg: PGid, pool: Pool, acting: list[int], oid: str
+        self, pg: PGid, pool: Pool, acting: list[int], oid: str,
+        off: int = 0, length: int = -1,
     ) -> tuple[int, bytes]:
+        """Ranged EC read: fetch only the chunk extents covering the
+        requested stripes from a minimal decodable shard set, verify
+        per-stripe crcs and version agreement, decode (one batched device
+        call), slice (reference:src/osd/ECBackend.cc:2187
+        objects_read_and_reconstruct, :1438 get_min_avail_to_read_shards,
+        :941/:994-1008 handle_sub_read + crc check, :2239 retry reads)."""
         codec, sinfo = self._pool_codec(pool)
         k, km = codec.get_data_chunk_count(), codec.get_chunk_count()
         want = list(range(k))
         available = {
             s: o for s, o in enumerate(acting[:km]) if o != CRUSH_ITEM_NONE
         }
+        if length >= 0:
+            s0 = sinfo.logical_to_prev_stripe_offset(off)
+            s1 = sinfo.logical_to_next_stripe_offset(off + length)
+            c_off = sinfo.aligned_logical_offset_to_chunk_offset(s0)
+            c_len = sinfo.aligned_logical_offset_to_chunk_offset(s1) - c_off
+        else:
+            s0, c_off, c_len = 0, 0, -1
+        first_stripe = s0 // sinfo.stripe_width
         failed: set[int] = set()
         for _attempt in range(km):  # each retry excludes newly-failed shards
             usable = [s for s in available if s not in failed]
@@ -562,7 +786,8 @@ class OSD(Dispatcher):
             except Exception:
                 return -EIO, b""
             shard_data, shard_attrs, errs = await self._read_shards(
-                pg, oid, {s: available[s] for s in to_read}
+                pg, oid, {s: available[s] for s in to_read},
+                offset=c_off, length=c_len,
             )
             failed |= set(errs)
             # crc verification (reference:ECBackend.cc:994-1008) + version
@@ -573,13 +798,19 @@ class OSD(Dispatcher):
             ois: dict[int, dict] = {}
             for s, data in shard_data.items():
                 attrs = shard_attrs.get(s, {})
-                hinfo_raw = attrs.get(HashInfo.XATTR_KEY)
-                if hinfo_raw is not None:
-                    hinfo = HashInfo.from_dict(json.loads(hinfo_raw))
-                    crc = native.crc32c(
-                        ec_util.CRC_SEED, np.frombuffer(data, dtype=np.uint8)
-                    )
-                    if crc != hinfo.get_chunk_hash(s):
+                arr = np.frombuffer(data, dtype=np.uint8)
+                hraw = attrs.get(StripeHashes.XATTR_KEY)
+                if hraw is not None and arr.size:
+                    ok = False
+                    try:
+                        sh = StripeHashes.from_dict(json.loads(hraw))
+                        ok = (
+                            arr.size % sinfo.chunk_size == 0
+                            and sh.verify(s, first_stripe, arr)
+                        )
+                    except Exception:
+                        ok = False
+                    if not ok:
                         logger.warning(
                             "%s: shard %d of %s failed crc", self.name, s, oid
                         )
@@ -588,7 +819,7 @@ class OSD(Dispatcher):
                 oi_raw = attrs.get(OI_KEY)
                 if oi_raw is not None:
                     ois[s] = json.loads(oi_raw)
-                chunks[s] = np.frombuffer(data, dtype=np.uint8)
+                chunks[s] = arr
             newest = max(
                 (tuple(oi.get("version", [0, 0])) for oi in ois.values()),
                 default=(0, 0),
@@ -605,36 +836,35 @@ class OSD(Dispatcher):
                     failed.add(s)
                     del chunks[s]
                 elif oi is not None:
-                    size = oi["size"]
+                    size = int(oi["size"])
             if errs and all(e == -ENOENT for e in errs.values()) and not chunks:
                 return -ENOENT, b""  # object absent on every shard asked
             if set(to_read) <= set(chunks):
+                if size is None:
+                    size = 0
+                end = size if length < 0 else min(off + length, size)
+                if off >= end:
+                    return 0, b""
                 logical = ec_util.decode_concat(sinfo, codec, chunks)
-                return 0, logical[: size if size is not None else len(logical)]
+                return 0, logical[off - s0 : end - s0]
             # else: a shard failed mid-read — loop retries with survivors
         return -EIO, b""
 
     async def _ec_stat(
         self, pg: PGid, pool: Pool, acting: list[int], oid: str
     ) -> tuple[int, int]:
-        """Object logical size from any shard's object-info xattr."""
+        """Object logical size from the newest object-info xattr."""
         codec, _ = self._pool_codec(pool)
         km = codec.get_chunk_count()
         available = {
             s: o for s, o in enumerate(acting[:km]) if o != CRUSH_ITEM_NONE
         }
-        _data, attrs, errs = await self._read_shards(
-            pg, oid, available, want_data=False
-        )
-        ois = [
-            json.loads(a[OI_KEY]) for a in attrs.values() if OI_KEY in a
-        ]
-        if not ois:
-            if errs and all(e == -ENOENT for e in errs.values()):
-                return -ENOENT, 0
-            return -EIO, 0
-        newest = max(ois, key=lambda oi: tuple(oi.get("version", [0, 0])))
-        return 0, newest["size"]
+        oi, _hashes, _vers, errs = await self._ec_meta(pg, oid, available)
+        if oi is None:
+            if any(e != -ENOENT for e in errs.values()):
+                return -EIO, 0  # unreachable shards: absence is unproven
+            return -ENOENT, 0
+        return 0, int(oi["size"])
 
     async def _read_shards(
         self,
@@ -643,12 +873,15 @@ class OSD(Dispatcher):
         targets: dict[int, int],
         want_data: bool = True,
         store_shard: int | None = None,
+        offset: int = 0,
+        length: int = -1,
     ) -> tuple[dict[int, bytes], dict[int, dict], dict[int, int]]:
-        """Fetch whole shard extents (+xattrs) from `targets` {key: osd}.
+        """Fetch shard extents (+xattrs) from `targets` {key: osd}.
 
-        Keys are shard ids for EC; for replicated fan-out pass
-        ``store_shard=-1`` so every member reads the whole-PG collection
-        while replies still route by key.
+        ``offset``/``length`` are in the chunk domain (length -1 = to the
+        end of the shard). Keys are shard ids for EC; for replicated
+        fan-out pass ``store_shard=-1`` so every member reads the
+        whole-PG collection while replies still route by key.
         """
         tid = self._new_tid()
         waiter = _ReadWaiter(set(targets), dict(targets))
@@ -658,7 +891,7 @@ class OSD(Dispatcher):
                 shard = key if store_shard is None else store_shard
                 if osd == self.osd_id:
                     data, attrs, err = self._local_shard_read(
-                        pg, shard, oid, want_data
+                        pg, shard, oid, want_data, offset, length
                     )
                     waiter.complete(key, data, attrs, err)
                     continue
@@ -671,13 +904,13 @@ class OSD(Dispatcher):
                 conn.send(
                     messages.MOSDECSubOpRead(
                         pgid=str(pg), tid=tid, shard=key,
-                        reads=[{"oid": [oid, shard], "offset": 0, "length": -1,
-                                "want_data": want_data}],
+                        reads=[{"oid": [oid, shard], "offset": offset,
+                                "length": length, "want_data": want_data}],
                         attrs=True,
                     )
                 )
             try:
-                async with asyncio.timeout(SUBOP_TIMEOUT):
+                async with asyncio.timeout(self.subop_timeout):
                     await waiter.event.wait()
             except TimeoutError:
                 for shard in list(waiter.pending):
@@ -687,13 +920,16 @@ class OSD(Dispatcher):
             del self._read_waiters[tid]
 
     def _local_shard_read(
-        self, pg: PGid, shard: int, oid: str, want_data: bool = True
+        self, pg: PGid, shard: int, oid: str, want_data: bool = True,
+        offset: int = 0, length: int = -1,
     ) -> tuple[bytes, dict, int]:
         # shard -1 = replicated whole-object read from the PG collection
         cid = self._shard_cid(pg, shard) if shard >= 0 else CollectionId(str(pg))
         soid = ObjectId(oid, shard)
         try:
-            data = self.store.read(cid, soid) if want_data else b""
+            data = (
+                self.store.read(cid, soid, offset, length) if want_data else b""
+            )
             attrs = {
                 k: v.decode() for k, v in self.store.getattrs(cid, soid).items()
             }
@@ -709,7 +945,8 @@ class OSD(Dispatcher):
         oid, shard = rd["oid"]
         pg = PGid.parse(msg.pgid)
         data, attrs, err = self._local_shard_read(
-            pg, shard, oid, rd.get("want_data", True)
+            pg, shard, oid, rd.get("want_data", True),
+            rd.get("offset", 0), rd.get("length", -1),
         )
         conn.send(
             messages.MOSDECSubOpReadReply(
@@ -749,6 +986,28 @@ class OSD(Dispatcher):
                 off = op.get("offset", 0)
                 txn.write(cid, oid, off, data)
                 projected_size = max(projected_size, off + len(data))
+                mutates = True
+                log_op = "modify"
+                out.append({"rval": 0})
+            elif name == "append":
+                data = msg.blobs[op["data"]]
+                txn.write(cid, oid, projected_size, data)
+                projected_size += len(data)
+                mutates = True
+                log_op = "modify"
+                out.append({"rval": 0})
+            elif name == "truncate":
+                size = int(op.get("size", op.get("offset", 0)))
+                txn.truncate(cid, oid, size)
+                projected_size = size
+                mutates = True
+                log_op = "modify"
+                out.append({"rval": 0})
+            elif name == "zero":
+                off = int(op.get("offset", 0))
+                ln = int(op.get("length", 0))
+                txn.zero(cid, oid, off, ln)
+                projected_size = max(projected_size, off + ln)
                 mutates = True
                 log_op = "modify"
                 out.append({"rval": 0})
@@ -833,10 +1092,11 @@ class OSD(Dispatcher):
                     messages.MOSDRepOp(
                         pgid=str(pg), tid=tid, from_osd=self.osd_id,
                         txn=ops, log=[entry.to_dict()],
-                        at_version=entry.version.to_list(), blobs=blobs,
+                        at_version=entry.version.to_list(),
+                        epoch=self._epoch(), blobs=blobs,
                     )
                 )
-            async with asyncio.timeout(SUBOP_TIMEOUT):
+            async with asyncio.timeout(self.subop_timeout):
                 await waiter.event.wait()
         except TimeoutError:
             return -EIO
@@ -847,9 +1107,11 @@ class OSD(Dispatcher):
         return 0
 
     def _handle_rep_op(self, conn: Connection, msg: messages.MOSDRepOp) -> None:
-        txn = messages.decode_txn(msg.txn, msg.blobs)
-        entries = [PGLogEntry.from_dict(d) for d in msg.log]
-        r = self._apply_sub_write(txn, msg.pgid, -1, entries)
+        r = self._gate_subop(msg.pgid, msg.epoch, msg.from_osd)
+        if r == 0:
+            txn = messages.decode_txn(msg.txn, msg.blobs)
+            entries = [PGLogEntry.from_dict(d) for d in msg.log]
+            r = self._apply_sub_write(txn, msg.pgid, -1, entries)
         conn.send(
             messages.MOSDRepOpReply(
                 pgid=msg.pgid, tid=msg.tid, from_osd=self.osd_id, result=r

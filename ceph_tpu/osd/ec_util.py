@@ -176,6 +176,106 @@ def decode_concat(
     return np.ascontiguousarray(arr).tobytes()
 
 
+# -- StripeHashes ------------------------------------------------------------
+
+
+class StripeHashes:
+    """Per-(shard, stripe) crc32c table — the overwrite-safe HashInfo.
+
+    The reference's cumulative HashInfo only supports append
+    (reference:src/osd/ECUtil.h:109-167); its overwrite pools lean on
+    store-level block checksums instead. Here crc granularity is one
+    chunk (= one shard's slice of one stripe), so an RMW overwrite
+    updates exactly the affected stripes' entries and scrub/deep-scrub
+    can verify any shard at rest chunk-by-chunk
+    (check sites: read path and scrub, the analogs of
+    reference:src/osd/ECBackend.cc:994-1008 and :2313).
+
+    Persisted under the same xattr key the reference uses for HashInfo.
+    """
+
+    XATTR_KEY = "hinfo_key"
+
+    def __init__(self, num_shards: int, chunk_size: int):
+        self.chunk_size = chunk_size
+        self.crcs: list[list[int]] = [[] for _ in range(num_shards)]
+
+    @property
+    def num_shards(self) -> int:
+        return len(self.crcs)
+
+    def num_stripes(self) -> int:
+        return len(self.crcs[0]) if self.crcs else 0
+
+    @staticmethod
+    def _chunk_crcs(buf: np.ndarray, chunk_size: int) -> list[int]:
+        buf = np.asarray(buf, dtype=np.uint8)
+        if buf.size % chunk_size != 0:
+            raise ValueError(
+                f"shard buffer {buf.size} not a multiple of chunk {chunk_size}"
+            )
+        return [
+            int(native.crc32c(CRC_SEED, buf[o : o + chunk_size]))
+            for o in range(0, buf.size, chunk_size)
+        ]
+
+    def zero_crc(self) -> int:
+        return int(
+            native.crc32c(CRC_SEED, np.zeros(self.chunk_size, dtype=np.uint8))
+        )
+
+    def set_range(
+        self, first_stripe: int, shard_bufs: Mapping[int, np.ndarray]
+    ) -> None:
+        """Install crcs for the stripes covered by ``shard_bufs`` (each a
+        whole number of chunks starting at stripe ``first_stripe``).
+        Holes below ``first_stripe`` (write past the old end) are chunks
+        the store zero-fills, so they get the zero-chunk crc."""
+        if sorted(shard_bufs) != list(range(self.num_shards)):
+            raise ValueError(
+                f"set_range covers shards {sorted(shard_bufs)}, "
+                f"table tracks 0..{self.num_shards - 1}"
+            )
+        zc = self.zero_crc()
+        for shard, buf in shard_bufs.items():
+            row = self.crcs[shard]
+            new = self._chunk_crcs(np.asarray(buf), self.chunk_size)
+            if len(row) < first_stripe:
+                row.extend([zc] * (first_stripe - len(row)))
+            row[first_stripe : first_stripe + len(new)] = new
+
+    def truncate_stripes(self, count: int) -> None:
+        """Drop entries past ``count`` stripes; zero-extend up to it."""
+        zc = self.zero_crc()
+        for row in self.crcs:
+            if len(row) > count:
+                del row[count:]
+            else:
+                row.extend([zc] * (count - len(row)))
+
+    def crc(self, shard: int, stripe: int) -> int:
+        return self.crcs[shard][stripe]
+
+    def verify(self, shard: int, first_stripe: int, buf: np.ndarray) -> bool:
+        """Check a shard extent (whole chunks from ``first_stripe``)."""
+        got = self._chunk_crcs(np.asarray(buf), self.chunk_size)
+        row = self.crcs[shard]
+        want = row[first_stripe : first_stripe + len(got)]
+        if len(want) < len(got):
+            # extent extends past the table: valid only if all-zero chunks
+            want = want + [self.zero_crc()] * (len(got) - len(want))
+        return got == want
+
+    def to_dict(self) -> dict:
+        return {"chunk_size": self.chunk_size, "crcs": [list(r) for r in self.crcs]}
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "StripeHashes":
+        sh = cls(len(d["crcs"]), int(d["chunk_size"]))
+        sh.crcs = [[int(c) for c in row] for row in d["crcs"]]
+        return sh
+
+
 # -- HashInfo ----------------------------------------------------------------
 
 

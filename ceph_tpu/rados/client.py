@@ -104,11 +104,17 @@ class RadosClient(Dispatcher):
         tid = next(self._tid)
         fut = asyncio.get_running_loop().create_future()
         self._op_futs[tid] = fut
-        conn = await self.messenger.connect(self.mon_addr, "mon.0")
-        self._fut_conns[tid] = conn
-        conn.send(messages.MMonCommand(tid=tid, cmd=cmd))
-        async with asyncio.timeout(self.op_timeout):
-            reply = await fut
+        try:
+            conn = await self.messenger.connect(self.mon_addr, "mon.0")
+            self._fut_conns[tid] = conn
+            conn.send(messages.MMonCommand(tid=tid, cmd=cmd))
+            async with asyncio.timeout(self.op_timeout):
+                reply = await fut
+        finally:
+            # a timeout/error must not leak the tid (ADVICE r1: operate()
+            # cleans up in its except clause; command() must too)
+            self._op_futs.pop(tid, None)
+            self._fut_conns.pop(tid, None)
         return reply.code, reply.status, reply.out
 
     # -- pools
@@ -206,6 +212,29 @@ class IoCtx:
         )
         if reply.result < 0:
             raise RadosError(reply.result, f"write {oid}")
+
+    async def append(self, oid: str, data: bytes) -> None:
+        reply = await self.client.operate(
+            self.pool_name, oid,
+            [{"op": "append", "data": 0}], [bytes(data)],
+        )
+        if reply.result < 0:
+            raise RadosError(reply.result, f"append {oid}")
+
+    async def truncate(self, oid: str, size: int) -> None:
+        reply = await self.client.operate(
+            self.pool_name, oid, [{"op": "truncate", "size": size}], []
+        )
+        if reply.result < 0:
+            raise RadosError(reply.result, f"truncate {oid}")
+
+    async def zero(self, oid: str, offset: int, length: int) -> None:
+        reply = await self.client.operate(
+            self.pool_name, oid,
+            [{"op": "zero", "offset": offset, "length": length}], [],
+        )
+        if reply.result < 0:
+            raise RadosError(reply.result, f"zero {oid}")
 
     async def read(self, oid: str, offset: int = 0, length: int = 0) -> bytes:
         reply = await self.client.operate(

@@ -91,7 +91,7 @@ class MemStore(ObjectStore):
                         snap_coll(op[1])
                     else:
                         snap_obj(op[1], op[2])
-                        if name == "clone":
+                        if name in ("clone", "try_stash", "stash_restore"):
                             snap_obj(op[1], op[3])
                     self._apply_op(op)
             except Exception:
@@ -167,6 +167,21 @@ class MemStore(ObjectStore):
             (_, cid, src, dst) = op
             obj = self._obj(cid, src, create=False)
             self._obj(cid, dst, create=True).clone_from(obj)
+        elif name == "try_stash":
+            (_, cid, src, dst) = op
+            coll = self._coll(cid)
+            obj = coll.get(src)
+            if obj is not None:
+                self._obj(cid, dst, create=True).clone_from(obj)
+        elif name == "stash_restore":
+            (_, cid, stash, dst) = op
+            coll = self._coll(cid)
+            obj = coll.get(stash)
+            if obj is not None:
+                self._obj(cid, dst, create=True).clone_from(obj)
+                coll.pop(stash, None)
+            else:
+                coll.pop(dst, None)
         elif name == "setattr":
             (_, cid, oid, key, value) = op
             self._obj(cid, oid, create=True).xattrs[key] = value

@@ -104,6 +104,27 @@ class Transaction:
         self.ops.append(("clone", cid, src, dst))
         return self
 
+    # -- rollback stashes (EC overwrite safety)
+    def try_stash(
+        self, cid: CollectionId, src: ObjectId, stash: ObjectId
+    ) -> "Transaction":
+        """Clone ``src`` (data+attrs+omap) to ``stash`` iff it exists,
+        else no-op. The EC write path stashes the pre-write object in the
+        same transaction as the overwrite so an interrupted fan-out can
+        roll back (the role of the reference's pg-log rollback info,
+        reference:doc/dev/osd_internals/erasure_coding/ecbackend.rst)."""
+        self.ops.append(("try_stash", cid, src, stash))
+        return self
+
+    def stash_restore(
+        self, cid: CollectionId, stash: ObjectId, dst: ObjectId
+    ) -> "Transaction":
+        """Undo a stashed mutation: if ``stash`` exists, restore it over
+        ``dst`` and drop the stash; if not (the mutation created the
+        object), remove ``dst``."""
+        self.ops.append(("stash_restore", cid, stash, dst))
+        return self
+
     # -- xattrs
     def setattr(
         self, cid: CollectionId, oid: ObjectId, key: str, value: bytes

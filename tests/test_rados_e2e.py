@@ -11,7 +11,8 @@ import json
 
 import pytest
 
-from ceph_tpu.osd.ec_util import HashInfo
+import numpy as np
+from ceph_tpu.osd.ec_util import StripeHashes
 from ceph_tpu.rados import MiniCluster, RadosError
 from ceph_tpu.store import CollectionId, ObjectId
 
@@ -88,7 +89,7 @@ def test_ec_put_get_roundtrip_default_profile():
 
 
 def test_ec_chunks_land_on_positional_shards():
-    """Shard i of the acting set stores chunk i with a valid HashInfo."""
+    """Shard i of the acting set stores chunk i with a valid crc table."""
 
     async def main():
         async with MiniCluster(n_osds=4) as cluster:
@@ -106,14 +107,19 @@ def test_ec_chunks_land_on_positional_shards():
                 soid = ObjectId("obj", shard)
                 chunk = store.read(cid, soid)
                 seen_sizes.add(len(chunk))
-                hinfo = HashInfo.from_dict(
-                    json.loads(store.getattr(cid, soid, HashInfo.XATTR_KEY))
+                hashes = StripeHashes.from_dict(
+                    json.loads(store.getattr(cid, soid, StripeHashes.XATTR_KEY))
                 )
-                assert hinfo.get_total_chunk_size() == len(chunk)
+                assert hashes.verify(
+                    shard, 0, np.frombuffer(chunk, dtype=np.uint8)
+                )
                 # pg log entry rode in the same transaction
                 omap = store.omap_get(cid, ObjectId("_pgmeta_", shard))
-                assert len(omap) == 1
-                (entry,) = [json.loads(v) for v in omap.values()]
+                entries = [
+                    json.loads(v) for k, v in omap.items() if "." in k
+                ]
+                assert len(entries) == 1
+                (entry,) = entries
                 assert entry["oid"] == "obj" and entry["op"] == "modify"
             assert len(seen_sizes) == 1  # equal chunk sizes
 
