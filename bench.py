@@ -158,14 +158,23 @@ def bench_device(batch: int, quick: bool, deadline: float | None,
     log("child: parity bytes match native engine")
 
     def chained(fn):
+        """Each iteration XOR-folds EVERY output row back into the input:
+        a real data dependency between iterations (nothing can be skipped
+        or overlapped), and no row's doubling/XOR chain can be dead-code-
+        eliminated from the timed graph (code-review r2 finding:
+        out[0]-only feedback measured ~1/m of the encode work).  The
+        feedback adds one input-sized write per iteration, so the reported
+        rate slightly UNDERestimates the bare kernel — acceptable, it's
+        conservative."""
         def make(T):
             @jax.jit
             def run(v):
                 def body(c, _):
                     out = fn(c)
-                    # feed one output row back into the input: a real data
-                    # dependency between iterations, shape-preserving
-                    return c ^ jnp.broadcast_to(out[0], c.shape), ()
+                    folded = out[0]
+                    for i in range(1, out.shape[0]):
+                        folded = folded ^ out[i]
+                    return c ^ jnp.broadcast_to(folded, c.shape), ()
                 c, _ = lax.scan(body, v, None, length=T)
                 return c
             return run

@@ -44,6 +44,7 @@ from ..models import registry
 from ..msg import AsyncMessenger, Connection, Dispatcher, messages
 from ..msg.message import Message
 from ..store import CollectionId, MemStore, ObjectId, ObjectStore, Transaction
+from ..store.objectstore import NeedsMkfs
 from . import ec_transaction, ec_util
 from .ec_util import StripeHashes, StripeInfo
 from .osdmap import CRUSH_ITEM_NONE, OSDMap, PGid, Pool, POOL_TYPE_ERASURE
@@ -182,7 +183,9 @@ class OSD(Dispatcher):
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
         try:
             self.store.mount()
-        except Exception:
+        except NeedsMkfs:
+            # only a never-formatted store: any OTHER mount failure on a
+            # durable store must NOT be answered by formatting it
             self.store.mkfs()
             self.store.mount()
         self.addr = await self.messenger.bind(host, port)
@@ -197,7 +200,10 @@ class OSD(Dispatcher):
         self.recovery.kick()  # reconcile whatever the map says we lead
         return self.addr
 
-    async def stop(self) -> None:
+    async def stop(self, umount: bool = True) -> None:
+        """``umount=False`` models a hard crash: the store is abandoned
+        without a clean shutdown, so a durable backend must recover from
+        its journal alone on the next mount."""
         self._stopping = True
         self.recovery.stop()
         if self._hb_task:
@@ -205,7 +211,8 @@ class OSD(Dispatcher):
         for t in list(self._tasks):
             t.cancel()
         await self.messenger.shutdown()
-        self.store.umount()
+        if umount:
+            self.store.umount()
 
     # -- dispatch ------------------------------------------------------------
 

@@ -5,8 +5,15 @@ TPU-native re-expression of the reference's ObjectStore layer
 store with byte extents, xattrs, and omap, consumed by the OSD data path.
 """
 
-from .objectstore import ObjectId, CollectionId, ObjectStore, Transaction
+from .objectstore import (
+    CollectionId,
+    NeedsMkfs,
+    ObjectId,
+    ObjectStore,
+    Transaction,
+)
 from .memstore import MemStore
+from .wal import CrashPoint, WalStore
 
 __all__ = [
     "ObjectId",
@@ -14,4 +21,7 @@ __all__ = [
     "ObjectStore",
     "Transaction",
     "MemStore",
+    "WalStore",
+    "CrashPoint",
+    "NeedsMkfs",
 ]

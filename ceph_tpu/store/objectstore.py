@@ -28,6 +28,12 @@ from typing import Callable, Iterable, Mapping, Sequence
 NO_SHARD = -1  # shard_id_t::NO_SHARD — replicated pools / whole objects
 
 
+class NeedsMkfs(RuntimeError):
+    """mount() on a store that was never mkfs'd — the ONE mount failure a
+    daemon may answer with mkfs(); anything else (corruption, I/O errors)
+    must propagate rather than be 'fixed' by formatting."""
+
+
 @dataclasses.dataclass(frozen=True, order=True)
 class ObjectId:
     """Object name within a collection (hobject_t essentials)."""
