@@ -253,6 +253,27 @@ class MOSDRepOpReply(Message):
     FIELDS = ("pgid", "tid", "from_osd", "result")
 
 
+# -- scrub -------------------------------------------------------------------
+
+
+@register
+class MOSDScrub(Message):
+    """Operator -> PG primary: deep-scrub (and optionally repair) one PG
+    (the `ceph pg deep-scrub` command path, reference:src/messages/
+    MOSDScrub.h; engine analog reference:src/osd/ECBackend.cc:2313)."""
+
+    TYPE = "osd_scrub"
+    FIELDS = ("tid", "pgid", "repair")
+
+
+@register
+class MOSDScrubReply(Message):
+    """``report`` = {"pg", "objects", "errors": [...], "repaired", "clean"}."""
+
+    TYPE = "osd_scrub_reply"
+    FIELDS = ("tid", "result", "report")
+
+
 # -- recovery ----------------------------------------------------------------
 
 

@@ -151,6 +151,7 @@ class OSD(Dispatcher):
         heartbeat_interval: float = 0.0,
         heartbeat_grace: float = 3.0,
         subop_timeout: float = SUBOP_TIMEOUT,
+        scrub_interval: float = 0.0,
     ):
         self.osd_id = osd_id
         self.name = f"osd.{osd_id}"
@@ -175,8 +176,10 @@ class OSD(Dispatcher):
         self._map_event = asyncio.Event()
         self._stopping = False
         from .recovery import RecoveryManager
+        from .scrub import ScrubManager
 
         self.recovery = RecoveryManager(self)
+        self.scrub = ScrubManager(self, interval=scrub_interval)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -198,6 +201,7 @@ class OSD(Dispatcher):
             self._hb_task = asyncio.ensure_future(self._heartbeat_loop())
         self.recovery.start()
         self.recovery.kick()  # reconcile whatever the map says we lead
+        self.scrub.start()
         return self.addr
 
     async def stop(self, umount: bool = True) -> None:
@@ -206,6 +210,7 @@ class OSD(Dispatcher):
         its journal alone on the next mount."""
         self._stopping = True
         self.recovery.stop()
+        self.scrub.stop()
         if self._hb_task:
             self._hb_task.cancel()
         for t in list(self._tasks):
@@ -245,6 +250,10 @@ class OSD(Dispatcher):
             w = self._write_waiters.get(msg.tid)
             if w:
                 w.complete(msg.from_osd, msg.result)
+        elif isinstance(msg, messages.MOSDScrub):
+            t = asyncio.ensure_future(self._handle_scrub(conn, msg))
+            self._tasks.add(t)
+            t.add_done_callback(self._tasks.discard)
         elif isinstance(msg, messages.MOSDPGScan):
             self.recovery.handle_scan(conn, msg)
         elif isinstance(msg, messages.MOSDPGScanReply):
@@ -341,6 +350,37 @@ class OSD(Dispatcher):
         if pool.type == POOL_TYPE_ERASURE:
             return await self._ec_execute(pg, pool, acting, msg)
         return await self._rep_execute(pg, pool, acting, msg)
+
+    async def _handle_scrub(self, conn: Connection, msg) -> None:
+        """Operator-commanded deep scrub of one PG (the `ceph pg scrub`
+        analog; engine in scrub.py, reference:src/osd/ECBackend.cc:2313)."""
+        try:
+            pg = PGid.parse(msg.pgid)
+            if self.osdmap is None:
+                raise RuntimeError("no map")
+            pool = self.osdmap.pools.get(pg.pool)
+            if pool is None:
+                raise RuntimeError(f"no pool {pg.pool}")
+            _up, _upp, acting, primary = self.osdmap.pg_to_up_acting_osds(pg)
+            if primary != self.osd_id:
+                conn.send(messages.MOSDScrubReply(
+                    tid=msg.tid, result=-EAGAIN,
+                    report={"error": "not primary", "primary": primary},
+                ))
+                return
+            report = await self.scrub.scrub_pg(
+                pg, pool, acting, repair=bool(msg.repair)
+            )
+            conn.send(messages.MOSDScrubReply(
+                tid=msg.tid, result=0, report=report,
+            ))
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            logger.exception("%s: scrub of %s failed", self.name, msg.pgid)
+            conn.send(messages.MOSDScrubReply(
+                tid=msg.tid, result=-EIO, report={"error": str(e)},
+            ))
 
     # ======================= EC backend =====================================
 

@@ -73,7 +73,14 @@ class RadosClient(Dispatcher):
                     if not fut.done():
                         fut.set_result(None)
                 self._map_waiters.clear()
-        elif isinstance(msg, (messages.MOSDOpReply, messages.MMonCommandReply)):
+        elif isinstance(
+            msg,
+            (
+                messages.MOSDOpReply,
+                messages.MMonCommandReply,
+                messages.MOSDScrubReply,
+            ),
+        ):
             fut = self._op_futs.pop(msg.tid, None)
             self._fut_conns.pop(msg.tid, None)
             if fut is not None and not fut.done():
@@ -188,6 +195,58 @@ class RadosClient(Dispatcher):
             return reply
         raise RadosError(-EAGAIN, f"op to {pool_name}/{oid} exhausted retries"
                          ) from last_err
+
+    # -- scrub (the `ceph pg deep-scrub` / `rados scrub` surface)
+    async def scrub_pool(
+        self, pool_name: str, repair: bool = True
+    ) -> list[dict]:
+        """Deep-scrub every PG of a pool at its primary; returns the
+        per-PG scrub reports (engine: ceph_tpu/osd/scrub.py, analog of
+        reference:src/osd/ECBackend.cc:2313 be_deep_scrub)."""
+        pool = self.osdmap.lookup_pool(pool_name) if self.osdmap else None
+        if pool is None:
+            raise RadosError(-ENOENT, f"no pool {pool_name!r}")
+        # a PG deep scrub reads every shard of every object: it needs a far
+        # larger deadline than one object op (and a timed-out scrub keeps
+        # running server-side — re-sending would queue duplicate scrubs)
+        scrub_timeout = max(self.op_timeout * 6, 60.0)
+        reports = []
+        for pg in self.osdmap.pgs_of_pool(pool.id):
+            for attempt in range(self.max_retries):
+                epoch = self.osdmap.epoch
+                _up, _upp, _acting, primary = self.osdmap.pg_to_up_acting_osds(pg)
+                addr = self.osdmap.get_addr(primary) if primary >= 0 else None
+                if primary < 0 or not addr:
+                    await self._wait_for_map_change(epoch, self.op_timeout)
+                    continue
+                tid = next(self._tid)
+                fut = asyncio.get_running_loop().create_future()
+                self._op_futs[tid] = fut
+                try:
+                    conn = await self.messenger.connect(addr, f"osd.{primary}")
+                    self._fut_conns[tid] = conn
+                    conn.send(messages.MOSDScrub(
+                        tid=tid, pgid=str(pg), repair=repair,
+                    ))
+                    async with asyncio.timeout(scrub_timeout):
+                        reply = await fut
+                except (ConnectionError, OSError, TimeoutError):
+                    self._op_futs.pop(tid, None)
+                    self._fut_conns.pop(tid, None)
+                    await self._wait_for_map_change(epoch, 2.0)
+                    continue
+                if reply.result == -EAGAIN:
+                    await self._wait_for_map_change(epoch, self.op_timeout)
+                    continue
+                if reply.result < 0:
+                    raise RadosError(reply.result, str(reply.report))
+                reports.append(reply.report)
+                break
+            else:
+                raise RadosError(
+                    -EAGAIN, f"scrub of {pg} exhausted retries"
+                )
+        return reports
 
 
 class IoCtx:
