@@ -54,13 +54,24 @@ from .map import (
     CrushMap,
 )
 
-jax.config.update("jax_enable_x64", True)  # straw2 draws are signed 64-bit
-
 _SEED = 1315423911  # CRUSH_HASH_SEED
-_S64_MIN = jnp.int64(-(1 << 63))
+_S64_MIN_PY = -(1 << 63)
 
-_RH_LH = jnp.asarray(np.array(ln_tables.RH_LH_TBL, dtype=np.int64))
-_LL = jnp.asarray(np.array(ln_tables.LL_TBL, dtype=np.int64))
+
+@functools.lru_cache(maxsize=1)
+def _ln_tables_dev():
+    """int64 ln tables, created lazily under a scoped x64 context.
+
+    The exact-draw path needs signed-64 fixed point; flipping
+    ``jax_enable_x64`` globally at import time silently changed dtype
+    behavior of unrelated JAX code in the process (advisor r1 finding) —
+    so x64 is scoped to the exact kernels instead, and the hot approx
+    path stays 32-bit/f32 and needs no x64 at all."""
+    with jax.enable_x64():
+        return (
+            jnp.asarray(np.array(ln_tables.RH_LH_TBL, dtype=np.int64)),
+            jnp.asarray(np.array(ln_tables.LL_TBL, dtype=np.int64)),
+        )
 
 # SET_* steps that are no-ops for a flat (non-chooseleaf) rule
 _LEAF_ONLY_SET_OPS = (
@@ -129,22 +140,25 @@ def _bit_length_16(x):
 def crush_ln(xin):
     """Batched fixed-point 2^44*log2(x+1) (reference:mapper.c:248).
 
-    ``xin`` int64 lanes in [0, 0xffff].
+    ``xin`` int64 lanes in [0, 0xffff].  Runs under a scoped x64 context
+    (signed-64 fixed point); the hot approx path never calls this.
     """
-    x = xin + 1  # 1..0x10000
-    norm = (x & 0x18000) == 0
-    bits = jnp.where(norm, 16 - _bit_length_16(x), 0)
-    x = x << bits
-    iexpon = 15 - bits
-    index1 = (x >> 8) << 1
-    rh = jnp.take(_RH_LH, index1 - 256)
-    lh = jnp.take(_RH_LH, index1 + 1 - 256)
-    # (x * rh) >> 48 exactly, without 65-bit overflow: rh = hi*2^24 + lo
-    rh_hi = rh >> 24
-    rh_lo = rh & 0xFFFFFF
-    xl64 = (x * rh_hi + ((x * rh_lo) >> 24)) >> 24
-    lh = lh + jnp.take(_LL, xl64 & 0xFF)
-    return (iexpon << 44) + (lh >> 4)
+    with jax.enable_x64():
+        rh_lh, ll = _ln_tables_dev()
+        x = jnp.asarray(xin, jnp.int64) + 1  # 1..0x10000
+        norm = (x & 0x18000) == 0
+        bits = jnp.where(norm, 16 - _bit_length_16(x), 0)
+        x = x << bits
+        iexpon = 15 - bits
+        index1 = (x >> 8) << 1
+        rh = jnp.take(rh_lh, index1 - 256)
+        lh = jnp.take(rh_lh, index1 + 1 - 256)
+        # (x * rh) >> 48 exactly, without 65-bit overflow: rh = hi*2^24+lo
+        rh_hi = rh >> 24
+        rh_lo = rh & 0xFFFFFF
+        xl64 = (x * rh_hi + ((x * rh_lo) >> 24)) >> 24
+        lh = lh + jnp.take(ll, xl64 & 0xFF)
+        return (iexpon << 44) + (lh >> 4)
 
 
 def straw2_choose(x, items, weights, r):
@@ -159,23 +173,32 @@ def straw2_choose(x, items, weights, r):
     """
     n = items.shape[0]
 
-    def draw_for(i):
-        u = (hash32_3(x, items[i], r) & jnp.uint32(0xFFFF)).astype(jnp.int64)
-        ln = crush_ln(u) - (1 << 48)
-        # div64_s64 truncates toward zero; ln <= 0 so negate-divide
-        return jnp.where(
-            weights[i] > 0, -((-ln) // jnp.maximum(weights[i], 1)), _S64_MIN
-        )
+    with jax.enable_x64():
+        s64_min = jnp.int64(_S64_MIN_PY)
 
-    def body(i, carry):
-        high, high_draw = carry
-        d = draw_for(i)
-        better = d > high_draw
-        return jnp.where(better, items[i], high), jnp.where(better, d, high_draw)
+        def draw_for(i):
+            u = (hash32_3(x, items[i], r) & jnp.uint32(0xFFFF)).astype(
+                jnp.int64
+            )
+            ln = crush_ln(u) - (1 << 48)
+            # div64_s64 truncates toward zero; ln <= 0 so negate-divide
+            return jnp.where(
+                weights[i] > 0, -((-ln) // jnp.maximum(weights[i], 1)),
+                s64_min,
+            )
 
-    init = (jnp.full_like(x, items[0], dtype=jnp.int32), draw_for(0))
-    high, _ = jax.lax.fori_loop(1, n, body, init)
-    return high
+        def body(i, carry):
+            high, high_draw = carry
+            d = draw_for(i)
+            better = d > high_draw
+            return (
+                jnp.where(better, items[i], high),
+                jnp.where(better, d, high_draw),
+            )
+
+        init = (jnp.full_like(x, items[0], dtype=jnp.int32), draw_for(0))
+        high, _ = jax.lax.fori_loop(1, n, body, init)
+        return high
 
 
 # -- gather-free approximate straw2 with exact-fallback flags ----------------
@@ -197,24 +220,53 @@ def straw2_choose(x, items, weights, r):
 
 
 def _host_q_exact(w: int) -> np.ndarray:
-    """q(u, w) for all u (exact, host)."""
-    from .mapper import crush_ln as ln_scalar
-
-    ln = np.array([ln_scalar(u) for u in range(0x10000)], dtype=np.int64)
-    return ((1 << 48) - ln) // np.int64(w)
+    """q(u, w) for all u (exact, host; vectorized — a per-weight scalar
+    crush_ln loop cost seconds per distinct weight on big hierarchies)."""
+    return ((1 << 48) - _np_ln_all()) // np.int64(w)
 
 
-@functools.lru_cache(maxsize=64)
-def _error_budget(w: int) -> float:
-    """Sound |qa - q| bound for one weight, measured over every u."""
-    u = np.arange(0x10000, dtype=np.float32)
-    t = np.float32(16.0) - np.log2(u + np.float32(1.0), dtype=np.float32)
-    qa = t * np.float32((1 << 44) / w)
-    err = np.abs(qa.astype(np.float64) - _host_q_exact(w).astype(np.float64))
-    # +2: quotient-floor slop; *1.01 + 64: margin for XLA log2 differing
-    # from numpy libm by a few ulp (validated end-to-end by the bit-exact
-    # tests, which fail loudly if this margin is ever too thin)
-    return float(err.max() * 1.01 + 2.0 + 64.0)
+@functools.lru_cache(maxsize=1)
+def _qa_kernel():
+    """The jitted qa(u) kernel used ONLY for budget measurement — the
+    same expression the runtime choose kernels compute.  ``u`` is a
+    RUNTIME argument: closing over it as a constant let XLA constant-fold
+    the log2 on the host evaluator (code-review r2: verified via HLO), so
+    the measurement never touched the device's actual log2."""
+
+    @jax.jit
+    def qa(u, inv_w):
+        t = jnp.float32(16.0) - jnp.log2(u + jnp.float32(1.0))
+        return t * inv_w
+
+    return qa
+
+
+@functools.lru_cache(maxsize=1)
+def _u_all_dev():
+    return jnp.asarray(np.arange(0x10000, dtype=np.float32))
+
+
+@functools.lru_cache(maxsize=4096)
+def measured_error_budget(w: int) -> float:
+    """|qa - q| bound for one weight, measured over every u WITH THE
+    RUNTIME XLA KERNEL on the active backend (advisor r1: a numpy-libm
+    measurement could under-bound a backend whose log2 rounds
+    differently).  The margin on top of the measured max covers the
+    quotient floor (+2) plus a cushion for fusion-context rounding
+    differences between this standalone kernel and the fused choose
+    kernels (1% + 16 ulp-scale slack — the bit-exact tests fail loudly
+    if it is ever too thin)."""
+    if w <= 0:
+        return 0.0
+    qa = np.asarray(
+        _qa_kernel()(_u_all_dev(), jnp.float32((1 << 44) / w)),
+        dtype=np.float64,
+    )
+    err = np.abs(qa - _host_q_exact(w).astype(np.float64))
+    return float(err.max() * 1.01 + 2.0 + 16.0)
+
+
+_error_budget = measured_error_budget  # flat-path call sites
 
 
 def straw2_choose_approx(x, items, inv_weights, err_budgets, ebmax, r):
@@ -532,7 +584,18 @@ def np_choose_indep(xs, items, weights, reweight, numrep, out_size, tries):
 
 
 def supports(cmap: CrushMap, ruleno: int) -> bool:
-    """True if vec_do_rule handles this (map, rule) bit-exactly."""
+    """True if vec_do_rule handles this (map, rule) bit-exactly — either
+    the flat fast path here or the hierarchical engine
+    (mapper_jax_hier.py, chooseleaf included)."""
+    if _supports_flat(cmap, ruleno):
+        return True
+    from .mapper_jax_hier import supports_hier
+
+    return supports_hier(cmap, ruleno)
+
+
+def _supports_flat(cmap: CrushMap, ruleno: int) -> bool:
+    """The single-level straw2 shape the flat kernels handle."""
     t = cmap.tunables
     if t.choose_local_tries != 0 or t.choose_local_fallback_tries != 0:
         return False
@@ -570,24 +633,89 @@ def supports(cmap: CrushMap, ruleno: int) -> bool:
     return all(i >= 0 for i in bucket.items)
 
 
-def vec_do_rule(
+def vec_rule_stats(
     cmap: CrushMap,
     ruleno: int,
     xs,
     result_max: int,
     weight=None,
-) -> np.ndarray:
-    """Batched crush_do_rule over ``xs`` (reference:mapper.c:854 x-loop
-    collapsed to one device program).
+) -> tuple[dict[int, int], int]:
+    """Bulk-sim statistics computed ON DEVICE: ({item: count}, bad_mappings).
 
-    Returns [X, numrep] int32 (CRUSH_ITEM_NONE holes); bit-identical to
-    the scalar mapper for supported maps (check with :func:`supports`).
-    """
-    if not supports(cmap, ruleno):
+    The CrushTester path: for 10^6 x a full [X, W] host fetch dwarfs the
+    compute (the tunneled d2h moves ~6 MiB/s), so placements are
+    bincounted on device and only the counts + ambiguity flags come
+    back; flagged lanes are re-run on the scalar oracle and the counts
+    patched. Identical numbers to counting vec_do_rule's output."""
+    from .mapper_jax_hier import supports_hier
+
+    xs_np = np.asarray(xs, dtype=np.uint32)
+    w_arr = weight if weight is not None else cmap.get_weights()
+    if _supports_flat(cmap, ruleno):
+        eng = _flat_engine(cmap, ruleno, xs_np, result_max, weight)
+        if eng is None:
+            return {}, 0
+        out_dev, amb_dev, p = eng
+
+        def exact_fn(sub_xs):
+            np_fn = np_choose_firstn if p["firstn"] else np_choose_indep
+            return np_fn(
+                sub_xs, p["items"], p["item_ws"],
+                np.array(w_arr, dtype=np.int32),
+                int(p["numrep"]), int(p["out_size"]), int(p["tries"]),
+            )
+    elif supports_hier(cmap, ruleno):
+        from .mapper_jax_hier import _hier_engine, np_do_rule_hier
+
+        eng = _hier_engine(cmap, ruleno, xs_np, result_max, weight)
+        if eng is None:
+            return {}, 0
+        out_dev, amb_dev = eng
+
+        def exact_fn(sub_xs):
+            return np_do_rule_hier(cmap, ruleno, sub_xs, result_max, weight)
+    else:
         raise ValueError("map/rule shape not supported by the vectorized path")
+
+    width = out_dev.shape[1]
+    # item ids span [-max_buckets, max_devices): shift into bincount range
+    offset = max(1, cmap.max_buckets)
+    length = offset + cmap.max_devices
+    flat = out_dev.ravel()
+    mask = flat != CRUSH_ITEM_NONE
+    counts_dev = jnp.bincount(
+        jnp.where(mask, flat + offset, 0),
+        weights=mask.astype(jnp.int32),
+        length=length,
+    )
+    placed = (out_dev != CRUSH_ITEM_NONE).sum(axis=1)
+    bad_dev = (placed < width).sum()
+    counts = np.asarray(counts_dev).astype(np.int64)
+    bad = int(bad_dev)
+    amb = np.asarray(amb_dev)
+    if amb.any():
+        flagged = np.nonzero(amb)[0]
+        rows = np.asarray(
+            jnp.take(out_dev, jnp.asarray(flagged), axis=0)
+        )  # small: only the flagged subset crosses the tunnel
+        exact = exact_fn(xs_np[flagged].astype(np.uint32))
+        for old, new in ((rows, -1), (exact, +1)):
+            filled = old != CRUSH_ITEM_NONE
+            vals, cnts = np.unique(old[filled], return_counts=True)
+            for v, c in zip(vals, cnts):
+                counts[int(v) + offset] += new * int(c)
+            bad += new * int((filled.sum(axis=1) < width).sum())
+    return (
+        {int(i) - offset: int(c) for i, c in enumerate(counts) if c},
+        bad,
+    )
+
+
+def _flat_engine(cmap, ruleno, xs_np, result_max, weight):
+    """Run the flat choose kernels; (out_dev, amb_dev) or None (empty)."""
     rule = cmap.rules[ruleno]
     t = cmap.tunables
-    tries = t.choose_total_tries + 1  # off-by-one compat (mapper.c:875)
+    tries = t.choose_total_tries + 1
     take_bucket = None
     numrep = result_max
     firstn = True
@@ -600,12 +728,10 @@ def vec_do_rule(
             firstn = s.op == CRUSH_RULE_CHOOSE_FIRSTN
             numrep = s.arg1 if s.arg1 > 0 else s.arg1 + result_max
     if numrep <= 0:
-        return np.zeros((len(np.asarray(xs)), 0), dtype=np.int32)
+        return None
     out_size = min(numrep, result_max)
     if weight is None:
         weight = cmap.get_weights()
-
-    xs_np = np.asarray(xs, dtype=np.uint32)
     item_ws = list(take_bucket.item_weights)
     inv_w = np.array(
         [(1 << 44) / w if w > 0 else 0.0 for w in item_ws], dtype=np.float32
@@ -615,9 +741,8 @@ def vec_do_rule(
         dtype=np.float32,
     )
     ebmax = np.float32(budgets.max() if budgets.size else 0.0)
-
     fn = choose_firstn if firstn else choose_indep
-    out, ambiguous = fn(
+    out_dev, amb_dev = fn(
         jnp.asarray(xs_np),
         jnp.asarray(np.array(take_bucket.items, dtype=np.int32)),
         jnp.asarray(inv_w),
@@ -626,6 +751,42 @@ def vec_do_rule(
         jnp.asarray(np.array(weight, dtype=np.int32)),
         numrep=int(numrep), out_size=int(out_size), tries=int(tries),
     )
+    params = {
+        "firstn": firstn, "numrep": numrep, "out_size": out_size,
+        "tries": tries, "items": list(take_bucket.items),
+        "item_ws": item_ws,
+    }
+    return out_dev, amb_dev, params
+
+
+def vec_do_rule(
+    cmap: CrushMap,
+    ruleno: int,
+    xs,
+    result_max: int,
+    weight=None,
+) -> np.ndarray:
+    """Batched crush_do_rule over ``xs`` (reference:mapper.c:854 x-loop
+    collapsed to one device program).
+
+    Returns [X, numrep] int32 (CRUSH_ITEM_NONE holes); bit-identical to
+    the scalar mapper for supported maps (check with :func:`supports`).
+    Hierarchical maps (chooseleaf included) route to the multi-level
+    engine in mapper_jax_hier.py.
+    """
+    if not _supports_flat(cmap, ruleno):
+        from .mapper_jax_hier import supports_hier, vec_do_rule_hier
+
+        if supports_hier(cmap, ruleno):
+            return vec_do_rule_hier(cmap, ruleno, xs, result_max, weight)
+        raise ValueError("map/rule shape not supported by the vectorized path")
+    if weight is None:
+        weight = cmap.get_weights()
+    xs_np = np.asarray(xs, dtype=np.uint32)
+    eng = _flat_engine(cmap, ruleno, xs_np, result_max, weight)
+    if eng is None:
+        return np.zeros((len(xs_np), 0), dtype=np.int32)
+    out, ambiguous, p = eng
     out = np.array(out)  # writable host copy (fallback splices below)
     ambiguous = np.asarray(ambiguous)
     # exact-resolution fallback: lanes whose straw2 runner-up fell inside
@@ -634,13 +795,13 @@ def vec_do_rule(
     # proportional to the (small) flagged fraction
     if ambiguous.any():
         flagged = np.nonzero(ambiguous)[0]
-        np_fn = np_choose_firstn if firstn else np_choose_indep
+        np_fn = np_choose_firstn if p["firstn"] else np_choose_indep
         exact = np_fn(
             xs_np[flagged].astype(np.uint32),
-            list(take_bucket.items),
-            item_ws,
+            p["items"],
+            p["item_ws"],
             np.array(weight, dtype=np.int32),
-            int(numrep), int(out_size), int(tries),
+            int(p["numrep"]), int(p["out_size"]), int(p["tries"]),
         )
         out[flagged] = exact
     return out

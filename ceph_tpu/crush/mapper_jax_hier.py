@@ -1,0 +1,1029 @@
+"""TPU-vectorized CRUSH for HIERARCHICAL maps (chooseleaf included).
+
+Extends the flat batched mapper (mapper_jax.py) to multi-level straw2
+hierarchies — the realistic hosts×racks maps whose bulk simulation is
+the reference's actual target (reference:src/crush/mapper.c:421
+crush_choose_firstn recursive descent + chooseleaf, :612
+crush_choose_indep; rule interpreter :854).
+
+Design
+------
+Per-map tables (padded [n_buckets, max_items]) let one device program
+evaluate straw2 for a *different bucket per lane*: a ``jnp.take`` row
+gather fetches each lane's item ids / inverse weights / child-row
+indices, and the draw loop runs over the padded item axis.  The descent
+from the TAKE root to the target type is a static loop bounded by the
+map's depth; the firstn retry ladder (per-lane ftotal), the chooseleaf
+inner recursion (single-rep firstn at type 0 with vary_r/stable
+semantics), and indep's round-global retries are masked vector loops —
+the exact control flow of the scalar mapper, one mask per branch.
+
+Draws use the gather-free f32 approximation of mapper_jax (a TPU has no
+fast vector gather for the 65536-entry ln table): each straw2 winner
+whose runner-up falls inside a *measured-on-this-backend* error budget
+flags its lane, and flagged lanes are recomputed with the exact scalar
+mapper on the host.  Bit-exactness contract: for supported maps the
+combined output equals ``crush_do_rule`` for every x
+(tests/test_crush_vec.py hierarchy suite).
+
+Supported shape (``supports_hier``):
+- every bucket straw2; acyclic, bounded depth;
+- one TAKE -> one CHOOSE[LEAF]_FIRSTN/INDEP -> EMIT (any target type);
+- modern tunables (choose_local_tries == choose_local_fallback_tries
+  == 0); chooseleaf_vary_r / chooseleaf_stable fully supported;
+- multi-step rules (e.g. LRC per-layer chains) fall back to the scalar
+  mapper via CrushTester.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .map import (
+    CRUSH_BUCKET_STRAW2,
+    CRUSH_ITEM_NONE,
+    CRUSH_RULE_CHOOSE_FIRSTN,
+    CRUSH_RULE_CHOOSE_INDEP,
+    CRUSH_RULE_CHOOSELEAF_FIRSTN,
+    CRUSH_RULE_CHOOSELEAF_INDEP,
+    CRUSH_RULE_EMIT,
+    CRUSH_RULE_SET_CHOOSE_LOCAL_FALLBACK_TRIES,
+    CRUSH_RULE_SET_CHOOSE_LOCAL_TRIES,
+    CRUSH_RULE_SET_CHOOSE_TRIES,
+    CRUSH_RULE_SET_CHOOSELEAF_STABLE,
+    CRUSH_RULE_SET_CHOOSELEAF_TRIES,
+    CRUSH_RULE_SET_CHOOSELEAF_VARY_R,
+    CRUSH_RULE_TAKE,
+    CrushMap,
+)
+
+_NONE = CRUSH_ITEM_NONE
+_UNDEF = 0x7FFFFFFE  # CRUSH_ITEM_UNDEF
+_BIG = 3.0e38
+
+_CHOOSE_OPS = (
+    CRUSH_RULE_CHOOSE_FIRSTN,
+    CRUSH_RULE_CHOOSE_INDEP,
+    CRUSH_RULE_CHOOSELEAF_FIRSTN,
+    CRUSH_RULE_CHOOSELEAF_INDEP,
+)
+
+
+# -- per-map device tables ---------------------------------------------------
+
+
+class MapTables:
+    """Padded bucket tables for lane-varying straw2 (host-built, cached
+    on the map object; invalidated by identity, so mutate-and-reuse maps
+    should drop ``cmap._vec_hier_tables``)."""
+
+    def __init__(self, cmap: CrushMap):
+        from .mapper_jax import measured_error_budget
+
+        bids = sorted(cmap.buckets)
+        self.row_of = {bid: i for i, bid in enumerate(bids)}
+        B = len(bids)
+        I = max((len(cmap.buckets[b].items) for b in bids), default=1)
+        items = np.full((B, I), float(_NONE), dtype=np.float32)
+        invw = np.zeros((B, I), dtype=np.float32)
+        eb = np.zeros((B, I), dtype=np.float32)
+        childrow = np.full((B, I), -1, dtype=np.int32)
+        size = np.zeros(B, dtype=np.int32)
+        btype = np.zeros(B, dtype=np.int32)
+        for bi, bid in enumerate(bids):
+            b = cmap.buckets[bid]
+            size[bi] = len(b.items)
+            btype[bi] = b.type
+            for ii, (it, w) in enumerate(zip(b.items, b.item_weights)):
+                items[bi, ii] = float(it)
+                if w > 0:
+                    invw[bi, ii] = np.float32((1 << 44) / w)
+                    eb[bi, ii] = measured_error_budget(int(w))
+                if it < 0 and it in cmap.buckets:
+                    childrow[bi, ii] = self.row_of[it]
+        # child item type (0 for devices): lets the descent read the
+        # chosen item's type from the same packed row fetch, no gather
+        childtype = np.zeros((B, I), dtype=np.float32)
+        for bi, bid in enumerate(bids):
+            b = cmap.buckets[bid]
+            for ii, it in enumerate(b.items):
+                if it < 0 and it in cmap.buckets:
+                    childtype[bi, ii] = float(cmap.buckets[it].type)
+        self.I = I
+        self.B = B
+        self.depth = self._max_depth(cmap, bids)
+        self.ebmax = float(eb.max()) if eb.size else 0.0
+        # ONE packed [B, 5I+1] matrix: a single one-hot MXU matmul per
+        # straw2 call fetches every per-lane bucket row (TPUs have no
+        # fast vector gather; a take-based version measured 6.5s/1M x,
+        # the matmul form is the fix). f32 is exact for ids < 2^24.
+        self.packed = jnp.asarray(
+            np.concatenate(
+                [
+                    items,
+                    invw,
+                    eb,
+                    childrow.astype(np.float32),
+                    childtype,
+                    size.astype(np.float32)[:, None],
+                ],
+                axis=1,
+            )
+        )
+        self.btype = jnp.asarray(btype)
+
+    @staticmethod
+    def _max_depth(cmap: CrushMap, bids) -> int:
+        depth: dict[int, int] = {}
+
+        def d(bid: int) -> int:
+            if bid in depth:
+                return depth[bid]
+            depth[bid] = 0  # cycle guard (supports_hier rejects cycles)
+            best = 0
+            for it in cmap.buckets[bid].items:
+                if it < 0 and it in cmap.buckets:
+                    best = max(best, 1 + d(it))
+            depth[bid] = best
+            return best
+
+        return max((d(b) for b in bids), default=0)
+
+    def tree(self):
+        return (self.packed,)
+
+
+def tables_for(cmap: CrushMap) -> MapTables:
+    t = getattr(cmap, "_vec_hier_tables", None)
+    if t is None:
+        t = MapTables(cmap)
+        cmap._vec_hier_tables = t
+    return t
+
+
+# -- batched primitives ------------------------------------------------------
+
+
+def _straw2_rows(T, x, rows, r, ebmax):
+    """straw2 over a per-lane bucket:
+    (item, child_row, child_type, ambiguous, empty).
+
+    x [X] uint32; rows [X] int32 bucket-row indices; r [X] int32.
+
+    The per-lane bucket row is fetched with ONE one-hot matmul against
+    the packed [B, 5I+1] table — exact under Precision.HIGHEST (one-hot
+    factors are 1.0/0.0, so the bf16x-pass products and zero sums
+    reproduce each f32 entry bit-for-bit) and MXU-fast, where a
+    take-gather version measured ~15ns/lane.
+    """
+    from .mapper_jax import hash32_3
+
+    (packed,) = T
+    B = packed.shape[0]
+    I = (packed.shape[1] - 1) // 5
+    rows = jnp.maximum(rows, 0)  # -1 sentinels ride under dead masks
+    onehot = (
+        rows[:, None] == jnp.arange(B, dtype=rows.dtype)[None, :]
+    ).astype(jnp.float32)
+    fetched = jnp.matmul(
+        onehot, packed, precision=jax.lax.Precision.HIGHEST
+    )  # [X, 5I+1]
+    it_l = fetched[:, 0:I].T          # [I, X] f32 item ids
+    iw_l = fetched[:, I : 2 * I].T    # inverse weights
+    eb_l = fetched[:, 2 * I : 3 * I].T
+    cr_l = fetched[:, 3 * I : 4 * I].T  # child row (f32-exact ints)
+    ct_l = fetched[:, 4 * I : 5 * I].T  # child type (0 = device)
+    empty = fetched[:, 5 * I] == 0
+
+    # all I draws at once: [I, X] hashes + draws, then a first-min
+    # argmin — one wide fused kernel instead of I loop-carried passes
+    it_all = it_l.astype(jnp.int32)                       # [I, X]
+    u = (
+        hash32_3(x[None, :], it_all, r.astype(jnp.uint32)[None, :])
+        & jnp.uint32(0xFFFF)
+    ).astype(jnp.float32)
+    q = jnp.where(
+        iw_l > 0, (jnp.float32(16.0) - jnp.log2(u + 1.0)) * iw_l, _BIG
+    )                                                     # [I, X]
+    best = jnp.argmin(q, axis=0)                          # first-min wins
+    sel = jnp.arange(I, dtype=best.dtype)[:, None] == best[None, :]
+    bq = jnp.min(q, axis=0)
+    second = jnp.min(jnp.where(sel, _BIG, q), axis=0)
+    pick = lambda a: jnp.where(sel, a, 0).sum(axis=0)  # noqa: E731
+    bit = pick(it_all)
+    brow = pick(cr_l).astype(jnp.int32)
+    btyp = pick(ct_l).astype(jnp.int32)
+    beb = pick(eb_l)
+    ambiguous = (second - bq) <= (beb + ebmax)
+    return bit, brow, btyp, ambiguous, empty
+
+
+def _descend(T, x, rows0, r, want_type, max_depth, ebmax):
+    """Drill from per-lane root buckets to the first item of want_type
+    (the retry_bucket descent of mapper.c:421/:612, minus empty/wrong-type
+    handling which the callers mask).  Returns
+    (item, item_row, resolved, dead, empty_hit, ambiguous)."""
+    X = x.shape[0]
+    cur = rows0
+    item = jnp.full((X,), _NONE, dtype=jnp.int32)
+    item_row = jnp.full((X,), -1, dtype=jnp.int32)
+    resolved = jnp.zeros((X,), dtype=bool)
+    dead = jnp.zeros((X,), dtype=bool)
+    empty_hit = jnp.zeros((X,), dtype=bool)
+    amb = jnp.zeros((X,), dtype=bool)
+    for _d in range(max_depth + 1):
+        it, crow, t, amb_d, empty = _straw2_rows(T, x, cur, r, ebmax)
+        live = ~resolved & ~dead & ~empty_hit
+        amb = amb | (live & amb_d)
+        empty_hit = empty_hit | (live & empty)
+        live = live & ~empty
+        hit = live & (t == want_type)
+        item = jnp.where(hit, it, item)
+        item_row = jnp.where(hit, crow, item_row)
+        resolved = resolved | hit
+        godeep = live & ~hit & (it < 0) & (crow >= 0)
+        dead = dead | (live & ~hit & ~godeep)
+        cur = jnp.where(godeep, crow, cur)
+    dead = dead | (~resolved & ~dead & ~empty_hit)  # depth exhausted
+    return item, item_row, resolved, dead, empty_hit, amb
+
+
+def _is_out_vec(x, reweight, item):
+    from .mapper_jax import hash32_2
+
+    n = reweight.shape[0]
+    idx = jnp.clip(item, 0, n - 1)
+    w = jnp.take(reweight, idx)
+    w = jnp.where((item < 0) | (item >= n), 0, w)  # out-of-range: out
+    hashed = (hash32_2(x, item.astype(jnp.uint32)) & jnp.uint32(0xFFFF)
+              ).astype(jnp.int32)
+    return jnp.where(w >= 0x10000, False, jnp.where(w == 0, True, hashed >= w))
+
+
+def _collides(out, outpos, item):
+    """item already in out[:, :outpos]? ([X,W], [X], [X]) -> [X] bool."""
+    W = out.shape[1]
+    cols = jnp.arange(W)[None, :]
+    return ((out == item[:, None]) & (cols < outpos[:, None])).any(axis=1)
+
+
+# -- chooseleaf inner recursion (single-rep firstn at type 0) ---------------
+
+
+def _leaf_firstn(
+    T, x, sub_rows, rep2, sub_r, out2, outpos, reweight,
+    recurse_tries: int, max_depth: int, ebmax, want,
+):
+    """The recursive leaf step of crush_choose_firstn (mapper.c:995-1012
+    via the python port): one rep (index rep2), parent_r=sub_r, descend
+    to a device, collide against out2[:, :outpos], is_out rejection.
+    Returns (leaf, ok, ambiguous) for lanes in ``want``."""
+    X = x.shape[0]
+    leaf = jnp.full((X,), _NONE, dtype=jnp.int32)
+    done = jnp.zeros((X,), dtype=bool)
+    failed = jnp.zeros((X,), dtype=bool)
+    amb = jnp.zeros((X,), dtype=bool)
+    ftotal = jnp.zeros((X,), dtype=jnp.int32)
+
+    # static unroll: recurse_tries is 1 under modern tunables
+    # (chooseleaf_descend_once), and a nested lax.while_loop inside the
+    # outer retry loop compiled pathologically; per-lane ftotal is kept
+    # so r2 matches the scalar ladder exactly
+    for _t in range(recurse_tries):
+        live = want & ~done & ~failed & (ftotal < recurse_tries)
+        r2 = rep2 + sub_r + ftotal
+        item, _row, resolved, dead, empty, amb_d = _descend(
+            T, x, sub_rows, r2, 0, max_depth, ebmax
+        )
+        amb = amb | (live & amb_d)
+        coll = _collides(out2, outpos, item)
+        rej = resolved & (coll | _is_out_vec(x, reweight, item))
+        ok_now = live & resolved & ~rej
+        leaf = jnp.where(ok_now, item, leaf)
+        done = done | ok_now
+        # wrong-type terminal inside the leaf descent = inner skip_rep:
+        # the inner rep is abandoned, the leaf fails for good
+        failed = failed | (live & dead)
+        retry = live & ~ok_now & ~dead
+        ftotal = ftotal + retry.astype(jnp.int32)
+    return leaf, done, amb
+
+
+# -- firstn ------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "numrep", "width", "tries", "recurse_tries", "want_type", "leaf",
+        "vary_r", "stable", "max_depth",
+    ),
+)
+def choose_firstn_hier(
+    tables, x, root_row, reweight, ebmax,
+    numrep: int, width: int, tries: int, recurse_tries: int,
+    want_type: int, leaf: bool, vary_r: int, stable: int, max_depth: int,
+):
+    """Batched crush_choose_firstn over a hierarchy (mapper.c:421).
+
+    Returns (out [X,width], out2 [X,width], outpos [X], ambiguous [X]).
+    out2 is the leaf vector when ``leaf`` (chooseleaf), else == out.
+    """
+    T = tables
+    X = x.shape[0]
+    out = jnp.full((X, width), _NONE, dtype=jnp.int32)
+    out2 = jnp.full((X, width), _NONE, dtype=jnp.int32)
+    outpos = jnp.zeros((X,), dtype=jnp.int32)
+    amb = jnp.zeros((X,), dtype=bool)
+    roots = jnp.full((X,), root_row, dtype=jnp.int32)
+
+    for rep in range(numrep):
+        active0 = outpos < width
+
+        def cond(st):
+            active, ftotal, out, out2, outpos, amb = st
+            return (active & (ftotal < tries)).any()
+
+        def body(st):
+            active, ftotal, out, out2, outpos, amb = st
+            live = active & (ftotal < tries)
+            r = jnp.int32(rep) + ftotal
+            item, item_row, resolved, dead, empty, amb_d = _descend(
+                T, x, roots, r, want_type, max_depth, ebmax
+            )
+            amb = amb | (live & amb_d)
+            coll = _collides(out, outpos, item)
+            if leaf:
+                sub_r = (r >> (vary_r - 1)) if vary_r else jnp.zeros_like(r)
+                rep2 = (
+                    jnp.zeros_like(outpos) if stable else outpos
+                )
+                want_leaf = live & resolved & ~coll
+                leaf_item, leaf_ok, amb2 = _leaf_firstn(
+                    T, x, item_row, rep2, sub_r, out2, outpos, reweight,
+                    recurse_tries, max_depth, ebmax, want_leaf,
+                )
+                amb = amb | (want_leaf & amb2)
+                rej_leaf = want_leaf & ~leaf_ok
+            else:
+                leaf_item = item
+                rej_leaf = jnp.zeros_like(live)
+            if want_type == 0 and not leaf:
+                rej_out = resolved & ~coll & _is_out_vec(x, reweight, item)
+            else:
+                rej_out = jnp.zeros_like(live)
+            reject = empty | rej_leaf | rej_out
+            ok = live & resolved & ~coll & ~reject
+            # one-hot masked write instead of a row scatter (TPU scatters
+            # with per-lane indices serialize; this was the engine's
+            # dominant cost at 10^6 lanes)
+            slotmask = jnp.arange(width)[None, :] == jnp.minimum(
+                outpos, width - 1
+            )[:, None]
+            wmask = slotmask & ok[:, None]
+            out = jnp.where(wmask, item[:, None], out)
+            out2 = jnp.where(
+                wmask, (leaf_item if leaf else item)[:, None], out2
+            )
+            outpos = outpos + ok.astype(jnp.int32)
+            active = active & ~ok & ~(live & dead)  # dead = skip_rep
+            fail = live & ~ok & ~dead
+            ftotal = ftotal + fail.astype(jnp.int32)
+            return active, ftotal, out, out2, outpos, amb
+
+        st = (active0, jnp.zeros((X,), jnp.int32), out, out2, outpos, amb)
+        _active, _ft, out, out2, outpos, amb = jax.lax.while_loop(
+            cond, body, st
+        )
+    return out, out2, outpos, amb
+
+
+# -- indep -------------------------------------------------------------------
+
+
+def _leaf_indep(
+    T, x, sub_rows, rep, parent_r, reweight,
+    numrep: int, recurse_tries: int, max_depth: int, ebmax, want,
+):
+    """Leaf recursion of crush_choose_indep (mapper.c:426-449 via the
+    python port): left=1 at slot ``rep``, type 0, its own retry rounds.
+    The inner call's collision scope is only its own slot — which it
+    resets to UNDEF on entry — so there is NO cross-slot leaf collision
+    check (distinctness comes from the outer subtree collision), and a
+    failed inner attempt is retried fresh by the next outer round.
+    Returns (leaf, ok, ambiguous)."""
+    X = x.shape[0]
+    leaf = jnp.full((X,), _NONE, dtype=jnp.int32)
+    done = jnp.zeros((X,), dtype=bool)
+    deadf = jnp.zeros((X,), dtype=bool)
+    amb = jnp.zeros((X,), dtype=bool)
+
+    for ft2 in range(recurse_tries):
+        live = want & ~done & ~deadf
+        r2 = rep + parent_r + numrep * ft2
+        item, _row, resolved, dead, empty, amb_d = _descend(
+            T, x, sub_rows, r2, 0, max_depth, ebmax
+        )
+        amb = amb | (live & amb_d)
+        rej = resolved & _is_out_vec(x, reweight, item)
+        ok_now = live & resolved & ~rej
+        leaf = jnp.where(ok_now, item, leaf)
+        done = done | ok_now
+        # wrong-type terminal: the inner call gives up (slot NONE) for
+        # THIS attempt; the outer round retries with a fresh inner call
+        deadf = deadf | (live & dead)
+    return leaf, done, amb
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "numrep", "out_size", "tries", "recurse_tries", "want_type",
+        "leaf", "max_depth",
+    ),
+)
+def choose_indep_hier(
+    tables, x, root_row, reweight, ebmax,
+    numrep: int, out_size: int, tries: int, recurse_tries: int,
+    want_type: int, leaf: bool, max_depth: int,
+):
+    """Batched crush_choose_indep over a hierarchy (mapper.c:612).
+
+    Returns (out [X,out_size], out2, ambiguous). Holes are NONE."""
+    T = tables
+    X = x.shape[0]
+    out = jnp.full((X, out_size), _UNDEF, dtype=jnp.int32)
+    out2 = jnp.full((X, out_size), _UNDEF, dtype=jnp.int32)
+    amb = jnp.zeros((X,), dtype=bool)
+    roots = jnp.full((X,), root_row, dtype=jnp.int32)
+
+    def cond(st):
+        ftotal, out, out2, amb = st
+        return jnp.logical_and(
+            ftotal < tries, (out == _UNDEF).any()
+        )
+
+    def body(st):
+        ftotal, out, out2, amb = st
+        for rep in range(out_size):
+            need = out[:, rep] == _UNDEF
+            r = jnp.int32(rep) + jnp.int32(numrep) * ftotal
+            rv = jnp.broadcast_to(r, (X,)).astype(jnp.int32)
+            item, item_row, resolved, dead, empty, amb_d = _descend(
+                T, x, roots, rv, want_type, max_depth, ebmax
+            )
+            amb = amb | (need & amb_d)
+            # permanent NONE: wrong-type terminal (depth dead-ends)
+            perm = need & dead
+            # collide against every slot of this call's region
+            coll = (out == item[:, None]).any(axis=1)
+            if leaf:
+                want_leaf = need & resolved & ~coll
+                leaf_item, leaf_ok, amb2 = _leaf_indep(
+                    T, x, item_row, jnp.int32(rep), rv, reweight,
+                    numrep, recurse_tries, max_depth, ebmax, want_leaf,
+                )
+                amb = amb | (want_leaf & amb2)
+                rej_leaf = want_leaf & ~leaf_ok
+            else:
+                leaf_item = item
+                rej_leaf = jnp.zeros_like(need)
+            if want_type == 0 and not leaf:
+                rej_out = resolved & ~coll & _is_out_vec(x, reweight, item)
+            else:
+                rej_out = jnp.zeros_like(need)
+            ok = need & resolved & ~coll & ~rej_leaf & ~rej_out & ~perm
+            out = out.at[:, rep].set(
+                jnp.where(ok, item, jnp.where(perm, _NONE, out[:, rep]))
+            )
+            out2 = out2.at[:, rep].set(
+                jnp.where(
+                    ok, leaf_item if leaf else item,
+                    jnp.where(perm, _NONE, out2[:, rep]),
+                )
+            )
+        return ftotal + 1, out, out2, amb
+
+    _ft, out, out2, amb = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), out, out2, amb)
+    )
+    out = jnp.where(out == _UNDEF, _NONE, out)
+    out2 = jnp.where(out2 == _UNDEF, _NONE, out2)
+    return out, out2, amb
+
+
+# -- host-exact fallback engine (numpy, table-exact draws) -------------------
+#
+# Flagged lanes (runner-up inside the f32 error budget) are re-run here:
+# host numpy has real vector gathers, so the exact 65536-entry draw
+# tables apply directly over just the flagged subset. One scalar
+# crush_do_rule call costs ~0.5 ms; at a ~0.7% flag rate over 10^6 x
+# that was ~3.5 s — this batched exact engine makes it milliseconds.
+
+
+class _NpTables:
+    """Exact per-map tables for the host fallback (cached on MapTables)."""
+
+    def __init__(self, cmap: CrushMap, T: MapTables):
+        from .mapper_jax import _np_draw_table
+
+        bids = sorted(cmap.buckets)
+        B, I = T.B, T.I
+        self.items = np.full((B, I), _NONE, dtype=np.int64)
+        self.childrow = np.full((B, I), -1, dtype=np.int64)
+        self.childtype = np.zeros((B, I), dtype=np.int64)
+        self.size = np.zeros(B, dtype=np.int64)
+        # exact draw tables deduped per distinct weight ([W, 65536] would
+        # be [B, I, 65536] otherwise — gigabytes on a big map)
+        wslot: dict[int, int] = {}
+        tabs: list[np.ndarray] = []
+        self.draw_slot = np.zeros((B, I), dtype=np.int64)
+        for bi, bid in enumerate(bids):
+            b = cmap.buckets[bid]
+            self.size[bi] = len(b.items)
+            for ii, (it, w) in enumerate(zip(b.items, b.item_weights)):
+                self.items[bi, ii] = it
+                w = int(w) if w > 0 else 0
+                if w not in wslot:
+                    wslot[w] = len(tabs)
+                    tabs.append(_np_draw_table(w))
+                self.draw_slot[bi, ii] = wslot[w]
+                if it < 0 and it in cmap.buckets:
+                    self.childrow[bi, ii] = T.row_of[it]
+                    self.childtype[bi, ii] = cmap.buckets[it].type
+        if 0 not in wslot:  # padding slots draw S64_MIN
+            wslot[0] = len(tabs)
+            tabs.append(_np_draw_table(0))
+        self.pad_slot = wslot[0]
+        self.draw_slot[self.items == _NONE] = self.pad_slot
+        self.draw_tabs = np.stack(tabs)  # [W, 65536] int64
+
+
+def _np_tables(cmap: CrushMap) -> _NpTables:
+    T = tables_for(cmap)
+    nt = getattr(T, "_np_tables", None)
+    if nt is None:
+        nt = _NpTables(cmap, T)
+        T._np_tables = nt
+    return nt
+
+
+def _np_hash3(a, b, c):
+    from .hashes import crush_hash32_3
+
+    return crush_hash32_3(
+        np.asarray(a, np.uint32), np.asarray(b, np.uint32),
+        np.asarray(c, np.uint32),
+    )
+
+
+def _np_straw2_rows(NT, x, rows, r):
+    """Exact straw2 per lane-varying bucket: (item, crow, ctype, empty)."""
+    X = len(x)
+    best = None
+    bit = np.full(X, _NONE, dtype=np.int64)
+    brow = np.full(X, -1, dtype=np.int64)
+    btyp = np.zeros(X, dtype=np.int64)
+    I = NT.items.shape[1]
+    szs = NT.size[rows]
+    for i in range(I):
+        it = NT.items[rows, i]
+        u = (_np_hash3(x, it & 0xFFFFFFFF, r) & np.uint32(0xFFFF)).astype(
+            np.int64
+        )
+        d = NT.draw_tabs[NT.draw_slot[rows, i], u]
+        d = np.where(i < szs, d, -(1 << 63))  # padding never wins
+        if best is None:
+            best, bit = d, it.copy()
+            brow, btyp = NT.childrow[rows, i], NT.childtype[rows, i]
+        else:
+            better = d > best
+            best = np.where(better, d, best)
+            bit = np.where(better, it, bit)
+            brow = np.where(better, NT.childrow[rows, i], brow)
+            btyp = np.where(better, NT.childtype[rows, i], btyp)
+    return bit, brow, btyp, szs == 0
+
+
+def _np_descend(NT, x, rows0, r, want_type, max_depth):
+    X = len(x)
+    cur = rows0.copy()
+    item = np.full(X, _NONE, dtype=np.int64)
+    item_row = np.full(X, -1, dtype=np.int64)
+    resolved = np.zeros(X, dtype=bool)
+    dead = np.zeros(X, dtype=bool)
+    empty_hit = np.zeros(X, dtype=bool)
+    for _d in range(max_depth + 1):
+        it, crow, t, empty = _np_straw2_rows(NT, x, np.maximum(cur, 0), r)
+        live = ~resolved & ~dead & ~empty_hit
+        empty_hit |= live & empty
+        live &= ~empty
+        hit = live & (t == want_type)
+        item = np.where(hit, it, item)
+        item_row = np.where(hit, crow, item_row)
+        resolved |= hit
+        godeep = live & ~hit & (it < 0) & (crow >= 0)
+        dead |= live & ~hit & ~godeep
+        cur = np.where(godeep, crow, cur)
+    dead |= ~resolved & ~dead & ~empty_hit
+    return item, item_row, resolved, dead, empty_hit
+
+
+def _np_is_out(x, weight, item):
+    from .hashes import crush_hash32_2
+
+    n = len(weight)
+    idx = np.clip(item, 0, n - 1)
+    w = np.where((item < 0) | (item >= n), 0, np.asarray(weight)[idx])
+    hashed = (
+        crush_hash32_2(np.asarray(x, np.uint32),
+                       np.asarray(item & 0xFFFFFFFF, np.uint32))
+        & np.uint32(0xFFFF)
+    ).astype(np.int64)
+    return np.where(w >= 0x10000, False, np.where(w == 0, True, hashed >= w))
+
+
+def _np_collides(out, outpos, item):
+    W = out.shape[1]
+    cols = np.arange(W)[None, :]
+    return ((out == item[:, None]) & (cols < outpos[:, None])).any(axis=1)
+
+
+def np_choose_firstn_hier(
+    NT, x, root_row, weight,
+    numrep, width, tries, recurse_tries, want_type, leaf, vary_r, stable,
+    max_depth,
+):
+    """Host-exact mirror of choose_firstn_hier (same masked control flow,
+    table-exact draws)."""
+    X = len(x)
+    out = np.full((X, width), _NONE, dtype=np.int64)
+    out2 = np.full((X, width), _NONE, dtype=np.int64)
+    outpos = np.zeros(X, dtype=np.int64)
+    roots = np.full(X, root_row, dtype=np.int64)
+    for rep in range(numrep):
+        active = outpos < width
+        ftotal = np.zeros(X, dtype=np.int64)
+        while True:
+            live = active & (ftotal < tries)
+            if not live.any():
+                break
+            r = rep + ftotal
+            item, item_row, resolved, dead, empty = _np_descend(
+                NT, x, roots, r, want_type, max_depth
+            )
+            coll = _np_collides(out, outpos, item)
+            if leaf:
+                sub_r = (r >> (vary_r - 1)) if vary_r else np.zeros_like(r)
+                rep2 = np.zeros_like(outpos) if stable else outpos
+                want_leaf = live & resolved & ~coll
+                leaf_item, leaf_ok = _np_leaf_firstn(
+                    NT, x, item_row, rep2, sub_r, out2, outpos, weight,
+                    recurse_tries, max_depth, want_leaf,
+                )
+                rej_leaf = want_leaf & ~leaf_ok
+            else:
+                leaf_item = item
+                rej_leaf = np.zeros_like(live)
+            if want_type == 0 and not leaf:
+                rej_out = resolved & ~coll & _np_is_out(x, weight, item)
+            else:
+                rej_out = np.zeros_like(live)
+            reject = empty | rej_leaf | rej_out
+            ok = live & resolved & ~coll & ~reject
+            slot = np.minimum(outpos, width - 1)
+            lanes = np.arange(X)
+            out[lanes[ok], slot[ok]] = item[ok]
+            out2[lanes[ok], slot[ok]] = (leaf_item if leaf else item)[ok]
+            outpos += ok.astype(np.int64)
+            active &= ~ok & ~(live & dead)
+            ftotal += (live & ~ok & ~dead).astype(np.int64)
+    return out, out2
+
+
+def _np_leaf_firstn(
+    NT, x, sub_rows, rep2, sub_r, out2, outpos, weight,
+    recurse_tries, max_depth, want,
+):
+    X = len(x)
+    leaf = np.full(X, _NONE, dtype=np.int64)
+    done = np.zeros(X, dtype=bool)
+    failed = np.zeros(X, dtype=bool)
+    ftotal = np.zeros(X, dtype=np.int64)
+    for _t in range(recurse_tries):
+        live = want & ~done & ~failed & (ftotal < recurse_tries)
+        if not live.any():
+            break
+        r2 = rep2 + sub_r + ftotal
+        item, _row, resolved, dead, empty = _np_descend(
+            NT, x, np.maximum(sub_rows, 0), r2, 0, max_depth
+        )
+        coll = _np_collides(out2, outpos, item)
+        rej = resolved & (coll | _np_is_out(x, weight, item))
+        ok_now = live & resolved & ~rej
+        leaf = np.where(ok_now, item, leaf)
+        done |= ok_now
+        failed |= live & dead
+        ftotal += (live & ~ok_now & ~dead).astype(np.int64)
+    return leaf, done
+
+
+def np_choose_indep_hier(
+    NT, x, root_row, weight,
+    numrep, out_size, tries, recurse_tries, want_type, leaf, max_depth,
+):
+    """Host-exact mirror of choose_indep_hier."""
+    X = len(x)
+    out = np.full((X, out_size), _UNDEF, dtype=np.int64)
+    out2 = np.full((X, out_size), _UNDEF, dtype=np.int64)
+    roots = np.full(X, root_row, dtype=np.int64)
+    for ftotal in range(tries):
+        if not (out == _UNDEF).any():
+            break
+        for rep in range(out_size):
+            need = out[:, rep] == _UNDEF
+            if not need.any():
+                continue
+            r = np.full(X, rep + numrep * ftotal, dtype=np.int64)
+            item, item_row, resolved, dead, empty = _np_descend(
+                NT, x, roots, r, want_type, max_depth
+            )
+            perm = need & dead
+            coll = (out == item[:, None]).any(axis=1)
+            if leaf:
+                want_leaf = need & resolved & ~coll
+                leaf_item, leaf_ok = _np_leaf_indep(
+                    NT, x, item_row, rep, r, weight,
+                    numrep, recurse_tries, max_depth, want_leaf,
+                )
+                rej_leaf = want_leaf & ~leaf_ok
+            else:
+                leaf_item = item
+                rej_leaf = np.zeros_like(need)
+            if want_type == 0 and not leaf:
+                rej_out = resolved & ~coll & _np_is_out(x, weight, item)
+            else:
+                rej_out = np.zeros_like(need)
+            ok = need & resolved & ~coll & ~rej_leaf & ~rej_out & ~perm
+            out[:, rep] = np.where(
+                ok, item, np.where(perm, _NONE, out[:, rep])
+            )
+            out2[:, rep] = np.where(
+                ok, (leaf_item if leaf else item),
+                np.where(perm, _NONE, out2[:, rep]),
+            )
+    out = np.where(out == _UNDEF, _NONE, out)
+    out2 = np.where(out2 == _UNDEF, _NONE, out2)
+    return out, out2
+
+
+def _np_leaf_indep(
+    NT, x, sub_rows, rep, parent_r, weight,
+    numrep, recurse_tries, max_depth, want,
+):
+    X = len(x)
+    leaf = np.full(X, _NONE, dtype=np.int64)
+    done = np.zeros(X, dtype=bool)
+    deadf = np.zeros(X, dtype=bool)
+    for ft2 in range(recurse_tries):
+        live = want & ~done & ~deadf
+        if not live.any():
+            break
+        r2 = rep + parent_r + numrep * ft2
+        item, _row, resolved, dead, empty = _np_descend(
+            NT, x, np.maximum(sub_rows, 0), r2, 0, max_depth
+        )
+        rej = resolved & _np_is_out(x, weight, item)
+        ok_now = live & resolved & ~rej
+        leaf = np.where(ok_now, item, leaf)
+        done |= ok_now
+        deadf |= live & dead
+    return leaf, done
+
+
+def np_do_rule_hier(cmap, ruleno, xs, result_max, weight=None) -> np.ndarray:
+    """Host-exact batched crush_do_rule for supported hierarchical rules
+    (the fallback engine; also an independent oracle for tests)."""
+    take, choose, tries, leaf_tries, vary_r, stable = _rule_shape(
+        cmap, ruleno
+    )
+    t = cmap.tunables
+    firstn = choose.op in (
+        CRUSH_RULE_CHOOSE_FIRSTN, CRUSH_RULE_CHOOSELEAF_FIRSTN
+    )
+    leaf = choose.op in (
+        CRUSH_RULE_CHOOSELEAF_FIRSTN, CRUSH_RULE_CHOOSELEAF_INDEP
+    )
+    numrep = choose.arg1 if choose.arg1 > 0 else choose.arg1 + result_max
+    if numrep <= 0:
+        return np.zeros((len(xs), 0), dtype=np.int32)
+    want_type = choose.arg2
+    if weight is None:
+        weight = cmap.get_weights()
+    T = tables_for(cmap)
+    NT = _np_tables(cmap)
+    xs = np.asarray(xs, dtype=np.uint32)
+    root_row = T.row_of[take]
+    if firstn:
+        if leaf_tries:
+            recurse_tries = leaf_tries
+        elif t.chooseleaf_descend_once:
+            recurse_tries = 1
+        else:
+            recurse_tries = tries
+        width = min(numrep, result_max)
+        out, out2 = np_choose_firstn_hier(
+            NT, xs, root_row, weight, numrep, width, tries,
+            recurse_tries, want_type, leaf, vary_r, stable, T.depth,
+        )
+    else:
+        out_size = min(numrep, result_max)
+        recurse_tries = leaf_tries if leaf_tries else 1
+        out, out2 = np_choose_indep_hier(
+            NT, xs, root_row, weight, numrep, out_size, tries,
+            recurse_tries, want_type, leaf, T.depth,
+        )
+    return (out2 if leaf else out).astype(np.int32)
+
+
+# -- rule-level driver -------------------------------------------------------
+
+
+def _rule_shape(cmap: CrushMap, ruleno: int):
+    """(take_bucket_id, choose_step, tries, leaf_tries, vary_r, stable)
+    or None if the rule is not a single TAKE->CHOOSE->EMIT chain."""
+    if ruleno < 0 or ruleno >= len(cmap.rules) or cmap.rules[ruleno] is None:
+        return None
+    t = cmap.tunables
+    tries = t.choose_total_tries + 1
+    leaf_tries = 0
+    vary_r = t.chooseleaf_vary_r
+    stable = t.chooseleaf_stable
+    take = None
+    choose = None
+    stage = 0
+    for s in cmap.rules[ruleno].steps:
+        if s.op == CRUSH_RULE_SET_CHOOSE_TRIES:
+            if s.arg1 > 0:
+                tries = s.arg1
+            continue
+        if s.op == CRUSH_RULE_SET_CHOOSELEAF_TRIES:
+            if s.arg1 > 0:
+                leaf_tries = s.arg1
+            continue
+        if s.op == CRUSH_RULE_SET_CHOOSELEAF_VARY_R:
+            if s.arg1 >= 0:
+                vary_r = s.arg1
+            continue
+        if s.op == CRUSH_RULE_SET_CHOOSELEAF_STABLE:
+            if s.arg1 >= 0:
+                stable = s.arg1
+            continue
+        if s.op in (
+            CRUSH_RULE_SET_CHOOSE_LOCAL_TRIES,
+            CRUSH_RULE_SET_CHOOSE_LOCAL_FALLBACK_TRIES,
+        ):
+            if s.arg1 > 0:
+                return None
+            continue
+        if stage == 0 and s.op == CRUSH_RULE_TAKE:
+            take = s.arg1
+            stage = 1
+        elif stage == 1 and s.op in _CHOOSE_OPS:
+            choose = s
+            stage = 2
+        elif stage == 2 and s.op == CRUSH_RULE_EMIT:
+            stage = 3
+        else:
+            return None
+    if stage != 3 or take is None or choose is None:
+        return None
+    return take, choose, tries, leaf_tries, vary_r, stable
+
+
+def supports_hier(cmap: CrushMap, ruleno: int) -> bool:
+    """True if vec_do_rule_hier handles this (map, rule) bit-exactly."""
+    t = cmap.tunables
+    if t.choose_local_tries != 0 or t.choose_local_fallback_tries != 0:
+        return False
+    shape = _rule_shape(cmap, ruleno)
+    if shape is None:
+        return False
+    take, choose, _tries, _lt, vary_r, _stable = shape
+    if take not in cmap.buckets:
+        return False
+    if vary_r < 0 or vary_r > 3:
+        return False
+    leaf = choose.op in (
+        CRUSH_RULE_CHOOSELEAF_FIRSTN, CRUSH_RULE_CHOOSELEAF_INDEP
+    )
+    if leaf and choose.arg2 == 0:
+        return False  # chooseleaf to type 0 is not a real shape
+    # every bucket straw2, acyclic, devices in range
+    seen: set[int] = set()
+
+    def walk(bid: int) -> bool:
+        if bid in seen:
+            return False  # cycle
+        seen.add(bid)
+        b = cmap.buckets.get(bid)
+        if b is None or b.alg != CRUSH_BUCKET_STRAW2:
+            return False
+        for it in b.items:
+            if it >= 0:
+                if it >= cmap.max_devices:
+                    return False
+            elif it in cmap.buckets:
+                if not walk(it):
+                    return False
+            else:
+                return False
+        seen.discard(bid)  # path-scoped for DAG-shared subtrees
+        return True
+
+    return walk(take)
+
+
+def _hier_engine(cmap, ruleno, xs_np, result_max, weight):
+    """Run the hierarchical engine; (out_dev [X,W], amb_dev [X]) or None
+    (degenerate numrep).  Device arrays: callers choose what to fetch
+    (vec_do_rule_hier fetches rows; vec_rule_stats bincounts on device)."""
+    take, choose, tries, leaf_tries, vary_r, stable = _rule_shape(
+        cmap, ruleno
+    )
+    t = cmap.tunables
+    firstn = choose.op in (
+        CRUSH_RULE_CHOOSE_FIRSTN, CRUSH_RULE_CHOOSELEAF_FIRSTN
+    )
+    leaf = choose.op in (
+        CRUSH_RULE_CHOOSELEAF_FIRSTN, CRUSH_RULE_CHOOSELEAF_INDEP
+    )
+    numrep = choose.arg1 if choose.arg1 > 0 else choose.arg1 + result_max
+    if numrep <= 0:
+        return None
+    want_type = choose.arg2
+    if weight is None:
+        weight = cmap.get_weights()
+    T = tables_for(cmap)
+    x = jnp.asarray(xs_np)
+    rw = jnp.asarray(np.array(weight, dtype=np.int32))
+    ebm = jnp.float32(T.ebmax)
+    root_row = T.row_of[take]
+
+    if firstn:
+        if leaf_tries:
+            recurse_tries = leaf_tries
+        elif t.chooseleaf_descend_once:
+            recurse_tries = 1
+        else:
+            recurse_tries = tries
+        width = min(numrep, result_max)
+        out, out2, _outpos, amb = choose_firstn_hier(
+            T.tree(), x, root_row, rw, ebm,
+            numrep=int(numrep), width=int(width), tries=int(tries),
+            recurse_tries=int(recurse_tries), want_type=int(want_type),
+            leaf=bool(leaf), vary_r=int(vary_r), stable=int(stable),
+            max_depth=int(T.depth),
+        )
+        # firstn result is compact (no holes): the engine writes
+        # sequentially per lane, so rows are already left-packed
+    else:
+        out_size = min(numrep, result_max)
+        recurse_tries = leaf_tries if leaf_tries else 1
+        out, out2, amb = choose_indep_hier(
+            T.tree(), x, root_row, rw, ebm,
+            numrep=int(numrep), out_size=int(out_size), tries=int(tries),
+            recurse_tries=int(recurse_tries), want_type=int(want_type),
+            leaf=bool(leaf), max_depth=int(T.depth),
+        )
+    return (out2 if leaf else out), amb
+
+
+def vec_do_rule_hier(
+    cmap: CrushMap,
+    ruleno: int,
+    xs,
+    result_max: int,
+    weight=None,
+) -> np.ndarray:
+    """Batched crush_do_rule over a hierarchical map; bit-identical to the
+    scalar mapper for supported (map, rule) shapes."""
+    if not supports_hier(cmap, ruleno):
+        raise ValueError("map/rule shape not supported by the hier vec path")
+    xs_np = np.asarray(xs, dtype=np.uint32)
+    eng = _hier_engine(cmap, ruleno, xs_np, result_max, weight)
+    if eng is None:
+        return np.zeros((len(xs_np), 0), dtype=np.int32)
+    out_dev, amb_dev = eng
+    res = np.array(out_dev)
+    amb = np.asarray(amb_dev)
+    if amb.any():
+        flagged = np.nonzero(amb)[0]
+        res[flagged] = np_do_rule_hier(
+            cmap, ruleno, xs_np[flagged], result_max, weight
+        )
+    return res

@@ -500,20 +500,33 @@ class RecoveryManager:
                 self._retry_needed = True
                 return
             for key, member in stale.items():
-                txn = (
-                    Transaction()
-                    .create_collection(cid)
-                    .remove(cid, soid)
-                    .write(cid, soid, 0, bytes(data))
-                )
-                for ak, av in (attrs or {}).items():
-                    txn.setattr(cid, soid, ak, av)
                 logger.info(
                     "%s: recovering %s -> osd.%d (v%s)",
                     osd.name, soid, member, version,
                 )
-                if await self._push_txn(pg, -1, member, txn, entry):
+                if await self.push_replica_object(
+                    pg, member, oid, data, attrs or {}, entry
+                ):
                     self.recoveries_done += 1
+
+    async def push_replica_object(
+        self, pg: PGid, member: int, oid: str, data: bytes,
+        attrs: dict[str, bytes], entry: PGLogEntry | None,
+    ) -> bool:
+        """Push one whole replicated object (data + attrs) to a member —
+        the single txn shape shared by recovery backfill and scrub repair
+        (reference:src/osd/ReplicatedBackend.cc push)."""
+        cid = CollectionId(str(pg))
+        soid = ObjectId(oid)
+        txn = (
+            Transaction()
+            .create_collection(cid)
+            .remove(cid, soid)
+            .write(cid, soid, 0, bytes(data))
+        )
+        for ak, av in attrs.items():
+            txn.setattr(cid, soid, ak, av)
+        return await self._push_txn(pg, -1, member, txn, entry)
 
     async def _push_txn(
         self, pg: PGid, shard: int, member: int, txn: Transaction,

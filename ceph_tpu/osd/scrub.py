@@ -364,22 +364,14 @@ class ScrubManager:
         if not bad or not repair:
             return
 
-        cid = CollectionId(str(pg))
-        soid = ObjectId(oid)
         auth_data = bytes(data[auth_member])
         auth_attrs = {
             ak: av.encode() for ak, av in attrs[auth_member].items()
         }
         for m in sorted(bad):
-            txn = (
-                Transaction()
-                .create_collection(cid)
-                .remove(cid, soid)
-                .write(cid, soid, 0, auth_data)
-            )
-            for ak, av in auth_attrs.items():
-                txn.setattr(cid, soid, ak, av)
-            if await osd.recovery._push_txn(pg, -1, m, txn, None):
+            if await osd.recovery.push_replica_object(
+                pg, m, oid, auth_data, auth_attrs, None
+            ):
                 report["repaired"] += 1
                 logger.info(
                     "%s: scrub repaired %s/%s on osd.%d (%s)",

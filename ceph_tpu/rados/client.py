@@ -230,7 +230,17 @@ class RadosClient(Dispatcher):
                     ))
                     async with asyncio.timeout(scrub_timeout):
                         reply = await fut
-                except (ConnectionError, OSError, TimeoutError):
+                except TimeoutError:
+                    self._op_futs.pop(tid, None)
+                    self._fut_conns.pop(tid, None)
+                    # do NOT re-send: the scrub keeps running server-side,
+                    # and a resend would queue a duplicate full scrub of
+                    # the same PG behind it
+                    raise RadosError(
+                        -EIO, f"scrub of {pg} timed out after "
+                        f"{scrub_timeout:.0f}s (still running server-side)"
+                    )
+                except (ConnectionError, OSError):
                     self._op_futs.pop(tid, None)
                     self._fut_conns.pop(tid, None)
                     await self._wait_for_map_change(epoch, 2.0)

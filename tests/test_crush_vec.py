@@ -62,6 +62,173 @@ def test_bit_exact_heavily_out():
     _compare(m, rule, 5, weights, True)
 
 
+# -- hierarchical maps (mapper_jax_hier) -------------------------------------
+
+N_XH = 400
+
+
+def _build_racks(tun=None, seed=7):
+    """2 racks x 3 hosts x 2-4 devices, uneven device weights."""
+    from ceph_tpu.crush.map import CRUSH_BUCKET_STRAW2
+
+    m = CrushMap(tun)
+    m.type_names.update({1: "host", 2: "rack", 3: "root"})
+    rng = np.random.default_rng(seed)
+    dev = 0
+    rack_ids, rack_ws = [], []
+    for rk in range(2):
+        host_ids, host_ws = [], []
+        for h in range(3):
+            n = int(rng.integers(2, 5))
+            devs = list(range(dev, dev + n))
+            dev += n
+            ws = [int(rng.integers(1, 4)) * 0x10000 for _ in devs]
+            hid = m.make_bucket(
+                CRUSH_BUCKET_STRAW2, 1, devs, ws, name=f"h{rk}{h}"
+            )
+            host_ids.append(hid)
+            host_ws.append(m.buckets[hid].weight)
+        rid = m.make_bucket(
+            CRUSH_BUCKET_STRAW2, 2, host_ids, host_ws, name=f"rack{rk}"
+        )
+        rack_ids.append(rid)
+        rack_ws.append(m.buckets[rid].weight)
+    m.make_bucket(CRUSH_BUCKET_STRAW2, 3, rack_ids, rack_ws, name="default")
+    return m
+
+
+def _compare_hier(cmap, rule, result_max, weights=None):
+    xs = np.arange(N_XH, dtype=np.uint32)
+    assert mapper_jax.supports(cmap, rule)
+    vec = mapper_jax.vec_do_rule(cmap, rule, xs, result_max, weight=weights)
+    for x in range(N_XH):
+        scal = mapper.crush_do_rule(cmap, rule, x, result_max, weight=weights)
+        want = np.full(vec.shape[1], CRUSH_ITEM_NONE, dtype=np.int32)
+        want[: len(scal)] = scal
+        assert np.array_equal(vec[x], want), (
+            f"x={x}: vec {list(vec[x])} != scalar {scal}"
+        )
+
+
+@pytest.mark.parametrize("profile", ["bobtail", "firefly", "jewel"])
+@pytest.mark.parametrize("indep", [False, True])
+def test_hier_chooseleaf_bit_exact(profile, indep):
+    """chooseleaf firstn/indep across a racks->hosts->devices hierarchy,
+    bit-equal to the scalar mapper across tunable generations
+    (vary_r=0/1, stable=0/1 are all covered by these profiles)."""
+    m = _build_racks(getattr(Tunables, profile)())
+    rule = m.add_simple_rule(m.root_id(), 1, indep=indep)
+    _compare_hier(m, rule, 4)
+
+
+def test_hier_chooseleaf_across_racks():
+    m = _build_racks()
+    rule = m.add_simple_rule(m.root_id(), 2)  # fault domain = rack
+    _compare_hier(m, rule, 2)
+
+
+def test_hier_out_and_reweighted_devices():
+    m = _build_racks()
+    r1 = m.add_simple_rule(m.root_id(), 1)
+    r2 = m.add_simple_rule(m.root_id(), 1, indep=True)
+    wv = m.get_weights(out=[0, 5], reweight={3: 0.33, 7: 0.5})
+    _compare_hier(m, r1, 3, wv)
+    _compare_hier(m, r2, 4, wv)
+
+
+def test_hier_plain_choose_buckets_and_devices():
+    """Non-chooseleaf CHOOSE to an intermediate type (returns bucket ids)
+    and type 0 (drills through the hierarchy to devices)."""
+    from ceph_tpu.crush.map import (
+        CRUSH_RULE_CHOOSE_FIRSTN,
+        CRUSH_RULE_CHOOSE_INDEP,
+        CRUSH_RULE_EMIT,
+        CRUSH_RULE_TAKE,
+        Rule,
+    )
+
+    m = _build_racks()
+    root = m.root_id()
+    for op, want_type, nrep in (
+        (CRUSH_RULE_CHOOSE_FIRSTN, 1, 3),
+        (CRUSH_RULE_CHOOSE_FIRSTN, 0, 3),
+        (CRUSH_RULE_CHOOSE_INDEP, 0, 4),
+    ):
+        r = Rule(20 + want_type + op, 1, 1, 10)
+        r.step(CRUSH_RULE_TAKE, root).step(op, 0, want_type).step(
+            CRUSH_RULE_EMIT
+        )
+        rn = m.add_rule(r)
+        _compare_hier(m, rn, nrep)
+
+
+def test_hier_exhaustion_more_reps_than_domains():
+    """numrep > #racks: firstn returns short, indep leaves holes."""
+    m = _build_racks()
+    r1 = m.add_simple_rule(m.root_id(), 2)
+    r2 = m.add_simple_rule(m.root_id(), 2, indep=True)
+    _compare_hier(m, r1, 5)
+    _compare_hier(m, r2, 5)
+
+
+def test_hier_zero_weight_host():
+    """A whole host at weight 0 forces ambiguity fallbacks and rejection
+    retries without breaking bit-exactness."""
+    from ceph_tpu.crush.map import CRUSH_BUCKET_STRAW2
+
+    m = CrushMap()
+    m.type_names.update({1: "host", 2: "root"})
+    h1 = m.make_bucket(CRUSH_BUCKET_STRAW2, 1, [0, 1], [0, 0], name="dead")
+    h2 = m.make_bucket(
+        CRUSH_BUCKET_STRAW2, 1, [2, 3], [0x10000, 0x10000], name="live1"
+    )
+    h3 = m.make_bucket(
+        CRUSH_BUCKET_STRAW2, 1, [4, 5], [0x10000, 0x8000], name="live2"
+    )
+    m.make_bucket(
+        CRUSH_BUCKET_STRAW2, 2, [h1, h2, h3],
+        [m.buckets[h].weight for h in (h1, h2, h3)], name="default",
+    )
+    r = m.add_simple_rule(m.root_id(), 1)
+    _compare_hier(m, r, 3)
+
+
+def test_np_hier_engine_matches_scalar():
+    """The host-exact fallback engine (np_do_rule_hier) is itself an
+    independent oracle: exact table draws, batched numpy control flow."""
+    from ceph_tpu.crush.mapper_jax_hier import np_do_rule_hier
+
+    m = _build_racks()
+    wv = m.get_weights(out=[2], reweight={6: 0.4})
+    for indep in (False, True):
+        rule = m.add_simple_rule(m.root_id(), 1, indep=indep)
+        xs = np.arange(N_XH, dtype=np.uint32)
+        got = np_do_rule_hier(m, rule, xs, 3, wv)
+        for x in range(N_XH):
+            scal = mapper.crush_do_rule(m, rule, x, 3, weight=wv)
+            want = np.full(got.shape[1], CRUSH_ITEM_NONE, dtype=np.int32)
+            want[: len(scal)] = scal
+            assert np.array_equal(got[x], want), (indep, x)
+
+
+def test_hier_tester_uses_vectorized_backend():
+    m = _build_racks()
+    rule = m.add_simple_rule(m.root_id(), 1)
+    t = CrushTester(m)
+    t.max_x = 255
+    t.min_rep = t.max_rep = 3
+    (rep,) = [r for r in t.test() if r.rule == rule]
+    assert rep.backend == "vectorized"
+    # and it agrees with a forced-scalar run
+    t2 = CrushTester(m)
+    t2.max_x = 255
+    t2.min_rep = t2.max_rep = 3
+    t2.force_scalar = True
+    (rep2,) = [r for r in t2.test() if r.rule == rule]
+    assert rep.device_counts == rep2.device_counts
+    assert rep.bad_mappings == rep2.bad_mappings
+
+
 def test_supports_rejects_unsupported():
     # legacy tunables -> perm-choose fallback paths possible
     m = CrushMap.flat(5, tunables=Tunables.legacy())
@@ -69,10 +236,16 @@ def test_supports_rejects_unsupported():
     assert not mapper_jax.supports(m, r)
     with pytest.raises(ValueError):
         mapper_jax.vec_do_rule(m, r, np.arange(4, dtype=np.uint32), 3)
-    # hierarchical chooseleaf -> not flat
+    # hierarchical chooseleaf IS supported now (mapper_jax_hier)
     m2 = CrushMap.hierarchical([[0, 1], [2, 3], [4, 5]])
     r2 = m2.add_simple_rule(m2.root_id("default"), 1)
-    assert not mapper_jax.supports(m2, r2)
+    assert mapper_jax.supports(m2, r2)
+    # ...but non-straw2 hierarchy buckets are not
+    from ceph_tpu.crush.map import CRUSH_BUCKET_STRAW
+
+    m4 = CrushMap.hierarchical([[0, 1], [2, 3]], alg=CRUSH_BUCKET_STRAW)
+    r4 = m4.add_simple_rule(m4.root_id("default"), 1)
+    assert not mapper_jax.supports(m4, r4)
     # supported flat map reports True
     m3 = CrushMap.flat(5)
     r3 = m3.add_simple_rule(m3.root_id(), 0)
