@@ -11,11 +11,18 @@ profile parsing (k/m/w/packetsize, :75), per-technique construction:
 - ``blaum_roth``     (:243) — m=2 bit-matrix code (w+1 prime)
 - ``liber8tion``     (:254) — m=2, w=8 bit-matrix code
 
-``blaum_roth`` and ``liber8tion`` are provided as capability-equivalent
-Cauchy bit-matrix codes with the same geometry constraints (m=2; w+1 prime
-/ w=8): the original constructions exist only as tables in the jerasure C
-library, so parity bytes differ from the reference for these two
-techniques, while profiles, chunk layout, and fault tolerance match.
+``blaum_roth`` is the real published construction (ring multiplication
+matrices over F2[x]/M_p, Blaum & Roth 1999 — the algorithm behind
+jerasure's technique; NOTE bit/row layout parity with the reference C is
+unverified, since neither the jerasure source nor its corpus is
+available in this tree).  ``liber8tion`` is a capability-equivalent stand-in: the
+original's bit-matrices exist only as search-found tables in Plank's
+paper/jerasure C code (w=8 admits no closed form — rotation-based
+minimal-density sets provably fail for rotation pairs differing by 4),
+so it is built as the GF(2^8) companion-power RAID-6 bit-matrix
+(X_j = C^j, MDS by field structure): same geometry (m=2, w=8, k<=8),
+same XOR-schedule execution, same fault tolerance, denser matrix and
+different parity bytes than the reference.
 """
 
 from __future__ import annotations
@@ -66,6 +73,51 @@ def liberation_bitmatrix(k: int, w: int) -> np.ndarray:
         if j > 0:
             i = (j * ((w - 1) // 2)) % w
             bm[w + i, j * w + (i + j - 1) % w] = 1
+    return bm
+
+
+def blaum_roth_bitmatrix(k: int, w: int) -> np.ndarray:
+    """Blaum-Roth minimal-density RAID-6 bit-matrix (Blaum & Roth, "On
+    Lowest Density MDS Codes", IEEE Trans. IT 1999; the construction
+    behind jerasure's blaum_roth technique,
+    reference:src/erasure-code/jerasure/ErasureCodeJerasure.cc:482).
+
+    Arithmetic is in the ring R_p = F2[x] / M_p(x) with p = w + 1 prime
+    and M_p(x) = 1 + x + ... + x^w.  Data device j's w bits are the
+    coefficients of a polynomial D_j; P = sum_j D_j (identity blocks) and
+    Q = sum_j x^j * D_j, so the Q block for device j is the
+    multiplication-by-x^j matrix over the basis {1, x, .., x^{w-1}} with
+    the reduction x^w = 1 + x + ... + x^{w-1}.  MDS for k <= w.
+    """
+    if not _is_prime(w + 1):
+        raise ErasureCodeValidationError(
+            f"blaum_roth requires w+1 prime, got w={w}"
+        )
+    if w > 32:
+        # the bit-matrix is O(k*w^2): an absurd profile w must not turn
+        # into a multi-GB allocation (jerasure's usable range is w <= 32)
+        raise ErasureCodeValidationError(
+            f"blaum_roth requires w <= 32, got w={w}"
+        )
+    if k > w:
+        raise ErasureCodeValidationError(
+            f"blaum_roth requires k <= w, got k={k} w={w}"
+        )
+    # powers of x mod M_p as coefficient vectors, up to x^(2w-2)
+    pows = np.zeros((2 * w - 1, w), dtype=np.uint8)
+    pows[0, 0] = 1
+    for t in range(1, 2 * w - 1):
+        prev = pows[t - 1]
+        cur = np.zeros(w, dtype=np.uint8)
+        cur[1:] = prev[:-1]
+        if prev[w - 1]:  # overflow: x^w = 1 + x + ... + x^{w-1}
+            cur ^= 1
+        pows[t] = cur
+    bm = np.zeros((2 * w, k * w), dtype=np.uint8)
+    for j in range(k):
+        for c in range(w):
+            bm[0:w, j * w + c][c] = 1          # P: identity blocks
+            bm[w : 2 * w, j * w + c] = pows[j + c]  # Q: coeffs of x^(j+c)
     return bm
 
 
@@ -123,15 +175,9 @@ class JerasureCodec:
         elif technique == "blaum_roth":
             if m != 2:
                 raise ErasureCodeValidationError("blaum_roth requires m=2")
-            if not _is_prime(w + 1):
-                raise ErasureCodeValidationError(
-                    f"blaum_roth requires w+1 prime, got w={w}"
-                )
-            if w not in (4, 8, 16):
-                w_eff = 4 if w < 8 else (8 if w < 16 else 16)
-            else:
-                w_eff = w
-            codec = BitmatrixErasureCode(k, 2, w_eff, mx.cauchy_good(k, 2, w_eff), ps)
+            codec = BitmatrixErasureCode(
+                k, 2, w, None, ps, bitmatrix=blaum_roth_bitmatrix(k, w)
+            )
         elif technique == "liber8tion":
             if m != 2:
                 raise ErasureCodeValidationError("liber8tion requires m=2")
@@ -139,7 +185,14 @@ class JerasureCodec:
                 raise ErasureCodeValidationError("liber8tion requires w=8")
             if k > 8:
                 raise ErasureCodeValidationError("liber8tion requires k <= 8")
-            codec = BitmatrixErasureCode(k, 2, 8, mx.cauchy_good(k, 2, 8), ps)
+            # companion-power RAID-6 (see module docstring): P = XOR,
+            # Q = sum_j g^j D_j over GF(2^8), as a pure XOR bit-matrix
+            from ..ops.gf import gf
+
+            r6 = np.ones((2, k), dtype=np.int64)
+            for j in range(k):
+                r6[1, j] = int(gf(8).exp[j % 255])
+            codec = BitmatrixErasureCode(k, 2, 8, r6, ps)
         else:
             raise ErasureCodeValidationError(f"unknown technique {technique!r}")
 

@@ -63,6 +63,12 @@ def lib() -> ctypes.CDLL:
                 ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
             ]
             _lib.crc32c_sw.restype = ctypes.c_uint32
+            for fn in (_lib.rs_vandermonde_matrix, _lib.cauchy_original_matrix):
+                fn.argtypes = [
+                    ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                    ctypes.POINTER(ctypes.c_int32),
+                ]
+                fn.restype = ctypes.c_int
         return _lib
 
 
@@ -95,6 +101,29 @@ def crc32c(crc: int, data: bytes | np.ndarray) -> int:
     if buf.size == 0:
         return crc & 0xFFFFFFFF
     return int(lib().crc32c_sw(crc & 0xFFFFFFFF, _u8ptr(buf), buf.size))
+
+
+def rs_vandermonde_matrix(k: int, m: int, w: int) -> np.ndarray:
+    """Independently-coded systematic RS-Vandermonde oracle (see
+    native/ec_cpu.cc): cross-checks the python construction."""
+    out = np.zeros((m, k), dtype=np.int32)
+    rc = lib().rs_vandermonde_matrix(
+        k, m, w, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+    )
+    if rc != 0:
+        raise ValueError(f"rs_vandermonde_matrix({k},{m},{w}) rc={rc}")
+    return out.astype(np.int64)
+
+
+def cauchy_original_matrix(k: int, m: int, w: int) -> np.ndarray:
+    """Independently-coded Cauchy-original oracle (native/ec_cpu.cc)."""
+    out = np.zeros((m, k), dtype=np.int32)
+    rc = lib().cauchy_original_matrix(
+        k, m, w, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+    )
+    if rc != 0:
+        raise ValueError(f"cauchy_original_matrix({k},{m},{w}) rc={rc}")
+    return out.astype(np.int64)
 
 
 def mul_region(c: int, src: np.ndarray) -> np.ndarray:

@@ -155,6 +155,119 @@ static const Crc32cTables kCrcTab;
 
 }  // namespace
 
+// ---------------------------------------------------------------------------
+// Independent coding-matrix constructions (golden cross-check oracle).
+//
+// Second implementation of the published matrix algorithms, written against
+// the papers rather than the python code, so tests can pin the python
+// matrices against an independently-coded oracle (the role the compiled
+// reference C played for the CRUSH golden fixtures):
+// - systematic RS-Vandermonde per Plank & Ding, "Note: Correction to the
+//   1997 Tutorial on Reed-Solomon Coding" (extended Vandermonde,
+//   column-operation systematization, parity row normalized to ones);
+// - Cauchy original per Blomer et al. / jerasure cauchy.c spec:
+//   entry(i, j) = 1 / (i XOR (m + j)) over GF(2^w).
+// Field definition: same primitive polynomials as gf-complete's defaults
+// (w4 0x13, w8 0x11d, w16 0x1100b) — part of the published spec.
+
+#include <vector>
+
+namespace {
+
+int gfw_poly(int w) {
+  switch (w) {
+    case 4: return 0x13;
+    case 8: return 0x11d;
+    case 16: return 0x1100b;
+    default: return 0;
+  }
+}
+
+struct GfW {
+  int w, size;
+  std::vector<int> logt, expt;
+  explicit GfW(int w_) : w(w_), size(1 << w_), logt(size, 0), expt(size, 0) {
+    int poly = gfw_poly(w);
+    int v = 1;
+    for (int i = 0; i < size - 1; i++) {
+      expt[i] = v;
+      logt[v] = i;
+      v <<= 1;
+      if (v & size) v ^= poly;
+    }
+  }
+  int mul(int a, int b) const {
+    if (a == 0 || b == 0) return 0;
+    return expt[(logt[a] + logt[b]) % (size - 1)];
+  }
+  int inv(int a) const {
+    return expt[(size - 1 - logt[a]) % (size - 1)];
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// out is [m*k] row-major; returns 0 on success
+int rs_vandermonde_matrix(int k, int m, int w, int32_t* out) {
+  if (gfw_poly(w) == 0 || k + m > (1 << w)) return -1;
+  GfW g(w);
+  const int rows = k + m, cols = k;
+  // extended Vandermonde: e0 / power rows / e_{cols-1}
+  std::vector<int> D(rows * cols, 0);
+  auto at = [&](int r, int c) -> int& { return D[r * cols + c]; };
+  at(0, 0) = 1;
+  if (rows > 1) {
+    at(rows - 1, cols - 1) = 1;
+    for (int i = 1; i < rows - 1; i++) {
+      int v = 1;
+      for (int j = 0; j < cols; j++) {
+        at(i, j) = v;
+        v = g.mul(v, i);
+      }
+    }
+  }
+  // systematize with column ops (these preserve every-k-rows-invertible)
+  for (int i = 1; i < cols; i++) {
+    int piv = -1;
+    for (int r = i; r < rows; r++)
+      if (at(r, i) != 0) { piv = r; break; }
+    if (piv < 0) return -2;
+    if (piv != i)
+      for (int c = 0; c < cols; c++) std::swap(at(i, c), at(piv, c));
+    if (at(i, i) != 1) {
+      int t = g.inv(at(i, i));
+      for (int r = 0; r < rows; r++) at(r, i) = g.mul(at(r, i), t);
+    }
+    for (int j = 0; j < cols; j++) {
+      int t = at(i, j);
+      if (j != i && t != 0)
+        for (int r = 0; r < rows; r++) at(r, j) ^= g.mul(t, at(r, i));
+    }
+  }
+  // parity block, first row normalized to all ones
+  for (int j = 0; j < cols; j++) {
+    int c = at(k, j);
+    if (c == 0) return -3;
+    int t = g.inv(c);
+    for (int r = 0; r < m; r++)
+      out[r * k + j] = g.mul(at(k + r, j), t);
+  }
+  return 0;
+}
+
+int cauchy_original_matrix(int k, int m, int w, int32_t* out) {
+  if (gfw_poly(w) == 0 || k + m > (1 << w)) return -1;
+  GfW g(w);
+  for (int i = 0; i < m; i++)
+    for (int j = 0; j < k; j++)
+      out[i * k + j] = g.inv(i ^ (m + j));
+  return 0;
+}
+
+}  // extern "C"
+
 extern "C" {
 
 uint32_t crc32c_sw(uint32_t crc, const uint8_t* data, int64_t n) {
