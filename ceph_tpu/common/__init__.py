@@ -1,0 +1,23 @@
+"""Core runtime utilities: config table, perf counters, admin socket.
+
+Re-expression of the reference's ``src/common`` daemon infrastructure
+(reference:src/common/config.cc + config_opts.h, perf_counters.cc,
+admin_socket.cc) for the asyncio mini-RADOS: two-tier configuration
+(typed daemon flags here; cluster-versioned EC profiles live in the
+OSDMap), typed performance counters on the hot paths, and a per-daemon
+unix admin socket serving `perf dump` / `config show|set` /
+`dump_ops_in_flight`.
+"""
+
+from .config import Config, Option, OPTIONS
+from .perf_counters import PerfCounters, PerfCountersCollection
+from .admin_socket import AdminSocket
+
+__all__ = [
+    "Config",
+    "Option",
+    "OPTIONS",
+    "PerfCounters",
+    "PerfCountersCollection",
+    "AdminSocket",
+]

@@ -1,0 +1,89 @@
+"""Per-daemon unix admin socket (reference:src/common/admin_socket.cc).
+
+``ceph daemon <name> <command>`` analog: a tiny asyncio unix-socket
+server taking one JSON request per connection ``{"prefix": "...", ...}``
+and answering with a JSON document — the transport for ``perf dump``,
+``config show``, ``config set``, ``dump_ops_in_flight`` and whatever a
+daemon registers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+from typing import Any, Callable
+
+logger = logging.getLogger("ceph_tpu.admin")
+
+Handler = Callable[[dict], Any]  # request dict -> json-able reply
+
+
+class AdminSocket:
+    def __init__(self, path: str):
+        self.path = path
+        self._handlers: dict[str, tuple[Handler, str]] = {}
+        self._server: asyncio.AbstractServer | None = None
+        self.register("help", self._help, "list registered commands")
+
+    def register(self, prefix: str, handler: Handler, desc: str = "") -> None:
+        """Register a command (AdminSocket::register_command)."""
+        if prefix in self._handlers:
+            raise ValueError(f"admin command {prefix!r} already registered")
+        self._handlers[prefix] = (handler, desc)
+
+    def _help(self, _req: dict) -> dict:
+        return {p: d for p, (_h, d) in sorted(self._handlers.items())}
+
+    async def start(self) -> None:
+        if os.path.exists(self.path):
+            os.unlink(self.path)  # stale socket from a dead daemon
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self._server = await asyncio.start_unix_server(
+            self._serve, path=self.path
+        )
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        if os.path.exists(self.path):
+            os.unlink(self.path)
+
+    async def _serve(self, reader, writer) -> None:
+        try:
+            raw = await reader.read(1 << 20)
+            try:
+                req = json.loads(raw or b"{}")
+                prefix = req.get("prefix", "")
+                entry = self._handlers.get(prefix)
+                if entry is None:
+                    reply = {"error": f"unknown command {prefix!r}",
+                             "commands": sorted(self._handlers)}
+                else:
+                    result = entry[0](req)
+                    if asyncio.iscoroutine(result):
+                        result = await result
+                    reply = result
+            except Exception as e:  # command errors go to the caller
+                logger.exception("admin command failed")
+                reply = {"error": str(e)}
+            writer.write(json.dumps(reply).encode())
+            await writer.drain()
+        finally:
+            writer.close()
+
+
+async def admin_command(path: str, prefix: str, **kw) -> Any:
+    """Client side: one command round trip (the `ceph daemon` CLI core)."""
+    reader, writer = await asyncio.open_unix_connection(path)
+    try:
+        writer.write(json.dumps({"prefix": prefix, **kw}).encode())
+        await writer.drain()
+        writer.write_eof()
+        raw = await reader.read()
+        return json.loads(raw)
+    finally:
+        writer.close()

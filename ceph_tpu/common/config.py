@@ -1,0 +1,199 @@
+"""Typed daemon configuration (reference:src/common/config.{h,cc}).
+
+The reference compiles 1206 ``OPTION(name, type, default)`` lines
+(reference:src/common/config_opts.h) into ``md_config_t`` and layers
+sources: compiled defaults -> ceph.conf ini -> CEPH_ARGS env -> argv ->
+runtime ``injectargs`` / admin-socket ``config set``, with registered
+observers notified on change (reference:src/common/config.h
+md_config_obs_t).
+
+Here the same shape, sized to this framework: a typed option table with
+defaults, ini-file and environment loading, runtime ``set`` with
+validation, and observer callbacks keyed on option name.  Cluster-tier
+configuration (EC profiles, pool flags) deliberately lives in the OSDMap
+instead — the reference's two-tier split (daemon flags vs mon-versioned
+profiles, reference:src/mon/OSDMonitor.cc:4305).
+"""
+
+from __future__ import annotations
+
+import configparser
+import dataclasses
+import os
+import shlex
+from typing import Any, Callable
+
+
+@dataclasses.dataclass(frozen=True)
+class Option:
+    name: str
+    type: type  # int | float | bool | str
+    default: Any
+    desc: str = ""
+
+    def coerce(self, value: Any) -> Any:
+        if self.type is bool:
+            if isinstance(value, bool):
+                return value
+            s = str(value).strip().lower()
+            if s in ("1", "true", "yes", "on"):
+                return True
+            if s in ("0", "false", "no", "off"):
+                return False
+            raise ValueError(f"{self.name}: bad bool {value!r}")
+        try:
+            return self.type(value)
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"{self.name}: {e}") from None
+
+
+def _opts(*options: Option) -> dict[str, Option]:
+    return {o.name: o for o in options}
+
+
+# The flag table (config_opts.h analog) — every tunable the daemons read.
+OPTIONS: dict[str, Option] = _opts(
+    # messenger
+    Option("ms_connect_timeout", float, 5.0, "outbound connect timeout (s)"),
+    Option("ms_reconnect_backoff", float, 0.2,
+           "base backoff between reconnect attempts (s)"),
+    Option("ms_reconnect_max_attempts", int, 3,
+           "reconnect attempts before a send fails"),
+    # osd: liveness
+    Option("osd_heartbeat_interval", float, 0.0,
+           "peer ping period (s); 0 disables (reference default 6)"),
+    Option("osd_heartbeat_grace", float, 3.0,
+           "silence before reporting a peer failed (reference default 20)"),
+    # osd: data path
+    Option("osd_subop_timeout", float, 30.0,
+           "shard sub-op round-trip budget (s)"),
+    Option("osd_client_op_retries", int, 8, "client-visible op retries"),
+    # osd: scrub
+    Option("osd_scrub_interval", float, 0.0,
+           "background deep-scrub period (s); 0 = on-demand only"),
+    Option("osd_scrub_auto_repair", bool, True,
+           "background scrub repairs what it finds"),
+    # osd: recovery
+    Option("osd_recovery_retry_interval", float, 0.5,
+           "pause before retrying a partial recovery pass (s)"),
+    Option("osd_recovery_scan_timeout", float, 10.0,
+           "peering scan round-trip budget (s)"),
+    # erasure code
+    Option("erasure_code_dir", str, "ceph_tpu.models",
+           "plugin module prefix (dlopen dir analog)"),
+    Option("osd_erasure_code_plugins", str, "jerasure isa lrc shec",
+           "plugins preloaded at daemon start"),
+    Option("osd_pool_default_erasure_code_profile", str,
+           "plugin=isa technique=reed_sol_van k=2 m=1",
+           "profile for pools created without one"),
+    # stores
+    Option("wal_checkpoint_bytes", int, 64 << 20,
+           "journal size triggering a WalStore checkpoint"),
+    Option("wal_sync", str, "fsync", "journal durability: fsync|flush|none"),
+    # mon
+    Option("mon_failure_min_reporters", int, 1,
+           "distinct reporters before an osd is marked down"),
+    Option("mon_lease_interval", float, 1.0,
+           "multi-mon lease/heartbeat period (s)"),
+    Option("mon_election_timeout", float, 2.0,
+           "silence before a mon calls an election (s)"),
+    # admin
+    Option("admin_socket", str, "",
+           "unix socket path for perf dump / config commands ('' = off)"),
+)
+
+
+class Config:
+    """Layered typed config with observers.
+
+    Precedence (low to high): option defaults -> ini file -> environment
+    (``CEPH_TPU_ARGS='--name value ...'``) -> constructor overrides ->
+    runtime :meth:`set`.
+    """
+
+    def __init__(
+        self,
+        overrides: dict[str, Any] | None = None,
+        conf_file: str | None = None,
+        section: str = "global",
+        env: str | None = None,
+        options: dict[str, Option] | None = None,
+    ):
+        self.options = dict(options or OPTIONS)
+        self._values: dict[str, Any] = {
+            name: o.default for name, o in self.options.items()
+        }
+        self._observers: dict[str, list[Callable[[str, Any], None]]] = {}
+        if conf_file:
+            self.load_file(conf_file, section)
+        env_args = (
+            env if env is not None else os.environ.get("CEPH_TPU_ARGS", "")
+        )
+        if env_args:
+            self.load_args(shlex.split(env_args))
+        for k, v in (overrides or {}).items():
+            self.set(k, v)
+
+    # -- sources
+    def load_file(self, path: str, section: str = "global") -> None:
+        cp = configparser.ConfigParser()
+        with open(path) as f:
+            cp.read_file(f)
+        for sec in ("global", section):
+            if cp.has_section(sec):
+                for k, v in cp.items(sec):
+                    self.set(k.replace(" ", "_"), v)
+
+    def load_args(self, args: list[str]) -> None:
+        """``--osd_subop_timeout 10 --wal_sync flush`` style pairs."""
+        i = 0
+        while i < len(args):
+            a = args[i]
+            if not a.startswith("--"):
+                raise ValueError(f"bad arg {a!r}")
+            name = a[2:].replace("-", "_")
+            if "=" in name:
+                name, val = name.split("=", 1)
+                i += 1
+            else:
+                if i + 1 >= len(args):
+                    raise ValueError(f"missing value for {a}")
+                val = args[i + 1]
+                i += 2
+            self.set(name, val)
+
+    # -- access
+    def get(self, name: str) -> Any:
+        return self._values[name]
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self.__dict__["_values"][name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def set(self, name: str, value: Any) -> None:
+        opt = self.options.get(name)
+        if opt is None:
+            raise KeyError(f"unknown option {name!r}")
+        coerced = opt.coerce(value)
+        self._values[name] = coerced
+        for cb in self._observers.get(name, []):
+            cb(name, coerced)
+
+    def observe(self, name: str, cb: Callable[[str, Any], None]) -> None:
+        """Register a change callback (md_config_obs_t analog)."""
+        if name not in self.options:
+            raise KeyError(f"unknown option {name!r}")
+        self._observers.setdefault(name, []).append(cb)
+
+    def show(self) -> dict[str, Any]:
+        """Every option with its current value (``config show``)."""
+        return dict(sorted(self._values.items()))
+
+    def diff(self) -> dict[str, Any]:
+        """Only options changed from their defaults (``config diff``)."""
+        return {
+            k: v for k, v in sorted(self._values.items())
+            if v != self.options[k].default
+        }

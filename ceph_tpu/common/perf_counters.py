@@ -1,0 +1,143 @@
+"""Typed performance counters (reference:src/common/perf_counters.{h,cc}).
+
+The reference registers per-subsystem ``PerfCounters`` objects (built
+with PerfCountersBuilder: u64 counters, gauges, time/long-run averages)
+in a per-daemon collection, dumpable via the admin socket as
+``perf dump``.  Same shape here; histograms are collapsed to
+(sum, count, min, max) averages — the consumers this framework has.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+COUNTER = "counter"   # monotonically increasing u64
+GAUGE = "gauge"       # set to arbitrary values
+AVG = "avg"           # (sum, count[, min, max]) pairs
+TIME_AVG = "time_avg"  # avg over elapsed seconds
+
+
+class PerfCounters:
+    """One subsystem's counters (e.g. 'osd', 'ec', 'messenger')."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._types: dict[str, str] = {}
+        self._vals: dict[str, Any] = {}
+        self._descs: dict[str, str] = {}
+
+    # -- builder (PerfCountersBuilder analog)
+    def add_counter(self, key: str, desc: str = "") -> "PerfCounters":
+        self._types[key] = COUNTER
+        self._vals[key] = 0
+        self._descs[key] = desc
+        return self
+
+    def add_gauge(self, key: str, desc: str = "") -> "PerfCounters":
+        self._types[key] = GAUGE
+        self._vals[key] = 0
+        self._descs[key] = desc
+        return self
+
+    def add_avg(self, key: str, desc: str = "") -> "PerfCounters":
+        self._types[key] = AVG
+        self._vals[key] = [0.0, 0, None, None]  # sum, count, min, max
+        self._descs[key] = desc
+        return self
+
+    def add_time_avg(self, key: str, desc: str = "") -> "PerfCounters":
+        self._types[key] = TIME_AVG
+        self._vals[key] = [0.0, 0, None, None]
+        self._descs[key] = desc
+        return self
+
+    # -- updates
+    def inc(self, key: str, by: int = 1) -> None:
+        with self._lock:
+            if self._types[key] != COUNTER:
+                raise TypeError(f"{key} is not a counter")
+            self._vals[key] += by
+
+    def set(self, key: str, value) -> None:
+        with self._lock:
+            if self._types[key] != GAUGE:
+                raise TypeError(f"{key} is not a gauge")
+            self._vals[key] = value
+
+    def observe(self, key: str, value: float) -> None:
+        with self._lock:
+            v = self._vals[key]
+            if self._types[key] not in (AVG, TIME_AVG):
+                raise TypeError(f"{key} is not an average")
+            v[0] += value
+            v[1] += 1
+            v[2] = value if v[2] is None else min(v[2], value)
+            v[3] = value if v[3] is None else max(v[3], value)
+
+    def time(self, key: str):
+        """Context manager observing elapsed seconds into a time_avg."""
+        counters = self
+
+        class _Timer:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                counters.observe(key, time.perf_counter() - self.t0)
+
+        return _Timer()
+
+    # -- read
+    def get(self, key: str):
+        with self._lock:
+            v = self._vals[key]
+            return list(v) if isinstance(v, list) else v
+
+    def dump(self) -> dict:
+        with self._lock:
+            out = {}
+            for key, t in self._types.items():
+                v = self._vals[key]
+                if t in (AVG, TIME_AVG):
+                    s, c, lo, hi = v
+                    out[key] = {
+                        "avgcount": c,
+                        "sum": s,
+                        "avg": (s / c) if c else 0.0,
+                        "min": lo,
+                        "max": hi,
+                    }
+                else:
+                    out[key] = v
+            return out
+
+
+class PerfCountersCollection:
+    """Per-daemon registry of PerfCounters (perf_counters_collection_t)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._subsystems: dict[str, PerfCounters] = {}
+
+    def create(self, name: str) -> PerfCounters:
+        with self._lock:
+            if name in self._subsystems:
+                return self._subsystems[name]
+            pc = PerfCounters(name)
+            self._subsystems[name] = pc
+            return pc
+
+    def get(self, name: str) -> PerfCounters | None:
+        return self._subsystems.get(name)
+
+    def dump(self) -> dict:
+        with self._lock:
+            return {
+                name: pc.dump() for name, pc in sorted(
+                    self._subsystems.items()
+                )
+            }

@@ -53,11 +53,18 @@ class Monitor(Dispatcher):
         self,
         name: str = "mon.0",
         max_osds: int = 16,
-        failure_min_reporters: int = 1,
+        failure_min_reporters: int | None = None,
+        config=None,
     ):
+        from ..common import Config
+
+        self.config = config or Config()
         self.name = name
         self.messenger = AsyncMessenger(name, self)
-        self.failure_min_reporters = failure_min_reporters
+        self.failure_min_reporters = (
+            self.config.mon_failure_min_reporters
+            if failure_min_reporters is None else failure_min_reporters
+        )
         self.osdmap = OSDMap(CrushMap.flat(max_osds))
         self.osdmap.set_max_osd(max_osds)
         self.osdmap.epoch = 1

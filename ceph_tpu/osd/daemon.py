@@ -66,7 +66,6 @@ EINVAL = 22
 ESTALE = 116
 
 OI_KEY = "_"  # object-info xattr (reference OI_ATTR)
-SUBOP_TIMEOUT = 30.0
 
 
 class WaiterBase:
@@ -148,21 +147,72 @@ class OSD(Dispatcher):
         osd_id: int,
         mon_addr: str,
         store: ObjectStore | None = None,
-        heartbeat_interval: float = 0.0,
-        heartbeat_grace: float = 3.0,
-        subop_timeout: float = SUBOP_TIMEOUT,
-        scrub_interval: float = 0.0,
+        heartbeat_interval: float | None = None,
+        heartbeat_grace: float | None = None,
+        subop_timeout: float | None = None,
+        scrub_interval: float | None = None,
+        config: "Config | None" = None,
     ):
+        from ..common import Config, PerfCountersCollection
+
+        self.config = config or Config()
+        cfg = self.config
         self.osd_id = osd_id
         self.name = f"osd.{osd_id}"
         self.mon_addr = mon_addr
         self.messenger = AsyncMessenger(self.name, self)
         self.store = store or MemStore()
-        self.subop_timeout = subop_timeout
+        self.subop_timeout = (
+            cfg.osd_subop_timeout if subop_timeout is None else subop_timeout
+        )
         self.osdmap: OSDMap | None = None
         self.addr = ""
-        self.heartbeat_interval = heartbeat_interval
-        self.heartbeat_grace = heartbeat_grace
+        self.heartbeat_interval = (
+            cfg.osd_heartbeat_interval
+            if heartbeat_interval is None else heartbeat_interval
+        )
+        self.heartbeat_grace = (
+            cfg.osd_heartbeat_grace
+            if heartbeat_grace is None else heartbeat_grace
+        )
+        # observability (reference:src/common/perf_counters.cc + the
+        # l_osd_* registrations in src/osd/OSD.cc)
+        self.perf = PerfCountersCollection()
+        posd = self.perf.create("osd")
+        posd.add_counter("op", "client ops")
+        posd.add_counter("op_r", "client reads")
+        posd.add_counter("op_w", "client mutations")
+        posd.add_counter("op_in_bytes", "client write payload bytes")
+        posd.add_counter("op_out_bytes", "client read payload bytes")
+        posd.add_counter("op_err", "client ops answered with an error")
+        posd.add_counter("subop_w", "sub-writes applied on this shard")
+        posd.add_time_avg("op_latency", "client op wall time")
+        pec = self.perf.create("ec")
+        pec.add_counter("encode_calls", "batched device encodes")
+        pec.add_counter("encode_bytes", "logical bytes encoded")
+        pec.add_counter("decode_calls", "batched device decodes")
+        pec.add_counter("decode_bytes", "shard bytes decoded")
+        prec = self.perf.create("recovery")
+        prec.add_counter("pushes", "objects/shards pushed")
+        pscrub = self.perf.create("scrub")
+        pscrub.add_counter("scrubs", "PG deep scrubs completed")
+        pscrub.add_counter("errors", "inconsistencies found")
+        pscrub.add_counter("repaired", "inconsistencies repaired")
+        self._inflight: dict[int, dict] = {}  # OpTracker-lite
+        self._op_seq = 0  # server-side tracker key (client tids collide)
+        self._historic: list[dict] = []
+        self._admin = None
+        # live knobs: without observers, admin-socket `config set` would
+        # change `config show` but not daemon behavior (review r2 finding)
+        cfg.observe(
+            "osd_subop_timeout",
+            lambda _n, v: setattr(self, "subop_timeout", v),
+        )
+        cfg.observe(
+            "osd_heartbeat_grace",
+            lambda _n, v: setattr(self, "heartbeat_grace", v),
+        )
+        cfg.observe("osd_scrub_interval", self._on_scrub_interval)
         self._codecs: dict[int, tuple[Any, StripeInfo]] = {}
         self._tid = 0
         self._write_waiters: dict[int, _Waiter] = {}
@@ -179,7 +229,18 @@ class OSD(Dispatcher):
         from .scrub import ScrubManager
 
         self.recovery = RecoveryManager(self)
-        self.scrub = ScrubManager(self, interval=scrub_interval)
+        self.scrub = ScrubManager(
+            self,
+            interval=(
+                cfg.osd_scrub_interval
+                if scrub_interval is None else scrub_interval
+            ),
+        )
+
+    def _on_scrub_interval(self, _name: str, value: float) -> None:
+        self.scrub.interval = value
+        if value > 0:
+            self.scrub.start()  # no-op if already running
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -202,7 +263,70 @@ class OSD(Dispatcher):
         self.recovery.start()
         self.recovery.kick()  # reconcile whatever the map says we lead
         self.scrub.start()
+        await self._start_admin_socket()
         return self.addr
+
+    async def _start_admin_socket(self) -> None:
+        """`ceph daemon osd.N <cmd>` surface (reference admin_socket.cc);
+        enabled when the ``admin_socket`` option is set ('{name}' expands
+        to this daemon's name)."""
+        path = self.config.admin_socket
+        if not path:
+            return
+        from ..common import AdminSocket
+
+        self._admin = AdminSocket(path.replace("{name}", self.name))
+        a = self._admin
+        a.register("perf dump", lambda req: self.perf.dump(),
+                   "typed performance counters")
+        a.register("config show", lambda req: self.config.show(),
+                   "every option with its current value")
+        a.register("config diff", lambda req: self.config.diff(),
+                   "options changed from defaults")
+
+        def _config_set(req: dict):
+            self.config.set(req["name"], req["value"])
+            return {"success": f"{req['name']} = {self.config.get(req['name'])}"}
+
+        a.register("config set", _config_set, "set one option at runtime")
+        def _ops_in_flight(_req: dict) -> dict:
+            now = time.monotonic()
+            ops = []
+            for o in self._inflight.values():
+                entry = {k: v for k, v in o.items() if k != "_t0"}
+                entry["age"] = now - o["_t0"]
+                ops.append(entry)
+            return {"num_ops": len(ops), "ops": ops}
+
+        a.register(
+            "dump_ops_in_flight", _ops_in_flight,
+            "client ops currently executing",
+        )
+        a.register(
+            "dump_historic_ops",
+            lambda req: {"ops": list(self._historic)},
+            "recently completed client ops",
+        )
+        a.register(
+            "status",
+            lambda req: {
+                "name": self.name,
+                "addr": self.addr,
+                "epoch": self._epoch(),
+                "pgs_led": sum(
+                    1 for _ in self._led_pgs()
+                ) if self.osdmap else 0,
+            },
+            "daemon identity and map epoch",
+        )
+        await a.start()
+
+    def _led_pgs(self):
+        for pool in self.osdmap.pools.values():
+            for pg in self.osdmap.pgs_of_pool(pool.id):
+                _u, _up, _a, primary = self.osdmap.pg_to_up_acting_osds(pg)
+                if primary == self.osd_id:
+                    yield pg
 
     async def stop(self, umount: bool = True) -> None:
         """``umount=False`` models a hard crash: the store is abandoned
@@ -215,6 +339,9 @@ class OSD(Dispatcher):
             self._hb_task.cancel()
         for t in list(self._tasks):
             t.cancel()
+        if self._admin is not None:
+            await self._admin.stop()
+            self._admin = None
         await self.messenger.shutdown()
         if umount:
             self.store.umount()
@@ -317,14 +444,51 @@ class OSD(Dispatcher):
 
     # -- client op engine (reference:PrimaryLogPG::do_osd_ops) ----------------
 
+    _WRITE_OPS = frozenset(
+        ("writefull", "write", "append", "zero", "truncate", "delete")
+    )
+
     async def _handle_client_op(self, conn: Connection, msg: messages.MOSDOp) -> None:
+        posd = self.perf.get("osd")
+        posd.inc("op")
+        names = [op.get("op") for op in msg.ops]
+        if any(n in self._WRITE_OPS for n in names):
+            posd.inc("op_w")
+            posd.inc("op_in_bytes", sum(len(b) for b in msg.blobs))
+        if any(n == "read" for n in names):
+            posd.inc("op_r")
+        self._op_seq += 1
+        seq = self._op_seq  # server-side key: client tids collide
+        track = {
+            "tid": msg.tid, "oid": msg.oid, "pool": msg.pool,
+            "ops": names, "_t0": time.monotonic(),
+        }
+        self._inflight[seq] = track
+        completed = False
         try:
-            result, out, blobs = await self._execute_op(msg)
-        except asyncio.CancelledError:
-            raise
-        except Exception as e:
-            logger.exception("%s: op tid=%s failed", self.name, msg.tid)
-            result, out, blobs = -EIO, [{"error": str(e)}], []
+            with posd.time("op_latency"):
+                try:
+                    result, out, blobs = await self._execute_op(msg)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    logger.exception("%s: op tid=%s failed", self.name, msg.tid)
+                    result, out, blobs = -EIO, [{"error": str(e)}], []
+            completed = True
+        finally:
+            done = self._inflight.pop(seq, None)
+            # cancelled ops (daemon stopping) never replied: they must not
+            # masquerade as completed in dump_historic_ops
+            if done is not None and completed:
+                done["duration"] = time.monotonic() - done.pop("_t0")
+                self._historic.append(done)
+                del self._historic[:-20]  # keep the newest 20
+        if result < 0:
+            posd.inc("op_err")
+        else:
+            posd.inc(
+                "op_out_bytes", sum(len(b) for b in blobs)
+            )
         conn.send(
             messages.MOSDOpReply(
                 tid=msg.tid, result=result, epoch=self._epoch(), out=out,
@@ -542,6 +706,9 @@ class OSD(Dispatcher):
             buf = ec_transaction.merge_extents(plan, sinfo, old_exts, offset, data)
             shard_bufs = ec_util.encode(sinfo, codec, buf)
             c_off = sinfo.aligned_logical_offset_to_chunk_offset(plan.will_write[0])
+            pec = self.perf.get("ec")
+            pec.inc("encode_calls")
+            pec.inc("encode_bytes", len(buf))
 
         # per-stripe crc table + object info (overwrite-safe HashInfo)
         if opname == "writefull" or hashes is None or (
@@ -727,6 +894,7 @@ class OSD(Dispatcher):
             return 0
         try:
             self.store.apply(txn)
+            self.perf.get("osd").inc("subop_w")
             return 0
         except Exception:
             logger.exception("%s: sub-write apply failed", self.name)
@@ -892,6 +1060,9 @@ class OSD(Dispatcher):
                 end = size if length < 0 else min(off + length, size)
                 if off >= end:
                     return 0, b""
+                pec = self.perf.get("ec")
+                pec.inc("decode_calls")
+                pec.inc("decode_bytes", sum(c.size for c in chunks.values()))
                 logical = ec_util.decode_concat(sinfo, codec, chunks)
                 return 0, logical[off - s0 : end - s0]
             # else: a shard failed mid-read — loop retries with survivors

@@ -64,7 +64,6 @@ class RecoveryManager:
         self._task: asyncio.Task | None = None
         self._wakeup = asyncio.Event()
         self._retry_needed = False
-        self.recoveries_done = 0  # observable progress counter
 
     def start(self) -> None:
         if self._task is None:
@@ -74,6 +73,12 @@ class RecoveryManager:
         if self._task is not None:
             self._task.cancel()
             self._task = None
+
+    @property
+    def recoveries_done(self) -> int:
+        """Pushes completed — reads through the perf counter so the
+        manager and `perf dump` can never disagree."""
+        return self.osd.perf.get("recovery").get("pushes")
 
     def kick(self) -> None:
         """Called on every new map epoch."""
@@ -317,7 +322,7 @@ class RecoveryManager:
                     osd.name, soid, member,
                 )
                 if await self._push_txn(pg, shard_field, member, txn, entry):
-                    self.recoveries_done += 1
+                    self.osd.perf.get("recovery").inc("pushes")
 
     async def _repair_object(
         self, pg: PGid, pool: Pool, erasure: bool,
@@ -466,7 +471,7 @@ class RecoveryManager:
                     osd.name, soid, key, member, version,
                 )
                 if await self._push_txn(pg, key, member, txn, entry):
-                    self.recoveries_done += 1
+                    self.osd.perf.get("recovery").inc("pushes")
         else:
             # replicated: push the whole object from a healthy member
             cid = CollectionId(str(pg))
@@ -507,7 +512,7 @@ class RecoveryManager:
                 if await self.push_replica_object(
                     pg, member, oid, data, attrs or {}, entry
                 ):
-                    self.recoveries_done += 1
+                    self.osd.perf.get("recovery").inc("pushes")
 
     async def push_replica_object(
         self, pg: PGid, member: int, oid: str, data: bytes,

@@ -48,9 +48,20 @@ class ScrubManager:
         self.osd = osd
         self.interval = interval
         self._task: asyncio.Task | None = None
-        self.scrubs_done = 0
-        self.errors_found = 0
-        self.errors_repaired = 0
+
+    # stats read through the perf counters so the manager and `perf dump`
+    # can never disagree (review r2 finding)
+    @property
+    def scrubs_done(self) -> int:
+        return self.osd.perf.get("scrub").get("scrubs")
+
+    @property
+    def errors_found(self) -> int:
+        return self.osd.perf.get("scrub").get("errors")
+
+    @property
+    def errors_repaired(self) -> int:
+        return self.osd.perf.get("scrub").get("repaired")
 
     def start(self) -> None:
         if self.interval > 0 and self._task is None:
@@ -63,10 +74,12 @@ class ScrubManager:
 
     async def _loop(self) -> None:
         try:
-            while True:
+            while self.interval > 0:  # config set to 0 stops the loop
                 await asyncio.sleep(self.interval)
                 try:
-                    await self.scrub_all()
+                    await self.scrub_all(
+                        repair=self.osd.config.osd_scrub_auto_repair
+                    )
                 except asyncio.CancelledError:
                     raise
                 except Exception:
@@ -75,6 +88,8 @@ class ScrubManager:
                     )
         except asyncio.CancelledError:
             pass
+        finally:
+            self._task = None  # allow a restart when re-enabled
 
     async def scrub_all(self, repair: bool = True) -> list[dict]:
         """Scrub every PG this OSD is primary for."""
@@ -104,9 +119,10 @@ class ScrubManager:
             report = await self._scrub_ec(pg, pool, acting, repair)
         else:
             report = await self._scrub_replicated(pg, pool, acting, repair)
-        self.scrubs_done += 1
-        self.errors_found += len(report["errors"])
-        self.errors_repaired += report["repaired"]
+        pscrub = self.osd.perf.get("scrub")
+        pscrub.inc("scrubs")
+        pscrub.inc("errors", len(report["errors"]))
+        pscrub.inc("repaired", report["repaired"])
         report["clean"] = not report["errors"]
         return report
 

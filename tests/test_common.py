@@ -1,0 +1,218 @@
+"""Config / perf counters / admin socket tests.
+
+Mirrors the reference intents: layered typed config with observers
+(reference:src/common/config.cc), typed counters dumpable as `perf dump`
+(reference:src/common/perf_counters.cc), and the per-daemon admin socket
+command surface (reference:src/common/admin_socket.cc) — including the
+e2e contract from SURVEY §7 step 7: `perf dump` returns LIVE counters
+from a running cluster.
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from ceph_tpu.common import Config, PerfCounters, PerfCountersCollection
+from ceph_tpu.common.admin_socket import admin_command
+from ceph_tpu.rados import MiniCluster
+
+
+# -- config ------------------------------------------------------------------
+
+
+def test_config_defaults_and_types():
+    c = Config()
+    assert c.osd_subop_timeout == 30.0
+    assert c.mon_failure_min_reporters == 1
+    assert isinstance(c.osd_scrub_auto_repair, bool)
+
+
+def test_config_precedence(tmp_path):
+    ini = tmp_path / "ceph.conf"
+    ini.write_text(
+        "[global]\nosd_subop_timeout = 7\n"
+        "[osd]\nosd_heartbeat_grace = 9\n"
+    )
+    c = Config(
+        overrides={"osd_heartbeat_grace": 11},
+        conf_file=str(ini),
+        section="osd",
+        env="--osd_subop_timeout 8",
+    )
+    # env beats file; constructor overrides beat env/file
+    assert c.osd_subop_timeout == 8.0
+    assert c.osd_heartbeat_grace == 11.0
+
+
+def test_config_set_validates_and_notifies():
+    c = Config()
+    seen = []
+    c.observe("osd_scrub_interval", lambda n, v: seen.append((n, v)))
+    c.set("osd_scrub_interval", "2.5")
+    assert c.osd_scrub_interval == 2.5
+    assert seen == [("osd_scrub_interval", 2.5)]
+    with pytest.raises(KeyError):
+        c.set("no_such_option", 1)
+    with pytest.raises(ValueError):
+        c.set("osd_scrub_auto_repair", "maybe")
+    assert c.diff() == {"osd_scrub_interval": 2.5}
+
+
+def test_config_args_equals_form():
+    c = Config(env="--wal_sync=flush --osd_client_op_retries=3")
+    assert c.wal_sync == "flush"
+    assert c.osd_client_op_retries == 3
+
+
+# -- perf counters -----------------------------------------------------------
+
+
+def test_perf_counter_types():
+    pc = PerfCounters("t")
+    pc.add_counter("ops").add_gauge("depth").add_avg("size")
+    pc.inc("ops")
+    pc.inc("ops", 4)
+    pc.set("depth", 7)
+    pc.observe("size", 10.0)
+    pc.observe("size", 30.0)
+    d = pc.dump()
+    assert d["ops"] == 5
+    assert d["depth"] == 7
+    assert d["size"] == {
+        "avgcount": 2, "sum": 40.0, "avg": 20.0, "min": 10.0, "max": 30.0,
+    }
+    with pytest.raises(TypeError):
+        pc.inc("depth")
+
+
+def test_perf_time_avg():
+    pc = PerfCounters("t")
+    pc.add_time_avg("lat")
+    with pc.time("lat"):
+        pass
+    d = pc.dump()["lat"]
+    assert d["avgcount"] == 1 and d["sum"] >= 0
+
+
+def test_collection_dump_groups_subsystems():
+    coll = PerfCountersCollection()
+    coll.create("a").add_counter("x")
+    coll.create("b").add_counter("y")
+    coll.get("a").inc("x")
+    assert coll.dump() == {"a": {"x": 1}, "b": {"y": 0}}
+
+
+# -- admin socket e2e --------------------------------------------------------
+
+
+def test_admin_socket_live_cluster(tmp_path):
+    """SURVEY step-7 contract: a running OSD's admin socket answers
+    `perf dump` with live counters, `config show/set`, op dumps."""
+
+    async def main():
+        from ceph_tpu.osd.daemon import OSD
+
+        sock_dir = str(tmp_path / "asok")
+        async with MiniCluster(n_osds=3) as cluster:
+            # restart osd.0 with an admin socket enabled
+            await cluster.kill_osd(0)
+            cfg = Config(overrides={
+                "admin_socket": os.path.join(sock_dir, "{name}.asok"),
+            })
+            osd = OSD(0, cluster.mon.addr, store=cluster.stores[0], config=cfg)
+            await osd.start()
+            cluster.osds[0] = osd
+            path = os.path.join(sock_dir, "osd.0.asok")
+
+            client = await cluster.client()
+            await client.create_pool("ecpool", "erasure")
+            io = client.io_ctx("ecpool")
+            pool = client.osdmap.lookup_pool("ecpool")
+            # deterministic: use object names whose PG primary is osd.0
+            names = []
+            i = 0
+            while len(names) < 4:
+                name = f"o{i}"
+                _pg, _acting, primary = client.osdmap.object_to_acting(
+                    name, pool.id
+                )
+                if primary == 0:
+                    names.append(name)
+                i += 1
+            payload = os.urandom(2048)
+            for name in names:
+                await io.write_full(name, payload)
+            for name in names:
+                assert await io.read(name) == payload
+
+            perf = await admin_command(path, "perf dump")
+            assert perf["osd"]["op"] > 0
+            assert perf["osd"]["op_w"] > 0
+            assert perf["osd"]["op_in_bytes"] > 0
+            assert perf["osd"]["subop_w"] > 0
+            assert perf["osd"]["op_latency"]["avgcount"] > 0
+            # osd.0 was the primary for every write: the EC hot path moved
+            assert perf["ec"]["encode_calls"] > 0
+            assert perf["ec"]["encode_bytes"] > 0
+            assert perf["ec"]["decode_calls"] > 0
+
+            cfgshow = await admin_command(path, "config show")
+            assert cfgshow["osd_subop_timeout"] == 30.0
+            r = await admin_command(
+                path, "config set", name="osd_subop_timeout", value=9,
+            )
+            assert "success" in r
+            assert (await admin_command(path, "config show"))[
+                "osd_subop_timeout"
+            ] == 9.0
+            # the knob is LIVE, not just recorded (observer wired)
+            assert osd.subop_timeout == 9.0
+
+            ops = await admin_command(path, "dump_ops_in_flight")
+            assert ops["num_ops"] == 0  # quiesced
+            hist = await admin_command(path, "dump_historic_ops")
+            assert len(hist["ops"]) > 0
+            assert all("duration" in o for o in hist["ops"])
+
+            status = await admin_command(path, "status")
+            assert status["name"] == "osd.0" and status["epoch"] > 0
+
+            help_ = await admin_command(path, "help")
+            assert "perf dump" in help_
+            bad = await admin_command(path, "no such thing")
+            assert "error" in bad
+
+    asyncio.run(main())
+
+
+def test_admin_socket_scrub_counters(tmp_path):
+    async def main():
+        from ceph_tpu.osd.daemon import OSD
+
+        async with MiniCluster(n_osds=3) as cluster:
+            for osd_id in list(cluster.osds):
+                await cluster.kill_osd(osd_id)
+            cfg = Config(overrides={
+                "admin_socket": os.path.join(str(tmp_path), "{name}.asok"),
+            })
+            for osd_id in range(3):
+                osd = OSD(
+                    osd_id, cluster.mon.addr,
+                    store=cluster.stores[osd_id], config=cfg,
+                )
+                await osd.start()
+                cluster.osds[osd_id] = osd
+            client = await cluster.client()
+            await client.create_pool("rep", "replicated", size=2)
+            io = client.io_ctx("rep")
+            await io.write_full("x", b"scrubme" * 100)
+            await client.scrub_pool("rep")
+            total = 0
+            for osd_id in range(3):
+                p = os.path.join(str(tmp_path), f"osd.{osd_id}.asok")
+                perf = await admin_command(p, "perf dump")
+                total += perf["scrub"]["scrubs"]
+            assert total > 0
+
+    asyncio.run(main())
