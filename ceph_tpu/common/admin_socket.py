@@ -54,7 +54,10 @@ class AdminSocket:
 
     async def _serve(self, reader, writer) -> None:
         try:
-            raw = await reader.read(1 << 20)
+            # read to EOF (the client write_eof()s after the request): a
+            # single read(n) returns the first segment, truncating large
+            # requests that span socket buffers
+            raw = await reader.read()
             try:
                 req = json.loads(raw or b"{}")
                 prefix = req.get("prefix", "")

@@ -151,16 +151,16 @@ class Config:
             a = args[i]
             if not a.startswith("--"):
                 raise ValueError(f"bad arg {a!r}")
-            name = a[2:].replace("-", "_")
+            name = a[2:]
             if "=" in name:
-                name, val = name.split("=", 1)
-                i += 1
+                name, val = name.split("=", 1)  # value BEFORE normalizing:
+                i += 1                           # it may contain hyphens
             else:
                 if i + 1 >= len(args):
                     raise ValueError(f"missing value for {a}")
                 val = args[i + 1]
                 i += 2
-            self.set(name, val)
+            self.set(name.replace("-", "_"), val)
 
     # -- access
     def get(self, name: str) -> Any:
@@ -186,6 +186,13 @@ class Config:
         if name not in self.options:
             raise KeyError(f"unknown option {name!r}")
         self._observers.setdefault(name, []).append(cb)
+
+    def unobserve(self, name: str, cb: Callable[[str, Any], None]) -> None:
+        """Remove a callback (daemons MUST unregister on stop: a shared
+        Config would otherwise keep firing actions on dead daemons)."""
+        cbs = self._observers.get(name, [])
+        if cb in cbs:
+            cbs.remove(cb)
 
     def show(self) -> dict[str, Any]:
         """Every option with its current value (``config show``)."""

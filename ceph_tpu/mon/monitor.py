@@ -1,12 +1,10 @@
-"""Monitor: authoritative OSDMap service.
+"""Monitor: authoritative OSDMap service, single- or multi-mon.
 
 Re-expression of the reference control plane for the mini-cluster:
 
 - map mutations bump the epoch and are pushed to every subscriber
   (reference OSDMonitor maintains the map inside Paxos and clients
-  subscribe via MMonSubscribe; here the mon is a single process so the
-  Paxos log collapses to in-process mutation order —
-  reference:src/mon/OSDMonitor.cc).
+  subscribe via MMonSubscribe — reference:src/mon/OSDMonitor.cc).
 - OSD boot reports mark the osd up (reference:src/mon/OSDMonitor.cc
   prepare_boot); failure reports from peers mark it down once enough
   distinct reporters agree (reference:src/mon/OSDMonitor.cc
@@ -17,12 +15,35 @@ Re-expression of the reference control plane for the mini-cluster:
 - a connection reset from a booted OSD is treated as an immediate
   failure signal (the mini-cluster analog of heartbeat-grace expiry —
   the TCP FIN arrives faster than any ping schedule on loopback).
+
+Multi-mon (reference:src/mon/Paxos.cc + Elector.cc, collapsed to a
+leader-driven majority-ack log over full-map snapshots — "Paxos-lite"):
+
+- election: lowest reachable rank wins (the reference Elector's rule).
+  A proposer gathers acks; acks carry the responder's committed map so
+  the winner adopts the newest state before taking over (the Paxos
+  recovery phase); victory broadcasts the adopted map.
+- commits: the leader proposes the new map to its peers and applies it
+  only after a MAJORITY of the monmap (counting itself) acked — then
+  broadcasts the commit, and every mon pushes the map to its own
+  subscribers.  No quorum -> mutations fail with -EAGAIN (CP behavior).
+- leases: the leader pings peons every mon_lease_interval; silence past
+  mon_election_timeout starts a new election.
+- forwarding: OSD boot/failure reports arriving at a peon are forwarded
+  to the leader; client commands at a peon are redirected (the reply
+  names the leader and the client re-targets).
+- durability: with ``store_path`` every committed map is written
+  write-tmp/rename (MonitorDBStore-lite) and reloaded on restart, so
+  pools/profiles survive a full-cluster restart.
 """
 
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
+import os
+import time
 from typing import Any
 
 from ..crush.map import CrushMap
@@ -36,6 +57,9 @@ logger = logging.getLogger("ceph_tpu.mon")
 EINVAL = 22
 ENOENT = 2
 EEXIST = 17
+EAGAIN = 11
+
+MON_REPORTER_BASE = 1_000_000  # synthetic reporter ids for forwarding mons
 
 DEFAULT_EC_PROFILE = {
     # reference:src/common/config_opts.h:677 osd_pool_default_erasure_code_profile
@@ -44,6 +68,20 @@ DEFAULT_EC_PROFILE = {
     "k": "2",
     "m": "1",
 }
+
+
+
+def _bg(coro) -> asyncio.Task:
+    """Fire-and-forget task that logs (instead of leaking or raising on
+    cancellation) its terminal exception."""
+    t = asyncio.ensure_future(coro)
+
+    def _done(t: asyncio.Task) -> None:
+        if not t.cancelled() and t.exception() is not None:
+            logger.error("mon background task failed", exc_info=t.exception())
+
+    t.add_done_callback(_done)
+    return t
 
 
 class Monitor(Dispatcher):
@@ -55,6 +93,8 @@ class Monitor(Dispatcher):
         max_osds: int = 16,
         failure_min_reporters: int | None = None,
         config=None,
+        rank: int = 0,
+        store_path: str | None = None,
     ):
         from ..common import Config
 
@@ -73,32 +113,150 @@ class Monitor(Dispatcher):
         self._boot_conns: dict[int, Connection] = {}  # osd id -> its conn
         self._failure_reports: dict[int, set[int]] = {}  # target -> reporters
         self.addr = ""
+        # -- quorum state
+        self.rank = rank
+        self.monmap: list[str] = []  # addrs by rank ([] / [self] = solo)
+        self.leader_rank: int | None = 0 if rank == 0 else None
+        self.election_epoch = 0
+        self.store_path = store_path
+        self._pending_commit: dict[int, dict] = {}  # version -> map value
+        self._lease_task: asyncio.Task | None = None
+        self._watch_task: asyncio.Task | None = None
+        self._last_lease = time.monotonic()
+        self._election_acks: dict[int, messages.MMonElection] = {}
+        self._paxos_acks: dict[int, set[int]] = {}  # version -> ranks
+        self._paxos_events: dict[int, asyncio.Event] = {}
+        self._electing = False
+        self._election_task: asyncio.Task | None = None
+        self._commit_lock = asyncio.Lock()
+        if store_path:
+            self._load_store()
+
+    # -- quorum helpers -------------------------------------------------------
+
+    @property
+    def is_leader(self) -> bool:
+        return self.leader_rank == self.rank
+
+    @property
+    def solo(self) -> bool:
+        return len(self.monmap) <= 1
+
+    def _majority(self) -> int:
+        return len(self.monmap) // 2 + 1
+
+    def _peer_ranks(self):
+        return [r for r in range(len(self.monmap)) if r != self.rank]
+
+    async def _peer_conn(self, r: int) -> Connection:
+        return await self.messenger.connect(self.monmap[r], f"mon.{r}")
+
+    async def _send_peer(self, r: int, msg: Message) -> bool:
+        try:
+            (await self._peer_conn(r)).send(msg)
+            return True
+        except (ConnectionError, OSError):
+            return False
+
+    def set_monmap(self, addrs: list[str]) -> None:
+        self.monmap = list(addrs)
+        if self.solo:
+            self.leader_rank = self.rank
+        else:
+            self.leader_rank = None
 
     # -- lifecycle
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
         self.addr = await self.messenger.bind(host, port)
         return self.addr
 
+    async def start_quorum(self) -> None:
+        """Begin elections/lease-watching (call once every mon is bound
+        and set_monmap ran).  Solo mons lead immediately; multi-mon
+        elections run in the background (a partitioned mon keeps
+        retrying forever — callers must not block on that)."""
+        if self.solo:
+            self.leader_rank = self.rank
+            return
+        self._watch_task = asyncio.ensure_future(self._lease_watchdog())
+        self._election_task = _bg(self._start_election())
+
     async def stop(self) -> None:
+        for t in (self._lease_task, self._watch_task, self._election_task):
+            if t is not None:
+                t.cancel()
+        self._lease_task = self._watch_task = self._election_task = None
         await self.messenger.shutdown()
+
+    # -- persistence (MonitorDBStore-lite) -----------------------------------
+
+    def _save_store(self) -> None:
+        if not self.store_path:
+            return
+        tmp = self.store_path + ".tmp"
+        os.makedirs(os.path.dirname(self.store_path) or ".", exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump({
+                "election_epoch": self.election_epoch,
+                "osdmap": self.osdmap.to_dict(),
+            }, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.store_path)
+
+    def _load_store(self) -> None:
+        try:
+            with open(self.store_path) as f:
+                data = json.load(f)
+        except FileNotFoundError:
+            return
+        self.osdmap = OSDMap.from_dict(data["osdmap"])
+        self.election_epoch = int(data.get("election_epoch", 0))
+        logger.info(
+            "%s: restored map epoch %d from %s",
+            self.name, self.osdmap.epoch, self.store_path,
+        )
 
     # -- dispatch
     async def ms_dispatch(self, conn: Connection, msg: Message) -> None:
+        # mutating handlers run as tasks: dispatch is serialized per
+        # connection, and a handler awaiting a Paxos ack that arrives on
+        # the SAME connection (forwarded reports ride the mon-peer conn)
+        # would deadlock the reader loop (review r2 finding)
         if isinstance(msg, messages.MOSDBoot):
-            self._handle_boot(conn, msg)
+            _bg(self._handle_boot(conn, msg))
         elif isinstance(msg, messages.MOSDFailure):
-            self._handle_failure(msg)
+            _bg(self._handle_failure(msg))
         elif isinstance(msg, messages.MMonGetMap):
             self._subs.add(conn)
             if msg.have is None or msg.have < self.osdmap.epoch:
                 self._send_map(conn)
+        elif isinstance(msg, messages.MOSDMapMsg):
+            # a newer committed map from the leader (peon catch-up)
+            if msg.epoch > self.osdmap.epoch:
+                self.osdmap = OSDMap.from_dict(msg.osdmap)
+                self._save_store()
+                self._publish_subs()
         elif isinstance(msg, messages.MMonCommand):
-            code, status, out = self.handle_command(msg.cmd)
-            conn.send(
-                messages.MMonCommandReply(
-                    tid=msg.tid, code=code, status=status, out=out
-                )
-            )
+            if not self.is_leader and not self.solo:
+                # redirect: the client re-targets the leader (reference
+                # forwards via PaxosService; a redirect keeps the mon lean)
+                lr = self.leader_rank
+                conn.send(messages.MMonCommandReply(
+                    tid=msg.tid, code=-EAGAIN, status="not leader",
+                    out={
+                        "leader": lr,
+                        "addr": self.monmap[lr] if lr is not None else None,
+                    },
+                ))
+                return
+            _bg(self._command_and_reply(conn, msg))
+        elif isinstance(msg, messages.MMonElection):
+            await self._handle_election(msg)
+        elif isinstance(msg, messages.MMonPaxos):
+            await self._handle_paxos(msg)
+        elif isinstance(msg, messages.MMonLease):
+            self._handle_lease(msg)
         elif isinstance(msg, messages.MPing):
             conn.send(messages.MPingReply(stamp=msg.stamp, epoch=self.osdmap.epoch))
 
@@ -109,49 +267,312 @@ class Monitor(Dispatcher):
                 del self._boot_conns[osd]
                 if self.osdmap.is_up(osd):
                     logger.info("%s: osd.%d connection reset -> down", self.name, osd)
-                    self.osdmap.mark_down(osd)
-                    self._publish()
+                    _bg(self._report_down(osd, MON_REPORTER_BASE + self.rank))
+
+    async def _report_down(self, osd: int, reporter: int) -> None:
+        """Route a locally-observed OSD death like any failure report:
+        handled if we lead, forwarded to the leader if not."""
+        await self._handle_failure(
+            messages.MOSDFailure(
+                target_osd=osd, reporter=reporter, epoch=self.osdmap.epoch
+            )
+        )
+
+    # -- election (reference:src/mon/Elector.cc, lowest rank wins) -----------
+
+    async def _start_election(self) -> None:
+        if self._electing:
+            return
+        self._electing = True
+        try:
+            while True:
+                self.election_epoch += 1
+                self.leader_rank = None
+                self._election_acks = {}
+                epoch = self.election_epoch
+                logger.info(
+                    "%s: starting election epoch %d", self.name, epoch
+                )
+                for r in self._peer_ranks():
+                    await self._send_peer(r, messages.MMonElection(
+                        op="propose", epoch=epoch, rank=self.rank,
+                        map_epoch=self.osdmap.epoch, osdmap=None,
+                    ))
+                await asyncio.sleep(self.config.mon_election_timeout / 2)
+                if self.leader_rank is not None:
+                    return  # lost to a lower rank (victory arrived)
+                acks = dict(self._election_acks)
+                if 1 + len(acks) >= self._majority():
+                    # acks may carry higher epochs from peers that saw later
+                    # elections: adopt the max so our victory outranks every
+                    # stale view (otherwise a rejoining rank-0 mon's victory
+                    # is ignored and the quorum split-brains)
+                    self.election_epoch = max(
+                        [self.election_epoch]
+                        + [a.epoch for a in acks.values()]
+                    )
+                    await self._declare_victory(self.election_epoch, acks)
+                    return
+                # no quorum reachable: keep trying (cluster is down anyway)
+                await asyncio.sleep(self.config.mon_election_timeout / 2)
+        finally:
+            self._electing = False
+
+    async def _declare_victory(self, epoch: int, acks) -> None:
+        # adopt the newest committed map in the quorum (Paxos recovery)
+        for ack in acks.values():
+            if ack.map_epoch > self.osdmap.epoch and ack.osdmap:
+                self.osdmap = OSDMap.from_dict(ack.osdmap)
+        self.leader_rank = self.rank
+        self._save_store()
+        logger.info(
+            "%s: won election epoch %d (map epoch %d)",
+            self.name, epoch, self.osdmap.epoch,
+        )
+        for r in self._peer_ranks():
+            await self._send_peer(r, messages.MMonElection(
+                op="victory", epoch=epoch, rank=self.rank,
+                map_epoch=self.osdmap.epoch, osdmap=self.osdmap.to_dict(),
+            ))
+        if self._lease_task is None:
+            self._lease_task = asyncio.ensure_future(self._lease_loop())
+        self._publish_subs()
+
+    async def _handle_election(self, msg: messages.MMonElection) -> None:
+        if msg.op == "propose":
+            if msg.rank < self.rank:
+                # defer to the lower rank; the ack carries our committed
+                # map (recovery) and our election epoch (the proposer
+                # adopts the max, so its victory outranks stale views)
+                self.election_epoch = max(self.election_epoch, msg.epoch)
+                self.leader_rank = None
+                self._stop_leading()
+                self._last_lease = time.monotonic()  # give it time to win
+                await self._send_peer(msg.rank, messages.MMonElection(
+                    op="ack", epoch=self.election_epoch, rank=self.rank,
+                    map_epoch=self.osdmap.epoch,
+                    osdmap=self.osdmap.to_dict(),
+                ))
+            else:
+                # a higher rank proposing: we should lead instead
+                if self.is_leader:
+                    # remind the prospective usurper who leads — at ITS
+                    # epoch (or ours if higher), else it ignores the
+                    # victory as stale and loops forever
+                    self.election_epoch = max(
+                        self.election_epoch, msg.epoch
+                    )
+                    await self._send_peer(msg.rank, messages.MMonElection(
+                        op="victory", epoch=self.election_epoch,
+                        rank=self.rank, map_epoch=self.osdmap.epoch,
+                        osdmap=self.osdmap.to_dict(),
+                    ))
+                elif not self._electing:
+                    self._election_task = _bg(self._start_election())
+        elif msg.op == "ack":
+            if msg.epoch >= self.election_epoch:
+                self._election_acks[msg.rank] = msg
+        elif msg.op == "victory":
+            if msg.rank <= self.rank and msg.epoch >= self.election_epoch:
+                self.election_epoch = msg.epoch
+                self.leader_rank = msg.rank
+                self._stop_leading()
+                self._last_lease = time.monotonic()
+                if msg.map_epoch > self.osdmap.epoch and msg.osdmap:
+                    self.osdmap = OSDMap.from_dict(msg.osdmap)
+                    self._save_store()
+                    self._publish_subs()
+                logger.info(
+                    "%s: mon.%d leads (election epoch %d)",
+                    self.name, msg.rank, msg.epoch,
+                )
+
+    def _stop_leading(self) -> None:
+        if self._lease_task is not None:
+            self._lease_task.cancel()
+            self._lease_task = None
+
+    # -- leases ---------------------------------------------------------------
+
+    async def _lease_loop(self) -> None:
+        try:
+            while self.is_leader:
+                for r in self._peer_ranks():
+                    await self._send_peer(r, messages.MMonLease(
+                        epoch=self.election_epoch, rank=self.rank,
+                        map_epoch=self.osdmap.epoch,
+                    ))
+                await asyncio.sleep(self.config.mon_lease_interval)
+        except asyncio.CancelledError:
+            pass
+
+    def _handle_lease(self, msg: messages.MMonLease) -> None:
+        if msg.rank == self.leader_rank or (
+            self.leader_rank is None
+            and msg.epoch >= self.election_epoch
+            and msg.rank <= self.rank
+        ):
+            # a live lease from the (or a credible) leader: adopt + renew
+            self.leader_rank = msg.rank
+            self.election_epoch = max(self.election_epoch, msg.epoch)
+            self._last_lease = time.monotonic()
+            if msg.map_epoch > self.osdmap.epoch:
+                # we missed a commit (transient partition): pull the map
+                # from the leader — its MMonGetMap path replies with the
+                # full snapshot and keeps us subscribed
+                _bg(self._send_peer(msg.rank, messages.MMonGetMap(
+                    have=self.osdmap.epoch
+                )))
+
+    async def _lease_watchdog(self) -> None:
+        try:
+            while True:
+                await asyncio.sleep(self.config.mon_election_timeout / 2)
+                if self.is_leader or self._electing:
+                    continue
+                if (
+                    time.monotonic() - self._last_lease
+                    > self.config.mon_election_timeout
+                ):
+                    logger.warning(
+                        "%s: leader mon.%s lease expired",
+                        self.name, self.leader_rank,
+                    )
+                    await self._start_election()
+        except asyncio.CancelledError:
+            pass
+
+    # -- replicated commit (Paxos-lite) ---------------------------------------
+
+    async def _handle_paxos(self, msg: messages.MMonPaxos) -> None:
+        if msg.op == "propose":
+            if msg.rank != self.leader_rank:
+                return  # stale leader: ignore (it will lose its lease)
+            # keep only the newest pending value: uncommitted older
+            # snapshots are superseded and would otherwise accumulate
+            for v in [v for v in self._pending_commit if v < msg.version]:
+                del self._pending_commit[v]
+            self._pending_commit[msg.version] = msg.value
+            await self._send_peer(msg.rank, messages.MMonPaxos(
+                op="ack", epoch=msg.epoch, rank=self.rank,
+                version=msg.version, value=None,
+            ))
+        elif msg.op == "ack":
+            acks = self._paxos_acks.get(msg.version)
+            if acks is not None:
+                acks.add(msg.rank)
+                if 1 + len(acks) >= self._majority():
+                    ev = self._paxos_events.get(msg.version)
+                    if ev is not None:
+                        ev.set()
+        elif msg.op == "commit":
+            value = self._pending_commit.pop(msg.version, None)
+            if value is not None and msg.version > self.osdmap.epoch:
+                self.osdmap = OSDMap.from_dict(value)
+                self._save_store()
+                self._publish_subs()
 
     def _valid_osd_id(self, osd) -> bool:
         return isinstance(osd, int) and 0 <= osd < self.osdmap.max_osd
 
     # -- osd lifecycle
-    def _handle_boot(self, conn: Connection, msg: messages.MOSDBoot) -> None:
+    async def _handle_boot(self, conn: Connection, msg: messages.MOSDBoot) -> None:
         osd = msg.osd_id
         if not self._valid_osd_id(osd):
             logger.warning("%s: rejecting boot with bad osd id %r", self.name, osd)
             return
-        # a reboot of an operator-out osd must NOT mark it back in
-        # (reference mon_osd_auto_mark_in=false semantics); only a
-        # first-ever boot auto-ins the device
-        first_boot = not self.osdmap.exists(osd)
-        self.osdmap.mark_up(osd, addr=msg.addr)
-        if first_boot or self.osdmap.is_in(osd):
-            self.osdmap.mark_in(osd)
-        self._boot_conns[osd] = conn
-        self._subs.add(conn)
-        self._failure_reports.pop(osd, None)
-        logger.info("%s: osd.%d booted at %s", self.name, osd, msg.addr)
-        self._publish()
+        if not conn.peer_name.startswith("mon."):
+            # only the OSD's OWN connection may be its liveness conn: a
+            # forwarded boot arrives on the peon's mon-peer connection,
+            # and tracking that would mark every OSD homed at the peon
+            # down the moment the peon dies (review r2 finding)
+            self._boot_conns[osd] = conn
+            self._subs.add(conn)
+        if not self.is_leader:
+            # forward the report to the leader; we keep serving this
+            # OSD's map subscription locally
+            if self.leader_rank is not None:
+                await self._send_peer(self.leader_rank, msg)
+            return
+        async with self._commit_lock:
+            # a reboot of an operator-out osd must NOT mark it back in
+            # (reference mon_osd_auto_mark_in=false semantics); only a
+            # first-ever boot auto-ins the device
+            first_boot = not self.osdmap.exists(osd)
+            self.osdmap.mark_up(osd, addr=msg.addr)
+            if first_boot or self.osdmap.is_in(osd):
+                self.osdmap.mark_in(osd)
+            self._failure_reports.pop(osd, None)
+            logger.info("%s: osd.%d booted at %s", self.name, osd, msg.addr)
+            await self._publish()
 
-    def _handle_failure(self, msg: messages.MOSDFailure) -> None:
+    async def _handle_failure(self, msg: messages.MOSDFailure) -> None:
         target = msg.target_osd
         if not self._valid_osd_id(target) or not self.osdmap.is_up(target):
+            return
+        if not self.is_leader:
+            if self.leader_rank is not None:
+                await self._send_peer(self.leader_rank, msg)
             return
         reporters = self._failure_reports.setdefault(target, set())
         reporters.add(msg.reporter)
         if len(reporters) >= self.failure_min_reporters:
-            logger.info(
-                "%s: osd.%d marked down (%d reporters)",
-                self.name, target, len(reporters),
-            )
-            self.osdmap.mark_down(target)
-            del self._failure_reports[target]
-            self._publish()
+            async with self._commit_lock:
+                if not self.osdmap.is_up(target):
+                    return  # a concurrent report already committed this
+                logger.info(
+                    "%s: osd.%d marked down (%d reporters)",
+                    self.name, target, len(reporters),
+                )
+                self.osdmap.mark_down(target)
+                self._failure_reports.pop(target, None)
+                await self._publish()
 
-    # -- map distribution
-    def _publish(self) -> None:
+    # -- map distribution / replication
+    async def _publish(self) -> bool:
+        """Commit a map mutation: bump the epoch, replicate to a majority
+        (multi-mon), persist, push to subscribers.  Returns False when no
+        quorum acked (the mutation stands locally but unreplicated —
+        callers surface -EAGAIN; the next quorum re-syncs from the
+        leader's map)."""
         self.osdmap.epoch += 1
+        ok = True
+        if not self.solo and self.is_leader:
+            version = self.osdmap.epoch
+            value = self.osdmap.to_dict()
+            self._paxos_acks[version] = set()
+            ev = self._paxos_events[version] = asyncio.Event()
+            try:
+                for r in self._peer_ranks():
+                    await self._send_peer(r, messages.MMonPaxos(
+                        op="propose", epoch=self.election_epoch,
+                        rank=self.rank, version=version, value=value,
+                    ))
+                if self._majority() > 1:
+                    try:
+                        async with asyncio.timeout(
+                            self.config.mon_election_timeout
+                        ):
+                            await ev.wait()
+                    except TimeoutError:
+                        logger.warning(
+                            "%s: commit %d: no quorum", self.name, version
+                        )
+                        ok = False
+                if ok:
+                    for r in self._peer_ranks():
+                        await self._send_peer(r, messages.MMonPaxos(
+                            op="commit", epoch=self.election_epoch,
+                            rank=self.rank, version=version, value=None,
+                        ))
+            finally:
+                self._paxos_acks.pop(version, None)
+                self._paxos_events.pop(version, None)
+        self._save_store()
+        self._publish_subs()
+        return ok
+
+    def _publish_subs(self) -> None:
         for conn in list(self._subs):
             self._send_map(conn)
 
@@ -160,7 +581,34 @@ class Monitor(Dispatcher):
             messages.MOSDMapMsg(epoch=self.osdmap.epoch, osdmap=self.osdmap.to_dict())
         )
 
+    async def _command_and_reply(
+        self, conn: Connection, msg: messages.MMonCommand
+    ) -> None:
+        code, status, out = await self.handle_command_async(msg.cmd)
+        conn.send(messages.MMonCommandReply(
+            tid=msg.tid, code=code, status=status, out=out
+        ))
+
     # -- commands (reference:src/mon/MonCommands.h subset)
+    async def handle_command_async(self, cmd: dict) -> tuple[int, str, Any]:
+        """Run a command; mutating handlers return an awaitable commit.
+        The commit lock serializes concurrent mutations (handlers run as
+        tasks, and interleaved epoch bumps would fork the map)."""
+        async with self._commit_lock:
+            code, status, out = self.handle_command(cmd)
+            if code == 0 and self._dirty:
+                self._dirty = False
+                if not await self._publish():
+                    return -EAGAIN, "no quorum: change not committed", None
+        return code, status, out
+
+    _dirty = False
+
+    def _mark_dirty(self) -> None:
+        """Handlers call this instead of publishing inline; the async
+        wrapper commits (and replicates) once, after the mutation."""
+        self._dirty = True
+
     def handle_command(self, cmd: dict) -> tuple[int, str, Any]:
         prefix = cmd.get("prefix", "")
         try:
@@ -214,7 +662,7 @@ class Monitor(Dispatcher):
         except Exception as e:
             return -EINVAL, f"invalid profile: {e}", None
         self.osdmap.set_erasure_code_profile(name, profile)
-        self._publish()
+        self._mark_dirty()
         return 0, "", None
 
     def _cmd_ec_profile_get(self, cmd: dict) -> tuple[int, str, Any]:
@@ -234,7 +682,7 @@ class Monitor(Dispatcher):
             if pool.erasure_code_profile == name:
                 return -EINVAL, f"profile {name!r} is in use by pool {pool.name!r}", None
         del self.osdmap.erasure_code_profiles[name]
-        self._publish()
+        self._mark_dirty()
         return 0, "", None
 
     def _cmd_pool_create(self, cmd: dict) -> tuple[int, str, Any]:
@@ -253,7 +701,7 @@ class Monitor(Dispatcher):
             pool = self.osdmap.create_replicated_pool(
                 name, size=int(cmd.get("size", 3)), pg_num=pg_num
             )
-        self._publish()
+        self._mark_dirty()
         return 0, "", {"pool_id": pool.id}
 
     def _cmd_pool_ls(self, cmd: dict) -> tuple[int, str, Any]:
@@ -265,7 +713,7 @@ class Monitor(Dispatcher):
             return -ENOENT, f"no pool {cmd['pool']!r}", None
         del self.osdmap.pools[pool.id]
         del self.osdmap.pool_name[pool.name]
-        self._publish()
+        self._mark_dirty()
         return 0, "", None
 
     def _cmd_osd_dump(self, cmd: dict) -> tuple[int, str, Any]:
@@ -276,7 +724,7 @@ class Monitor(Dispatcher):
         if not self._valid_osd_id(osd):
             return -EINVAL, f"bad osd id {osd}", None
         self.osdmap.mark_down(osd)
-        self._publish()
+        self._mark_dirty()
         return 0, "", None
 
     def _cmd_osd_out(self, cmd: dict) -> tuple[int, str, Any]:
@@ -284,7 +732,7 @@ class Monitor(Dispatcher):
         if not self._valid_osd_id(osd):
             return -EINVAL, f"bad osd id {osd}", None
         self.osdmap.mark_out(osd)
-        self._publish()
+        self._mark_dirty()
         return 0, "", None
 
     def _cmd_osd_in(self, cmd: dict) -> tuple[int, str, Any]:
@@ -292,7 +740,7 @@ class Monitor(Dispatcher):
         if not self._valid_osd_id(osd):
             return -EINVAL, f"bad osd id {osd}", None
         self.osdmap.mark_in(osd)
-        self._publish()
+        self._mark_dirty()
         return 0, "", None
 
     def _cmd_status(self, cmd: dict) -> tuple[int, str, Any]:

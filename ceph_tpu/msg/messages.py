@@ -170,6 +170,41 @@ class MOSDFailure(Message):
     FIELDS = ("target_osd", "reporter", "epoch")
 
 
+# -- mon quorum (multi-mon election + replicated map log) --------------------
+
+
+@register
+class MMonElection(Message):
+    """Elector exchange (reference:src/mon/Elector.cc): ``op`` is
+    propose | ack | victory.  Acks carry the responder's committed map so
+    the winner adopts the newest state before taking over (the Paxos
+    recovery phase collapsed to full-map snapshots); victory carries the
+    adopted map."""
+
+    TYPE = "mon_election"
+    FIELDS = ("op", "epoch", "rank", "map_epoch", "osdmap")
+
+
+@register
+class MMonPaxos(Message):
+    """Replicated map commit (reference:src/mon/Paxos.cc, collapsed to a
+    leader-driven majority-ack log over full-map values): ``op`` is
+    propose | ack | commit; ``version`` is the map epoch being committed."""
+
+    TYPE = "mon_paxos"
+    FIELDS = ("op", "epoch", "rank", "version", "value")
+
+
+@register
+class MMonLease(Message):
+    """Leader liveness + read lease to peons (reference:src/mon/Paxos.cc
+    lease extension); silence past mon_election_timeout triggers a new
+    election."""
+
+    TYPE = "mon_lease"
+    FIELDS = ("epoch", "rank", "map_epoch")
+
+
 # -- client <-> OSD ----------------------------------------------------------
 
 

@@ -199,20 +199,23 @@ class OSD(Dispatcher):
         pscrub.add_counter("errors", "inconsistencies found")
         pscrub.add_counter("repaired", "inconsistencies repaired")
         self._inflight: dict[int, dict] = {}  # OpTracker-lite
+        self._mon_conn: Connection | None = None
         self._op_seq = 0  # server-side tracker key (client tids collide)
         self._historic: list[dict] = []
         self._admin = None
         # live knobs: without observers, admin-socket `config set` would
-        # change `config show` but not daemon behavior (review r2 finding)
-        cfg.observe(
-            "osd_subop_timeout",
-            lambda _n, v: setattr(self, "subop_timeout", v),
-        )
-        cfg.observe(
-            "osd_heartbeat_grace",
-            lambda _n, v: setattr(self, "heartbeat_grace", v),
-        )
-        cfg.observe("osd_scrub_interval", self._on_scrub_interval)
+        # change `config show` but not daemon behavior (review r2 finding);
+        # tracked so stop() unregisters them — a shared Config must not
+        # keep firing actions on (or pinning) dead daemons
+        self._observers = [
+            ("osd_subop_timeout",
+             lambda _n, v: setattr(self, "subop_timeout", v)),
+            ("osd_heartbeat_grace",
+             lambda _n, v: setattr(self, "heartbeat_grace", v)),
+            ("osd_scrub_interval", self._on_scrub_interval),
+        ]
+        for opt, cb in self._observers:
+            cfg.observe(opt, cb)
         self._codecs: dict[int, tuple[Any, StripeInfo]] = {}
         self._tid = 0
         self._write_waiters: dict[int, _Waiter] = {}
@@ -253,9 +256,7 @@ class OSD(Dispatcher):
             self.store.mkfs()
             self.store.mount()
         self.addr = await self.messenger.bind(host, port)
-        mon = await self.messenger.connect(self.mon_addr, "mon.0")
-        mon.send(messages.MMonGetMap(have=0))
-        mon.send(messages.MOSDBoot(osd_id=self.osd_id, addr=self.addr))
+        await self._connect_mon()
         async with asyncio.timeout(10):
             await self._map_event.wait()
         if self.heartbeat_interval > 0:
@@ -265,6 +266,55 @@ class OSD(Dispatcher):
         self.scrub.start()
         await self._start_admin_socket()
         return self.addr
+
+    @property
+    def _mon_addrs(self) -> list[str]:
+        """mon_addr may be one address or a monmap list (multi-mon)."""
+        if isinstance(self.mon_addr, str):
+            return [self.mon_addr]
+        return list(self.mon_addr)
+
+    async def _connect_mon(self) -> Connection:
+        """Subscribe + announce to the first reachable mon (any mon
+        serves maps and forwards reports to the leader); the connection
+        is re-established against another mon if this one dies."""
+        last: Exception | None = None
+        for _attempt in range(3):
+            for i, addr in enumerate(self._mon_addrs):
+                try:
+                    conn = await self.messenger.connect(addr, f"mon.{i}")
+                except (ConnectionError, OSError) as e:
+                    last = e
+                    continue
+                conn.send(messages.MMonGetMap(
+                    have=self.osdmap.epoch if self.osdmap else 0
+                ))
+                conn.send(messages.MOSDBoot(osd_id=self.osd_id, addr=self.addr))
+                self._mon_conn = conn
+                return conn
+            await asyncio.sleep(0.2)
+        raise ConnectionError(f"no mon reachable: {last}")
+
+    def _on_mon_reset(self) -> None:
+        """Our mon died: fail over to another one (reference MonClient
+        hunting)."""
+        if self._stopping:
+            return
+
+        async def rehunt():
+            try:
+                await self._connect_mon()
+                logger.info("%s: re-homed to a live mon", self.name)
+            except (ConnectionError, OSError):
+                await asyncio.sleep(0.5)
+                if not self._stopping:
+                    t = asyncio.ensure_future(rehunt())
+                    self._tasks.add(t)
+                    t.add_done_callback(self._tasks.discard)
+
+        t = asyncio.ensure_future(rehunt())
+        self._tasks.add(t)
+        t.add_done_callback(self._tasks.discard)
 
     async def _start_admin_socket(self) -> None:
         """`ceph daemon osd.N <cmd>` surface (reference admin_socket.cc);
@@ -333,6 +383,8 @@ class OSD(Dispatcher):
         without a clean shutdown, so a durable backend must recover from
         its journal alone on the next mount."""
         self._stopping = True
+        for opt, cb in self._observers:
+            self.config.unobserve(opt, cb)
         self.recovery.stop()
         self.scrub.stop()
         if self._hb_task:
@@ -391,6 +443,10 @@ class OSD(Dispatcher):
             self._hb_last[self._peer_osd_id(conn)] = time.monotonic()
 
     def ms_handle_reset(self, conn: Connection) -> None:
+        if conn is self._mon_conn:
+            self._mon_conn = None
+            self._on_mon_reset()
+            return
         # fail every in-flight sub-op this peer owed us so primary ops and
         # recovery scans re-plan promptly instead of waiting out timeouts
         peer = self._peer_osd_id(conn)
@@ -1358,7 +1414,9 @@ class OSD(Dispatcher):
                             "%s: peer osd.%d silent for %.1fs -> reporting",
                             self.name, osd, now - last,
                         )
-                        mon = await self.messenger.connect(self.mon_addr, "mon.0")
+                        mon = self._mon_conn
+                        if mon is None:
+                            mon = await self._connect_mon()
                         mon.send(
                             messages.MOSDFailure(
                                 target_osd=osd, reporter=self.osd_id,

@@ -39,7 +39,7 @@ class RadosError(OSError):
 class RadosClient(Dispatcher):
     """Cluster handle: mon session + map + op submission."""
 
-    def __init__(self, mon_addr: str, name: str | None = None,
+    def __init__(self, mon_addr: "str | list[str]", name: str | None = None,
                  op_timeout: float = 10.0, max_retries: int = 8):
         self.name = name or f"client.{next(_client_counter)}"
         self.mon_addr = mon_addr
@@ -51,17 +51,68 @@ class RadosClient(Dispatcher):
         self._op_futs: dict[int, asyncio.Future] = {}
         self._fut_conns: dict[int, Connection] = {}
         self._map_waiters: list[asyncio.Future] = []
+        self._cmd_addr: str | None = None  # current mon target for commands
+        self._sub_conn: Connection | None = None  # map subscription feed
+        self._shutdown = False
+        self._tasks: set[asyncio.Task] = set()
+
+    @property
+    def _mon_addrs(self) -> list[str]:
+        """mon_addr may be one address or a monmap list (multi-mon)."""
+        if isinstance(self.mon_addr, str):
+            return [self.mon_addr]
+        return list(self.mon_addr)
+
+    async def _mon_conn(self, addr: str | None = None) -> Connection:
+        """Connect to the given mon (or hunt for any live one)."""
+        last: Exception | None = None
+        addrs = [addr] if addr else self._mon_addrs
+        for a in addrs:
+            try:
+                conn = await self.messenger.connect(a, f"mon@{a}")
+                self._cmd_addr = a
+                return conn
+            except (ConnectionError, OSError) as e:
+                last = e
+        raise ConnectionError(f"no mon reachable: {last}")
 
     # -- lifecycle
     async def connect(self) -> "RadosClient":
-        mon = await self.messenger.connect(self.mon_addr, "mon.0")
-        mon.send(messages.MMonGetMap(have=0))
+        await self._subscribe()
         async with asyncio.timeout(10):
             while self.osdmap is None:
                 await self._wait_for_map_change(-1, 10.0)
         return self
 
+    async def _subscribe(self) -> None:
+        mon = await self._mon_conn()
+        self._sub_conn = mon
+        mon.send(messages.MMonGetMap(
+            have=self.osdmap.epoch if self.osdmap else 0
+        ))
+
+    def _resubscribe_later(self) -> None:
+        """Our subscription mon died: re-home the map feed to a live one
+        (reference MonClient hunting).  Tasks are strongly referenced —
+        the loop only weak-refs pending tasks and an unreferenced rehunt
+        could be garbage-collected mid-flight."""
+        if self._shutdown:
+            return
+
+        async def rehunt():
+            while not self._shutdown:
+                try:
+                    await self._subscribe()
+                    return
+                except (ConnectionError, OSError):
+                    await asyncio.sleep(0.3)
+
+        t = asyncio.ensure_future(rehunt())
+        self._tasks.add(t)
+        t.add_done_callback(self._tasks.discard)
+
     async def shutdown(self) -> None:
+        self._shutdown = True
         await self.messenger.shutdown()
 
     # -- dispatch
@@ -87,6 +138,9 @@ class RadosClient(Dispatcher):
                 fut.set_result(msg)
 
     def ms_handle_reset(self, conn: Connection) -> None:
+        if conn is self._sub_conn:
+            self._sub_conn = None
+            self._resubscribe_later()
         # fail in-flight ops on this conn fast so operate() can re-target
         for tid, c in list(self._fut_conns.items()):
             if c is conn:
@@ -108,21 +162,42 @@ class RadosClient(Dispatcher):
 
     # -- mon commands
     async def command(self, cmd: dict) -> tuple[int, str, Any]:
-        tid = next(self._tid)
-        fut = asyncio.get_running_loop().create_future()
-        self._op_futs[tid] = fut
-        try:
-            conn = await self.messenger.connect(self.mon_addr, "mon.0")
-            self._fut_conns[tid] = conn
-            conn.send(messages.MMonCommand(tid=tid, cmd=cmd))
-            async with asyncio.timeout(self.op_timeout):
-                reply = await fut
-        finally:
-            # a timeout/error must not leak the tid (ADVICE r1: operate()
-            # cleans up in its except clause; command() must too)
-            self._op_futs.pop(tid, None)
-            self._fut_conns.pop(tid, None)
-        return reply.code, reply.status, reply.out
+        """Mon command; follows leader redirects and fails over to other
+        mons (reference MonClient hunting + command forwarding)."""
+        target = self._cmd_addr
+        last: tuple[int, str, Any] | None = None
+        for _attempt in range(self.max_retries):
+            tid = next(self._tid)
+            fut = asyncio.get_running_loop().create_future()
+            self._op_futs[tid] = fut
+            try:
+                conn = await self._mon_conn(target)
+                self._fut_conns[tid] = conn
+                conn.send(messages.MMonCommand(tid=tid, cmd=cmd))
+                async with asyncio.timeout(self.op_timeout):
+                    reply = await fut
+            except (ConnectionError, OSError):
+                target = None  # hunt any live mon next round
+                await asyncio.sleep(0.2)
+                continue
+            finally:
+                # a timeout/error must not leak the tid (ADVICE r1:
+                # operate() cleans up; command() must too)
+                self._op_futs.pop(tid, None)
+                self._fut_conns.pop(tid, None)
+            if (
+                reply.code == -EAGAIN
+                and reply.status == "not leader"
+            ):
+                hint = (reply.out or {}).get("addr")
+                target = hint  # None -> hunt; the mon may still be voting
+                last = (reply.code, reply.status, reply.out)
+                await asyncio.sleep(0.2 if hint is None else 0)
+                continue
+            return reply.code, reply.status, reply.out
+        if last is not None:
+            return last
+        raise RadosError(-EAGAIN, "mon command exhausted retries")
 
     # -- pools
     async def create_pool(self, name: str, pool_type: str = "replicated",

@@ -28,13 +28,21 @@ class MiniCluster:
         heartbeat_interval: float = 0.0,
         failure_min_reporters: int = 1,
         store_dir: str | None = None,
+        n_mons: int = 1,
+        mon_config=None,
     ):
         self.n_osds = n_osds
         self.heartbeat_interval = heartbeat_interval
-        self.mon = Monitor(
-            max_osds=n_osds, failure_min_reporters=failure_min_reporters
+        self.mons: dict[int, Monitor] = {}
+        self._mon_args = dict(
+            max_osds=n_osds, failure_min_reporters=failure_min_reporters,
+            config=mon_config,
         )
+        self.n_mons = n_mons
         self.store_dir = store_dir
+        for rank in range(n_mons):
+            self.mons[rank] = self._make_mon(rank)
+        self.monmap: list[str] = []
         self.stores: list[ObjectStore] = [
             self._make_store(i) for i in range(n_osds)
         ]
@@ -57,18 +65,68 @@ class MiniCluster:
             os.path.join(self.store_dir, f"osd.{osd_id}"), sync="flush"
         )
 
+    def _make_mon(self, rank: int) -> Monitor:
+        store_path = (
+            os.path.join(self.store_dir, f"mon.{rank}.json")
+            if self.store_dir is not None else None
+        )
+        return Monitor(
+            name=f"mon.{rank}", rank=rank, store_path=store_path,
+            **self._mon_args,
+        )
+
+    @property
+    def mon(self) -> Monitor:
+        """The current quorum leader (mons[0] before quorum forms) —
+        single-mon clusters behave exactly as before."""
+        for m in self.mons.values():
+            if m.is_leader:
+                return m
+        return next(iter(self.mons.values()))
+
     async def start(self) -> "MiniCluster":
-        await self.mon.start()
+        for rank in sorted(self.mons):
+            await self.mons[rank].start()
+        self.monmap = [self.mons[r].addr for r in sorted(self.mons)]
+        for m in self.mons.values():
+            m.set_monmap(self.monmap)
+        for m in self.mons.values():
+            await m.start_quorum()
+        if self.n_mons > 1:
+            await self.wait_for_leader()
         for i in range(self.n_osds):
             await self.start_osd(i)
         return self
+
+    async def wait_for_leader(self, timeout: float = 10.0) -> Monitor:
+        async with asyncio.timeout(timeout):
+            while True:
+                for m in self.mons.values():
+                    if m.is_leader:
+                        return m
+                await asyncio.sleep(0.01)
+
+    async def kill_mon(self, rank: int) -> None:
+        await self.mons.pop(rank).stop()
+
+    async def restart_mon(self, rank: int) -> Monitor:
+        if rank in self.mons:
+            await self.kill_mon(rank)
+        m = self._make_mon(rank)
+        self.mons[rank] = m
+        # rebind on the SAME address so the monmap stays valid
+        host, port = self.monmap[rank].rsplit(":", 1)
+        await m.start(host, int(port))
+        m.set_monmap(self.monmap)
+        await m.start_quorum()
+        return m
 
     async def start_osd(self, osd_id: int) -> OSD:
         if osd_id in self.osds:
             raise RuntimeError(f"osd.{osd_id} already running")
         store = self.stores[osd_id]
         osd = OSD(
-            osd_id, self.mon.addr, store=store,
+            osd_id, self.monmap or self.mon.addr, store=store,
             heartbeat_interval=self.heartbeat_interval,
         )
         await osd.start()
@@ -114,7 +172,9 @@ class MiniCluster:
                 await asyncio.sleep(0.005)
 
     async def client(self, **kw) -> RadosClient:
-        cl = await RadosClient(self.mon.addr, **kw).connect()
+        cl = await RadosClient(
+            self.monmap or self.mon.addr, **kw
+        ).connect()
         self._clients.append(cl)
         return cl
 
@@ -124,7 +184,8 @@ class MiniCluster:
         self._clients.clear()
         for osd_id in list(self.osds):
             await self.kill_osd(osd_id)
-        await self.mon.stop()
+        for rank in list(self.mons):
+            await self.mons.pop(rank).stop()
 
     async def __aenter__(self) -> "MiniCluster":
         return await self.start()

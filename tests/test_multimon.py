@@ -1,0 +1,189 @@
+"""Multi-monitor quorum tests.
+
+Mirrors the reference intents (reference:src/mon/Elector.cc lowest-rank
+election, reference:src/mon/Paxos.cc majority commit + recovery,
+MonClient hunting/failover): kill the leader mid-workload and the
+cluster keeps serving; maps converge; mon state survives restarts.
+"""
+
+import asyncio
+import os
+
+from ceph_tpu.rados import MiniCluster
+
+
+def test_three_mons_elect_lowest_rank():
+    async def main():
+        async with MiniCluster(n_osds=3, n_mons=3) as cluster:
+            leader = await cluster.wait_for_leader()
+            assert leader.rank == 0
+            # peons agree on the leader
+            async with asyncio.timeout(5):
+                while not all(
+                    m.leader_rank == 0 for m in cluster.mons.values()
+                ):
+                    await asyncio.sleep(0.01)
+
+    asyncio.run(main())
+
+
+def test_commands_replicate_to_peons():
+    async def main():
+        async with MiniCluster(n_osds=3, n_mons=3) as cluster:
+            client = await cluster.client()
+            await client.create_pool("ecpool", "erasure")
+            # every mon's committed map has the pool
+            async with asyncio.timeout(5):
+                while not all(
+                    m.osdmap.lookup_pool("ecpool") is not None
+                    for m in cluster.mons.values()
+                ):
+                    await asyncio.sleep(0.01)
+            epochs = {m.osdmap.epoch for m in cluster.mons.values()}
+            assert len(epochs) == 1, epochs
+
+    asyncio.run(main())
+
+
+def test_command_via_peon_redirects():
+    async def main():
+        async with MiniCluster(n_osds=3, n_mons=3) as cluster:
+            await cluster.wait_for_leader()
+            client = await cluster.client()
+            # aim the client's command path at a PEON explicitly
+            client._cmd_addr = cluster.mons[2].addr
+            code, _status, out = await client.command(
+                {"prefix": "osd pool create", "pool": "p1",
+                 "pool_type": "replicated", "size": "2"}
+            )
+            assert code == 0, (code, out)
+            assert cluster.mons[0].osdmap.lookup_pool("p1") is not None
+
+    asyncio.run(main())
+
+
+def test_leader_death_fails_over_and_cluster_serves():
+    async def main():
+        async with MiniCluster(n_osds=4, n_mons=3) as cluster:
+            client = await cluster.client()
+            await client.create_pool("ecpool", "erasure")
+            io = client.io_ctx("ecpool")
+            blobs = {f"o{i}": os.urandom(800) for i in range(4)}
+            for k, v in blobs.items():
+                await io.write_full(k, v)
+
+            await cluster.kill_mon(0)
+            # mon.1 (lowest surviving rank) takes over
+            async with asyncio.timeout(15):
+                while True:
+                    alive = [m for m in cluster.mons.values() if m.is_leader]
+                    if alive and alive[0].rank == 1:
+                        break
+                    await asyncio.sleep(0.05)
+
+            # data path still serves (osd targeting needs no mon)
+            for k, v in blobs.items():
+                assert await io.read(k) == v
+            # control plane still serves: new pool via the new leader
+            await client.create_pool("rep", "replicated", size=2)
+            io2 = client.io_ctx("rep")
+            await io2.write_full("after-failover", b"alive")
+            assert await io2.read("after-failover") == b"alive"
+
+    asyncio.run(main())
+
+
+def test_mon_rejoin_converges():
+    async def main():
+        async with MiniCluster(n_osds=3, n_mons=3) as cluster:
+            client = await cluster.client()
+            await cluster.kill_mon(2)
+            await client.create_pool("while-away", "replicated", size=2)
+            m2 = await cluster.restart_mon(2)
+            # the rejoined peon catches up (victory/commit carries the map)
+            async with asyncio.timeout(10):
+                while m2.osdmap.lookup_pool("while-away") is None:
+                    await asyncio.sleep(0.02)
+            # counter-elections triggered by the rejoin settle on mon.0
+            async with asyncio.timeout(10):
+                while m2.leader_rank != 0:
+                    await asyncio.sleep(0.02)
+
+    asyncio.run(main())
+
+
+def test_leader_kill_mid_write_load():
+    """The VERDICT r1 #7 acceptance: kill the leader mid-thrash; the
+    cluster keeps serving and maps converge."""
+
+    async def main():
+        async with MiniCluster(n_osds=4, n_mons=3) as cluster:
+            client = await cluster.client()
+            await client.create_pool("ecpool", "erasure")
+            io = client.io_ctx("ecpool")
+            written = {}
+            stop = asyncio.Event()
+
+            async def writer():
+                i = 0
+                while not stop.is_set():
+                    data = os.urandom(600)
+                    await io.write_full(f"w{i}", data)
+                    written[f"w{i}"] = data
+                    i += 1
+                    await asyncio.sleep(0.01)
+
+            w = asyncio.ensure_future(writer())
+            await asyncio.sleep(0.3)
+            await cluster.kill_mon(0)  # leader dies under load
+            await asyncio.sleep(2.0)   # election + failover happen under load
+            stop.set()
+            await w
+            assert len(written) > 5
+            for k, v in written.items():
+                assert await io.read(k) == v
+            # surviving mons converge on one map
+            async with asyncio.timeout(10):
+                while True:
+                    epochs = {
+                        m.osdmap.epoch for m in cluster.mons.values()
+                    }
+                    if len(epochs) == 1:
+                        break
+                    await asyncio.sleep(0.05)
+
+    asyncio.run(main())
+
+
+def test_mon_state_survives_full_cluster_restart(tmp_path):
+    """MonitorDBStore-lite: pools/profiles come back after every daemon
+    (mons included) restarts — closing the round-2 gap where pools lived
+    only in mon RAM."""
+    d = str(tmp_path / "cluster")
+
+    async def phase1():
+        async with MiniCluster(n_osds=3, n_mons=3, store_dir=d) as cluster:
+            client = await cluster.client()
+            code, _s, _o = await client.command({
+                "prefix": "osd erasure-code-profile set", "name": "rs32",
+                "profile": {"plugin": "isa", "technique": "reed_sol_van",
+                            "k": "2", "m": "1"},
+            })
+            assert code == 0
+            await client.create_pool(
+                "keeper", "erasure", erasure_code_profile="rs32"
+            )
+            io = client.io_ctx("keeper")
+            await io.write_full("persist", b"through the dark")
+
+    async def phase2():
+        async with MiniCluster(n_osds=3, n_mons=3, store_dir=d) as cluster:
+            client = await cluster.client()
+            # NO pool re-creation: the mon store remembered it
+            assert client.osdmap.lookup_pool("keeper") is not None
+            assert "rs32" in client.osdmap.erasure_code_profiles
+            io = client.io_ctx("keeper")
+            assert await io.read("persist") == b"through the dark"
+
+    asyncio.run(phase1())
+    asyncio.run(phase2())
