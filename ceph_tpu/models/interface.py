@@ -71,6 +71,18 @@ class ErasureCodeInterface(abc.ABC):
         """Cost-aware variant; default ignores costs (reference :315)."""
         return self.minimum_to_decode(want_to_read, list(available))
 
+    def get_ruleset_steps(self) -> "list[tuple[str, str, int]] | None":
+        """Placement steps for this codec's crush rule, or None for the
+        default simple rule (reference:ErasureCodeInterface.h:213
+        create_ruleset; LRC's layered placement,
+        reference:src/erasure-code/lrc/ErasureCodeLrc.cc:44).
+
+        Each step is (op, type_name, n) with op "choose"|"chooseleaf" —
+        e.g. LRC's [("choose", "rack", groups), ("chooseleaf", "host",
+        l+1)] places each local-parity group in its own rack.
+        """
+        return None
+
     @abc.abstractmethod
     def encode(
         self, want_to_encode: Sequence[int], data: bytes | np.ndarray

@@ -126,7 +126,33 @@ class LrcErasureCode(ErasureCode):
             raise ErasureCodeValidationError(
                 f"chunk positions {sorted(missing)} are neither data nor coding"
             )
+        if "ruleset-steps" in profile:
+            # explicit steps for the layers form (reference ruleset_parse,
+            # reference:src/erasure-code/lrc/ErasureCodeLrc.cc:88)
+            try:
+                raw = json.loads(profile["ruleset-steps"])
+                steps = [(str(op), str(t), int(n)) for op, t, n in raw]
+            except (json.JSONDecodeError, TypeError, ValueError) as e:
+                raise ErasureCodeValidationError(
+                    f"bad ruleset-steps: {e}"
+                ) from e
+            for op, _t, _n in steps:
+                if op not in ("choose", "chooseleaf"):
+                    raise ErasureCodeValidationError(
+                        f"ruleset-steps op must be choose|chooseleaf, got {op!r}"
+                    )
+            self.ruleset_steps = steps
+        elif not self.ruleset_steps:
+            self.ruleset_steps = [
+                ("chooseleaf", profile.get("ruleset-failure-domain", "host"), 0)
+            ]
         self._profile = dict(profile)
+
+    def get_ruleset_steps(self):
+        """Per-layer placement steps consumed at pool creation
+        (reference:src/erasure-code/lrc/ErasureCodeLrc.cc:44
+        create_ruleset)."""
+        return list(self.ruleset_steps)
 
     def _parse_kml(self, profile: dict) -> None:
         for banned in ("mapping", "layers"):

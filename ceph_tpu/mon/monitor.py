@@ -95,6 +95,7 @@ class Monitor(Dispatcher):
         config=None,
         rank: int = 0,
         store_path: str | None = None,
+        crush: CrushMap | None = None,
     ):
         from ..common import Config
 
@@ -105,7 +106,7 @@ class Monitor(Dispatcher):
             self.config.mon_failure_min_reporters
             if failure_min_reporters is None else failure_min_reporters
         )
-        self.osdmap = OSDMap(CrushMap.flat(max_osds))
+        self.osdmap = OSDMap(crush or CrushMap.flat(max_osds))
         self.osdmap.set_max_osd(max_osds)
         self.osdmap.epoch = 1
         self.osdmap.set_erasure_code_profile("default", DEFAULT_EC_PROFILE)

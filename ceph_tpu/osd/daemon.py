@@ -64,6 +64,7 @@ EIO = 5
 EAGAIN = 11
 EINVAL = 22
 ESTALE = 116
+EOPNOTSUPP = 95
 
 OI_KEY = "_"  # object-info xattr (reference OI_ATTR)
 
@@ -657,10 +658,153 @@ class OSD(Dispatcher):
                 out.append({"rval": r, "size": size})
                 if r < 0:
                     return r, out, blobs
+            elif name in ("setxattr", "rmxattr"):
+                value = (
+                    msg.blobs[op["data"]] if op.get("data") is not None else b""
+                )
+                r = await self._ec_setxattr(
+                    pg, pool, acting, msg.oid, op["key"],
+                    value if name == "setxattr" else None,
+                )
+                out.append({"rval": r})
+                if r < 0:
+                    return r, out, blobs
+            elif name in ("getxattr", "getxattrs"):
+                r, attrs = await self._ec_getxattrs(pg, pool, acting, msg.oid)
+                if r < 0:
+                    out.append({"rval": r})
+                    return r, out, blobs
+                if name == "getxattr":
+                    val = attrs.get(op["key"])
+                    if val is None:
+                        out.append({"rval": -ENOENT})
+                    else:
+                        out.append({"rval": 0, "data": len(blobs)})
+                        blobs.append(val)
+                else:
+                    out.append({
+                        "rval": 0,
+                        "attrs": {k: len(blobs) + i for i, k in
+                                  enumerate(sorted(attrs))},
+                    })
+                    blobs.extend(attrs[k] for k in sorted(attrs))
+            elif name.startswith("omap_"):
+                # EC pools do not support omap (reference:PrimaryLogPG.cc
+                # do_osd_ops rejects omap writes on EC with -EOPNOTSUPP)
+                out.append({"rval": -EOPNOTSUPP, "error": "no omap on EC pools"})
+                return -EOPNOTSUPP, out, blobs
             else:
                 out.append({"rval": -EINVAL, "error": f"bad op {name!r}"})
                 return -EINVAL, out, blobs
         return 0, out, blobs
+
+    USER_XATTR_PREFIX = "u_"  # system keys ("_", hinfo) live unprefixed
+
+    async def _ec_setxattr(
+        self, pg: PGid, pool: Pool, acting: list[int], oid: str,
+        key: str, value: bytes | None,
+    ) -> int:
+        """Set (or remove, value=None) a user xattr on every present
+        shard — a versioned mutation through the normal sub-write path
+        (reference stores object attrs on all EC shards)."""
+        async with self.pg_lock(pg):
+            codec, _si = self._pool_codec(pool)
+            k, km = codec.get_data_chunk_count(), codec.get_chunk_count()
+            present = [
+                (s, o) for s, o in enumerate(acting[:km])
+                if o != CRUSH_ITEM_NONE
+            ]
+            if len(present) < max(pool.min_size, k):
+                return -EAGAIN
+            oi, hashes, vers, errs = await self._ec_meta(
+                pg, oid, dict(present)
+            )
+            if any(e != -ENOENT for e in errs.values()):
+                return -EAGAIN
+            create = oi is None
+            if create and value is None:
+                return -ENOENT  # rmxattr on a missing object
+            if not create:
+                newest = tuple(Eversion.from_list(oi["version"]).to_list())
+                present = [
+                    (s, o) for s, o in present if vers.get(s) == newest
+                ]
+                if len(present) < max(pool.min_size, k):
+                    return -EAGAIN
+            version = self._next_version(pg)
+            prior = (
+                Eversion() if create else Eversion.from_list(oi["version"])
+            )
+            oi_b = json.dumps(
+                {
+                    "size": 0 if create else int(oi["size"]),
+                    "version": version.to_list(),
+                }
+            ).encode()
+            sname = stash_name(oid, version)
+            entry = PGLogEntry("modify", oid, version, prior, stash=sname)
+            skey = self.USER_XATTR_PREFIX + key
+            hinfo_b = None
+            if create:
+                # setxattr creates missing objects (reference semantics);
+                # a fresh empty crc table keeps scrub quiet
+                _codec, sinfo = self._pool_codec(pool)
+                hinfo_b = json.dumps(
+                    StripeHashes(km, sinfo.chunk_size).to_dict()
+                ).encode()
+
+            def build_txn(shard: int) -> Transaction:
+                cid = self._shard_cid(pg, shard)
+                soid = ObjectId(oid, shard)
+                txn = (
+                    Transaction()
+                    .create_collection(cid)
+                    .try_stash(cid, soid, ObjectId(sname, shard))
+                )
+                if value is None:
+                    txn.rmattr(cid, soid, skey)
+                else:
+                    txn.setattr(cid, soid, skey, value)
+                txn.setattr(cid, soid, OI_KEY, oi_b)
+                if hinfo_b is not None:
+                    txn.setattr(cid, soid, StripeHashes.XATTR_KEY, hinfo_b)
+                return txn
+
+            return await self._ec_fan_out(
+                pg, present, build_txn, [entry], version
+            )
+
+    async def _ec_getxattrs(
+        self, pg: PGid, pool: Pool, acting: list[int], oid: str
+    ) -> tuple[int, dict[str, bytes]]:
+        """User xattrs from the newest-version shard."""
+        codec, _si = self._pool_codec(pool)
+        km = codec.get_chunk_count()
+        available = {
+            s: o for s, o in enumerate(acting[:km]) if o != CRUSH_ITEM_NONE
+        }
+        _d, attrs, errs = await self._read_shards(
+            pg, oid, available, want_data=False
+        )
+        best: dict | None = None
+        newest = (0, 0)
+        for s, a in attrs.items():
+            raw = a.get(OI_KEY)
+            if raw is None:
+                continue
+            v = tuple(json.loads(raw).get("version", [0, 0]))
+            if v >= newest:
+                newest = v
+                best = a
+        if best is None:
+            if any(e != -ENOENT for e in errs.values()):
+                return -EIO, {}
+            return -ENOENT, {}
+        plen = len(self.USER_XATTR_PREFIX)
+        return 0, {
+            k[plen:]: v.encode("latin-1") for k, v in best.items()
+            if k.startswith(self.USER_XATTR_PREFIX)
+        }
 
     # -- EC mutation pipeline (RMW) -------------------------------------------
 
@@ -785,36 +929,52 @@ class OSD(Dispatcher):
         sname = stash_name(oid, version)
         entry = PGLogEntry("modify", oid, version, prior, stash=sname)
 
+        def build_txn(shard: int) -> Transaction:
+            cid = self._shard_cid(pg, shard)
+            soid = ObjectId(oid, shard)
+            txn = (
+                Transaction()
+                .create_collection(cid)
+                .try_stash(cid, soid, ObjectId(sname, shard))
+            )
+            if plan.shard_truncate is not None:
+                txn.truncate(cid, soid, plan.shard_truncate)
+            if shard_bufs is not None:
+                txn.write(cid, soid, c_off, shard_bufs[shard].tobytes())
+            txn.setattr(cid, soid, StripeHashes.XATTR_KEY, hinfo_b)
+            txn.setattr(cid, soid, OI_KEY, oi_b)
+            return txn
+
+        return await self._ec_fan_out(pg, present, build_txn, [entry], version)
+
+    async def _ec_fan_out(
+        self, pg: PGid, present: list[tuple[int, int]], build_txn,
+        entries: list[PGLogEntry], version: Eversion,
+    ) -> int:
+        """The EC sub-write commit protocol shared by every versioned EC
+        mutation (writes, deletes, xattr updates): per-shard txn fan-out,
+        all-present ack gathering, ESTALE->EAGAIN folding, roll-forward
+        watermark advance on success (reference:src/osd/ECBackend.cc:1389
+        submit_transaction -> :1946 try_finish_rmw)."""
         tid = self._new_tid()
         waiter = _Waiter({s for s, _ in present}, dict(present))
         self._write_waiters[tid] = waiter
         try:
             for shard, osd in present:
-                cid = self._shard_cid(pg, shard)
-                soid = ObjectId(oid, shard)
-                txn = (
-                    Transaction()
-                    .create_collection(cid)
-                    .try_stash(cid, soid, ObjectId(sname, shard))
+                await self._send_sub_write(
+                    tid, pg, shard, osd, build_txn(shard), entries
                 )
-                if plan.shard_truncate is not None:
-                    txn.truncate(cid, soid, plan.shard_truncate)
-                if shard_bufs is not None:
-                    txn.write(cid, soid, c_off, shard_bufs[shard].tobytes())
-                txn.setattr(cid, soid, StripeHashes.XATTR_KEY, hinfo_b)
-                txn.setattr(cid, soid, OI_KEY, oi_b)
-                await self._send_sub_write(tid, pg, shard, osd, txn, [entry])
             async with asyncio.timeout(self.subop_timeout):
                 await waiter.event.wait()
         except TimeoutError:
-            logger.warning("%s: ec %s tid=%d timed out on %s",
-                           self.name, opname, tid, waiter.pending)
+            logger.warning("%s: ec commit tid=%d timed out on %s",
+                           self.name, tid, waiter.pending)
             return -EIO
         finally:
             del self._write_waiters[tid]
         if any(r != 0 for r in waiter.results.values()):
             if any(r == -ESTALE for r in waiter.results.values()):
-                return -EAGAIN  # we are a demoted primary; client re-targets
+                return -EAGAIN  # demoted primary; client re-targets
             return -EIO
         self._mark_committed(pg, version, present)
         return 0
@@ -838,32 +998,18 @@ class OSD(Dispatcher):
         version = self._next_version(pg)
         sname = stash_name(oid, version)
         entry = PGLogEntry("delete", oid, version, Eversion(), stash=sname)
-        tid = self._new_tid()
-        waiter = _Waiter({s for s, _ in present}, dict(present))
-        self._write_waiters[tid] = waiter
-        try:
-            for shard, osd in present:
-                cid = self._shard_cid(pg, shard)
-                soid = ObjectId(oid, shard)
-                txn = (
-                    Transaction()
-                    .create_collection(cid)
-                    .try_stash(cid, soid, ObjectId(sname, shard))
-                    .remove(cid, soid)
-                )
-                await self._send_sub_write(tid, pg, shard, osd, txn, [entry])
-            async with asyncio.timeout(self.subop_timeout):
-                await waiter.event.wait()
-        except TimeoutError:
-            return -EIO
-        finally:
-            del self._write_waiters[tid]
-        if any(r != 0 for r in waiter.results.values()):
-            if any(r == -ESTALE for r in waiter.results.values()):
-                return -EAGAIN
-            return -EIO
-        self._mark_committed(pg, version, present)
-        return 0
+
+        def build_txn(shard: int) -> Transaction:
+            cid = self._shard_cid(pg, shard)
+            soid = ObjectId(oid, shard)
+            return (
+                Transaction()
+                .create_collection(cid)
+                .try_stash(cid, soid, ObjectId(sname, shard))
+                .remove(cid, soid)
+            )
+
+        return await self._ec_fan_out(pg, present, build_txn, [entry], version)
 
     # -- commit watermark / stash trim ----------------------------------------
 
@@ -1205,7 +1351,8 @@ class OSD(Dispatcher):
                 self.store.read(cid, soid, offset, length) if want_data else b""
             )
             attrs = {
-                k: v.decode() for k, v in self.store.getattrs(cid, soid).items()
+                k: v.decode("latin-1")
+                for k, v in self.store.getattrs(cid, soid).items()
             }
             return data, attrs, 0
         except KeyError:
@@ -1307,6 +1454,69 @@ class OSD(Dispatcher):
                     out.append({"rval": -ENOENT, "size": 0})
                     return -ENOENT, out, blobs
                 out.append({"rval": 0, "size": size})
+            elif name == "setxattr":
+                txn.setattr(
+                    cid, oid, self.USER_XATTR_PREFIX + op["key"],
+                    msg.blobs[op["data"]],
+                )
+                mutates = True
+                out.append({"rval": 0})
+            elif name == "rmxattr":
+                if not self.store.exists(cid, oid):
+                    out.append({"rval": -ENOENT})
+                    return -ENOENT, out, blobs
+                txn.rmattr(cid, oid, self.USER_XATTR_PREFIX + op["key"])
+                mutates = True
+                out.append({"rval": 0})
+            elif name == "getxattr":
+                try:
+                    val = self.store.getattr(
+                        cid, oid, self.USER_XATTR_PREFIX + op["key"]
+                    )
+                except KeyError:
+                    out.append({"rval": -ENOENT})
+                    return -ENOENT, out, blobs
+                out.append({"rval": 0, "data": len(blobs)})
+                blobs.append(val)
+            elif name == "getxattrs":
+                try:
+                    attrs = self.store.getattrs(cid, oid)
+                except KeyError:
+                    out.append({"rval": -ENOENT})
+                    return -ENOENT, out, blobs
+                plen = len(self.USER_XATTR_PREFIX)
+                user = {
+                    k[plen:]: v for k, v in sorted(attrs.items())
+                    if k.startswith(self.USER_XATTR_PREFIX)
+                }
+                out.append({
+                    "rval": 0,
+                    "attrs": {k: len(blobs) + i for i, k in enumerate(user)},
+                })
+                blobs.extend(user.values())
+            elif name == "omap_setkeys":
+                kv = {
+                    k: msg.blobs[bi] for k, bi in op.get("keys", {}).items()
+                }
+                txn.omap_setkeys(cid, oid, kv)
+                mutates = True
+                out.append({"rval": 0})
+            elif name == "omap_rmkeys":
+                txn.omap_rmkeys(cid, oid, list(op.get("keys", [])))
+                mutates = True
+                out.append({"rval": 0})
+            elif name == "omap_get":
+                try:
+                    omap = self.store.omap_get(cid, oid)
+                except KeyError:
+                    out.append({"rval": -ENOENT})
+                    return -ENOENT, out, blobs
+                keys = sorted(omap)
+                out.append({
+                    "rval": 0,
+                    "keys": {k: len(blobs) + i for i, k in enumerate(keys)},
+                })
+                blobs.extend(omap[k] for k in keys)
             else:
                 out.append({"rval": -EINVAL})
                 return -EINVAL, out, blobs

@@ -413,12 +413,30 @@ class OSDMap:
         codec = registry.instance().factory(plugin, profile)
         k = codec.get_data_chunk_count()
         km = codec.get_chunk_count()
-        root = self.crush.root_id()
+        root = self.crush.root_id(profile.get("ruleset-root", "default"))
         ruleset = len([r for r in self.crush.rules if r])
-        self.crush.add_simple_rule(
-            root, fault_domain_type, RULE_TYPE_ERASURE, ruleset=ruleset,
-            indep=True, max_size=km,
-        )
+        steps = codec.get_ruleset_steps()
+        type_names = set(self.crush.type_names.values()) | {"osd"}
+        if steps and all(t in type_names for _op, t, _n in steps):
+            # codec-directed placement (LRC's per-layer steps,
+            # reference:src/erasure-code/lrc/ErasureCodeLrc.cc:44)
+            self._add_steps_rule(root, steps, ruleset, km)
+        else:
+            if steps:
+                # flat dev maps have no host/rack types: degrade to the
+                # simple rule instead of refusing the pool (the locality
+                # the steps encode needs a topology that does not exist)
+                import logging
+
+                logging.getLogger("ceph_tpu.osd").warning(
+                    "pool %s: placement steps %s need crush types not in "
+                    "this map (%s); using a simple rule",
+                    name, steps, sorted(type_names),
+                )
+            self.crush.add_simple_rule(
+                root, fault_domain_type, RULE_TYPE_ERASURE, ruleset=ruleset,
+                indep=True, max_size=km,
+            )
         pool = Pool(
             id=self._next_pool_id(), name=name, type=POOL_TYPE_ERASURE,
             size=km, min_size=k + 1 if km > k + 1 else k, pg_num=pg_num,
@@ -428,6 +446,41 @@ class OSDMap:
         )
         self.add_pool(pool)
         return pool
+
+    def _add_steps_rule(
+        self, root: int, steps, ruleset: int, max_size: int
+    ) -> int:
+        """Build a multi-step INDEP crush rule from codec placement steps
+        [(op, type_name, n), ...] (reference:ErasureCodeLrc.cc:44
+        create_ruleset: SET_CHOOSELEAF_TRIES 5, TAKE root, then one
+        CHOOSE(LEAF)_INDEP per step, EMIT)."""
+        from ..crush.map import (
+            CRUSH_RULE_CHOOSE_INDEP,
+            CRUSH_RULE_CHOOSELEAF_INDEP,
+            CRUSH_RULE_EMIT,
+            CRUSH_RULE_SET_CHOOSELEAF_TRIES,
+            CRUSH_RULE_TAKE,
+            Rule,
+        )
+
+        type_of = {name: tid for tid, name in self.crush.type_names.items()}
+        type_of.setdefault("osd", 0)
+        rule = Rule(ruleset, RULE_TYPE_ERASURE, 1, max_size)
+        rule.step(CRUSH_RULE_SET_CHOOSELEAF_TRIES, 5)
+        rule.step(CRUSH_RULE_TAKE, root)
+        for op, type_name, n in steps:
+            if type_name not in type_of:
+                raise ValueError(
+                    f"placement step type {type_name!r} not in the crush "
+                    f"map (types: {sorted(type_of)})"
+                )
+            step_op = (
+                CRUSH_RULE_CHOOSELEAF_INDEP if op == "chooseleaf"
+                else CRUSH_RULE_CHOOSE_INDEP
+            )
+            rule.step(step_op, int(n), type_of[type_name])
+        rule.step(CRUSH_RULE_EMIT)
+        return self.crush.add_rule(rule)
 
     # -- wire form (reference: OSDMap::encode/decode) ------------------------
 

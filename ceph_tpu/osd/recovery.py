@@ -494,7 +494,8 @@ class RecoveryManager:
                     if -1 in d and -1 not in errs:
                         data = d[-1]
                         attrs = {
-                            ak: av.encode() for ak, av in a.get(-1, {}).items()
+                            ak: av.encode("latin-1")
+                            for ak, av in a.get(-1, {}).items()
                         }
                         break
             if data is None:

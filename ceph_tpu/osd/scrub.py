@@ -382,7 +382,8 @@ class ScrubManager:
 
         auth_data = bytes(data[auth_member])
         auth_attrs = {
-            ak: av.encode() for ak, av in attrs[auth_member].items()
+            ak: av.encode("latin-1")
+            for ak, av in attrs[auth_member].items()
         }
         for m in sorted(bad):
             if await osd.recovery.push_replica_object(

@@ -8,5 +8,9 @@ reference:src/test/erasure-code/test-erasure-code.sh run_mon/run_osd).
 
 from .client import IoCtx, RadosClient, RadosError
 from .cluster import MiniCluster
+from .striper import StripedLayout, StripedObject
 
-__all__ = ["RadosClient", "IoCtx", "RadosError", "MiniCluster"]
+__all__ = [
+    "RadosClient", "IoCtx", "RadosError", "MiniCluster",
+    "StripedLayout", "StripedObject",
+]

@@ -404,3 +404,75 @@ class IoCtx:
         if reply.result < 0:
             raise RadosError(reply.result, f"stat {oid}")
         return reply.out[0]["size"]
+
+    # -- xattrs (reference librados rados_setxattr/getxattr/rmxattr)
+    async def setxattr(self, oid: str, key: str, value: bytes) -> None:
+        reply = await self.client.operate(
+            self.pool_name, oid,
+            [{"op": "setxattr", "key": key, "data": 0}], [bytes(value)],
+        )
+        if reply.result < 0:
+            raise RadosError(reply.result, f"setxattr {oid} {key}")
+
+    async def getxattr(self, oid: str, key: str) -> bytes:
+        reply = await self.client.operate(
+            self.pool_name, oid, [{"op": "getxattr", "key": key}], []
+        )
+        out = reply.out[0]
+        if reply.result < 0 or out.get("rval", 0) < 0:
+            raise RadosError(
+                min(reply.result, out.get("rval", 0)), f"getxattr {oid} {key}"
+            )
+        return bytes(reply.blobs[out["data"]])
+
+    async def rmxattr(self, oid: str, key: str) -> None:
+        reply = await self.client.operate(
+            self.pool_name, oid, [{"op": "rmxattr", "key": key}], []
+        )
+        if reply.result < 0:
+            raise RadosError(reply.result, f"rmxattr {oid} {key}")
+
+    async def getxattrs(self, oid: str) -> dict[str, bytes]:
+        reply = await self.client.operate(
+            self.pool_name, oid, [{"op": "getxattrs"}], []
+        )
+        if reply.result < 0:
+            raise RadosError(reply.result, f"getxattrs {oid}")
+        out = reply.out[0]
+        return {
+            k: bytes(reply.blobs[bi]) for k, bi in out.get("attrs", {}).items()
+        }
+
+    # -- omap (replicated pools only; EC pools answer -EOPNOTSUPP like
+    #    the reference, reference:src/osd/PrimaryLogPG.cc do_osd_ops)
+    async def omap_set(self, oid: str, kv: dict[str, bytes]) -> None:
+        keys = {}
+        blobs = []
+        for k, v in kv.items():
+            keys[k] = len(blobs)
+            blobs.append(bytes(v))
+        reply = await self.client.operate(
+            self.pool_name, oid,
+            [{"op": "omap_setkeys", "keys": keys}], blobs,
+        )
+        if reply.result < 0:
+            raise RadosError(reply.result, f"omap_set {oid}")
+
+    async def omap_get(self, oid: str) -> dict[str, bytes]:
+        reply = await self.client.operate(
+            self.pool_name, oid, [{"op": "omap_get"}], []
+        )
+        if reply.result < 0:
+            raise RadosError(reply.result, f"omap_get {oid}")
+        out = reply.out[0]
+        return {
+            k: bytes(reply.blobs[bi]) for k, bi in out.get("keys", {}).items()
+        }
+
+    async def omap_rmkeys(self, oid: str, keys: list[str]) -> None:
+        reply = await self.client.operate(
+            self.pool_name, oid,
+            [{"op": "omap_rmkeys", "keys": list(keys)}], [],
+        )
+        if reply.result < 0:
+            raise RadosError(reply.result, f"omap_rmkeys {oid}")

@@ -30,10 +30,12 @@ class MiniCluster:
         store_dir: str | None = None,
         n_mons: int = 1,
         mon_config=None,
+        crush_hosts: "list[list[int]] | None" = None,
     ):
         self.n_osds = n_osds
         self.heartbeat_interval = heartbeat_interval
         self.mons: dict[int, Monitor] = {}
+        self.crush_hosts = crush_hosts
         self._mon_args = dict(
             max_osds=n_osds, failure_min_reporters=failure_min_reporters,
             config=mon_config,
@@ -70,9 +72,16 @@ class MiniCluster:
             os.path.join(self.store_dir, f"mon.{rank}.json")
             if self.store_dir is not None else None
         )
+        crush = None
+        if self.crush_hosts is not None:
+            # a FRESH map per mon: mons mutate their own copy on pool
+            # creation, a shared object would alias across daemons
+            from ..crush.map import CrushMap
+
+            crush = CrushMap.hierarchical(self.crush_hosts)
         return Monitor(
             name=f"mon.{rank}", rank=rank, store_path=store_path,
-            **self._mon_args,
+            crush=crush, **self._mon_args,
         )
 
     @property

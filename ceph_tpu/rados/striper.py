@@ -1,0 +1,175 @@
+"""Client-side striping: large logical objects over many RADOS objects.
+
+Re-expression of the reference Striper (reference:src/osdc/Striper.cc:1
+file_to_extents) + libradosstriper (reference:src/libradosstriper/): a
+logical byte stream is cut into stripe units of ``stripe_unit`` bytes,
+distributed round-robin over ``stripe_count`` objects per object set,
+with each backing object capped at ``object_size`` bytes.  Backing
+objects are named ``<soid>.<objectno:016x>`` (the striper's naming
+convention), and the logical size rides as a "size" attribute on the
+first object (the striper's locking/metadata collapsed to the size key
+— the mini-RADOS has a single writer per op).
+
+Layout math (file_to_extents): for logical offset ``off``:
+  blockno   = off // stripe_unit        (which stripe unit)
+  stripeno  = blockno // stripe_count   (which stripe row)
+  stripepos = blockno % stripe_count    (column -> object in the set)
+  objectsetno = stripeno // stripes_per_object
+  objectno  = objectsetno * stripe_count + stripepos
+  obj_off   = (stripeno % stripes_per_object) * stripe_unit + off % stripe_unit
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from .client import ENOENT, IoCtx, RadosError
+
+SIZE_XATTR = "striper.size"  # logical size key on the first backing object
+
+
+class StripedLayout:
+    """The file_to_extents algebra (reference:src/osdc/Striper.cc:59)."""
+
+    def __init__(self, stripe_unit: int = 4096, stripe_count: int = 4,
+                 object_size: int = 1 << 22):
+        if stripe_unit <= 0 or stripe_count <= 0 or object_size <= 0:
+            raise ValueError("layout parameters must be positive")
+        if object_size % stripe_unit:
+            raise ValueError("object_size must be a multiple of stripe_unit")
+        self.stripe_unit = stripe_unit
+        self.stripe_count = stripe_count
+        self.object_size = object_size
+        self.stripes_per_object = object_size // stripe_unit
+
+    def extents(self, offset: int, length: int) -> list[tuple[int, int, int]]:
+        """(objectno, obj_offset, len) covering [offset, offset+length),
+        merged per contiguous run within each object."""
+        out: list[tuple[int, int, int]] = []
+        pos = offset
+        end = offset + length
+        while pos < end:
+            blockno = pos // self.stripe_unit
+            stripeno = blockno // self.stripe_count
+            stripepos = blockno % self.stripe_count
+            objectsetno = stripeno // self.stripes_per_object
+            objectno = objectsetno * self.stripe_count + stripepos
+            obj_off = (
+                (stripeno % self.stripes_per_object) * self.stripe_unit
+                + pos % self.stripe_unit
+            )
+            run = min(self.stripe_unit - pos % self.stripe_unit, end - pos)
+            if out and out[-1][0] == objectno and (
+                out[-1][1] + out[-1][2] == obj_off
+            ):
+                out[-1] = (objectno, out[-1][1], out[-1][2] + run)
+            else:
+                out.append((objectno, obj_off, run))
+            pos += run
+        return out
+
+    def object_count(self, size: int) -> int:
+        """Backing objects a logical size may touch."""
+        if size == 0:
+            return 0
+        blocks = -(-size // self.stripe_unit)
+        stripes = -(-blocks // self.stripe_count)
+        objectsets = -(-stripes // self.stripes_per_object)
+        return objectsets * self.stripe_count
+
+
+class StripedObject:
+    """One striped logical object (rados_striper_* surface)."""
+
+    def __init__(self, io: IoCtx, soid: str, layout: StripedLayout | None = None):
+        self.io = io
+        self.soid = soid
+        self.layout = layout or StripedLayout()
+
+    def _oname(self, objectno: int) -> str:
+        return f"{self.soid}.{objectno:016x}"
+
+    async def _read_size_attr(self) -> int:
+        try:
+            raw = await self.io.getxattr(self._oname(0), SIZE_XATTR)
+        except RadosError as e:
+            if e.code == -ENOENT:
+                return -1
+            raise
+        return int(raw.decode() or 0)
+
+    async def _write_size_attr(self, size: int) -> None:
+        oname = self._oname(0)
+        try:
+            await self.io.setxattr(oname, SIZE_XATTR, str(size).encode())
+        except RadosError as e:
+            if e.code != -ENOENT:
+                raise
+            # a write that never touched object 0 (high offset): create it
+            # empty so the size attr has a home (the reference striper
+            # likewise keeps its metadata on the first object)
+            await self.io.write(oname, b"", offset=0)
+            await self.io.setxattr(oname, SIZE_XATTR, str(size).encode())
+
+    async def size(self) -> int:
+        s = await self._read_size_attr()
+        if s < 0:
+            raise RadosError(-ENOENT, f"no striped object {self.soid!r}")
+        return s
+
+    async def write(self, data: bytes, offset: int = 0) -> None:
+        """Write across backing objects; extents land concurrently."""
+        ext = self.layout.extents(offset, len(data))
+        pos = 0
+        ops = []
+        for objectno, obj_off, run in ext:
+            chunk = data[pos : pos + run]
+            pos += run
+            ops.append(
+                self.io.write(self._oname(objectno), chunk, offset=obj_off)
+            )
+        if ops:
+            await asyncio.gather(*ops)
+        old = await self._read_size_attr()
+        new_end = offset + len(data)
+        if new_end > max(old, 0):
+            await self._write_size_attr(new_end)
+
+    async def read(self, offset: int = 0, length: int = 0) -> bytes:
+        size = await self.size()
+        end = size if length <= 0 else min(offset + length, size)
+        if offset >= end:
+            return b""
+        ext = self.layout.extents(offset, end - offset)
+
+        async def fetch(objectno: int, obj_off: int, run: int) -> bytes:
+            try:
+                got = await self.io.read(
+                    self._oname(objectno), obj_off, run
+                )
+            except RadosError as e:
+                if e.code == -ENOENT:
+                    got = b""  # hole: object never written
+                else:
+                    raise
+            return got + b"\x00" * (run - len(got))  # short read = hole
+
+        parts = await asyncio.gather(
+            *(fetch(o, oo, r) for o, oo, r in ext)
+        )
+        return b"".join(parts)
+
+    async def remove(self) -> None:
+        size = await self._read_size_attr()
+        count = self.layout.object_count(max(size, 0))
+        ops = []
+        for objectno in range(max(count, 1)):  # object 0 always exists
+            ops.append(self._remove_quiet(self._oname(objectno)))
+        await asyncio.gather(*ops)
+
+    async def _remove_quiet(self, oname: str) -> None:
+        try:
+            await self.io.remove(oname)
+        except RadosError as e:
+            if e.code != -ENOENT:
+                raise

@@ -1,0 +1,336 @@
+"""Client striping + LRC placement wiring + xattr/omap client ops.
+
+Mirrors the reference intents: Striper file_to_extents layout algebra
+(reference:src/osdc/Striper.cc:59) and libradosstriper round-trips;
+LRC's create_ruleset consuming per-layer placement steps
+(reference:src/erasure-code/lrc/ErasureCodeLrc.cc:44); the librados
+xattr/omap op surface (reference:src/osd/PrimaryLogPG.cc:4150
+do_osd_ops opcode switch, EC omap rejection).
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from ceph_tpu.rados import MiniCluster, RadosError, StripedLayout, StripedObject
+
+
+# -- layout algebra ----------------------------------------------------------
+
+
+def test_layout_extents_basic():
+    lo = StripedLayout(stripe_unit=4, stripe_count=2, object_size=8)
+    # logical 0..3 -> obj0[0:4], 4..7 -> obj1[0:4], 8..11 -> obj0[4:8],
+    # 12..15 -> obj1[4:8], 16.. -> next object set (obj2)
+    assert lo.extents(0, 4) == [(0, 0, 4)]
+    assert lo.extents(4, 4) == [(1, 0, 4)]
+    assert lo.extents(8, 4) == [(0, 4, 4)]
+    assert lo.extents(16, 4) == [(2, 0, 4)]
+    # a span across everything
+    ext = lo.extents(0, 20)
+    assert sum(r for _, _, r in ext) == 20
+    # contiguous runs within one object merge
+    assert lo.extents(0, 2) == [(0, 0, 2)]
+    assert lo.extents(2, 4) == [(0, 2, 2), (1, 0, 2)]
+
+
+def test_layout_round_trips_any_offset():
+    lo = StripedLayout(stripe_unit=16, stripe_count=3, object_size=64)
+    blob = bytes(range(256)) * 4
+    # simulate object store: apply extents and read them back
+    objs: dict[int, bytearray] = {}
+    for off in (0, 5, 16, 47, 200, 777):
+        data = blob[: 301]
+        pos = 0
+        for objectno, obj_off, run in lo.extents(off, len(data)):
+            objs.setdefault(objectno, bytearray(1024))[
+                obj_off : obj_off + run
+            ] = data[pos : pos + run]
+            pos += run
+        got = b"".join(
+            bytes(objs[o][oo : oo + r]) for o, oo, r in lo.extents(off, len(data))
+        )
+        assert got == data, off
+
+
+def test_object_count():
+    lo = StripedLayout(stripe_unit=4, stripe_count=2, object_size=8)
+    assert lo.object_count(0) == 0
+    assert lo.object_count(1) == 2
+    assert lo.object_count(16) == 2
+    assert lo.object_count(17) == 4
+
+
+# -- striper e2e -------------------------------------------------------------
+
+
+def test_striped_object_round_trip():
+    async def main():
+        async with MiniCluster(n_osds=4) as cluster:
+            client = await cluster.client()
+            await client.create_pool("ecpool", "erasure")
+            io = client.io_ctx("ecpool")
+            so = StripedObject(
+                io, "bigfile",
+                StripedLayout(stripe_unit=512, stripe_count=3,
+                              object_size=2048),
+            )
+            payload = os.urandom(10_000)  # spans multiple object sets
+            await so.write(payload)
+            assert await so.size() == len(payload)
+            assert await so.read() == payload
+            # ranged reads across stripe/object boundaries
+            for off, ln in ((0, 100), (500, 600), (2040, 300), (9_900, 100)):
+                assert await so.read(off, ln) == payload[off : off + ln]
+            # overwrite middle
+            await so.write(b"X" * 700, offset=1800)
+            patched = payload[:1800] + b"X" * 700 + payload[2500:]
+            assert await so.read() == patched
+            # extend past the end
+            await so.write(b"tail", offset=len(payload) + 100)
+            assert await so.size() == len(payload) + 104
+            got = await so.read()
+            assert got[: len(patched)] == patched
+            assert got[-4:] == b"tail"
+            assert got[len(payload) : len(payload) + 100] == b"\x00" * 100
+            # the data really is striped over many backing objects
+            n_backing = so.layout.object_count(await so.size())
+            assert n_backing >= 6
+            await so.remove()
+            with pytest.raises(RadosError):
+                await so.size()
+
+    asyncio.run(main())
+
+
+def test_striped_write_at_high_offset_only():
+    """A write that never touches backing object 0 still records the
+    logical size (object 0 is created for the metadata)."""
+
+    async def main():
+        async with MiniCluster(n_osds=3) as cluster:
+            client = await cluster.client()
+            await client.create_pool("rep", "replicated", size=2)
+            io = client.io_ctx("rep")
+            so = StripedObject(
+                io, "sparse",
+                StripedLayout(stripe_unit=128, stripe_count=2,
+                              object_size=512),
+            )
+            await so.write(b"data", offset=130)  # lands on object 1
+            assert await so.size() == 134
+            got = await so.read()
+            assert got == b"\x00" * 130 + b"data"
+
+    asyncio.run(main())
+
+
+# -- xattr / omap client ops -------------------------------------------------
+
+
+@pytest.mark.parametrize("pool_kind", ["erasure", "replicated"])
+def test_xattr_round_trip(pool_kind):
+    async def main():
+        async with MiniCluster(n_osds=4) as cluster:
+            client = await cluster.client()
+            if pool_kind == "erasure":
+                await client.create_pool("p", "erasure")
+            else:
+                await client.create_pool("p", "replicated", size=2)
+            io = client.io_ctx("p")
+            await io.write_full("obj", b"payload")
+            await io.setxattr("obj", "color", b"teal")
+            await io.setxattr("obj", "shape", b"round")
+            assert await io.getxattr("obj", "color") == b"teal"
+            attrs = await io.getxattrs("obj")
+            assert attrs == {"color": b"teal", "shape": b"round"}
+            await io.rmxattr("obj", "color")
+            with pytest.raises(RadosError):
+                await io.getxattr("obj", "color")
+            # the payload is untouched by attr churn
+            assert await io.read("obj") == b"payload"
+            # setxattr on a missing object CREATES it (reference
+            # semantics); rmxattr on a missing object fails cleanly
+            await io.setxattr("fresh", "k", b"v")
+            assert await io.getxattr("fresh", "k") == b"v"
+            assert await io.stat("fresh") == 0
+            with pytest.raises(RadosError):
+                await io.rmxattr("ghost", "k")
+
+    asyncio.run(main())
+
+
+@pytest.mark.parametrize("pool_kind", ["erasure", "replicated"])
+def test_xattr_binary_values(pool_kind):
+    """Non-UTF-8 xattr values must round-trip and must NOT poison data
+    reads (review r2: v.decode() on the shard-read path bricked the
+    object forever)."""
+
+    async def main():
+        async with MiniCluster(n_osds=4) as cluster:
+            client = await cluster.client()
+            if pool_kind == "erasure":
+                await client.create_pool("p", "erasure")
+            else:
+                await client.create_pool("p", "replicated", size=2)
+            io = client.io_ctx("p")
+            await io.write_full("obj", b"payload")
+            binval = bytes(range(256))
+            await io.setxattr("obj", "bin", binval)
+            assert await io.getxattr("obj", "bin") == binval
+            # the object still reads, stats, and overwrites normally
+            assert await io.read("obj") == b"payload"
+            assert await io.stat("obj") == 7
+            await io.write_full("obj", b"payload2")
+            assert await io.read("obj") == b"payload2"
+
+    asyncio.run(main())
+
+
+def test_lrc_pool_on_flat_map_falls_back_to_simple_rule():
+    """An LRC profile whose steps need crush types the map lacks (the
+    flat dev map has no 'host') degrades to the simple rule instead of
+    refusing the pool (review r2 regression)."""
+
+    async def main():
+        async with MiniCluster(n_osds=8) as cluster:  # flat map
+            client = await cluster.client()
+            code, status, _ = await client.command({
+                "prefix": "osd erasure-code-profile set", "name": "lrcflat",
+                "profile": {"plugin": "lrc", "k": "4", "m": "2", "l": "3"},
+            })
+            assert code == 0, status
+            await client.create_pool(
+                "lrcflat", "erasure", erasure_code_profile="lrcflat"
+            )
+            io = client.io_ctx("lrcflat")
+            payload = os.urandom(4000)
+            await io.write_full("obj", payload)
+            assert await io.read("obj") == payload
+
+    asyncio.run(main())
+
+
+def test_omap_replicated_and_ec_rejection():
+    async def main():
+        async with MiniCluster(n_osds=4) as cluster:
+            client = await cluster.client()
+            await client.create_pool("rep", "replicated", size=2)
+            await client.create_pool("ec", "erasure")
+            rio = client.io_ctx("rep")
+            await rio.write_full("obj", b"x")
+            await rio.omap_set("obj", {"a": b"1", "b": b"2"})
+            assert await rio.omap_get("obj") == {"a": b"1", "b": b"2"}
+            await rio.omap_rmkeys("obj", ["a"])
+            assert await rio.omap_get("obj") == {"b": b"2"}
+            # EC pools reject omap like the reference (-EOPNOTSUPP)
+            eio_ctx = client.io_ctx("ec")
+            await eio_ctx.write_full("obj", b"x")
+            with pytest.raises(RadosError) as ei:
+                await eio_ctx.omap_set("obj", {"k": b"v"})
+            assert ei.value.code == -95
+
+    asyncio.run(main())
+
+
+# -- LRC placement wiring ----------------------------------------------------
+
+HOSTS = [[0, 1], [2, 3], [4, 5], [6, 7]]  # 4 hosts x 2 osds
+
+
+def _host_of(osd: int) -> int:
+    return osd // 2
+
+
+def test_lrc_pool_places_by_ruleset_steps():
+    """An LRC k=4 m=2 l=3 pool on a hosts map: every chunk lands on a
+    distinct failure domain (chooseleaf host), and I/O round-trips."""
+
+    async def main():
+        async with MiniCluster(n_osds=8, crush_hosts=HOSTS) as cluster:
+            client = await cluster.client()
+            code, status, _ = await client.command({
+                "prefix": "osd erasure-code-profile set", "name": "lrc42",
+                "profile": {"plugin": "lrc", "k": "4", "m": "2", "l": "3",
+                            "ruleset-failure-domain": "host"},
+            })
+            assert code == 0, status
+            # k=4 m=2 l=3 -> 2 groups x (3+1) = 8 chunks; but only 4
+            # hosts exist -> chooseleaf host 0 needs 8 distinct hosts.
+            # Use l groups as locality instead: 8 chunks over 4 hosts
+            # needs 2 per host -> choose host 4, chooseleaf osd 2
+            code, status, _ = await client.command({
+                "prefix": "osd erasure-code-profile set", "name": "lrc-local",
+                "profile": {
+                    "plugin": "lrc", "k": "4", "m": "2", "l": "3",
+                    "ruleset-steps": '[["choose", "host", 4], '
+                                     '["chooseleaf", "osd", 2]]',
+                    # kml parse also sets steps; explicit steps override
+                },
+            })
+            assert code == 0, status
+            await client.create_pool(
+                "lrcpool", "erasure", erasure_code_profile="lrc-local"
+            )
+            io = client.io_ctx("lrcpool")
+            payload = os.urandom(6000)
+            await io.write_full("obj", payload)
+            assert await io.read("obj") == payload
+
+            # placement: every PG's acting set spreads 2 chunks per host
+            pool = client.osdmap.lookup_pool("lrcpool")
+            assert pool.size == 8
+            for pg in client.osdmap.pgs_of_pool(pool.id):
+                _u, _up, acting, _p = client.osdmap.pg_to_up_acting_osds(pg)
+                assert len(acting) == 8
+                placed = [o for o in acting if o >= 0]
+                if len(placed) == 8:
+                    hosts = [_host_of(o) for o in placed]
+                    from collections import Counter
+
+                    counts = Counter(hosts)
+                    assert set(counts.values()) == {2}, (pg, acting)
+
+    asyncio.run(main())
+
+
+def test_lrc_kml_profile_uses_locality_groups():
+    """The kml shorthand with ruleset-locality generates
+    [choose <locality> groups, chooseleaf <failure-domain> l+1] and the
+    rule materializes in the pool's crush ruleset."""
+
+    async def main():
+        async with MiniCluster(n_osds=8, crush_hosts=HOSTS) as cluster:
+            client = await cluster.client()
+            code, status, _ = await client.command({
+                "prefix": "osd erasure-code-profile set", "name": "lrcloc",
+                "profile": {"plugin": "lrc", "k": "2", "m": "2", "l": "2",
+                            "ruleset-locality": "host",
+                            "ruleset-failure-domain": "osd"},
+            })
+            assert code == 0, status
+            await client.create_pool(
+                "locpool", "erasure", erasure_code_profile="lrcloc"
+            )
+            # k2 m2 l2 -> 2 groups x 3 = 6 chunks; steps: choose host 2,
+            # chooseleaf osd 3 -> each group inside ONE host... 3 osds
+            # per host needed but hosts have 2 -> short mappings expected
+            # on this topology; the rule SHAPE is what this test pins
+            pool = client.osdmap.lookup_pool("locpool")
+            mon = cluster.mon
+            rule = None
+            for r in mon.osdmap.crush.rules:
+                if r is not None and r.ruleset == pool.crush_ruleset:
+                    rule = r
+            assert rule is not None
+            from ceph_tpu.crush.map import (
+                CRUSH_RULE_CHOOSE_INDEP,
+                CRUSH_RULE_CHOOSELEAF_INDEP,
+            )
+
+            ops = [(s.op, s.arg1) for s in rule.steps]
+            assert (CRUSH_RULE_CHOOSE_INDEP, 2) in ops
+            assert (CRUSH_RULE_CHOOSELEAF_INDEP, 3) in ops
+
+    asyncio.run(main())
