@@ -55,9 +55,9 @@ def _opts(*options: Option) -> dict[str, Option]:
 OPTIONS: dict[str, Option] = _opts(
     # messenger
     Option("ms_connect_timeout", float, 5.0, "outbound connect timeout (s)"),
-    Option("ms_reconnect_backoff", float, 0.2,
+    Option("ms_reconnect_backoff", float, 0.1,
            "base backoff between reconnect attempts (s)"),
-    Option("ms_reconnect_max_attempts", int, 3,
+    Option("ms_reconnect_max_attempts", int, 2,
            "reconnect attempts before a send fails"),
     # osd: liveness
     Option("osd_heartbeat_interval", float, 0.0,

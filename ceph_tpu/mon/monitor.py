@@ -102,6 +102,7 @@ class Monitor(Dispatcher):
         self.config = config or Config()
         self.name = name
         self.messenger = AsyncMessenger(name, self)
+        self.messenger.apply_config(self.config)
         self.failure_min_reporters = (
             self.config.mon_failure_min_reporters
             if failure_min_reporters is None else failure_min_reporters

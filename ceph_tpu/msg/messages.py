@@ -309,6 +309,21 @@ class MOSDScrubReply(Message):
     FIELDS = ("tid", "result", "report")
 
 
+@register
+class MPGLs(Message):
+    """Client -> PG primary: list this PG's objects (the pgls op behind
+    `rados ls`, reference:src/osd/PrimaryLogPG.cc do_pg_op PGLS)."""
+
+    TYPE = "pg_ls"
+    FIELDS = ("tid", "pgid")
+
+
+@register
+class MPGLsReply(Message):
+    TYPE = "pg_ls_reply"
+    FIELDS = ("tid", "result", "names")
+
+
 # -- recovery ----------------------------------------------------------------
 
 

@@ -162,6 +162,7 @@ class OSD(Dispatcher):
         self.name = f"osd.{osd_id}"
         self.mon_addr = mon_addr
         self.messenger = AsyncMessenger(self.name, self)
+        self.messenger.apply_config(cfg)
         self.store = store or MemStore()
         self.subop_timeout = (
             cfg.osd_subop_timeout if subop_timeout is None else subop_timeout
@@ -430,6 +431,8 @@ class OSD(Dispatcher):
             w = self._write_waiters.get(msg.tid)
             if w:
                 w.complete(msg.from_osd, msg.result)
+        elif isinstance(msg, messages.MPGLs):
+            self._handle_pgls(conn, msg)
         elif isinstance(msg, messages.MOSDScrub):
             t = asyncio.ensure_future(self._handle_scrub(conn, msg))
             self._tasks.add(t)
@@ -571,6 +574,40 @@ class OSD(Dispatcher):
         if pool.type == POOL_TYPE_ERASURE:
             return await self._ec_execute(pg, pool, acting, msg)
         return await self._rep_execute(pg, pool, acting, msg)
+
+    def _handle_pgls(self, conn: Connection, msg) -> None:
+        """List this PG's objects from the primary's own shard (every
+        acting shard holds a chunk of every object, so the local scan is
+        complete — the reference's PGLS, reference:src/osd/
+        PrimaryLogPG.cc do_pg_op)."""
+        try:
+            pg = PGid.parse(msg.pgid)
+            if self.osdmap is None:
+                raise RuntimeError("no map")
+            pool = self.osdmap.pools.get(pg.pool)
+            if pool is None:
+                raise RuntimeError(f"no pool {pg.pool}")
+            _up, _upp, acting, primary = self.osdmap.pg_to_up_acting_osds(pg)
+            if primary != self.osd_id:
+                conn.send(messages.MPGLsReply(
+                    tid=msg.tid, result=-EAGAIN, names=[],
+                ))
+                return
+            if pool.type == POOL_TYPE_ERASURE:
+                shard = next(
+                    (s for s, o in enumerate(acting) if o == self.osd_id), 0
+                )
+            else:
+                shard = -1
+            objects, _log = self.recovery._local_scan(str(pg), shard)
+            conn.send(messages.MPGLsReply(
+                tid=msg.tid, result=0, names=sorted(objects),
+            ))
+        except Exception as e:
+            logger.exception("%s: pgls of %s failed", self.name, msg.pgid)
+            conn.send(messages.MPGLsReply(
+                tid=msg.tid, result=-EIO, names=[str(e)],
+            ))
 
     async def _handle_scrub(self, conn: Connection, msg) -> None:
         """Operator-commanded deep scrub of one PG (the `ceph pg scrub`
@@ -1502,6 +1539,9 @@ class OSD(Dispatcher):
                 mutates = True
                 out.append({"rval": 0})
             elif name == "omap_rmkeys":
+                if not self.store.exists(cid, oid):
+                    out.append({"rval": -ENOENT})
+                    return -ENOENT, out, blobs
                 txn.omap_rmkeys(cid, oid, list(op.get("keys", [])))
                 mutates = True
                 out.append({"rval": 0})

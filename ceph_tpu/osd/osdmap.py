@@ -416,23 +416,23 @@ class OSDMap:
         root = self.crush.root_id(profile.get("ruleset-root", "default"))
         ruleset = len([r for r in self.crush.rules if r])
         steps = codec.get_ruleset_steps()
-        type_names = set(self.crush.type_names.values()) | {"osd"}
-        if steps and all(t in type_names for _op, t, _n in steps):
-            # codec-directed placement (LRC's per-layer steps,
-            # reference:src/erasure-code/lrc/ErasureCodeLrc.cc:44)
-            self._add_steps_rule(root, steps, ruleset, km)
-        else:
-            if steps:
+        added = False
+        if steps:
+            try:
+                # codec-directed placement (LRC's per-layer steps,
+                # reference:src/erasure-code/lrc/ErasureCodeLrc.cc:44)
+                self._add_steps_rule(root, steps, ruleset, km)
+                added = True
+            except ValueError as e:
                 # flat dev maps have no host/rack types: degrade to the
                 # simple rule instead of refusing the pool (the locality
                 # the steps encode needs a topology that does not exist)
                 import logging
 
                 logging.getLogger("ceph_tpu.osd").warning(
-                    "pool %s: placement steps %s need crush types not in "
-                    "this map (%s); using a simple rule",
-                    name, steps, sorted(type_names),
+                    "pool %s: %s; using a simple rule", name, e
                 )
+        if not added:
             self.crush.add_simple_rule(
                 root, fault_domain_type, RULE_TYPE_ERASURE, ruleset=ruleset,
                 indep=True, max_size=km,

@@ -130,6 +130,7 @@ class RadosClient(Dispatcher):
                 messages.MOSDOpReply,
                 messages.MMonCommandReply,
                 messages.MOSDScrubReply,
+                messages.MPGLsReply,
             ),
         ):
             fut = self._op_futs.pop(msg.tid, None)
@@ -271,6 +272,49 @@ class RadosClient(Dispatcher):
         raise RadosError(-EAGAIN, f"op to {pool_name}/{oid} exhausted retries"
                          ) from last_err
 
+    async def _pg_roundtrip(
+        self, pg, build_msg, timeout: float, resend_on_timeout: bool = True
+    ):
+        """One request to a PG's primary with map-change retargeting;
+        ``build_msg(tid)`` makes the message (the pg-addressed command
+        pattern shared by scrub and pgls)."""
+        for _attempt in range(self.max_retries):
+            epoch = self.osdmap.epoch
+            _up, _upp, _acting, primary = self.osdmap.pg_to_up_acting_osds(pg)
+            addr = self.osdmap.get_addr(primary) if primary >= 0 else None
+            if primary < 0 or not addr:
+                await self._wait_for_map_change(epoch, self.op_timeout)
+                continue
+            tid = next(self._tid)
+            fut = asyncio.get_running_loop().create_future()
+            self._op_futs[tid] = fut
+            try:
+                conn = await self.messenger.connect(addr, f"osd.{primary}")
+                self._fut_conns[tid] = conn
+                conn.send(build_msg(tid))
+                async with asyncio.timeout(timeout):
+                    reply = await fut
+            except TimeoutError:
+                self._op_futs.pop(tid, None)
+                self._fut_conns.pop(tid, None)
+                if not resend_on_timeout:
+                    raise RadosError(
+                        -EIO, f"pg {pg} request timed out after "
+                        f"{timeout:.0f}s (still running server-side)"
+                    )
+                await self._wait_for_map_change(epoch, 2.0)
+                continue
+            except (ConnectionError, OSError):
+                self._op_futs.pop(tid, None)
+                self._fut_conns.pop(tid, None)
+                await self._wait_for_map_change(epoch, 2.0)
+                continue
+            if reply.result == -EAGAIN:
+                await self._wait_for_map_change(epoch, self.op_timeout)
+                continue
+            return reply
+        raise RadosError(-EAGAIN, f"pg {pg} request exhausted retries")
+
     # -- scrub (the `ceph pg deep-scrub` / `rados scrub` surface)
     async def scrub_pool(
         self, pool_name: str, repair: bool = True
@@ -287,51 +331,36 @@ class RadosClient(Dispatcher):
         scrub_timeout = max(self.op_timeout * 6, 60.0)
         reports = []
         for pg in self.osdmap.pgs_of_pool(pool.id):
-            for attempt in range(self.max_retries):
-                epoch = self.osdmap.epoch
-                _up, _upp, _acting, primary = self.osdmap.pg_to_up_acting_osds(pg)
-                addr = self.osdmap.get_addr(primary) if primary >= 0 else None
-                if primary < 0 or not addr:
-                    await self._wait_for_map_change(epoch, self.op_timeout)
-                    continue
-                tid = next(self._tid)
-                fut = asyncio.get_running_loop().create_future()
-                self._op_futs[tid] = fut
-                try:
-                    conn = await self.messenger.connect(addr, f"osd.{primary}")
-                    self._fut_conns[tid] = conn
-                    conn.send(messages.MOSDScrub(
-                        tid=tid, pgid=str(pg), repair=repair,
-                    ))
-                    async with asyncio.timeout(scrub_timeout):
-                        reply = await fut
-                except TimeoutError:
-                    self._op_futs.pop(tid, None)
-                    self._fut_conns.pop(tid, None)
-                    # do NOT re-send: the scrub keeps running server-side,
-                    # and a resend would queue a duplicate full scrub of
-                    # the same PG behind it
-                    raise RadosError(
-                        -EIO, f"scrub of {pg} timed out after "
-                        f"{scrub_timeout:.0f}s (still running server-side)"
-                    )
-                except (ConnectionError, OSError):
-                    self._op_futs.pop(tid, None)
-                    self._fut_conns.pop(tid, None)
-                    await self._wait_for_map_change(epoch, 2.0)
-                    continue
-                if reply.result == -EAGAIN:
-                    await self._wait_for_map_change(epoch, self.op_timeout)
-                    continue
-                if reply.result < 0:
-                    raise RadosError(reply.result, str(reply.report))
-                reports.append(reply.report)
-                break
-            else:
-                raise RadosError(
-                    -EAGAIN, f"scrub of {pg} exhausted retries"
-                )
+            reply = await self._pg_roundtrip(
+                pg,
+                lambda tid, pg=pg: messages.MOSDScrub(
+                    tid=tid, pgid=str(pg), repair=repair,
+                ),
+                scrub_timeout,
+                resend_on_timeout=False,
+            )
+            if reply.result < 0:
+                raise RadosError(reply.result, str(reply.report))
+            reports.append(reply.report)
         return reports
+
+    async def list_objects(self, pool_name: str) -> list[str]:
+        """Every object name in a pool via per-PG pgls at the primaries
+        (`rados ls`, reference:src/osd/PrimaryLogPG.cc do_pg_op PGLS)."""
+        pool = self.osdmap.lookup_pool(pool_name) if self.osdmap else None
+        if pool is None:
+            raise RadosError(-ENOENT, f"no pool {pool_name!r}")
+        names: set[str] = set()
+        for pg in self.osdmap.pgs_of_pool(pool.id):
+            reply = await self._pg_roundtrip(
+                pg,
+                lambda tid, pg=pg: messages.MPGLs(tid=tid, pgid=str(pg)),
+                self.op_timeout,
+            )
+            if reply.result < 0:
+                raise RadosError(reply.result, f"pgls {pg}")
+            names.update(reply.names)
+        return sorted(names)
 
 
 class IoCtx:

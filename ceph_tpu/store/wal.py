@@ -184,13 +184,23 @@ class WalStore(MemStore):
     """
 
     def __init__(self, path: str, sync: str = "fsync",
-                 checkpoint_bytes: int = 64 << 20):
+                 checkpoint_bytes: int = 64 << 20,
+                 compression: str = "none"):
         super().__init__()
         if sync not in ("fsync", "flush", "none"):
             raise ValueError(f"bad sync mode {sync!r}")
         self.path = path
         self.sync = sync
         self.checkpoint_bytes = checkpoint_bytes
+        # checkpoint compression via the compressor plugin family (the
+        # BlueStore blob-compression analog, reference:src/compressor/);
+        # decompression keys off the header, so the setting may change
+        # between mounts
+        self.compression = compression
+        if compression != "none":
+            from ..compressor import create as _create_compressor
+
+            _create_compressor(compression)  # validate at construction
         self._journal: BinaryIO | None = None
         self._seq = 0  # last journaled seq
         self.crash_after: int | None = None  # journal appends until CrashPoint
@@ -350,6 +360,12 @@ class WalStore(MemStore):
                     _w_str(out, k)
                     _w_bytes(out, v)
         blob = bytes(out)
+        if self.compression != "none":
+            from ..compressor import create as _create_compressor
+
+            comp = _create_compressor(self.compression)
+            name = self.compression.encode()
+            blob = b"CMP1" + bytes([len(name)]) + name + comp.compress(blob)
         tmp = self._checkpoint_path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(_U32.pack(zlib.crc32(blob)))
@@ -382,6 +398,12 @@ class WalStore(MemStore):
             # half-written checkpoint never happens (atomic rename), but a
             # corrupt one must not take the store down: fall back to replay
             return 0
+        if blob[:4] == b"CMP1":
+            nlen = blob[4]
+            name = blob[5 : 5 + nlen].decode()
+            from ..compressor import create as _create_compressor
+
+            blob = _create_compressor(name).decompress(blob[5 + nlen :])
         rd = _Reader(blob)
         seq = rd.u64()
         n_colls = rd.u32()

@@ -1,0 +1,280 @@
+"""rados: the object-store CLI (reference:src/tools/rados/rados.cc).
+
+The reference's operator surface, narrowed to the verbs this framework
+serves: pool admin (lspools/mkpool/rmpool), object I/O
+(put/get/ls/rm/stat), xattrs (setxattr/getxattr/listxattr/rmxattr),
+scrub, df-style status, and a bench workload
+(reference:rados.cc bench / `rados bench`).
+
+Connects to a mon (or a comma-separated monmap) with -m/--mon.
+
+Usage examples:
+  rados -m 127.0.0.1:6789 lspools
+  rados -m ... mkpool data erasure
+  rados -m ... -p data put objname localfile
+  rados -m ... -p data get objname - | sha1sum
+  rados -m ... -p data ls
+  rados -m ... -p data scrub
+  rados -m ... bench data 5 write
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+import time
+
+from ..rados.client import RadosClient, RadosError
+
+
+def _mon_arg(m: str) -> "str | list[str]":
+    return m.split(",") if "," in m else m
+
+
+async def _with_client(args, fn) -> int:
+    client = await RadosClient(_mon_arg(args.mon)).connect()
+    try:
+        return await fn(client)
+    finally:
+        await client.shutdown()
+
+
+def _need_pool(args) -> str:
+    if not args.pool:
+        print("error: -p/--pool required", file=sys.stderr)
+        raise SystemExit(2)
+    return args.pool
+
+
+async def _cmd_lspools(client, args) -> int:
+    _code, _status, out = await client.command({"prefix": "osd pool ls"})
+    for name in out or []:
+        print(name)
+    return 0
+
+
+async def _cmd_mkpool(client, args) -> int:
+    kw = {"prefix": "osd pool create", "pool": args.name,
+          "pool_type": args.pool_type}
+    if args.profile:
+        kw["erasure_code_profile"] = args.profile
+    if args.size:
+        kw["size"] = args.size
+    code, status, _ = await client.command(kw)
+    if code < 0:
+        print(f"error: {status}", file=sys.stderr)
+        return 1
+    print(f"pool '{args.name}' created")
+    return 0
+
+
+async def _cmd_rmpool(client, args) -> int:
+    code, status, _ = await client.command(
+        {"prefix": "osd pool rm", "pool": args.name}
+    )
+    if code < 0:
+        print(f"error: {status}", file=sys.stderr)
+        return 1
+    return 0
+
+
+async def _cmd_df(client, args) -> int:
+    _code, _status, out = await client.command({"prefix": "status"})
+    for k, v in (out or {}).items():
+        print(f"{k}: {v}")
+    return 0
+
+
+async def _cmd_put(client, args) -> int:
+    io = client.io_ctx(_need_pool(args))
+    data = (
+        sys.stdin.buffer.read() if args.infile == "-"
+        else open(args.infile, "rb").read()
+    )
+    await io.write_full(args.obj, data)
+    return 0
+
+
+async def _cmd_get(client, args) -> int:
+    io = client.io_ctx(_need_pool(args))
+    data = await io.read(args.obj)
+    if args.outfile == "-":
+        sys.stdout.buffer.write(data)
+    else:
+        with open(args.outfile, "wb") as f:
+            f.write(data)
+    return 0
+
+
+async def _cmd_ls(client, args) -> int:
+    for n in await client.list_objects(_need_pool(args)):
+        print(n)
+    return 0
+
+
+async def _cmd_rm(client, args) -> int:
+    io = client.io_ctx(_need_pool(args))
+    await io.remove(args.obj)
+    return 0
+
+
+async def _cmd_stat(client, args) -> int:
+    io = client.io_ctx(_need_pool(args))
+    size = await io.stat(args.obj)
+    print(f"{args.pool}/{args.obj} size {size}")
+    return 0
+
+
+async def _cmd_setxattr(client, args) -> int:
+    io = client.io_ctx(_need_pool(args))
+    await io.setxattr(args.obj, args.key, args.value.encode())
+    return 0
+
+
+async def _cmd_getxattr(client, args) -> int:
+    io = client.io_ctx(_need_pool(args))
+    sys.stdout.buffer.write(await io.getxattr(args.obj, args.key))
+    return 0
+
+
+async def _cmd_listxattr(client, args) -> int:
+    io = client.io_ctx(_need_pool(args))
+    for k in sorted(await io.getxattrs(args.obj)):
+        print(k)
+    return 0
+
+
+async def _cmd_rmxattr(client, args) -> int:
+    io = client.io_ctx(_need_pool(args))
+    await io.rmxattr(args.obj, args.key)
+    return 0
+
+
+async def _cmd_scrub(client, args) -> int:
+    reports = await client.scrub_pool(
+        _need_pool(args), repair=not args.no_repair
+    )
+    errors = sum(len(r["errors"]) for r in reports)
+    repaired = sum(r["repaired"] for r in reports)
+    objects = sum(r["objects"] for r in reports)
+    print(
+        f"scrubbed {len(reports)} pgs, {objects} objects: "
+        f"{errors} errors, {repaired} repaired"
+    )
+    return 0 if errors == repaired else 1
+
+
+async def _cmd_bench(client, args) -> int:
+    """`rados bench <pool> <seconds> write|seq` (reference:rados.cc bench)."""
+    io = client.io_ctx(args.name)
+    size = args.object_size
+    deadline = time.monotonic() + args.seconds
+    n = 0
+    payload = os.urandom(size)
+    t0 = time.monotonic()
+    if args.mode == "write":
+        while time.monotonic() < deadline:
+            await io.write_full(f"bench_{n}", payload)
+            n += 1
+    else:
+        # seq: read the objects a prior `bench ... write` run left behind
+        names = [
+            x for x in await client.list_objects(args.name)
+            if x.startswith("bench_")
+        ]
+        if not names:
+            print("seq: no bench_* objects (run `bench ... write` first)",
+                  file=sys.stderr)
+            return 1
+        sizes = await io.stat(names[0])
+        size = max(sizes, 1)
+        t0 = time.monotonic()
+        while time.monotonic() < deadline:
+            await io.read(names[n % len(names)])
+            n += 1
+    dt = time.monotonic() - t0
+    total_mb = n * size / 1e6
+    print(
+        f"{args.mode}: {n} ops, {total_mb:.1f} MB in {dt:.2f}s = "
+        f"{total_mb / dt:.2f} MB/s, {n / dt:.1f} ops/s"
+    )
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="rados", description=__doc__)
+    p.add_argument("-m", "--mon", required=True,
+                   help="mon address (comma-separate a monmap)")
+    p.add_argument("-p", "--pool", default=None)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("lspools")
+    mk = sub.add_parser("mkpool")
+    mk.add_argument("name")
+    mk.add_argument("pool_type", nargs="?", default="replicated",
+                    choices=["replicated", "erasure"])
+    mk.add_argument("--profile", default=None)
+    mk.add_argument("--size", type=int, default=None)
+    rm = sub.add_parser("rmpool")
+    rm.add_argument("name")
+    sub.add_parser("df")
+
+    put = sub.add_parser("put")
+    put.add_argument("obj")
+    put.add_argument("infile")
+    get = sub.add_parser("get")
+    get.add_argument("obj")
+    get.add_argument("outfile")
+    ls = sub.add_parser("ls")
+    rmo = sub.add_parser("rm")
+    rmo.add_argument("obj")
+    st = sub.add_parser("stat")
+    st.add_argument("obj")
+
+    sx = sub.add_parser("setxattr")
+    sx.add_argument("obj")
+    sx.add_argument("key")
+    sx.add_argument("value")
+    gx = sub.add_parser("getxattr")
+    gx.add_argument("obj")
+    gx.add_argument("key")
+    lx = sub.add_parser("listxattr")
+    lx.add_argument("obj")
+    rx = sub.add_parser("rmxattr")
+    rx.add_argument("obj")
+    rx.add_argument("key")
+
+    sc = sub.add_parser("scrub")
+    sc.add_argument("--no-repair", action="store_true")
+
+    be = sub.add_parser("bench")
+    be.add_argument("name")
+    be.add_argument("seconds", type=int)
+    be.add_argument("mode", choices=["write", "seq"])
+    be.add_argument("--object-size", type=int, default=65536)
+
+    args = p.parse_args(argv)
+    fn = {
+        "lspools": _cmd_lspools, "mkpool": _cmd_mkpool,
+        "rmpool": _cmd_rmpool, "df": _cmd_df,
+        "put": _cmd_put, "get": _cmd_get, "ls": _cmd_ls, "rm": _cmd_rm,
+        "stat": _cmd_stat,
+        "setxattr": _cmd_setxattr, "getxattr": _cmd_getxattr,
+        "listxattr": _cmd_listxattr, "rmxattr": _cmd_rmxattr,
+        "scrub": _cmd_scrub, "bench": _cmd_bench,
+    }[args.cmd]
+
+    async def run(client):
+        try:
+            return await fn(client, args)
+        except RadosError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+
+    return asyncio.run(_with_client(args, run))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
