@@ -12,6 +12,9 @@ unix admin socket serving `perf dump` / `config show|set` /
 from .config import Config, Option, OPTIONS
 from .perf_counters import PerfCounters, PerfCountersCollection
 from .admin_socket import AdminSocket
+from .heartbeat_map import HeartbeatHandle, HeartbeatMap
+from .lockdep import LockdepLock, LockOrderViolation, lockdep_enable
+from .tracing import TraceProvider, tracepoint_provider
 
 __all__ = [
     "Config",
@@ -20,4 +23,11 @@ __all__ = [
     "PerfCounters",
     "PerfCountersCollection",
     "AdminSocket",
+    "HeartbeatHandle",
+    "HeartbeatMap",
+    "LockdepLock",
+    "LockOrderViolation",
+    "lockdep_enable",
+    "TraceProvider",
+    "tracepoint_provider",
 ]

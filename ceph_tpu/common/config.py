@@ -100,6 +100,13 @@ OPTIONS: dict[str, Option] = _opts(
     # admin
     Option("admin_socket", str, "",
            "unix socket path for perf dump / config commands ('' = off)"),
+    # debugging (reference:lockdep + HeartbeatMap thread timeouts)
+    Option("lockdep", bool, False,
+           "detect lock-order cycles on PG/daemon locks"),
+    Option("osd_op_thread_timeout", float, 15.0,
+           "op worker heartbeat grace before the daemon is unhealthy"),
+    Option("osd_op_thread_suicide_timeout", float, 150.0,
+           "op worker stall that aborts the daemon (0 disables)"),
 )
 
 

@@ -1,0 +1,74 @@
+"""Lightweight tracepoints (reference:src/tracing/*.tp, common/EventTrace).
+
+The reference compiles LTTng-UST tracepoint providers (osd/oprequest/
+pg/objectstore/librados/...) wrapping hot-path boundaries; collection
+is out-of-process.  Here a provider is a named ring buffer of
+timestamped events, cheap enough to leave enabled, dumpable via the
+admin socket ("dump_tracepoints") and inspectable in tests.
+
+Spans (``with provider.span("encode", oid=...)``) record begin/end
+pairs with the elapsed time, the EventTrace analog.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import deque
+from typing import Any, Iterator
+
+_providers: dict[str, "TraceProvider"] = {}
+
+
+class TraceProvider:
+    """One subsystem's tracepoint provider (an ``osd.tp`` analog)."""
+
+    def __init__(self, name: str, capacity: int = 4096):
+        self.name = name
+        self.enabled = True
+        self._events: deque[dict] = deque(maxlen=capacity)
+
+    def point(self, event: str, **fields: Any) -> None:
+        if not self.enabled:
+            return
+        self._events.append(
+            {"ts": time.monotonic(), "event": event, **fields}
+        )
+
+    @contextlib.contextmanager
+    def span(self, event: str, **fields: Any) -> Iterator[None]:
+        if not self.enabled:
+            yield
+            return
+        t0 = time.monotonic()
+        self.point(f"{event}_enter", **fields)
+        try:
+            yield
+        finally:
+            self.point(
+                f"{event}_exit", elapsed=time.monotonic() - t0, **fields
+            )
+
+    def events(self, event: str | None = None) -> list[dict]:
+        return [
+            e for e in self._events if event is None or e["event"] == event
+        ]
+
+    def clear(self) -> None:
+        self._events.clear()
+
+    def dump(self) -> dict:
+        return {"name": self.name, "enabled": self.enabled,
+                "events": list(self._events)}
+
+
+def tracepoint_provider(name: str) -> TraceProvider:
+    """Get-or-create, like TracepointProvider::instance
+    (reference:src/common/TracepointProvider.h)."""
+    if name not in _providers:
+        _providers[name] = TraceProvider(name)
+    return _providers[name]
+
+
+def dump_all() -> dict:
+    return {n: p.dump() for n, p in _providers.items()}

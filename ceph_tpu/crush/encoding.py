@@ -54,6 +54,9 @@ def crush_to_dict(cmap: CrushMap) -> dict:
         ],
         "type_names": {str(k): v for k, v in cmap.type_names.items()},
         "item_names": {str(k): v for k, v in cmap.item_names.items()},
+        "rule_names": {
+            str(k): v for k, v in getattr(cmap, "rule_names", {}).items()
+        },
     }
 
 
@@ -76,4 +79,7 @@ def crush_from_dict(d: dict) -> CrushMap:
         cmap.rules.append(rule)
     cmap.type_names = {int(k): v for k, v in d["type_names"].items()}
     cmap.item_names = {int(k): v for k, v in d["item_names"].items()}
+    cmap.rule_names = {
+        int(k): v for k, v in d.get("rule_names", {}).items()
+    }
     return cmap

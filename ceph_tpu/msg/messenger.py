@@ -260,8 +260,20 @@ class AsyncMessenger:
                     ).encode() + b"\n"
                 )
                 await writer.drain()
-                banner = json.loads((await reader.readline()).decode())
-                conn.peer_name = banner["entity"]
+                line = await reader.readline()
+                if not line:
+                    # peer died between accept and banner: a transient
+                    # reset, not a protocol error — must hit the retry loop
+                    raise ConnectionResetError(
+                        f"{addr}: peer closed during handshake"
+                    )
+                try:
+                    banner = json.loads(line.decode())
+                    conn.peer_name = banner["entity"]
+                except (ValueError, KeyError) as e:
+                    raise ConnectionResetError(
+                        f"{addr}: bad handshake banner: {e!r}"
+                    ) from e
         except BaseException:
             if writer is not None:
                 writer.close()  # a half-done handshake must not leak the fd

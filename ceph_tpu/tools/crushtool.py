@@ -6,8 +6,15 @@ builds simple hierarchies, and bulk-simulates placement with --test
 here is the framework's JSON wire form (ceph_tpu.crush.encoding) instead
 of the boost::spirit text grammar.
 
+Text-format interop (reference:src/crush/CrushCompiler.cc) lives in
+ceph_tpu.crush.compiler: ``-c map.txt`` compiles the reference text
+grammar, ``-d map.json`` decompiles to it; ``-i``/``-o`` take either
+form (files ending .txt/.map are treated as text).
+
 Usage:
   crushtool --build N [--weight W] -o map.json
+  crushtool -c map.txt -o map.json       # compile text -> wire form
+  crushtool -d map.json [-o map.txt]     # decompile -> text
   crushtool -i map.json --tree
   crushtool -i map.json --test [--num-rep N] [--min-x A] [--max-x B]
             [--rule R] [--show-utilization] [--show-mappings] [--scalar]
@@ -19,19 +26,29 @@ import argparse
 import json
 import sys
 
+from ..crush.compiler import compile_crushmap, decompile_crushmap
 from ..crush.encoding import crush_from_dict, crush_to_dict
 from ..crush.map import CrushMap
 from ..crush.tester import CrushTester
 
 
+def _is_text(path: str) -> bool:
+    return path.endswith((".txt", ".map"))
+
+
 def _load(path: str) -> CrushMap:
     with open(path) as f:
+        if _is_text(path):
+            return compile_crushmap(f.read())
         return crush_from_dict(json.load(f))
 
 
 def _save(cmap: CrushMap, path: str) -> None:
     with open(path, "w") as f:
-        json.dump(crush_to_dict(cmap), f, indent=1)
+        if _is_text(path):
+            f.write(decompile_crushmap(cmap))
+        else:
+            json.dump(crush_to_dict(cmap), f, indent=1)
 
 
 def _tree(cmap: CrushMap, out) -> None:
@@ -53,8 +70,12 @@ def _tree(cmap: CrushMap, out) -> None:
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="crushtool", description=__doc__)
-    p.add_argument("-i", "--infn", help="input map (JSON wire form)")
+    p.add_argument("-i", "--infn", help="input map (JSON wire or text form)")
     p.add_argument("-o", "--outfn", help="output map file")
+    p.add_argument("-c", "--compile", metavar="SRC",
+                   help="compile a text crushmap")
+    p.add_argument("-d", "--decompile", metavar="SRC",
+                   help="decompile a map to text (stdout unless -o)")
     p.add_argument("--build", type=int, metavar="N",
                    help="build a flat N-device straw2 map")
     p.add_argument("--weight", type=float, default=1.0)
@@ -75,10 +96,19 @@ def main(argv=None) -> int:
         cmap = CrushMap.flat(args.build, weight=args.weight)
         cmap.add_simple_rule(cmap.root_id(), 0)
         cmap.add_simple_rule(cmap.root_id(), 0, indep=True)
+    elif args.compile:
+        if not args.outfn and not (args.tree or args.test):
+            p.error("-c needs -o <outfile> (or --tree/--test)")
+        with open(args.compile) as f:
+            cmap = compile_crushmap(f.read())
+    elif args.decompile:
+        cmap = _load(args.decompile)
+        if not args.outfn:
+            out.write(decompile_crushmap(cmap))
     elif args.infn:
         cmap = _load(args.infn)
     else:
-        p.error("need -i <map> or --build N")
+        p.error("need -i <map>, -c/-d <map>, or --build N")
 
     if args.tree:
         _tree(cmap, out)

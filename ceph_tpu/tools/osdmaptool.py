@@ -59,10 +59,14 @@ def _print(m: OSDMap) -> None:
 
 
 def _test_map_pgs(m: OSDMap, pool_id: int | None) -> int:
-    pools = (
-        [m.pools[pool_id]] if pool_id is not None
-        else list(m.pools.values())
-    )
+    if pool_id is not None:
+        pool = m.pools.get(pool_id)
+        if pool is None:
+            print(f"pool {pool_id} does not exist", file=sys.stderr)
+            return 1
+        pools = [pool]
+    else:
+        pools = list(m.pools.values())
     if not pools:
         print("no pools", file=sys.stderr)
         return 1

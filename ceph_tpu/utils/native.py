@@ -31,9 +31,11 @@ def build(force: bool = False) -> pathlib.Path:
         if _SO.stat().st_mtime >= _SRC.stat().st_mtime:
             return _SO
     _BUILD.mkdir(parents=True, exist_ok=True)
+    from .arch import host_march_flags
+
     cmd = [
-        "g++", "-O3", "-march=native", "-funroll-loops", "-shared", "-fPIC",
-        "-std=c++17", str(_SRC), "-o", str(_SO),
+        "g++", "-O3", *host_march_flags(), "-funroll-loops", "-shared",
+        "-fPIC", "-std=c++17", str(_SRC), "-o", str(_SO),
     ]
     subprocess.run(cmd, check=True, capture_output=True)
     return _SO

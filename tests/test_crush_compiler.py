@@ -1,0 +1,225 @@
+"""Text crushmap compile/decompile round-trips.
+
+Mirrors the reference's compile-decompile-recompile identity tests
+(reference:src/test/cli/crushtool/, CrushCompiler.cc): the text form is
+the interop contract, so a decompiled map must recompile to a map that
+places objects identically.
+"""
+
+import subprocess
+import sys
+
+import pytest
+
+from ceph_tpu.crush import mapper
+from ceph_tpu.crush.compiler import (
+    CrushCompileError,
+    compile_crushmap,
+    decompile_crushmap,
+)
+from ceph_tpu.crush.map import (
+    CRUSH_BUCKET_LIST,
+    CRUSH_BUCKET_STRAW2,
+    CRUSH_BUCKET_TREE,
+    CRUSH_BUCKET_UNIFORM,
+    CrushMap,
+    Tunables,
+)
+
+REFERENCE_STYLE_MAP = """\
+# begin crush map
+tunable choose_local_tries 0
+tunable choose_local_fallback_tries 0
+tunable choose_total_tries 50
+tunable chooseleaf_descend_once 1
+tunable chooseleaf_vary_r 1
+tunable chooseleaf_stable 1
+tunable straw_calc_version 1
+
+# devices
+device 0 osd.0
+device 1 osd.1
+device 2 osd.2
+device 3 osd.3
+device 4 osd.4
+device 5 osd.5
+
+# types
+type 0 osd
+type 1 host
+type 2 rack
+type 3 root
+
+# buckets
+host host0 {
+\tid -1\t\t# do not change unnecessarily
+\t# weight 2.000
+\talg straw2
+\thash 0\t# rjenkins1
+\titem osd.0 weight 1.000
+\titem osd.1 weight 1.000
+}
+host host1 {
+\tid -2
+\talg straw2
+\thash 0
+\titem osd.2 weight 1.000
+\titem osd.3 weight 1.000
+}
+host host2 {
+\tid -3
+\talg straw2
+\thash 0
+\titem osd.4 weight 1.000
+\titem osd.5 weight 1.000
+}
+rack rack0 {
+\tid -4
+\talg straw2
+\thash 0
+\titem host0 weight 2.000
+\titem host1 weight 2.000
+}
+rack rack1 {
+\tid -5
+\talg straw2
+\thash 0
+\titem host2 weight 2.000
+}
+root default {
+\tid -6
+\talg straw2
+\thash 0
+\titem rack0 weight 4.000
+\titem rack1 weight 2.000
+}
+
+# rules
+rule replicated_ruleset {
+\truleset 0
+\ttype replicated
+\tmin_size 1
+\tmax_size 10
+\tstep take default
+\tstep chooseleaf firstn 0 type host
+\tstep emit
+}
+rule ecpool {
+\truleset 1
+\ttype erasure
+\tmin_size 3
+\tmax_size 20
+\tstep set_chooseleaf_tries 5
+\tstep take default
+\tstep chooseleaf indep 0 type host
+\tstep emit
+}
+
+# end crush map
+"""
+
+
+def _mappings(m, ruleno, numrep, xs=range(64)):
+    ws = mapper.Workspace(m)
+    return [
+        mapper.crush_do_rule(m, ruleno, x, numrep, workspace=ws) for x in xs
+    ]
+
+
+class TestCompile:
+    def test_reference_style_map_compiles(self):
+        m = compile_crushmap(REFERENCE_STYLE_MAP)
+        assert m.max_devices == 6
+        assert sorted(m.buckets) == [-6, -5, -4, -3, -2, -1]
+        assert m.type_names == {0: "osd", 1: "host", 2: "rack", 3: "root"}
+        assert m.item_names[-6] == "default"
+        assert m.rule_names == {0: "replicated_ruleset", 1: "ecpool"}
+        assert m.tunables.choose_total_tries == 50
+        assert m.tunables.chooseleaf_stable == 1
+
+    def test_compiled_map_places(self):
+        m = compile_crushmap(REFERENCE_STYLE_MAP)
+        for res in _mappings(m, 0, 3):
+            assert len(res) == 3
+            assert len(set(res)) == 3
+            # chooseleaf over hosts: no two replicas on one host
+            hosts = {d // 2 for d in res}
+            assert len(hosts) == 3
+
+    def test_unknown_item_fails(self):
+        bad = REFERENCE_STYLE_MAP.replace("item osd.5", "item osd.99")
+        with pytest.raises(CrushCompileError):
+            compile_crushmap(bad)
+
+    def test_unknown_step_fails(self):
+        bad = REFERENCE_STYLE_MAP.replace("step emit", "step emits", 1)
+        with pytest.raises(CrushCompileError):
+            compile_crushmap(bad)
+
+
+class TestRoundTrip:
+    def _roundtrip(self, m):
+        text = decompile_crushmap(m)
+        m2 = compile_crushmap(text)
+        # identical structure where it matters: same placements
+        for ruleno, r in enumerate(m.rules):
+            if r is None:
+                continue
+            nrep = 3 if r.max_size >= 3 else r.max_size
+            assert _mappings(m, ruleno, nrep) == _mappings(m2, ruleno, nrep)
+        # and the text form is a fixed point
+        assert decompile_crushmap(m2) == text
+        return m2
+
+    def test_hierarchical(self):
+        m = CrushMap.hierarchical([[0, 1], [2, 3], [4, 5], [6, 7]])
+        m.add_simple_rule(m.root_id(), 1)
+        m.add_simple_rule(m.root_id(), 1, indep=True)
+        self._roundtrip(m)
+
+    def test_reference_style(self):
+        m = compile_crushmap(REFERENCE_STYLE_MAP)
+        m2 = self._roundtrip(m)
+        assert m2.rule_names == m.rule_names
+
+    def test_all_bucket_algs(self):
+        m = CrushMap(Tunables.jewel())
+        m.type_names.update({1: "host", 2: "root"})
+        w = [0x10000, 0x10000]
+        b0 = m.make_bucket(CRUSH_BUCKET_UNIFORM, 1, [0, 1], w, name="h0")
+        b1 = m.make_bucket(CRUSH_BUCKET_LIST, 1, [2, 3], w, name="h1")
+        b2 = m.make_bucket(CRUSH_BUCKET_TREE, 1, [4, 5], w, name="h2")
+        b3 = m.make_bucket(CRUSH_BUCKET_STRAW2, 1, [6, 7], w, name="h3")
+        ws = [m.buckets[b].weight for b in (b0, b1, b2, b3)]
+        m.make_bucket(CRUSH_BUCKET_STRAW2, 2, [b0, b1, b2, b3], ws,
+                      name="default")
+        m.add_simple_rule(m.root_id(), 1)
+        self._roundtrip(m)
+
+    def test_legacy_tunables_print_nothing(self):
+        m = CrushMap.flat(4, tunables=Tunables.legacy())
+        m.add_simple_rule(m.root_id(), 0)
+        text = decompile_crushmap(m)
+        assert "tunable" not in text
+        self._roundtrip(m)
+
+
+class TestCLI:
+    def test_compile_decompile_cli(self, tmp_path):
+        src = tmp_path / "in.txt"
+        src.write_text(REFERENCE_STYLE_MAP)
+        js = tmp_path / "map.json"
+        r = subprocess.run(
+            [sys.executable, "-m", "ceph_tpu.tools.crushtool",
+             "-c", str(src), "-o", str(js)],
+            capture_output=True, text=True,
+        )
+        assert r.returncode == 0, r.stderr
+        r = subprocess.run(
+            [sys.executable, "-m", "ceph_tpu.tools.crushtool",
+             "-d", str(js)],
+            capture_output=True, text=True,
+        )
+        assert r.returncode == 0, r.stderr
+        assert "step chooseleaf firstn 0 type host" in r.stdout
+        assert "root default {" in r.stdout

@@ -1,0 +1,266 @@
+"""Watchdog / lockdep / tracing / arch-probe tests.
+
+Reference intents: HeartbeatMap worker deadlines with suicide aborts
+(reference:src/common/HeartbeatMap.{h,cc}), lockdep lock-order cycle
+detection (reference:src/common/lockdep.cc), tracepoint providers on op
+boundaries (reference:src/tracing/oprequest.tp), and the startup
+capability probe gating kernel dispatch (reference:src/arch/probe.cc).
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from ceph_tpu.common.heartbeat_map import HeartbeatMap
+from ceph_tpu.common.lockdep import (
+    LockdepLock,
+    LockOrderViolation,
+    lockdep_enable,
+    lockdep_reset,
+)
+from ceph_tpu.common.tracing import tracepoint_provider
+
+
+# -- HeartbeatMap ------------------------------------------------------------
+
+
+class TestHeartbeatMap:
+    def test_healthy_lifecycle(self):
+        hm = HeartbeatMap("osd.0")
+        h = hm.add_worker("w", grace=5.0)
+        assert hm.is_healthy()  # idle
+        h.reset_timeout()
+        assert hm.is_healthy()  # fresh
+        h.clear_timeout()
+        assert hm.is_healthy()  # idle again
+
+    def test_missed_grace_is_unhealthy(self):
+        hm = HeartbeatMap("osd.0")
+        h = hm.add_worker("w", grace=0.01)
+        h.reset_timeout()
+        time.sleep(0.03)
+        assert not hm.is_healthy()
+        h.reset_timeout()  # worker touched it again
+        assert hm.is_healthy()
+
+    def test_suicide_fires_callback(self):
+        died = []
+        hm = HeartbeatMap("osd.0", on_suicide=died.append)
+        h = hm.add_worker("w", grace=0.0005, suicide_grace=0.001)
+        h.reset_timeout()
+        time.sleep(0.01)
+        assert not hm.is_healthy()
+        assert died == ["w"]
+
+    def test_default_suicide_raises(self):
+        hm = HeartbeatMap("osd.0")
+        h = hm.add_worker("w", grace=0.0005, suicide_grace=0.001)
+        h.reset_timeout()
+        time.sleep(0.01)
+        with pytest.raises(SystemExit):
+            hm.is_healthy()
+
+    def test_dump(self):
+        hm = HeartbeatMap("osd.0")
+        h = hm.add_worker("op_worker", grace=10.0, suicide_grace=100.0)
+        h.reset_timeout()
+        d = hm.dump()
+        assert d["workers"][0]["name"] == "op_worker"
+        assert d["workers"][0]["idle"] is False
+        assert d["workers"][0]["overdue"] is False
+
+    def test_suicide_aborts_daemon_without_heartbeat_loop(self):
+        """The watchdog loop is independent of peer pings (which default
+        off): a wedged op past the suicide timeout takes the daemon down
+        even with osd_heartbeat_interval=0."""
+        from ceph_tpu.common import Config
+        from ceph_tpu.osd.daemon import OSD
+        from ceph_tpu.rados import MiniCluster
+
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                await cluster.kill_osd(0)
+                cfg = Config(overrides={
+                    "osd_op_thread_timeout": 0.03,
+                    "osd_op_thread_suicide_timeout": 0.06,
+                })
+                osd = OSD(0, cluster.mon.addr, store=cluster.stores[0],
+                          config=cfg)
+                await osd.start()
+                cluster.osds[0] = osd
+                assert osd._wd_task is not None
+                osd._inflight[1] = {"_t0": time.monotonic()}  # wedged op
+                osd._refresh_op_handle()
+                for _ in range(100):
+                    if osd._stopping:
+                        break
+                    await asyncio.sleep(0.02)
+                assert osd._stopping  # the daemon aborted itself
+                await asyncio.sleep(0.05)  # let stop() finish
+
+        asyncio.run(main())
+
+    def test_wedged_op_marks_osd_unhealthy(self):
+        """The OSD wires its op engine to the map: an op stuck longer
+        than osd_op_thread_timeout makes the daemon report unhealthy."""
+
+        async def main():
+            from ceph_tpu.common import Config
+            from ceph_tpu.osd.daemon import OSD
+
+            cfg = Config(overrides={"osd_op_thread_timeout": 0.01})
+            osd = OSD(0, "127.0.0.1:1", config=cfg)
+            assert osd.hb_map.is_healthy()
+            # simulate a wedged in-flight op without a cluster
+            osd._inflight[1] = {"_t0": time.monotonic() - 1.0}
+            osd._refresh_op_handle()
+            assert not osd.hb_map.is_healthy()
+            osd._inflight.clear()
+            osd._refresh_op_handle()
+            assert osd.hb_map.is_healthy()
+
+        asyncio.run(main())
+
+
+# -- lockdep -----------------------------------------------------------------
+
+
+@pytest.fixture
+def lockdep():
+    lockdep_enable(True)
+    yield
+    lockdep_enable(False)
+
+
+class TestLockdep:
+    def test_consistent_order_ok(self, lockdep):
+        async def main():
+            a, b = LockdepLock("A"), LockdepLock("B")
+            for _ in range(3):
+                async with a:
+                    async with b:
+                        pass
+
+        asyncio.run(main())
+
+    def test_abba_detected_without_deadlock(self, lockdep):
+        """The second task takes B->A after A->B was recorded: lockdep
+        raises on the ACQUISITION ORDER even though no actual deadlock
+        happens (the reference's whole point)."""
+
+        async def main():
+            a, b = LockdepLock("A"), LockdepLock("B")
+            async with a:
+                async with b:
+                    pass
+            with pytest.raises(LockOrderViolation):
+                async with b:
+                    async with a:
+                        pass
+
+        asyncio.run(main())
+
+    def test_recursive_lock_detected(self, lockdep):
+        async def main():
+            a = LockdepLock("A")
+            with pytest.raises(LockOrderViolation):
+                async with a:
+                    await a.acquire()
+
+        asyncio.run(main())
+
+    def test_disabled_is_plain_lock(self):
+        lockdep_enable(False)
+
+        async def main():
+            a, b = LockdepLock("A"), LockdepLock("B")
+            async with a:
+                async with b:
+                    pass
+            async with b:
+                async with a:  # would violate, but lockdep is off
+                    pass
+
+        asyncio.run(main())
+
+    def test_reset_forgets_history(self, lockdep):
+        async def main():
+            a, b = LockdepLock("A"), LockdepLock("B")
+            async with a:
+                async with b:
+                    pass
+            lockdep_reset()
+            async with b:
+                async with a:
+                    pass
+
+        asyncio.run(main())
+
+
+# -- tracing -----------------------------------------------------------------
+
+
+class TestTracing:
+    def test_points_and_spans(self):
+        p = tracepoint_provider("test_subsys")
+        p.clear()
+        p.point("ev", x=1)
+        with p.span("work", oid="o1"):
+            pass
+        events = [e["event"] for e in p.events()]
+        assert events == ["ev", "work_enter", "work_exit"]
+        exit_ev = p.events("work_exit")[0]
+        assert exit_ev["elapsed"] >= 0
+        assert exit_ev["oid"] == "o1"
+
+    def test_provider_is_singleton(self):
+        assert tracepoint_provider("x1") is tracepoint_provider("x1")
+
+    def test_disabled_provider_records_nothing(self):
+        p = tracepoint_provider("test_off")
+        p.clear()
+        p.enabled = False
+        p.point("ev")
+        with p.span("s"):
+            pass
+        assert p.events() == []
+        p.enabled = True
+
+    def test_ring_capacity(self):
+        from ceph_tpu.common.tracing import TraceProvider
+
+        p = TraceProvider("cap", capacity=4)
+        for i in range(10):
+            p.point("e", i=i)
+        evs = p.events()
+        assert len(evs) == 4
+        assert evs[-1]["i"] == 9
+
+
+# -- arch probe --------------------------------------------------------------
+
+
+class TestArchProbe:
+    def test_probe_under_tests_is_cpu(self):
+        from ceph_tpu.utils import arch
+
+        p = arch.probe()
+        assert p.platform == "cpu"  # conftest pins jax to cpu
+        assert p.num_devices == 8  # virtual device mesh
+        assert not p.has_mxu
+        assert p.preferred_gf_kernel == "u32_doubling"
+        assert arch.probe() is p  # cached single probe
+
+    def test_dump_shape(self):
+        from ceph_tpu.utils import arch
+
+        d = arch.dump()
+        assert {"platform", "device_kind", "num_devices",
+                "preferred_gf_kernel", "host_march_flags"} <= set(d)
+
+    def test_march_flags_compile(self):
+        from ceph_tpu.utils import arch
+
+        flags = arch.host_march_flags()
+        assert isinstance(flags, list)
