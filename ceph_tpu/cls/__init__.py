@@ -1,0 +1,168 @@
+"""Object classes: in-OSD stored procedures (reference:src/cls/).
+
+The reference loads ``libcls_*.so`` plugins into the OSD; clients invoke
+their methods atomically on one object via the ``call`` op
+(reference:src/osd/PrimaryLogPG.cc do_osd_ops CEPH_OSD_OP_CALL →
+ClassHandler, reference:src/osd/ClassHandler.cc).  A method declares
+RD/WR flags; its reads see the object's current state and its writes
+join the op's transaction, so the whole call commits atomically with
+the rest of the client op.
+
+Here a class is a registered Python module of methods over a
+:class:`MethodContext` (the ``cls_method_context_t`` analog).  The
+built-ins mirror the reference's most-used classes: ``lock``
+(advisory object locks, reference:src/cls/lock/) and ``refcount``
+(reference:src/cls/refcount/).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable
+
+CLS_METHOD_RD = 1
+CLS_METHOD_WR = 2
+
+# errnos the methods use (match the OSD's convention)
+EBUSY = 16
+EEXIST = 17
+ENOENT = 2
+EINVAL = 22
+
+
+class ClsError(Exception):
+    """Method failure with an errno (negative return in the reference)."""
+
+    def __init__(self, code: int, msg: str = ""):
+        super().__init__(msg or f"cls error {code}")
+        self.code = code
+
+
+class MethodContext:
+    """What a method may touch: ONE object, through the op's transaction
+    (reference:cls_method_context_t / PrimaryLogPG::do_osd_op wrapper).
+
+    Reads go to the store's current state; writes are recorded through
+    the supplied callbacks so they join the surrounding transaction and
+    commit (and replicate) atomically with it.
+    """
+
+    def __init__(
+        self,
+        *,
+        read: Callable[[], bytes | None],
+        getxattr: Callable[[str], bytes | None],
+        setxattr: Callable[[str, bytes], None] | None = None,
+        omap_get: Callable[[], dict[str, bytes]] | None = None,
+        omap_set: Callable[[dict[str, bytes]], None] | None = None,
+        omap_rm: Callable[[list[str]], None] | None = None,
+        write_full: Callable[[bytes], None] | None = None,
+        writable: bool = False,
+    ):
+        self._read = read
+        self._getxattr = getxattr
+        self._setxattr = setxattr
+        self._omap_get = omap_get
+        self._omap_set = omap_set
+        self._omap_rm = omap_rm
+        self._write_full = write_full
+        self.writable = writable
+
+    # -- reads
+    def read(self) -> bytes | None:
+        return self._read()
+
+    def getxattr(self, key: str) -> bytes | None:
+        return self._getxattr(key)
+
+    def omap_get(self) -> dict[str, bytes]:
+        return self._omap_get() if self._omap_get else {}
+
+    # -- writes (WR methods only)
+    def _need_wr(self) -> None:
+        if not self.writable:
+            raise ClsError(EINVAL, "write from a read-only method context")
+
+    def setxattr(self, key: str, value: bytes) -> None:
+        self._need_wr()
+        self._setxattr(key, value)
+
+    def omap_set(self, kv: dict[str, bytes]) -> None:
+        self._need_wr()
+        self._omap_set(kv)
+
+    def omap_rm(self, keys: list[str]) -> None:
+        self._need_wr()
+        self._omap_rm(keys)
+
+    def write_full(self, data: bytes) -> None:
+        self._need_wr()
+        self._write_full(data)
+
+    # -- convenience for json-speaking methods
+    def get_json(self, key: str) -> dict | None:
+        raw = self.getxattr(key)
+        return json.loads(raw) if raw else None
+
+    def set_json(self, key: str, value: dict) -> None:
+        self.setxattr(key, json.dumps(value).encode())
+
+
+class ClassMethod:
+    def __init__(self, name: str, flags: int, fn: Callable):
+        self.name = name
+        self.flags = flags
+        self.fn = fn
+
+    @property
+    def is_write(self) -> bool:
+        return bool(self.flags & CLS_METHOD_WR)
+
+
+class ObjectClass:
+    """One registered class (``cls_register`` analog)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.methods: dict[str, ClassMethod] = {}
+
+    def method(self, name: str, flags: int):
+        """Decorator: register a method (cls_register_cxx_method)."""
+
+        def deco(fn):
+            self.methods[name] = ClassMethod(name, flags, fn)
+            return fn
+
+        return deco
+
+
+_classes: dict[str, ObjectClass] = {}
+
+
+def register_class(name: str) -> ObjectClass:
+    if name not in _classes:
+        _classes[name] = ObjectClass(name)
+    return _classes[name]
+
+
+def get_class(name: str) -> ObjectClass | None:
+    _load_builtins()
+    return _classes.get(name)
+
+
+def list_classes() -> list[str]:
+    _load_builtins()
+    return sorted(_classes)
+
+
+_loaded = False
+
+
+def _load_builtins() -> None:
+    """Import the built-in classes on first use (the OSD's cls preload,
+    reference:src/osd/ClassHandler.cc open_all_classes)."""
+    global _loaded
+    if _loaded:
+        return
+    _loaded = True
+    from . import lock, rbd_cls, refcount  # noqa: F401

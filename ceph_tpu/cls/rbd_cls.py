@@ -1,0 +1,68 @@
+"""RBD helper class (reference:src/cls/rbd/cls_rbd.cc dir_* methods).
+
+The image directory must be mutated atomically — a bare
+read-check-then-omap_set from the client races concurrent creates.
+These methods run under the PG lock like every cls call, so
+name-claiming is linearized exactly as the reference's
+``dir_add_image``/``dir_remove_image``/``dir_rename_image`` are.
+"""
+
+from __future__ import annotations
+
+from . import (
+    CLS_METHOD_RD,
+    CLS_METHOD_WR,
+    ClsError,
+    EEXIST,
+    ENOENT,
+    EINVAL,
+    MethodContext,
+    register_class,
+)
+
+cls = register_class("rbd")
+
+
+@cls.method("dir_add", CLS_METHOD_RD | CLS_METHOD_WR)
+def dir_add(ctx: MethodContext, input: dict) -> dict:
+    name, image_id = input.get("name"), input.get("id")
+    if not name or not image_id:
+        raise ClsError(EINVAL, "dir_add: need name and id")
+    omap = ctx.omap_get()
+    if f"name_{name}" in omap:
+        raise ClsError(EEXIST, f"image {name!r} exists")
+    if f"id_{image_id}" in omap:
+        raise ClsError(EEXIST, f"image id {image_id!r} exists")
+    ctx.omap_set({
+        f"name_{name}": image_id.encode(),
+        f"id_{image_id}": name.encode(),
+    })
+    return {}
+
+
+@cls.method("dir_remove", CLS_METHOD_RD | CLS_METHOD_WR)
+def dir_remove(ctx: MethodContext, input: dict) -> dict:
+    name, image_id = input.get("name"), input.get("id")
+    omap = ctx.omap_get()
+    if omap.get(f"name_{name}") != (image_id or "").encode():
+        raise ClsError(ENOENT, f"no image {name!r} with id {image_id!r}")
+    ctx.omap_rm([f"name_{name}", f"id_{image_id}"])
+    return {}
+
+
+@cls.method("dir_rename", CLS_METHOD_RD | CLS_METHOD_WR)
+def dir_rename(ctx: MethodContext, input: dict) -> dict:
+    src, dst = input.get("src"), input.get("dst")
+    omap = ctx.omap_get()
+    raw = omap.get(f"name_{src}")
+    if raw is None:
+        raise ClsError(ENOENT, f"no image {src!r}")
+    if f"name_{dst}" in omap:
+        raise ClsError(EEXIST, f"image {dst!r} exists")
+    image_id = raw.decode()
+    ctx.omap_set({
+        f"name_{dst}": raw,
+        f"id_{image_id}": dst.encode(),
+    })
+    ctx.omap_rm([f"name_{src}"])
+    return {}

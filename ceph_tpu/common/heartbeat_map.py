@@ -36,9 +36,10 @@ class HeartbeatHandle:
 
     def reset_timeout(self) -> None:
         """Start/refresh the deadlines — call at the top of each work
-        item (reference:HeartbeatMap.cc reset_timeout)."""
+        item (reference:HeartbeatMap.cc reset_timeout).  Grace <= 0
+        means no deadline (the reference's grace-0 semantics)."""
         now = time.monotonic()
-        self.timeout = now + self.grace
+        self.timeout = now + self.grace if self.grace > 0 else 0.0
         self.suicide_timeout = (
             now + self.suicide_grace if self.suicide_grace > 0 else 0.0
         )

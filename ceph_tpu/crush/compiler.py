@@ -52,6 +52,7 @@ from .map import (
     CRUSH_RULE_SET_CHOOSE_LOCAL_FALLBACK_TRIES,
     CRUSH_RULE_SET_CHOOSE_LOCAL_TRIES,
     CRUSH_RULE_SET_CHOOSE_TRIES,
+    CRUSH_RULE_TAKE,
     RULE_TYPE_ERASURE,
     RULE_TYPE_REPLICATED,
     CrushMap,
@@ -195,7 +196,7 @@ def decompile_crushmap(m: CrushMap) -> str:
                 verb, mode = _CHOOSE_NAMES[s.op]
                 tname = m.type_names.get(s.arg2, f"type{s.arg2}")
                 out.append(f"\tstep {verb} {mode} {s.arg1} type {tname}")
-            elif s.op == 1:  # TAKE
+            elif s.op == CRUSH_RULE_TAKE:
                 iname = m.item_names.get(s.arg1, f"bucket{-1 - s.arg1}")
                 out.append(f"\tstep take {iname}")
             else:
@@ -227,8 +228,20 @@ def compile_crushmap(text: str) -> CrushMap:
     m.rule_names = {}
     m.type_names = {}
     item_id: dict[str, int] = {}
-    # queued (alg, type, items, weights, id, name) — buckets are built
-    # through make_bucket so list sums / tree nodes / straws regenerate
+    # buckets are built through make_bucket so list sums / tree nodes /
+    # straws regenerate
+    try:
+        _compile_toks(m, toks, item_id)
+    except IndexError:
+        raise CrushCompileError("unexpected end of input") from None
+    if 0 not in m.type_names:
+        m.type_names[0] = "osd"
+    return m
+
+
+def _compile_toks(
+    m: CrushMap, toks: list[str], item_id: dict[str, int]
+) -> None:
     pos = 0
     while pos < len(toks):
         tok = toks[pos]
@@ -254,9 +267,6 @@ def compile_crushmap(text: str) -> CrushMap:
             pos = _parse_bucket(m, toks, pos, item_id)
         else:
             raise CrushCompileError(f"unexpected token {tok!r}")
-    if 0 not in m.type_names:
-        m.type_names[0] = "osd"
-    return m
 
 
 def _type_ids(m: CrushMap) -> dict[str, int]:
@@ -390,7 +400,7 @@ def _parse_rule(
                 iname = toks[pos + 2]
                 if iname not in item_id:
                     raise CrushCompileError(f"step take: unknown {iname!r}")
-                r.step(1, item_id[iname])
+                r.step(CRUSH_RULE_TAKE, item_id[iname])
                 pos += 3
             elif verb in _SET_STEPS:
                 r.step(_SET_STEPS[verb], int(toks[pos + 2]))

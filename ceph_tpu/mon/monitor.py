@@ -622,6 +622,13 @@ class Monitor(Dispatcher):
                 "osd pool create": self._cmd_pool_create,
                 "osd pool ls": self._cmd_pool_ls,
                 "osd pool rm": self._cmd_pool_rm,
+                "osd pool mksnap": self._cmd_pool_mksnap,
+                "osd pool rmsnap": self._cmd_pool_rmsnap,
+                "osd pool lssnap": self._cmd_pool_lssnap,
+                "osd pool selfmanaged-snap create":
+                    self._cmd_selfmanaged_snap_create,
+                "osd pool selfmanaged-snap rm":
+                    self._cmd_selfmanaged_snap_rm,
                 "osd dump": self._cmd_osd_dump,
                 "osd down": self._cmd_osd_down,
                 "osd out": self._cmd_osd_out,
@@ -715,6 +722,72 @@ class Monitor(Dispatcher):
             return -ENOENT, f"no pool {cmd['pool']!r}", None
         del self.osdmap.pools[pool.id]
         del self.osdmap.pool_name[pool.name]
+        self._mark_dirty()
+        return 0, "", None
+
+    # -- snapshots (reference:src/mon/OSDMonitor.cc 'osd pool mksnap' /
+    # 'rmsnap' prepare paths; self-managed ids via IoCtx selfmanaged_
+    # snap_create -> mon allocation from the same pool sequence) ---------
+
+    def _cmd_pool_mksnap(self, cmd: dict) -> tuple[int, str, Any]:
+        pool = self.osdmap.lookup_pool(cmd["pool"])
+        if pool is None:
+            return -ENOENT, f"no pool {cmd['pool']!r}", None
+        name = cmd["snap"]
+        if name in pool.snaps.values():
+            return -EEXIST, f"snap {name!r} already exists", None
+        pool.snap_seq += 1
+        pool.snaps[pool.snap_seq] = name
+        self._mark_dirty()
+        return 0, f"created pool snap {name!r}", {"snapid": pool.snap_seq}
+
+    def _cmd_pool_rmsnap(self, cmd: dict) -> tuple[int, str, Any]:
+        pool = self.osdmap.lookup_pool(cmd["pool"])
+        if pool is None:
+            return -ENOENT, f"no pool {cmd['pool']!r}", None
+        name = cmd["snap"]
+        snapid = next(
+            (i for i, n in pool.snaps.items() if n == name), None
+        )
+        if snapid is None:
+            return -ENOENT, f"no snap {name!r}", None
+        del pool.snaps[snapid]
+        pool.removed_snaps.append(snapid)
+        self._mark_dirty()
+        return 0, f"removed pool snap {name!r}", {"snapid": snapid}
+
+    def _cmd_pool_lssnap(self, cmd: dict) -> tuple[int, str, Any]:
+        pool = self.osdmap.lookup_pool(cmd["pool"])
+        if pool is None:
+            return -ENOENT, f"no pool {cmd['pool']!r}", None
+        return 0, "", {
+            "snap_seq": pool.snap_seq,
+            "snaps": [
+                {"snapid": i, "name": n}
+                for i, n in sorted(pool.snaps.items())
+            ],
+            "removed_snaps": sorted(pool.removed_snaps),
+        }
+
+    def _cmd_selfmanaged_snap_create(self, cmd: dict) -> tuple[int, str, Any]:
+        pool = self.osdmap.lookup_pool(cmd["pool"])
+        if pool is None:
+            return -ENOENT, f"no pool {cmd['pool']!r}", None
+        pool.snap_seq += 1  # unnamed: the client owns the snap context
+        self._mark_dirty()
+        return 0, "", {"snapid": pool.snap_seq}
+
+    def _cmd_selfmanaged_snap_rm(self, cmd: dict) -> tuple[int, str, Any]:
+        pool = self.osdmap.lookup_pool(cmd["pool"])
+        if pool is None:
+            return -ENOENT, f"no pool {cmd['pool']!r}", None
+        snapid = int(cmd["snapid"])
+        if snapid in pool.removed_snaps or snapid > pool.snap_seq:
+            return -ENOENT, f"no snap {snapid}", None
+        # if the id happens to be a NAMED pool snap, retire the name too:
+        # a dangling entry would keep riding every write's SnapContext
+        pool.snaps.pop(snapid, None)
+        pool.removed_snaps.append(snapid)
         self._mark_dirty()
         return 0, "", None
 

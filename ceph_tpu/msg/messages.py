@@ -214,10 +214,13 @@ class MOSDOp(Message):
 
     ``ops`` = list of {"op": name, ...args}; write-class payloads ride in
     blobs in op order (blob index in the op's "data" key).
+
+    ``snapc`` ({"seq", "snaps"}) rides with writes, ``snapid`` with reads
+    — the reference's MOSDOp snap_seq/snaps/snapid header fields.
     """
 
     TYPE = "osd_op"
-    FIELDS = ("tid", "epoch", "pool", "oid", "ops")
+    FIELDS = ("tid", "epoch", "pool", "oid", "ops", "snapc", "snapid")
 
 
 @register
@@ -322,6 +325,24 @@ class MPGLs(Message):
 class MPGLsReply(Message):
     TYPE = "pg_ls_reply"
     FIELDS = ("tid", "result", "names")
+
+
+@register
+class MWatchNotify(Message):
+    """OSD -> watching client: a notify fired on an object you watch
+    (reference:src/messages/MWatchNotify.h).  Payload in blobs[0]."""
+
+    TYPE = "watch_notify"
+    FIELDS = ("notify_id", "cookie", "oid", "notifier")
+
+
+@register
+class MWatchNotifyAck(Message):
+    """Watching client -> OSD: notify handled; reply payload (if any)
+    in blobs[0] (reference ack path via CEPH_OSD_OP_NOTIFY_ACK)."""
+
+    TYPE = "watch_notify_ack"
+    FIELDS = ("notify_id", "cookie")
 
 
 # -- recovery ----------------------------------------------------------------

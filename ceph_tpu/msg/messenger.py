@@ -270,7 +270,7 @@ class AsyncMessenger:
                 try:
                     banner = json.loads(line.decode())
                     conn.peer_name = banner["entity"]
-                except (ValueError, KeyError) as e:
+                except (ValueError, KeyError, TypeError) as e:
                     raise ConnectionResetError(
                         f"{addr}: bad handshake banner: {e!r}"
                     ) from e

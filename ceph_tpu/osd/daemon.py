@@ -46,6 +46,7 @@ from ..msg.message import Message
 from ..store import CollectionId, MemStore, ObjectId, ObjectStore, Transaction
 from ..store.objectstore import NeedsMkfs
 from . import ec_transaction, ec_util
+from . import snaps as snaps_mod
 from .ec_util import StripeHashes, StripeInfo
 from .osdmap import CRUSH_ITEM_NONE, OSDMap, PGid, Pool, POOL_TYPE_ERASURE
 from .pg_log import (
@@ -58,6 +59,12 @@ from .pg_log import (
 )
 
 logger = logging.getLogger("ceph_tpu.osd")
+
+# tracepoint provider wrapping op ingress/egress, the analog of
+# reference:src/tracing/oprequest.tp wired at OSD.cc:6119
+from ..common.tracing import tracepoint_provider  # noqa: E402
+
+_trace = tracepoint_provider("oprequest")
 
 ENOENT = 2
 EIO = 5
@@ -99,6 +106,31 @@ class WaiterBase:
         for key in list(self.pending):
             if self.members.get(key) == osd_id:
                 self.fail_key(key)
+
+
+class _NotifyWaiter:
+    """Gathers MWatchNotifyAck from every watcher of one notify
+    (reference:src/osd/Watch.cc Notify::maybe_complete_notify)."""
+
+    def __init__(self, cookies: set[str]):
+        self.pending = set(cookies)
+        self.acks: dict[str, bytes] = {}
+        self.event = asyncio.Event()
+        if not self.pending:
+            self.event.set()
+
+    def ack(self, cookie: str, payload: bytes = b"") -> None:
+        if cookie in self.pending:
+            self.pending.discard(cookie)
+            self.acks[cookie] = payload
+            if not self.pending:
+                self.event.set()
+
+    def drop(self, cookie: str) -> None:
+        """Watcher died: stop waiting on it (its ack never comes)."""
+        self.pending.discard(cookie)
+        if not self.pending:
+            self.event.set()
 
 
 class _Waiter(WaiterBase):
@@ -224,9 +256,31 @@ class OSD(Dispatcher):
         self._read_waiters: dict[int, _ReadWaiter] = {}
         self._pg_versions: dict[str, Eversion] = {}
         self._pg_committed: dict[str, Eversion] = {}  # roll-forward watermark
+        self._trimmed_snaps: dict[int, set[int]] = {}  # pool -> handled rms
+        self._trimming: set[int] = set()  # pools with a trim pass running
+        # watch/notify (reference:src/osd/Watch.{h,cc}): in-memory watcher
+        # table; clients re-register after resets (the linger model)
+        self._watchers: dict[tuple[int, str], dict[str, Connection]] = {}
+        self._notify_waiters: dict[int, "_NotifyWaiter"] = {}
         self._pg_locks: dict[str, asyncio.Lock] = {}
+        # watchdog (reference:common/HeartbeatMap): the op engine is the
+        # "worker"; a wedged op marks the daemon unhealthy (heartbeats
+        # stop flowing -> peers report us), a blown suicide timeout
+        # force-stops the daemon, the asyncio analog of ceph_abort
+        from ..common.heartbeat_map import HeartbeatMap
+        from ..common.lockdep import lockdep_enable
+
+        self.hb_map = HeartbeatMap(self.name, on_suicide=self._hb_suicide)
+        self._op_handle = self.hb_map.add_worker(
+            "osd_op_worker",
+            cfg.osd_op_thread_timeout,
+            cfg.osd_op_thread_suicide_timeout,
+        )
+        if cfg.lockdep:
+            lockdep_enable(True)
         self._tasks: set[asyncio.Task] = set()
         self._hb_task: asyncio.Task | None = None
+        self._wd_task: asyncio.Task | None = None
         self._hb_last: dict[int, float] = {}
         self._map_event = asyncio.Event()
         self._stopping = False
@@ -241,6 +295,34 @@ class OSD(Dispatcher):
                 if scrub_interval is None else scrub_interval
             ),
         )
+
+    def _refresh_op_handle(self) -> None:
+        """Pin the watchdog deadlines to the OLDEST in-flight op — one
+        shared handle must not let fresh traffic mask a wedged op (the
+        reference sidesteps this with per-thread handles)."""
+        h = self._op_handle
+        if not self._inflight or h.grace <= 0:
+            # grace 0 = watchdog disabled, not a zero-second deadline
+            h.clear_timeout()
+            return
+        oldest = min(o["_t0"] for o in self._inflight.values())
+        h.timeout = oldest + h.grace
+        h.suicide_timeout = (
+            oldest + h.suicide_grace if h.suicide_grace > 0 else 0.0
+        )
+
+    def _hb_suicide(self, worker: str) -> None:
+        """A worker blew its suicide timeout: take the daemon down hard
+        (the reference aborts the process; here the cluster-visible
+        effect — the daemon dies and peers fail it — is what matters)."""
+        if self._stopping:
+            return  # is_healthy() re-polls; one abort is enough
+        self._stopping = True
+        logger.error("%s: %s suicide timeout — aborting daemon",
+                     self.name, worker)
+        # NOT tracked in self._tasks: stop() cancels those, and the
+        # shutdown task cancelling itself would leave the messenger up
+        asyncio.ensure_future(self.stop(umount=False))
 
     def _on_scrub_interval(self, _name: str, value: float) -> None:
         self.scrub.interval = value
@@ -263,6 +345,11 @@ class OSD(Dispatcher):
             await self._map_event.wait()
         if self.heartbeat_interval > 0:
             self._hb_task = asyncio.ensure_future(self._heartbeat_loop())
+        if self.config.osd_op_thread_timeout > 0:
+            # the watchdog must not depend on the (optional) peer
+            # heartbeat loop, or the suicide timeout is inert in every
+            # cluster that disables pings (review r2 finding)
+            self._wd_task = asyncio.ensure_future(self._watchdog_loop())
         self.recovery.start()
         self.recovery.kick()  # reconcile whatever the map says we lead
         self.scrub.start()
@@ -360,6 +447,31 @@ class OSD(Dispatcher):
             "recently completed client ops",
         )
         a.register(
+            "dump_watchdog",
+            lambda req: self.hb_map.dump(),
+            "HeartbeatMap worker deadlines",
+        )
+
+        def _dump_tracepoints(_req: dict) -> dict:
+            from ..common.tracing import dump_all
+
+            return dump_all()
+
+        a.register("dump_tracepoints", _dump_tracepoints,
+                   "ring-buffer tracepoint events")
+
+        async def _arch(_req: dict) -> dict:
+            from ..utils import arch
+
+            # first probe() initializes the JAX backend (seconds): keep
+            # it off the event loop or a diagnostics command stalls
+            # heartbeats and in-flight ops
+            return await asyncio.get_running_loop().run_in_executor(
+                None, arch.dump
+            )
+
+        a.register("arch", _arch, "accelerator/host capability probe")
+        a.register(
             "status",
             lambda req: {
                 "name": self.name,
@@ -391,8 +503,12 @@ class OSD(Dispatcher):
         self.scrub.stop()
         if self._hb_task:
             self._hb_task.cancel()
+        if self._wd_task:
+            self._wd_task.cancel()
+        me = asyncio.current_task()
         for t in list(self._tasks):
-            t.cancel()
+            if t is not me:  # a tracked task calling stop() must finish it
+                t.cancel()
         if self._admin is not None:
             await self._admin.stop()
             self._admin = None
@@ -425,6 +541,10 @@ class OSD(Dispatcher):
                 err = msg.errors[0] if msg.errors else 0
                 data = msg.blobs[0] if msg.blobs else b""
                 w.complete(msg.shard, data, msg.attrs, err)  # attrs: flat {key: str}
+        elif isinstance(msg, messages.MWatchNotifyAck):
+            nw = self._notify_waiters.get(msg.notify_id)
+            if nw:
+                nw.ack(msg.cookie, msg.blobs[0] if msg.blobs else b"")
         elif isinstance(msg, messages.MOSDRepOp):
             self._handle_rep_op(conn, msg)
         elif isinstance(msg, messages.MOSDRepOpReply):
@@ -451,6 +571,16 @@ class OSD(Dispatcher):
             self._mon_conn = None
             self._on_mon_reset()
             return
+        # a dead client's watches die with its connection (reference:
+        # Watch.cc handle_watch_timeout; lingers re-register on reconnect)
+        for key, table in list(self._watchers.items()):
+            for cookie, wconn in list(table.items()):
+                if wconn is conn:
+                    del table[cookie]
+                    for nw in self._notify_waiters.values():
+                        nw.drop(cookie)
+            if not table:
+                del self._watchers[key]
         # fail every in-flight sub-op this peer owed us so primary ops and
         # recovery scans re-plan promptly instead of waiting out timeouts
         peer = self._peer_osd_id(conn)
@@ -481,6 +611,24 @@ class OSD(Dispatcher):
         self._codecs.clear()  # pools/profiles may have changed
         self._map_event.set()
         self.recovery.kick()  # acting sets may have changed
+        self._kick_snap_trim()
+
+    def _kick_snap_trim(self) -> None:
+        """Schedule clone trimming for pools whose removed_snaps grew
+        (the SnapTrimmer trigger, reference:src/osd/PrimaryLogPG.cc
+        kick_snap_trim on map advance).  A pool is recorded as handled
+        only after a COMPLETE trim pass, so degraded/failed passes are
+        retried on the next map advance."""
+        for pool in self.osdmap.pools.values():
+            removed = set(pool.removed_snaps)
+            if not removed or removed == self._trimmed_snaps.get(pool.id):
+                continue
+            if pool.id in self._trimming:
+                continue  # one pass per pool at a time
+            self._trimming.add(pool.id)
+            t = asyncio.ensure_future(self._snap_trim_pool(pool))
+            self._tasks.add(t)
+            t.add_done_callback(self._tasks.discard)
 
     # -- codec / placement helpers --------------------------------------------
 
@@ -507,6 +655,11 @@ class OSD(Dispatcher):
     _WRITE_OPS = frozenset(
         ("writefull", "write", "append", "zero", "truncate", "delete")
     )
+    # replicated ops that must plan+commit under the PG lock
+    _REP_LOCKED_OPS = _WRITE_OPS | frozenset(
+        ("rollback", "call", "setxattr", "rmxattr",
+         "omap_setkeys", "omap_rmkeys", "omap_clear")
+    )
 
     async def _handle_client_op(self, conn: Connection, msg: messages.MOSDOp) -> None:
         posd = self.perf.get("osd")
@@ -524,11 +677,14 @@ class OSD(Dispatcher):
             "ops": names, "_t0": time.monotonic(),
         }
         self._inflight[seq] = track
+        self._refresh_op_handle()
+        _trace.point("osd_dequeue_op", osd=self.osd_id, tid=msg.tid,
+                     oid=msg.oid, ops=names)
         completed = False
         try:
             with posd.time("op_latency"):
                 try:
-                    result, out, blobs = await self._execute_op(msg)
+                    result, out, blobs = await self._execute_op(msg, conn)
                 except asyncio.CancelledError:
                     raise
                 except Exception as e:
@@ -537,12 +693,15 @@ class OSD(Dispatcher):
             completed = True
         finally:
             done = self._inflight.pop(seq, None)
+            self._refresh_op_handle()
             # cancelled ops (daemon stopping) never replied: they must not
             # masquerade as completed in dump_historic_ops
             if done is not None and completed:
                 done["duration"] = time.monotonic() - done.pop("_t0")
                 self._historic.append(done)
                 del self._historic[:-20]  # keep the newest 20
+        _trace.point("osd_op_reply", osd=self.osd_id, tid=msg.tid,
+                     result=result)
         if result < 0:
             posd.inc("op_err")
         else:
@@ -557,7 +716,7 @@ class OSD(Dispatcher):
         )
 
     async def _execute_op(
-        self, msg: messages.MOSDOp
+        self, msg: messages.MOSDOp, conn: Connection | None = None
     ) -> tuple[int, list, list[bytes]]:
         if self.osdmap is None:
             return -EAGAIN, [{"error": "no map"}], []
@@ -571,8 +730,22 @@ class OSD(Dispatcher):
         if primary != self.osd_id:
             # client raced a map change; it must re-target
             return -EAGAIN, [{"error": "not primary", "primary": primary}], []
+        names = [op.get("op") for op in msg.ops]
+        if any(n in ("watch", "unwatch", "notify") for n in names):
+            # backend-independent: watch state lives on the primary, not
+            # in the object store (reference:src/osd/Watch.cc)
+            return await self._watch_execute(pg, pool, acting, msg, conn)
         if pool.type == POOL_TYPE_ERASURE:
             return await self._ec_execute(pg, pool, acting, msg)
+        if any(n in self._REP_LOCKED_OPS for n in names):
+            # every replicated mutation plans against current state
+            # (snap clone decisions, cls read-modify-write, projected
+            # sizes) — planning and commit must be atomic vs concurrent
+            # ops on the PG (the reference holds the PG lock across
+            # execute_ctx); the commit path skips re-locking
+            async with self.pg_lock(pg):
+                return await self._rep_execute(pg, pool, acting, msg,
+                                               locked=True)
         return await self._rep_execute(pg, pool, acting, msg)
 
     def _handle_pgls(self, conn: Connection, msg) -> None:
@@ -601,7 +774,11 @@ class OSD(Dispatcher):
                 shard = -1
             objects, _log = self.recovery._local_scan(str(pg), shard)
             conn.send(messages.MPGLsReply(
-                tid=msg.tid, result=0, names=sorted(objects),
+                tid=msg.tid, result=0,
+                # clones/snapdirs are internal names, not listable heads
+                names=sorted(
+                    n for n in objects if not snaps_mod.is_clone_name(n)
+                ),
             ))
         except Exception as e:
             logger.exception("%s: pgls of %s failed", self.name, msg.pgid)
@@ -652,7 +829,11 @@ class OSD(Dispatcher):
         key = str(pg)
         lock = self._pg_locks.get(key)
         if lock is None:
-            lock = self._pg_locks[key] = asyncio.Lock()
+            from ..common.lockdep import LockdepLock
+
+            # LockdepLock is a plain asyncio.Lock unless lockdep is
+            # enabled (the reference's `lockdep = true` config)
+            lock = self._pg_locks[key] = LockdepLock(f"{self.name}:pg:{key}")
         return lock
 
     def _next_version(self, pg: PGid) -> Eversion:
@@ -666,32 +847,55 @@ class OSD(Dispatcher):
     ) -> tuple[int, list, list[bytes]]:
         out: list = []
         blobs: list[bytes] = []
+        snapc = snaps_mod.SnapContext.from_dict(msg.snapc)
+        # reads at a snap resolve oid -> serving clone once per message
+        read_oid = msg.oid
+        if msg.snapid is not None:
+            r, read_oid = await self._ec_resolve_snap(
+                pg, pool, acting, msg.oid, int(msg.snapid)
+            )
+            if r < 0:
+                return r, [{"rval": r}], blobs
         for op in msg.ops:
             name = op["op"]
             if name in ("writefull", "write", "append", "zero", "truncate"):
                 data = (
                     msg.blobs[op["data"]] if op.get("data") is not None else b""
                 )
-                r = await self._ec_mutate(pg, pool, acting, msg.oid, name, op, data)
+                r = await self._ec_mutate(
+                    pg, pool, acting, msg.oid, name, op, data, snapc
+                )
                 out.append({"rval": r})
                 if r < 0:
                     return r, out, blobs
             elif name == "delete":
-                r = await self._ec_delete(pg, pool, acting, msg.oid)
+                r = await self._ec_delete(pg, pool, acting, msg.oid, snapc)
                 out.append({"rval": r})
+                if r < 0:
+                    return r, out, blobs
+            elif name == "rollback":
+                r = await self._ec_rollback(
+                    pg, pool, acting, msg.oid, int(op["snapid"]), snapc
+                )
+                out.append({"rval": r})
+                if r < 0:
+                    return r, out, blobs
+            elif name == "list_snaps":
+                r, ssd = await self._ec_list_snaps(pg, pool, acting, msg.oid)
+                out.append({"rval": r, **({"snapset": ssd} if r == 0 else {})})
                 if r < 0:
                     return r, out, blobs
             elif name == "read":
                 off = int(op.get("offset", 0))
                 ln = int(op.get("length", 0)) or -1
-                r, data = await self._ec_read(pg, pool, acting, msg.oid, off, ln)
+                r, data = await self._ec_read(pg, pool, acting, read_oid, off, ln)
                 if r < 0:
                     out.append({"rval": r})
                     return r, out, blobs
                 out.append({"rval": 0, "data": len(blobs)})
                 blobs.append(data)
             elif name == "stat":
-                r, size = await self._ec_stat(pg, pool, acting, msg.oid)
+                r, size = await self._ec_stat(pg, pool, acting, read_oid)
                 out.append({"rval": r, "size": size})
                 if r < 0:
                     return r, out, blobs
@@ -701,13 +905,13 @@ class OSD(Dispatcher):
                 )
                 r = await self._ec_setxattr(
                     pg, pool, acting, msg.oid, op["key"],
-                    value if name == "setxattr" else None,
+                    value if name == "setxattr" else None, snapc=snapc,
                 )
                 out.append({"rval": r})
                 if r < 0:
                     return r, out, blobs
             elif name in ("getxattr", "getxattrs"):
-                r, attrs = await self._ec_getxattrs(pg, pool, acting, msg.oid)
+                r, attrs = await self._ec_getxattrs(pg, pool, acting, read_oid)
                 if r < 0:
                     out.append({"rval": r})
                     return r, out, blobs
@@ -730,6 +934,13 @@ class OSD(Dispatcher):
                 # do_osd_ops rejects omap writes on EC with -EOPNOTSUPP)
                 out.append({"rval": -EOPNOTSUPP, "error": "no omap on EC pools"})
                 return -EOPNOTSUPP, out, blobs
+            elif name == "call":
+                # object classes need omap/overwrite primitives EC shards
+                # don't have (matches rados-classes-on-EC being
+                # unsupported at the reference version)
+                out.append({"rval": -EOPNOTSUPP,
+                            "error": "no object classes on EC pools"})
+                return -EOPNOTSUPP, out, blobs
             else:
                 out.append({"rval": -EINVAL, "error": f"bad op {name!r}"})
                 return -EINVAL, out, blobs
@@ -739,11 +950,14 @@ class OSD(Dispatcher):
 
     async def _ec_setxattr(
         self, pg: PGid, pool: Pool, acting: list[int], oid: str,
-        key: str, value: bytes | None,
+        key: str, value: bytes | None, raw_key: bool = False,
+        snapc: "snaps_mod.SnapContext | None" = None,
     ) -> int:
         """Set (or remove, value=None) a user xattr on every present
         shard — a versioned mutation through the normal sub-write path
-        (reference stores object attrs on all EC shards)."""
+        (reference stores object attrs on all EC shards).  ``raw_key``
+        skips the user prefix (system attrs, e.g. the SnapSet).  Like
+        every mutation, clones on first-write-after-snap."""
         async with self.pg_lock(pg):
             codec, _si = self._pool_codec(pool)
             k, km = codec.get_data_chunk_count(), codec.get_chunk_count()
@@ -753,7 +967,7 @@ class OSD(Dispatcher):
             ]
             if len(present) < max(pool.min_size, k):
                 return -EAGAIN
-            oi, hashes, vers, errs = await self._ec_meta(
+            oi, hashes, vers, errs, ss = await self._ec_meta(
                 pg, oid, dict(present)
             )
             if any(e != -ENOENT for e in errs.values()):
@@ -768,6 +982,19 @@ class OSD(Dispatcher):
                 ]
                 if len(present) < max(pool.min_size, k):
                     return -EAGAIN
+            # clone-on-first-write-after-snap applies to metadata too;
+            # a recreate-after-delete adopts the snapdir's SnapSet like
+            # the data-write path does
+            remove_snapdir = False
+            if snapc is not None and create:
+                ss, remove_snapdir = await self._ec_adopt_snapdir(
+                    pg, oid, dict(present), ss
+                )
+                if ss is None:
+                    return -EAGAIN
+            clone_src = snaps_mod.plan_clone(
+                ss, snapc, not create, 0 if create else int(oi["size"]), oid
+            )
             version = self._next_version(pg)
             prior = (
                 Eversion() if create else Eversion.from_list(oi["version"])
@@ -780,7 +1007,7 @@ class OSD(Dispatcher):
             ).encode()
             sname = stash_name(oid, version)
             entry = PGLogEntry("modify", oid, version, prior, stash=sname)
-            skey = self.USER_XATTR_PREFIX + key
+            skey = key if raw_key else self.USER_XATTR_PREFIX + key
             hinfo_b = None
             if create:
                 # setxattr creates missing objects (reference semantics);
@@ -798,11 +1025,19 @@ class OSD(Dispatcher):
                     .create_collection(cid)
                     .try_stash(cid, soid, ObjectId(sname, shard))
                 )
+                if clone_src is not None:
+                    txn.try_stash(cid, soid, ObjectId(clone_src, shard))
+                if remove_snapdir:
+                    txn.remove(
+                        cid, ObjectId(snaps_mod.snapdir_name(oid), shard)
+                    )
                 if value is None:
                     txn.rmattr(cid, soid, skey)
                 else:
                     txn.setattr(cid, soid, skey, value)
                 txn.setattr(cid, soid, OI_KEY, oi_b)
+                if not ss.empty() and skey != snaps_mod.SS_KEY:
+                    txn.setattr(cid, soid, snaps_mod.SS_KEY, ss.to_json())
                 if hinfo_b is not None:
                     txn.setattr(cid, soid, StripeHashes.XATTR_KEY, hinfo_b)
                 return txn
@@ -848,15 +1083,19 @@ class OSD(Dispatcher):
     async def _ec_mutate(
         self, pg: PGid, pool: Pool, acting: list[int], oid: str,
         opname: str, op: dict, data: bytes,
+        snapc: "snaps_mod.SnapContext | None" = None,
+        attr_ops: dict[str, bytes | None] | None = None,
     ) -> int:
         async with self.pg_lock(pg):
             return await self._ec_mutate_locked(
-                pg, pool, acting, oid, opname, op, data
+                pg, pool, acting, oid, opname, op, data, snapc, attr_ops
             )
 
     async def _ec_mutate_locked(
         self, pg: PGid, pool: Pool, acting: list[int], oid: str,
         opname: str, op: dict, data: bytes,
+        snapc: "snaps_mod.SnapContext | None" = None,
+        attr_ops: dict[str, bytes | None] | None = None,
     ) -> int:
         """One EC object mutation, planned and committed under the PG lock.
 
@@ -883,7 +1122,7 @@ class OSD(Dispatcher):
         if len(present) < max(pool.min_size, k):
             return -EAGAIN  # degraded below min_size: cannot accept writes
         available = dict(present)
-        oi, hashes, vers, meta_errs = await self._ec_meta(pg, oid, available)
+        oi, hashes, vers, meta_errs, ss = await self._ec_meta(pg, oid, available)
         if any(e != -ENOENT for e in meta_errs.values()):
             # a shard's state is UNKNOWN (not merely absent): planning a
             # partial write against a possibly-stale oi could silently
@@ -892,6 +1131,19 @@ class OSD(Dispatcher):
             return -EAGAIN
         old_size = int(oi["size"]) if oi else 0
         prior = Eversion.from_list(oi["version"]) if oi else Eversion()
+        # snapshots (reference:PrimaryLogPG.cc make_writeable): first
+        # write after a snap clones the pre-write object; a recreate
+        # after delete-with-clones adopts the SnapSet parked on snapdir
+        remove_snapdir = False
+        if snapc is not None and oi is None:
+            ss, remove_snapdir = await self._ec_adopt_snapdir(
+                pg, oid, available, ss
+            )
+            if ss is None:
+                return -EAGAIN
+        clone_src = snaps_mod.plan_clone(
+            ss, snapc, oi is not None, old_size, oid
+        )
         if oi is not None and opname != "writefull":
             # partial ops must only stamp shards that are up to date: a
             # stale/rejoined shard stamped with the new version+crc table
@@ -974,12 +1226,29 @@ class OSD(Dispatcher):
                 .create_collection(cid)
                 .try_stash(cid, soid, ObjectId(sname, shard))
             )
+            if clone_src is not None:
+                # preserve the pre-write shard for snap reads (the copy
+                # carries the old OI + crc table, so the clone is
+                # readable/scrubable like any object); try_stash = clone
+                # iff present, so a stale shard missing the head object
+                # doesn't fail the whole sub-write
+                txn.try_stash(cid, soid, ObjectId(clone_src, shard))
+            if remove_snapdir:
+                txn.remove(cid, ObjectId(snaps_mod.snapdir_name(oid), shard))
             if plan.shard_truncate is not None:
                 txn.truncate(cid, soid, plan.shard_truncate)
             if shard_bufs is not None:
                 txn.write(cid, soid, c_off, shard_bufs[shard].tobytes())
             txn.setattr(cid, soid, StripeHashes.XATTR_KEY, hinfo_b)
             txn.setattr(cid, soid, OI_KEY, oi_b)
+            if not ss.empty():
+                txn.setattr(cid, soid, snaps_mod.SS_KEY, ss.to_json())
+            for ak, av in (attr_ops or {}).items():
+                pak = self.USER_XATTR_PREFIX + ak
+                if av is None:
+                    txn.rmattr(cid, soid, pak)
+                else:
+                    txn.setattr(cid, soid, pak, av)
             return txn
 
         return await self._ec_fan_out(pg, present, build_txn, [entry], version)
@@ -1016,14 +1285,252 @@ class OSD(Dispatcher):
         self._mark_committed(pg, version, present)
         return 0
 
-    async def _ec_delete(
+    # -- snap trimming --------------------------------------------------------
+
+    async def _snap_trim_pool(self, pool: Pool) -> None:
+        """Delete clones whose snaps were all removed and scrub the
+        removed ids out of every SnapSet (the SnapTrimmer,
+        reference:src/osd/PrimaryLogPG.cc TrimmingObjects/snap_trimmer)."""
+        removed = set(pool.removed_snaps)
+        complete = True
+        try:
+            for pg in self.osdmap.pgs_of_pool(pool.id):
+                _u, _up, acting, primary = self.osdmap.pg_to_up_acting_osds(pg)
+                if primary != self.osd_id:
+                    continue
+                if pool.type == POOL_TYPE_ERASURE:
+                    ok = await self._snap_trim_pg_ec(pg, pool, acting, removed)
+                else:
+                    ok = await self._snap_trim_pg_rep(pg, pool, acting, removed)
+                complete = complete and ok
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            complete = False
+            logger.exception("%s: snap trim of pool %s failed",
+                             self.name, pool.name)
+        finally:
+            self._trimming.discard(pool.id)
+        if complete:
+            self._trimmed_snaps[pool.id] = removed
+            # snaps removed while this pass ran were not in its capture:
+            # re-kick so they aren't stranded until an unrelated map event
+            cur = self.osdmap.pools.get(pool.id) if self.osdmap else None
+            if cur is not None and set(cur.removed_snaps) != removed:
+                self._kick_snap_trim()
+
+    def _trim_scan_heads(self, cid: CollectionId) -> list[str]:
+        """Head/snapdir names with snapshot state in a local collection."""
+        heads: set[str] = set()
+        try:
+            names = self.store.list_objects(cid)
+        except KeyError:
+            return []
+        for o in names:
+            n = o.name
+            if n == "_pgmeta_" or is_stash_name(n):
+                continue
+            if snaps_mod.is_clone_name(n):
+                heads.add(snaps_mod.clone_parent(n))
+        return sorted(heads)
+
+    async def _snap_trim_pg_rep(
+        self, pg: PGid, pool: Pool, acting: list[int], removed: set[int]
+    ) -> bool:
+        ok = True
+        cid = CollectionId(str(pg))
+        for head in self._trim_scan_heads(cid):
+            async with self.pg_lock(pg):  # plan+commit atomically per head
+                head_exists, ss, from_sdir = self._rep_snapset(cid, head)
+                dead = ss.trim(removed)
+                if not dead:
+                    continue
+                txn = Transaction().create_collection(cid)
+                for d in dead:
+                    txn.remove(cid, ObjectId(snaps_mod.clone_name(head, d)))
+                carrier = (
+                    snaps_mod.snapdir_name(head) if from_sdir else head
+                )
+                log_op = "modify"
+                if not ss.clones and from_sdir:
+                    txn.remove(cid, ObjectId(carrier))  # nothing left
+                    log_op = "delete"
+                else:
+                    # the seq must survive even with zero clones, so reads
+                    # at trimmed snaps resolve MISSING rather than head
+                    txn.setattr(
+                        cid, ObjectId(carrier), snaps_mod.SS_KEY,
+                        ss.to_json()
+                    )
+                try:
+                    size = self.store.stat(cid, ObjectId(carrier))
+                except KeyError:
+                    size = 0
+                r = await self._rep_commit_locked(
+                    pg, acting, txn, carrier, log_op, size
+                )
+            ok = ok and r == 0
+        return ok
+
+    async def _snap_trim_pg_ec(
+        self, pg: PGid, pool: Pool, acting: list[int], removed: set[int]
+    ) -> bool:
+        ok = True
+        shard = next(
+            (s for s, o in enumerate(acting) if o == self.osd_id), 0
+        )
+        cid = self._shard_cid(pg, shard)
+        for head in self._trim_scan_heads(cid):
+            r, head_exists, ss = await self._ec_snapset(
+                pg, pool, acting, head
+            )
+            if r < 0:
+                ok = False  # degraded/raced: retried on the next map kick
+                continue
+            dead = ss.trim(removed)
+            if not dead:
+                continue
+            for d in dead:
+                r = await self._ec_delete(
+                    pg, pool, acting, snaps_mod.clone_name(head, d)
+                )
+                ok = ok and r in (0, -ENOENT)
+            carrier = head if head_exists else snaps_mod.snapdir_name(head)
+            if ss.clones or head_exists:
+                r = await self._ec_setxattr(
+                    pg, pool, acting, carrier, snaps_mod.SS_KEY,
+                    ss.to_json() if not ss.empty() else None,
+                    raw_key=True,
+                )
+            else:
+                r = await self._ec_delete(pg, pool, acting, carrier)
+            ok = ok and r in (0, -ENOENT)
+        return ok
+
+    # -- EC snapshots ---------------------------------------------------------
+
+    async def _ec_adopt_snapdir(
+        self, pg: PGid, oid: str, available: dict[int, int],
+        ss: "snaps_mod.SnapSet",
+    ) -> tuple["snaps_mod.SnapSet | None", bool]:
+        """Recreate-after-delete: pick up the SnapSet parked on the
+        snapdir.  Returns (snapset or None on -EAGAIN, remove_snapdir)."""
+        sd_oi, _h, _v, sd_errs, sd_ss = await self._ec_meta(
+            pg, snaps_mod.snapdir_name(oid), dict(available)
+        )
+        if any(e != -ENOENT for e in sd_errs.values()):
+            return None, False
+        if sd_oi is not None:
+            return sd_ss, True
+        return ss, False
+
+    async def _ec_snapset(
         self, pg: PGid, pool: Pool, acting: list[int], oid: str
+    ) -> tuple[int, bool, "snaps_mod.SnapSet"]:
+        """(errno, head_exists, snapset) — falls back to the snapdir when
+        the head is deleted (reference:PrimaryLogPG.cc find_object_context)."""
+        codec, _si = self._pool_codec(pool)
+        km = codec.get_chunk_count()
+        available = {
+            s: o for s, o in enumerate(acting[:km]) if o != CRUSH_ITEM_NONE
+        }
+        if not available:
+            return -EAGAIN, False, snaps_mod.SnapSet()
+        oi, _h, _v, errs, ss = await self._ec_meta(pg, oid, available)
+        if any(e != -ENOENT for e in errs.values()):
+            return -EAGAIN, False, ss
+        if oi is not None:
+            return 0, True, ss
+        sd_oi, _h2, _v2, sd_errs, sd_ss = await self._ec_meta(
+            pg, snaps_mod.snapdir_name(oid), available
+        )
+        if any(e != -ENOENT for e in sd_errs.values()):
+            return -EAGAIN, False, ss
+        if sd_oi is None:
+            return -ENOENT, False, snaps_mod.SnapSet()
+        return 0, False, sd_ss
+
+    async def _ec_resolve_snap(
+        self, pg: PGid, pool: Pool, acting: list[int], oid: str, snapid: int
+    ) -> tuple[int, str]:
+        """Map (oid, snapid) -> the object actually serving that snap."""
+        r, head_exists, ss = await self._ec_snapset(pg, pool, acting, oid)
+        if r < 0:
+            return r, oid
+        res = ss.resolve(snapid)
+        if res == snaps_mod.SnapSet.HEAD:
+            return (0, oid) if head_exists else (-ENOENT, oid)
+        if res == snaps_mod.SnapSet.MISSING:
+            return -ENOENT, oid
+        return 0, snaps_mod.clone_name(oid, res)
+
+    async def _ec_list_snaps(
+        self, pg: PGid, pool: Pool, acting: list[int], oid: str
+    ) -> tuple[int, dict]:
+        r, head_exists, ss = await self._ec_snapset(pg, pool, acting, oid)
+        if r < 0:
+            return r, {}
+        return 0, {
+            "seq": ss.seq,
+            "head_exists": head_exists,
+            "clones": [
+                {"cloneid": c.cloneid, "snaps": c.snaps, "size": c.size}
+                for c in ss.clones
+            ],
+        }
+
+    async def _ec_rollback(
+        self, pg: PGid, pool: Pool, acting: list[int], oid: str,
+        snapid: int, snapc: "snaps_mod.SnapContext | None",
+    ) -> int:
+        """Restore the head to its state at ``snapid``
+        (reference:PrimaryLogPG.cc _rollback_to): resolves the serving
+        clone and rewrites the head from it (itself snap-aware, so a
+        snap taken since the last write still gets its clone); rollback
+        to a snap where the object did not exist deletes the head."""
+        r, src = await self._ec_resolve_snap(pg, pool, acting, oid, snapid)
+        if r == -ENOENT:
+            rr, head_exists, _ss = await self._ec_snapset(
+                pg, pool, acting, oid
+            )
+            if rr == -EAGAIN:
+                return rr
+            if rr == 0 and head_exists:
+                return await self._ec_delete(pg, pool, acting, oid, snapc)
+            return -ENOENT
+        if r < 0:
+            return r
+        if src == oid:
+            return 0  # head already serves that snap
+        r, data = await self._ec_read(pg, pool, acting, src)
+        if r < 0:
+            return r
+        # restore the clone's user xattrs and drop head-only ones, like
+        # the replicated rollback (reference _rollback_to copies attrs)
+        rc, clone_attrs = await self._ec_getxattrs(pg, pool, acting, src)
+        if rc < 0:
+            return rc
+        rh, head_attrs = await self._ec_getxattrs(pg, pool, acting, oid)
+        if rh not in (0, -ENOENT):
+            return rh
+        attr_ops: dict[str, bytes | None] = {
+            k: None for k in head_attrs if k not in clone_attrs
+        }
+        attr_ops.update(clone_attrs)
+        return await self._ec_mutate(
+            pg, pool, acting, oid, "writefull", {}, data, snapc, attr_ops
+        )
+
+    async def _ec_delete(
+        self, pg: PGid, pool: Pool, acting: list[int], oid: str,
+        snapc: "snaps_mod.SnapContext | None" = None,
     ) -> int:
         async with self.pg_lock(pg):
-            return await self._ec_delete_locked(pg, pool, acting, oid)
+            return await self._ec_delete_locked(pg, pool, acting, oid, snapc)
 
     async def _ec_delete_locked(
-        self, pg: PGid, pool: Pool, acting: list[int], oid: str
+        self, pg: PGid, pool: Pool, acting: list[int], oid: str,
+        snapc: "snaps_mod.SnapContext | None" = None,
     ) -> int:
         codec, _ = self._pool_codec(pool)
         km = codec.get_chunk_count()
@@ -1032,19 +1539,56 @@ class OSD(Dispatcher):
         ]
         if not present:
             return -EAGAIN
+        # delete under a snap context preserves the pre-delete object and
+        # parks the SnapSet on the snapdir (reference:PrimaryLogPG.cc
+        # make_writeable delete branch + get_snapdir)
+        clone_src: str | None = None
+        ss = snaps_mod.SnapSet()
+        write_snapdir = False
+        if snapc is not None and snapc.valid():
+            oi, _h, _v, errs, ss = await self._ec_meta(
+                pg, oid, dict(present)
+            )
+            if any(e != -ENOENT for e in errs.values()):
+                return -EAGAIN
+            clone_src = snaps_mod.plan_clone(
+                ss, snapc, oi is not None,
+                0 if oi is None else int(oi["size"]), oid,
+            )
+            write_snapdir = bool(ss.clones)
         version = self._next_version(pg)
         sname = stash_name(oid, version)
         entry = PGLogEntry("delete", oid, version, Eversion(), stash=sname)
+        sdir = snaps_mod.snapdir_name(oid)
+        sd_oi = json.dumps(
+            {"size": 0, "version": version.to_list()}
+        ).encode()
+        # an empty crc table keeps scrub quiet on the zero-length snapdir
+        _codec2, sinfo = self._pool_codec(pool)
+        sd_hinfo = json.dumps(
+            StripeHashes(km, sinfo.chunk_size).to_dict()
+        ).encode()
 
         def build_txn(shard: int) -> Transaction:
             cid = self._shard_cid(pg, shard)
             soid = ObjectId(oid, shard)
-            return (
+            txn = (
                 Transaction()
                 .create_collection(cid)
                 .try_stash(cid, soid, ObjectId(sname, shard))
-                .remove(cid, soid)
             )
+            if clone_src is not None:
+                txn.try_stash(cid, soid, ObjectId(clone_src, shard))
+            txn.remove(cid, soid)
+            sdoid = ObjectId(sdir, shard)
+            if write_snapdir:
+                txn.touch(cid, sdoid)
+                txn.setattr(cid, sdoid, OI_KEY, sd_oi)
+                txn.setattr(cid, sdoid, StripeHashes.XATTR_KEY, sd_hinfo)
+                txn.setattr(cid, sdoid, snaps_mod.SS_KEY, ss.to_json())
+            else:
+                txn.remove(cid, sdoid)  # no clones left: no snapdir
+            return txn
 
         return await self._ec_fan_out(pg, present, build_txn, [entry], version)
 
@@ -1175,12 +1719,16 @@ class OSD(Dispatcher):
 
     async def _ec_meta(
         self, pg: PGid, oid: str, available: dict[int, int]
-    ) -> tuple[dict | None, StripeHashes | None, dict[int, tuple], dict[int, int]]:
+    ) -> tuple[
+        dict | None, StripeHashes | None, dict[int, tuple], dict[int, int],
+        "snaps_mod.SnapSet",
+    ]:
         """Newest object info + crc table from the shards' xattrs (one
         attrs-only round trip) — the planner's hash_infos input
         (reference:src/osd/ECTransaction.h:26-33 WritePlan.hash_infos).
-        Returns (oi, hashes, per-shard versions, per-shard errnos); callers
-        must distinguish absent-everywhere from unreachable via ``errs``."""
+        Returns (oi, hashes, per-shard versions, per-shard errnos,
+        snapset-of-newest-shard); callers must distinguish
+        absent-everywhere from unreachable via ``errs``."""
         _d, attrs, errs = await self._read_shards(
             pg, oid, dict(available), want_data=False
         )
@@ -1188,6 +1736,7 @@ class OSD(Dispatcher):
         hashes: StripeHashes | None = None
         vers: dict[int, tuple] = {}
         newest = (0, 0)
+        ss_raw: bytes | None = None
         for s, a in attrs.items():
             raw = a.get(OI_KEY)
             if raw is None:
@@ -1199,6 +1748,7 @@ class OSD(Dispatcher):
             if v >= newest:
                 newest = v
                 oi = o
+                ss_raw = a.get(snaps_mod.SS_KEY)
                 hraw = a.get(StripeHashes.XATTR_KEY)
                 hashes = None
                 if hraw is not None:
@@ -1206,7 +1756,7 @@ class OSD(Dispatcher):
                         hashes = StripeHashes.from_dict(json.loads(hraw))
                     except Exception:
                         hashes = None
-        return oi, hashes, vers, errs
+        return oi, hashes, vers, errs, snaps_mod.SnapSet.from_json(ss_raw)
 
     async def _ec_read(
         self, pg: PGid, pool: Pool, acting: list[int], oid: str,
@@ -1316,7 +1866,7 @@ class OSD(Dispatcher):
         available = {
             s: o for s, o in enumerate(acting[:km]) if o != CRUSH_ITEM_NONE
         }
-        oi, _hashes, _vers, errs = await self._ec_meta(pg, oid, available)
+        oi, _hashes, _vers, errs, _ss = await self._ec_meta(pg, oid, available)
         if oi is None:
             if any(e != -ENOENT for e in errs.values()):
                 return -EIO, 0  # unreachable shards: absence is unproven
@@ -1416,8 +1966,211 @@ class OSD(Dispatcher):
 
     # ======================= replicated backend ==============================
 
+    # -- object classes (reference:src/osd/ClassHandler.cc + src/cls/) -------
+
+    CLS_XATTR_PREFIX = "c_"  # cls attrs: their own namespace, like "u_"
+
+    def _do_cls_call(
+        self, cid: CollectionId, oid: ObjectId, op: dict,
+        blobs: list[bytes], txn: Transaction,
+    ) -> tuple[int, dict, dict]:
+        """Run one cls method; its writes join ``txn`` so they commit
+        (and replicate) atomically with the surrounding client op
+        (reference:PrimaryLogPG.cc do_osd_ops CEPH_OSD_OP_CALL).
+        Returns (rval, method output or error dict, {mutated, new_size})."""
+        from .. import cls as cls_mod
+
+        info = {"mutated": False, "new_size": None}
+        kls = cls_mod.get_class(op.get("cls", ""))
+        method = kls.methods.get(op.get("method", "")) if kls else None
+        if method is None:
+            return -EOPNOTSUPP, {
+                "error": f"no method {op.get('cls')}.{op.get('method')}"
+            }, info
+        input = dict(op.get("input") or {})
+        if op.get("data") is not None:
+            input["data"] = blobs[op["data"]]
+
+        def _read() -> bytes | None:
+            try:
+                return bytes(self.store.read(cid, oid))
+            except KeyError:
+                return None
+
+        def _getx(key: str) -> bytes | None:
+            try:
+                return self.store.getattr(
+                    cid, oid, self.CLS_XATTR_PREFIX + key
+                )
+            except KeyError:
+                return None
+
+        def _mark() -> None:
+            info["mutated"] = True
+
+        def _setx(key: str, value: bytes) -> None:
+            _mark()
+            txn.touch(cid, oid)
+            txn.setattr(cid, oid, self.CLS_XATTR_PREFIX + key, value)
+
+        def _omap_get() -> dict[str, bytes]:
+            try:
+                return dict(self.store.omap_get(cid, oid))
+            except KeyError:
+                return {}
+
+        def _omap_set(kv: dict[str, bytes]) -> None:
+            _mark()
+            txn.touch(cid, oid)
+            txn.omap_setkeys(cid, oid, kv)
+
+        def _omap_rm(keys: list[str]) -> None:
+            _mark()
+            txn.omap_rmkeys(cid, oid, keys)
+
+        def _write_full(data: bytes) -> None:
+            _mark()
+            info["new_size"] = len(data)
+            txn.remove(cid, oid).write(cid, oid, 0, data)
+
+        ctx = cls_mod.MethodContext(
+            read=_read, getxattr=_getx, setxattr=_setx,
+            omap_get=_omap_get, omap_set=_omap_set, omap_rm=_omap_rm,
+            write_full=_write_full, writable=method.is_write,
+        )
+        try:
+            ret = method.fn(ctx, input) or {}
+        except cls_mod.ClsError as e:
+            return -e.code, {"error": str(e)}, info
+        except Exception as e:
+            logger.exception("cls %s.%s failed", kls.name, method.name)
+            return -EIO, {"error": f"cls crashed: {e}"}, info
+        return 0, ret, info
+
+    # -- watch / notify (reference:src/osd/Watch.{h,cc}) ----------------------
+
+    async def _watch_execute(
+        self, pg: PGid, pool: Pool, acting: list[int],
+        msg: messages.MOSDOp, conn: Connection | None,
+    ) -> tuple[int, list, list[bytes]]:
+        out: list = []
+        blobs: list[bytes] = []
+        key = (pool.id, msg.oid)
+        for op in msg.ops:
+            name = op["op"]
+            if name == "watch":
+                r = await self._obj_exists(pg, pool, acting, msg.oid)
+                if r < 0:
+                    out.append({"rval": r})
+                    return r, out, blobs
+                if conn is None:
+                    out.append({"rval": -EINVAL})
+                    return -EINVAL, out, blobs
+                cookie = str(op.get("cookie", ""))
+                self._watchers.setdefault(key, {})[cookie] = conn
+                out.append({"rval": 0})
+            elif name == "unwatch":
+                cookie = str(op.get("cookie", ""))
+                table = self._watchers.get(key, {})
+                table.pop(cookie, None)
+                if not table:
+                    self._watchers.pop(key, None)
+                out.append({"rval": 0})
+            elif name == "notify":
+                payload = (
+                    msg.blobs[op["data"]] if op.get("data") is not None else b""
+                )
+                timeout = float(op.get("timeout", 5.0))
+                acks, missed = await self._do_notify(
+                    key, msg.oid, payload, timeout
+                )
+                out.append({
+                    "rval": 0,
+                    "acks": {c: len(blobs) + i for i, c in
+                             enumerate(sorted(acks))},
+                    "missed": sorted(missed),
+                })
+                blobs.extend(acks[c] for c in sorted(acks))
+            else:
+                out.append({"rval": -EINVAL,
+                            "error": "watch ops cannot mix with I/O ops"})
+                return -EINVAL, out, blobs
+        return 0, out, blobs
+
+    async def _obj_exists(
+        self, pg: PGid, pool: Pool, acting: list[int], oid: str
+    ) -> int:
+        """Watch requires the object to exist (reference do_osd_ops
+        CEPH_OSD_OP_WATCH on missing object -> -ENOENT)."""
+        if pool.type == POOL_TYPE_ERASURE:
+            r, _size = await self._ec_stat(pg, pool, acting, oid)
+            return r
+        cid = CollectionId(str(pg))
+        return 0 if self.store.exists(cid, ObjectId(oid)) else -ENOENT
+
+    async def _do_notify(
+        self, key: tuple[int, str], oid: str, payload: bytes, timeout: float
+    ) -> tuple[dict[str, bytes], list[str]]:
+        """Fan a notify out to every watcher, gather acks (or time out),
+        reference:src/osd/Watch.cc Notify::init/maybe_complete_notify."""
+        watchers = dict(self._watchers.get(key, {}))
+        notify_id = self._new_tid()
+        waiter = _NotifyWaiter(set(watchers))
+        self._notify_waiters[notify_id] = waiter
+        try:
+            for cookie, conn in watchers.items():
+                try:
+                    conn.send(messages.MWatchNotify(
+                        notify_id=notify_id, cookie=cookie, oid=oid,
+                        notifier=self.name, blobs=[payload],
+                    ))
+                except (ConnectionError, OSError):
+                    waiter.drop(cookie)
+            try:
+                async with asyncio.timeout(timeout):
+                    await waiter.event.wait()
+            except TimeoutError:
+                pass
+            missed = sorted(waiter.pending)
+            return dict(waiter.acks), missed
+        finally:
+            del self._notify_waiters[notify_id]
+
+    def _rep_snapset(
+        self, cid: CollectionId, oid_str: str
+    ) -> tuple[bool, "snaps_mod.SnapSet", bool]:
+        """(head_exists, snapset, snapset-came-from-snapdir) from the
+        primary's local store (every replica holds whole objects)."""
+        oid = ObjectId(oid_str)
+        if self.store.exists(cid, oid):
+            try:
+                raw = self.store.getattr(cid, oid, snaps_mod.SS_KEY)
+            except KeyError:
+                raw = None
+            return True, snaps_mod.SnapSet.from_json(raw), False
+        sd = ObjectId(snaps_mod.snapdir_name(oid_str))
+        if self.store.exists(cid, sd):
+            try:
+                raw = self.store.getattr(cid, sd, snaps_mod.SS_KEY)
+            except KeyError:
+                raw = None
+            return False, snaps_mod.SnapSet.from_json(raw), True
+        return False, snaps_mod.SnapSet(), False
+
+    def _rep_resolve_snap(
+        self, cid: CollectionId, oid_str: str, snapid: int
+    ) -> tuple[int, str]:
+        head_exists, ss, _sd = self._rep_snapset(cid, oid_str)
+        res = ss.resolve(snapid)
+        if res == snaps_mod.SnapSet.HEAD:
+            return (0, oid_str) if head_exists else (-ENOENT, oid_str)
+        if res == snaps_mod.SnapSet.MISSING:
+            return -ENOENT, oid_str
+        return 0, snaps_mod.clone_name(oid_str, res)
+
     async def _rep_execute(
-        self, pg: PGid, pool: Pool, acting: list[int], msg: messages.MOSDOp
+        self, pg: PGid, pool: Pool, acting: list[int], msg: messages.MOSDOp,
+        locked: bool = False,
     ) -> tuple[int, list, list[bytes]]:
         cid = CollectionId(str(pg))
         oid = ObjectId(msg.oid)
@@ -1430,8 +2183,57 @@ class OSD(Dispatcher):
             projected_size = self.store.stat(cid, oid)
         except KeyError:
             projected_size = 0
+        # snapshots: writes clone-on-first-write-after-snap, reads at a
+        # snap resolve to the serving clone (reference:PrimaryLogPG.cc
+        # make_writeable / find_object_context)
+        snapc = snaps_mod.SnapContext.from_dict(msg.snapc)
+        read_oid = oid
+        if msg.snapid is not None:
+            r, resolved = self._rep_resolve_snap(cid, msg.oid, int(msg.snapid))
+            if r < 0:
+                return r, [{"rval": r}], blobs
+            read_oid = ObjectId(resolved)
+        ss: "snaps_mod.SnapSet | None" = None
+
+        def prep_write() -> "snaps_mod.SnapSet":
+            """Once per message, before the first mutating op lands in
+            the txn: clone the pre-write object if a snap demands it."""
+            nonlocal ss
+            if ss is not None:
+                return ss
+            head_exists, ss, from_sdir = self._rep_snapset(cid, msg.oid)
+            clone_src = snaps_mod.plan_clone(
+                ss, snapc, head_exists, projected_size, msg.oid
+            )
+            if clone_src is not None:
+                txn.try_stash(cid, oid, ObjectId(clone_src))
+            if snapc is not None and from_sdir:
+                txn.remove(cid, ObjectId(snaps_mod.snapdir_name(msg.oid)))
+            return ss
+
+        def delete_head() -> None:
+            """Remove the head, parking the SnapSet on the snapdir while
+            clones survive it (shared by delete and rollback-to-absent,
+            reference:PrimaryLogPG.cc make_writeable delete branch)."""
+            nonlocal projected_size, mutates, log_op
+            txn.remove(cid, oid)
+            sd = ObjectId(snaps_mod.snapdir_name(msg.oid))
+            if ss is not None and ss.clones:
+                txn.touch(cid, sd)
+                txn.setattr(cid, sd, snaps_mod.SS_KEY, ss.to_json())
+            else:
+                txn.remove(cid, sd)
+            projected_size = 0
+            mutates = True
+            log_op = "delete"
+
         for op in msg.ops:
             name = op["op"]
+            if name in self._REP_LOCKED_OPS:
+                # EVERY mutation goes through make_writeable (including
+                # cls calls and xattr/omap changes), or a snap silently
+                # absorbs post-snap state (review r2 findings)
+                prep_write()
             if name == "writefull":
                 data = msg.blobs[op["data"]]
                 txn.remove(cid, oid).write(cid, oid, 0, data)
@@ -1470,15 +2272,63 @@ class OSD(Dispatcher):
                 log_op = "modify"
                 out.append({"rval": 0})
             elif name == "delete":
-                txn.remove(cid, oid)
-                projected_size = 0
-                mutates = True
-                log_op = "delete"
+                delete_head()
                 out.append({"rval": 0})
+            elif name == "rollback":
+                r, src = self._rep_resolve_snap(
+                    cid, msg.oid, int(op["snapid"])
+                )
+                if r == -ENOENT and self.store.exists(cid, oid):
+                    # object absent at that snap: rollback deletes head
+                    delete_head()
+                    out.append({"rval": 0})
+                    continue
+                if r < 0:
+                    out.append({"rval": r})
+                    return r, out, blobs
+                if src != msg.oid:
+                    data = self.store.read(cid, ObjectId(src))
+                    attrs = self.store.getattrs(cid, ObjectId(src))
+                    txn.remove(cid, oid).write(cid, oid, 0, bytes(data))
+                    for k, v in attrs.items():
+                        if k not in (OI_KEY, snaps_mod.SS_KEY):
+                            txn.setattr(cid, oid, k, v)
+                    projected_size = len(data)
+                    mutates = True
+                    log_op = "modify"
+                out.append({"rval": 0})
+            elif name == "call":
+                r, ret, info = self._do_cls_call(cid, oid, op, msg.blobs, txn)
+                out.append({"rval": r, **({"ret": ret} if r == 0 else ret)})
+                if r < 0:
+                    return r, out, blobs
+                if info["mutated"]:
+                    mutates = True
+                    if info["new_size"] is not None:
+                        projected_size = info["new_size"]
+            elif name == "list_snaps":
+                head_exists, lss, _sd = self._rep_snapset(cid, msg.oid)
+                if not head_exists and lss.empty():
+                    out.append({"rval": -ENOENT})
+                    return -ENOENT, out, blobs
+                out.append({
+                    "rval": 0,
+                    "snapset": {
+                        "seq": lss.seq,
+                        "head_exists": head_exists,
+                        "clones": [
+                            {"cloneid": c.cloneid, "snaps": c.snaps,
+                             "size": c.size}
+                            for c in lss.clones
+                        ],
+                    },
+                })
             elif name == "read":
                 try:
                     ln = op.get("length", -1) or -1
-                    data = self.store.read(cid, oid, op.get("offset", 0), ln)
+                    data = self.store.read(
+                        cid, read_oid, op.get("offset", 0), ln
+                    )
                 except KeyError:
                     out.append({"rval": -ENOENT})
                     return -ENOENT, out, blobs
@@ -1486,7 +2336,7 @@ class OSD(Dispatcher):
                 blobs.append(data)
             elif name == "stat":
                 try:
-                    size = self.store.stat(cid, oid)
+                    size = self.store.stat(cid, read_oid)
                 except KeyError:
                     out.append({"rval": -ENOENT, "size": 0})
                     return -ENOENT, out, blobs
@@ -1508,7 +2358,7 @@ class OSD(Dispatcher):
             elif name == "getxattr":
                 try:
                     val = self.store.getattr(
-                        cid, oid, self.USER_XATTR_PREFIX + op["key"]
+                        cid, read_oid, self.USER_XATTR_PREFIX + op["key"]
                     )
                 except KeyError:
                     out.append({"rval": -ENOENT})
@@ -1517,7 +2367,7 @@ class OSD(Dispatcher):
                 blobs.append(val)
             elif name == "getxattrs":
                 try:
-                    attrs = self.store.getattrs(cid, oid)
+                    attrs = self.store.getattrs(cid, read_oid)
                 except KeyError:
                     out.append({"rval": -ENOENT})
                     return -ENOENT, out, blobs
@@ -1547,7 +2397,7 @@ class OSD(Dispatcher):
                 out.append({"rval": 0})
             elif name == "omap_get":
                 try:
-                    omap = self.store.omap_get(cid, oid)
+                    omap = self.store.omap_get(cid, read_oid)
                 except KeyError:
                     out.append({"rval": -ENOENT})
                     return -ENOENT, out, blobs
@@ -1561,9 +2411,16 @@ class OSD(Dispatcher):
                 out.append({"rval": -EINVAL})
                 return -EINVAL, out, blobs
         if mutates:
-            r = await self._rep_commit(
-                pg, acting, txn, msg.oid, log_op, projected_size
-            )
+            if ss is not None and not ss.empty() and log_op != "delete":
+                txn.setattr(cid, oid, snaps_mod.SS_KEY, ss.to_json())
+            if locked:
+                r = await self._rep_commit_locked(
+                    pg, acting, txn, msg.oid, log_op, projected_size
+                )
+            else:
+                r = await self._rep_commit(
+                    pg, acting, txn, msg.oid, log_op, projected_size
+                )
             if r < 0:
                 return r, out, blobs
         return 0, out, blobs
@@ -1644,11 +2501,28 @@ class OSD(Dispatcher):
 
     # ======================= heartbeats ======================================
 
+    async def _watchdog_loop(self) -> None:
+        """Poll the HeartbeatMap independently of peer pings (the
+        reference polls from its always-on heartbeat(); here pings are
+        optional, the watchdog is not)."""
+        period = max(0.05, self.config.osd_op_thread_timeout / 3)
+        try:
+            while not self._stopping:
+                await asyncio.sleep(period)
+                self.hb_map.is_healthy()
+        except asyncio.CancelledError:
+            pass
+
     async def _heartbeat_loop(self) -> None:
         """reference:src/osd/OSD.cc:4104-4245 heartbeat + failure_queue."""
         try:
             while not self._stopping:
                 await asyncio.sleep(self.heartbeat_interval)
+                if not self.hb_map.is_healthy():
+                    # a wedged worker: stop pinging so peers report us
+                    # (reference:OSD.cc heartbeat() cct->get_heartbeat_map()
+                    # ->is_healthy() gate)
+                    continue
                 if self.osdmap is None:
                     continue
                 now = time.monotonic()

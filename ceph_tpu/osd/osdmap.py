@@ -115,6 +115,12 @@ class Pool:
     flags: int = FLAG_HASHPSPOOL
     erasure_code_profile: str = ""
     stripe_width: int = 0
+    # snapshots (reference:osd_types.h pg_pool_t snap_seq/snaps/
+    # removed_snaps): pool snaps are named and cluster-managed;
+    # self-managed snaps only consume ids from the same sequence
+    snap_seq: int = 0
+    snaps: dict = field(default_factory=dict)  # snapid -> name
+    removed_snaps: list = field(default_factory=list)
 
     @property
     def pg_num_mask(self) -> int:
@@ -517,6 +523,8 @@ class OSDMap:
         m.osd_addrs = {int(k): v for k, v in d.get("osd_addrs", {}).items()}
         for pid, pd in d["pools"].items():
             pool = Pool(**pd)
+            # JSON stringifies the snapid keys
+            pool.snaps = {int(k): v for k, v in pool.snaps.items()}
             m.pools[int(pid)] = pool
             m.pool_name[pool.name] = int(pid)
         m.erasure_code_profiles = {

@@ -55,6 +55,9 @@ class RadosClient(Dispatcher):
         self._sub_conn: Connection | None = None  # map subscription feed
         self._shutdown = False
         self._tasks: set[asyncio.Task] = set()
+        # watches: cookie -> {pool, oid, callback, conn} (linger state)
+        self._watches: dict[str, dict] = {}
+        self._watch_cookie = itertools.count(1)
 
     @property
     def _mon_addrs(self) -> list[str]:
@@ -137,6 +140,39 @@ class RadosClient(Dispatcher):
             self._fut_conns.pop(msg.tid, None)
             if fut is not None and not fut.done():
                 fut.set_result(msg)
+        elif isinstance(msg, messages.MWatchNotify):
+            await self._handle_watch_notify(conn, msg)
+
+    async def _handle_watch_notify(
+        self, conn: Connection, msg: messages.MWatchNotify
+    ) -> None:
+        """A notify fired on an object we watch: run the callback, then
+        ack so the notifier's gather completes (reference:
+        src/osdc/Objecter.cc handle_watch_notify + librados WatchCtx).
+
+        Delivery runs as a task: ms_dispatch is awaited inline by the
+        connection reader, so an async callback doing I/O on this same
+        connection would deadlock against its own reply."""
+
+        async def deliver() -> None:
+            w = self._watches.get(msg.cookie)
+            payload = msg.blobs[0] if msg.blobs else b""
+            if w is not None:
+                try:
+                    res = w["callback"](msg.notifier, payload)
+                    if asyncio.iscoroutine(res):
+                        await res
+                except Exception:
+                    logger.exception("%s: watch callback failed", self.name)
+            conn.send(
+                messages.MWatchNotifyAck(
+                    notify_id=msg.notify_id, cookie=msg.cookie
+                )
+            )
+
+        t = asyncio.ensure_future(deliver())
+        self._tasks.add(t)
+        t.add_done_callback(self._tasks.discard)
 
     def ms_handle_reset(self, conn: Connection) -> None:
         if conn is self._sub_conn:
@@ -149,6 +185,32 @@ class RadosClient(Dispatcher):
                 del self._fut_conns[tid]
                 if fut is not None and not fut.done():
                     fut.set_exception(ConnectionResetError(f"{conn} reset"))
+        # linger semantics: re-register watches whose OSD connection died
+        # (reference:Objecter.cc _linger_ops resend on reset)
+        stale = [c for c, w in self._watches.items() if w.get("conn") is conn]
+        if stale and not self._shutdown:
+            t = asyncio.ensure_future(self._rewatch(stale))
+            self._tasks.add(t)
+            t.add_done_callback(self._tasks.discard)
+
+    async def _rewatch(self, cookies: list[str]) -> None:
+        await asyncio.sleep(0.2)  # let the map catch up with the failure
+        for cookie in cookies:
+            w = self._watches.get(cookie)
+            if w is None:
+                continue
+            try:
+                reply = await self.operate(
+                    w["pool"], w["oid"],
+                    [{"op": "watch", "cookie": cookie}], [],
+                )
+                if reply.result == 0:
+                    w["conn"] = await self._primary_conn(w["pool"], w["oid"])
+            except (RadosError, ConnectionError, OSError):
+                logger.warning(
+                    "%s: re-watch of %s/%s failed", self.name,
+                    w["pool"], w["oid"],
+                )
 
     async def _wait_for_map_change(self, have_epoch: int, timeout: float) -> None:
         if self.osdmap is not None and self.osdmap.epoch > have_epoch:
@@ -225,9 +287,26 @@ class RadosClient(Dispatcher):
         return IoCtx(self, pool_name)
 
     # -- op submission (Objecter)
+    async def _primary_conn(self, pool_name: str, oid: str) -> Connection:
+        """The (cached) connection to the object's current primary —
+        the conn a watch rides on."""
+        pool = self.osdmap.lookup_pool(pool_name)
+        if pool is None:
+            raise RadosError(-ENOENT, f"no pool {pool_name!r}")
+        pg = self.osdmap.object_locator_to_pg(oid, pool.id)
+        _up, _upp, _acting, primary = self.osdmap.pg_to_up_acting_osds(pg)
+        addr = self.osdmap.get_addr(primary) if primary >= 0 else None
+        if not addr:
+            raise RadosError(-EAGAIN, "no primary for watch")
+        return await self.messenger.connect(addr, f"osd.{primary}")
+
     async def operate(
-        self, pool_name: str, oid: str, ops: list[dict], blobs: list[bytes]
+        self, pool_name: str, oid: str, ops: list[dict], blobs: list[bytes],
+        snapc: dict | None = None, snapid: int | None = None,
+        op_timeout: float | None = None,
     ) -> messages.MOSDOpReply:
+        if op_timeout is None:
+            op_timeout = self.op_timeout
         last_err: Exception | None = None
         for attempt in range(self.max_retries):
             epoch = self.osdmap.epoch
@@ -249,10 +328,10 @@ class RadosClient(Dispatcher):
                 conn.send(
                     messages.MOSDOp(
                         tid=tid, epoch=epoch, pool=pool.id, oid=oid,
-                        ops=ops, blobs=blobs,
+                        ops=ops, blobs=blobs, snapc=snapc, snapid=snapid,
                     )
                 )
-                async with asyncio.timeout(self.op_timeout):
+                async with asyncio.timeout(op_timeout):
                     reply = await fut
             except (ConnectionError, OSError, TimeoutError) as e:
                 self._op_futs.pop(tid, None)
@@ -364,89 +443,207 @@ class RadosClient(Dispatcher):
 
 
 class IoCtx:
-    """Pool-scoped object operations (reference:src/librados/IoCtxImpl.cc)."""
+    """Pool-scoped object operations (reference:src/librados/IoCtxImpl.cc).
+
+    Snapshots (reference:IoCtxImpl snapc/snap_seq handling): writes carry
+    a SnapContext — the pool's own for named pool snaps, or the one set
+    with :meth:`set_snapc` for self-managed snaps; reads honor
+    :meth:`set_read` (a snap id) and resolve to the serving clone.
+    """
 
     def __init__(self, client: RadosClient, pool_name: str):
         self.client = client
         self.pool_name = pool_name
+        self.read_snap: int | None = None   # set_read: reads-at-snap
+        self._selfmanaged_snapc: dict | None = None
 
-    async def write_full(self, oid: str, data: bytes) -> None:
+    # -- snap context plumbing ----------------------------------------------
+    def set_read(self, snapid: int | None) -> None:
+        """Route reads to the object state at ``snapid`` (None = head)."""
+        self.read_snap = snapid
+
+    def set_snapc(self, seq: int, snaps: list[int]) -> None:
+        """Self-managed snap context for subsequent writes (newest
+        first, like librados selfmanaged_snap_set_write_ctx)."""
+        self._selfmanaged_snapc = {
+            "seq": int(seq), "snaps": [int(s) for s in snaps]
+        }
+
+    def write_snapc(self) -> dict | None:
+        """The SnapContext writes carry: explicit self-managed one, else
+        the pool's named snaps from the current map."""
+        if self._selfmanaged_snapc is not None:
+            return self._selfmanaged_snapc
+        pool = self.client.osdmap.lookup_pool(self.pool_name)
+        if pool is None or not pool.snaps:
+            return None
+        return {
+            "seq": pool.snap_seq,
+            "snaps": sorted(pool.snaps, reverse=True),
+        }
+
+    async def _op_w(self, oid: str, ops: list[dict], blobs: list[bytes]):
+        return await self.client.operate(
+            self.pool_name, oid, ops, blobs, snapc=self.write_snapc()
+        )
+
+    async def _op_r(self, oid: str, ops: list[dict], blobs: list[bytes]):
+        return await self.client.operate(
+            self.pool_name, oid, ops, blobs, snapid=self.read_snap
+        )
+
+    # -- snapshot operations -------------------------------------------------
+    async def create_snap(self, name: str) -> int:
+        """Named pool snapshot (rados mksnap); returns its snap id and
+        waits for the map so subsequent writes clone against it."""
+        code, status, out = await self.client.command(
+            {"prefix": "osd pool mksnap", "pool": self.pool_name,
+             "snap": name}
+        )
+        if code < 0:
+            raise RadosError(code, status)
+        snapid = out["snapid"]
+        await self._wait_snap_seq(snapid)
+        return snapid
+
+    async def remove_snap(self, name: str) -> None:
+        code, status, out = await self.client.command(
+            {"prefix": "osd pool rmsnap", "pool": self.pool_name,
+             "snap": name}
+        )
+        if code < 0:
+            raise RadosError(code, status)
+
+    async def list_pool_snaps(self) -> list[dict]:
+        code, status, out = await self.client.command(
+            {"prefix": "osd pool lssnap", "pool": self.pool_name}
+        )
+        if code < 0:
+            raise RadosError(code, status)
+        return out["snaps"]
+
+    async def lookup_snap(self, name: str) -> int:
+        for s in await self.list_pool_snaps():
+            if s["name"] == name:
+                return s["snapid"]
+        raise RadosError(-ENOENT, f"no snap {name!r}")
+
+    async def selfmanaged_snap_create(self) -> int:
+        """Allocate a snap id the application manages itself (librbd's
+        mode; reference librados selfmanaged_snap_create)."""
+        code, status, out = await self.client.command(
+            {"prefix": "osd pool selfmanaged-snap create",
+             "pool": self.pool_name}
+        )
+        if code < 0:
+            raise RadosError(code, status)
+        return out["snapid"]
+
+    async def selfmanaged_snap_remove(self, snapid: int) -> None:
+        code, status, _ = await self.client.command(
+            {"prefix": "osd pool selfmanaged-snap rm",
+             "pool": self.pool_name, "snapid": snapid}
+        )
+        if code < 0:
+            raise RadosError(code, status)
+
+    async def _wait_snap_seq(self, snapid: int, timeout: float = 10.0) -> None:
+        deadline = asyncio.get_running_loop().time() + timeout
+        while True:
+            pool = self.client.osdmap.lookup_pool(self.pool_name)
+            if pool is not None and pool.snap_seq >= snapid:
+                return
+            remaining = deadline - asyncio.get_running_loop().time()
+            if remaining <= 0:
+                raise RadosError(-EAGAIN, "snap not visible in map")
+            await self.client._wait_for_map_change(
+                self.client.osdmap.epoch, remaining
+            )
+
+    async def rollback(self, oid: str, snap: "str | int") -> None:
+        """Restore ``oid`` to its state at the snap (rados rollback)."""
+        snapid = (
+            await self.lookup_snap(snap) if isinstance(snap, str) else snap
+        )
+        reply = await self._op_w(
+            oid, [{"op": "rollback", "snapid": snapid}], []
+        )
+        if reply.result < 0:
+            raise RadosError(reply.result, f"rollback {oid}@{snapid}")
+
+    async def list_snaps(self, oid: str) -> dict:
+        """The object's SnapSet: seq, clones with their snaps/sizes."""
         reply = await self.client.operate(
-            self.pool_name, oid,
-            [{"op": "writefull", "data": 0}], [bytes(data)],
+            self.pool_name, oid, [{"op": "list_snaps"}], []
+        )
+        if reply.result < 0:
+            raise RadosError(reply.result, f"list_snaps {oid}")
+        return reply.out[0]["snapset"]
+
+    # -- object I/O ----------------------------------------------------------
+    async def write_full(self, oid: str, data: bytes) -> None:
+        reply = await self._op_w(
+            oid, [{"op": "writefull", "data": 0}], [bytes(data)]
         )
         if reply.result < 0:
             raise RadosError(reply.result, f"write_full {oid}")
 
     async def write(self, oid: str, data: bytes, offset: int = 0) -> None:
-        reply = await self.client.operate(
-            self.pool_name, oid,
-            [{"op": "write", "offset": offset, "data": 0}], [bytes(data)],
+        reply = await self._op_w(
+            oid, [{"op": "write", "offset": offset, "data": 0}], [bytes(data)]
         )
         if reply.result < 0:
             raise RadosError(reply.result, f"write {oid}")
 
     async def append(self, oid: str, data: bytes) -> None:
-        reply = await self.client.operate(
-            self.pool_name, oid,
-            [{"op": "append", "data": 0}], [bytes(data)],
+        reply = await self._op_w(
+            oid, [{"op": "append", "data": 0}], [bytes(data)]
         )
         if reply.result < 0:
             raise RadosError(reply.result, f"append {oid}")
 
     async def truncate(self, oid: str, size: int) -> None:
-        reply = await self.client.operate(
-            self.pool_name, oid, [{"op": "truncate", "size": size}], []
-        )
+        reply = await self._op_w(oid, [{"op": "truncate", "size": size}], [])
         if reply.result < 0:
             raise RadosError(reply.result, f"truncate {oid}")
 
     async def zero(self, oid: str, offset: int, length: int) -> None:
-        reply = await self.client.operate(
-            self.pool_name, oid,
-            [{"op": "zero", "offset": offset, "length": length}], [],
+        reply = await self._op_w(
+            oid, [{"op": "zero", "offset": offset, "length": length}], []
         )
         if reply.result < 0:
             raise RadosError(reply.result, f"zero {oid}")
 
     async def read(self, oid: str, offset: int = 0, length: int = 0) -> bytes:
-        reply = await self.client.operate(
-            self.pool_name, oid,
-            [{"op": "read", "offset": offset, "length": length}], [],
+        reply = await self._op_r(
+            oid, [{"op": "read", "offset": offset, "length": length}], []
         )
         if reply.result < 0:
             raise RadosError(reply.result, f"read {oid}")
         return reply.blobs[reply.out[0]["data"]]
 
     async def remove(self, oid: str) -> None:
-        reply = await self.client.operate(
-            self.pool_name, oid, [{"op": "delete"}], []
-        )
+        reply = await self._op_w(oid, [{"op": "delete"}], [])
         if reply.result < 0:
             raise RadosError(reply.result, f"remove {oid}")
 
     async def stat(self, oid: str) -> int:
         """Returns object size."""
-        reply = await self.client.operate(
-            self.pool_name, oid, [{"op": "stat"}], []
-        )
+        reply = await self._op_r(oid, [{"op": "stat"}], [])
         if reply.result < 0:
             raise RadosError(reply.result, f"stat {oid}")
         return reply.out[0]["size"]
 
     # -- xattrs (reference librados rados_setxattr/getxattr/rmxattr)
     async def setxattr(self, oid: str, key: str, value: bytes) -> None:
-        reply = await self.client.operate(
-            self.pool_name, oid,
-            [{"op": "setxattr", "key": key, "data": 0}], [bytes(value)],
+        reply = await self._op_w(
+            oid, [{"op": "setxattr", "key": key, "data": 0}], [bytes(value)]
         )
         if reply.result < 0:
             raise RadosError(reply.result, f"setxattr {oid} {key}")
 
     async def getxattr(self, oid: str, key: str) -> bytes:
-        reply = await self.client.operate(
-            self.pool_name, oid, [{"op": "getxattr", "key": key}], []
-        )
+        reply = await self._op_r(oid, [{"op": "getxattr", "key": key}], [])
         out = reply.out[0]
         if reply.result < 0 or out.get("rval", 0) < 0:
             raise RadosError(
@@ -455,22 +652,99 @@ class IoCtx:
         return bytes(reply.blobs[out["data"]])
 
     async def rmxattr(self, oid: str, key: str) -> None:
-        reply = await self.client.operate(
-            self.pool_name, oid, [{"op": "rmxattr", "key": key}], []
-        )
+        reply = await self._op_w(oid, [{"op": "rmxattr", "key": key}], [])
         if reply.result < 0:
             raise RadosError(reply.result, f"rmxattr {oid} {key}")
 
     async def getxattrs(self, oid: str) -> dict[str, bytes]:
-        reply = await self.client.operate(
-            self.pool_name, oid, [{"op": "getxattrs"}], []
-        )
+        reply = await self._op_r(oid, [{"op": "getxattrs"}], [])
         if reply.result < 0:
             raise RadosError(reply.result, f"getxattrs {oid}")
         out = reply.out[0]
         return {
             k: bytes(reply.blobs[bi]) for k, bi in out.get("attrs", {}).items()
         }
+
+    # -- watch / notify (reference librados rados_watch/notify) --------------
+    async def watch(self, oid: str, callback) -> str:
+        """Watch ``oid``: ``callback(notifier, payload)`` runs on every
+        notify (may be async).  Returns the watch cookie.  The watch
+        re-registers itself if the OSD connection resets (linger)."""
+        cookie = f"{self.client.name}.w{next(self.client._watch_cookie)}"
+        # register the callback BEFORE the op commits: the OSD may fan a
+        # notify at us the instant the watch lands, and an acked notify
+        # whose callback never ran is a silent loss
+        self.client._watches[cookie] = {
+            "pool": self.pool_name, "oid": oid, "callback": callback,
+            "conn": None,
+        }
+        try:
+            reply = await self.client.operate(
+                self.pool_name, oid, [{"op": "watch", "cookie": cookie}], []
+            )
+            if reply.result < 0:
+                raise RadosError(reply.result, f"watch {oid}")
+            self.client._watches[cookie]["conn"] = (
+                await self.client._primary_conn(self.pool_name, oid)
+            )
+        except BaseException:
+            self.client._watches.pop(cookie, None)
+            raise
+        return cookie
+
+    async def unwatch(self, cookie: str) -> None:
+        w = self.client._watches.pop(cookie, None)
+        if w is None:
+            return
+        reply = await self.client.operate(
+            self.pool_name, w["oid"], [{"op": "unwatch", "cookie": cookie}], []
+        )
+        if reply.result < 0:
+            raise RadosError(reply.result, f"unwatch {w['oid']}")
+
+    async def notify(
+        self, oid: str, payload: bytes = b"", timeout: float = 5.0
+    ) -> dict:
+        """Notify every watcher; returns {"acks": {cookie: reply_bytes},
+        "missed": [cookie]} after all acks or the timeout."""
+        # the op must outlive the OSD-side ack gather, or operate()'s
+        # retry would fan duplicate notifies at every watcher
+        reply = await self.client.operate(
+            self.pool_name, oid,
+            [{"op": "notify", "data": 0, "timeout": timeout}],
+            [bytes(payload)],
+            op_timeout=timeout + 5.0,
+        )
+        if reply.result < 0:
+            raise RadosError(reply.result, f"notify {oid}")
+        out = reply.out[0]
+        return {
+            "acks": {
+                c: bytes(reply.blobs[bi]) for c, bi in out["acks"].items()
+            },
+            "missed": out["missed"],
+        }
+
+    # -- object classes (reference librados rados_exec) ----------------------
+    async def exec(
+        self, oid: str, cls: str, method: str,
+        input: dict | None = None, data: bytes | None = None,
+    ) -> dict:
+        """Invoke an in-OSD object-class method atomically on ``oid``."""
+        op = {"op": "call", "cls": cls, "method": method,
+              "input": input or {}}
+        blobs: list[bytes] = []
+        if data is not None:
+            op["data"] = 0
+            blobs.append(bytes(data))
+        reply = await self._op_w(oid, [op], blobs)
+        out = reply.out[0]
+        if reply.result < 0 or out.get("rval", 0) < 0:
+            raise RadosError(
+                min(reply.result, out.get("rval", 0)),
+                out.get("error", f"exec {cls}.{method} on {oid}"),
+            )
+        return out.get("ret", {})
 
     # -- omap (replicated pools only; EC pools answer -EOPNOTSUPP like
     #    the reference, reference:src/osd/PrimaryLogPG.cc do_osd_ops)
@@ -480,17 +754,14 @@ class IoCtx:
         for k, v in kv.items():
             keys[k] = len(blobs)
             blobs.append(bytes(v))
-        reply = await self.client.operate(
-            self.pool_name, oid,
-            [{"op": "omap_setkeys", "keys": keys}], blobs,
+        reply = await self._op_w(
+            oid, [{"op": "omap_setkeys", "keys": keys}], blobs
         )
         if reply.result < 0:
             raise RadosError(reply.result, f"omap_set {oid}")
 
     async def omap_get(self, oid: str) -> dict[str, bytes]:
-        reply = await self.client.operate(
-            self.pool_name, oid, [{"op": "omap_get"}], []
-        )
+        reply = await self._op_r(oid, [{"op": "omap_get"}], [])
         if reply.result < 0:
             raise RadosError(reply.result, f"omap_get {oid}")
         out = reply.out[0]
@@ -499,9 +770,8 @@ class IoCtx:
         }
 
     async def omap_rmkeys(self, oid: str, keys: list[str]) -> None:
-        reply = await self.client.operate(
-            self.pool_name, oid,
-            [{"op": "omap_rmkeys", "keys": list(keys)}], [],
+        reply = await self._op_w(
+            oid, [{"op": "omap_rmkeys", "keys": list(keys)}], []
         )
         if reply.result < 0:
             raise RadosError(reply.result, f"omap_rmkeys {oid}")

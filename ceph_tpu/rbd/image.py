@@ -1,0 +1,398 @@
+"""RBD image engine (reference:src/librbd/ — ImageCtx, internal.cc,
+cls_rbd header ops; see package docstring for the on-disk layout)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import secrets
+
+from ..rados.client import ENOENT, EAGAIN, IoCtx, RadosError
+
+EEXIST = 17
+EINVAL = 22
+EBUSY = 16
+
+RBD_DIRECTORY = "rbd_directory"
+HEADER_PREFIX = "rbd_header."
+DATA_PREFIX = "rbd_data."
+DEFAULT_ORDER = 22  # 4 MiB objects, the rbd default
+
+
+class RbdError(RadosError):
+    pass
+
+
+class RBD:
+    """Pool-level image operations (reference:librbd::RBD)."""
+
+    def __init__(self, io: IoCtx):
+        self.io = io
+
+    # -- directory (reference:src/cls/rbd cls_rbd dir_* methods) ----------
+    async def _dir(self) -> dict[str, bytes]:
+        try:
+            return await self.io.omap_get(RBD_DIRECTORY)
+        except RadosError as e:
+            if e.code == -ENOENT:
+                return {}
+            raise
+
+    async def list(self) -> list[str]:
+        return sorted(
+            k[len("name_"):] for k in await self._dir()
+            if k.startswith("name_")
+        )
+
+    async def create(
+        self, name: str, size: int, order: int = DEFAULT_ORDER
+    ) -> None:
+        """reference:librbd::create — claim the name atomically in the
+        directory (cls rbd.dir_add, serialized under the PG lock), then
+        write the header."""
+        if not (12 <= order <= 26):
+            raise RbdError(-EINVAL, f"order {order} out of range")
+        image_id = secrets.token_hex(8)  # process-independent, 64-bit
+        try:
+            await self.io.exec(RBD_DIRECTORY, "rbd", "dir_add",
+                               {"name": name, "id": image_id})
+        except RadosError as e:
+            raise RbdError(e.code, f"image {name!r} exists") from e
+        header = HEADER_PREFIX + image_id
+        await self.io.omap_set(header, {
+            "size": str(int(size)).encode(),
+            "order": str(order).encode(),
+            "snap_seq": b"0",
+            "snaps": b"{}",
+        })
+
+    async def remove(self, name: str) -> None:
+        """reference:librbd::remove — refuse while snapshots exist."""
+        img = await Image.open(self.io, name)
+        try:
+            if img.snaps:
+                raise RbdError(-EBUSY, "image has snapshots")
+            await img._remove_data_objects(img.size_bytes)
+            await self.io.remove(img.header)
+        finally:
+            await img.close()
+        await self.io.exec(RBD_DIRECTORY, "rbd", "dir_remove",
+                           {"name": name, "id": img.image_id})
+
+    async def rename(self, src: str, dst: str) -> None:
+        try:
+            await self.io.exec(RBD_DIRECTORY, "rbd", "dir_rename",
+                               {"src": src, "dst": dst})
+        except RadosError as e:
+            raise RbdError(e.code, f"rename {src!r} -> {dst!r}") from e
+
+
+class Image:
+    """One open image (reference:librbd::ImageCtx + Image API).
+
+    The image holds its own IoCtx so its write snap-context and read
+    snap never leak into the caller's; the header watch keeps the
+    cached metadata fresh across clients.
+    """
+
+    def __init__(self, io: IoCtx, name: str, image_id: str):
+        # private IoCtx: snap state is per-open-image
+        self.io = IoCtx(io.client, io.pool_name)
+        self.name = name
+        self.image_id = image_id
+        self.header = HEADER_PREFIX + image_id
+        self.size_bytes = 0
+        self.order = DEFAULT_ORDER
+        self.snaps: dict[str, dict] = {}   # name -> {"id", "size"}
+        self.snap_name: str | None = None  # opened-at-snap (read-only)
+        self._watch_cookie: str | None = None
+        self._closed = False
+
+    # -- lifecycle ---------------------------------------------------------
+    @classmethod
+    async def open(
+        cls, io: IoCtx, name: str, snap_name: str | None = None
+    ) -> "Image":
+        d = {}
+        try:
+            d = await io.omap_get(RBD_DIRECTORY)
+        except RadosError as e:
+            if e.code != -ENOENT:
+                raise
+        raw = d.get(f"name_{name}")
+        if raw is None:
+            raise RbdError(-ENOENT, f"no image {name!r}")
+        img = cls(io, name, raw.decode())
+        await img._refresh()
+        if snap_name is not None:
+            img.set_snap(snap_name)
+        # watch the header: other clients' resizes/snap ops invalidate us
+        # (reference:ImageCtx::register_watch)
+        img._watch_cookie = await img.io.watch(
+            img.header, img._header_notify
+        )
+        return img
+
+    async def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._watch_cookie is not None:
+            try:
+                await self.io.unwatch(self._watch_cookie)
+            except (RadosError, ConnectionError, OSError):
+                pass
+
+    async def _refresh(self) -> None:
+        try:
+            h = await self.io.omap_get(self.header)
+        except RadosError as e:
+            if e.code == -ENOENT:
+                raise RbdError(-ENOENT, f"image {self.name!r} vanished")
+            raise
+        self.size_bytes = int(h["size"])
+        self.order = int(h["order"])
+        self.snaps = json.loads(h.get("snaps", b"{}"))
+        self._apply_snapc()
+
+    def _header_notify(self, notifier: str, payload: bytes):
+        # run the refresh asynchronously; the ack must not wait on I/O
+        return self._refresh()
+
+    # -- layout ------------------------------------------------------------
+    @property
+    def object_size(self) -> int:
+        return 1 << self.order
+
+    def _data_name(self, objectno: int) -> str:
+        return f"{DATA_PREFIX}{self.image_id}.{objectno:016x}"
+
+    def _extents(
+        self, offset: int, length: int
+    ) -> list[tuple[int, int, int]]:
+        """(objectno, obj_off, len) runs covering the range."""
+        out = []
+        pos, end = offset, offset + length
+        while pos < end:
+            objectno = pos // self.object_size
+            obj_off = pos % self.object_size
+            run = min(self.object_size - obj_off, end - pos)
+            out.append((objectno, obj_off, run))
+            pos += run
+        return out
+
+    def _apply_snapc(self) -> None:
+        """Writes carry the image's live-snap context
+        (reference:ImageCtx::get_snap_context)."""
+        ids = sorted(
+            (int(s["id"]) for s in self.snaps.values()), reverse=True
+        )
+        if ids:
+            self.io.set_snapc(ids[0], ids)
+        else:
+            self.io.set_snapc(0, [])
+
+    # -- data path ---------------------------------------------------------
+    def _check_open_rw(self) -> None:
+        if self._closed:
+            raise RbdError(-EINVAL, "image is closed")
+        if self.snap_name is not None:
+            raise RbdError(-EINVAL, "image opened at a snapshot: read-only")
+
+    async def write(self, offset: int, data: bytes) -> int:
+        self._check_open_rw()
+        if offset + len(data) > self.size_bytes:
+            raise RbdError(-EINVAL, "write past end of image")
+        pos = 0
+        ops = []
+        for objectno, obj_off, run in self._extents(offset, len(data)):
+            chunk = data[pos : pos + run]
+            pos += run
+            ops.append(
+                self.io.write(self._data_name(objectno), chunk, offset=obj_off)
+            )
+        await asyncio.gather(*ops)
+        return len(data)
+
+    async def read(self, offset: int, length: int) -> bytes:
+        if self._closed:
+            raise RbdError(-EINVAL, "image is closed")
+        size = (
+            int(self.snaps[self.snap_name]["size"])
+            if self.snap_name is not None else self.size_bytes
+        )
+        end = min(offset + length, size)
+        if offset >= end:
+            return b""
+
+        async def fetch(objectno: int, obj_off: int, run: int) -> bytes:
+            try:
+                got = await self.io.read(
+                    self._data_name(objectno), obj_off, run
+                )
+            except RadosError as e:
+                if e.code != -ENOENT:
+                    raise
+                got = b""  # never-written extent reads as zeros
+            return got + b"\x00" * (run - len(got))
+
+        parts = await asyncio.gather(
+            *(fetch(o, oo, r) for o, oo, r in self._extents(offset, end - offset))
+        )
+        return b"".join(parts)
+
+    async def discard(self, offset: int, length: int) -> None:
+        """Punch a hole (reference:librbd discard -> zero/truncate/remove
+        per object)."""
+        self._check_open_rw()
+        ops = []
+        for objectno, obj_off, run in self._extents(offset, length):
+            name = self._data_name(objectno)
+            if obj_off == 0 and run == self.object_size:
+                ops.append(self._remove_quiet(name))
+            else:
+                ops.append(self._zero_quiet(name, obj_off, run))
+        await asyncio.gather(*ops)
+
+    async def _remove_quiet(self, name: str) -> None:
+        try:
+            await self.io.remove(name)
+        except RadosError as e:
+            if e.code != -ENOENT:
+                raise
+
+    async def _zero_quiet(self, name: str, off: int, ln: int) -> None:
+        try:
+            await self.io.zero(name, off, ln)
+        except RadosError as e:
+            if e.code != -ENOENT:
+                raise
+
+    # -- metadata ----------------------------------------------------------
+    async def resize(self, new_size: int) -> None:
+        """Grow or shrink (reference:librbd::resize; shrink removes the
+        now-out-of-range data objects)."""
+        self._check_open_rw()
+        old = self.size_bytes
+        if new_size < old:
+            first_dead = -(-new_size // self.object_size)
+            last = (old - 1) // self.object_size if old else -1
+            await asyncio.gather(*(
+                self._remove_quiet(self._data_name(n))
+                for n in range(first_dead, last + 1)
+            ))
+            if new_size % self.object_size:
+                # partial tail object: drop bytes past the new end
+                await self._zero_quiet(
+                    self._data_name(new_size // self.object_size),
+                    new_size % self.object_size,
+                    self.object_size - new_size % self.object_size,
+                )
+        await self._set_header({"size": str(int(new_size)).encode()})
+        self.size_bytes = int(new_size)
+
+    async def _set_header(self, kv: dict[str, bytes]) -> None:
+        await self.io.omap_set(self.header, kv)
+        try:
+            await self.io.notify(self.header, b"header-update", timeout=2.0)
+        except RadosError:
+            pass  # watchers refresh lazily on the next notify
+
+    async def stat(self) -> dict:
+        return {
+            "name": self.name, "id": self.image_id,
+            "size": self.size_bytes, "order": self.order,
+            "object_size": self.object_size,
+            "num_objs": -(-self.size_bytes // self.object_size),
+            "snaps": sorted(self.snaps),
+        }
+
+    async def _remove_data_objects(self, up_to_size: int) -> None:
+        count = -(-up_to_size // self.object_size)
+        await asyncio.gather(*(
+            self._remove_quiet(self._data_name(n)) for n in range(count)
+        ))
+
+    # -- snapshots (reference:librbd snap_create/remove/rollback) ----------
+    def set_snap(self, snap_name: str | None) -> None:
+        """Route reads to a snapshot (None = head); writes are refused
+        while a snap is set."""
+        if snap_name is not None and snap_name not in self.snaps:
+            raise RbdError(-ENOENT, f"no snap {snap_name!r}")
+        self.snap_name = snap_name
+        self.io.set_read(
+            int(self.snaps[snap_name]["id"]) if snap_name else None
+        )
+
+    async def snap_create(self, snap_name: str) -> None:
+        self._check_open_rw()
+        if snap_name in self.snaps:
+            raise RbdError(-EEXIST, f"snap {snap_name!r} exists")
+        snapid = await self.io.selfmanaged_snap_create()
+        self.snaps[snap_name] = {"id": snapid, "size": self.size_bytes}
+        self._apply_snapc()
+        await self._set_header({"snaps": json.dumps(self.snaps).encode()})
+
+    async def snap_remove(self, snap_name: str) -> None:
+        self._check_open_rw()
+        s = self.snaps.pop(snap_name, None)
+        if s is None:
+            raise RbdError(-ENOENT, f"no snap {snap_name!r}")
+        await self.io.selfmanaged_snap_remove(int(s["id"]))
+        self._apply_snapc()
+        await self._set_header({"snaps": json.dumps(self.snaps).encode()})
+
+    async def snap_rollback(self, snap_name: str) -> None:
+        """Roll every data object back to the snap (reference:librbd
+        snap_rollback -> per-object selfmanaged rollback)."""
+        self._check_open_rw()
+        s = self.snaps.get(snap_name)
+        if s is None:
+            raise RbdError(-ENOENT, f"no snap {snap_name!r}")
+        snapid, snap_size = int(s["id"]), int(s["size"])
+        max_size = max(self.size_bytes, snap_size)
+        count = -(-max_size // self.object_size)
+
+        async def roll(objectno: int) -> None:
+            name = self._data_name(objectno)
+            try:
+                await self.io.rollback(name, snapid)
+            except RadosError as e:
+                if e.code != -ENOENT:
+                    raise  # absent everywhere: object was a hole then too
+
+        await asyncio.gather(*(roll(n) for n in range(count)))
+        if snap_size != self.size_bytes:
+            await self._set_header({"size": str(snap_size).encode()})
+            self.size_bytes = snap_size
+
+    # -- exclusive lock (reference:librbd/ExclusiveLock -> cls lock) -------
+    LOCK_NAME = "rbd_lock"
+    LOCK_TAG = "internal"
+
+    async def lock_acquire(self, cookie: str = "auto") -> None:
+        try:
+            await self.io.exec(self.header, "lock", "lock", {
+                "name": self.LOCK_NAME, "type": 1,
+                "entity": self.io.client.name, "cookie": cookie,
+                "tag": self.LOCK_TAG,
+            })
+        except RadosError as e:
+            raise RbdError(e.code, "image is locked") from e
+
+    async def lock_release(self, cookie: str = "auto") -> None:
+        await self.io.exec(self.header, "lock", "unlock", {
+            "name": self.LOCK_NAME,
+            "entity": self.io.client.name, "cookie": cookie,
+        })
+
+    async def lock_owners(self) -> list[dict]:
+        info = await self.io.exec(
+            self.header, "lock", "get_info", {"name": self.LOCK_NAME}
+        )
+        return info["lockers"]
+
+    async def break_lock(self, entity: str, cookie: str = "auto") -> None:
+        await self.io.exec(self.header, "lock", "break_lock", {
+            "name": self.LOCK_NAME, "entity": entity, "cookie": cookie,
+        })

@@ -127,6 +127,45 @@ async def _cmd_stat(client, args) -> int:
     return 0
 
 
+async def _cmd_mksnap(client, args) -> int:
+    io = client.io_ctx(_need_pool(args))
+    snapid = await io.create_snap(args.snap)
+    print(f"created pool {args.pool} snap {args.snap} (id {snapid})")
+    return 0
+
+
+async def _cmd_rmsnap(client, args) -> int:
+    io = client.io_ctx(_need_pool(args))
+    await io.remove_snap(args.snap)
+    print(f"removed pool {args.pool} snap {args.snap}")
+    return 0
+
+
+async def _cmd_lssnap(client, args) -> int:
+    io = client.io_ctx(_need_pool(args))
+    snaps = await io.list_pool_snaps()
+    for s in snaps:
+        print(f"{s['snapid']}\t{s['name']}")
+    print(f"{len(snaps)} snaps")
+    return 0
+
+
+async def _cmd_rollback(client, args) -> int:
+    io = client.io_ctx(_need_pool(args))
+    await io.rollback(args.obj, args.snap)
+    print(f"rolled back {args.pool}/{args.obj} to {args.snap}")
+    return 0
+
+
+async def _cmd_listsnaps(client, args) -> int:
+    io = client.io_ctx(_need_pool(args))
+    ss = await io.list_snaps(args.obj)
+    print(f"{args.obj}: seq {ss['seq']}, head={'yes' if ss['head_exists'] else 'no'}")
+    for c in ss["clones"]:
+        print(f"  clone {c['cloneid']}: snaps {c['snaps']} size {c['size']}")
+    return 0
+
+
 async def _cmd_setxattr(client, args) -> int:
     io = client.io_ctx(_need_pool(args))
     await io.setxattr(args.obj, args.key, args.value.encode())
@@ -233,6 +272,17 @@ def main(argv=None) -> int:
     st = sub.add_parser("stat")
     st.add_argument("obj")
 
+    mks = sub.add_parser("mksnap")
+    mks.add_argument("snap")
+    rms = sub.add_parser("rmsnap")
+    rms.add_argument("snap")
+    sub.add_parser("lssnap")
+    rb = sub.add_parser("rollback")
+    rb.add_argument("obj")
+    rb.add_argument("snap")
+    lsn = sub.add_parser("listsnaps")
+    lsn.add_argument("obj")
+
     sx = sub.add_parser("setxattr")
     sx.add_argument("obj")
     sx.add_argument("key")
@@ -263,6 +313,9 @@ def main(argv=None) -> int:
         "stat": _cmd_stat,
         "setxattr": _cmd_setxattr, "getxattr": _cmd_getxattr,
         "listxattr": _cmd_listxattr, "rmxattr": _cmd_rmxattr,
+        "mksnap": _cmd_mksnap, "rmsnap": _cmd_rmsnap,
+        "lssnap": _cmd_lssnap, "rollback": _cmd_rollback,
+        "listsnaps": _cmd_listsnaps,
         "scrub": _cmd_scrub, "bench": _cmd_bench,
     }[args.cmd]
 

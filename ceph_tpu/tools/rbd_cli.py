@@ -1,0 +1,232 @@
+"""rbd: the block-image CLI (reference:src/tools/rbd/ — `rbd` command).
+
+The reference's operator surface for images:
+  rbd -m MON -p POOL create NAME --size BYTES [--order N]
+  rbd -m MON -p POOL ls
+  rbd -m MON -p POOL info NAME
+  rbd -m MON -p POOL rm NAME
+  rbd -m MON -p POOL resize NAME --size BYTES
+  rbd -m MON -p POOL snap create NAME@SNAP   (also: snap ls/rm/rollback)
+  rbd -m MON -p POOL import LOCALFILE NAME
+  rbd -m MON -p POOL export NAME LOCALFILE
+  rbd -m MON -p POOL bench NAME --io-size N --io-total N
+  rbd -m MON -p POOL lock ls NAME
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+import time
+
+from ..rados.client import RadosClient, RadosError
+from ..rbd import RBD, Image
+
+
+def _mon_arg(m: str) -> "str | list[str]":
+    return m.split(",") if "," in m else m
+
+
+def _split_snap(spec: str) -> tuple[str, str]:
+    if "@" not in spec:
+        print(f"error: need IMAGE@SNAP, got {spec!r}", file=sys.stderr)
+        raise SystemExit(2)
+    name, snap = spec.split("@", 1)
+    return name, snap
+
+
+async def _cmd_create(rbd, io, args) -> int:
+    kw = {}
+    if args.order:
+        kw["order"] = args.order
+    await rbd.create(args.image, args.size, **kw)
+    return 0
+
+
+async def _cmd_ls(rbd, io, args) -> int:
+    for name in await rbd.list():
+        print(name)
+    return 0
+
+
+async def _cmd_info(rbd, io, args) -> int:
+    img = await Image.open(io, args.image)
+    try:
+        st = await img.stat()
+    finally:
+        await img.close()
+    print(f"rbd image '{st['name']}':")
+    print(f"\tsize {st['size']} bytes in {st['num_objs']} objects")
+    print(f"\torder {st['order']} ({st['object_size']} byte objects)")
+    print(f"\tid: {st['id']}")
+    if st["snaps"]:
+        print(f"\tsnapshots: {', '.join(st['snaps'])}")
+    return 0
+
+
+async def _cmd_rm(rbd, io, args) -> int:
+    await rbd.remove(args.image)
+    return 0
+
+
+async def _cmd_resize(rbd, io, args) -> int:
+    img = await Image.open(io, args.image)
+    try:
+        await img.resize(args.size)
+    finally:
+        await img.close()
+    return 0
+
+
+async def _cmd_snap(rbd, io, args) -> int:
+    if args.snap_cmd == "ls":
+        img = await Image.open(io, args.spec)
+        try:
+            for name in sorted(img.snaps):
+                s = img.snaps[name]
+                print(f"{s['id']}\t{name}\t{s['size']}")
+        finally:
+            await img.close()
+        return 0
+    name, snap = _split_snap(args.spec)
+    img = await Image.open(io, name)
+    try:
+        if args.snap_cmd == "create":
+            await img.snap_create(snap)
+        elif args.snap_cmd == "rm":
+            await img.snap_remove(snap)
+        elif args.snap_cmd == "rollback":
+            await img.snap_rollback(snap)
+    finally:
+        await img.close()
+    return 0
+
+
+async def _cmd_import(rbd, io, args) -> int:
+    data = (
+        sys.stdin.buffer.read() if args.path == "-"
+        else open(args.path, "rb").read()
+    )
+    await rbd.create(args.image, len(data))
+    img = await Image.open(io, args.image)
+    try:
+        step = 4 << 20
+        for off in range(0, len(data), step):
+            await img.write(off, data[off : off + step])
+    finally:
+        await img.close()
+    return 0
+
+
+async def _cmd_export(rbd, io, args) -> int:
+    img = await Image.open(io, args.image, snap_name=args.snap)
+    try:
+        size = (
+            int(img.snaps[args.snap]["size"]) if args.snap
+            else img.size_bytes
+        )
+        out = (
+            sys.stdout.buffer if args.path == "-"
+            else open(args.path, "wb")
+        )
+        step = 4 << 20
+        for off in range(0, size, step):
+            out.write(await img.read(off, min(step, size - off)))
+        if out is not sys.stdout.buffer:
+            out.close()
+    finally:
+        await img.close()
+    return 0
+
+
+async def _cmd_bench(rbd, io, args) -> int:
+    img = await Image.open(io, args.image)
+    try:
+        payload = b"\xa5" * args.io_size
+        n = max(1, args.io_total // args.io_size)
+        t0 = time.monotonic()
+        for i in range(n):
+            off = (i * args.io_size) % max(
+                img.size_bytes - args.io_size, 1
+            )
+            await img.write(off, payload)
+        dt = time.monotonic() - t0
+        mb = n * args.io_size / 1e6
+        print(f"elapsed {dt:.2f}s, {n} ops, {mb / dt:.2f} MB/s")
+    finally:
+        await img.close()
+    return 0
+
+
+async def _cmd_lock(rbd, io, args) -> int:
+    img = await Image.open(io, args.image)
+    try:
+        if args.lock_cmd == "ls":
+            for owner in await img.lock_owners():
+                print(f"{owner['entity']}\t{owner['cookie']}")
+    finally:
+        await img.close()
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="rbd", description=__doc__)
+    p.add_argument("-m", "--mon", required=True)
+    p.add_argument("-p", "--pool", required=True)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    c = sub.add_parser("create")
+    c.add_argument("image")
+    c.add_argument("--size", type=int, required=True)
+    c.add_argument("--order", type=int, default=None)
+    sub.add_parser("ls")
+    for verb in ("info", "rm"):
+        v = sub.add_parser(verb)
+        v.add_argument("image")
+    r = sub.add_parser("resize")
+    r.add_argument("image")
+    r.add_argument("--size", type=int, required=True)
+    s = sub.add_parser("snap")
+    s.add_argument("snap_cmd", choices=["create", "ls", "rm", "rollback"])
+    s.add_argument("spec", help="IMAGE@SNAP (ls: IMAGE)")
+    imp = sub.add_parser("import")
+    imp.add_argument("path")
+    imp.add_argument("image")
+    exp = sub.add_parser("export")
+    exp.add_argument("image")
+    exp.add_argument("path")
+    exp.add_argument("--snap", default=None)
+    b = sub.add_parser("bench")
+    b.add_argument("image")
+    b.add_argument("--io-size", type=int, default=65536)
+    b.add_argument("--io-total", type=int, default=4 << 20)
+    lk = sub.add_parser("lock")
+    lk.add_argument("lock_cmd", choices=["ls"])
+    lk.add_argument("image")
+    args = p.parse_args(argv)
+
+    fn = {
+        "create": _cmd_create, "ls": _cmd_ls, "info": _cmd_info,
+        "rm": _cmd_rm, "resize": _cmd_resize, "snap": _cmd_snap,
+        "import": _cmd_import, "export": _cmd_export,
+        "bench": _cmd_bench, "lock": _cmd_lock,
+    }[args.cmd]
+
+    async def run() -> int:
+        client = await RadosClient(_mon_arg(args.mon)).connect()
+        try:
+            io = client.io_ctx(args.pool)
+            rbd = RBD(io)
+            return await fn(rbd, io, args)
+        except RadosError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        finally:
+            await client.shutdown()
+
+    return asyncio.run(run())
+
+
+if __name__ == "__main__":
+    sys.exit(main())

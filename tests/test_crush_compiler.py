@@ -156,6 +156,12 @@ class TestCompile:
         with pytest.raises(CrushCompileError):
             compile_crushmap(bad)
 
+    def test_truncated_map_fails_cleanly(self):
+        whole = REFERENCE_STYLE_MAP
+        for cut in (len(whole) // 3, len(whole) // 2, len(whole) - 40):
+            with pytest.raises(CrushCompileError):
+                compile_crushmap(whole[:cut])
+
 
 class TestRoundTrip:
     def _roundtrip(self, m):

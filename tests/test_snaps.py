@@ -1,0 +1,372 @@
+"""Snapshot tests: SnapSet semantics + pool/self-managed snaps e2e.
+
+Reference intents: clone-on-first-write-after-snap
+(reference:src/osd/PrimaryLogPG.cc make_writeable), snap reads through
+the SnapSet (find_object_context), rollback (_rollback_to), snapdir
+for deleted heads with live clones (get_snapdir), and the snap
+trimmer deleting clones whose snaps were all removed.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.osd.snaps import (
+    Clone,
+    SnapContext,
+    SnapSet,
+    clone_name,
+    is_clone_name,
+    snapdir_name,
+)
+from ceph_tpu.rados import MiniCluster, RadosError
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+# -- SnapSet unit semantics --------------------------------------------------
+
+
+class TestSnapSet:
+    def test_clone_on_first_write_after_snap(self):
+        ss = SnapSet()
+        assert not ss.needs_clone(SnapContext(0, []))
+        snapc = SnapContext(1, [1])
+        assert ss.needs_clone(snapc)
+        c = ss.make_clone(snapc, size=100)
+        assert c.cloneid == 1 and c.snaps == [1]
+        # second write under the same snapc: no new clone
+        assert not ss.needs_clone(snapc)
+
+    def test_clone_covers_all_new_snaps(self):
+        ss = SnapSet()
+        ss.make_clone(SnapContext(1, [1]), 10)
+        # two snaps taken since, one write: ONE clone serves both
+        c = ss.make_clone(SnapContext(3, [3, 2, 1]), 20)
+        assert c.cloneid == 3 and c.snaps == [2, 3]
+
+    def test_resolution(self):
+        ss = SnapSet()
+        ss.make_clone(SnapContext(1, [1]), 10)   # clone 1 serves snap 1
+        ss.make_clone(SnapContext(3, [3, 2, 1]), 20)  # clone 3 serves 2,3
+        assert ss.resolve(1) == 1
+        assert ss.resolve(2) == 3
+        assert ss.resolve(3) == 3
+        assert ss.resolve(4) == SnapSet.HEAD
+        ss2 = SnapSet()
+        ss2.make_clone(SnapContext(3, [3]), 5)  # created before snap 3 only
+        assert ss2.resolve(2) == SnapSet.MISSING  # no state for snap 2
+
+    def test_trim(self):
+        ss = SnapSet()
+        ss.make_clone(SnapContext(1, [1]), 10)
+        ss.make_clone(SnapContext(3, [3, 2]), 20)
+        assert ss.trim({2}) == []          # clone 3 still serves snap 3
+        assert ss.trim({3}) == [3]         # now it's dead
+        assert ss.trim({1}) == [1]
+        assert ss.clones == []
+
+    def test_json_roundtrip(self):
+        ss = SnapSet()
+        ss.make_clone(SnapContext(2, [2, 1]), 42)
+        ss2 = SnapSet.from_json(ss.to_json())
+        assert ss2.seq == 2
+        assert ss2.clones[0].cloneid == 2
+        assert ss2.clones[0].snaps == [1, 2]
+        assert SnapSet.from_json(None).empty()
+
+    def test_names(self):
+        assert is_clone_name(clone_name("obj", 3))
+        assert is_clone_name(snapdir_name("obj"))
+        assert not is_clone_name("obj@3")  # user names never collide
+
+
+# -- e2e: pool snapshots -----------------------------------------------------
+
+
+V1 = b"version-one " * 300
+V2 = b"VERSION-TWO " * 400
+V3 = b"v3!" * 100
+
+
+def _snap_workout(pool_type: str):
+    """The shared pool-snapshot scenario, run on both backends."""
+
+    async def main():
+        async with MiniCluster(n_osds=4) as cluster:
+            cl = await cluster.client()
+            if pool_type == "erasure":
+                await cl.create_pool("p", "erasure")
+            else:
+                await cl.create_pool("p", "replicated", size=3)
+            io = cl.io_ctx("p")
+
+            await io.write_full("obj", V1)
+            s1 = await io.create_snap("s1")
+            # read at snap before any post-snap write: served by head
+            io.set_read(s1)
+            assert await io.read("obj") == V1
+            io.set_read(None)
+
+            await io.write_full("obj", V2)     # first write after snap: clone
+            assert await io.read("obj") == V2
+            io.set_read(s1)
+            assert await io.read("obj") == V1  # the clone
+            assert await io.stat("obj") == len(V1)
+            io.set_read(None)
+
+            ss = await io.list_snaps("obj")
+            assert ss["seq"] == s1
+            assert [c["cloneid"] for c in ss["clones"]] == [s1]
+            assert ss["clones"][0]["size"] == len(V1)
+
+            # second snap + partial overwrite
+            s2 = await io.create_snap("s2")
+            await io.write("obj", b"XX", offset=0)
+            io.set_read(s2)
+            assert await io.read("obj") == V2
+            io.set_read(s1)
+            assert await io.read("obj") == V1
+            io.set_read(None)
+            head = await io.read("obj")
+            assert head[:2] == b"XX" and head[2:] == V2[2:]
+
+            # rollback head to s1
+            await io.rollback("obj", "s1")
+            assert await io.read("obj") == V1
+            io.set_read(s2)
+            assert await io.read("obj") == V2  # clones unaffected
+            io.set_read(None)
+
+            # delete with live clones: snaps must stay readable (snapdir)
+            await io.remove("obj")
+            with pytest.raises(RadosError):
+                await io.read("obj")
+            io.set_read(s1)
+            assert await io.read("obj") == V1
+            io.set_read(None)
+
+            # recreate the head; old snaps still resolve
+            await io.write_full("obj", V3)
+            assert await io.read("obj") == V3
+            io.set_read(s2)
+            assert await io.read("obj") == V2
+            io.set_read(None)
+
+    run(main())
+
+
+def test_pool_snaps_replicated():
+    _snap_workout("replicated")
+
+
+def test_pool_snaps_erasure():
+    _snap_workout("erasure")
+
+
+def _trim_workout(pool_type: str):
+    async def main():
+        async with MiniCluster(n_osds=4) as cluster:
+            cl = await cluster.client()
+            if pool_type == "erasure":
+                await cl.create_pool("p", "erasure")
+            else:
+                await cl.create_pool("p", "replicated", size=3)
+            io = cl.io_ctx("p")
+            await io.write_full("obj", V1)
+            s1 = await io.create_snap("s1")
+            await io.write_full("obj", V2)
+            io.set_read(s1)
+            assert await io.read("obj") == V1
+            io.set_read(None)
+
+            await io.remove_snap("s1")
+            # reading a removed snap eventually fails and the clone is
+            # trimmed from the SnapSet
+            for _ in range(100):
+                ss = await io.list_snaps("obj")
+                if not ss["clones"]:
+                    break
+                await asyncio.sleep(0.05)
+            assert ss["clones"] == []
+            io.set_read(s1)
+            with pytest.raises(RadosError):
+                await io.read("obj")
+            io.set_read(None)
+            assert await io.read("obj") == V2  # head untouched
+
+    run(main())
+
+
+def test_snap_trim_replicated():
+    _trim_workout("replicated")
+
+
+def test_snap_trim_erasure():
+    _trim_workout("erasure")
+
+
+# -- e2e: self-managed snapshots (the librbd mode) ---------------------------
+
+
+def test_selfmanaged_snaps():
+    async def main():
+        async with MiniCluster(n_osds=3) as cluster:
+            cl = await cluster.client()
+            await cl.create_pool("p", "replicated", size=3)
+            io = cl.io_ctx("p")
+            await io.write_full("img", V1)
+            snap = await io.selfmanaged_snap_create()
+            io.set_snapc(snap, [snap])
+            await io.write_full("img", V2)
+            io.set_read(snap)
+            assert await io.read("img") == V1
+            io.set_read(None)
+            assert await io.read("img") == V2
+            # a second self-managed snap
+            snap2 = await io.selfmanaged_snap_create()
+            io.set_snapc(snap2, [snap2, snap])
+            await io.write_full("img", V3)
+            io.set_read(snap2)
+            assert await io.read("img") == V2
+            io.set_read(snap)
+            assert await io.read("img") == V1
+
+    run(main())
+
+
+# -- metadata is snapshotted too (review r2 findings) ------------------------
+
+
+def _xattr_snap_workout(pool_type: str):
+    async def main():
+        async with MiniCluster(n_osds=4) as cluster:
+            cl = await cluster.client()
+            if pool_type == "erasure":
+                await cl.create_pool("p", "erasure")
+            else:
+                await cl.create_pool("p", "replicated", size=3)
+            io = cl.io_ctx("p")
+            await io.write_full("obj", V1)
+            await io.setxattr("obj", "k", b"old")
+            s1 = await io.create_snap("s1")
+            # xattr-only mutation after the snap MUST clone
+            await io.setxattr("obj", "k", b"new")
+            io.set_read(s1)
+            assert await io.getxattr("obj", "k") == b"old"
+            assert await io.read("obj") == V1
+            io.set_read(None)
+            assert await io.getxattr("obj", "k") == b"new"
+            # rollback restores data AND xattrs
+            await io.setxattr("obj", "extra", b"headonly")
+            await io.rollback("obj", "s1")
+            assert await io.getxattr("obj", "k") == b"old"
+            with pytest.raises(RadosError):
+                await io.getxattr("obj", "extra")
+
+    run(main())
+
+
+def test_xattr_snapshots_replicated():
+    _xattr_snap_workout("replicated")
+
+
+def test_xattr_snapshots_erasure():
+    _xattr_snap_workout("erasure")
+
+
+def test_rollback_to_missing_keeps_clones_replicated():
+    """Rollback to a snap where the object was absent deletes the head;
+    later snaps' clones must stay reachable through the snapdir."""
+
+    async def main():
+        async with MiniCluster(n_osds=3) as cluster:
+            cl = await cluster.client()
+            await cl.create_pool("p", "replicated", size=3)
+            io = cl.io_ctx("p")
+            s1 = await io.create_snap("s1")   # taken BEFORE the object
+            await io.write_full("obj", V1)
+            s2 = await io.create_snap("s2")
+            await io.write_full("obj", V2)    # clone for s2
+            await io.rollback("obj", "s1")    # absent then -> head deleted
+            with pytest.raises(RadosError):
+                await io.read("obj")
+            io.set_read(s2)
+            assert await io.read("obj") == V1  # clone survives via snapdir
+
+    run(main())
+
+
+def test_concurrent_writes_after_snap_keep_clone_intact():
+    """Two racing writes after a snap: whoever clones first wins; the
+    clone must hold PRE-snap bytes, never a racer's post-snap data
+    (planning and commit are atomic under the PG lock)."""
+
+    async def main():
+        async with MiniCluster(n_osds=3) as cluster:
+            cl = await cluster.client()
+            await cl.create_pool("p", "replicated", size=3)
+            io = cl.io_ctx("p")
+            await io.write_full("obj", V1)
+            s1 = await io.create_snap("s1")
+            await asyncio.gather(
+                io.write_full("obj", V2),
+                io.write_full("obj", V3),
+                io.write("obj", b"Z", offset=0),
+            )
+            io.set_read(s1)
+            assert await io.read("obj") == V1
+            ss = await io.list_snaps("obj")
+            assert [c["cloneid"] for c in ss["clones"]] == [s1]
+
+    run(main())
+
+
+def test_ec_setxattr_recreate_adopts_snapdir():
+    """Recreating a deleted EC object via setxattr must pick the parked
+    SnapSet back up so old snaps stay resolvable."""
+
+    async def main():
+        async with MiniCluster(n_osds=4) as cluster:
+            cl = await cluster.client()
+            await cl.create_pool("p", "erasure")
+            io = cl.io_ctx("p")
+            await io.write_full("obj", V1)
+            s1 = await io.create_snap("s1")
+            await io.write_full("obj", V2)
+            await io.remove("obj")
+            await io.setxattr("obj", "k", b"reborn")  # recreates the head
+            io.set_read(s1)
+            assert await io.read("obj") == V1
+            io.set_read(None)
+            ss = await io.list_snaps("obj")
+            assert [c["cloneid"] for c in ss["clones"]] == [s1]
+
+    run(main())
+
+
+# -- degraded snaps: clones recover like any object --------------------------
+
+
+def test_snap_read_survives_osd_kill_erasure():
+    async def main():
+        async with MiniCluster(n_osds=4) as cluster:
+            cl = await cluster.client()
+            await cl.create_pool("p", "erasure")  # default RS(2,1)
+            io = cl.io_ctx("p")
+            await io.write_full("obj", V1)
+            s1 = await io.create_snap("s1")
+            await io.write_full("obj", V2)
+            pool = cl.osdmap.lookup_pool("p")
+            _pg, acting, primary = cl.osdmap.object_to_acting("obj", pool.id)
+            victim = next(o for o in acting if o != primary)
+            await cluster.kill_osd(victim)
+            await cluster.wait_for_osd_down(victim)
+            io.set_read(s1)
+            assert await io.read("obj") == V1  # reconstructed clone
+            io.set_read(None)
+            assert await io.read("obj") == V2
+
+    run(main())

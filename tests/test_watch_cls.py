@@ -1,0 +1,305 @@
+"""Watch/notify + object-class (cls) tests.
+
+Reference intents: notify fan-out with ack gathering
+(reference:src/osd/Watch.cc), linger re-registration, and in-OSD
+stored procedures executing atomically with the op's transaction
+(reference:src/osd/ClassHandler.cc, src/cls/lock, src/cls/refcount).
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.rados import MiniCluster, RadosError
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+# -- object classes ----------------------------------------------------------
+
+
+class TestClsLock:
+    def test_exclusive_lock_lifecycle(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                cl = await cluster.client()
+                await cl.create_pool("p", "replicated", size=3)
+                io = cl.io_ctx("p")
+                await io.write_full("obj", b"x")
+                await io.exec("obj", "lock", "lock",
+                              {"name": "L", "entity": "a", "cookie": "1"})
+                # the same owner may re-acquire
+                await io.exec("obj", "lock", "lock",
+                              {"name": "L", "entity": "a", "cookie": "1"})
+                # another owner is rejected
+                with pytest.raises(RadosError):
+                    await io.exec("obj", "lock", "lock",
+                                  {"name": "L", "entity": "b", "cookie": "2"})
+                info = await io.exec("obj", "lock", "get_info", {"name": "L"})
+                assert info["lockers"][0]["entity"] == "a"
+                await io.exec("obj", "lock", "unlock",
+                              {"name": "L", "entity": "a", "cookie": "1"})
+                # free now
+                await io.exec("obj", "lock", "lock",
+                              {"name": "L", "entity": "b", "cookie": "2"})
+
+        run(main())
+
+    def test_shared_locks_and_break(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                cl = await cluster.client()
+                await cl.create_pool("p", "replicated", size=3)
+                io = cl.io_ctx("p")
+                await io.write_full("obj", b"x")
+                for ent in ("a", "b"):
+                    await io.exec("obj", "lock", "lock",
+                                  {"name": "S", "type": 2, "entity": ent,
+                                   "cookie": "c"})
+                info = await io.exec("obj", "lock", "get_info", {"name": "S"})
+                assert len(info["lockers"]) == 2
+                # exclusive blocked while shared held
+                with pytest.raises(RadosError):
+                    await io.exec("obj", "lock", "lock",
+                                  {"name": "S", "type": 1, "entity": "c",
+                                   "cookie": "z"})
+                # fence a dead owner
+                await io.exec("obj", "lock", "break_lock",
+                              {"name": "S", "entity": "a", "cookie": "c"})
+                info = await io.exec("obj", "lock", "get_info", {"name": "S"})
+                assert len(info["lockers"]) == 1
+                names = await io.exec("obj", "lock", "list_locks", {})
+                assert names["names"] == ["S"]
+
+        run(main())
+
+    def test_lock_race_one_winner(self):
+        """Two clients race an exclusive lock: exactly one wins (the
+        cls call is atomic under the PG lock)."""
+
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                cl1 = await cluster.client()
+                cl2 = await cluster.client()
+                await cl1.create_pool("p", "replicated", size=3)
+                await cl2.wait_for_pool("p")
+                io1, io2 = cl1.io_ctx("p"), cl2.io_ctx("p")
+                await io1.write_full("obj", b"x")
+
+                async def grab(io, ent):
+                    try:
+                        await io.exec("obj", "lock", "lock",
+                                      {"name": "L", "entity": ent,
+                                       "cookie": "c"})
+                        return True
+                    except RadosError:
+                        return False
+
+                results = await asyncio.gather(
+                    *[grab(io, e) for io, e in
+                      [(io1, "a"), (io2, "b")] * 4]
+                )
+                # first winner holds it; every later distinct owner loses
+                assert results.count(True) >= 1
+                info = await io1.exec("obj", "lock", "get_info",
+                                      {"name": "L"})
+                assert len(info["lockers"]) == 1
+
+        run(main())
+
+    def test_lock_expiry(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                cl = await cluster.client()
+                await cl.create_pool("p", "replicated", size=3)
+                io = cl.io_ctx("p")
+                await io.write_full("obj", b"x")
+                await io.exec("obj", "lock", "lock",
+                              {"name": "L", "entity": "a", "cookie": "1",
+                               "duration": 0.05})
+                await asyncio.sleep(0.1)
+                # expired: another owner may take it
+                await io.exec("obj", "lock", "lock",
+                              {"name": "L", "entity": "b", "cookie": "2"})
+
+        run(main())
+
+
+class TestClsRefcount:
+    def test_get_put(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                cl = await cluster.client()
+                await cl.create_pool("p", "replicated", size=3)
+                io = cl.io_ctx("p")
+                await io.write_full("obj", b"shared")
+                assert (await io.exec("obj", "refcount", "get",
+                                      {"tag": "t1"}))["count"] == 1
+                assert (await io.exec("obj", "refcount", "get",
+                                      {"tag": "t2"}))["count"] == 2
+                r = await io.exec("obj", "refcount", "put", {"tag": "t1"})
+                assert r["count"] == 1 and not r["last"]
+                r = await io.exec("obj", "refcount", "put", {"tag": "t2"})
+                assert r["last"]
+                refs = await io.exec("obj", "refcount", "read", {})
+                assert refs["refs"] == []
+
+        run(main())
+
+
+class TestClsErrors:
+    def test_unknown_class_and_method(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                cl = await cluster.client()
+                await cl.create_pool("p", "replicated", size=3)
+                io = cl.io_ctx("p")
+                await io.write_full("obj", b"x")
+                with pytest.raises(RadosError):
+                    await io.exec("obj", "nope", "m", {})
+                with pytest.raises(RadosError):
+                    await io.exec("obj", "lock", "nope", {})
+
+        run(main())
+
+    def test_cls_rejected_on_ec_pool(self):
+        async def main():
+            async with MiniCluster(n_osds=4) as cluster:
+                cl = await cluster.client()
+                await cl.create_pool("ec", "erasure")
+                io = cl.io_ctx("ec")
+                await io.write_full("obj", b"x" * 100)
+                with pytest.raises(RadosError):
+                    await io.exec("obj", "lock", "lock",
+                                  {"name": "L", "entity": "a", "cookie": "1"})
+
+        run(main())
+
+    def test_cls_write_clones_after_snap(self):
+        """A cls mutation is a mutation: the first one after a snap must
+        clone, so snap reads see pre-snap cls state."""
+
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                cl = await cluster.client()
+                await cl.create_pool("p", "replicated", size=3)
+                io = cl.io_ctx("p")
+                await io.write_full("obj", b"data-v1")
+                await io.exec("obj", "refcount", "get", {"tag": "t1"})
+                s1 = await io.create_snap("s1")
+                await io.exec("obj", "refcount", "get", {"tag": "t2"})
+                ss = await io.list_snaps("obj")
+                assert [c["cloneid"] for c in ss["clones"]] == [s1]
+                io.set_read(s1)
+                assert await io.read("obj") == b"data-v1"
+                io.set_read(None)
+                refs = await io.exec("obj", "refcount", "read", {})
+                assert refs["refs"] == ["t1", "t2"]
+
+        run(main())
+
+    def test_cls_write_replicates(self):
+        """cls state written via the txn reaches the replicas (it rides
+        the normal rep-op fan-out)."""
+
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                cl = await cluster.client()
+                await cl.create_pool("p", "replicated", size=3)
+                io = cl.io_ctx("p")
+                await io.write_full("obj", b"x")
+                await io.exec("obj", "lock", "lock",
+                              {"name": "L", "entity": "a", "cookie": "1"})
+                from ceph_tpu.store import CollectionId, ObjectId
+
+                pool = cl.osdmap.lookup_pool("p")
+                pg, acting, _p = cl.osdmap.object_to_acting("obj", pool.id)
+                cid = CollectionId(str(pg))
+                for osd_id in acting:
+                    store = cluster.osds[osd_id].store
+                    raw = store.getattr(cid, ObjectId("obj"), "c_lock.L")
+                    assert b"lockers" in raw
+
+        run(main())
+
+
+# -- watch / notify ----------------------------------------------------------
+
+
+class TestWatchNotify:
+    def test_notify_reaches_watchers(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                cl1 = await cluster.client()
+                cl2 = await cluster.client()
+                cl3 = await cluster.client()
+                await cl1.create_pool("p", "replicated", size=3)
+                for c in (cl2, cl3):
+                    await c.wait_for_pool("p")
+                io1, io2, io3 = (c.io_ctx("p") for c in (cl1, cl2, cl3))
+                await io1.write_full("obj", b"x")
+                got1, got2 = [], []
+                c1 = await io1.watch("obj", lambda n, p: got1.append(p))
+                c2 = await io2.watch("obj", lambda n, p: got2.append(p))
+                res = await io3.notify("obj", b"hello")
+                assert sorted(res["acks"]) == sorted([c1, c2])
+                assert res["missed"] == []
+                assert got1 == [b"hello"] and got2 == [b"hello"]
+                # unwatch stops delivery
+                await io2.unwatch(c2)
+                res = await io3.notify("obj", b"again")
+                assert list(res["acks"]) == [c1]
+                assert got2 == [b"hello"]
+
+        run(main())
+
+    def test_watch_missing_object_fails(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                cl = await cluster.client()
+                await cl.create_pool("p", "replicated", size=3)
+                io = cl.io_ctx("p")
+                with pytest.raises(RadosError):
+                    await io.watch("ghost", lambda n, p: None)
+
+        run(main())
+
+    def test_dead_watcher_does_not_hang_notify(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                cl1 = await cluster.client()
+                cl2 = await cluster.client()
+                await cl1.create_pool("p", "replicated", size=3)
+                await cl2.wait_for_pool("p")
+                io1, io2 = cl1.io_ctx("p"), cl2.io_ctx("p")
+                await io1.write_full("obj", b"x")
+                await io2.watch("obj", lambda n, p: None)
+                await cl2.shutdown()  # watcher dies without unwatch
+                await asyncio.sleep(0.1)
+                res = await io1.notify("obj", b"anyone?", timeout=2.0)
+                # the dead watcher was dropped on connection reset
+                assert res["acks"] == {} and res["missed"] == []
+
+        run(main())
+
+    def test_async_callback_and_ec_pool(self):
+        async def main():
+            async with MiniCluster(n_osds=4) as cluster:
+                cl = await cluster.client()
+                await cl.create_pool("ec", "erasure")
+                io = cl.io_ctx("ec")
+                await io.write_full("obj", b"x" * 100)
+                got = []
+
+                async def cb(notifier, payload):
+                    await asyncio.sleep(0.01)
+                    got.append(payload)
+
+                await io.watch("obj", cb)
+                res = await io.notify("obj", b"ec-notify")
+                assert len(res["acks"]) == 1
+                assert got == [b"ec-notify"]
+
+        run(main())

@@ -70,6 +70,22 @@ class TestHeartbeatMap:
         assert d["workers"][0]["idle"] is False
         assert d["workers"][0]["overdue"] is False
 
+    def test_zero_grace_means_disabled_not_instant(self):
+        """osd_op_thread_timeout=0 must disable the watchdog, not turn
+        every in-flight op into an instant deadline miss."""
+
+        async def main():
+            from ceph_tpu.common import Config
+            from ceph_tpu.osd.daemon import OSD
+
+            cfg = Config(overrides={"osd_op_thread_timeout": 0.0})
+            osd = OSD(0, "127.0.0.1:1", config=cfg)
+            osd._inflight[1] = {"_t0": time.monotonic() - 100.0}
+            osd._refresh_op_handle()
+            assert osd.hb_map.is_healthy()  # no deadline at all
+
+        asyncio.run(main())
+
     def test_suicide_aborts_daemon_without_heartbeat_loop(self):
         """The watchdog loop is independent of peer pings (which default
         off): a wedged op past the suicide timeout takes the daemon down
