@@ -88,6 +88,7 @@ def lock(ctx: MethodContext, input: dict) -> dict:
         "expires": _now() + duration if duration else 0,
     }
     ctx.set_json(_key(name), info)
+    _index_update(ctx, name, held=True)
     return {}
 
 
@@ -101,6 +102,7 @@ def unlock(ctx: MethodContext, input: dict) -> dict:
     del info["lockers"][owner]
     if not info["lockers"]:
         info["type"] = LOCK_NONE
+        _index_update(ctx, name, held=False)
     ctx.set_json(_key(name), info)
     return {}
 
@@ -116,6 +118,7 @@ def break_lock(ctx: MethodContext, input: dict) -> dict:
     del info["lockers"][victim]
     if not info["lockers"]:
         info["type"] = LOCK_NONE
+        _index_update(ctx, name, held=False)
     ctx.set_json(_key(name), info)
     return {}
 
@@ -137,28 +140,21 @@ def get_info(ctx: MethodContext, input: dict) -> dict:
     }
 
 
+def _index_update(ctx: MethodContext, name: str, held: bool) -> None:
+    """Lock names live in xattr keys; the context exposes only
+    get-by-key, so a name index is stored alongside (the reference
+    iterates the attr map instead).  Released names are pruned."""
+    idx = ctx.get_json(_PREFIX + "_index") or {"names": []}
+    names = set(idx["names"])
+    want = (names | {name}) if held else (names - {name})
+    if want != names:
+        ctx.set_json(_PREFIX + "_index", {"names": sorted(want)})
+
+
 @cls.method("list_locks", CLS_METHOD_RD)
 def list_locks(ctx: MethodContext, input: dict) -> dict:
-    # lock names live in xattr keys; the context exposes only get-by-key,
-    # so the list is stored alongside (reference iterates the attr map)
     names = []
     idx = ctx.get_json(_PREFIX + "_index")
     if idx:
         names = [n for n in idx.get("names", []) if _load(ctx, n)["lockers"]]
     return {"names": names}
-
-
-# keep the index current on lock: wrap the raw method
-_raw_lock = cls.methods["lock"].fn
-
-
-def _lock_with_index(ctx: MethodContext, input: dict) -> dict:
-    out = _raw_lock(ctx, input)
-    idx = ctx.get_json(_PREFIX + "_index") or {"names": []}
-    if input["name"] not in idx["names"]:
-        idx["names"].append(input["name"])
-        ctx.set_json(_PREFIX + "_index", idx)
-    return out
-
-
-cls.methods["lock"].fn = _lock_with_index

@@ -90,6 +90,11 @@ OPTIONS: dict[str, Option] = _opts(
     Option("wal_checkpoint_bytes", int, 64 << 20,
            "journal size triggering a WalStore checkpoint"),
     Option("wal_sync", str, "fsync", "journal durability: fsync|flush|none"),
+    # mgr
+    Option("mgr_beacon_interval", float, 0.5,
+           "mgr -> mon registration beacon period (s)"),
+    Option("osd_mgr_report_interval", float, 1.0,
+           "osd -> mgr MPGStats period (s); 0 disables"),
     # mon
     Option("mon_failure_min_reporters", int, 1,
            "distinct reporters before an osd is marked down"),

@@ -1,0 +1,25 @@
+"""CephFS: a journaled metadata server + POSIX-ish client
+(reference:src/mds/ + src/client/).
+
+The reference MDS keeps the namespace in RADOS (directories as omap
+objects in a metadata pool, file data striped into a data pool), logs
+every metadata mutation to a journal in RADOS first, and replays that
+journal on restart/failover — the MDS daemon itself is stateless
+modulo cache.  Clients do metadata ops through the MDS and file I/O
+DIRECTLY against the data pool (the MDS is not on the data path).
+
+Same architecture here:
+
+- pool ``.cephfs.meta``: ``dir.<ino>`` omap objects (entry name ->
+  embedded inode json, the reference's primary-dentry embedding),
+  ``mds_journal`` omap (seq -> event), ``mds_meta`` omap (ino
+  allocator, journal trim point)
+- pool ``.cephfs.data``: file content as striped ``data.<ino>``
+- active/standby MDS via the mon's beacon machinery (MDSMonitor
+  analog); a standby replays the RADOS journal and takes over
+"""
+
+from .daemon import MDSDaemon  # noqa: F401
+from .fsclient import CephFSClient, FSError  # noqa: F401
+
+__all__ = ["MDSDaemon", "CephFSClient", "FSError"]

@@ -1,0 +1,238 @@
+"""The mgr daemon: beacon, stats ingest, module host
+(reference:src/mgr/Mgr.cc, MgrStandby.cc, DaemonServer.cc)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any
+
+from ..msg import AsyncMessenger, Connection, Dispatcher, messages
+from ..msg.message import Message
+from ..osd.osdmap import OSDMap
+
+logger = logging.getLogger("ceph_tpu.mgr")
+
+EINVAL = 22
+
+
+class MgrModule:
+    """One hosted module (the MgrPyModule analog,
+    reference:src/mgr/MgrPyModule.cc): ``COMMANDS`` maps command
+    prefixes to handler names; handlers see the mgr's aggregated
+    state."""
+
+    NAME = ""
+    COMMANDS: dict[str, str] = {}
+
+    def handle_command(
+        self, mgr: "MgrDaemon", cmd: dict
+    ) -> tuple[int, str, Any]:
+        handler = getattr(self, self.COMMANDS[cmd["prefix"]])
+        return handler(mgr, cmd)
+
+
+class MgrDaemon(Dispatcher):
+    """Active-or-standby manager.  Beacons keep it registered with the
+    mon; the map says which mgr is active, and OSDs report stats to
+    that one (reference:src/mgr/MgrStandby.cc)."""
+
+    def __init__(self, name: str, mon_addr: "str | list[str]",
+                 config=None, modules: list[MgrModule] | None = None):
+        from ..common import Config, PerfCountersCollection
+
+        self.config = config or Config()
+        self.name = name
+        self.mon_addr = mon_addr
+        self.messenger = AsyncMessenger(name, self)
+        self.messenger.apply_config(self.config)
+        self.osdmap: OSDMap | None = None
+        self.addr = ""
+        self.active = False
+        # per-osd last report: {osd: {"pgs", "perf", "store", "ts", "epoch"}}
+        self.osd_stats: dict[int, dict] = {}
+        self._prev_perf: dict[int, tuple[float, dict]] = {}  # io-rate basis
+        self.io_rates: dict[int, dict[str, float]] = {}
+        self.perf = PerfCountersCollection()
+        pm = self.perf.create("mgr")
+        pm.add_counter("stats_received", "MPGStats ingested")
+        pm.add_counter("commands", "module commands served")
+        from .modules import DfModule, PGDumpModule, PrometheusModule, StatusModule
+
+        self.modules: list[MgrModule] = modules or [
+            StatusModule(), DfModule(), PGDumpModule(), PrometheusModule()
+        ]
+        self._routes: dict[str, MgrModule] = {}
+        for mod in self.modules:
+            for prefix in mod.COMMANDS:
+                self._routes[prefix] = mod
+        self._mon_conn: Connection | None = None
+        self._redirect_addr: str | None = None  # leader hint from a peon
+        self._beacon_task: asyncio.Task | None = None
+        self._stopping = False
+
+    # -- lifecycle -----------------------------------------------------------
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        self.addr = await self.messenger.bind(host, port)
+        await self._connect_mon()
+        self._beacon_task = asyncio.ensure_future(self._beacon_loop())
+        return self.addr
+
+    async def stop(self) -> None:
+        self._stopping = True
+        if self._beacon_task:
+            self._beacon_task.cancel()
+        await self.messenger.shutdown()
+
+    @property
+    def _mon_addrs(self) -> list[str]:
+        if isinstance(self.mon_addr, str):
+            return [self.mon_addr]
+        return list(self.mon_addr)
+
+    async def _connect_mon(self) -> Connection:
+        last: Exception | None = None
+        addrs = self._mon_addrs
+        if self._redirect_addr:
+            addrs = [self._redirect_addr, *addrs]
+            self._redirect_addr = None
+        for addr in addrs:
+            try:
+                conn = await self.messenger.connect(addr, "mon")
+                conn.send(messages.MMonGetMap(have=0))
+                self._mon_conn = conn
+                return conn
+            except (ConnectionError, OSError) as e:
+                last = e
+        raise ConnectionError(f"no mon reachable: {last}")
+
+    async def _beacon_loop(self) -> None:
+        """reference:MgrStandby::send_beacon — stay registered, learn
+        whether we are the active mgr."""
+        interval = self.config.mgr_beacon_interval
+        tid = 0
+        try:
+            while not self._stopping:
+                tid += 1
+                try:
+                    conn = self._mon_conn or await self._connect_mon()
+                    conn.send(messages.MMonCommand(
+                        tid=tid,
+                        cmd={"prefix": "mgr beacon", "name": self.name,
+                             "addr": self.addr},
+                    ))
+                except (ConnectionError, OSError):
+                    self._mon_conn = None
+                await asyncio.sleep(interval)
+        except asyncio.CancelledError:
+            pass
+
+    # -- dispatch ------------------------------------------------------------
+    async def ms_dispatch(self, conn: Connection, msg: Message) -> None:
+        if isinstance(msg, messages.MOSDMapMsg):
+            if self.osdmap is None or msg.epoch > self.osdmap.epoch:
+                self.osdmap = OSDMap.from_dict(msg.osdmap)
+                was = self.active
+                self.active = self.osdmap.mgr_name == self.name
+                if self.active and not was:
+                    logger.info("%s: now the ACTIVE mgr", self.name)
+        elif isinstance(msg, messages.MMonCommandReply):
+            # a peon redirect: re-home the beacon at the leader
+            if (msg.code == -11 and isinstance(msg.out, dict)
+                    and msg.out.get("addr")):
+                self._redirect_addr = msg.out["addr"]
+                self._mon_conn = None
+        elif isinstance(msg, messages.MPGStats):
+            self._ingest_stats(msg)
+        elif isinstance(msg, messages.MMonCommand):
+            code, status, out = self.handle_command(msg.cmd)
+            conn.send(messages.MMonCommandReply(
+                tid=msg.tid, code=code, status=status, out=out,
+            ))
+
+    def ms_handle_reset(self, conn: Connection) -> None:
+        if conn is self._mon_conn:
+            self._mon_conn = None
+
+    # -- stats ingest (reference:DaemonServer::handle_pg_stats) --------------
+    def _ingest_stats(self, msg: messages.MPGStats) -> None:
+        self.perf.get("mgr").inc("stats_received")
+        now = time.monotonic()
+        self.osd_stats[msg.osd] = {
+            "pgs": dict(msg.pgs or {}),
+            "perf": dict(msg.perf or {}),
+            "store": dict(msg.store or {}),
+            "epoch": msg.epoch,
+            "ts": now,
+        }
+        # client io rates from op-counter deltas
+        prev = self._prev_perf.get(msg.osd)
+        osd_perf = (msg.perf or {}).get("osd", {})
+        if prev is not None:
+            dt = now - prev[0]
+            if dt > 0:
+                p = prev[1].get("osd", {})
+                self.io_rates[msg.osd] = {
+                    "op_per_sec": max(
+                        0.0, (osd_perf.get("op", 0) - p.get("op", 0)) / dt
+                    ),
+                    "rd_bytes_sec": max(
+                        0.0,
+                        (osd_perf.get("op_out_bytes", 0)
+                         - p.get("op_out_bytes", 0)) / dt,
+                    ),
+                    "wr_bytes_sec": max(
+                        0.0,
+                        (osd_perf.get("op_in_bytes", 0)
+                         - p.get("op_in_bytes", 0)) / dt,
+                    ),
+                }
+        self._prev_perf[msg.osd] = (now, dict(msg.perf or {}))
+
+    # -- module host ---------------------------------------------------------
+    def handle_command(self, cmd: dict) -> tuple[int, str, Any]:
+        prefix = cmd.get("prefix", "")
+        if prefix == "mgr module ls":
+            return 0, "", [m.NAME for m in self.modules]
+        mod = self._routes.get(prefix)
+        if mod is None:
+            return -EINVAL, f"mgr: unknown command {prefix!r}", None
+        self.perf.get("mgr").inc("commands")
+        try:
+            return mod.handle_command(self, cmd)
+        except Exception as e:
+            logger.exception("%s: module %s failed on %r",
+                             self.name, mod.NAME, prefix)
+            return -EINVAL, str(e), None
+
+    # -- aggregate views the modules share -----------------------------------
+    STALE_AFTER = 30.0  # seconds without a report -> entry dropped
+
+    def live_osd_stats(self) -> dict[int, dict]:
+        """Reports worth aggregating: the OSD is up in the map and its
+        report is fresh — a dead primary's frozen counts must not shadow
+        the remapped PG's new primary (reference: PGMap ages out stats
+        of down OSDs)."""
+        now = time.monotonic()
+        live: dict[int, dict] = {}
+        for osd, st in list(self.osd_stats.items()):
+            if now - st["ts"] > self.STALE_AFTER:
+                del self.osd_stats[osd]  # long-dead: drop for good
+                self._prev_perf.pop(osd, None)
+                self.io_rates.pop(osd, None)
+                continue
+            if self.osdmap is not None and not self.osdmap.is_up(osd):
+                continue
+            live[osd] = st
+        return live
+
+    def pg_summary(self) -> dict[str, dict]:
+        """Authoritative per-PG view: the primary's report wins
+        (reference: pg stats keyed by the primary's report)."""
+        pgs: dict[str, dict] = {}
+        for osd, st in self.live_osd_stats().items():
+            for pgid, pst in st["pgs"].items():
+                if pst.get("primary") == osd or pgid not in pgs:
+                    pgs[pgid] = {**pst, "reporter": osd}
+        return pgs

@@ -1,0 +1,132 @@
+"""Built-in mgr modules (reference:src/pybind/mgr/ — status, df,
+prometheus; pg dump comes from the reference's PGMap served via mgr)."""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from .daemon import MgrDaemon, MgrModule
+
+
+class StatusModule(MgrModule):
+    """`ceph -s` body: cluster health + services + data + io summary."""
+
+    NAME = "status"
+    COMMANDS = {"status": "status"}
+
+    def status(self, mgr: MgrDaemon, cmd: dict) -> tuple[int, str, Any]:
+        m = mgr.osdmap
+        if m is None:
+            return 0, "", {"health": "HEALTH_WARN", "detail": "no map yet"}
+        up = sum(1 for o in range(m.max_osd) if m.is_up(o))
+        inn = sum(1 for o in range(m.max_osd) if m.is_in(o))
+        exists = sum(1 for o in range(m.max_osd) if m.exists(o))
+        pgs = mgr.pg_summary()
+        objects = sum(p.get("objects", 0) for p in pgs.values())
+        data = sum(p.get("bytes", 0) for p in pgs.values())
+        health = "HEALTH_OK" if up == inn == exists else "HEALTH_WARN"
+        io = {
+            "op_per_sec": sum(
+                r.get("op_per_sec", 0) for r in mgr.io_rates.values()
+            ),
+            "rd_bytes_sec": sum(
+                r.get("rd_bytes_sec", 0) for r in mgr.io_rates.values()
+            ),
+            "wr_bytes_sec": sum(
+                r.get("wr_bytes_sec", 0) for r in mgr.io_rates.values()
+            ),
+        }
+        return 0, "", {
+            "health": health,
+            "monmap_epoch": m.epoch,
+            "osdmap": {"epoch": m.epoch, "num_osds": exists,
+                       "num_up_osds": up, "num_in_osds": inn},
+            "mgrmap": {"active": m.mgr_name,
+                       "standbys": [n for n, _ in m.mgr_standbys]},
+            "pgmap": {
+                "num_pgs": len(pgs),
+                "num_objects": objects,
+                "data_bytes": data,
+                "num_pools": len(m.pools),
+            },
+            "io": io,
+        }
+
+
+class DfModule(MgrModule):
+    """`ceph df`: per-pool usage from the primaries' reports."""
+
+    NAME = "df"
+    COMMANDS = {"df": "df"}
+
+    def df(self, mgr: MgrDaemon, cmd: dict) -> tuple[int, str, Any]:
+        m = mgr.osdmap
+        if m is None:
+            return 0, "", {"pools": []}
+        per_pool: dict[int, dict] = {
+            pid: {"name": p.name, "objects": 0, "bytes": 0}
+            for pid, p in m.pools.items()
+        }
+        for pgid, pst in mgr.pg_summary().items():
+            pool_id = int(pgid.split(".", 1)[0])
+            if pool_id in per_pool:
+                per_pool[pool_id]["objects"] += pst.get("objects", 0)
+                per_pool[pool_id]["bytes"] += pst.get("bytes", 0)
+        stored = sum(
+            st["store"].get("bytes_used", 0)
+            for st in mgr.live_osd_stats().values()
+        )
+        return 0, "", {
+            "pools": [per_pool[pid] for pid in sorted(per_pool)],
+            "total_used_bytes": stored,
+            "num_osds_reporting": len(mgr.live_osd_stats()),
+        }
+
+
+class PGDumpModule(MgrModule):
+    """`ceph pg dump`: the PGMap listing."""
+
+    NAME = "pg_dump"
+    COMMANDS = {"pg dump": "dump"}
+
+    def dump(self, mgr: MgrDaemon, cmd: dict) -> tuple[int, str, Any]:
+        now = time.monotonic()
+        pgs = mgr.pg_summary()
+        return 0, "", {
+            "num_pgs": len(pgs),
+            "pgs": [
+                {"pgid": pgid, **pst} for pgid, pst in sorted(pgs.items())
+            ],
+            "osd_stats": [
+                {"osd": osd, "age": now - st["ts"], "epoch": st["epoch"]}
+                for osd, st in sorted(mgr.live_osd_stats().items())
+            ],
+        }
+
+
+class PrometheusModule(MgrModule):
+    """Prometheus-style exposition of every reported counter
+    (reference:src/pybind/mgr/prometheus)."""
+
+    NAME = "prometheus"
+    COMMANDS = {"metrics": "metrics"}
+
+    def metrics(self, mgr: MgrDaemon, cmd: dict) -> tuple[int, str, Any]:
+        lines: list[str] = []
+        for osd, st in sorted(mgr.live_osd_stats().items()):
+            for subsys, counters in sorted(st["perf"].items()):
+                for key, val in sorted(counters.items()):
+                    if isinstance(val, (list, tuple)):
+                        if len(val) >= 2 and val[1]:
+                            val = val[0] / val[1]  # avg pairs
+                        else:
+                            continue
+                    lines.append(
+                        f'ceph_{subsys}_{key}{{daemon="osd.{osd}"}} {val}'
+                    )
+        for pgid, pst in sorted(mgr.pg_summary().items()):
+            lines.append(
+                f'ceph_pg_objects{{pgid="{pgid}"}} {pst.get("objects", 0)}'
+            )
+        return 0, "", "\n".join(lines) + "\n"

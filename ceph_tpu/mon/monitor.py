@@ -40,9 +40,7 @@ leader-driven majority-ack log over full-map snapshots — "Paxos-lite"):
 from __future__ import annotations
 
 import asyncio
-import json
 import logging
-import os
 import time
 from typing import Any
 
@@ -131,7 +129,15 @@ class Monitor(Dispatcher):
         self._electing = False
         self._election_task: asyncio.Task | None = None
         self._commit_lock = asyncio.Lock()
+        # (svc, name) -> last beacon; svc in ("mgr", "mds")
+        self._svc_beacons: dict[tuple[str, str], float] = {}
+        self._svc_fail_pending = {"mgr": False, "mds": False}
+        self._tick_task: asyncio.Task | None = None
+        self._db_store = None
         if store_path:
+            from .store import MonitorDBStore
+
+            self._db_store = MonitorDBStore(store_path)
             self._load_store()
 
     # -- quorum helpers -------------------------------------------------------
@@ -170,7 +176,22 @@ class Monitor(Dispatcher):
     # -- lifecycle
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
         self.addr = await self.messenger.bind(host, port)
+        self._tick_task = _bg(self._tick_loop())
         return self.addr
+
+    async def _tick_loop(self) -> None:
+        """Periodic housekeeping (Monitor::tick): currently mgr-beacon
+        staleness; leader-only mutations."""
+        try:
+            while True:
+                await asyncio.sleep(self.config.mon_lease_interval)
+                if self.is_leader:
+                    for svc in ("mgr", "mds"):
+                        self.check_svc_beacons(
+                            svc, grace=self.config.mon_lease_interval * 3
+                        )
+        except asyncio.CancelledError:
+            pass
 
     async def start_quorum(self) -> None:
         """Begin elections/lease-watching (call once every mon is bound
@@ -184,36 +205,32 @@ class Monitor(Dispatcher):
         self._election_task = _bg(self._start_election())
 
     async def stop(self) -> None:
-        for t in (self._lease_task, self._watch_task, self._election_task):
+        for t in (self._lease_task, self._watch_task, self._election_task,
+                  self._tick_task):
             if t is not None:
                 t.cancel()
         self._lease_task = self._watch_task = self._election_task = None
+        self._tick_task = None
         await self.messenger.shutdown()
+        if self._db_store is not None:
+            self._db_store.close()
+            self._db_store = None
 
     # -- persistence (MonitorDBStore-lite) -----------------------------------
 
     def _save_store(self) -> None:
-        if not self.store_path:
+        if self._db_store is None:
             return
-        tmp = self.store_path + ".tmp"
-        os.makedirs(os.path.dirname(self.store_path) or ".", exist_ok=True)
-        with open(tmp, "w") as f:
-            json.dump({
-                "election_epoch": self.election_epoch,
-                "osdmap": self.osdmap.to_dict(),
-            }, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.store_path)
+        self._db_store.save(self.osdmap.to_dict(), self.election_epoch)
 
     def _load_store(self) -> None:
-        try:
-            with open(self.store_path) as f:
-                data = json.load(f)
-        except FileNotFoundError:
+        if self._db_store is None:
             return
-        self.osdmap = OSDMap.from_dict(data["osdmap"])
-        self.election_epoch = int(data.get("election_epoch", 0))
+        data = self._db_store.get_map()
+        if data is None:
+            return
+        self.osdmap = OSDMap.from_dict(data)
+        self.election_epoch = self._db_store.election_epoch()
         logger.info(
             "%s: restored map epoch %d from %s",
             self.name, self.osdmap.epoch, self.store_path,
@@ -630,6 +647,12 @@ class Monitor(Dispatcher):
                 "osd pool selfmanaged-snap rm":
                     self._cmd_selfmanaged_snap_rm,
                 "osd dump": self._cmd_osd_dump,
+                "mgr beacon": lambda c: self._cmd_svc_beacon("mgr", c),
+                "mgr fail": lambda c: self._cmd_svc_fail("mgr", c),
+                "mgr prune-standbys": lambda c: self._cmd_svc_prune("mgr", c),
+                "mds beacon": lambda c: self._cmd_svc_beacon("mds", c),
+                "mds fail": lambda c: self._cmd_svc_fail("mds", c),
+                "mds prune-standbys": lambda c: self._cmd_svc_prune("mds", c),
                 "osd down": self._cmd_osd_down,
                 "osd out": self._cmd_osd_out,
                 "osd in": self._cmd_osd_in,
@@ -817,6 +840,130 @@ class Monitor(Dispatcher):
         self.osdmap.mark_in(osd)
         self._mark_dirty()
         return 0, "", None
+
+    # -- active/standby service lifecycle: mgr AND mds share the beacon
+    # machinery (reference:src/mon/MgrMonitor.cc beacon handling,
+    # src/mon/MDSMonitor.cc prepare_beacon) --------------------------------
+
+    def _svc_fields(self, svc: str) -> tuple[str, str, list]:
+        m = self.osdmap
+        return (
+            getattr(m, f"{svc}_name"),
+            getattr(m, f"{svc}_addr"),
+            getattr(m, f"{svc}_standbys"),
+        )
+
+    def _svc_set(self, svc: str, name: str, addr: str, standbys: list) -> None:
+        m = self.osdmap
+        setattr(m, f"{svc}_name", name)
+        setattr(m, f"{svc}_addr", addr)
+        setattr(m, f"{svc}_standbys", standbys)
+
+    def _cmd_svc_beacon(self, svc: str, cmd: dict) -> tuple[int, str, Any]:
+        name, addr = cmd["name"], cmd["addr"]
+        active, active_addr, standbys = self._svc_fields(svc)
+        self._svc_beacons[(svc, name)] = time.monotonic()
+        if active == name:
+            if active_addr != addr:  # restarted on a new port
+                self._svc_set(svc, name, addr, standbys)
+                self._mark_dirty()
+            return 0, "", {"active": True}
+        if not active:
+            self._svc_set(
+                svc, name, addr, [(n, a) for n, a in standbys if n != name]
+            )
+            self._mark_dirty()
+            logger.info("%s: %s %s is now active", self.name, svc, name)
+            return 0, "", {"active": True}
+        known = dict(standbys)
+        if known.get(name) != addr:  # new standby OR restarted on a new port
+            known[name] = addr
+            self._svc_set(svc, active, active_addr, sorted(known.items()))
+            self._mark_dirty()
+        return 0, "", {"active": False}
+
+    def _svc_fresh(self, svc: str, name: str,
+                   grace: float | None = None) -> bool:
+        if grace is None:
+            grace = self.config.mon_lease_interval * 3
+        last = self._svc_beacons.get((svc, name))
+        return last is not None and time.monotonic() - last <= grace
+
+    def _cmd_svc_fail(self, svc: str, cmd: dict) -> tuple[int, str, Any]:
+        """Demote the active daemon (operator command / beacon-staleness
+        path); the first standby with a FRESH beacon is promoted — a
+        dead standby would just re-fail a tick later."""
+        active, _addr, standbys = self._svc_fields(svc)
+        if not active:
+            return 0, f"no active {svc}", None
+        self._svc_beacons.pop((svc, active), None)
+        live = [(n, a) for n, a in standbys if self._svc_fresh(svc, n)]
+        dead = [t for t in standbys if t not in live]
+        if live:
+            (new, new_addr), *rest = live
+            self._svc_set(svc, new, new_addr, rest + dead)
+            logger.info("%s: %s %s failed over to %s",
+                        self.name, svc, active, new)
+        else:
+            self._svc_set(svc, "", "", standbys)
+        self._mark_dirty()
+        return 0, f"{svc} {active} failed", None
+
+    def _cmd_svc_prune(self, svc: str, cmd: dict) -> tuple[int, str, Any]:
+        active, addr, standbys = self._svc_fields(svc)
+        grace = float(cmd.get("grace", self.config.mon_lease_interval * 9))
+        live = [
+            t for t in standbys if self._svc_fresh(svc, t[0], grace=grace)
+        ]
+        if live != standbys:
+            self._svc_set(svc, active, addr, live)
+            self._mark_dirty()
+        return 0, "", None
+
+    def check_svc_beacons(self, svc: str, grace: float = 3.0) -> None:
+        """Leader-side staleness check, called from the tick path: an
+        active daemon silent past the grace is failed over; long-dead
+        standbys are pruned from the map."""
+        active, _addr, standbys = self._svc_fields(svc)
+        now = time.monotonic()
+        for n, _a in standbys:
+            # freshly-elected leader: start every standby's clock too,
+            # or the first tick prunes live standbys it never heard from
+            self._svc_beacons.setdefault((svc, n), now)
+        if any(
+            not self._svc_fresh(svc, n, grace=grace * 3)
+            for n, _a in standbys
+        ) and not self._svc_fail_pending[svc]:
+            # through the serialized command path (same reason as the
+            # fail below: no interleaved epoch bumps)
+            self._spawn_svc_cmd(
+                svc, {"prefix": f"{svc} prune-standbys", "grace": grace * 3}
+            )
+        if not active:
+            return
+        last = self._svc_beacons.get((svc, active))
+        if last is None:
+            # freshly-elected leader / restart: start the clock now
+            self._svc_beacons[(svc, active)] = time.monotonic()
+            return
+        if time.monotonic() - last > grace and not self._svc_fail_pending[svc]:
+            # through the async path: _commit_lock serializes the epoch
+            # bump against concurrent client commands (interleaved
+            # publishes would fork the map).  The pending flag stops a
+            # slow commit from queueing a SECOND fail that would demote
+            # the freshly promoted standby too.
+            self._spawn_svc_cmd(svc, {"prefix": f"{svc} fail"})
+
+    def _spawn_svc_cmd(self, svc: str, cmd: dict) -> None:
+        self._svc_fail_pending[svc] = True
+
+        async def run_and_clear():
+            try:
+                await self.handle_command_async(cmd)
+            finally:
+                self._svc_fail_pending[svc] = False
+
+        _bg(run_and_clear())
 
     def _cmd_status(self, cmd: dict) -> tuple[int, str, Any]:
         m = self.osdmap

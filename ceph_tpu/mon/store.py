@@ -1,0 +1,67 @@
+"""MonitorDBStore: the mon's durable state over KeyValueDB
+(reference:src/mon/MonitorDBStore.h — paxos versions and service maps
+in one transactional KV store).
+
+Keys: ``osdmap/<epoch:010d>`` full map snapshots (a bounded history,
+like the mon's trimmed paxos versions), ``meta/last_committed``,
+``meta/election_epoch``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from ..store.kv import FileKVDB, KeyValueDB
+
+KEEP_EPOCHS = 500  # reference: mon_min_osdmap_epochs
+
+
+class MonitorDBStore:
+    def __init__(self, path: str, db: KeyValueDB | None = None):
+        legacy = None
+        if db is None and os.path.isfile(path):
+            # pre-KV single-JSON store: migrate in place (the mon's
+            # durable state must survive the format change)
+            with open(path) as f:
+                legacy = json.load(f)
+            os.replace(path, path + ".legacy")
+        self.db = db or FileKVDB(path)
+        self.db.open()
+        if legacy is not None and self.last_committed() == 0:
+            self.save(
+                legacy["osdmap"], int(legacy.get("election_epoch", 0))
+            )
+
+    def close(self) -> None:
+        self.db.close()
+
+    # -- write
+    def save(self, osdmap_dict: dict, election_epoch: int) -> None:
+        epoch = int(osdmap_dict["epoch"])
+        txn = self.db.transaction()
+        txn.set("osdmap", f"{epoch:010d}", json.dumps(osdmap_dict).encode())
+        txn.set("meta", "last_committed", str(epoch).encode())
+        txn.set("meta", "election_epoch", str(election_epoch).encode())
+        for k in self.db.keys("osdmap"):
+            if int(k) <= epoch - KEEP_EPOCHS:
+                txn.rmkey("osdmap", k)
+        self.db.submit(txn)
+
+    # -- read
+    def last_committed(self) -> int:
+        raw = self.db.get("meta", "last_committed")
+        return int(raw) if raw else 0
+
+    def election_epoch(self) -> int:
+        raw = self.db.get("meta", "election_epoch")
+        return int(raw) if raw else 0
+
+    def get_map(self, epoch: int | None = None) -> dict | None:
+        if epoch is None:
+            epoch = self.last_committed()
+        raw = self.db.get("osdmap", f"{epoch:010d}")
+        return json.loads(raw) if raw else None
+
+    def versions(self) -> list[int]:
+        return [int(k) for k in self.db.keys("osdmap")]

@@ -328,6 +328,33 @@ class MPGLsReply(Message):
 
 
 @register
+class MPGStats(Message):
+    """OSD -> mgr: periodic stats report (reference:src/messages/
+    MPGStats.h).  ``pgs`` = {pgid: {"objects", "bytes", "primary"}},
+    ``perf`` = the daemon's counter dump, ``store`` = usage totals."""
+
+    TYPE = "pg_stats"
+    FIELDS = ("osd", "epoch", "pgs", "perf", "store")
+
+
+@register
+class MClientRequest(Message):
+    """CephFS client -> MDS metadata op (reference:src/messages/
+    MClientRequest.h).  ``op`` names the call, ``args`` its parameters."""
+
+    TYPE = "client_request"
+    FIELDS = ("tid", "op", "args")
+
+
+@register
+class MClientReply(Message):
+    """reference:src/messages/MClientReply.h."""
+
+    TYPE = "client_reply"
+    FIELDS = ("tid", "result", "out")
+
+
+@register
 class MWatchNotify(Message):
     """OSD -> watching client: a notify fired on an object you watch
     (reference:src/messages/MWatchNotify.h).  Payload in blobs[0]."""

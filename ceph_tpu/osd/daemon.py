@@ -281,6 +281,10 @@ class OSD(Dispatcher):
         self._tasks: set[asyncio.Task] = set()
         self._hb_task: asyncio.Task | None = None
         self._wd_task: asyncio.Task | None = None
+        self._mgr_task: asyncio.Task | None = None
+        self._mgr_conn: Connection | None = None
+        self._mgr_addr_used = ""  # where _mgr_conn points (failover check)
+        self._pg_stats_cache: dict[str, tuple[tuple, dict]] = {}
         self._hb_last: dict[int, float] = {}
         self._map_event = asyncio.Event()
         self._stopping = False
@@ -350,6 +354,8 @@ class OSD(Dispatcher):
             # heartbeat loop, or the suicide timeout is inert in every
             # cluster that disables pings (review r2 finding)
             self._wd_task = asyncio.ensure_future(self._watchdog_loop())
+        if self.config.osd_mgr_report_interval > 0:
+            self._mgr_task = asyncio.ensure_future(self._mgr_report_loop())
         self.recovery.start()
         self.recovery.kick()  # reconcile whatever the map says we lead
         self.scrub.start()
@@ -505,6 +511,8 @@ class OSD(Dispatcher):
             self._hb_task.cancel()
         if self._wd_task:
             self._wd_task.cancel()
+        if self._mgr_task:
+            self._mgr_task.cancel()
         me = asyncio.current_task()
         for t in list(self._tasks):
             if t is not me:  # a tracked task calling stop() must finish it
@@ -571,6 +579,8 @@ class OSD(Dispatcher):
             self._mon_conn = None
             self._on_mon_reset()
             return
+        if conn is self._mgr_conn:
+            self._mgr_conn = None
         # a dead client's watches die with its connection (reference:
         # Watch.cc handle_watch_timeout; lingers re-register on reconnect)
         for key, table in list(self._watchers.items()):
@@ -1539,23 +1549,20 @@ class OSD(Dispatcher):
         ]
         if not present:
             return -EAGAIN
-        # delete under a snap context preserves the pre-delete object and
-        # parks the SnapSet on the snapdir (reference:PrimaryLogPG.cc
-        # make_writeable delete branch + get_snapdir)
-        clone_src: str | None = None
-        ss = snaps_mod.SnapSet()
-        write_snapdir = False
-        if snapc is not None and snapc.valid():
-            oi, _h, _v, errs, ss = await self._ec_meta(
-                pg, oid, dict(present)
-            )
-            if any(e != -ENOENT for e in errs.values()):
-                return -EAGAIN
-            clone_src = snaps_mod.plan_clone(
-                ss, snapc, oi is not None,
-                0 if oi is None else int(oi["size"]), oid,
-            )
-            write_snapdir = bool(ss.clones)
+        # a delete preserves the pre-delete object when the snap context
+        # demands it, and ALWAYS parks a surviving SnapSet on the snapdir
+        # — even snapc-less deletes (a self-managed-snap client's pool
+        # context is empty) must not orphan existing clones
+        # (reference:PrimaryLogPG.cc make_writeable delete branch +
+        # get_snapdir)
+        oi, _h, _v, errs, ss = await self._ec_meta(pg, oid, dict(present))
+        if any(e != -ENOENT for e in errs.values()):
+            return -EAGAIN
+        clone_src = snaps_mod.plan_clone(
+            ss, snapc, oi is not None,
+            0 if oi is None else int(oi["size"]), oid,
+        )
+        write_snapdir = bool(ss.clones)
         version = self._next_version(pg)
         sname = stash_name(oid, version)
         entry = PGLogEntry("delete", oid, version, Eversion(), stash=sname)
@@ -2512,6 +2519,108 @@ class OSD(Dispatcher):
                 self.hb_map.is_healthy()
         except asyncio.CancelledError:
             pass
+
+    async def _mgr_report_loop(self) -> None:
+        """Periodic MPGStats to the active mgr (reference:src/osd/OSD.cc
+        mgrc report path, src/messages/MPGStats.h)."""
+        try:
+            while not self._stopping:
+                await asyncio.sleep(self.config.osd_mgr_report_interval)
+                if self.osdmap is None or not self.osdmap.mgr_addr:
+                    continue
+                addr = self.osdmap.mgr_addr
+                try:
+                    conn = self._mgr_conn
+                    if (conn is None or conn._closed
+                            or self._mgr_addr_used != addr):
+                        # failover re-target: an open conn to a DEMOTED
+                        # mgr must not keep swallowing our reports (and
+                        # must not leak — close it)
+                        if conn is not None and not conn._closed:
+                            await conn.close()
+                        conn = await self.messenger.connect(
+                            addr, self.osdmap.mgr_name
+                        )
+                        self._mgr_conn = conn
+                        self._mgr_addr_used = addr
+                    pgs, used = await self._collect_pg_stats()
+                    conn.send(messages.MPGStats(
+                        osd=self.osd_id, epoch=self._epoch(), pgs=pgs,
+                        perf=self.perf.dump(),
+                        store={"bytes_used": used},
+                    ))
+                except (ConnectionError, OSError):
+                    self._mgr_conn = None  # mgr bouncing; retry next tick
+        except asyncio.CancelledError:
+            pass
+
+    async def _collect_pg_stats(self) -> tuple[dict, int]:
+        """Per-led-PG object/byte counts from the local store (the
+        primary's report is the authoritative one in the mgr's PGMap).
+        Yields to the loop between objects — a big store scan must not
+        stall in-flight ops or the watchdog."""
+        scanned = 0
+        pgs: dict[str, dict] = {}
+        used = 0
+        if self.osdmap is None:
+            return pgs, used
+        for pool in self.osdmap.pools.values():
+            for pg in self.osdmap.pgs_of_pool(pool.id):
+                _u, _up, acting, primary = self.osdmap.pg_to_up_acting_osds(pg)
+                if primary != self.osd_id:
+                    self._pg_stats_cache.pop(str(pg), None)
+                    continue
+                # an unchanged PG (same epoch + same last-issued version)
+                # reuses its last scan — rescanning every object every
+                # second is pure waste on a quiet store
+                cache_key = (
+                    self._epoch(),
+                    self._pg_versions.get(str(pg), Eversion()).key(),
+                )
+                hit = self._pg_stats_cache.get(str(pg))
+                if hit is not None and hit[0] == cache_key:
+                    pgs[str(pg)] = hit[1]
+                    used += hit[1]["bytes"]
+                    continue
+                if pool.type == POOL_TYPE_ERASURE:
+                    shard = next(
+                        (s for s, o in enumerate(acting)
+                         if o == self.osd_id), 0
+                    )
+                    cid = self._shard_cid(pg, shard)
+                else:
+                    cid = CollectionId(str(pg))
+                objects = 0
+                pg_bytes = 0
+                try:
+                    names = self.store.list_objects(cid)
+                except KeyError:
+                    names = []
+                for o in names:
+                    scanned += 1
+                    if scanned % 256 == 0:
+                        await asyncio.sleep(0)
+                    n = o.name
+                    if (n == "_pgmeta_" or is_stash_name(n)
+                            or snaps_mod.is_clone_name(n)):
+                        continue
+                    objects += 1
+                    try:
+                        raw = self.store.getattr(cid, o, OI_KEY)
+                        pg_bytes += int(json.loads(raw).get("size", 0))
+                    except (KeyError, ValueError):
+                        try:
+                            pg_bytes += self.store.stat(cid, o)
+                        except KeyError:
+                            pass
+                stat = {
+                    "objects": objects, "bytes": pg_bytes,
+                    "primary": self.osd_id,
+                }
+                pgs[str(pg)] = stat
+                self._pg_stats_cache[str(pg)] = (cache_key, stat)
+                used += pg_bytes
+        return pgs, used
 
     async def _heartbeat_loop(self) -> None:
         """reference:src/osd/OSD.cc:4104-4245 heartbeat + failure_queue."""

@@ -189,6 +189,16 @@ class OSDMap:
         self.erasure_code_profiles: dict[str, dict[str, str]] = {}
         self.pg_temp: dict[PGid, list[int]] = {}
         self.primary_temp: dict[PGid, int] = {}
+        # MgrMap/MDSMap essentials, piggybacked on the OSDMap (the
+        # reference versions separate maps; one versioned map is the
+        # same contract at this scale — reference:src/mon/MgrMap.h,
+        # src/mds/MDSMap.h)
+        self.mgr_name = ""
+        self.mgr_addr = ""
+        self.mgr_standbys: list[tuple[str, str]] = []  # (name, addr)
+        self.mds_name = ""
+        self.mds_addr = ""
+        self.mds_standbys: list[tuple[str, str]] = []
 
     # -- device lifecycle ----------------------------------------------------
 
@@ -507,6 +517,12 @@ class OSDMap:
             "erasure_code_profiles": self.erasure_code_profiles,
             "pg_temp": {str(pg): osds for pg, osds in self.pg_temp.items()},
             "primary_temp": {str(pg): o for pg, o in self.primary_temp.items()},
+            "mgr_name": self.mgr_name,
+            "mgr_addr": self.mgr_addr,
+            "mgr_standbys": list(self.mgr_standbys),
+            "mds_name": self.mds_name,
+            "mds_addr": self.mds_addr,
+            "mds_standbys": list(self.mds_standbys),
         }
 
     @classmethod
@@ -536,4 +552,10 @@ class OSDMap:
         m.primary_temp = {
             PGid.parse(s): o for s, o in d.get("primary_temp", {}).items()
         }
+        m.mgr_name = d.get("mgr_name", "")
+        m.mgr_addr = d.get("mgr_addr", "")
+        m.mgr_standbys = [tuple(x) for x in d.get("mgr_standbys", [])]
+        m.mds_name = d.get("mds_name", "")
+        m.mds_addr = d.get("mds_addr", "")
+        m.mds_standbys = [tuple(x) for x in d.get("mds_standbys", [])]
         return m

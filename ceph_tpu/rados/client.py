@@ -134,6 +134,7 @@ class RadosClient(Dispatcher):
                 messages.MMonCommandReply,
                 messages.MOSDScrubReply,
                 messages.MPGLsReply,
+                messages.MClientReply,
             ),
         ):
             fut = self._op_futs.pop(msg.tid, None)
@@ -224,30 +225,36 @@ class RadosClient(Dispatcher):
             pass
 
     # -- mon commands
+    async def command_on(
+        self, conn: Connection, cmd: dict
+    ) -> messages.MMonCommandReply:
+        """One MMonCommand round trip on an already-chosen connection
+        (shared by mon commands and the ceph CLI's direct-to-mgr path)."""
+        tid = next(self._tid)
+        fut = asyncio.get_running_loop().create_future()
+        self._op_futs[tid] = fut
+        self._fut_conns[tid] = conn
+        try:
+            conn.send(messages.MMonCommand(tid=tid, cmd=cmd))
+            async with asyncio.timeout(self.op_timeout):
+                return await fut
+        finally:
+            self._op_futs.pop(tid, None)
+            self._fut_conns.pop(tid, None)
+
     async def command(self, cmd: dict) -> tuple[int, str, Any]:
         """Mon command; follows leader redirects and fails over to other
         mons (reference MonClient hunting + command forwarding)."""
         target = self._cmd_addr
         last: tuple[int, str, Any] | None = None
         for _attempt in range(self.max_retries):
-            tid = next(self._tid)
-            fut = asyncio.get_running_loop().create_future()
-            self._op_futs[tid] = fut
             try:
                 conn = await self._mon_conn(target)
-                self._fut_conns[tid] = conn
-                conn.send(messages.MMonCommand(tid=tid, cmd=cmd))
-                async with asyncio.timeout(self.op_timeout):
-                    reply = await fut
-            except (ConnectionError, OSError):
+                reply = await self.command_on(conn, cmd)
+            except (ConnectionError, OSError, TimeoutError):
                 target = None  # hunt any live mon next round
                 await asyncio.sleep(0.2)
                 continue
-            finally:
-                # a timeout/error must not leak the tid (ADVICE r1:
-                # operate() cleans up; command() must too)
-                self._op_futs.pop(tid, None)
-                self._fut_conns.pop(tid, None)
             if (
                 reply.code == -EAGAIN
                 and reply.status == "not leader"

@@ -56,6 +56,10 @@ class MiniCluster:
                 if not os.path.exists(s._journal_path):
                     s.mkfs()
         self.osds: dict[int, OSD] = {}
+        self.mgrs: dict[str, "object"] = {}  # name -> MgrDaemon
+        self._mgr_seq = 0  # monotonic: killed mgrs' names never recycle
+        self.mdss: dict[str, "object"] = {}  # name -> MDSDaemon
+        self._mds_seq = 0
         self._clients: list[RadosClient] = []
 
     def _make_store(self, osd_id: int) -> ObjectStore:
@@ -187,10 +191,59 @@ class MiniCluster:
         self._clients.append(cl)
         return cl
 
+    # -- mgr (reference:src/mgr; vstart's MGR_COUNT) ------------------------
+    async def start_mgr(self, name: str | None = None, config=None):
+        from ..mgr import MgrDaemon
+
+        self._mgr_seq += 1
+        name = name or f"mgr.{self._mgr_seq}"
+        mgr = MgrDaemon(name, self.monmap or self.mon.addr, config=config)
+        await mgr.start()
+        self.mgrs[name] = mgr
+        return mgr
+
+    async def kill_mgr(self, name: str) -> None:
+        await self.mgrs.pop(name).stop()
+
+    async def wait_for_active_mgr(self, timeout: float = 10.0) -> str:
+        """Until the map names an active mgr that is actually running."""
+        async with asyncio.timeout(timeout):
+            while True:
+                active = self.mon.osdmap.mgr_name
+                if active in self.mgrs and self.mgrs[active].active:
+                    return active
+                await asyncio.sleep(0.01)
+
+    # -- mds (reference:src/mds; vstart's MDS_COUNT) ------------------------
+    async def start_mds(self, name: str | None = None, config=None):
+        from ..mds import MDSDaemon
+
+        self._mds_seq += 1
+        name = name or f"mds.{self._mds_seq}"
+        mds = MDSDaemon(name, self.monmap or self.mon.addr, config=config)
+        await mds.start()
+        self.mdss[name] = mds
+        return mds
+
+    async def kill_mds(self, name: str) -> None:
+        await self.mdss.pop(name).stop()
+
+    async def wait_for_active_mds(self, timeout: float = 10.0) -> str:
+        async with asyncio.timeout(timeout):
+            while True:
+                active = self.mon.osdmap.mds_name
+                if active in self.mdss and self.mdss[active].active:
+                    return active
+                await asyncio.sleep(0.01)
+
     async def stop(self) -> None:
         for cl in self._clients:
             await cl.shutdown()
         self._clients.clear()
+        for name in list(self.mdss):
+            await self.kill_mds(name)
+        for name in list(self.mgrs):
+            await self.kill_mgr(name)
         for osd_id in list(self.osds):
             await self.kill_osd(osd_id)
         for rank in list(self.mons):

@@ -1,0 +1,230 @@
+"""The S3 REST front end (reference:src/rgw/rgw_main.cc over civetweb;
+op demux reference:src/rgw/rgw_rest_s3.cc).
+
+A deliberately small asyncio HTTP/1.1 server speaking the S3 calling
+convention this framework's store supports:
+
+  GET    /                         list buckets (owner of the key)
+  PUT    /<bucket>                 create bucket
+  DELETE /<bucket>                 delete bucket
+  GET    /<bucket>?prefix&marker&delimiter&max-keys   list objects
+  PUT    /<bucket>/<key>           put object (or ?uploadId&partNumber)
+  GET    /<bucket>/<key>           get object
+  HEAD   /<bucket>/<key>           head object
+  DELETE /<bucket>/<key>           delete object (or abort ?uploadId)
+  POST   /<bucket>/<key>?uploads   initiate multipart
+  POST   /<bucket>/<key>?uploadId  complete multipart
+
+Auth: ``Authorization: AWS <access_key>:<anything>`` — the key selects
+the user (the reference's signature check collapsed to key lookup;
+CephX-style wire auth lives in the messenger tier).  Responses are
+JSON rather than XML — a deliberate re-design; the verbs, status
+codes, and listing semantics are the S3 ones.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from urllib.parse import parse_qs, unquote, urlsplit
+
+from .store import RGWError, RGWStore
+
+logger = logging.getLogger("ceph_tpu.rgw")
+
+_STATUS = {
+    200: "OK", 201: "Created", 204: "No Content", 400: "Bad Request",
+    403: "Forbidden", 404: "Not Found", 405: "Method Not Allowed",
+    409: "Conflict",
+}
+
+_ERRNO_HTTP = {2: 404, 17: 409, 39: 409, 13: 403, 22: 400}
+
+
+class S3Server:
+    def __init__(self, store: RGWStore):
+        self.store = store
+        self._server: asyncio.AbstractServer | None = None
+        self.addr = ""
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        self._server = await asyncio.start_server(self._serve, host, port)
+        h, p = self._server.sockets[0].getsockname()[:2]
+        self.addr = f"{h}:{p}"
+        return self.addr
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    # -- http plumbing -------------------------------------------------------
+    async def _serve(self, reader, writer) -> None:
+        try:
+            while True:
+                line = await reader.readline()
+                if not line or line in (b"\r\n", b"\n"):
+                    break
+                try:
+                    method, target, _ver = line.decode().split(None, 2)
+                except ValueError:
+                    break
+                headers: dict[str, str] = {}
+                while True:
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = h.decode().partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                body = b""
+                n = int(headers.get("content-length", 0) or 0)
+                if n:
+                    body = await reader.readexactly(n)
+                status, out_headers, payload = await self._route(
+                    method.upper(), target, headers, body
+                )
+                reason = _STATUS.get(status, "?")
+                head = [f"HTTP/1.1 {status} {reason}"]
+                out_headers.setdefault("content-length", str(len(payload)))
+                out_headers.setdefault("connection", "keep-alive")
+                for k, v in out_headers.items():
+                    head.append(f"{k}: {v}")
+                writer.write(
+                    ("\r\n".join(head) + "\r\n\r\n").encode() + payload
+                )
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            writer.close()
+
+    @staticmethod
+    def _json(obj) -> tuple[dict, bytes]:
+        return (
+            {"content-type": "application/json"},
+            json.dumps(obj).encode(),
+        )
+
+    # -- request routing (RGWHandler_REST_S3 analog) -------------------------
+    async def _route(
+        self, method: str, target: str, headers: dict, body: bytes
+    ) -> tuple[int, dict, bytes]:
+        try:
+            user = await self._auth(headers)
+            if user is None:
+                h, b = self._json({"error": "access denied"})
+                return 403, h, b
+            parts = urlsplit(target)
+            q = {
+                k: v[0] for k, v in parse_qs(
+                    parts.query, keep_blank_values=True
+                ).items()
+            }
+            path = unquote(parts.path).strip("/")
+            bucket, _, key = path.partition("/")
+            if not bucket:
+                return await self._svc(method, user)
+            if not key:
+                return await self._bucket(method, user, bucket, q)
+            return await self._object(
+                method, user, bucket, key, q, body, headers
+            )
+        except RGWError as e:
+            status = _ERRNO_HTTP.get(-e.code, 400)
+            h, b = self._json({"error": str(e)})
+            return status, h, b
+        except Exception:
+            logger.exception("rgw: request failed")
+            h, b = self._json({"error": "internal error"})
+            return 400, h, b
+
+    async def _auth(self, headers: dict) -> dict | None:
+        auth = headers.get("authorization", "")
+        if not auth.startswith("AWS "):
+            return None
+        access_key = auth[4:].split(":", 1)[0]
+        return await self.store.user_by_access_key(access_key)
+
+    async def _svc(self, method: str, user: dict):
+        if method != "GET":
+            return 405, *self._json({"error": "bad method"})
+        names = await self.store.list_buckets(user["uid"])
+        return 200, *self._json({"owner": user["uid"], "buckets": names})
+
+    async def _bucket(self, method: str, user: dict, bucket: str, q: dict):
+        if method == "PUT":
+            await self.store.create_bucket(bucket, user["uid"])
+            return 200, *self._json({"bucket": bucket})
+        if method == "DELETE":
+            await self._check_owner(user, bucket)
+            await self.store.delete_bucket(bucket)
+            return 204, {}, b""
+        if method == "GET":
+            await self._check_owner(user, bucket)
+            listing = await self.store.list_objects(
+                bucket,
+                prefix=q.get("prefix", ""),
+                marker=q.get("marker", ""),
+                max_keys=int(q.get("max-keys", 1000)),
+                delimiter=q.get("delimiter", ""),
+            )
+            return 200, *self._json({"name": bucket, **listing})
+        return 405, *self._json({"error": "bad method"})
+
+    async def _object(
+        self, method: str, user: dict, bucket: str, key: str,
+        q: dict, body: bytes, headers: dict,
+    ):
+        await self._check_owner(user, bucket)
+        store = self.store
+        if method == "PUT":
+            if "uploadId" in q:
+                out = await store.upload_part(
+                    bucket, key, q["uploadId"],
+                    int(q.get("partNumber", 1)), body,
+                )
+                return 200, {"etag": out["etag"]}, b""
+            entry = await store.put_object(
+                bucket, key, body,
+                content_type=headers.get(
+                    "content-type", "binary/octet-stream"
+                ),
+            )
+            return 200, {"etag": entry["etag"]}, b""
+        if method == "POST":
+            if "uploads" in q:
+                upload = await store.init_multipart(bucket, key)
+                return 200, *self._json({"uploadId": upload})
+            if "uploadId" in q:
+                entry = await store.complete_multipart(
+                    bucket, key, q["uploadId"]
+                )
+                return 200, *self._json(entry)
+            return 400, *self._json({"error": "bad post"})
+        if method == "GET":
+            data, entry = await store.get_object(bucket, key)
+            return 200, {
+                "content-type": entry.get("content_type",
+                                          "binary/octet-stream"),
+                "etag": entry["etag"],
+            }, data
+        if method == "HEAD":
+            entry = await store.head_object(bucket, key)
+            return 200, {
+                "content-length": str(entry["size"]),
+                "etag": entry["etag"],
+            }, b""
+        if method == "DELETE":
+            if "uploadId" in q:
+                await store.abort_multipart(bucket, key, q["uploadId"])
+                return 204, {}, b""
+            await store.delete_object(bucket, key)
+            return 204, {}, b""
+        return 405, *self._json({"error": "bad method"})
+
+    async def _check_owner(self, user: dict, bucket: str) -> None:
+        info = await self.store.bucket_info(bucket)
+        if info["owner"] != user["uid"]:
+            raise RGWError(-13, "access denied")

@@ -1,0 +1,383 @@
+"""The RGW object-store engine (reference:src/rgw/rgw_rados.cc — user,
+bucket, object and multipart operations over rados; bucket index
+reference:src/cls/rgw/)."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import secrets
+import time
+
+from ..rados.client import ENOENT, IoCtx, RadosClient, RadosError
+from ..rados.striper import StripedObject
+
+META_POOL = ".rgw.meta"
+DATA_POOL = ".rgw.buckets"
+USERS_OBJ = "users"
+BUCKETS_OBJ = "buckets"
+
+EEXIST = 17
+EINVAL = 22
+EACCES = 13
+ENOTEMPTY = 39
+
+
+class RGWError(RadosError):
+    pass
+
+
+def _now() -> float:
+    return time.time()
+
+
+class RGWStore:
+    """One gateway's view of the cluster (RGWRados analog)."""
+
+    def __init__(self, client: RadosClient):
+        self.client = client
+        self.meta = client.io_ctx(META_POOL)
+        self.data = client.io_ctx(DATA_POOL)
+
+    @classmethod
+    async def create(cls, client: RadosClient) -> "RGWStore":
+        """Bootstrap: ensure the gateway pools exist
+        (reference:rgw_rados.cc open_root_pool-style lazy creation)."""
+        for pool in (META_POOL, DATA_POOL):
+            await client.create_pool(pool, "replicated")
+        return cls(client)
+
+    # -- users (reference:src/rgw/rgw_user.cc) -------------------------------
+    async def create_user(
+        self, uid: str, display_name: str = ""
+    ) -> dict:
+        users = await self._omap(self.meta, USERS_OBJ)
+        if uid in users:
+            raise RGWError(-EEXIST, f"user {uid!r} exists")
+        rec = {
+            "uid": uid,
+            "display_name": display_name or uid,
+            "access_key": secrets.token_hex(10),
+            "secret_key": secrets.token_hex(20),
+            "created": _now(),
+        }
+        await self.meta.omap_set(
+            USERS_OBJ, {uid: json.dumps(rec).encode()}
+        )
+        return rec
+
+    async def get_user(self, uid: str) -> dict:
+        users = await self._omap(self.meta, USERS_OBJ)
+        raw = users.get(uid)
+        if raw is None:
+            raise RGWError(-ENOENT, f"no user {uid!r}")
+        return json.loads(raw)
+
+    async def list_users(self) -> list[str]:
+        return sorted(await self._omap(self.meta, USERS_OBJ))
+
+    async def user_by_access_key(self, access_key: str) -> dict | None:
+        for raw in (await self._omap(self.meta, USERS_OBJ)).values():
+            rec = json.loads(raw)
+            if rec["access_key"] == access_key:
+                return rec
+        return None
+
+    async def remove_user(self, uid: str) -> None:
+        await self.get_user(uid)
+        for b in await self.list_buckets(uid):
+            raise RGWError(-ENOTEMPTY, f"user {uid!r} owns bucket {b!r}")
+        await self.meta.omap_rmkeys(USERS_OBJ, [uid])
+
+    # -- buckets (reference:src/rgw/rgw_bucket.cc) ---------------------------
+    def _index_obj(self, bucket: str) -> str:
+        return f".index.{bucket}"
+
+    async def create_bucket(self, bucket: str, owner: str) -> None:
+        if not bucket or "/" in bucket:
+            raise RGWError(-EINVAL, f"bad bucket name {bucket!r}")
+        await self.get_user(owner)
+        buckets = await self._omap(self.meta, BUCKETS_OBJ)
+        if bucket in buckets:
+            rec = json.loads(buckets[bucket])
+            if rec["owner"] != owner:
+                raise RGWError(-EEXIST, f"bucket {bucket!r} taken")
+            return  # idempotent re-create by the owner, like S3
+        await self.meta.omap_set(BUCKETS_OBJ, {
+            bucket: json.dumps(
+                {"owner": owner, "created": _now()}
+            ).encode()
+        })
+        await self.data.omap_set(self._index_obj(bucket), {})
+
+    async def bucket_info(self, bucket: str) -> dict:
+        buckets = await self._omap(self.meta, BUCKETS_OBJ)
+        raw = buckets.get(bucket)
+        if raw is None:
+            raise RGWError(-ENOENT, f"no bucket {bucket!r}")
+        return json.loads(raw)
+
+    async def list_buckets(self, owner: str | None = None) -> list[str]:
+        out = []
+        for name, raw in (await self._omap(self.meta, BUCKETS_OBJ)).items():
+            if owner is None or json.loads(raw)["owner"] == owner:
+                out.append(name)
+        return sorted(out)
+
+    async def delete_bucket(self, bucket: str) -> None:
+        await self.bucket_info(bucket)
+        index = await self._omap(self.data, self._index_obj(bucket))
+        if index:
+            raise RGWError(-ENOTEMPTY, f"bucket {bucket!r} not empty")
+        try:
+            await self.data.remove(self._index_obj(bucket))
+        except RadosError as e:
+            if e.code != -ENOENT:
+                raise
+        await self.meta.omap_rmkeys(BUCKETS_OBJ, [bucket])
+
+    # -- objects (reference:src/rgw/rgw_op.cc put/get/delete/list) -----------
+    def _data_obj(self, bucket: str, key: str) -> StripedObject:
+        return StripedObject(self.data, f"{bucket}/{key}")
+
+    async def put_object(
+        self, bucket: str, key: str, data: bytes,
+        content_type: str = "binary/octet-stream",
+    ) -> dict:
+        await self.bucket_info(bucket)
+        if not key:
+            raise RGWError(-EINVAL, "empty object key")
+        sobj = self._data_obj(bucket, key)
+        old = await self._index_entry(bucket, key)
+        if old is not None:
+            await sobj.remove()  # overwrite drops the old extents
+        await sobj.write(data, 0)
+        entry = {
+            "size": len(data),
+            "etag": hashlib.md5(data).hexdigest(),
+            "mtime": _now(),
+            "content_type": content_type,
+        }
+        await self.data.omap_set(
+            self._index_obj(bucket), {key: json.dumps(entry).encode()}
+        )
+        return entry
+
+    async def get_object(self, bucket: str, key: str) -> tuple[bytes, dict]:
+        entry = await self.head_object(bucket, key)
+        data = await self._data_obj(bucket, key).read(0, entry["size"])
+        return data, entry
+
+    async def head_object(self, bucket: str, key: str) -> dict:
+        entry = await self._index_entry(bucket, key)
+        if entry is None:
+            raise RGWError(-ENOENT, f"no object {bucket}/{key}")
+        return entry
+
+    async def delete_object(self, bucket: str, key: str) -> None:
+        entry = await self._index_entry(bucket, key)
+        if entry is None:
+            raise RGWError(-ENOENT, f"no object {bucket}/{key}")
+        await self._data_obj(bucket, key).remove()
+        await self.data.omap_rmkeys(self._index_obj(bucket), [key])
+
+    async def copy_object(
+        self, src_bucket: str, src_key: str, dst_bucket: str, dst_key: str
+    ) -> dict:
+        data, entry = await self.get_object(src_bucket, src_key)
+        return await self.put_object(
+            dst_bucket, dst_key, data,
+            content_type=entry.get("content_type", "binary/octet-stream"),
+        )
+
+    async def list_objects(
+        self, bucket: str, prefix: str = "", marker: str = "",
+        max_keys: int = 1000, delimiter: str = "",
+    ) -> dict:
+        """The S3 ListObjects contract: sorted keys after ``marker``
+        under ``prefix``, collapsed into common prefixes at
+        ``delimiter`` (reference:rgw_op.cc RGWListBucket)."""
+        await self.bucket_info(bucket)
+        index = await self._omap(self.data, self._index_obj(bucket))
+        keys = sorted(
+            k for k in index
+            if k.startswith(prefix) and not k.startswith(".upload.")
+        )
+        contents: list[dict] = []
+        common: list[str] = []
+        truncated = False
+        last_item = ""  # key OR common prefix — next_marker must be the
+        # last item RETURNED, else delimiter pages repeat/loop (S3 rule)
+        for k in keys:
+            if k <= marker:
+                continue
+            if (delimiter and marker.endswith(delimiter)
+                    and k.startswith(marker)):
+                # the marker was a common prefix: its whole rolled-up
+                # group was already returned on the previous page
+                continue
+            if delimiter:
+                rest = k[len(prefix):]
+                cut = rest.find(delimiter)
+                if cut >= 0:
+                    cp = prefix + rest[: cut + len(delimiter)]
+                    if not common or common[-1] != cp:
+                        if len(contents) + len(common) >= max_keys:
+                            truncated = True
+                            break
+                        common.append(cp)
+                        last_item = cp
+                    continue
+            if len(contents) + len(common) >= max_keys:
+                truncated = True
+                break
+            contents.append({"key": k, **json.loads(index[k])})
+            last_item = k
+        return {
+            "contents": contents,
+            "common_prefixes": common,
+            "truncated": truncated,
+            "next_marker": last_item if truncated else "",
+        }
+
+    # -- multipart (reference:src/rgw/rgw_multi.cc) --------------------------
+    def _part_name(self, bucket: str, key: str, upload: str, n: int) -> str:
+        return f"{bucket}/{key}.{upload}.{n:05d}"
+
+    def _upload_key(self, key: str, upload: str) -> str:
+        return f".upload.{key}.{upload}"
+
+    def _part_key(self, key: str, upload: str, n: int) -> str:
+        return f".upload.{key}.{upload}.part.{n:05d}"
+
+    async def init_multipart(self, bucket: str, key: str) -> str:
+        await self.bucket_info(bucket)
+        upload = secrets.token_hex(8)
+        await self.data.omap_set(
+            self._index_obj(bucket),
+            {self._upload_key(key, upload): json.dumps(
+                {"key": key, "started": _now()}
+            ).encode()},
+        )
+        return upload
+
+    async def upload_part(
+        self, bucket: str, key: str, upload: str, part_num: int, data: bytes
+    ) -> dict:
+        """Each part is its OWN index key — concurrent part uploads
+        (standard S3 client behavior) must not lose each other in a
+        read-modify-write of shared metadata."""
+        await self._upload_meta(bucket, key, upload)
+        sobj = StripedObject(
+            self.data, self._part_name(bucket, key, upload, part_num)
+        )
+        await sobj.write(data, 0)
+        etag = hashlib.md5(data).hexdigest()
+        await self.data.omap_set(
+            self._index_obj(bucket),
+            {self._part_key(key, upload, part_num): json.dumps(
+                {"size": len(data), "etag": etag}
+            ).encode()},
+        )
+        return {"etag": etag}
+
+    async def _upload_parts(
+        self, bucket: str, key: str, upload: str
+    ) -> dict[int, dict]:
+        index = await self._omap(self.data, self._index_obj(bucket))
+        prefix = f"{self._upload_key(key, upload)}.part."
+        return {
+            int(k[len(prefix):]): json.loads(v)
+            for k, v in index.items() if k.startswith(prefix)
+        }
+
+    async def complete_multipart(
+        self, bucket: str, key: str, upload: str
+    ) -> dict:
+        """Assemble parts in part-number order into the final object
+        (reference completes by linking manifests; a copy-through is the
+        same contract at this scale)."""
+        await self._upload_meta(bucket, key, upload)
+        parts = await self._upload_parts(bucket, key, upload)
+        if not parts:
+            raise RGWError(-EINVAL, "no parts uploaded")
+        md5s = hashlib.md5()
+        total = 0
+        final = self._data_obj(bucket, key)
+        old = await self._index_entry(bucket, key)
+        if old is not None:
+            await final.remove()
+        for n in sorted(parts):
+            part = StripedObject(
+                self.data, self._part_name(bucket, key, upload, n)
+            )
+            data = await part.read()
+            await final.write(data, total)
+            total += len(data)
+            md5s.update(bytes.fromhex(parts[n]["etag"]))
+            await part.remove()
+        etag = f"{md5s.hexdigest()}-{len(parts)}"
+        entry = {
+            "size": total, "etag": etag, "mtime": _now(),
+            "content_type": "binary/octet-stream",
+        }
+        await self.data.omap_set(
+            self._index_obj(bucket), {key: json.dumps(entry).encode()}
+        )
+        await self.data.omap_rmkeys(
+            self._index_obj(bucket),
+            [self._upload_key(key, upload)]
+            + [self._part_key(key, upload, n) for n in parts],
+        )
+        return entry
+
+    async def abort_multipart(
+        self, bucket: str, key: str, upload: str
+    ) -> None:
+        await self._upload_meta(bucket, key, upload)
+        parts = await self._upload_parts(bucket, key, upload)
+        for n in parts:
+            await StripedObject(
+                self.data, self._part_name(bucket, key, upload, n)
+            ).remove()
+        await self.data.omap_rmkeys(
+            self._index_obj(bucket),
+            [self._upload_key(key, upload)]
+            + [self._part_key(key, upload, n) for n in parts],
+        )
+
+    # -- stats ----------------------------------------------------------------
+    async def bucket_stats(self, bucket: str) -> dict:
+        info = await self.bucket_info(bucket)
+        index = await self._omap(self.data, self._index_obj(bucket))
+        objs = [
+            json.loads(v) for k, v in index.items()
+            if not k.startswith(".upload.")
+        ]
+        return {
+            "bucket": bucket,
+            "owner": info["owner"],
+            "num_objects": len(objs),
+            "size_bytes": sum(o["size"] for o in objs),
+        }
+
+    # -- internals ------------------------------------------------------------
+    async def _omap(self, io: IoCtx, obj: str) -> dict[str, bytes]:
+        try:
+            return await io.omap_get(obj)
+        except RadosError as e:
+            if e.code == -ENOENT:
+                return {}
+            raise
+
+    async def _index_entry(self, bucket: str, key: str) -> dict | None:
+        index = await self._omap(self.data, self._index_obj(bucket))
+        raw = index.get(key)
+        return json.loads(raw) if raw is not None else None
+
+    async def _upload_meta(self, bucket: str, key: str, upload: str) -> dict:
+        index = await self._omap(self.data, self._index_obj(bucket))
+        raw = index.get(self._upload_key(key, upload))
+        if raw is None:
+            raise RGWError(-ENOENT, f"no upload {upload!r} for {key!r}")
+        return json.loads(raw)

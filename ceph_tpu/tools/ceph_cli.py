@@ -1,0 +1,99 @@
+"""ceph: the cluster-status CLI (reference:src/ceph.in).
+
+Stats commands (status/df/pg dump/metrics) are served by the active
+mgr — discovered through the map, like the reference's mon-to-mgr
+command forwarding; everything else goes to the mon.
+
+Usage:
+  ceph -m MON status
+  ceph -m MON df
+  ceph -m MON pg dump
+  ceph -m MON metrics          # prometheus exposition text
+  ceph -m MON mgr module ls
+  ceph -m MON osd dump
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from ..msg import messages
+from ..rados.client import RadosClient, RadosError
+
+MGR_COMMANDS = {"status", "df", "pg dump", "metrics", "mgr module ls"}
+
+
+async def _mgr_command(client: RadosClient, cmd: dict):
+    m = client.osdmap
+    if not m.mgr_addr:
+        print("error: no active mgr in the map", file=sys.stderr)
+        return 1, None
+    conn = await client.messenger.connect(m.mgr_addr, m.mgr_name)
+    reply = await client.command_on(conn, cmd)
+    if reply.code < 0:
+        print(f"error: {reply.status}", file=sys.stderr)
+        return 1, None
+    return 0, reply.out
+
+
+def _print_status(out: dict) -> None:
+    print(f"  health:  {out['health']}")
+    om = out["osdmap"]
+    print(f"  osd:     {om['num_osds']} osds: {om['num_up_osds']} up, "
+          f"{om['num_in_osds']} in (epoch {om['epoch']})")
+    mg = out["mgrmap"]
+    stand = f", standbys: {', '.join(mg['standbys'])}" if mg["standbys"] else ""
+    print(f"  mgr:     {mg['active'] or '(none)'}{stand}")
+    pm = out["pgmap"]
+    print(f"  data:    {pm['num_pools']} pools, {pm['num_pgs']} pgs, "
+          f"{pm['num_objects']} objects, {pm['data_bytes']} bytes")
+    io = out["io"]
+    print(f"  io:      {io['op_per_sec']:.0f} op/s, "
+          f"{io['rd_bytes_sec']:.0f} B/s rd, {io['wr_bytes_sec']:.0f} B/s wr")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ceph", description=__doc__)
+    p.add_argument("-m", "--mon", required=True)
+    p.add_argument("-f", "--format", choices=["plain", "json"],
+                   default="plain")
+    p.add_argument("words", nargs="+", help="command words")
+    args = p.parse_args(argv)
+    prefix = " ".join(args.words)
+    mon = args.mon.split(",") if "," in args.mon else args.mon
+
+    async def run() -> int:
+        client = await RadosClient(mon).connect()
+        try:
+            if prefix in MGR_COMMANDS:
+                rc, out = await _mgr_command(client, {"prefix": prefix})
+                if rc:
+                    return rc
+            else:
+                code, status, out = await client.command({"prefix": prefix})
+                if code < 0:
+                    print(f"error: {status}", file=sys.stderr)
+                    return 1
+            if args.format == "json":
+                print(json.dumps(out, indent=1, sort_keys=True))
+            elif prefix == "status" and isinstance(out, dict):
+                _print_status(out)
+            elif isinstance(out, str):
+                print(out, end="")
+            else:
+                print(json.dumps(out, indent=1, sort_keys=True))
+            return 0
+        except (RadosError, ConnectionError, TimeoutError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        finally:
+            await client.shutdown()
+
+    return asyncio.run(run())
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,105 @@
+"""rgw_admin: gateway administration (reference:src/rgw/rgw_admin.cc —
+the radosgw-admin command).
+
+Usage:
+  rgw_admin -m MON user create --uid alice [--display-name "Alice"]
+  rgw_admin -m MON user ls
+  rgw_admin -m MON user info --uid alice
+  rgw_admin -m MON user rm --uid alice
+  rgw_admin -m MON bucket ls [--uid alice]
+  rgw_admin -m MON bucket stats --bucket photos
+  rgw_admin -m MON serve [--host H] [--port P]     # run the S3 gateway
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from ..rados.client import RadosClient, RadosError
+from ..rgw import RGWStore
+from ..rgw.http import S3Server
+
+
+def _mon_arg(m: str) -> "str | list[str]":
+    return m.split(",") if "," in m else m
+
+
+async def _cmd_user(store: RGWStore, args) -> int:
+    if args.sub == "create":
+        rec = await store.create_user(args.uid, args.display_name or "")
+        print(json.dumps(rec, indent=1))
+    elif args.sub == "ls":
+        for uid in await store.list_users():
+            print(uid)
+    elif args.sub == "info":
+        print(json.dumps(await store.get_user(args.uid), indent=1))
+    elif args.sub == "rm":
+        await store.remove_user(args.uid)
+    return 0
+
+
+async def _cmd_bucket(store: RGWStore, args) -> int:
+    if args.sub == "ls":
+        for b in await store.list_buckets(args.uid):
+            print(b)
+    elif args.sub == "stats":
+        print(json.dumps(await store.bucket_stats(args.bucket), indent=1))
+    return 0
+
+
+async def _cmd_serve(store: RGWStore, args) -> int:
+    server = S3Server(store)
+    addr = await server.start(args.host, args.port)
+    print(f"rgw listening on {addr}", flush=True)
+    try:
+        await asyncio.Event().wait()  # serve until interrupted
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    finally:
+        await server.stop()
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="rgw_admin", description=__doc__)
+    p.add_argument("-m", "--mon", required=True)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    u = sub.add_parser("user")
+    u.add_argument("sub", choices=["create", "ls", "info", "rm"])
+    u.add_argument("--uid")
+    u.add_argument("--display-name")
+    b = sub.add_parser("bucket")
+    b.add_argument("sub", choices=["ls", "stats"])
+    b.add_argument("--uid", default=None)
+    b.add_argument("--bucket")
+    s = sub.add_parser("serve")
+    s.add_argument("--host", default="127.0.0.1")
+    s.add_argument("--port", type=int, default=0)
+    args = p.parse_args(argv)
+    if args.cmd == "user" and args.sub != "ls" and not args.uid:
+        p.error("--uid required")
+    if args.cmd == "bucket" and args.sub == "stats" and not args.bucket:
+        p.error("--bucket required")
+
+    async def run() -> int:
+        client = await RadosClient(_mon_arg(args.mon)).connect()
+        try:
+            store = await RGWStore.create(client)
+            fn = {"user": _cmd_user, "bucket": _cmd_bucket,
+                  "serve": _cmd_serve}[args.cmd]
+            return await fn(store, args)
+        except RadosError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        finally:
+            await client.shutdown()
+
+    return asyncio.run(run())
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,257 @@
+"""KeyValueDB + MonitorDBStore + offline tools tests.
+
+Reference intents: transactional KV metadata persistence
+(reference:src/kv/KeyValueDB.h), the mon's versioned store
+(reference:src/mon/MonitorDBStore.h), and the offline disaster tools
+(reference:src/tools/ceph_objectstore_tool.cc, ceph_monstore_tool.cc).
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from ceph_tpu.mon.store import MonitorDBStore
+from ceph_tpu.store.kv import FileKVDB, MemDB
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+# -- KeyValueDB --------------------------------------------------------------
+
+
+class TestKV:
+    def test_memdb_batches(self):
+        db = MemDB()
+        t = db.transaction()
+        t.set("p", "b", b"2").set("p", "a", b"1").set("q", "x", b"9")
+        db.submit(t)
+        assert db.get("p", "a") == b"1"
+        assert db.keys("p") == ["a", "b"]  # sorted iteration
+        db.submit(db.transaction().rmkey("p", "a").rmkeys_by_prefix("q"))
+        assert db.get("p", "a") is None
+        assert db.keys("q") == []
+
+    def test_filekv_durable(self, tmp_path):
+        path = str(tmp_path / "kv")
+        db = FileKVDB(path)
+        db.open()
+        for i in range(10):
+            db.set_one("maps", f"{i:04d}", f"map-{i}".encode())
+        db.submit(db.transaction().rmkey("maps", "0003"))
+        db.close()
+        db2 = FileKVDB(path)
+        db2.open()
+        assert db2.get("maps", "0007") == b"map-7"
+        assert db2.get("maps", "0003") is None
+        assert len(db2.keys("maps")) == 9
+        db2.close()
+
+    def test_filekv_survives_no_close(self, tmp_path):
+        """Journal-only state (no checkpoint) replays on open — the
+        process-crash contract."""
+        path = str(tmp_path / "kv")
+        db = FileKVDB(path)
+        db.open()
+        db.set_one("p", "k", b"v")
+        db._journal.close()  # crash: no checkpoint written
+        db2 = FileKVDB(path)
+        db2.open()
+        assert db2.get("p", "k") == b"v"
+        db2.close()
+
+    def test_filekv_torn_tail(self, tmp_path):
+        path = str(tmp_path / "kv")
+        db = FileKVDB(path)
+        db.open()
+        db.set_one("p", "good", b"1")
+        db.set_one("p", "torn", b"2")
+        db._journal.close()
+        # corrupt the last record's payload
+        j = os.path.join(path, "journal")
+        raw = bytearray(open(j, "rb").read())
+        raw[-1] ^= 0xFF
+        open(j, "wb").write(raw)
+        db2 = FileKVDB(path)
+        db2.open()
+        assert db2.get("p", "good") == b"1"
+        assert db2.get("p", "torn") is None  # torn record dropped
+        # and the db keeps working past the truncation
+        db2.set_one("p", "after", b"3")
+        db2.close()
+        db3 = FileKVDB(path)
+        db3.open()
+        assert db3.get("p", "after") == b"3"
+        db3.close()
+
+    def test_checkpoint_rollover(self, tmp_path):
+        path = str(tmp_path / "kv")
+        db = FileKVDB(path)
+        db.CHECKPOINT_EVERY = 512
+        db.open()
+        for i in range(50):
+            db.set_one("p", f"k{i}", b"x" * 64)
+        assert db._journal_bytes < 512  # rolled over at least once
+        db2 = FileKVDB(path)
+        db2.open()
+        assert len(db2.keys("p")) == 50
+        db2.close()
+        db.close()
+
+
+# -- MonitorDBStore ----------------------------------------------------------
+
+
+class TestMonStore:
+    def test_versions_and_prune(self, tmp_path):
+        s = MonitorDBStore(str(tmp_path / "mon"))
+        for e in range(1, 6):
+            s.save({"epoch": e, "marker": f"v{e}"}, election_epoch=e * 10)
+        assert s.last_committed() == 5
+        assert s.election_epoch() == 50
+        assert s.get_map()["marker"] == "v5"
+        assert s.get_map(2)["marker"] == "v2"
+        assert s.versions() == [1, 2, 3, 4, 5]
+        s.close()
+        s2 = MonitorDBStore(str(tmp_path / "mon"))
+        assert s2.get_map(4)["marker"] == "v4"
+        s2.close()
+
+    def test_mon_history_accumulates(self, tmp_path):
+        """A live mon's store keeps every committed epoch (the paxos
+        version history the monstore tool dumps)."""
+        from ceph_tpu.rados import MiniCluster
+
+        async def main():
+            store = str(tmp_path / "mon.0")
+            async with MiniCluster(
+                n_osds=3, store_dir=str(tmp_path / "osd"),
+            ) as cluster:
+                cluster.mon.store_path = store
+                from ceph_tpu.mon.store import MonitorDBStore as MDS
+
+                cluster.mon._db_store = MDS(store)
+                cl = await cluster.client()
+                await cl.create_pool("a", "replicated", size=3)
+                await cl.create_pool("b", "replicated", size=3)
+            s = MonitorDBStore(store)
+            assert len(s.versions()) >= 2
+            assert s.get_map()["epoch"] == s.last_committed()
+            s.close()
+
+        run(main())
+
+
+    def test_legacy_single_file_store_migrates(self, tmp_path):
+        """A pre-KV mon store (one JSON file) is migrated in place, not
+        clobbered."""
+        path = str(tmp_path / "mon.0.json")
+        with open(path, "w") as f:
+            json.dump({
+                "election_epoch": 7,
+                "osdmap": {"epoch": 42, "pools": {"1": {"name": "keep"}}},
+            }, f)
+        s = MonitorDBStore(path)
+        assert s.last_committed() == 42
+        assert s.election_epoch() == 7
+        assert s.get_map()["pools"]["1"]["name"] == "keep"
+        s.close()
+        assert os.path.isdir(path)
+        assert os.path.exists(path + ".legacy")
+
+
+# -- offline tools -----------------------------------------------------------
+
+
+ENV = None
+
+
+def _tool(mod: str, *args: str) -> str:
+    env = dict(os.environ, PYTHONPATH=os.getcwd() + ":" + os.environ.get(
+        "PYTHONPATH", ""))
+    r = subprocess.run(
+        [sys.executable, "-m", f"ceph_tpu.tools.{mod}", *args],
+        env=env, capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0, (args, r.stderr)
+    return r.stdout
+
+
+class TestObjectstoreTool:
+    def test_list_dump_export_import(self, tmp_path):
+        from ceph_tpu.rados import MiniCluster
+        from ceph_tpu.store.wal import WalStore
+
+        async def build():
+            async with MiniCluster(
+                n_osds=3, store_dir=str(tmp_path / "stores"),
+            ) as cluster:
+                cl = await cluster.client()
+                await cl.create_pool("p", "replicated", size=3)
+                io = cl.io_ctx("p")
+                await io.write_full("alpha", b"alpha-data")
+                await io.setxattr("alpha", "k", b"v")
+                await io.omap_set("alpha", {"ok": b"ov"})
+                await io.write_full("beta", b"beta-data")
+
+        run(build())
+        data_path = str(tmp_path / "stores" / "osd.0")
+        pgs = _tool("objectstore_tool", "--data-path", data_path,
+                    "--op", "list-pgs").split()
+        assert pgs, "no pgs found"
+        listing = _tool("objectstore_tool", "--data-path", data_path,
+                        "--op", "list")
+        rows = [json.loads(line) for line in listing.splitlines()]
+        names = {r[1] for r in rows}
+        assert {"alpha", "beta"} <= names
+        pgid = next(r[0] for r in rows if r[1] == "alpha")
+        dump = json.loads(_tool(
+            "objectstore_tool", "--data-path", data_path,
+            "--op", "dump", "--pgid", pgid, "--oid", "alpha",
+        ))
+        import base64
+
+        assert base64.b64decode(dump["data"]) == b"alpha-data"
+        assert "u_k" in dump["attrs"]
+        assert "ok" in dump["omap"]
+        # export -> import into a fresh store
+        exp = str(tmp_path / "pg.export")
+        _tool("objectstore_tool", "--data-path", data_path,
+              "--op", "export", "--pgid", pgid, "--file", exp)
+        dst = str(tmp_path / "fresh")
+        s = WalStore(dst)
+        s.mkfs()
+        s.mount()
+        s.umount()
+        _tool("objectstore_tool", "--data-path", dst,
+              "--op", "import", "--file", exp)
+        out = json.loads(_tool(
+            "objectstore_tool", "--data-path", dst,
+            "--op", "dump", "--pgid", pgid, "--oid", "alpha",
+        ))
+        assert base64.b64decode(out["data"]) == b"alpha-data"
+        # remove
+        _tool("objectstore_tool", "--data-path", dst,
+              "--op", "remove", "--pgid", pgid, "--oid", "alpha")
+        listing = _tool("objectstore_tool", "--data-path", dst, "--op", "list")
+        assert "alpha" not in listing
+
+
+class TestMonstoreTool:
+    def test_dump_and_get(self, tmp_path):
+        store = str(tmp_path / "mon")
+        s = MonitorDBStore(store)
+        s.save({"epoch": 1, "pools": {}}, election_epoch=3)
+        s.save({"epoch": 2, "pools": {}}, election_epoch=3)
+        s.close()
+        dump = json.loads(_tool("monstore_tool", store, "dump"))
+        assert dump["last_committed"] == 2
+        assert dump["versions"] == [1, 2]
+        m = json.loads(_tool("monstore_tool", store, "get-osdmap",
+                             "--version", "1"))
+        assert m["epoch"] == 1

@@ -1,0 +1,202 @@
+"""Manager daemon tests (reference:src/mgr/ intents).
+
+Beacon/active-standby failover through the mon, MPGStats ingest from
+OSDs, and the stats command surface (status/df/pg dump/metrics) the
+`ceph` CLI rides on.
+"""
+
+import asyncio
+import os
+import subprocess
+import sys
+
+import pytest
+
+from ceph_tpu.rados import MiniCluster
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+async def _mgr_cmd(cluster, client, prefix: str):
+    from ceph_tpu.tools.ceph_cli import _mgr_command
+
+    rc, out = await _mgr_command(client, {"prefix": prefix})
+    assert rc == 0, prefix
+    return out
+
+
+class TestMgrLifecycle:
+    def test_beacon_makes_active(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                mgr = await cluster.start_mgr("mgr.x")
+                active = await cluster.wait_for_active_mgr()
+                assert active == "mgr.x"
+                assert cluster.mon.osdmap.mgr_addr == mgr.addr
+                # a second mgr becomes a standby
+                await cluster.start_mgr("mgr.y")
+                await asyncio.sleep(0.3)
+                assert cluster.mon.osdmap.mgr_name == "mgr.x"
+                assert [n for n, _ in cluster.mon.osdmap.mgr_standbys] == [
+                    "mgr.y"
+                ]
+
+        run(main())
+
+    def test_failover_to_standby(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                await cluster.start_mgr("mgr.x")
+                await cluster.wait_for_active_mgr()
+                await cluster.start_mgr("mgr.y")
+                await asyncio.sleep(0.2)
+                cl = await cluster.client()
+                await cl.create_pool("p", "replicated", size=3)
+                await cl.io_ctx("p").write_full("o", b"x" * 1000)
+                await cluster.kill_mgr("mgr.x")
+                # the mon's beacon-staleness tick promotes the standby
+                async with asyncio.timeout(15):
+                    while cluster.mon.osdmap.mgr_name != "mgr.y":
+                        await asyncio.sleep(0.05)
+                active = await cluster.wait_for_active_mgr()
+                assert active == "mgr.y"
+                # OSD reports re-target the new active: its PGMap fills
+                async with asyncio.timeout(15):
+                    while True:
+                        st = await _mgr_cmd(cluster, cl, "status")
+                        if st["pgmap"]["num_objects"] >= 1:
+                            break
+                        await asyncio.sleep(0.1)
+
+        run(main())
+
+    def test_operator_mgr_fail(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                await cluster.start_mgr("mgr.x")
+                await cluster.wait_for_active_mgr()
+                cl = await cluster.client()
+                code, _s, _o = await cl.command({"prefix": "mgr fail"})
+                assert code == 0
+                assert cluster.mon.osdmap.mgr_name == ""
+
+        run(main())
+
+
+class TestMgrStats:
+    def test_status_df_pgdump_metrics(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                await cluster.start_mgr()
+                await cluster.wait_for_active_mgr()
+                cl = await cluster.client()
+                await cl.create_pool("data", "replicated", size=3)
+                io = cl.io_ctx("data")
+                payload = b"x" * 5000
+                for i in range(12):
+                    await io.write_full(f"obj{i}", payload)
+                # wait for reports to flow
+                mgr = next(iter(cluster.mgrs.values()))
+                async with asyncio.timeout(15):
+                    while True:
+                        st = await _mgr_cmd(cluster, cl, "status")
+                        if st["pgmap"]["num_objects"] >= 12:
+                            break
+                        await asyncio.sleep(0.1)
+                assert st["health"] == "HEALTH_OK"
+                assert st["osdmap"]["num_up_osds"] == 3
+                assert st["mgrmap"]["active"] == mgr.name
+                assert st["pgmap"]["data_bytes"] >= 12 * 5000
+
+                df = await _mgr_cmd(cluster, cl, "df")
+                pool_row = next(
+                    p for p in df["pools"] if p["name"] == "data"
+                )
+                assert pool_row["objects"] == 12
+                assert pool_row["bytes"] == 12 * 5000
+
+                dump = await _mgr_cmd(cluster, cl, "pg dump")
+                assert dump["num_pgs"] > 0
+                assert sum(p["objects"] for p in dump["pgs"]) == 12
+
+                metrics = await _mgr_cmd(cluster, cl, "metrics")
+                assert 'ceph_osd_op{daemon="osd.' in metrics
+                assert "ceph_pg_objects{" in metrics
+
+                mods = await _mgr_cmd(cluster, cl, "mgr module ls")
+                assert {"status", "df", "pg_dump", "prometheus"} <= set(mods)
+
+        run(main())
+
+    def test_io_rates_appear(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                from ceph_tpu.common import Config
+
+                # fast reporting so two samples land quickly
+                for osd in cluster.osds.values():
+                    osd.config.set("osd_mgr_report_interval", 0.1)
+                await cluster.start_mgr()
+                await cluster.wait_for_active_mgr()
+                cl = await cluster.client()
+                await cl.create_pool("p", "replicated", size=3)
+                io = cl.io_ctx("p")
+
+                async def writer():
+                    for i in range(60):
+                        await io.write_full(f"o{i % 4}", b"z" * 4096)
+                        await asyncio.sleep(0.01)
+
+                w = asyncio.ensure_future(writer())
+                try:
+                    async with asyncio.timeout(20):
+                        while True:
+                            st = await _mgr_cmd(cluster, cl, "status")
+                            if st["io"]["op_per_sec"] > 0:
+                                break
+                            await asyncio.sleep(0.1)
+                finally:
+                    w.cancel()
+
+        run(main())
+
+
+class TestCephCLI:
+    def test_ceph_status_cli(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                await cluster.start_mgr()
+                await cluster.wait_for_active_mgr()
+                cl = await cluster.client()
+                await cl.create_pool("p", "replicated", size=3)
+                await cl.io_ctx("p").write_full("o", b"hello")
+                await asyncio.sleep(1.2)  # one report cycle
+                env = dict(
+                    os.environ,
+                    PYTHONPATH=os.getcwd() + ":" + os.environ.get(
+                        "PYTHONPATH", ""
+                    ),
+                )
+                mon = cluster.mon.addr
+
+                def ceph(*words):
+                    r = subprocess.run(
+                        [sys.executable, "-m", "ceph_tpu.tools.ceph_cli",
+                         "-m", mon, *words],
+                        env=env, capture_output=True, text=True, timeout=60,
+                    )
+                    assert r.returncode == 0, (words, r.stderr)
+                    return r.stdout
+
+                out = await asyncio.to_thread(ceph, "status")
+                assert "health:" in out and "osd:" in out and "3 up" in out
+                out = await asyncio.to_thread(ceph, "-f", "json", "df")
+                assert '"pools"' in out
+                out = await asyncio.to_thread(ceph, "metrics")
+                assert "ceph_" in out
+                out = await asyncio.to_thread(ceph, "osd", "dump")
+                assert "epoch" in out
+
+        run(main())
