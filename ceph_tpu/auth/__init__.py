@@ -1,0 +1,172 @@
+"""CephX-style authentication (reference:src/auth/).
+
+The reference's CephX: every entity holds a shared secret in a
+keyring; the mon's auth service verifies an entity's key via
+nonce/HMAC challenge and issues time-limited service TICKETS sealed
+with the cluster's secret; daemons verify the ticket presented in the
+messenger handshake (``AuthAuthorizer``) without talking to the mon
+(reference:src/auth/cephx/CephxProtocol.h).
+
+Collapsed to its load-bearing parts (HMAC-SHA256 in place of the
+reference's AES construction — the trust model is identical):
+
+- :class:`Keyring` — entity name -> secret (file- or dict-backed).
+- The mon verifies ``auth get-ticket`` requests by HMAC over a fresh
+  client nonce and replies with a :class:`Ticket` sealed with the
+  CLUSTER secret.
+- Every daemon holds the cluster secret and verifies tickets inline
+  during the messenger handshake; daemons authorize each other with
+  the same mechanism (their tickets are self-issued since they hold
+  the cluster secret).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+import os
+import secrets as _secrets
+import time
+
+CLUSTER_ENTITY = "cluster"  # the keyring row daemons share
+TICKET_LIFETIME = 3600.0    # reference: auth_service_ticket_ttl
+
+
+def new_secret() -> str:
+    return _secrets.token_hex(16)
+
+
+def _sig(secret: str, payload: bytes) -> str:
+    return hmac.new(secret.encode(), payload, hashlib.sha256).hexdigest()
+
+
+class Keyring:
+    """entity -> secret (reference:src/auth/KeyRing.cc)."""
+
+    def __init__(self, keys: dict[str, str] | None = None):
+        self.keys = dict(keys or {})
+
+    @classmethod
+    def generate(cls, entities: list[str]) -> "Keyring":
+        kr = cls({CLUSTER_ENTITY: new_secret()})
+        for e in entities:
+            kr.add(e)
+        return kr
+
+    def add(self, entity: str, secret: str | None = None) -> str:
+        self.keys[entity] = secret or new_secret()
+        return self.keys[entity]
+
+    def get(self, entity: str) -> str | None:
+        return self.keys.get(entity)
+
+    @property
+    def cluster_secret(self) -> str:
+        return self.keys[CLUSTER_ENTITY]
+
+    # -- file form (ceph.keyring analog)
+    def save(self, path: str) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.keys, f, indent=1)
+        os.replace(tmp, path)
+
+    @classmethod
+    def load(cls, path: str) -> "Keyring":
+        with open(path) as f:
+            return cls(json.load(f))
+
+
+class Ticket:
+    """A sealed {entity, expires} claim (CephxTicketBlob analog)."""
+
+    @staticmethod
+    def issue(cluster_secret: str, entity: str,
+              lifetime: float = TICKET_LIFETIME) -> dict:
+        payload = {"entity": entity, "expires": time.time() + lifetime}
+        blob = json.dumps(payload, sort_keys=True).encode()
+        return {**payload, "sig": _sig(cluster_secret, blob)}
+
+    @staticmethod
+    def verify(cluster_secret: str, ticket: dict | None) -> str | None:
+        """Returns the authenticated entity, or None."""
+        if not isinstance(ticket, dict):
+            return None
+        payload = {
+            "entity": ticket.get("entity"),
+            "expires": ticket.get("expires"),
+        }
+        if not payload["entity"] or not isinstance(
+            payload["expires"], (int, float)
+        ):
+            return None
+        blob = json.dumps(payload, sort_keys=True).encode()
+        want = _sig(cluster_secret, blob)
+        if not hmac.compare_digest(want, str(ticket.get("sig", ""))):
+            return None
+        if payload["expires"] < time.time():
+            return None
+        return payload["entity"]
+
+
+def challenge_response(entity_secret: str, nonce: str) -> str:
+    """The client's proof of key possession (CephxAuthenticate analog)."""
+    return _sig(entity_secret, f"cephx-auth:{nonce}".encode())
+
+
+def daemon_auth_context(config, name: str) -> "AuthContext | None":
+    """The auth context a cluster daemon's messenger runs with: holds
+    the cluster secret (so it verifies peers and self-issues its own
+    ticket), enforcing when auth_supported=cephx."""
+    if getattr(config, "auth_supported", "none") != "cephx":
+        return None
+    kr = Keyring.load(config.keyring)
+    return AuthContext(
+        name, cluster_secret=kr.cluster_secret, require=True
+    )
+
+
+class AuthContext:
+    """What a messenger needs: my ticket to present, and (daemons) the
+    cluster secret to verify peers with."""
+
+    def __init__(self, entity: str, *, cluster_secret: str | None = None,
+                 require: bool = False):
+        self.entity = entity
+        self.cluster_secret = cluster_secret
+        self.require = require
+        self.ticket: dict | None = None
+        if cluster_secret is not None:
+            # a cluster-secret holder vouches for itself
+            self.ticket = Ticket.issue(cluster_secret, entity)
+
+    REFRESH_MARGIN = 60.0  # re-issue this close to expiry
+
+    def authorizer(self) -> dict | None:
+        if (
+            self.cluster_secret is not None
+            and self.ticket is not None
+            and self.ticket["expires"] < time.time() + self.REFRESH_MARGIN
+        ):
+            # cluster-secret holders re-vouch for themselves; ticketed
+            # clients refresh through the mon (RadosClient._authenticate)
+            self.ticket = Ticket.issue(self.cluster_secret, self.entity)
+        return self.ticket
+
+    def ticket_fresh(self) -> bool:
+        return (
+            self.ticket is not None
+            and self.ticket["expires"] >= time.time() + self.REFRESH_MARGIN
+        )
+
+    def verify(self, authorizer: dict | None) -> str | None:
+        """None = reject; entity name = accept.  Only meaningful on
+        daemons (cluster-secret holders)."""
+        if not self.require:
+            return "" if authorizer is None else (
+                Ticket.verify(self.cluster_secret or "", authorizer) or ""
+            )
+        if self.cluster_secret is None:
+            return ""  # cannot verify: not enforcing
+        return Ticket.verify(self.cluster_secret, authorizer)

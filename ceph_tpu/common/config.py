@@ -105,6 +105,10 @@ OPTIONS: dict[str, Option] = _opts(
     # admin
     Option("admin_socket", str, "",
            "unix socket path for perf dump / config commands ('' = off)"),
+    # auth (reference:src/auth; auth_supported / keyring options)
+    Option("auth_supported", str, "none",
+           "authentication: none | cephx (handshake tickets)"),
+    Option("keyring", str, "", "keyring file path (cephx)"),
     # debugging (reference:lockdep + HeartbeatMap thread timeouts)
     Option("lockdep", bool, False,
            "detect lock-order cycles on PG/daemon locks"),

@@ -58,6 +58,9 @@ class MDSDaemon(Dispatcher):
         self.mon_addr = mon_addr
         self.messenger = AsyncMessenger(name, self)
         self.messenger.apply_config(self.config)
+        from ..auth import daemon_auth_context
+
+        self.messenger.auth = daemon_auth_context(self.config, name)
         self.addr = ""
         self.active = False
         self.osdmap = None
@@ -76,7 +79,11 @@ class MDSDaemon(Dispatcher):
     # -- lifecycle -----------------------------------------------------------
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
         self.addr = await self.messenger.bind(host, port)
-        self.client = await RadosClient(self.mon_addr).connect()
+        self.client = RadosClient(self.mon_addr)
+        # the MDS's internal rados client is a cluster daemon: it talks
+        # to mon/OSDs with the cluster-secret-backed authorizer
+        self.client.messenger.auth = self.messenger.auth
+        await self.client.connect()
         for pool in (META_POOL, DATA_POOL):
             await self.client.create_pool(pool, "replicated")
         self.meta = self.client.io_ctx(META_POOL)

@@ -47,6 +47,9 @@ class MgrDaemon(Dispatcher):
         self.mon_addr = mon_addr
         self.messenger = AsyncMessenger(name, self)
         self.messenger.apply_config(self.config)
+        from ..auth import daemon_auth_context
+
+        self.messenger.auth = daemon_auth_context(self.config, name)
         self.osdmap: OSDMap | None = None
         self.addr = ""
         self.active = False

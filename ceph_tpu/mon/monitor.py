@@ -133,6 +133,17 @@ class Monitor(Dispatcher):
         self._svc_beacons: dict[tuple[str, str], float] = {}
         self._svc_fail_pending = {"mgr": False, "mds": False}
         self._tick_task: asyncio.Task | None = None
+        # -- auth (reference:src/mon/AuthMonitor.cc + CephX service)
+        self._keyring = None
+        if self.config.auth_supported == "cephx":
+            from ..auth import AuthContext, Keyring
+
+            self._keyring = Keyring.load(self.config.keyring)
+            self.messenger.auth = AuthContext(
+                name, cluster_secret=self._keyring.cluster_secret,
+                require=True,
+            )
+            self.messenger.auth_mon_mode = True
         self._db_store = None
         if store_path:
             from .store import MonitorDBStore
@@ -242,6 +253,14 @@ class Monitor(Dispatcher):
         # connection, and a handler awaiting a Paxos ack that arrives on
         # the SAME connection (forwarded reports ride the mon-peer conn)
         # would deadlock the reader loop (review r2 finding)
+        if isinstance(msg, messages.MAuth):
+            self._handle_auth(conn, msg)
+            return
+        if not conn.authenticated:
+            # unauthenticated conns exist only for the MAuth bootstrap
+            logger.warning("%s: dropping %s from unauthenticated %s",
+                           self.name, msg.TYPE, conn.peer_name)
+            return
         if isinstance(msg, messages.MOSDBoot):
             _bg(self._handle_boot(conn, msg))
         elif isinstance(msg, messages.MOSDFailure):
@@ -840,6 +859,50 @@ class Monitor(Dispatcher):
         self.osdmap.mark_in(osd)
         self._mark_dirty()
         return 0, "", None
+
+    # -- CephX auth service (reference:src/mon/AuthMonitor.cc +
+    # src/auth/cephx/CephxServiceHandler.cc) --------------------------------
+
+    def _handle_auth(self, conn: Connection, msg: "messages.MAuth") -> None:
+        from ..auth import Ticket, challenge_response, new_secret
+
+        if self._keyring is None:
+            conn.send(messages.MAuthReply(
+                tid=msg.tid, result=0, nonce=None, ticket=None,
+            ))  # auth off: everything is implicitly authorized
+            return
+        if msg.op == "get_nonce":
+            conn._auth_nonce = new_secret()
+            conn.send(messages.MAuthReply(
+                tid=msg.tid, result=0, nonce=conn._auth_nonce, ticket=None,
+            ))
+            return
+        if msg.op == "authenticate":
+            secret = self._keyring.get(msg.entity or "")
+            nonce = getattr(conn, "_auth_nonce", None)
+            if (
+                not secret or not nonce
+                or challenge_response(secret, nonce) != msg.proof
+            ):
+                logger.warning("%s: auth FAILED for %r",
+                               self.name, msg.entity)
+                conn.send(messages.MAuthReply(
+                    tid=msg.tid, result=-13, nonce=None, ticket=None,
+                ))
+                return
+            conn._auth_nonce = None  # single use
+            conn.authenticated = True
+            conn.peer_name = msg.entity
+            conn.send(messages.MAuthReply(
+                tid=msg.tid, result=0, nonce=None,
+                ticket=Ticket.issue(
+                    self._keyring.cluster_secret, msg.entity
+                ),
+            ))
+            return
+        conn.send(messages.MAuthReply(
+            tid=msg.tid, result=-EINVAL, nonce=None, ticket=None,
+        ))
 
     # -- active/standby service lifecycle: mgr AND mds share the beacon
     # machinery (reference:src/mon/MgrMonitor.cc beacon handling,

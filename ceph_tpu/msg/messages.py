@@ -338,6 +338,24 @@ class MPGStats(Message):
 
 
 @register
+class MAuth(Message):
+    """Client -> mon CephX bootstrap (reference:src/messages/MAuth.h).
+    op = "get_nonce" | "authenticate" (with entity + proof)."""
+
+    TYPE = "auth"
+    FIELDS = ("tid", "op", "entity", "proof")
+
+
+@register
+class MAuthReply(Message):
+    """reference:src/messages/MAuthReply.h; carries the service ticket
+    on success."""
+
+    TYPE = "auth_reply"
+    FIELDS = ("tid", "result", "nonce", "ticket")
+
+
+@register
 class MClientRequest(Message):
     """CephFS client -> MDS metadata op (reference:src/messages/
     MClientRequest.h).  ``op`` names the call, ``args`` its parameters."""

@@ -50,6 +50,8 @@ class Connection:
         self._writer = writer
         self.peer_name: str = "?"
         self.peer_addr: str = ""
+        self.authenticated = True  # False only on a mon awaiting MAuth
+        self.auth_entity = ""      # ticket-verified identity (cephx)
         self._send_seq = 0
         self._sendq: asyncio.Queue[Optional[bytes]] = asyncio.Queue()
         self._tasks: list[asyncio.Task] = []
@@ -140,6 +142,11 @@ class AsyncMessenger:
         self._pending: dict[str, asyncio.Future] = {}  # in-flight connects
         self._all: set[Connection] = set()
         self._stopped = False
+        # CephX-style handshake auth (reference AuthAuthorizer in the
+        # messenger handshake): when set, outbound banners carry the
+        # ticket and inbound banners are verified (see _accept)
+        self.auth = None  # ceph_tpu.auth.AuthContext | None
+        self.auth_mon_mode = False  # mon: admit unauth conns for MAuth
 
     def apply_config(self, cfg) -> None:
         """Adopt the ms_* options from a Config."""
@@ -192,11 +199,31 @@ class AsyncMessenger:
             banner = json.loads((await reader.readline()).decode())
             conn.peer_name = banner["entity"]
             conn.peer_addr = banner.get("addr", "")
+            if self.auth is not None and self.auth.require:
+                # the TICKET's entity is the authenticated identity; the
+                # banner name is just the instance label (many clients
+                # share one keyring entity, like client.admin)
+                entity = self.auth.verify(banner.get("authorizer"))
+                conn.auth_entity = entity or ""
+                if entity is None:
+                    if self.auth_mon_mode:
+                        # the mon admits the conn but only for the MAuth
+                        # exchange (the CephX bootstrap); the dispatcher
+                        # gates everything else on conn.authenticated
+                        conn.authenticated = False
+                    else:
+                        writer.write(
+                            json.dumps({"error": "auth failed"}).encode()
+                            + b"\n"
+                        )
+                        await writer.drain()
+                        writer.close()
+                        return
             writer.write(
                 json.dumps({"entity": self.name, "addr": self.addr}).encode() + b"\n"
             )
             await writer.drain()
-        except (ValueError, KeyError, ConnectionError, OSError):
+        except (ValueError, KeyError, TypeError, ConnectionError, OSError):
             writer.close()
             return
         self._start(conn)
@@ -238,6 +265,8 @@ class AsyncMessenger:
                 )
             try:
                 return await self._dial(addr, peer_name)
+            except PermissionError:
+                raise  # deterministic auth rejection: do not retry
             except (ConnectionError, OSError, TimeoutError) as e:
                 last = e
         raise ConnectionError(
@@ -254,11 +283,12 @@ class AsyncMessenger:
                 conn = Connection(self, reader, writer)
                 conn.peer_addr = addr
                 conn.peer_name = peer_name
-                writer.write(
-                    json.dumps(
-                        {"entity": self.name, "addr": self.addr}
-                    ).encode() + b"\n"
-                )
+                out_banner = {"entity": self.name, "addr": self.addr}
+                if self.auth is not None:
+                    authz = self.auth.authorizer()
+                    if authz is not None:
+                        out_banner["authorizer"] = authz
+                writer.write(json.dumps(out_banner).encode() + b"\n")
                 await writer.drain()
                 line = await reader.readline()
                 if not line:
@@ -269,7 +299,15 @@ class AsyncMessenger:
                     )
                 try:
                     banner = json.loads(line.decode())
+                    if isinstance(banner, dict) and "error" in banner:
+                        # a deliberate rejection (auth): retrying is
+                        # pointless and the caller must see WHY
+                        raise PermissionError(
+                            f"{addr}: {banner['error']}"
+                        )
                     conn.peer_name = banner["entity"]
+                except PermissionError:
+                    raise
                 except (ValueError, KeyError, TypeError) as e:
                     raise ConnectionResetError(
                         f"{addr}: bad handshake banner: {e!r}"

@@ -195,6 +195,9 @@ class OSD(Dispatcher):
         self.mon_addr = mon_addr
         self.messenger = AsyncMessenger(self.name, self)
         self.messenger.apply_config(cfg)
+        from ..auth import daemon_auth_context
+
+        self.messenger.auth = daemon_auth_context(cfg, self.name)
         self.store = store or MemStore()
         self.subop_timeout = (
             cfg.osd_subop_timeout if subop_timeout is None else subop_timeout

@@ -28,6 +28,7 @@ _client_counter = itertools.count(1)
 
 ENOENT = 2
 EAGAIN = 11
+EACCES = 13
 
 
 class RadosError(OSError):
@@ -40,8 +41,14 @@ class RadosClient(Dispatcher):
     """Cluster handle: mon session + map + op submission."""
 
     def __init__(self, mon_addr: "str | list[str]", name: str | None = None,
-                 op_timeout: float = 10.0, max_retries: int = 8):
+                 op_timeout: float = 10.0, max_retries: int = 8,
+                 auth_entity: str | None = None,
+                 auth_secret: str | None = None):
         self.name = name or f"client.{next(_client_counter)}"
+        # cephx: entity + secret prove key possession to the mon, which
+        # returns the ticket every later handshake presents
+        self.auth_entity = auth_entity
+        self.auth_secret = auth_secret
         self.mon_addr = mon_addr
         self.messenger = AsyncMessenger(self.name, self)
         self.osdmap: OSDMap | None = None
@@ -87,8 +94,49 @@ class RadosClient(Dispatcher):
                 await self._wait_for_map_change(-1, 10.0)
         return self
 
+    async def _authenticate(self, mon: Connection) -> None:
+        """CephX bootstrap (reference:MonClient::authenticate): prove key
+        possession over a mon nonce, pocket the ticket — every later
+        handshake (OSDs, other mons) presents it."""
+        from ..auth import AuthContext, challenge_response
+
+        if self.auth_secret is None or (
+            self.messenger.auth is not None
+            and self.messenger.auth.ticket_fresh()
+        ):
+            return
+        r1 = await self._auth_roundtrip(mon, {"op": "get_nonce"})
+        if r1.result < 0:
+            raise RadosError(r1.result, "auth: no nonce")
+        if not r1.nonce:
+            return  # the mon runs with auth off: nothing to prove
+        r2 = await self._auth_roundtrip(mon, {
+            "op": "authenticate",
+            "entity": self.auth_entity or self.name,
+            "proof": challenge_response(self.auth_secret, r1.nonce),
+        })
+        if r2.result < 0 or not r2.ticket:
+            raise RadosError(r2.result or -EACCES, "authentication failed")
+        ctx = AuthContext(self.auth_entity or self.name)
+        ctx.ticket = r2.ticket
+        self.messenger.auth = ctx
+
+    async def _auth_roundtrip(self, conn: Connection, fields: dict):
+        tid = next(self._tid)
+        fut = asyncio.get_running_loop().create_future()
+        self._op_futs[tid] = fut
+        self._fut_conns[tid] = conn
+        try:
+            conn.send(messages.MAuth(tid=tid, **fields))
+            async with asyncio.timeout(self.op_timeout):
+                return await fut
+        finally:
+            self._op_futs.pop(tid, None)
+            self._fut_conns.pop(tid, None)
+
     async def _subscribe(self) -> None:
         mon = await self._mon_conn()
+        await self._authenticate(mon)
         self._sub_conn = mon
         mon.send(messages.MMonGetMap(
             have=self.osdmap.epoch if self.osdmap else 0
@@ -135,6 +183,7 @@ class RadosClient(Dispatcher):
                 messages.MOSDScrubReply,
                 messages.MPGLsReply,
                 messages.MClientReply,
+                messages.MAuthReply,
             ),
         ):
             fut = self._op_futs.pop(msg.tid, None)
@@ -315,6 +364,17 @@ class RadosClient(Dispatcher):
         if op_timeout is None:
             op_timeout = self.op_timeout
         last_err: Exception | None = None
+        if (
+            self.auth_secret is not None
+            and self.messenger.auth is not None
+            and not self.messenger.auth.ticket_fresh()
+        ):
+            # a near-expiry ticket would fail the NEXT OSD handshake:
+            # refresh through the mon before dialing (cephx renewal)
+            try:
+                await self._authenticate(await self._mon_conn())
+            except (ConnectionError, OSError):
+                pass  # mon hunting happens below anyway
         for attempt in range(self.max_retries):
             epoch = self.osdmap.epoch
             pool = self.osdmap.lookup_pool(pool_name)

@@ -31,8 +31,23 @@ class MiniCluster:
         n_mons: int = 1,
         mon_config=None,
         crush_hosts: "list[list[int]] | None" = None,
+        auth: bool = False,
     ):
         self.n_osds = n_osds
+        # cephx: one generated keyring shared by all daemons + the admin
+        # client (the vstart --cephx flow)
+        self.auth = auth
+        self.keyring = None
+        self._keyring_path = None
+        if auth:
+            import tempfile
+
+            from ..auth import Keyring
+
+            self.keyring = Keyring.generate(["client.admin"])
+            fd, self._keyring_path = tempfile.mkstemp(suffix=".keyring")
+            os.close(fd)
+            self.keyring.save(self._keyring_path)
         self.heartbeat_interval = heartbeat_interval
         self.mons: dict[int, Monitor] = {}
         self.crush_hosts = crush_hosts
@@ -40,6 +55,14 @@ class MiniCluster:
             max_osds=n_osds, failure_min_reporters=failure_min_reporters,
             config=mon_config,
         )
+        if auth and mon_config is not None:
+            raise ValueError(
+                "auth=True manages the mon config itself; a custom "
+                "mon_config would leave the mons un-keyringed while "
+                "every other daemon enforces cephx"
+            )
+        if auth:
+            self._mon_args["config"] = self._daemon_config()
         self.n_mons = n_mons
         self.store_dir = store_dir
         for rank in range(n_mons):
@@ -61,6 +84,17 @@ class MiniCluster:
         self.mdss: dict[str, "object"] = {}  # name -> MDSDaemon
         self._mds_seq = 0
         self._clients: list[RadosClient] = []
+
+    def _daemon_config(self):
+        """A fresh Config carrying the cephx knobs (None when auth is
+        off, so daemons keep their own defaults)."""
+        if not self.auth:
+            return None
+        from ..common import Config
+
+        return Config(overrides={
+            "auth_supported": "cephx", "keyring": self._keyring_path,
+        })
 
     def _make_store(self, osd_id: int) -> ObjectStore:
         if self.store_dir is None:
@@ -141,6 +175,7 @@ class MiniCluster:
         osd = OSD(
             osd_id, self.monmap or self.mon.addr, store=store,
             heartbeat_interval=self.heartbeat_interval,
+            config=self._daemon_config(),
         )
         await osd.start()
         self.osds[osd_id] = osd
@@ -185,6 +220,11 @@ class MiniCluster:
                 await asyncio.sleep(0.005)
 
     async def client(self, **kw) -> RadosClient:
+        if self.auth and "auth_secret" not in kw:
+            kw.setdefault("auth_entity", "client.admin")
+            kw.setdefault(
+                "auth_secret", self.keyring.get("client.admin")
+            )
         cl = await RadosClient(
             self.monmap or self.mon.addr, **kw
         ).connect()
@@ -197,7 +237,8 @@ class MiniCluster:
 
         self._mgr_seq += 1
         name = name or f"mgr.{self._mgr_seq}"
-        mgr = MgrDaemon(name, self.monmap or self.mon.addr, config=config)
+        mgr = MgrDaemon(name, self.monmap or self.mon.addr,
+                        config=config or self._daemon_config())
         await mgr.start()
         self.mgrs[name] = mgr
         return mgr
@@ -220,7 +261,8 @@ class MiniCluster:
 
         self._mds_seq += 1
         name = name or f"mds.{self._mds_seq}"
-        mds = MDSDaemon(name, self.monmap or self.mon.addr, config=config)
+        mds = MDSDaemon(name, self.monmap or self.mon.addr,
+                        config=config or self._daemon_config())
         await mds.start()
         self.mdss[name] = mds
         return mds
@@ -248,6 +290,12 @@ class MiniCluster:
             await self.kill_osd(osd_id)
         for rank in list(self.mons):
             await self.mons.pop(rank).stop()
+        if self._keyring_path is not None:
+            try:
+                os.unlink(self._keyring_path)  # secret-bearing tmp file
+            except OSError:
+                pass
+            self._keyring_path = None
 
     async def __aenter__(self) -> "MiniCluster":
         return await self.start()

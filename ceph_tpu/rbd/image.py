@@ -107,11 +107,13 @@ class Image:
         self.snap_name: str | None = None  # opened-at-snap (read-only)
         self._watch_cookie: str | None = None
         self._closed = False
+        self._cache = None  # librbd-style writeback cache (opt-in)
 
     # -- lifecycle ---------------------------------------------------------
     @classmethod
     async def open(
-        cls, io: IoCtx, name: str, snap_name: str | None = None
+        cls, io: IoCtx, name: str, snap_name: str | None = None,
+        cache_bytes: int = 0,
     ) -> "Image":
         d = {}
         try:
@@ -124,6 +126,13 @@ class Image:
             raise RbdError(-ENOENT, f"no image {name!r}")
         img = cls(io, name, raw.decode())
         await img._refresh()
+        if cache_bytes > 0 and snap_name is None:
+            # the librbd object cache (reference:librbd cache over
+            # ObjectCacher); snapshots read uncached (set_read routing
+            # happens below the cache)
+            from ..rados.object_cacher import ObjectCacher
+
+            img._cache = ObjectCacher(img.io, max_bytes=cache_bytes)
         if snap_name is not None:
             img.set_snap(snap_name)
         # watch the header: other clients' resizes/snap ops invalidate us
@@ -137,6 +146,7 @@ class Image:
         if self._closed:
             return
         self._closed = True
+        await self._cache_flush()
         if self._watch_cookie is not None:
             try:
                 await self.io.unwatch(self._watch_cookie)
@@ -157,7 +167,13 @@ class Image:
 
     def _header_notify(self, notifier: str, payload: bytes):
         # run the refresh asynchronously; the ack must not wait on I/O
-        return self._refresh()
+        async def refresh_and_drop():
+            await self._refresh()
+            # another client changed the image (rollback/resize/...):
+            # cached data may be stale now
+            await self._cache_drop()
+
+        return refresh_and_drop()
 
     # -- layout ------------------------------------------------------------
     @property
@@ -208,9 +224,11 @@ class Image:
         for objectno, obj_off, run in self._extents(offset, len(data)):
             chunk = data[pos : pos + run]
             pos += run
-            ops.append(
-                self.io.write(self._data_name(objectno), chunk, offset=obj_off)
-            )
+            name = self._data_name(objectno)
+            if self._cache is not None:
+                ops.append(self._cache.write(name, chunk, offset=obj_off))
+            else:
+                ops.append(self.io.write(name, chunk, offset=obj_off))
         await asyncio.gather(*ops)
         return len(data)
 
@@ -226,10 +244,12 @@ class Image:
             return b""
 
         async def fetch(objectno: int, obj_off: int, run: int) -> bytes:
+            name = self._data_name(objectno)
             try:
-                got = await self.io.read(
-                    self._data_name(objectno), obj_off, run
-                )
+                if self._cache is not None and self.snap_name is None:
+                    got = await self._cache.read(name, obj_off, run)
+                else:
+                    got = await self.io.read(name, obj_off, run)
             except RadosError as e:
                 if e.code != -ENOENT:
                     raise
@@ -256,17 +276,34 @@ class Image:
 
     async def _remove_quiet(self, name: str) -> None:
         try:
-            await self.io.remove(name)
+            if self._cache is not None:
+                await self._cache.remove(name)
+            else:
+                await self.io.remove(name)
         except RadosError as e:
             if e.code != -ENOENT:
                 raise
 
     async def _zero_quiet(self, name: str, off: int, ln: int) -> None:
         try:
-            await self.io.zero(name, off, ln)
+            if self._cache is not None:
+                # match the uncached path's existence semantics: zeroing
+                # a never-written object must NOT materialize it
+                await self._cache.read(name, 0, 0)
+                await self._cache.write(name, b"\x00" * ln, offset=off)
+            else:
+                await self.io.zero(name, off, ln)
         except RadosError as e:
             if e.code != -ENOENT:
                 raise
+
+    async def _cache_flush(self) -> None:
+        if self._cache is not None:
+            await self._cache.flush()
+
+    async def _cache_drop(self) -> None:
+        if self._cache is not None:
+            await self._cache.invalidate()
 
     # -- metadata ----------------------------------------------------------
     async def resize(self, new_size: int) -> None:
@@ -328,6 +365,8 @@ class Image:
         self._check_open_rw()
         if snap_name in self.snaps:
             raise RbdError(-EEXIST, f"snap {snap_name!r} exists")
+        # dirty cached writes must be IN the snapshot
+        await self._cache_flush()
         snapid = await self.io.selfmanaged_snap_create()
         self.snaps[snap_name] = {"id": snapid, "size": self.size_bytes}
         self._apply_snapc()
@@ -349,6 +388,9 @@ class Image:
         s = self.snaps.get(snap_name)
         if s is None:
             raise RbdError(-ENOENT, f"no snap {snap_name!r}")
+        # rollback rewrites objects server-side: cached state is stale
+        await self._cache_flush()
+        await self._cache_drop()
         snapid, snap_size = int(s["id"]), int(s["size"])
         max_size = max(self.size_bytes, snap_size)
         count = -(-max_size // self.object_size)
