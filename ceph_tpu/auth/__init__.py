@@ -68,7 +68,10 @@ class Keyring:
     # -- file form (ceph.keyring analog)
     def save(self, path: str) -> None:
         tmp = path + ".tmp"
-        with open(tmp, "w") as f:
+        # 0600: the file holds every secret in the cluster — a
+        # world-readable keyring lets any local user mint tickets
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
             json.dump(self.keys, f, indent=1)
         os.replace(tmp, path)
 

@@ -50,10 +50,17 @@ def data_obj(ino: int) -> str:
 class MDSDaemon(Dispatcher):
     """Active-or-standby metadata server."""
 
-    def __init__(self, name: str, mon_addr: "str | list[str]", config=None):
+    def __init__(self, name: str, mon_addr: "str | list[str]", config=None,
+                 data_pool_type: str = "replicated",
+                 data_profile: str | None = None):
         from ..common import Config
 
         self.config = config or Config()
+        # data objects are striped byte streams (no omap): an EC data
+        # pool works; the omap-bearing metadata pool stays replicated
+        # (the reference's cephfs EC-data-pool layout)
+        self.data_pool_type = data_pool_type
+        self.data_profile = data_profile
         self.name = name
         self.mon_addr = mon_addr
         self.messenger = AsyncMessenger(name, self)
@@ -84,8 +91,11 @@ class MDSDaemon(Dispatcher):
         # to mon/OSDs with the cluster-secret-backed authorizer
         self.client.messenger.auth = self.messenger.auth
         await self.client.connect()
-        for pool in (META_POOL, DATA_POOL):
-            await self.client.create_pool(pool, "replicated")
+        await self.client.create_pool(META_POOL, "replicated")
+        kw = {}
+        if self.data_pool_type == "erasure" and self.data_profile:
+            kw["erasure_code_profile"] = self.data_profile
+        await self.client.create_pool(DATA_POOL, self.data_pool_type, **kw)
         self.meta = self.client.io_ctx(META_POOL)
         self.data = self.client.io_ctx(DATA_POOL)
         # NO journal recovery here: a STANDBY replaying (and trimming)

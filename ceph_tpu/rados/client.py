@@ -300,6 +300,8 @@ class RadosClient(Dispatcher):
             try:
                 conn = await self._mon_conn(target)
                 reply = await self.command_on(conn, cmd)
+            except PermissionError as e:
+                raise RadosError(-EACCES, str(e)) from e
             except (ConnectionError, OSError, TimeoutError):
                 target = None  # hunt any live mon next round
                 await asyncio.sleep(0.2)
@@ -400,6 +402,12 @@ class RadosClient(Dispatcher):
                 )
                 async with asyncio.timeout(op_timeout):
                     reply = await fut
+            except PermissionError as e:
+                # deterministic auth rejection from the OSD handshake:
+                # retrying is pointless and hides WHY
+                self._op_futs.pop(tid, None)
+                self._fut_conns.pop(tid, None)
+                raise RadosError(-EACCES, str(e)) from e
             except (ConnectionError, OSError, TimeoutError) as e:
                 self._op_futs.pop(tid, None)
                 self._fut_conns.pop(tid, None)
@@ -450,6 +458,10 @@ class RadosClient(Dispatcher):
                     )
                 await self._wait_for_map_change(epoch, 2.0)
                 continue
+            except PermissionError as e:
+                self._op_futs.pop(tid, None)
+                self._fut_conns.pop(tid, None)
+                raise RadosError(-EACCES, str(e)) from e
             except (ConnectionError, OSError):
                 self._op_futs.pop(tid, None)
                 self._fut_conns.pop(tid, None)

@@ -256,13 +256,13 @@ class MiniCluster:
                 await asyncio.sleep(0.01)
 
     # -- mds (reference:src/mds; vstart's MDS_COUNT) ------------------------
-    async def start_mds(self, name: str | None = None, config=None):
+    async def start_mds(self, name: str | None = None, config=None, **kw):
         from ..mds import MDSDaemon
 
         self._mds_seq += 1
         name = name or f"mds.{self._mds_seq}"
         mds = MDSDaemon(name, self.monmap or self.mon.addr,
-                        config=config or self._daemon_config())
+                        config=config or self._daemon_config(), **kw)
         await mds.start()
         self.mdss[name] = mds
         return mds

@@ -64,17 +64,21 @@ class ObjectCacher:
         obj = CachedObject(data, exists)
         self._objs[oid] = obj
         self._bytes += len(data)
-        await self._evict()
+        await self._evict(keep=oid)
         return obj
 
-    async def _evict(self) -> None:
+    async def _evict(self, keep: str | None = None) -> None:
         """LRU eviction; dirty victims flush first (reference
-        ObjectCacher::trim)."""
-        while self._bytes > self.max_bytes and self._objs:
-            oid, obj = next(iter(self._objs.items()))
+        ObjectCacher::trim).  ``keep`` is the object the caller is
+        actively mutating: evicting it mid-operation would orphan the
+        CachedObject and silently lose the dirty write."""
+        while self._bytes > self.max_bytes:
+            victim = next((k for k in self._objs if k != keep), None)
+            if victim is None:
+                return  # only the in-use object remains: keep it cached
+            obj = self._objs.pop(victim)
             if obj.dirty:
-                await self._flush_one(oid, obj)
-            del self._objs[oid]
+                await self._flush_one(victim, obj)
             self._bytes -= len(obj.data)
 
     async def _flush_one(self, oid: str, obj: CachedObject) -> None:
@@ -107,7 +111,7 @@ class ObjectCacher:
             obj.dirty = True
             if not self.write_back:
                 await self._flush_one(oid, obj)
-            await self._evict()
+            await self._evict(keep=oid)
 
     async def write_full(self, oid: str, data: bytes) -> None:
         async with self._lock:
@@ -115,13 +119,15 @@ class ObjectCacher:
             if obj is None:
                 obj = CachedObject(bytearray(), False)
                 self._objs[oid] = obj
+            else:
+                self._objs.move_to_end(oid)  # hot: refresh LRU position
             self._bytes += len(data) - len(obj.data)
             obj.data = bytearray(data)
             obj.exists = True
             obj.dirty = True
             if not self.write_back:
                 await self._flush_one(oid, obj)
-            await self._evict()
+            await self._evict(keep=oid)
 
     async def remove(self, oid: str) -> None:
         async with self._lock:

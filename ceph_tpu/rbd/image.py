@@ -285,11 +285,10 @@ class Image:
                 raise
 
     async def _zero_quiet(self, name: str, off: int, ln: int) -> None:
+        # both paths materialize a zero-filled object if absent — the
+        # OSD zero op creates-on-write, and the cached path must match
         try:
             if self._cache is not None:
-                # match the uncached path's existence semantics: zeroing
-                # a never-written object must NOT materialize it
-                await self._cache.read(name, 0, 0)
                 await self._cache.write(name, b"\x00" * ln, offset=off)
             else:
                 await self.io.zero(name, off, ln)

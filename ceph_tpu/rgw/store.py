@@ -14,6 +14,7 @@ from ..rados.striper import StripedObject
 
 META_POOL = ".rgw.meta"
 DATA_POOL = ".rgw.buckets"
+INDEX_POOL = ".rgw.buckets.index"  # omap lives here; data pool may be EC
 USERS_OBJ = "users"
 BUCKETS_OBJ = "buckets"
 
@@ -37,14 +38,26 @@ class RGWStore:
     def __init__(self, client: RadosClient):
         self.client = client
         self.meta = client.io_ctx(META_POOL)
+        self.index = client.io_ctx(INDEX_POOL)
         self.data = client.io_ctx(DATA_POOL)
 
     @classmethod
-    async def create(cls, client: RadosClient) -> "RGWStore":
+    async def create(
+        cls, client: RadosClient,
+        data_pool_type: str = "replicated",
+        data_profile: str | None = None,
+    ) -> "RGWStore":
         """Bootstrap: ensure the gateway pools exist
-        (reference:rgw_rados.cc open_root_pool-style lazy creation)."""
-        for pool in (META_POOL, DATA_POOL):
+        (reference:rgw_rados.cc open_root_pool-style lazy creation).
+        ``data_pool_type="erasure"`` puts object DATA on an EC pool —
+        the omap-bearing index/meta pools stay replicated, the
+        reference's .rgw.buckets.index split."""
+        for pool in (META_POOL, INDEX_POOL):
             await client.create_pool(pool, "replicated")
+        kw = {}
+        if data_pool_type == "erasure" and data_profile:
+            kw["erasure_code_profile"] = data_profile
+        await client.create_pool(DATA_POOL, data_pool_type, **kw)
         return cls(client)
 
     # -- users (reference:src/rgw/rgw_user.cc) -------------------------------
@@ -108,7 +121,7 @@ class RGWStore:
                 {"owner": owner, "created": _now()}
             ).encode()
         })
-        await self.data.omap_set(self._index_obj(bucket), {})
+        await self.index.omap_set(self._index_obj(bucket), {})
 
     async def bucket_info(self, bucket: str) -> dict:
         buckets = await self._omap(self.meta, BUCKETS_OBJ)
@@ -126,11 +139,11 @@ class RGWStore:
 
     async def delete_bucket(self, bucket: str) -> None:
         await self.bucket_info(bucket)
-        index = await self._omap(self.data, self._index_obj(bucket))
+        index = await self._omap(self.index, self._index_obj(bucket))
         if index:
             raise RGWError(-ENOTEMPTY, f"bucket {bucket!r} not empty")
         try:
-            await self.data.remove(self._index_obj(bucket))
+            await self.index.remove(self._index_obj(bucket))
         except RadosError as e:
             if e.code != -ENOENT:
                 raise
@@ -158,7 +171,7 @@ class RGWStore:
             "mtime": _now(),
             "content_type": content_type,
         }
-        await self.data.omap_set(
+        await self.index.omap_set(
             self._index_obj(bucket), {key: json.dumps(entry).encode()}
         )
         return entry
@@ -179,7 +192,7 @@ class RGWStore:
         if entry is None:
             raise RGWError(-ENOENT, f"no object {bucket}/{key}")
         await self._data_obj(bucket, key).remove()
-        await self.data.omap_rmkeys(self._index_obj(bucket), [key])
+        await self.index.omap_rmkeys(self._index_obj(bucket), [key])
 
     async def copy_object(
         self, src_bucket: str, src_key: str, dst_bucket: str, dst_key: str
@@ -198,7 +211,7 @@ class RGWStore:
         under ``prefix``, collapsed into common prefixes at
         ``delimiter`` (reference:rgw_op.cc RGWListBucket)."""
         await self.bucket_info(bucket)
-        index = await self._omap(self.data, self._index_obj(bucket))
+        index = await self._omap(self.index, self._index_obj(bucket))
         keys = sorted(
             k for k in index
             if k.startswith(prefix) and not k.startswith(".upload.")
@@ -253,7 +266,7 @@ class RGWStore:
     async def init_multipart(self, bucket: str, key: str) -> str:
         await self.bucket_info(bucket)
         upload = secrets.token_hex(8)
-        await self.data.omap_set(
+        await self.index.omap_set(
             self._index_obj(bucket),
             {self._upload_key(key, upload): json.dumps(
                 {"key": key, "started": _now()}
@@ -273,7 +286,7 @@ class RGWStore:
         )
         await sobj.write(data, 0)
         etag = hashlib.md5(data).hexdigest()
-        await self.data.omap_set(
+        await self.index.omap_set(
             self._index_obj(bucket),
             {self._part_key(key, upload, part_num): json.dumps(
                 {"size": len(data), "etag": etag}
@@ -284,7 +297,7 @@ class RGWStore:
     async def _upload_parts(
         self, bucket: str, key: str, upload: str
     ) -> dict[int, dict]:
-        index = await self._omap(self.data, self._index_obj(bucket))
+        index = await self._omap(self.index, self._index_obj(bucket))
         prefix = f"{self._upload_key(key, upload)}.part."
         return {
             int(k[len(prefix):]): json.loads(v)
@@ -321,10 +334,10 @@ class RGWStore:
             "size": total, "etag": etag, "mtime": _now(),
             "content_type": "binary/octet-stream",
         }
-        await self.data.omap_set(
+        await self.index.omap_set(
             self._index_obj(bucket), {key: json.dumps(entry).encode()}
         )
-        await self.data.omap_rmkeys(
+        await self.index.omap_rmkeys(
             self._index_obj(bucket),
             [self._upload_key(key, upload)]
             + [self._part_key(key, upload, n) for n in parts],
@@ -340,7 +353,7 @@ class RGWStore:
             await StripedObject(
                 self.data, self._part_name(bucket, key, upload, n)
             ).remove()
-        await self.data.omap_rmkeys(
+        await self.index.omap_rmkeys(
             self._index_obj(bucket),
             [self._upload_key(key, upload)]
             + [self._part_key(key, upload, n) for n in parts],
@@ -349,7 +362,7 @@ class RGWStore:
     # -- stats ----------------------------------------------------------------
     async def bucket_stats(self, bucket: str) -> dict:
         info = await self.bucket_info(bucket)
-        index = await self._omap(self.data, self._index_obj(bucket))
+        index = await self._omap(self.index, self._index_obj(bucket))
         objs = [
             json.loads(v) for k, v in index.items()
             if not k.startswith(".upload.")
@@ -371,12 +384,12 @@ class RGWStore:
             raise
 
     async def _index_entry(self, bucket: str, key: str) -> dict | None:
-        index = await self._omap(self.data, self._index_obj(bucket))
+        index = await self._omap(self.index, self._index_obj(bucket))
         raw = index.get(key)
         return json.loads(raw) if raw is not None else None
 
     async def _upload_meta(self, bucket: str, key: str, upload: str) -> dict:
-        index = await self._omap(self.data, self._index_obj(bucket))
+        index = await self._omap(self.index, self._index_obj(bucket))
         raw = index.get(self._upload_key(key, upload))
         if raw is None:
             raise RGWError(-ENOENT, f"no upload {upload!r} for {key!r}")

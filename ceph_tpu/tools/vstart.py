@@ -1,0 +1,107 @@
+"""vstart: launch a whole dev cluster in one process
+(reference:src/vstart.sh — the developer cluster launcher).
+
+Boots N mons + N OSDs (+ mgr, mds, rgw on request) on loopback, prints
+the connection lines every other CLI needs, and serves until Ctrl-C.
+
+Usage:
+  vstart --osds 4 --mons 3 --mgr --mds --rgw [--auth]
+         [--store-dir DIR] [--crush-hosts 2x2]
+  # then, from other shells:
+  rados -m <mon> lspools
+  ceph -m <mon> status
+  rbd -m <mon> -p rbd create img --size 1048576
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import signal
+import sys
+
+from ..rados import MiniCluster
+
+
+def _parse_hosts(spec: str | None, n_osds: int):
+    """"2x2" = 2 hosts x 2 osds; None = flat."""
+    if not spec:
+        return None
+    hosts, per = (int(x) for x in spec.lower().split("x", 1))
+    if hosts * per != n_osds:
+        raise SystemExit(f"--crush-hosts {spec} != --osds {n_osds}")
+    return [list(range(h * per, (h + 1) * per)) for h in range(hosts)]
+
+
+async def _run(args) -> int:
+    cluster = MiniCluster(
+        n_osds=args.osds,
+        n_mons=args.mons,
+        store_dir=args.store_dir,
+        auth=args.auth,
+        crush_hosts=_parse_hosts(args.crush_hosts, args.osds),
+        heartbeat_interval=args.heartbeat_interval,
+    )
+    await cluster.start()
+    monmap = ",".join(cluster.monmap)
+    print(f"mon:    {monmap}")
+    if args.auth:
+        print(f"keyring: {cluster._keyring_path} (client.admin)")
+    if args.mgr:
+        mgr = await cluster.start_mgr()
+        await cluster.wait_for_active_mgr()
+        print(f"mgr:    {mgr.name} @ {mgr.addr}")
+    if args.mds:
+        mds = await cluster.start_mds()
+        await cluster.wait_for_active_mds()
+        print(f"mds:    {mds.name} @ {mds.addr}")
+    rgw_srv = None
+    if args.rgw:
+        from ..rgw import RGWStore
+        from ..rgw.http import S3Server
+
+        cl = await cluster.client()
+        store = await RGWStore.create(cl)
+        user = None
+        try:
+            user = await store.create_user("admin", "vstart admin")
+        except Exception:
+            user = await store.get_user("admin")
+        rgw_srv = S3Server(store)
+        addr = await rgw_srv.start(port=args.rgw_port)
+        print(f"rgw:    http://{addr}  (AWS {user['access_key']}:...)")
+    print(f"osds:   {args.osds} up", flush=True)
+    print("ready — Ctrl-C to stop", flush=True)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    print("stopping...", flush=True)
+    if rgw_srv is not None:
+        await rgw_srv.stop()
+    await cluster.stop()
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="vstart", description=__doc__)
+    p.add_argument("--osds", type=int, default=3)
+    p.add_argument("--mons", type=int, default=1)
+    p.add_argument("--mgr", action="store_true")
+    p.add_argument("--mds", action="store_true")
+    p.add_argument("--rgw", action="store_true")
+    p.add_argument("--rgw-port", type=int, default=0)
+    p.add_argument("--auth", action="store_true", help="enable cephx")
+    p.add_argument("--store-dir", default=None,
+                   help="durable WalStores here (default: in-memory)")
+    p.add_argument("--crush-hosts", default=None, metavar="HxP",
+                   help='hierarchy, e.g. "2x2" = 2 hosts x 2 osds')
+    p.add_argument("--heartbeat-interval", type=float, default=1.0)
+    args = p.parse_args(argv)
+    return asyncio.run(_run(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -88,6 +88,24 @@ class TestCacher:
 
         run(main())
 
+    def test_oversized_object_never_loses_writes(self):
+        """An object bigger than the whole cache must not be evicted out
+        from under its own in-flight mutation (silent data loss)."""
+
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                cl = await cluster.client()
+                await cl.create_pool("p", "replicated", size=3)
+                io = cl.io_ctx("p")
+                await io.write_full("big", b"\x11" * 2000)
+                cache = ObjectCacher(io, max_bytes=1000)
+                await cache.write("big", b"X", 0)
+                assert (await cache.read("big", 0, 2))[:1] == b"X"
+                await cache.flush()
+                assert (await io.read("big"))[:1] == b"X"  # not lost
+
+        run(main())
+
     def test_invalidate_rereads(self):
         async def main():
             async with MiniCluster(n_osds=3) as cluster:
