@@ -141,6 +141,20 @@ def bench_device(batch: int, quick: bool, deadline: float | None,
     P, RM, present = _matrices()
     enc32 = make_gf_matmul_u32(P, W)
     dec32 = make_gf_matmul_u32(RM, W)
+    engine = "xla"
+    if (platform or "tpu") != "cpu":
+        try:
+            from ceph_tpu.ops.gf_pallas import BLOCK, make_gf_matmul_pallas
+
+            if jax.devices()[0].platform == "tpu" and (
+                (batch * CHUNK) // 4
+            ) % BLOCK == 0:
+                enc32 = make_gf_matmul_pallas(P, W)
+                dec32 = make_gf_matmul_pallas(RM, W)
+                engine = "pallas"
+        except Exception as e:  # the XLA engine is always available
+            log(f"child: pallas unavailable ({e!r}); using xla engine")
+    log(f"child: GF engine: {engine}")
 
     n = batch * CHUNK
     rng = np.random.default_rng(0)
@@ -149,8 +163,21 @@ def bench_device(batch: int, quick: bool, deadline: float | None,
     data_bytes = K * n
     log(f"child: {data_bytes >> 20} MiB uploaded")
 
-    # correctness pin: TPU parity == native C++ engine parity (first 4 KiB)
-    parity_dev = jax.jit(enc32)(data)
+    # correctness pin: TPU parity == native C++ engine parity (first 4 KiB).
+    # This is also the pallas engine's first real Mosaic compile — a
+    # lowering failure here must DEMOTE to the XLA engine, not kill the
+    # phase (the import-time try above can't see compile errors)
+    if engine == "pallas":
+        try:
+            parity_dev = jax.jit(enc32)(data)
+        except Exception as e:
+            log(f"child: pallas compile failed ({e!r}); demoting to xla")
+            engine = "xla"
+            enc32 = make_gf_matmul_u32(P, W)
+            dec32 = make_gf_matmul_u32(RM, W)
+            parity_dev = jax.jit(enc32)(data)
+    else:
+        parity_dev = jax.jit(enc32)(data)
     head = np.asarray(parity_dev[:, :1024]).view(np.uint8)
     head_ref = native.encode(P, data_u8[:, :4096])
     if not np.array_equal(head, head_ref):
@@ -212,6 +239,7 @@ def bench_device(batch: int, quick: bool, deadline: float | None,
 
     out = {
         "platform": str(dev),
+        "engine": engine,
         "encode_gbps": data_bytes / t_encode / 1e9,
         "reconstruct_gbps": data_bytes / t_decode / 1e9,
         "combined_gbps": 2 * data_bytes / (t_encode + t_decode) / 1e9,

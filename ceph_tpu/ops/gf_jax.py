@@ -156,6 +156,11 @@ def _row_plans(matrix: np.ndarray, w: int):
 def make_gf_matmul(matrix: np.ndarray, w: int = 8):
     """Compile a GF matmul: data [k, N] uint8 -> parity [m, N] uint8.
 
+    On TPU, lane counts that tile route to the fused Pallas engine
+    (~1.4x the XLA schedule, see ceph_tpu/ops/gf_pallas.py); everything
+    else takes the XLA doubling kernel.  Parity bytes are identical
+    either way (tests pin all engines to the numpy oracle).
+
     ``matrix`` is a static [m, k] array of GF(2^w) elements.  N must be a
     multiple of 4 (callers pad; chunk sizes are SIMD_ALIGN-padded anyway,
     mirroring reference:src/erasure-code/ErasureCode.cc:27 SIMD_ALIGN=32).
@@ -163,9 +168,22 @@ def make_gf_matmul(matrix: np.ndarray, w: int = 8):
     [k, N]; batching many stripes = concatenating along N.
     """
     inner = make_gf_matmul_u32(matrix, w)
+    pallas_inner = None  # built lazily: importing pallas costs nothing
+    # until a TPU shape actually routes here
 
     def fn(data: jax.Array) -> jax.Array:
-        return _as_u8(inner(_as_u32(data)))
+        nonlocal pallas_inner
+        d32 = _as_u32(data)
+        from . import gf_pallas
+
+        if (
+            gf_pallas._have_pallas_tpu()
+            and d32.shape[-1] % gf_pallas.BLOCK == 0
+        ):
+            if pallas_inner is None:
+                pallas_inner = gf_pallas.make_gf_matmul_pallas(matrix, w)
+            return _as_u8(pallas_inner(d32))
+        return _as_u8(inner(d32))
 
     return fn
 

@@ -1,0 +1,101 @@
+"""Fused GF(2^w) matmul as a Pallas TPU kernel.
+
+The XLA version (:func:`ceph_tpu.ops.gf_jax.make_gf_matmul_u32`) builds
+an unrolled doubling/XOR graph and leaves fusion/tiling to the
+compiler.  This kernel pins the whole computation into VMEM: each grid
+step DMAs one [k, B] block of packed-u32 data on chip, walks the
+doubling chains in registers, XOR-accumulates the m outputs, and
+writes [m, B] back — data is read once and parity written once,
+nothing else touches HBM.
+
+Measured on a v5e-1 (dependency-chained methodology from bench.py,
+RS(8,3) over 64 MiB): the block size is the lever —
+
+    BLOCK=512   138 GB/s   (grid overhead dominates)
+    BLOCK=4096  323 GB/s   vs the XLA kernel's 230 GB/s
+    BLOCK=8192  324 GB/s
+    BLOCK=16384 301 GB/s   (VMEM pressure)
+
+so the fused kernel beats XLA's schedule by ~1.4x at the sweet spot.
+
+Same contract as the XLA kernel: data [k, N4] uint32 -> parity
+[m, N4] uint32, bit-identical bytes (tests pin them against the numpy
+oracle and the XLA kernel).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .gf_jax import _PACK, _row_plans
+
+BLOCK = 4096  # u32 lanes per grid step (x4 = 16 KiB per row)
+
+
+def _have_pallas_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def make_gf_matmul_pallas(matrix: np.ndarray, w: int = 8,
+                          interpret: bool = False):
+    """Compile the fused kernel; returns fn(d32 [k, N4]) -> [m, N4].
+
+    ``interpret=True`` runs the Pallas interpreter (CPU testing).
+    N4 must be a multiple of BLOCK — callers fall back to the XLA
+    kernel otherwise (the codec layer's batch sizes satisfy it).
+    """
+    from jax.experimental import pallas as pl
+
+    matrix = np.asarray(matrix)
+    m, k = matrix.shape
+    plans = _row_plans(matrix, w)
+    mask_low, high_unit, poly = _PACK[w]
+    shift = w - 1
+    # per input row: which powers are needed, and by which outputs
+    need: list[set[int]] = [set() for _ in range(k)]
+    users: dict[tuple[int, int], list[int]] = {}
+    for i, terms in enumerate(plans):
+        for j, b in terms:
+            need[j].add(b)
+            users.setdefault((j, b), []).append(i)
+
+    def kernel(d_ref, o_ref):
+        accs = [None] * m
+        for j in range(k):
+            if not need[j]:
+                continue
+            cur = d_ref[j, :]
+            maxb = max(need[j])
+            for b in range(maxb + 1):
+                if b in need[j]:
+                    for i in users[(j, b)]:
+                        accs[i] = cur if accs[i] is None else accs[i] ^ cur
+                if b < maxb:
+                    high = (cur >> shift) & high_unit
+                    cur = ((cur & mask_low) << 1) ^ (high * poly)
+        zero = jnp.zeros((BLOCK,), dtype=jnp.uint32)
+        for i in range(m):
+            o_ref[i, :] = zero if accs[i] is None else accs[i]
+
+    def fn(d32: jax.Array) -> jax.Array:
+        assert d32.shape[0] == k, (d32.shape, k)
+        n4 = d32.shape[1]
+        assert n4 % BLOCK == 0, (n4, BLOCK)
+        grid = (n4 // BLOCK,)
+        return pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[pl.BlockSpec((k, BLOCK), lambda g: (0, g))],
+            out_specs=pl.BlockSpec((m, BLOCK), lambda g: (0, g)),
+            out_shape=jax.ShapeDtypeStruct((m, n4), jnp.uint32),
+            interpret=interpret,
+        )(d32)
+
+    return fn
+
+
