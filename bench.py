@@ -170,6 +170,9 @@ def bench_device(batch: int, quick: bool, deadline: float | None,
     if engine == "pallas":
         try:
             parity_dev = jax.jit(enc32)(data)
+            # the recovery matrix lowers a DIFFERENT unroll — probe it
+            # too, or a dec-only Mosaic failure still kills the phase
+            jax.block_until_ready(jax.jit(dec32)(data[:, :4096]))
         except Exception as e:
             log(f"child: pallas compile failed ({e!r}); demoting to xla")
             engine = "xla"

@@ -50,6 +50,49 @@ def dir_remove(ctx: MethodContext, input: dict) -> dict:
     return {}
 
 
+@cls.method("child_add", CLS_METHOD_RD | CLS_METHOD_WR)
+def child_add(ctx: MethodContext, input: dict) -> dict:
+    """Register a clone under parent@snap — atomic under the PG lock,
+    like the reference's cls_rbd add_child (a client-side
+    read-modify-write would lose concurrent registrations)."""
+    key, child = input.get("key"), input.get("child")
+    if not key or not child:
+        raise ClsError(EINVAL, "child_add: need key and child")
+    import json as _json
+
+    omap = ctx.omap_get()
+    ids = _json.loads(omap.get(key, b"[]"))
+    if child not in ids:
+        ids.append(child)
+        ctx.omap_set({key: _json.dumps(ids).encode()})
+    return {"children": ids}
+
+
+@cls.method("child_remove", CLS_METHOD_RD | CLS_METHOD_WR)
+def child_remove(ctx: MethodContext, input: dict) -> dict:
+    key, child = input.get("key"), input.get("child")
+    import json as _json
+
+    omap = ctx.omap_get()
+    ids = _json.loads(omap.get(key, b"[]"))
+    ids = [c for c in ids if c != child]
+    if ids:
+        ctx.omap_set({key: _json.dumps(ids).encode()})
+    else:
+        ctx.omap_rm([key])
+    return {"children": ids}
+
+
+@cls.method("children_get", CLS_METHOD_RD)
+def children_get(ctx: MethodContext, input: dict) -> dict:
+    import json as _json
+
+    omap = ctx.omap_get()
+    return {
+        "children": _json.loads(omap.get(input.get("key", ""), b"[]"))
+    }
+
+
 @cls.method("dir_rename", CLS_METHOD_RD | CLS_METHOD_WR)
 def dir_rename(ctx: MethodContext, input: dict) -> dict:
     src, dst = input.get("src"), input.get("dst")

@@ -59,6 +59,9 @@ OPTIONS: dict[str, Option] = _opts(
            "base backoff between reconnect attempts (s)"),
     Option("ms_reconnect_max_attempts", int, 2,
            "reconnect attempts before a send fails"),
+    Option("ms_dispatch_throttle_bytes", int, 0,
+           "in-flight inbound byte budget per messenger (0 = off; "
+           "reference default 100MB)"),
     # osd: liveness
     Option("osd_heartbeat_interval", float, 0.0,
            "peer ping period (s); 0 disables (reference default 6)"),

@@ -1,0 +1,71 @@
+"""Byte/count budgets (reference:src/common/Throttle.{h,cc}).
+
+The reference throttles in-flight bytes at every boundary — messenger
+dispatch, objecter ops, recovery — blocking producers when the budget
+is exhausted.  Same contract for asyncio: ``acquire(n)`` waits until
+``n`` fits, ``release(n)`` wakes waiters FIFO; a zero limit means
+unthrottled (the reference's convention)."""
+
+from __future__ import annotations
+
+import asyncio
+from collections import deque
+
+
+class Throttle:
+    def __init__(self, name: str, limit: int = 0):
+        self.name = name
+        self.limit = int(limit)
+        self.current = 0
+        self._waiters: deque[tuple[int, asyncio.Future]] = deque()
+
+    def _would_fit(self, n: int) -> bool:
+        # an oversized request (> limit) is admitted alone, like the
+        # reference (_should_wait lets c > max through when current==0)
+        return (
+            self.current + n <= self.limit
+            or (self.current == 0 and n > self.limit)
+        )
+
+    async def acquire(self, n: int = 1) -> None:
+        if self.limit <= 0:
+            self.current += n
+            return
+        if not self._waiters and self._would_fit(n):
+            self.current += n
+            return
+        fut = asyncio.get_running_loop().create_future()
+        self._waiters.append((n, fut))
+        try:
+            await fut
+        except asyncio.CancelledError:
+            if not fut.done() or fut.cancelled():
+                try:
+                    self._waiters.remove((n, fut))
+                except ValueError:
+                    pass
+            else:
+                # woken AND cancelled: hand the grant back
+                self.release(n)
+            raise
+
+    def release(self, n: int = 1) -> None:
+        self.current = max(0, self.current - n)
+        while self._waiters:
+            need, fut = self._waiters[0]
+            if self.limit > 0 and not self._would_fit(need):
+                break
+            self._waiters.popleft()
+            if not fut.done():
+                self.current += need
+                fut.set_result(None)
+
+    def get_current(self) -> int:
+        return self.current
+
+    def waiters(self) -> int:
+        return len(self._waiters)
+
+    def dump(self) -> dict:
+        return {"name": self.name, "limit": self.limit,
+                "current": self.current, "waiters": len(self._waiters)}

@@ -658,6 +658,9 @@ class Monitor(Dispatcher):
                 "osd pool create": self._cmd_pool_create,
                 "osd pool ls": self._cmd_pool_ls,
                 "osd pool rm": self._cmd_pool_rm,
+                "osd pool set": self._cmd_pool_set,
+                "osd pool get": self._cmd_pool_get,
+                "osd reweight": self._cmd_osd_reweight,
                 "osd pool mksnap": self._cmd_pool_mksnap,
                 "osd pool rmsnap": self._cmd_pool_rmsnap,
                 "osd pool lssnap": self._cmd_pool_lssnap,
@@ -766,6 +769,59 @@ class Monitor(Dispatcher):
         del self.osdmap.pool_name[pool.name]
         self._mark_dirty()
         return 0, "", None
+
+    # pool vars an operator may tune at runtime (reference:OSDMonitor.cc
+    # prepare_command 'osd pool set' — the subset this data path reads)
+    _POOL_VARS = {"size": int, "min_size": int}
+
+    def _cmd_pool_set(self, cmd: dict) -> tuple[int, str, Any]:
+        pool = self.osdmap.lookup_pool(cmd["pool"])
+        if pool is None:
+            return -ENOENT, f"no pool {cmd['pool']!r}", None
+        var = cmd.get("var", "")
+        conv = self._POOL_VARS.get(var)
+        if conv is None:
+            return -EINVAL, f"cannot set {var!r} (supported: " \
+                            f"{sorted(self._POOL_VARS)})", None
+        try:
+            val = conv(cmd["val"])
+        except (TypeError, ValueError):
+            return -EINVAL, f"bad value for {var!r}", None
+        if pool.is_erasure() and var == "size":
+            return -EINVAL, "EC pool size is fixed by its profile", None
+        if var == "min_size" and not (1 <= val <= pool.size):
+            return -EINVAL, f"min_size must be in [1, {pool.size}]", None
+        if var == "size" and not (1 <= val <= self.osdmap.max_osd):
+            return -EINVAL, "size out of range", None
+        setattr(pool, var, val)
+        if var == "size" and pool.min_size > val:
+            pool.min_size = max(1, val - 1)
+        self._mark_dirty()  # the epoch bump re-peers every PG
+        return 0, f"set pool {pool.name} {var} = {val}", None
+
+    def _cmd_pool_get(self, cmd: dict) -> tuple[int, str, Any]:
+        pool = self.osdmap.lookup_pool(cmd["pool"])
+        if pool is None:
+            return -ENOENT, f"no pool {cmd['pool']!r}", None
+        return 0, "", {
+            "pool": pool.name, "size": pool.size,
+            "min_size": pool.min_size, "pg_num": pool.pg_num,
+            "type": "erasure" if pool.is_erasure() else "replicated",
+            "erasure_code_profile": pool.erasure_code_profile,
+        }
+
+    def _cmd_osd_reweight(self, cmd: dict) -> tuple[int, str, Any]:
+        """reference:OSDMonitor 'osd reweight' — scale an osd's in-weight
+        (0.0..1.0) to shift load without marking it out."""
+        osd = int(cmd["id"])
+        w = float(cmd["weight"])
+        if not (0 <= osd < self.osdmap.max_osd):
+            return -ENOENT, f"no osd.{osd}", None
+        if not (0.0 <= w <= 1.0):
+            return -EINVAL, "weight must be in [0, 1]", None
+        self.osdmap.osd_weight[osd] = int(w * 0x10000)
+        self._mark_dirty()
+        return 0, f"reweighted osd.{osd} to {w}", None
 
     # -- snapshots (reference:src/mon/OSDMonitor.cc 'osd pool mksnap' /
     # 'rmsnap' prepare paths; self-managed ids via IoCtx selfmanaged_

@@ -78,20 +78,28 @@ class Connection:
             pass
 
     async def _reader_loop(self) -> None:
+        throttle = self.messenger.dispatch_throttle
         try:
             while True:
                 hdr = await self._reader.readexactly(_LEN.size)
                 (n,) = _LEN.unpack(hdr)
-                frame = await self._reader.readexactly(n)
-                msg, _seq = decode_frame(frame)
+                # the dispatch throttle bounds in-flight inbound bytes:
+                # waiting HERE exerts TCP backpressure on the peer
+                # (reference:Messenger policy throttler semantics)
+                await throttle.acquire(n)
                 try:
-                    await self.messenger._dispatch(self, msg)
-                except Exception:
-                    # a handler bug must not tear down the peer link
-                    logger.exception(
-                        "%s: dispatcher failed on %s from %s",
-                        self.messenger.name, msg.TYPE, self.peer_name,
-                    )
+                    frame = await self._reader.readexactly(n)
+                    msg, _seq = decode_frame(frame)
+                    try:
+                        await self.messenger._dispatch(self, msg)
+                    except Exception:
+                        # a handler bug must not tear down the peer link
+                        logger.exception(
+                            "%s: dispatcher failed on %s from %s",
+                            self.messenger.name, msg.TYPE, self.peer_name,
+                        )
+                finally:
+                    throttle.release(n)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
         except BadFrame:
@@ -147,12 +155,18 @@ class AsyncMessenger:
         # ticket and inbound banners are verified (see _accept)
         self.auth = None  # ceph_tpu.auth.AuthContext | None
         self.auth_mon_mode = False  # mon: admit unauth conns for MAuth
+        from ..common.throttle import Throttle
+
+        # bounds in-flight inbound bytes across all connections
+        # (reference ms_dispatch_throttle_bytes); 0 = unthrottled
+        self.dispatch_throttle = Throttle(f"{name}.dispatch", 0)
 
     def apply_config(self, cfg) -> None:
         """Adopt the ms_* options from a Config."""
         self.reconnect_attempts = cfg.ms_reconnect_max_attempts
         self.reconnect_backoff = cfg.ms_reconnect_backoff
         self.connect_timeout = cfg.ms_connect_timeout
+        self.dispatch_throttle.limit = cfg.ms_dispatch_throttle_bytes
 
     # -- lifecycle
     async def bind(self, host: str = "127.0.0.1", port: int = 0) -> str:

@@ -168,8 +168,8 @@ def make_gf_matmul(matrix: np.ndarray, w: int = 8):
     [k, N]; batching many stripes = concatenating along N.
     """
     inner = make_gf_matmul_u32(matrix, w)
-    pallas_inner = None  # built lazily: importing pallas costs nothing
-    # until a TPU shape actually routes here
+    pallas_inner = None  # None = unbuilt, False = Mosaic refused, fn = ok
+    k = int(np.asarray(matrix).shape[1])
 
     def fn(data: jax.Array) -> jax.Array:
         nonlocal pallas_inner
@@ -179,10 +179,23 @@ def make_gf_matmul(matrix: np.ndarray, w: int = 8):
         if (
             gf_pallas._have_pallas_tpu()
             and d32.shape[-1] % gf_pallas.BLOCK == 0
+            and pallas_inner is not False
         ):
             if pallas_inner is None:
-                pallas_inner = gf_pallas.make_gf_matmul_pallas(matrix, w)
-            return _as_u8(pallas_inner(d32))
+                # probe-compile ONCE on a tiny block: a Mosaic lowering
+                # failure must demote to the XLA engine, not turn a perf
+                # optimization into an I/O failure (review r2 finding)
+                try:
+                    cand = gf_pallas.make_gf_matmul_pallas(matrix, w)
+                    probe = jnp.zeros(
+                        (k, gf_pallas.BLOCK), dtype=jnp.uint32
+                    )
+                    jax.block_until_ready(jax.jit(cand)(probe))
+                    pallas_inner = cand
+                except Exception:
+                    pallas_inner = False
+            if pallas_inner is not False:
+                return _as_u8(pallas_inner(d32))
         return _as_u8(inner(d32))
 
     return fn

@@ -14,6 +14,7 @@ EINVAL = 22
 EBUSY = 16
 
 RBD_DIRECTORY = "rbd_directory"
+RBD_CHILDREN = "rbd_children"  # parent@snap -> [child ids] (cls_rbd analog)
 HEADER_PREFIX = "rbd_header."
 DATA_PREFIX = "rbd_data."
 DEFAULT_ORDER = 22  # 4 MiB objects, the rbd default
@@ -72,6 +73,8 @@ class RBD:
         try:
             if img.snaps:
                 raise RbdError(-EBUSY, "image has snapshots")
+            if img.parent is not None:
+                await img._deregister_child()  # free the parent snap
             await img._remove_data_objects(img.size_bytes)
             await self.io.remove(img.header)
         finally:
@@ -85,6 +88,42 @@ class RBD:
                                {"src": src, "dst": dst})
         except RadosError as e:
             raise RbdError(e.code, f"rename {src!r} -> {dst!r}") from e
+
+    async def clone(
+        self, parent_name: str, parent_snap: str, clone_name: str
+    ) -> None:
+        """COW child of a PROTECTED parent snap (reference:librbd::clone,
+        format-2 layering): the child starts as pure metadata; reads fall
+        through holes to the parent, first writes copy objects up."""
+        parent = await Image.open(self.io, parent_name)
+        try:
+            s = parent.snaps.get(parent_snap)
+            if s is None:
+                raise RbdError(-ENOENT, f"no snap {parent_snap!r}")
+            if not s.get("protected"):
+                raise RbdError(
+                    -EINVAL, f"snap {parent_snap!r} is not protected"
+                )
+            snap_size = int(s["size"])
+            await self.create(clone_name, snap_size, order=parent.order)
+            child = await Image.open(self.io, clone_name)
+            try:
+                await self.io.omap_set(child.header, {
+                    "parent": json.dumps({
+                        "image_id": parent.image_id,
+                        "snap_name": parent_snap,
+                        "snap_id": int(s["id"]),
+                        "overlap": snap_size,
+                    }).encode(),
+                })
+                await self.io.exec(RBD_CHILDREN, "rbd", "child_add", {
+                    "key": f"{parent.image_id}@{int(s['id'])}",
+                    "child": child.image_id,
+                })
+            finally:
+                await child.close()
+        finally:
+            await parent.close()
 
 
 class Image:
@@ -103,11 +142,16 @@ class Image:
         self.header = HEADER_PREFIX + image_id
         self.size_bytes = 0
         self.order = DEFAULT_ORDER
-        self.snaps: dict[str, dict] = {}   # name -> {"id", "size"}
+        self.snaps: dict[str, dict] = {}   # name -> {"id","size","protected"?}
         self.snap_name: str | None = None  # opened-at-snap (read-only)
         self._watch_cookie: str | None = None
         self._closed = False
         self._cache = None  # librbd-style writeback cache (opt-in)
+        # layering (format-2 cloning): {"image_id","snap_name","snap_id",
+        # "size"} of the parent, or None
+        self.parent: dict | None = None
+        self._parent_img: "Image | None" = None  # opened lazily at the snap
+        self._copyup_locks: dict[int, asyncio.Lock] = {}
 
     # -- lifecycle ---------------------------------------------------------
     @classmethod
@@ -147,6 +191,9 @@ class Image:
             return
         self._closed = True
         await self._cache_flush()
+        if self._parent_img is not None:
+            await self._parent_img.close()
+            self._parent_img = None
         if self._watch_cookie is not None:
             try:
                 await self.io.unwatch(self._watch_cookie)
@@ -163,7 +210,25 @@ class Image:
         self.size_bytes = int(h["size"])
         self.order = int(h["order"])
         self.snaps = json.loads(h.get("snaps", b"{}"))
+        raw_parent = h.get("parent")
+        self.parent = json.loads(raw_parent) if raw_parent else None
         self._apply_snapc()
+
+    async def _parent(self) -> "Image | None":
+        """The parent image opened read-only at the clone snap
+        (reference:ImageCtx::parent), opened lazily and cached."""
+        if self.parent is None:
+            return None
+        if self._parent_img is None:
+            d = await self.io.omap_get(RBD_DIRECTORY)
+            pname = d.get(f"id_{self.parent['image_id']}")
+            if pname is None:
+                raise RbdError(-ENOENT, "parent image vanished")
+            self._parent_img = await Image.open(
+                self.io, pname.decode(),
+                snap_name=self.parent["snap_name"],
+            )
+        return self._parent_img
 
     def _header_notify(self, notifier: str, payload: bytes):
         # run the refresh asynchronously; the ack must not wait on I/O
@@ -219,6 +284,12 @@ class Image:
         self._check_open_rw()
         if offset + len(data) > self.size_bytes:
             raise RbdError(-EINVAL, "write past end of image")
+        if self.parent is not None:
+            await asyncio.gather(*(
+                self._ensure_copyup(objectno)
+                for objectno in {o for o, _off, _r in
+                                 self._extents(offset, len(data))}
+            ))
         pos = 0
         ops = []
         for objectno, obj_off, run in self._extents(offset, len(data)):
@@ -253,13 +324,64 @@ class Image:
             except RadosError as e:
                 if e.code != -ENOENT:
                     raise
-                got = b""  # never-written extent reads as zeros
+                # absent object: a clone shows the parent through the
+                # hole (reference:librbd read-from-parent); plain images
+                # read zeros
+                got = await self._parent_read(objectno, obj_off, run)
             return got + b"\x00" * (run - len(got))
 
         parts = await asyncio.gather(
             *(fetch(o, oo, r) for o, oo, r in self._extents(offset, end - offset))
         )
         return b"".join(parts)
+
+    # -- layering internals --------------------------------------------------
+    async def _parent_read(self, objectno: int, obj_off: int,
+                           run: int) -> bytes:
+        """Bytes the parent contributes to a hole in this object, clipped
+        to the parent overlap (shrunk by resize, never regrown)."""
+        if self.parent is None:
+            return b""
+        logical = objectno * self.object_size + obj_off
+        overlap = int(self.parent["overlap"])
+        if logical >= overlap:
+            return b""
+        parent = await self._parent()
+        return await parent.read(logical, min(run, overlap - logical))
+
+    async def _object_exists(self, name: str) -> bool:
+        try:
+            if self._cache is not None:
+                await self._cache.read(name, 0, 0)
+            else:
+                await self.io.stat(name)
+            return True
+        except RadosError as e:
+            if e.code == -ENOENT:
+                return False
+            raise
+
+    async def _ensure_copyup(self, objectno: int) -> None:
+        """First write to a clone's absent object copies the parent's
+        whole object range up first (reference:librbd copy-up), so
+        later reads of the object's untouched regions stay correct.
+        Serialized per object: a racing copy-up landing after another
+        task's data write would revert acknowledged bytes (librbd's
+        per-object copyup state machine)."""
+        if self.parent is None:
+            return
+        lock = self._copyup_locks.setdefault(objectno, asyncio.Lock())
+        async with lock:
+            name = self._data_name(objectno)
+            if await self._object_exists(name):
+                return
+            base = await self._parent_read(objectno, 0, self.object_size)
+            if not base:
+                return  # beyond the overlap: plain create-on-write
+            if self._cache is not None:
+                await self._cache.write(name, base, offset=0)
+            else:
+                await self.io.write(name, base, offset=0)
 
     async def discard(self, offset: int, length: int) -> None:
         """Punch a hole (reference:librbd discard -> zero/truncate/remove
@@ -268,11 +390,28 @@ class Image:
         ops = []
         for objectno, obj_off, run in self._extents(offset, length):
             name = self._data_name(objectno)
+            parent_covers = (
+                self.parent is not None
+                and objectno * self.object_size < int(self.parent["overlap"])
+            )
             if obj_off == 0 and run == self.object_size:
-                ops.append(self._remove_quiet(name))
+                if parent_covers:
+                    # removing the object would re-expose the parent:
+                    # an EXISTING empty object reads as zeros instead
+                    ops.append(self._truncate_zero(name))
+                else:
+                    ops.append(self._remove_quiet(name))
             else:
+                if parent_covers:
+                    await self._ensure_copyup(objectno)
                 ops.append(self._zero_quiet(name, obj_off, run))
         await asyncio.gather(*ops)
+
+    async def _truncate_zero(self, name: str) -> None:
+        if self._cache is not None:
+            await self._cache.write_full(name, b"")
+        else:
+            await self.io.truncate(name, 0)
 
     async def _remove_quiet(self, name: str) -> None:
         try:
@@ -318,13 +457,30 @@ class Image:
                 for n in range(first_dead, last + 1)
             ))
             if new_size % self.object_size:
-                # partial tail object: drop bytes past the new end
+                # partial tail object: drop bytes past the new end.  On
+                # a clone the boundary object may still be a parent
+                # hole — zeroing would materialize it and shadow the
+                # RETAINED head with zeros, so copy up first
+                boundary = new_size // self.object_size
+                if (self.parent is not None
+                        and boundary * self.object_size
+                        < int(self.parent["overlap"])):
+                    await self._ensure_copyup(boundary)
                 await self._zero_quiet(
-                    self._data_name(new_size // self.object_size),
+                    self._data_name(boundary),
                     new_size % self.object_size,
                     self.object_size - new_size % self.object_size,
                 )
-        await self._set_header({"size": str(int(new_size)).encode()})
+        kv = {"size": str(int(new_size)).encode()}
+        if self.parent is not None and new_size < int(
+            self.parent["overlap"]
+        ):
+            # the parent overlap shrinks with the image and never
+            # regrows (reference:librbd parent_overlap semantics) — a
+            # later grow reads zeros there, not stale parent bytes
+            self.parent["overlap"] = int(new_size)
+            kv["parent"] = json.dumps(self.parent).encode()
+        await self._set_header(kv)
         self.size_bytes = int(new_size)
 
     async def _set_header(self, kv: dict[str, bytes]) -> None:
@@ -373,9 +529,13 @@ class Image:
 
     async def snap_remove(self, snap_name: str) -> None:
         self._check_open_rw()
-        s = self.snaps.pop(snap_name, None)
+        s = self.snaps.get(snap_name)
         if s is None:
             raise RbdError(-ENOENT, f"no snap {snap_name!r}")
+        if s.get("protected"):
+            raise RbdError(-EBUSY,
+                           f"snap {snap_name!r} is protected (clones?)")
+        self.snaps.pop(snap_name)
         await self.io.selfmanaged_snap_remove(int(s["id"]))
         self._apply_snapc()
         await self._set_header({"snaps": json.dumps(self.snaps).encode()})
@@ -406,6 +566,91 @@ class Image:
         if snap_size != self.size_bytes:
             await self._set_header({"size": str(snap_size).encode()})
             self.size_bytes = snap_size
+
+    # -- layering: protect / flatten (reference:librbd snap_protect,
+    # flatten; children registry reference:src/cls/rbd children ops) -------
+
+    async def snap_protect(self, snap_name: str) -> None:
+        """Cloning requires a protected snap: protection blocks rmsnap
+        until every child is flattened or removed."""
+        self._check_open_rw()
+        s = self.snaps.get(snap_name)
+        if s is None:
+            raise RbdError(-ENOENT, f"no snap {snap_name!r}")
+        s["protected"] = True
+        await self._set_header({"snaps": json.dumps(self.snaps).encode()})
+
+    async def snap_unprotect(self, snap_name: str) -> None:
+        self._check_open_rw()
+        s = self.snaps.get(snap_name)
+        if s is None:
+            raise RbdError(-ENOENT, f"no snap {snap_name!r}")
+        children = await self._children_of(int(s["id"]))
+        if children:
+            raise RbdError(
+                -EBUSY, f"snap {snap_name!r} has {len(children)} children"
+            )
+        s["protected"] = False
+        await self._set_header({"snaps": json.dumps(self.snaps).encode()})
+
+    async def _children_of(self, snapid: int) -> list[str]:
+        try:
+            out = await self.io.exec(
+                RBD_CHILDREN, "rbd", "children_get",
+                {"key": f"{self.image_id}@{snapid}"},
+            )
+        except RadosError as e:
+            if e.code == -ENOENT:
+                return []
+            raise
+        return out["children"]
+
+    async def list_children(self, snap_name: str) -> list[str]:
+        """Child image NAMES cloned from the snap."""
+        s = self.snaps.get(snap_name)
+        if s is None:
+            raise RbdError(-ENOENT, f"no snap {snap_name!r}")
+        ids = await self._children_of(int(s["id"]))
+        d = await self.io.omap_get(RBD_DIRECTORY)
+        return sorted(
+            d[f"id_{cid}"].decode() for cid in ids if f"id_{cid}" in d
+        )
+
+    async def _deregister_child(self) -> None:
+        """Drop this image from its parent snap's children table
+        (atomic via the cls method, like registration)."""
+        try:
+            await self.io.exec(RBD_CHILDREN, "rbd", "child_remove", {
+                "key": f"{self.parent['image_id']}@{self.parent['snap_id']}",
+                "child": self.image_id,
+            })
+        except RadosError as e:
+            if e.code != -ENOENT:
+                raise
+
+    async def flatten(self) -> None:
+        """Copy every parent-backed object up and detach from the parent
+        (reference:librbd::flatten)."""
+        self._check_open_rw()
+        if self.parent is None:
+            return
+        overlap = int(self.parent["overlap"])
+        sem = asyncio.Semaphore(8)  # bounded parallel copy-ups
+
+        async def up(objectno: int) -> None:
+            async with sem:
+                await self._ensure_copyup(objectno)
+
+        await asyncio.gather(*(
+            up(n) for n in range(-(-overlap // self.object_size))
+        ))
+        await self._deregister_child()
+        await self.io.omap_rmkeys(self.header, ["parent"])
+        self.parent = None
+        if self._parent_img is not None:
+            await self._parent_img.close()
+            self._parent_img = None
+        await self._set_header({})  # notify watchers
 
     # -- exclusive lock (reference:librbd/ExclusiveLock -> cls lock) -------
     LOCK_NAME = "rbd_lock"

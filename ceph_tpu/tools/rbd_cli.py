@@ -98,6 +98,36 @@ async def _cmd_snap(rbd, io, args) -> int:
             await img.snap_remove(snap)
         elif args.snap_cmd == "rollback":
             await img.snap_rollback(snap)
+        elif args.snap_cmd == "protect":
+            await img.snap_protect(snap)
+        elif args.snap_cmd == "unprotect":
+            await img.snap_unprotect(snap)
+    finally:
+        await img.close()
+    return 0
+
+
+async def _cmd_clone(rbd, io, args) -> int:
+    parent, snap = _split_snap(args.parent_spec)
+    await rbd.clone(parent, snap, args.child)
+    return 0
+
+
+async def _cmd_flatten(rbd, io, args) -> int:
+    img = await Image.open(io, args.image)
+    try:
+        await img.flatten()
+    finally:
+        await img.close()
+    return 0
+
+
+async def _cmd_children(rbd, io, args) -> int:
+    name, snap = _split_snap(args.spec)
+    img = await Image.open(io, name)
+    try:
+        for child in await img.list_children(snap):
+            print(child)
     finally:
         await img.close()
     return 0
@@ -188,8 +218,16 @@ def main(argv=None) -> int:
     r.add_argument("image")
     r.add_argument("--size", type=int, required=True)
     s = sub.add_parser("snap")
-    s.add_argument("snap_cmd", choices=["create", "ls", "rm", "rollback"])
+    s.add_argument("snap_cmd", choices=["create", "ls", "rm", "rollback",
+                                        "protect", "unprotect"])
     s.add_argument("spec", help="IMAGE@SNAP (ls: IMAGE)")
+    cl = sub.add_parser("clone")
+    cl.add_argument("parent_spec", help="PARENT@SNAP")
+    cl.add_argument("child")
+    fl = sub.add_parser("flatten")
+    fl.add_argument("image")
+    ch = sub.add_parser("children")
+    ch.add_argument("spec", help="IMAGE@SNAP")
     imp = sub.add_parser("import")
     imp.add_argument("path")
     imp.add_argument("image")
@@ -209,6 +247,8 @@ def main(argv=None) -> int:
     fn = {
         "create": _cmd_create, "ls": _cmd_ls, "info": _cmd_info,
         "rm": _cmd_rm, "resize": _cmd_resize, "snap": _cmd_snap,
+        "clone": _cmd_clone, "flatten": _cmd_flatten,
+        "children": _cmd_children,
         "import": _cmd_import, "export": _cmd_export,
         "bench": _cmd_bench, "lock": _cmd_lock,
     }[args.cmd]

@@ -286,3 +286,55 @@ def test_unknown_command():
         await mon.stop()
 
     run(main())
+
+
+def test_pool_set_get_and_reweight():
+    """Operator tuning (reference:OSDMonitor 'osd pool set/get',
+    'osd reweight'): validation, epoch bumps, and CRUSH effect."""
+
+    async def main():
+        mon = Monitor(max_osds=4)
+        addr = await mon.start()
+        cl = Client("client.5")
+        conn = await cl.messenger.connect(addr)
+        await cl.command(conn, {
+            "prefix": "osd pool create", "pool": "p",
+            "pool_type": "replicated"})
+        code, _, out = await cl.command(
+            conn, {"prefix": "osd pool get", "pool": "p"})
+        assert code == 0 and out["size"] == 3 and out["type"] == "replicated"
+        epoch = mon.osdmap.epoch
+        code, status, _ = await cl.command(conn, {
+            "prefix": "osd pool set", "pool": "p", "var": "size", "val": 2})
+        assert code == 0, status
+        assert mon.osdmap.epoch > epoch
+        pool = mon.osdmap.lookup_pool("p")
+        assert pool.size == 2 and pool.min_size <= 2
+        # validation
+        for bad in (
+            {"var": "size", "val": 99},
+            {"var": "min_size", "val": 0},
+            {"var": "pg_num", "val": 64},
+        ):
+            code, _s, _ = await cl.command(conn, {
+                "prefix": "osd pool set", "pool": "p", **bad})
+            assert code != 0, bad
+        # EC size is profile-fixed
+        await cl.command(conn, {
+            "prefix": "osd pool create", "pool": "ec",
+            "pool_type": "erasure"})
+        code, _s, _ = await cl.command(conn, {
+            "prefix": "osd pool set", "pool": "ec", "var": "size", "val": 5})
+        assert code != 0
+        # reweight changes the crush weight vector
+        code, _s, _ = await cl.command(conn, {
+            "prefix": "osd reweight", "id": 1, "weight": 0.25})
+        assert code == 0
+        assert mon.osdmap.osd_weight[1] == int(0.25 * 0x10000)
+        code, _s, _ = await cl.command(conn, {
+            "prefix": "osd reweight", "id": 99, "weight": 0.5})
+        assert code != 0
+        await cl.messenger.shutdown()
+        await mon.stop()
+
+    run(main())

@@ -1,0 +1,211 @@
+"""RBD layering tests (reference:librbd clone/copy-up/flatten,
+src/test/librbd clone intents): protected snaps, COW children,
+read-through holes, copy-up on first write, overlap semantics, flatten,
+children registry, and the protect/remove guards."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.rados import MiniCluster
+from ceph_tpu.rbd import RBD, Image, RbdError
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+ORDER = 14
+OBJ = 1 << ORDER
+
+
+async def _setup(cluster, cache_bytes=0):
+    cl = await cluster.client()
+    await cl.create_pool("rbd", "replicated", size=3)
+    io = cl.io_ctx("rbd")
+    rbd = RBD(io)
+    await rbd.create("base", 4 * OBJ, order=ORDER)
+    base = await Image.open(io, "base")
+    golden = bytes(range(256)) * (3 * OBJ // 256)  # 3 of 4 objects
+    await base.write(0, golden)
+    await base.snap_create("gold")
+    await base.snap_protect("gold")
+    await rbd.clone("base", "gold", "child")
+    child = await Image.open(io, "child", cache_bytes=cache_bytes)
+    return io, rbd, base, child, golden
+
+
+class TestClone:
+    def test_requires_protected_snap(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                cl = await cluster.client()
+                await cl.create_pool("rbd", "replicated", size=3)
+                io = cl.io_ctx("rbd")
+                rbd = RBD(io)
+                await rbd.create("base", OBJ, order=ORDER)
+                img = await Image.open(io, "base")
+                await img.snap_create("s")
+                with pytest.raises(RbdError):
+                    await rbd.clone("base", "s", "c")  # not protected
+                with pytest.raises(RbdError):
+                    await rbd.clone("base", "nope", "c")
+                await img.close()
+
+        run(main())
+
+    def test_read_through_and_copy_up(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                io, rbd, base, child, golden = await _setup(cluster)
+                # untouched child reads the parent through the holes
+                assert await child.read(0, len(golden)) == golden
+                assert await child.read(3 * OBJ, OBJ) == b"\x00" * OBJ
+                # parent changes AFTER the snap are invisible to the child
+                await base.write(0, b"\xdd" * OBJ)
+                assert (await child.read(0, OBJ)) == golden[:OBJ]
+                # a small write copies the whole object up, preserving
+                # the rest of the object's parent bytes
+                await child.write(100, b"CHILD")
+                got = await child.read(0, OBJ)
+                assert got[100:105] == b"CHILD"
+                assert got[:100] == golden[:100]
+                assert got[105:] == golden[105:OBJ]
+                # other objects still read through
+                assert await child.read(OBJ, OBJ) == golden[OBJ : 2 * OBJ]
+                # the parent is untouched by the child's write
+                base.set_snap("gold")
+                assert (await base.read(0, OBJ))[100:105] == golden[100:105]
+                await base.close()
+                await child.close()
+
+        run(main())
+
+    def test_clone_with_cache(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                io, rbd, base, child, golden = await _setup(
+                    cluster, cache_bytes=1 << 20
+                )
+                assert await child.read(0, 2 * OBJ) == golden[: 2 * OBJ]
+                await child.write(10, b"X")
+                got = await child.read(0, 64)
+                assert got[10:11] == b"X" and got[:10] == golden[:10]
+                await child.close()
+                # durable: reopen uncached
+                child2 = await Image.open(io, "child")
+                got = await child2.read(0, 64)
+                assert got[10:11] == b"X" and got[11:64] == golden[11:64]
+                await child2.close()
+                await base.close()
+
+        run(main())
+
+    def test_discard_masks_parent(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                io, rbd, base, child, golden = await _setup(cluster)
+                await child.discard(0, OBJ)          # whole parent object
+                assert await child.read(0, OBJ) == b"\x00" * OBJ
+                await child.discard(OBJ + 50, 20)    # partial
+                got = await child.read(OBJ, OBJ)
+                assert got[:50] == golden[OBJ : OBJ + 50]
+                assert got[50:70] == b"\x00" * 20
+                assert got[70:] == golden[OBJ + 70 : 2 * OBJ]
+                await base.close()
+                await child.close()
+
+        run(main())
+
+    def test_overlap_shrinks_with_resize(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                io, rbd, base, child, golden = await _setup(cluster)
+                await child.resize(OBJ)      # shrink under the overlap
+                await child.resize(4 * OBJ)  # grow back
+                got = await child.read(0, 4 * OBJ)
+                assert got[:OBJ] == golden[:OBJ]
+                # past the shrunken overlap: zeros, NOT stale parent bytes
+                assert got[OBJ:] == b"\x00" * (3 * OBJ)
+                await base.close()
+                await child.close()
+
+        run(main())
+
+
+class TestFlattenAndGuards:
+    def test_flatten_detaches(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                io, rbd, base, child, golden = await _setup(cluster)
+                assert await base.list_children("gold") == ["child"]
+                with pytest.raises(RbdError):
+                    await base.snap_unprotect("gold")  # child exists
+                with pytest.raises(RbdError):
+                    await base.snap_remove("gold")     # protected
+                await child.flatten()
+                assert child.parent is None
+                assert await child.read(0, len(golden)) == golden
+                # guards release once the child is independent
+                await base.snap_unprotect("gold")
+                await base.snap_remove("gold")
+                # the flattened child no longer depends on the parent
+                await base.close()
+                await rbd.remove("base")
+                assert await child.read(0, OBJ) == golden[:OBJ]
+                await child.close()
+
+        run(main())
+
+    def test_child_remove_releases_parent(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                io, rbd, base, child, golden = await _setup(cluster)
+                await child.close()
+                await rbd.remove("child")
+                assert await base.list_children("gold") == []
+                await base.snap_unprotect("gold")
+                await base.close()
+
+        run(main())
+
+
+class TestCloneCLI:
+    def test_cli_clone_workflow(self, tmp_path):
+        import os
+        import subprocess
+        import sys as _sys
+
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                mon = cluster.mon.addr
+                env = dict(os.environ, PYTHONPATH=os.getcwd() + ":"
+                           + os.environ.get("PYTHONPATH", ""))
+                src = tmp_path / "img.bin"
+                src.write_bytes(b"golden-image" * 1000)
+
+                async def rbd(*a):
+                    r = await asyncio.to_thread(
+                        subprocess.run,
+                        [_sys.executable, "-m", "ceph_tpu.tools.rbd_cli",
+                         "-m", mon, "-p", "rbd", *a],
+                        env=env, capture_output=True, text=True, timeout=60,
+                    )
+                    assert r.returncode == 0, (a, r.stderr)
+                    return r.stdout
+
+                cl = await cluster.client()
+                await cl.create_pool("rbd", "replicated", size=3)
+                await rbd("import", str(src), "golden")
+                await rbd("snap", "create", "golden@v1")
+                await rbd("snap", "protect", "golden@v1")
+                await rbd("clone", "golden@v1", "vm1")
+                assert "vm1" in await rbd("children", "golden@v1")
+                out = tmp_path / "out.bin"
+                await rbd("export", "vm1", str(out))
+                assert out.read_bytes() == src.read_bytes()
+                await rbd("flatten", "vm1")
+                assert (await rbd("children", "golden@v1")).strip() == ""
+                await rbd("snap", "unprotect", "golden@v1")
+
+        run(main())
