@@ -1,0 +1,140 @@
+"""Snapshot-aware thrashing (reference:qa/suites/rados/
+thrash-erasure-code-overwrites + snaps workloads): random OSD
+kill/restart cycles while a model-based workload mixes writes, partial
+overwrites, snapshots, snap reads, rollbacks, and deletes — at the end
+every live object AND every live snapshot must read back exactly."""
+
+import asyncio
+import random
+
+import pytest
+
+from ceph_tpu.rados import MiniCluster, RadosError
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+OBJECTS = 12
+
+
+class _Model:
+    """Client-side truth: per-object head bytes + per-snap frozen bytes."""
+
+    def __init__(self):
+        self.heads: dict[str, bytes] = {}
+        self.snaps: dict[str, dict[str, bytes]] = {}  # snap -> {obj: bytes}
+
+    def freeze(self, snap_name: str) -> None:
+        self.snaps[snap_name] = dict(self.heads)
+
+    def drop_snap(self, snap_name: str) -> None:
+        del self.snaps[snap_name]
+
+
+def _patch(data: bytes, off: int, chunk: bytes) -> bytes:
+    end = off + len(chunk)
+    base = data.ljust(end, b"\x00")
+    return base[:off] + chunk + base[end:]
+
+
+@pytest.mark.parametrize("pool_type", ["replicated", "erasure"])
+def test_thrash_with_snapshots(pool_type):
+    async def main():
+        rng = random.Random(20260730)
+        async with MiniCluster(n_osds=6) as cluster:
+            cl = await cluster.client()
+            if pool_type == "erasure":
+                code, status, _ = await cl.command({
+                    "prefix": "osd erasure-code-profile set", "name": "rs32",
+                    "profile": {"plugin": "jerasure",
+                                "technique": "reed_sol_van",
+                                "k": "3", "m": "2"},
+                })
+                assert code == 0, status
+                await cl.create_pool("p", "erasure",
+                                     erasure_code_profile="rs32", pg_num=16)
+            else:
+                await cl.create_pool("p", "replicated", size=3, pg_num=16)
+            io = cl.io_ctx("p")
+            model = _Model()
+            snap_seq = 0
+
+            async def mutate(round_no: int, ops: int = 10) -> None:
+                nonlocal snap_seq
+                for i in range(ops):
+                    name = f"o{rng.randrange(OBJECTS)}"
+                    roll = rng.random()
+                    if roll < 0.45 or name not in model.heads:
+                        data = bytes([round_no & 0xFF, i]) * rng.randrange(
+                            300, 6000
+                        )
+                        await io.write_full(name, data)
+                        model.heads[name] = data
+                    elif roll < 0.75:
+                        off = rng.randrange(0, len(model.heads[name]))
+                        chunk = bytes([i]) * rng.randrange(1, 2000)
+                        await io.write(name, chunk, offset=off)
+                        model.heads[name] = _patch(
+                            model.heads[name], off, chunk
+                        )
+                    elif roll < 0.9:
+                        await io.remove(name)
+                        del model.heads[name]
+                    else:
+                        snap_seq += 1
+                        sname = f"s{snap_seq}"
+                        await io.create_snap(sname)
+                        model.freeze(sname)
+
+            async def verify() -> None:
+                for name in (f"o{i}" for i in range(OBJECTS)):
+                    if name in model.heads:
+                        assert await io.read(name) == model.heads[name], (
+                            f"head {name} diverged"
+                        )
+                    else:
+                        with pytest.raises(RadosError) as ei:
+                            await io.read(name)
+                        # a clean does-not-exist, not a transient error
+                        assert ei.value.code == -2, (name, ei.value)
+                for sname, frozen in model.snaps.items():
+                    sid = await io.lookup_snap(sname)
+                    io.set_read(sid)
+                    try:
+                        for name, data in frozen.items():
+                            assert await io.read(name) == data, (
+                                f"snap {sname} object {name} diverged"
+                            )
+                    finally:
+                        io.set_read(None)
+
+            await mutate(0, 14)
+            for round_no in range(1, 4):
+                victim = rng.choice(sorted(cluster.osds))
+                await cluster.kill_osd(victim)
+                await cluster.wait_for_osd_down(victim)
+                await mutate(round_no)
+                # occasionally roll an object back to a live snap
+                if model.snaps and rng.random() < 0.7:
+                    sname = rng.choice(sorted(model.snaps))
+                    frozen = model.snaps[sname]
+                    if frozen:
+                        # deliberately including DELETED heads: rollback
+                        # must revive them from the clone via the snapdir
+                        name = rng.choice(sorted(frozen))
+                        await io.rollback(name, sname)
+                        model.heads[name] = frozen[name]
+                await cluster.restart_osd(victim)
+                await cluster.wait_for_osd_up(victim)
+                await mutate(round_no + 10)
+                # occasionally retire a snapshot
+                if model.snaps and rng.random() < 0.5:
+                    sname = rng.choice(sorted(model.snaps))
+                    await io.remove_snap(sname)
+                    model.drop_snap(sname)
+            await asyncio.sleep(0.6)  # settle recovery + trim
+            await verify()
+
+    run(main())
