@@ -98,6 +98,9 @@ class Monitor(Dispatcher):
         from ..common import Config
 
         self.config = config or Config()
+        from ..common.log import install as _install_memlog
+
+        _install_memlog()
         self.name = name
         self.messenger = AsyncMessenger(name, self)
         self.messenger.apply_config(self.config)

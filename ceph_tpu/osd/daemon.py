@@ -190,6 +190,9 @@ class OSD(Dispatcher):
 
         self.config = config or Config()
         cfg = self.config
+        from ..common.log import install as _install_memlog
+
+        _install_memlog()  # recent-events ring (reference:src/log)
         self.osd_id = osd_id
         self.name = f"osd.{osd_id}"
         self.mon_addr = mon_addr
@@ -327,6 +330,10 @@ class OSD(Dispatcher):
         self._stopping = True
         logger.error("%s: %s suicide timeout — aborting daemon",
                      self.name, worker)
+        from ..common.log import dump_recent
+
+        for line in dump_recent(50):  # the crash-time recent-events dump
+            logger.error("recent: %s", line)
         # NOT tracked in self._tasks: stop() cancels those, and the
         # shutdown task cancelling itself would leave the messenger up
         asyncio.ensure_future(self.stop(umount=False))
@@ -460,6 +467,18 @@ class OSD(Dispatcher):
             lambda req: self.hb_map.dump(),
             "HeartbeatMap worker deadlines",
         )
+
+        def _log_dump(req: dict) -> dict:
+            from ..common.log import install
+
+            ml = install()
+            n = int(req.get("num", 200) or 200)
+            if n < 0:
+                return {"error": f"num must be >= 0, got {n}"}
+            return {"entries": ml.recent(n=n, level=req.get("level"))}
+
+        a.register("log dump", _log_dump,
+                   "recent in-memory log entries (ring buffer)")
 
         def _dump_tracepoints(_req: dict) -> dict:
             from ..common.tracing import dump_all

@@ -216,3 +216,62 @@ def test_admin_socket_scrub_counters(tmp_path):
             assert total > 0
 
     asyncio.run(main())
+
+
+# -- in-memory ring log (reference:src/log/Log.cc) ---------------------------
+
+
+def test_memory_log_ring_and_admin_dump(tmp_path):
+    """The recent-events ring records across subsystems and serves
+    `log dump` from a live OSD's admin socket."""
+    import logging
+
+    from ceph_tpu.common.log import dump_recent, install
+
+    import pytest as _pytest
+
+    ml = install()
+    ml.clear()
+    root = logging.getLogger("ceph_tpu")
+    old_level = root.level
+    root.setLevel(logging.DEBUG)  # the ring honors configured levels
+    try:
+        logging.getLogger("ceph_tpu.test_subsys").debug("quiet detail %d", 7)
+        logging.getLogger("ceph_tpu.test_subsys").error("loud failure")
+    finally:
+        root.setLevel(old_level)
+    entries = ml.recent()
+    msgs = [e["msg"] for e in entries]
+    assert "quiet detail 7" in msgs and "loud failure" in msgs
+    only_err = ml.recent(level="ERROR")
+    assert [e["msg"] for e in only_err][-1] == "loud failure"
+    with _pytest.raises(ValueError):
+        ml.recent(level="not-a-level")
+    assert ml.recent(n=1)[-1]["msg"] == "loud failure"
+    assert any("loud failure" in line for line in dump_recent(10))
+    # capacity resize preserves entries
+    ml2 = install(capacity=7)
+    assert ml2 is ml and ml._ring.maxlen == 7
+    install(capacity=10000)
+
+    async def main():
+        from ceph_tpu.common import Config
+        from ceph_tpu.common.admin_socket import admin_command
+        from ceph_tpu.osd.daemon import OSD
+
+        sock = str(tmp_path / "{name}.asok")
+        async with MiniCluster(n_osds=3) as cluster:
+            await cluster.kill_osd(0)
+            cfg = Config(overrides={"admin_socket": sock})
+            osd = OSD(0, cluster.mon.addr, store=cluster.stores[0],
+                      config=cfg)
+            await osd.start()
+            cluster.osds[0] = osd
+            out = await admin_command(
+                str(tmp_path / "osd.0.asok"), "log dump", num=500
+            )
+            assert any(
+                "loud failure" in e["msg"] for e in out["entries"]
+            )
+
+    asyncio.run(main())
