@@ -984,12 +984,16 @@ class OSD(Dispatcher):
         self, pg: PGid, pool: Pool, acting: list[int], oid: str,
         key: str, value: bytes | None, raw_key: bool = False,
         snapc: "snaps_mod.SnapContext | None" = None,
+        create_missing: bool = True,
     ) -> int:
         """Set (or remove, value=None) a user xattr on every present
         shard — a versioned mutation through the normal sub-write path
         (reference stores object attrs on all EC shards).  ``raw_key``
         skips the user prefix (system attrs, e.g. the SnapSet).  Like
-        every mutation, clones on first-write-after-snap."""
+        every mutation, clones on first-write-after-snap.
+        ``create_missing=False`` answers -ENOENT instead of creating —
+        background maintainers (the snap trimmer) must never RESURRECT
+        an object a racing client delete just removed."""
         async with self.pg_lock(pg):
             codec, _si = self._pool_codec(pool)
             k, km = codec.get_data_chunk_count(), codec.get_chunk_count()
@@ -1005,8 +1009,8 @@ class OSD(Dispatcher):
             if any(e != -ENOENT for e in errs.values()):
                 return -EAGAIN
             create = oi is None
-            if create and value is None:
-                return -ENOENT  # rmxattr on a missing object
+            if create and (value is None or not create_missing):
+                return -ENOENT  # rmxattr / no-create on a missing object
             if not create:
                 newest = tuple(Eversion.from_list(oi["version"]).to_list())
                 present = [
@@ -1429,10 +1433,13 @@ class OSD(Dispatcher):
                 ok = ok and r in (0, -ENOENT)
             carrier = head if head_exists else snaps_mod.snapdir_name(head)
             if ss.clones or head_exists:
+                # NEVER create: head_exists is a pre-lock snapshot, and a
+                # racing client delete must not be undone by the trimmer
+                # recreating the head as an empty object (thrash finding)
                 r = await self._ec_setxattr(
                     pg, pool, acting, carrier, snaps_mod.SS_KEY,
                     ss.to_json() if not ss.empty() else None,
-                    raw_key=True,
+                    raw_key=True, create_missing=False,
                 )
             else:
                 r = await self._ec_delete(pg, pool, acting, carrier)

@@ -31,6 +31,26 @@ EAGAIN = 11
 EACCES = 13
 
 
+def resolve_mon_arg(spec: str) -> "str | list[str]":
+    """A ``-m`` value: one address, a comma list, or a monmap FILE (the
+    bootstrap artifact monmaptool writes / vstart --write-monmap emits).
+    A broken monmap file exits with a CLI-friendly error, not a
+    traceback — this only runs on operator-supplied ``-m`` values."""
+    import os as _os
+    import sys as _sys
+
+    if _os.path.isfile(spec):
+        from ..tools.monmaptool import load_monmap, monmap_addrs
+
+        try:
+            return monmap_addrs(load_monmap(spec))
+        except Exception as e:
+            print(f"error: bad monmap file {spec!r}: {e}",
+                  file=_sys.stderr)
+            raise SystemExit(2) from e
+    return spec.split(",") if "," in spec else spec
+
+
 class RadosError(OSError):
     def __init__(self, code: int, msg: str = ""):
         super().__init__(abs(code), msg or f"rados error {code}")

@@ -63,7 +63,9 @@ def main(argv=None) -> int:
     p.add_argument("words", nargs="+", help="command words")
     args = p.parse_args(argv)
     prefix = " ".join(args.words)
-    mon = args.mon.split(",") if "," in args.mon else args.mon
+    from ..rados.client import resolve_mon_arg
+
+    mon = resolve_mon_arg(args.mon)
 
     async def run() -> int:
         client = await RadosClient(mon).connect()

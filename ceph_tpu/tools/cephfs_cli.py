@@ -22,15 +22,11 @@ import json
 import sys
 
 from ..mds import CephFSClient, FSError
-from ..rados.client import RadosClient, RadosError
-
-
-def _mon_arg(m: str) -> "str | list[str]":
-    return m.split(",") if "," in m else m
+from ..rados.client import RadosClient, RadosError, resolve_mon_arg
 
 
 async def _run(args) -> int:
-    client = await RadosClient(_mon_arg(args.mon)).connect()
+    client = await RadosClient(resolve_mon_arg(args.mon)).connect()
     try:
         fs = await CephFSClient.mount(client)
         if args.cmd == "ls":

@@ -26,15 +26,11 @@ import os
 import sys
 import time
 
-from ..rados.client import RadosClient, RadosError
-
-
-def _mon_arg(m: str) -> "str | list[str]":
-    return m.split(",") if "," in m else m
+from ..rados.client import RadosClient, RadosError, resolve_mon_arg
 
 
 async def _with_client(args, fn) -> int:
-    client = await RadosClient(_mon_arg(args.mon)).connect()
+    client = await RadosClient(resolve_mon_arg(args.mon)).connect()
     try:
         return await fn(client)
     finally:

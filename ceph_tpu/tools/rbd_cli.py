@@ -20,12 +20,8 @@ import asyncio
 import sys
 import time
 
-from ..rados.client import RadosClient, RadosError
+from ..rados.client import RadosClient, RadosError, resolve_mon_arg
 from ..rbd import RBD, Image
-
-
-def _mon_arg(m: str) -> "str | list[str]":
-    return m.split(",") if "," in m else m
 
 
 def _split_snap(spec: str) -> tuple[str, str]:
@@ -254,7 +250,7 @@ def main(argv=None) -> int:
     }[args.cmd]
 
     async def run() -> int:
-        client = await RadosClient(_mon_arg(args.mon)).connect()
+        client = await RadosClient(resolve_mon_arg(args.mon)).connect()
         try:
             io = client.io_ctx(args.pool)
             rbd = RBD(io)

@@ -18,13 +18,9 @@ import asyncio
 import json
 import sys
 
-from ..rados.client import RadosClient, RadosError
+from ..rados.client import RadosClient, RadosError, resolve_mon_arg
 from ..rgw import RGWStore
 from ..rgw.http import S3Server
-
-
-def _mon_arg(m: str) -> "str | list[str]":
-    return m.split(",") if "," in m else m
 
 
 async def _cmd_user(store: RGWStore, args) -> int:
@@ -86,7 +82,7 @@ def main(argv=None) -> int:
         p.error("--bucket required")
 
     async def run() -> int:
-        client = await RadosClient(_mon_arg(args.mon)).connect()
+        client = await RadosClient(resolve_mon_arg(args.mon)).connect()
         try:
             store = await RGWStore.create(client)
             fn = {"user": _cmd_user, "bucket": _cmd_bucket,

@@ -45,6 +45,17 @@ async def _run(args) -> int:
     await cluster.start()
     monmap = ",".join(cluster.monmap)
     print(f"mon:    {monmap}")
+    if args.write_monmap:
+        from .monmaptool import save_monmap
+
+        save_monmap({
+            "epoch": 1,
+            "mons": [
+                {"rank": i, "name": f"mon.{i}", "addr": a}
+                for i, a in enumerate(cluster.monmap)
+            ],
+        }, args.write_monmap)
+        print(f"monmap: {args.write_monmap}")
     if args.auth:
         print(f"keyring: {cluster._keyring_path} (client.admin)")
     if args.mgr:
@@ -99,6 +110,9 @@ def main(argv=None) -> int:
     p.add_argument("--crush-hosts", default=None, metavar="HxP",
                    help='hierarchy, e.g. "2x2" = 2 hosts x 2 osds')
     p.add_argument("--heartbeat-interval", type=float, default=1.0)
+    p.add_argument("--write-monmap", default=None, metavar="PATH",
+                   help="write the bootstrap monmap file (every CLI's "
+                        "-m accepts it)")
     args = p.parse_args(argv)
     return asyncio.run(_run(args))
 

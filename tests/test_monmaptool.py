@@ -1,0 +1,114 @@
+"""monmaptool + monmap-file bootstrap tests
+(reference:src/tools/monmaptool.cc): create/add/rm/print, and the
+end-to-end contract — a monmap file written by vstart bootstraps every
+CLI through -m."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from ceph_tpu.rados.client import resolve_mon_arg
+from ceph_tpu.tools.monmaptool import load_monmap, main, monmap_addrs
+
+
+def _tool(*args):
+    return main(list(args))
+
+
+class TestMonmaptool:
+    def test_create_add_rm_print(self, tmp_path, capsys):
+        path = str(tmp_path / "monmap.json")
+        assert _tool("--create", "--add", "mon.a", "127.0.0.1:6789",
+                     "--add", "mon.b", "127.0.0.1:6790", "-o", path) == 0
+        m = load_monmap(path)
+        assert monmap_addrs(m) == ["127.0.0.1:6789", "127.0.0.1:6790"]
+        # duplicate guards
+        assert _tool(path, "--add", "mon.a", "127.0.0.1:7000") == 1
+        assert _tool(path, "--add", "mon.c", "127.0.0.1:6789") == 1
+        # rm re-ranks and bumps the epoch
+        e0 = load_monmap(path)["epoch"]
+        assert _tool(path, "--rm", "mon.a") == 0
+        m = load_monmap(path)
+        assert m["epoch"] == e0 + 1
+        assert monmap_addrs(m) == ["127.0.0.1:6790"]
+        assert m["mons"][0]["rank"] == 0
+        assert _tool(path, "--rm", "ghost") == 1
+        # print
+        capsys.readouterr()
+        assert _tool(path, "--print") == 0
+        out = capsys.readouterr().out
+        assert "127.0.0.1:6790 mon.b" in out
+
+    def test_bad_file_rejected(self, tmp_path):
+        bad = tmp_path / "not-a-monmap.json"
+        bad.write_text('{"foo": 1}')
+        assert _tool(str(bad), "--print") == 1
+
+    def test_resolve_mon_arg_forms(self, tmp_path):
+        assert resolve_mon_arg("1.2.3.4:5") == "1.2.3.4:5"
+        assert resolve_mon_arg("a:1,b:2") == ["a:1", "b:2"]
+        path = str(tmp_path / "monmap.json")
+        _tool("--create", "--add", "mon.a", "9.9.9.9:1",
+              "--add", "mon.b", "9.9.9.9:2", "-o", path)
+        assert resolve_mon_arg(path) == ["9.9.9.9:1", "9.9.9.9:2"]
+
+
+def test_monmap_file_bootstraps_clis(tmp_path):
+    """vstart --write-monmap emits the artifact; the CLIs consume it."""
+    env = dict(os.environ, PYTHONPATH=os.getcwd() + ":" + os.environ.get(
+        "PYTHONPATH", ""))
+    monmap = str(tmp_path / "monmap.json")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ceph_tpu.tools.vstart",
+         "--osds", "3", "--mons", "3", "--write-monmap", monmap],
+        env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,  # never fill a pipe nobody drains
+        text=True,
+    )
+    try:
+        # bounded wait for "ready": a wedged vstart must fail, not hang
+        import selectors
+        import time as _time
+
+        sel = selectors.DefaultSelector()
+        sel.register(proc.stdout, selectors.EVENT_READ)
+        deadline = _time.monotonic() + 60
+        ready = False
+        buf = ""
+        while _time.monotonic() < deadline and not ready:
+            if not sel.select(timeout=1.0):
+                continue
+            chunk = os.read(proc.stdout.fileno(), 4096).decode()
+            if not chunk:
+                break
+            buf += chunk
+            ready = any(
+                ln.startswith("ready") for ln in buf.splitlines()
+            )
+        sel.close()
+        assert ready, f"vstart never became ready:\n{buf}"
+        m = load_monmap(monmap)
+        assert len(m["mons"]) == 3
+        r = subprocess.run(
+            [sys.executable, "-m", "ceph_tpu.tools.rados_cli",
+             "-m", monmap, "mkpool", "p", "replicated"],
+            env=env, capture_output=True, text=True, timeout=60,
+        )
+        assert r.returncode == 0, r.stderr
+        r = subprocess.run(
+            [sys.executable, "-m", "ceph_tpu.tools.rados_cli",
+             "-m", monmap, "lspools"],
+            env=env, capture_output=True, text=True, timeout=60,
+        )
+        assert r.returncode == 0 and "p" in r.stdout
+    finally:
+        proc.send_signal(signal.SIGINT)
+        try:
+            proc.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            raise
