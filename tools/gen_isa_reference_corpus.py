@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Generate the reference-pinned ISA parity corpus.
+
+Runs the vendored ISA-L C reference oracle (ceph_tpu/utils/isa_oracle.py —
+compiled from reference:src/erasure-code/isa/isa-l/erasure_code/ec_base.c,
+unmodified) over a deterministic profile grid and writes
+``tests/golden/isa_reference/manifest.json``.
+
+Unlike the older self-generated ``tests/golden/ec_corpus`` entries, the
+bytes in this manifest are produced by Intel's code as shipped in the
+reference tree — the generator is recorded in the manifest, including the
+sha256 of the exact ec_base.c compiled.  This is the repo's analog of the
+``ceph-erasure-code-corpus`` submodule pin
+(reference:src/test/erasure-code/ceph_erasure_code_non_regression.cc:154,226).
+
+Data chunks are not stored: they are regenerated from the recorded numpy
+PCG64 seed, which is part of the pinned contract.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import pathlib
+import sys
+
+import numpy as np
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from ceph_tpu.utils import isa_oracle as O  # noqa: E402
+
+OUT = (
+    pathlib.Path(__file__).resolve().parent.parent
+    / "tests" / "golden" / "isa_reference" / "manifest.json"
+)
+
+# (technique, k, m, chunk_len): both matrix kinds, the BASELINE.md headline
+# shapes, and one deliberately odd length (no SIMD alignment).
+GRID = [
+    ("reed_sol_van", 2, 1, 4096),
+    ("reed_sol_van", 4, 2, 4096),
+    ("reed_sol_van", 8, 3, 4096),
+    ("reed_sol_van", 8, 3, 1000),
+    ("reed_sol_van", 6, 3, 4096),
+    ("cauchy", 2, 1, 4096),
+    ("cauchy", 4, 2, 4096),
+    ("cauchy", 8, 3, 4096),
+    ("cauchy", 10, 4, 4096),
+    ("cauchy", 10, 4, 1000),
+]
+
+SEED = 0xCE11  # stable corpus seed
+
+
+def case_data(k: int, length: int, seed: int) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, size=(k, length), dtype=np.uint8)
+
+
+def main() -> None:
+    if not O.available():
+        raise SystemExit("reference ISA-L sources unavailable; cannot generate")
+    O.build(force=True)
+    src = O.ec_base_path()
+    cases = []
+    for tech, k, m, length in GRID:
+        data = case_data(k, length, SEED + k * 1000 + m * 10 + length)
+        parity = O.encode_km(tech, k, m, data)
+        full = O.gen_matrix(tech, k, m)
+        cases.append({
+            "technique": tech,
+            "k": k,
+            "m": m,
+            "chunk_len": length,
+            "data_seed": SEED + k * 1000 + m * 10 + length,
+            "matrix_parity_rows": full[k:, :].tolist(),
+            "parity": [
+                base64.b64encode(parity[i].tobytes()).decode()
+                for i in range(m)
+            ],
+            "parity_sha256": [
+                hashlib.sha256(parity[i].tobytes()).hexdigest()
+                for i in range(m)
+            ],
+        })
+    manifest = {
+        "generator": {
+            "implementation": "vendored ISA-L plain-C reference (ec_base.c)",
+            "source": "reference:src/erasure-code/isa/isa-l/erasure_code/ec_base.c",
+            "source_sha256": hashlib.sha256(src.read_bytes()).hexdigest(),
+            "shim": "native/isa_oracle_shim.c",
+            "note": (
+                "parity bytes produced by Intel's unmodified C fallback "
+                "(gf_gen_rs_matrix/gf_gen_cauchy1_matrix + ec_encode_data_base);"
+                " NOT by any code in this repo"
+            ),
+        },
+        "cases": cases,
+    }
+    OUT.parent.mkdir(parents=True, exist_ok=True)
+    OUT.write_text(json.dumps(manifest, indent=1))
+    print(f"wrote {OUT} ({len(cases)} cases)")
+
+
+if __name__ == "__main__":
+    main()
