@@ -8,16 +8,23 @@ messenger handshake (``AuthAuthorizer``) without talking to the mon
 (reference:src/auth/cephx/CephxProtocol.h).
 
 Collapsed to its load-bearing parts (HMAC-SHA256 in place of the
-reference's AES construction — the trust model is identical):
+reference's AES construction):
 
 - :class:`Keyring` — entity name -> secret (file- or dict-backed).
 - The mon verifies ``auth get-ticket`` requests by HMAC over a fresh
   client nonce and replies with a :class:`Ticket` sealed with the
-  CLUSTER secret.
+  CLUSTER secret, plus a ticket-bound SESSION KEY sealed with the
+  entity's own secret (CephxServiceTicket::secret analog) — only the
+  keyholder can recover it; it never travels in the clear.
 - Every daemon holds the cluster secret and verifies tickets inline
   during the messenger handshake; daemons authorize each other with
   the same mechanism (their tickets are self-issued since they hold
   the cluster secret).
+- The handshake is challenge-bound: the acceptor sends a fresh nonce
+  and requires ``HMAC(session_key, nonce)`` back, so observing one
+  handshake does not let you replay the authorizer (the reference
+  added the same server challenge for CVE-2018-1128,
+  reference:src/msg/async/ProtocolV1 authorizer challenge).
 """
 
 from __future__ import annotations
@@ -92,6 +99,19 @@ class Ticket:
         return {**payload, "sig": _sig(cluster_secret, blob)}
 
     @staticmethod
+    def session_key(cluster_secret: str, ticket: dict) -> str:
+        """The ticket-bound session key (CephxServiceTicket secret
+        analog).  Derivable only by cluster-secret holders; handed to the
+        ticket's owner sealed under its entity secret (:func:`seal_skey`).
+        Never sent in the clear — it is what the handshake challenge
+        proves possession of."""
+        blob = json.dumps(
+            {"entity": ticket["entity"], "expires": ticket["expires"]},
+            sort_keys=True,
+        ).encode()
+        return _sig(cluster_secret, b"skey:" + blob)
+
+    @staticmethod
     def verify(cluster_secret: str, ticket: dict | None) -> str | None:
         """Returns the authenticated entity, or None."""
         if not isinstance(ticket, dict):
@@ -118,6 +138,26 @@ def challenge_response(entity_secret: str, nonce: str) -> str:
     return _sig(entity_secret, f"cephx-auth:{nonce}".encode())
 
 
+def seal_skey(entity_secret: str, ticket: dict, skey: str) -> str:
+    """Seal a session key under the entity's own secret for transport in
+    MAuthReply (the reference encrypts the service ticket with the
+    client key; here: XOR with an entity-keyed mask over the ticket
+    sig, recoverable only by the keyholder)."""
+    mask = _sig(entity_secret, b"seal:" + str(ticket.get("sig", "")).encode())
+    return format(int(skey, 16) ^ int(mask, 16), f"0{len(skey)}x")
+
+
+def unseal_skey(entity_secret: str, ticket: dict, sealed: str) -> str:
+    return seal_skey(entity_secret, ticket, sealed)  # XOR is its own inverse
+
+
+def connection_proof(session_key: str, challenge: str) -> str:
+    """The connector's answer to the acceptor's handshake nonce: proves
+    possession of the ticket's session key, not just the (observable)
+    ticket bytes — replaying a sniffed handshake fails on a new nonce."""
+    return _sig(session_key, f"cephx-conn:{challenge}".encode())
+
+
 def daemon_auth_context(config, name: str) -> "AuthContext | None":
     """The auth context a cluster daemon's messenger runs with: holds
     the cluster secret (so it verifies peers and self-issues its own
@@ -136,15 +176,29 @@ class AuthContext:
 
     def __init__(self, entity: str, *, cluster_secret: str | None = None,
                  require: bool = False):
+        if require and cluster_secret is None:
+            # fail closed at construction: a daemon demanding auth
+            # without the means to verify it would otherwise accept
+            # everyone (ADVICE r2: verify() used to return "" here)
+            raise ValueError(
+                "AuthContext(require=True) needs the cluster secret"
+            )
         self.entity = entity
         self.cluster_secret = cluster_secret
         self.require = require
         self.ticket: dict | None = None
+        self.session_key: str | None = None
         if cluster_secret is not None:
             # a cluster-secret holder vouches for itself
             self.ticket = Ticket.issue(cluster_secret, entity)
+            self.session_key = Ticket.session_key(cluster_secret, self.ticket)
 
     REFRESH_MARGIN = 60.0  # re-issue this close to expiry
+
+    def adopt_ticket(self, ticket: dict, session_key: str) -> None:
+        """Install a mon-issued ticket + its (unsealed) session key."""
+        self.ticket = ticket
+        self.session_key = session_key
 
     def authorizer(self) -> dict | None:
         if (
@@ -155,6 +209,9 @@ class AuthContext:
             # cluster-secret holders re-vouch for themselves; ticketed
             # clients refresh through the mon (RadosClient._authenticate)
             self.ticket = Ticket.issue(self.cluster_secret, self.entity)
+            self.session_key = Ticket.session_key(
+                self.cluster_secret, self.ticket
+            )
         return self.ticket
 
     def ticket_fresh(self) -> bool:
@@ -163,13 +220,33 @@ class AuthContext:
             and self.ticket["expires"] >= time.time() + self.REFRESH_MARGIN
         )
 
-    def verify(self, authorizer: dict | None) -> str | None:
+    def prove(self, challenge: str) -> str | None:
+        """Connector side: answer the acceptor's handshake nonce."""
+        if self.session_key is None:
+            return None
+        return connection_proof(self.session_key, challenge)
+
+    def verify(self, authorizer: dict | None, *,
+               challenge: str | None = None,
+               proof: str | None = None) -> str | None:
         """None = reject; entity name = accept.  Only meaningful on
-        daemons (cluster-secret holders)."""
-        if not self.require:
-            return "" if authorizer is None else (
-                Ticket.verify(self.cluster_secret or "", authorizer) or ""
-            )
+        daemons (cluster-secret holders).
+
+        When ``challenge`` is given (the nonce this acceptor sent), the
+        peer must also present ``proof`` == HMAC(session_key, nonce):
+        ticket bytes alone — which any observer of a prior handshake
+        holds — are not enough."""
         if self.cluster_secret is None:
-            return ""  # cannot verify: not enforcing
-        return Ticket.verify(self.cluster_secret, authorizer)
+            # cannot verify anything; only acceptable when not enforcing
+            return None if self.require else ""
+        if not self.require and authorizer is None:
+            return ""
+        entity = Ticket.verify(self.cluster_secret, authorizer)
+        if entity is None:
+            return None
+        if challenge is not None:
+            skey = Ticket.session_key(self.cluster_secret, authorizer)
+            want = connection_proof(skey, challenge)
+            if proof is None or not hmac.compare_digest(want, proof):
+                return None
+        return entity

@@ -923,7 +923,7 @@ class Monitor(Dispatcher):
     # src/auth/cephx/CephxServiceHandler.cc) --------------------------------
 
     def _handle_auth(self, conn: Connection, msg: "messages.MAuth") -> None:
-        from ..auth import Ticket, challenge_response, new_secret
+        from ..auth import Ticket, challenge_response, new_secret, seal_skey
 
         if self._keyring is None:
             conn.send(messages.MAuthReply(
@@ -952,11 +952,13 @@ class Monitor(Dispatcher):
             conn._auth_nonce = None  # single use
             conn.authenticated = True
             conn.peer_name = msg.entity
+            ticket = Ticket.issue(self._keyring.cluster_secret, msg.entity)
+            # the session key rides sealed under the ENTITY secret: only
+            # the keyholder can use the ticket in a handshake challenge
+            skey = Ticket.session_key(self._keyring.cluster_secret, ticket)
             conn.send(messages.MAuthReply(
-                tid=msg.tid, result=0, nonce=None,
-                ticket=Ticket.issue(
-                    self._keyring.cluster_secret, msg.entity
-                ),
+                tid=msg.tid, result=0, nonce=None, ticket=ticket,
+                skey=seal_skey(secret, ticket, skey),
             ))
             return
         conn.send(messages.MAuthReply(

@@ -349,10 +349,11 @@ class MAuth(Message):
 @register
 class MAuthReply(Message):
     """reference:src/messages/MAuthReply.h; carries the service ticket
-    on success."""
+    on success plus the ticket's session key sealed under the entity
+    secret (CephxServiceTicket secret analog — see auth.seal_skey)."""
 
     TYPE = "auth_reply"
-    FIELDS = ("tid", "result", "nonce", "ticket")
+    FIELDS = ("tid", "result", "nonce", "ticket", "skey")
 
 
 @register

@@ -217,7 +217,27 @@ class AsyncMessenger:
                 # the TICKET's entity is the authenticated identity; the
                 # banner name is just the instance label (many clients
                 # share one keyring entity, like client.admin)
-                entity = self.auth.verify(banner.get("authorizer"))
+                entity = None
+                if banner.get("authorizer") is not None:
+                    # challenge-bound verification: the peer must prove it
+                    # holds the ticket's session key, not just ticket
+                    # bytes observable from an earlier handshake (the
+                    # reference's authorizer challenge, CVE-2018-1128)
+                    from ..auth import new_secret
+
+                    nonce = new_secret()
+                    writer.write(
+                        json.dumps({"challenge": nonce}).encode() + b"\n"
+                    )
+                    await writer.drain()
+                    answer = json.loads((await reader.readline()).decode())
+                    if not isinstance(answer, dict):
+                        answer = {}
+                    entity = self.auth.verify(
+                        banner["authorizer"],
+                        challenge=nonce,
+                        proof=answer.get("proof"),
+                    )
                 conn.auth_entity = entity or ""
                 if entity is None:
                     if self.auth_mon_mode:
@@ -311,6 +331,27 @@ class AsyncMessenger:
                     raise ConnectionResetError(
                         f"{addr}: peer closed during handshake"
                     )
+                try:
+                    probe = json.loads(line.decode()) if line.strip() else {}
+                except ValueError as e:
+                    raise ConnectionResetError(
+                        f"{addr}: bad handshake banner: {e!r}"
+                    ) from e
+                if isinstance(probe, dict) and "challenge" in probe:
+                    # acceptor demands proof of session-key possession
+                    proof = (
+                        self.auth.prove(probe["challenge"])
+                        if self.auth is not None else None
+                    )
+                    writer.write(
+                        json.dumps({"proof": proof}).encode() + b"\n"
+                    )
+                    await writer.drain()
+                    line = await reader.readline()
+                    if not line:
+                        raise ConnectionResetError(
+                            f"{addr}: peer closed during auth challenge"
+                        )
                 try:
                     banner = json.loads(line.decode())
                     if isinstance(banner, dict) and "error" in banner:

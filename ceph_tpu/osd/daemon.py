@@ -268,6 +268,9 @@ class OSD(Dispatcher):
         # table; clients re-register after resets (the linger model)
         self._watchers: dict[tuple[int, str], dict[str, Connection]] = {}
         self._notify_waiters: dict[int, "_NotifyWaiter"] = {}
+        # (watch key, client notify id) -> completed/in-flight fan-out:
+        # retried notifies join rather than re-fire (see _do_notify)
+        self._notify_dedupe: dict[tuple, asyncio.Future] = {}
         self._pg_locks: dict[str, asyncio.Lock] = {}
         # watchdog (reference:common/HeartbeatMap): the op engine is the
         # "worker"; a wedged op marks the daemon unhealthy (heartbeats
@@ -2118,7 +2121,8 @@ class OSD(Dispatcher):
                 )
                 timeout = float(op.get("timeout", 5.0))
                 acks, missed = await self._do_notify(
-                    key, msg.oid, payload, timeout
+                    key, msg.oid, payload, timeout,
+                    nid=op.get("nid"),
                 )
                 out.append({
                     "rval": 0,
@@ -2145,10 +2149,40 @@ class OSD(Dispatcher):
         return 0 if self.store.exists(cid, ObjectId(oid)) else -ENOENT
 
     async def _do_notify(
-        self, key: tuple[int, str], oid: str, payload: bytes, timeout: float
+        self, key: tuple[int, str], oid: str, payload: bytes, timeout: float,
+        nid: str | None = None,
     ) -> tuple[dict[str, bytes], list[str]]:
         """Fan a notify out to every watcher, gather acks (or time out),
-        reference:src/osd/Watch.cc Notify::init/maybe_complete_notify."""
+        reference:src/osd/Watch.cc Notify::init/maybe_complete_notify.
+
+        ``nid`` is the client-chosen notify id: operate()'s retry loop
+        (map change / not-primary / EAGAIN) may deliver the same logical
+        notify twice, and watch callbacks are not required to be
+        idempotent (ADVICE r2) — a duplicate nid joins the in-flight (or
+        completed) fan-out instead of re-firing every watcher."""
+        if nid is not None:
+            prior = self._notify_dedupe.get((key, nid))
+            if prior is not None:
+                return await asyncio.shield(prior)
+            fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            self._notify_dedupe[(key, nid)] = fut
+            if len(self._notify_dedupe) > 512:  # bounded memory: evict
+                # oldest COMPLETED entries only — evicting an in-flight
+                # fan-out would re-enable the double-fire this prevents
+                done = [
+                    kk for kk, f in self._notify_dedupe.items() if f.done()
+                ]
+                for kk in done[: len(self._notify_dedupe) - 512]:
+                    self._notify_dedupe.pop(kk, None)
+            try:
+                result = await self._do_notify(key, oid, payload, timeout)
+            except BaseException as e:
+                fut.set_exception(e)
+                fut.exception()  # retrieved: no un-awaited warning
+                self._notify_dedupe.pop((key, nid), None)
+                raise
+            fut.set_result(result)
+            return result
         watchers = dict(self._watchers.get(key, {}))
         notify_id = self._new_tid()
         waiter = _NotifyWaiter(set(watchers))

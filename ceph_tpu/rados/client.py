@@ -118,7 +118,7 @@ class RadosClient(Dispatcher):
         """CephX bootstrap (reference:MonClient::authenticate): prove key
         possession over a mon nonce, pocket the ticket — every later
         handshake (OSDs, other mons) presents it."""
-        from ..auth import AuthContext, challenge_response
+        from ..auth import AuthContext, challenge_response, unseal_skey
 
         if self.auth_secret is None or (
             self.messenger.auth is not None
@@ -135,10 +135,12 @@ class RadosClient(Dispatcher):
             "entity": self.auth_entity or self.name,
             "proof": challenge_response(self.auth_secret, r1.nonce),
         })
-        if r2.result < 0 or not r2.ticket:
+        if r2.result < 0 or not r2.ticket or not r2.skey:
             raise RadosError(r2.result or -EACCES, "authentication failed")
         ctx = AuthContext(self.auth_entity or self.name)
-        ctx.ticket = r2.ticket
+        ctx.adopt_ticket(
+            r2.ticket, unseal_skey(self.auth_secret, r2.ticket, r2.skey)
+        )
         self.messenger.auth = ctx
 
     async def _auth_roundtrip(self, conn: Connection, fields: dict):
@@ -808,9 +810,12 @@ class IoCtx:
         "missed": [cookie]} after all acks or the timeout."""
         # the op must outlive the OSD-side ack gather, or operate()'s
         # retry would fan duplicate notifies at every watcher
+        # client-chosen notify id: if operate()'s retry loop resends the
+        # op, the OSD dedupes on it instead of double-firing callbacks
+        nid = f"{self.client.name}.n{next(self.client._tid)}"
         reply = await self.client.operate(
             self.pool_name, oid,
-            [{"op": "notify", "data": 0, "timeout": timeout}],
+            [{"op": "notify", "data": 0, "timeout": timeout, "nid": nid}],
             [bytes(payload)],
             op_timeout=timeout + 5.0,
         )

@@ -152,16 +152,26 @@ class ObjectCacher:
             for o, obj in targets:
                 await self._flush_one(o, obj)
 
-    async def invalidate(self, oid: str | None = None) -> None:
-        """Drop cached state (the watch/notify 'someone else wrote'
-        hook); dirty data is flushed first, like the reference's
-        release_set-after-flush."""
+    async def invalidate(
+        self, oid: str | None = None, *, discard: bool = False
+    ) -> None:
+        """Drop cached state.  Two modes:
+
+        - ``discard=False`` (default, self-initiated release): dirty data
+          is flushed first, like the reference's release_set-after-flush.
+        - ``discard=True`` (remote-change notification — another client
+          resized/rolled back/overwrote): dirty buffers are dropped
+          WITHOUT flushing.  Flushing here would push stale whole-object
+          writes over the other client's change (e.g. resurrect
+          pre-rollback data), since the exclusive lock is advisory
+          (ADVICE r2)."""
         async with self._lock:
             names = [oid] if oid is not None else list(self._objs)
             for o in names:
                 obj = self._objs.pop(o, None)
                 if obj is not None:
-                    await self._flush_one(o, obj)
+                    if not discard:
+                        await self._flush_one(o, obj)
                     self._bytes -= len(obj.data)
 
     def stats(self) -> dict:

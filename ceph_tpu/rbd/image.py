@@ -235,8 +235,10 @@ class Image:
         async def refresh_and_drop():
             await self._refresh()
             # another client changed the image (rollback/resize/...):
-            # cached data may be stale now
-            await self._cache_drop()
+            # cached data may be stale now.  discard, don't flush — a
+            # flush would overwrite the other client's change with our
+            # stale whole-object buffers (the lock is advisory)
+            await self._cache_drop(discard=True)
 
         return refresh_and_drop()
 
@@ -439,9 +441,9 @@ class Image:
         if self._cache is not None:
             await self._cache.flush()
 
-    async def _cache_drop(self) -> None:
+    async def _cache_drop(self, *, discard: bool = False) -> None:
         if self._cache is not None:
-            await self._cache.invalidate()
+            await self._cache.invalidate(discard=discard)
 
     # -- metadata ----------------------------------------------------------
     async def resize(self, new_size: int) -> None:
@@ -548,8 +550,10 @@ class Image:
         if s is None:
             raise RbdError(-ENOENT, f"no snap {snap_name!r}")
         # rollback rewrites objects server-side: cached state is stale
+        # (our own pending writes are flushed first by design; the drop
+        # itself must not re-flush)
         await self._cache_flush()
-        await self._cache_drop()
+        await self._cache_drop(discard=True)
         snapid, snap_size = int(s["id"]), int(s["size"])
         max_size = max(self.size_bytes, snap_size)
         count = -(-max_size // self.object_size)

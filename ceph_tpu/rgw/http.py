@@ -15,16 +15,24 @@ convention this framework's store supports:
   POST   /<bucket>/<key>?uploads   initiate multipart
   POST   /<bucket>/<key>?uploadId  complete multipart
 
-Auth: ``Authorization: AWS <access_key>:<anything>`` — the key selects
-the user (the reference's signature check collapsed to key lookup;
-CephX-style wire auth lives in the messenger tier).  Responses are
-JSON rather than XML — a deliberate re-design; the verbs, status
-codes, and listing semantics are the S3 ones.
+Auth: ``Authorization: AWS <access_key>:<signature>`` — AWS signature
+v2 (reference:src/rgw/rgw_auth_s3.h rgw_create_s3_canonical_header /
+RGW_Auth_S3): the signature is base64(HMAC-SHA1(secret_key,
+StringToSign)) over method, content-md5, content-type, date, and the
+canonical resource path; the server recomputes it from the stored
+secret and compares constant-time.  Knowing the (public) access key id
+alone no longer grants access.  Clock-skew checking and the x-amz-*
+header canonicalization are the simplifications vs the reference.
+Responses are JSON rather than XML — a deliberate re-design; the
+verbs, status codes, and listing semantics are the S3 ones.
 """
 
 from __future__ import annotations
 
 import asyncio
+import base64
+import hashlib
+import hmac
 import json
 import logging
 from urllib.parse import parse_qs, unquote, urlsplit
@@ -40,6 +48,55 @@ _STATUS = {
 }
 
 _ERRNO_HTTP = {2: 404, 17: 409, 39: 409, 13: 403, 22: 400}
+
+# Subresources that are part of the canonical resource string in AWS sig v2
+# (the subset this gateway implements).
+_SIGNED_SUBRESOURCES = ("uploads", "uploadId", "partNumber")
+
+
+def string_to_sign(method: str, target: str, headers: dict) -> str:
+    """AWS signature-v2 StringToSign for this gateway's API subset.
+
+    method, content-md5, content-type, date (x-amz-date wins), then the
+    canonical resource: the decoded path plus any signed subresources in
+    query-string order (reference:src/rgw/rgw_auth_s3.h canonical header).
+    """
+    parts = urlsplit(target)
+    resource = unquote(parts.path) or "/"
+    sub = [
+        p for p in parts.query.split("&")
+        if p and p.split("=", 1)[0] in _SIGNED_SUBRESOURCES
+    ]
+    if sub:
+        resource += "?" + "&".join(sub)
+    # header keys are case-insensitive on the wire; the server lowercases
+    # them on receipt, so the client side must sign over the same view
+    h = {k.lower(): v for k, v in headers.items()}
+    date = h.get("x-amz-date") or h.get("date", "")
+    return "\n".join([
+        method.upper(),
+        h.get("content-md5", ""),
+        h.get("content-type", ""),
+        date,
+        resource,
+    ])
+
+
+def sign_request(secret_key: str, method: str, target: str,
+                 headers: dict) -> str:
+    """base64(HMAC-SHA1(secret, StringToSign)) — the v2 signature."""
+    mac = hmac.new(
+        secret_key.encode(),
+        string_to_sign(method, target, headers).encode(),
+        hashlib.sha1,
+    )
+    return base64.b64encode(mac.digest()).decode()
+
+
+def auth_header(access_key: str, secret_key: str, method: str,
+                target: str, headers: dict) -> str:
+    """Convenience for clients: the full Authorization header value."""
+    return f"AWS {access_key}:{sign_request(secret_key, method, target, headers)}"
 
 
 class S3Server:
@@ -112,7 +169,7 @@ class S3Server:
         self, method: str, target: str, headers: dict, body: bytes
     ) -> tuple[int, dict, bytes]:
         try:
-            user = await self._auth(headers)
+            user = await self._auth(method, target, headers)
             if user is None:
                 h, b = self._json({"error": "access denied"})
                 return 403, h, b
@@ -140,12 +197,25 @@ class S3Server:
             h, b = self._json({"error": "internal error"})
             return 400, h, b
 
-    async def _auth(self, headers: dict) -> dict | None:
+    async def _auth(
+        self, method: str, target: str, headers: dict
+    ) -> dict | None:
+        """Verify the AWS v2 signature against the stored secret_key.
+
+        The access key id only *selects* the user; access requires the
+        request HMAC to check out (ADVICE r2: key-id-only auth was a
+        bypass — ids are not secrets in the S3 model)."""
         auth = headers.get("authorization", "")
         if not auth.startswith("AWS "):
             return None
-        access_key = auth[4:].split(":", 1)[0]
-        return await self.store.user_by_access_key(access_key)
+        access_key, _, signature = auth[4:].partition(":")
+        user = await self.store.user_by_access_key(access_key)
+        if user is None:
+            return None
+        want = sign_request(user["secret_key"], method, target, headers)
+        if not hmac.compare_digest(signature.strip(), want):
+            return None
+        return user
 
     async def _svc(self, method: str, user: dict):
         if method != "GET":

@@ -175,3 +175,70 @@ class TestAuthCluster:
                 assert await fs.read_file("/top/f") == b"fs-under-auth"
 
         run(main())
+
+
+class TestReplayProtection:
+    """The handshake challenge: ticket bytes alone (observable on the
+    wire) must not authenticate a connection (CVE-2018-1128 analog)."""
+
+    def test_session_key_seal_roundtrip(self):
+        from ceph_tpu.auth import seal_skey, unseal_skey
+
+        cluster, entity = new_secret(), new_secret()
+        t = Ticket.issue(cluster, "client.a")
+        skey = Ticket.session_key(cluster, t)
+        sealed = seal_skey(entity, t, skey)
+        assert sealed != skey
+        assert unseal_skey(entity, t, sealed) == skey
+        # wrong entity secret recovers garbage, not the key
+        assert unseal_skey(new_secret(), t, sealed) != skey
+
+    def test_verify_demands_proof_when_challenged(self):
+        cs = new_secret()
+        server = AuthContext("osd.0", cluster_secret=cs, require=True)
+        client = AuthContext("client.a", cluster_secret=cs)
+        authz = client.authorizer()
+        # unchallenged path still verifies the ticket
+        assert server.verify(authz) == "client.a"
+        nonce = new_secret()
+        # ticket without proof: rejected
+        assert server.verify(authz, challenge=nonce, proof=None) is None
+        # stale proof (for another nonce): rejected
+        stale = client.prove(new_secret())
+        assert server.verify(authz, challenge=nonce, proof=stale) is None
+        # correct proof: accepted
+        assert server.verify(
+            authz, challenge=nonce, proof=client.prove(nonce)
+        ) == "client.a"
+
+    def test_require_without_secret_fails_closed(self):
+        with pytest.raises(ValueError):
+            AuthContext("osd.0", require=True)
+
+    def test_replayed_authorizer_rejected_on_live_handshake(self):
+        """A peer that holds captured ticket bytes but not the session
+        key cannot complete the OSD handshake."""
+
+        async def main():
+            async with MiniCluster(n_osds=3, auth=True) as cluster:
+                cl = await cluster.client()
+                await cl.create_pool("p", "replicated", size=3)
+                # steal the client's ticket (what a wire observer sees)
+                stolen = dict(cl.messenger.auth.ticket)
+                from ceph_tpu.msg.messenger import AsyncMessenger
+
+                class NullDispatcher:
+                    async def ms_dispatch(self, conn, msg): ...
+                    def ms_handle_reset(self, conn): ...
+
+                replayer = AsyncMessenger("client.replay", NullDispatcher())
+                ctx = AuthContext("client.replay")
+                ctx.ticket = stolen  # ticket only — no session key
+                replayer.auth = ctx
+                with pytest.raises((ConnectionError, OSError)):
+                    await replayer.connect(cluster.osds[0].addr, "osd.0")
+                await replayer.shutdown()
+                # the legitimate holder (ticket + session key) still works
+                assert cl.messenger.auth.session_key is not None
+
+        run(main())

@@ -176,3 +176,31 @@ class TestRbdCache:
                 await img2.close()
 
         run(main())
+
+
+class TestDiscardInvalidate:
+    def test_discard_drops_dirty_without_flush(self):
+        """invalidate(discard=True) — the remote-change path — must NOT
+        push stale dirty buffers over the remote client's change
+        (ADVICE r2: flush-on-invalidate resurrected pre-rollback data)."""
+
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                cl = await cluster.client()
+                await cl.create_pool("p", "replicated", size=3)
+                io = cl.io_ctx("p")
+                await io.write_full("o", b"remote-truth")
+                cache = ObjectCacher(io, write_back=True)
+                # local stale dirty buffer (never flushed)
+                await cache.write("o", b"stale-local!")
+                await cache.invalidate(discard=True)
+                # the store still holds the other client's data
+                assert await io.read("o") == b"remote-truth"
+                # and a re-read goes to the store, not dead cache state
+                assert await cache.read("o") == b"remote-truth"
+                # default mode still flushes
+                await cache.write("o", b"mine-to-keep")
+                await cache.invalidate()
+                assert await io.read("o") == b"mine-to-keep"
+
+        run(main())

@@ -236,11 +236,19 @@ class TestHTTPGateway:
         """Real HTTP against the S3Server: auth, bucket CRUD, object
         round-trip, listing, multipart."""
 
-        async def http(addr, method, path, body=b"", headers=None):
+        from ceph_tpu.rgw.http import auth_header
+
+        async def http(addr, method, path, body=b"", headers=None, creds=None):
             host, port = addr.rsplit(":", 1)
             reader, writer = await asyncio.open_connection(host, int(port))
             try:
                 h = {"content-length": str(len(body)), **(headers or {})}
+                if creds is not None:
+                    h.setdefault("date", "Thu, 01 Jan 2026 00:00:00 GMT")
+                    h["authorization"] = auth_header(
+                        creds["access_key"], creds["secret_key"],
+                        method, path, h,
+                    )
                 head = f"{method} {path} HTTP/1.1\r\n" + "".join(
                     f"{k}: {v}\r\n" for k, v in h.items()
                 ) + "\r\n"
@@ -268,7 +276,7 @@ class TestHTTPGateway:
             async with MiniCluster(n_osds=3) as cluster:
                 s = await _store(cluster)
                 user = await s.create_user("alice")
-                auth = {"authorization": f"AWS {user['access_key']}:sig"}
+                # requests are signed per-call via creds=user
                 from ceph_tpu.rgw.http import S3Server
 
                 srv = S3Server(s)
@@ -278,66 +286,75 @@ class TestHTTPGateway:
                     st, _, _ = await http(addr, "GET", "/")
                     assert st == 403
                     st, _, _ = await http(addr, "PUT", "/photos",
-                                          headers=auth)
+                                          creds=user)
                     assert st == 200
                     body = b"jpegjpegjpeg" * 500
                     st, h, _ = await http(addr, "PUT", "/photos/cat.jpg",
-                                          body=body, headers=auth)
+                                          body=body, creds=user)
                     assert st == 200
                     assert h["etag"] == hashlib.md5(body).hexdigest()
                     st, h, payload = await http(
-                        addr, "GET", "/photos/cat.jpg", headers=auth
+                        addr, "GET", "/photos/cat.jpg", creds=user
                     )
                     assert st == 200 and payload == body
                     st, h, _ = await http(addr, "HEAD", "/photos/cat.jpg",
-                                          headers=auth)
+                                          creds=user)
                     assert st == 200
                     assert int(h["content-length"]) == len(body)
                     st, _, payload = await http(
-                        addr, "GET", "/photos?prefix=cat", headers=auth
+                        addr, "GET", "/photos?prefix=cat", creds=user
                     )
                     listing = json.loads(payload)
                     assert listing["contents"][0]["key"] == "cat.jpg"
                     # multipart over REST
                     st, _, payload = await http(
-                        addr, "POST", "/photos/big?uploads", headers=auth
+                        addr, "POST", "/photos/big?uploads", creds=user
                     )
                     up = json.loads(payload)["uploadId"]
                     st, _, _ = await http(
                         addr, "PUT",
                         f"/photos/big?uploadId={up}&partNumber=1",
-                        body=b"P1" * 3000, headers=auth,
+                        body=b"P1" * 3000, creds=user,
                     )
                     assert st == 200
                     st, _, _ = await http(
                         addr, "PUT",
                         f"/photos/big?uploadId={up}&partNumber=2",
-                        body=b"P2" * 10, headers=auth,
+                        body=b"P2" * 10, creds=user,
                     )
                     st, _, payload = await http(
                         addr, "POST", f"/photos/big?uploadId={up}",
-                        headers=auth,
+                        creds=user,
                     )
                     assert st == 200
                     assert json.loads(payload)["size"] == 6020
                     st, _, payload = await http(
-                        addr, "GET", "/photos/big", headers=auth
+                        addr, "GET", "/photos/big", creds=user
                     )
                     assert payload == b"P1" * 3000 + b"P2" * 10
                     # 404 + delete
                     st, _, _ = await http(addr, "GET", "/photos/ghost",
-                                          headers=auth)
+                                          creds=user)
                     assert st == 404
                     st, _, _ = await http(addr, "DELETE", "/photos/cat.jpg",
-                                          headers=auth)
+                                          creds=user)
                     assert st == 204
                     # another user cannot touch alice's bucket
                     other = await s.create_user("eve")
-                    eauth = {
-                        "authorization": f"AWS {other['access_key']}:s"
-                    }
                     st, _, _ = await http(addr, "GET", "/photos",
-                                          headers=eauth)
+                                          creds=other)
+                    assert st == 403
+                    # key-id alone (no valid signature) is NOT enough:
+                    # access key ids are public in the S3 model
+                    bad = {"authorization": f"AWS {user['access_key']}:bogus",
+                           "date": "Thu, 01 Jan 2026 00:00:00 GMT"}
+                    st, _, _ = await http(addr, "GET", "/photos",
+                                          headers=bad)
+                    assert st == 403
+                    # signature from the wrong secret -> 403
+                    stolen = dict(user, secret_key=other["secret_key"])
+                    st, _, _ = await http(addr, "GET", "/photos",
+                                          creds=stolen)
                     assert st == 403
                 finally:
                     await srv.stop()

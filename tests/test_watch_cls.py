@@ -303,3 +303,41 @@ class TestWatchNotify:
                 assert got == [b"ec-notify"]
 
         run(main())
+
+
+class TestNotifyDedupe:
+    def test_retried_notify_fires_callbacks_once(self):
+        """operate()-level resends of one logical notify must not double
+        -fire watch callbacks: the OSD dedupes on the client notify id
+        (ADVICE r2)."""
+
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                cl = await cluster.client()
+                await cl.create_pool("p", "replicated", size=3)
+                io = cl.io_ctx("p")
+                await io.write_full("o", b"x")
+                fired = []
+
+                async def cb(notifier, payload):
+                    fired.append(bytes(payload))
+                    return b"ack"
+
+                await io.watch("o", cb)
+                out = await io.notify("o", b"hello")
+                assert len(out["acks"]) == 1 and not out["missed"]
+                # simulate the retry: resend the SAME op (same nid) the
+                # way operate() would on -EAGAIN / map change
+                nid = f"{cl.name}.dup"
+                op = [{"op": "notify", "data": 0, "timeout": 5.0,
+                       "nid": nid}]
+                r1 = await cl.operate("p", "o", op, [b"retry-me"])
+                r2 = await cl.operate("p", "o", op, [b"retry-me"])
+                assert r1.result == 0 and r2.result == 0
+                # both replies carry the one fan-out's acks
+                assert len(r1.out[0]["acks"]) == 1
+                assert len(r2.out[0]["acks"]) == 1
+                await asyncio.sleep(0.1)
+                assert fired == [b"hello", b"retry-me"]  # not 3 firings
+
+        run(main())
