@@ -122,11 +122,21 @@ class Monitor(Dispatcher):
         self.leader_rank: int | None = 0 if rank == 0 else None
         self.election_epoch = 0
         self.store_path = store_path
-        self._pending_commit: dict[int, dict] = {}  # version -> map value
+        # version -> (election epoch of the proposal, map value): the
+        # ACCEPTED register of Paxos — survives into elections so an
+        # acked-but-uncommitted value can be adopted (see _handle_election)
+        self._pending_commit: dict[int, tuple[int, dict]] = {}
+        # election epoch the current committed map was chosen in; orders
+        # committed vs accepted state during recovery as (epoch, version)
+        self.map_committed_epoch = 0
         self._lease_task: asyncio.Task | None = None
         self._watch_task: asyncio.Task | None = None
         self._last_lease = time.monotonic()
         self._election_acks: dict[int, messages.MMonElection] = {}
+        # epoch of the election I last WON (vs election_epoch, which can
+        # be absorbed from overheard proposals without winning): the
+        # deposition rule in _handle_lease compares against this
+        self._victory_epoch = 0
         self._paxos_acks: dict[int, set[int]] = {}  # version -> ranks
         self._paxos_events: dict[int, asyncio.Event] = {}
         self._electing = False
@@ -235,7 +245,10 @@ class Monitor(Dispatcher):
     def _save_store(self) -> None:
         if self._db_store is None:
             return
-        self._db_store.save(self.osdmap.to_dict(), self.election_epoch)
+        self._db_store.save(
+            self.osdmap.to_dict(), self.election_epoch,
+            self.map_committed_epoch,
+        )
 
     def _load_store(self) -> None:
         if self._db_store is None:
@@ -245,6 +258,12 @@ class Monitor(Dispatcher):
             return
         self.osdmap = OSDMap.from_dict(data)
         self.election_epoch = self._db_store.election_epoch()
+        self.map_committed_epoch = self._db_store.committed_epoch()
+        acc = self._db_store.accepted()
+        if acc is not None and acc["version"] > self.osdmap.epoch:
+            # an accepted-but-uncommitted proposal survived our restart;
+            # re-arm the register so election recovery can surface it
+            self._pending_commit[acc["version"]] = (acc["epoch"], acc["value"])
         logger.info(
             "%s: restored map epoch %d from %s",
             self.name, self.osdmap.epoch, self.store_path,
@@ -273,9 +292,15 @@ class Monitor(Dispatcher):
             if msg.have is None or msg.have < self.osdmap.epoch:
                 self._send_map(conn)
         elif isinstance(msg, messages.MOSDMapMsg):
-            # a newer committed map from the leader (peon catch-up)
+            # a newer committed map from the leader (peon catch-up).
+            # Stamp the SENDER's commit epoch — stamping our own
+            # election_epoch (which election loops can ratchet far past
+            # the quorum's) would let this map out-rank genuinely newer
+            # commits in a later recovery (review r3 finding)
             if msg.epoch > self.osdmap.epoch:
                 self.osdmap = OSDMap.from_dict(msg.osdmap)
+                if msg.committed_epoch is not None:
+                    self.map_committed_epoch = msg.committed_epoch
                 self._save_store()
                 self._publish_subs()
         elif isinstance(msg, messages.MMonCommand):
@@ -326,6 +351,15 @@ class Monitor(Dispatcher):
             return
         self._electing = True
         try:
+            if self.rank:
+                # stagger by rank: give lower ranks' proposals time to
+                # arrive so we defer instead of racing to a dual victory
+                # at the same epoch (the defer path cancels this task
+                # mid-sleep).  The reference Elector gets the same effect
+                # from its propose/defer timing.
+                await asyncio.sleep(
+                    min(0.05 * self.rank, self.config.mon_election_timeout / 4)
+                )
             while True:
                 self.election_epoch += 1
                 self.leader_rank = None
@@ -335,9 +369,16 @@ class Monitor(Dispatcher):
                     "%s: starting election epoch %d", self.name, epoch
                 )
                 for r in self._peer_ranks():
+                    # proposals carry our state summary so an incumbent
+                    # leader can tell a routine timeout election (we hold
+                    # nothing newer -> it safely reasserts) from a
+                    # post-partition one (we hold newer committed or
+                    # accepted state -> it must run recovery)
                     await self._send_peer(r, messages.MMonElection(
                         op="propose", epoch=epoch, rank=self.rank,
                         map_epoch=self.osdmap.epoch, osdmap=None,
+                        committed_epoch=self.map_committed_epoch,
+                        accepted=self._accepted_register(),
                     ))
                 await asyncio.sleep(self.config.mon_election_timeout / 2)
                 if self.leader_rank is not None:
@@ -359,11 +400,63 @@ class Monitor(Dispatcher):
         finally:
             self._electing = False
 
+    def _sync_accepted(self) -> None:
+        """Mirror the in-memory accepted register to the durable store
+        (reference Paxos persists the uncommitted value)."""
+        if self._db_store is not None:
+            self._db_store.set_accepted(self._accepted_register())
+
+    def _accepted_register(self) -> dict | None:
+        """This mon's highest accepted-but-uncommitted proposal, for the
+        election ack (Paxos 'last' message uncommitted-value carry)."""
+        if not self._pending_commit:
+            return None
+        version = max(self._pending_commit)
+        pepoch, value = self._pending_commit[version]
+        return {"epoch": pepoch, "version": version, "value": value}
+
     async def _declare_victory(self, epoch: int, acks) -> None:
-        # adopt the newest committed map in the quorum (Paxos recovery)
+        # Paxos recovery over full-map snapshots: adopt the newest
+        # COMMITTED map in the quorum, then — the collect/last phase —
+        # the highest ACCEPTED proposal (ordered by (election epoch,
+        # version)) if it is newer than every committed map.  This closes
+        # the lost-acked-write window: a leader that got majority acks,
+        # applied, replied to the client, and died before broadcasting
+        # the commit leaves the value in its peons' accepted registers,
+        # and the new leader must surface it
+        # (reference:src/mon/Paxos.cc handle_last uncommitted handling).
+        committed = (self.map_committed_epoch, self.osdmap.epoch)
         for ack in acks.values():
-            if ack.map_epoch > self.osdmap.epoch and ack.osdmap:
+            ce = ack.committed_epoch or 0
+            if ack.osdmap and (ce, ack.map_epoch) > committed:
                 self.osdmap = OSDMap.from_dict(ack.osdmap)
+                self.map_committed_epoch = ce
+                committed = (ce, ack.map_epoch)
+        best = self._accepted_register()
+        for ack in acks.values():
+            acc = ack.accepted
+            if acc and (
+                best is None
+                or (acc["epoch"], acc["version"])
+                > (best["epoch"], best["version"])
+            ):
+                best = acc
+        if best is not None and (
+            (best["epoch"], best["version"]) > committed
+            and best["version"] > self.osdmap.epoch
+        ):
+            logger.info(
+                "%s: adopting accepted-but-uncommitted map v%d from "
+                "election epoch %d (dead leader's in-flight commit)",
+                self.name, best["version"], best["epoch"],
+            )
+            self.osdmap = OSDMap.from_dict(best["value"])
+        self._pending_commit.clear()
+        self._sync_accepted()
+        # whatever we now hold is chosen at THIS election's epoch: the
+        # victory broadcast below is its commit
+        self.map_committed_epoch = epoch
+        self._victory_epoch = epoch
         self.leader_rank = self.rank
         self._save_store()
         logger.info(
@@ -389,25 +482,69 @@ class Monitor(Dispatcher):
                 self.leader_rank = None
                 self._stop_leading()
                 self._last_lease = time.monotonic()  # give it time to win
+                if self._electing and self._election_task is not None:
+                    # stand down our own in-flight election: acking the
+                    # lower rank while still collecting our own acks
+                    # produces dual victories at the same epoch (the
+                    # lease watchdog re-elects if the winner dies)
+                    self._election_task.cancel()
+                    self._election_task = None
+                    self._electing = False
                 await self._send_peer(msg.rank, messages.MMonElection(
                     op="ack", epoch=self.election_epoch, rank=self.rank,
                     map_epoch=self.osdmap.epoch,
                     osdmap=self.osdmap.to_dict(),
+                    committed_epoch=self.map_committed_epoch,
+                    accepted=self._accepted_register(),
                 ))
             else:
                 # a higher rank proposing: we should lead instead
                 if self.is_leader:
-                    # remind the prospective usurper who leads — at ITS
-                    # epoch (or ours if higher), else it ignores the
-                    # victory as stale and loops forever
-                    self.election_epoch = max(
-                        self.election_epoch, msg.epoch
+                    mine = (self.map_committed_epoch, self.osdmap.epoch)
+                    theirs = (msg.committed_epoch or 0, msg.map_epoch or 0)
+                    acc = msg.accepted
+                    theirs_acc = (
+                        (acc["epoch"], acc["version"]) if acc else (0, 0)
                     )
-                    await self._send_peer(msg.rank, messages.MMonElection(
-                        op="victory", epoch=self.election_epoch,
-                        rank=self.rank, map_epoch=self.osdmap.epoch,
-                        osdmap=self.osdmap.to_dict(),
-                    ))
+                    if theirs <= mine and theirs_acc <= mine:
+                        # routine timeout election: the proposer holds
+                        # nothing newer than us (committed OR accepted),
+                        # so reasserting our leadership at its epoch is
+                        # safe — remind it who leads (else it ignores the
+                        # victory as stale and loops forever).  Any state
+                        # committed since our victory lives on a majority
+                        # (that's what commit means), so a proposer with
+                        # nothing newer cannot be fronting for a newer
+                        # quorum we missed.
+                        self.election_epoch = max(
+                            self.election_epoch, msg.epoch
+                        )
+                        self._victory_epoch = self.election_epoch
+                        await self._send_peer(msg.rank, messages.MMonElection(
+                            op="victory", epoch=self.election_epoch,
+                            rank=self.rank, map_epoch=self.osdmap.epoch,
+                            osdmap=self.osdmap.to_dict(),
+                        ))
+                    else:
+                        # the proposer holds NEWER committed/accepted
+                        # state: another quorum ran while we were
+                        # partitioned (and its leader may be dead — no
+                        # lease will depose us).  Reasserting would
+                        # reimpose a stale map; step down and run a real
+                        # election whose recovery phase adopts the newer
+                        # state before we lead again (review r3 finding).
+                        logger.warning(
+                            "%s: proposer mon.%d holds newer state "
+                            "(%s/%s > %s) — stepping down for recovery",
+                            self.name, msg.rank, theirs, theirs_acc, mine,
+                        )
+                        self.leader_rank = None
+                        self._stop_leading()
+                        self.election_epoch = max(
+                            self.election_epoch, msg.epoch
+                        )
+                        if not self._electing:
+                            self._election_task = _bg(self._start_election())
                 elif not self._electing:
                     self._election_task = _bg(self._start_election())
         elif msg.op == "ack":
@@ -419,10 +556,23 @@ class Monitor(Dispatcher):
                 self.leader_rank = msg.rank
                 self._stop_leading()
                 self._last_lease = time.monotonic()
+                # our accepted register is resolved: the new leader either
+                # adopted its value (it arrives in this victory / a later
+                # commit) or superseded it
+                self._pending_commit.clear()
+                self._sync_accepted()
                 if msg.map_epoch > self.osdmap.epoch and msg.osdmap:
                     self.osdmap = OSDMap.from_dict(msg.osdmap)
+                    self.map_committed_epoch = msg.epoch
                     self._save_store()
                     self._publish_subs()
+                elif msg.map_epoch == self.osdmap.epoch:
+                    # we already hold the chosen map: re-stamp it at the
+                    # winning election's epoch, or a deposed leader's
+                    # locally-applied (-EAGAIN'd) mutation could out-rank
+                    # it in a later recovery (review r3 finding)
+                    self.map_committed_epoch = msg.epoch
+                    self._save_store()
                 logger.info(
                     "%s: mon.%d leads (election epoch %d)",
                     self.name, msg.rank, msg.epoch,
@@ -448,6 +598,35 @@ class Monitor(Dispatcher):
             pass
 
     def _handle_lease(self, msg: messages.MMonLease) -> None:
+        if (
+            self.is_leader and msg.rank != self.rank
+            and (
+                msg.epoch > self._victory_epoch
+                or (msg.epoch == self._victory_epoch
+                    and msg.rank < self.rank)
+            )
+        ):
+            # another mon is leading at an epoch we never WON (the quorum
+            # elected it while we were partitioned — we may have absorbed
+            # its epoch from an overheard propose without winning it), or
+            # a lower rank won the same epoch in a startup race: our
+            # leadership is stale.  Step down and call a new election —
+            # as the lowest reachable rank we may well win it, but the
+            # recovery phase makes us adopt the newer quorum's state
+            # first (the reference Elector bootstraps on any message
+            # from a higher election epoch).
+            logger.warning(
+                "%s: mon.%d is leading at election epoch %d (mine %d) — "
+                "deposed, re-electing", self.name, msg.rank, msg.epoch,
+                self.election_epoch,
+            )
+            self.leader_rank = None
+            self._stop_leading()
+            self.election_epoch = msg.epoch
+            self._last_lease = time.monotonic()
+            if not self._electing:
+                self._election_task = _bg(self._start_election())
+            return
         if msg.rank == self.leader_rank or (
             self.leader_rank is None
             and msg.epoch >= self.election_epoch
@@ -487,13 +666,22 @@ class Monitor(Dispatcher):
 
     async def _handle_paxos(self, msg: messages.MMonPaxos) -> None:
         if msg.op == "propose":
-            if msg.rank != self.leader_rank:
-                return  # stale leader: ignore (it will lose its lease)
+            if msg.rank != self.leader_rank or msg.epoch < self.election_epoch:
+                # stale leader (by identity or by election epoch): a
+                # deposed leader racing across a partition heal must not
+                # get its proposal accepted (reference Paxos rejects
+                # lower proposal numbers in the accept phase)
+                return
             # keep only the newest pending value: uncommitted older
             # snapshots are superseded and would otherwise accumulate
             for v in [v for v in self._pending_commit if v < msg.version]:
                 del self._pending_commit[v]
-            self._pending_commit[msg.version] = msg.value
+            self._pending_commit[msg.version] = (msg.epoch, msg.value)
+            # persist the accepted register BEFORE acking: the ack is a
+            # durable promise — if we crash and restart, the election
+            # recovery must still be able to surface this value
+            # (reference Paxos stores the uncommitted value)
+            self._sync_accepted()
             await self._send_peer(msg.rank, messages.MMonPaxos(
                 op="ack", epoch=msg.epoch, rank=self.rank,
                 version=msg.version, value=None,
@@ -507,9 +695,14 @@ class Monitor(Dispatcher):
                     if ev is not None:
                         ev.set()
         elif msg.op == "commit":
-            value = self._pending_commit.pop(msg.version, None)
-            if value is not None and msg.version > self.osdmap.epoch:
+            if msg.rank != self.leader_rank or msg.epoch < self.election_epoch:
+                return  # a deposed leader's commit: superseded
+            entry = self._pending_commit.pop(msg.version, None)
+            self._sync_accepted()
+            if entry is not None and msg.version > self.osdmap.epoch:
+                _epoch, value = entry
                 self.osdmap = OSDMap.from_dict(value)
+                self.map_committed_epoch = msg.epoch
                 self._save_store()
                 self._publish_subs()
 
@@ -584,23 +777,37 @@ class Monitor(Dispatcher):
             self._paxos_acks[version] = set()
             ev = self._paxos_events[version] = asyncio.Event()
             try:
-                for r in self._peer_ranks():
-                    await self._send_peer(r, messages.MMonPaxos(
-                        op="propose", epoch=self.election_epoch,
-                        rank=self.rank, version=version, value=value,
-                    ))
-                if self._majority() > 1:
+                # up to 3 propose rounds: a transient re-election makes
+                # peons reject the first round's (now stale) epoch; once
+                # it settles — with us still leading — re-propose at the
+                # new epoch instead of failing the client op (the
+                # reference's Paxos waits for a writeable quorum)
+                for round_ in range(3):
+                    if round_ and not self.is_leader:
+                        ok = False
+                        break
+                    for r in self._peer_ranks():
+                        await self._send_peer(r, messages.MMonPaxos(
+                            op="propose", epoch=self.election_epoch,
+                            rank=self.rank, version=version, value=value,
+                        ))
+                    if self._majority() <= 1:
+                        break
                     try:
                         async with asyncio.timeout(
                             self.config.mon_election_timeout
                         ):
                             await ev.wait()
+                        ok = True
+                        break
                     except TimeoutError:
                         logger.warning(
-                            "%s: commit %d: no quorum", self.name, version
+                            "%s: commit %d: no quorum (round %d)",
+                            self.name, version, round_ + 1,
                         )
                         ok = False
                 if ok:
+                    self.map_committed_epoch = self.election_epoch
                     for r in self._peer_ranks():
                         await self._send_peer(r, messages.MMonPaxos(
                             op="commit", epoch=self.election_epoch,
@@ -609,6 +816,8 @@ class Monitor(Dispatcher):
             finally:
                 self._paxos_acks.pop(version, None)
                 self._paxos_events.pop(version, None)
+        elif self.solo:
+            self.map_committed_epoch = self.election_epoch
         self._save_store()
         self._publish_subs()
         return ok
@@ -619,7 +828,10 @@ class Monitor(Dispatcher):
 
     def _send_map(self, conn: Connection) -> None:
         conn.send(
-            messages.MOSDMapMsg(epoch=self.osdmap.epoch, osdmap=self.osdmap.to_dict())
+            messages.MOSDMapMsg(
+                epoch=self.osdmap.epoch, osdmap=self.osdmap.to_dict(),
+                committed_epoch=self.map_committed_epoch,
+            )
         )
 
     async def _command_and_reply(

@@ -37,12 +37,14 @@ class MonitorDBStore:
         self.db.close()
 
     # -- write
-    def save(self, osdmap_dict: dict, election_epoch: int) -> None:
+    def save(self, osdmap_dict: dict, election_epoch: int,
+             committed_epoch: int = 0) -> None:
         epoch = int(osdmap_dict["epoch"])
         txn = self.db.transaction()
         txn.set("osdmap", f"{epoch:010d}", json.dumps(osdmap_dict).encode())
         txn.set("meta", "last_committed", str(epoch).encode())
         txn.set("meta", "election_epoch", str(election_epoch).encode())
+        txn.set("meta", "committed_epoch", str(committed_epoch).encode())
         for k in self.db.keys("osdmap"):
             if int(k) <= epoch - KEEP_EPOCHS:
                 txn.rmkey("osdmap", k)
@@ -56,6 +58,27 @@ class MonitorDBStore:
     def election_epoch(self) -> int:
         raw = self.db.get("meta", "election_epoch")
         return int(raw) if raw else 0
+
+    def committed_epoch(self) -> int:
+        """Election epoch the stored map was committed in (orders
+        recovery candidates as (epoch, version))."""
+        raw = self.db.get("meta", "committed_epoch")
+        return int(raw) if raw else 0
+
+    # -- accepted register (Paxos uncommitted value; the reference
+    # persists it so an acked-but-uncommitted proposal survives the
+    # acceptor's restart — reference:src/mon/Paxos.cc store_state)
+    def set_accepted(self, accepted: dict | None) -> None:
+        txn = self.db.transaction()
+        if accepted is None:
+            txn.rmkey("meta", "accepted")
+        else:
+            txn.set("meta", "accepted", json.dumps(accepted).encode())
+        self.db.submit(txn)
+
+    def accepted(self) -> dict | None:
+        raw = self.db.get("meta", "accepted")
+        return json.loads(raw) if raw else None
 
     def get_map(self, epoch: int | None = None) -> dict | None:
         if epoch is None:

@@ -151,7 +151,9 @@ class MOSDMapMsg(Message):
     dict in ``osdmap``."""
 
     TYPE = "osd_map"
-    FIELDS = ("epoch", "osdmap")
+    # committed_epoch: election epoch the map was committed in (set on
+    # mon->mon catch-up pushes; recovery orders maps by (epoch, version))
+    FIELDS = ("epoch", "osdmap", "committed_epoch")
 
 
 @register
@@ -182,7 +184,13 @@ class MMonElection(Message):
     adopted map."""
 
     TYPE = "mon_election"
-    FIELDS = ("op", "epoch", "rank", "map_epoch", "osdmap")
+    # accepted: the responder's highest ACCEPTED-but-uncommitted proposal
+    # {"epoch", "version", "value"} (the Paxos collect/last phase's
+    # uncommitted-value carry — reference:src/mon/Paxos.cc handle_last);
+    # committed_epoch: the election epoch the committed map was chosen in,
+    # so recovery can order committed vs accepted by (epoch, version).
+    FIELDS = ("op", "epoch", "rank", "map_epoch", "osdmap",
+              "accepted", "committed_epoch")
 
 
 @register
