@@ -16,9 +16,11 @@ Differences from the reference, by design:
 
 - The reference pipelines plans through three wait-lists with an extent
   cache for in-flight overlap (reference:src/osd/ECBackend.h:549-551,
-  reference:src/osd/ExtentCache.h:1); here the per-PG asyncio lock
-  serializes mutations, so the plan executes synchronously under the
-  lock and the cache collapses away.
+  reference:src/osd/ExtentCache.h:1); here a per-OBJECT asyncio lock
+  (OSD.obj_lock — any same-object extents conflict in the collapsed
+  model) serializes same-object mutations while different objects in
+  one PG pipeline freely, so the plan executes synchronously under the
+  object's lock and the cache collapses away.
 - Zero-extension (append/truncate-up across never-written stripes) needs
   no device work at all: linear codes encode zero data to zero parity,
   so shard-side zero-fill of the hole *is* the correct encoding.

@@ -282,8 +282,12 @@ class RecoveryManager:
     ) -> tuple[dict[int, tuple], dict[int, int]]:
         """Revalidation read (attrs only) of every member's copy of ``oid``.
 
-        Returns ({key: version currently stored}, {key: errno}); call under
-        the pg lock so the answer can't be invalidated by a client op.
+        Returns ({key: version currently stored}, {key: errno}); call
+        under the lock that excludes client mutations of ``oid`` — the
+        per-object family lock (osd.obj_lock) for erasure pools, the pg
+        lock for replicated ones — so the answer can't be invalidated by
+        a client op on this object.  It says nothing about OTHER objects
+        in the PG: EC client ops elsewhere proceed concurrently.
         """
         osd = self.osd
         _d, attrs, errs = await osd._read_shards(
@@ -304,7 +308,11 @@ class RecoveryManager:
         oid: str, state: dict,
     ) -> None:
         osd = self.osd
-        async with osd.pg_lock(pg):
+        # EC client ops serialize per object (osd.obj_lock); replicated
+        # ones per PG — take the matching lock so repair still excludes
+        # the client path it can race with
+        lock = osd.obj_lock(pg, oid) if erasure else osd.pg_lock(pg)
+        async with lock:
             vers, errs = await self._fresh_versions(pg, erasure, shards, oid)
             if vers and max(vers.values()) > tuple(state["version"]):
                 return  # re-created after the scan: nothing to delete
@@ -340,7 +348,8 @@ class RecoveryManager:
         if not scan_stale:
             return
         osd = self.osd
-        async with osd.pg_lock(pg):
+        lock = osd.obj_lock(pg, oid) if erasure else osd.pg_lock(pg)
+        async with lock:
             # up to a few rounds: an undecodable newest version is first
             # rolled back via the shards' stashes, then the survivors are
             # repaired to the (decodable) version that remains

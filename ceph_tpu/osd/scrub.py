@@ -160,7 +160,9 @@ class ScrubManager:
             return report
 
         for oid in self._scrub_targets(scans):
-            async with osd.pg_lock(pg):  # per-object: bounded write stall
+            # object-family lock: excludes the EC client pipeline for
+            # exactly this object, bounded write stall for the rest
+            async with osd.obj_lock(pg, oid):
                 await self._scrub_ec_object(
                     pg, codec, sinfo, k, shards, oid, repair, report
                 )

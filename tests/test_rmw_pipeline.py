@@ -1,0 +1,208 @@
+"""EC RMW pipelining (the collapsed ExtentCache, VERDICT r2 Next #5).
+
+Round 2 serialized every EC mutation in a PG behind one asyncio lock —
+correct, but a PG-wide throughput ceiling the reference does not have
+(reference:src/osd/ExtentCache.h:1 + the three wait-lists
+reference:src/osd/ECBackend.h:549-551 let overlapping writes to one PG
+proceed concurrently).  Round 3 moved to per-object-family locks: these
+tests prove two RMWs to DIFFERENT objects in one PG interleave their
+read and commit phases, while same-object RMWs still serialize and the
+family (head + clones + snapdir) stays exclusive.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.rados import MiniCluster
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+async def _single_pg_ec_cluster(cluster):
+    cl = await cluster.client()
+    # pg_num=1: every object lands in the same PG
+    await cl.create_pool("ec1", "erasure", pg_num="1")
+    return cl
+
+
+class TestPipelinedRmw:
+    def test_different_objects_interleave_read_and_commit(self):
+        """Object A's RMW stalls in its read phase; object B's RMW —
+        same PG — must start AND commit while A is stalled.  Under the
+        old per-PG lock, B could not even begin until A finished."""
+
+        async def main():
+            async with MiniCluster(n_osds=4) as cluster:
+                cl = await _single_pg_ec_cluster(cluster)
+                io = cl.io_ctx("ec1")
+                # both objects need existing data so a partial overwrite
+                # takes the read(RMW) path
+                await io.write_full("A", b"a" * 10000)
+                await io.write_full("B", b"b" * 10000)
+
+                pool = cl.osdmap.lookup_pool("ec1")
+                _pg, _acting, prim = cl.osdmap.object_to_acting("A", pool.id)
+                primary = cluster.osds[prim]
+
+                events: list[str] = []
+                a_read_started = asyncio.Event()
+                release_a = asyncio.Event()
+                real_read = primary._ec_read
+
+                async def traced_read(pg, pool, acting, oid, *a, **kw):
+                    if oid == "A":
+                        events.append("A:read-start")
+                        a_read_started.set()
+                        await release_a.wait()  # stall A's read phase
+                    return await real_read(pg, pool, acting, oid, *a, **kw)
+
+                real_fan = primary._ec_fan_out
+
+                async def traced_fan(pg, present, build_txn, entries, version):
+                    oid = entries[-1].oid if entries else "?"
+                    r = await real_fan(pg, present, build_txn, entries, version)
+                    events.append(f"{oid}:committed")
+                    return r
+
+                primary._ec_read = traced_read
+                primary._ec_fan_out = traced_fan
+                try:
+                    # partial mid-stripe overwrites -> read-modify-write
+                    ta = asyncio.ensure_future(io.write("A", b"XX", offset=100))
+                    await a_read_started.wait()
+                    # B runs to COMPLETION while A is stalled reading
+                    async with asyncio.timeout(10):
+                        await io.write("B", b"YY", offset=100)
+                    assert "B:committed" in events
+                    assert "A:committed" not in events
+                    release_a.set()
+                    async with asyncio.timeout(10):
+                        await ta
+                    assert events.index("B:committed") < events.index(
+                        "A:committed"
+                    )
+                finally:
+                    release_a.set()
+                    primary._ec_read = real_read
+                    primary._ec_fan_out = real_fan
+                # both writes landed correctly
+                a = await io.read("A")
+                b = await io.read("B")
+                assert a[100:102] == b"XX" and a[:100] == b"a" * 100
+                assert b[100:102] == b"YY" and b[102:200] == b"b" * 98
+
+        run(main())
+
+    def test_same_object_rmws_serialize(self):
+        """Two RMWs to ONE object must not interleave (any same-object
+        extents conflict in the collapsed ExtentCache model)."""
+
+        async def main():
+            async with MiniCluster(n_osds=4) as cluster:
+                cl = await _single_pg_ec_cluster(cluster)
+                io = cl.io_ctx("ec1")
+                await io.write_full("O", b"o" * 8192)
+                # 16 concurrent partial writes to distinct extents of one
+                # object: serialized execution must apply all of them
+                async with asyncio.timeout(30):
+                    await asyncio.gather(*(
+                        io.write("O", bytes([65 + i]) * 16, offset=i * 512)
+                        for i in range(16)
+                    ))
+                data = await io.read("O")
+                for i in range(16):
+                    assert data[i * 512 : i * 512 + 16] == bytes([65 + i]) * 16
+
+        run(main())
+
+    def test_concurrent_distinct_objects_all_land(self):
+        """Throughput-shaped smoke: 24 objects written concurrently into
+        one PG, all readable and correct afterwards."""
+
+        async def main():
+            async with MiniCluster(n_osds=4) as cluster:
+                cl = await _single_pg_ec_cluster(cluster)
+                io = cl.io_ctx("ec1")
+                payloads = {
+                    f"o{i}": bytes([i]) * (1000 + 37 * i) for i in range(24)
+                }
+                async with asyncio.timeout(60):
+                    await asyncio.gather(*(
+                        io.write_full(k, v) for k, v in payloads.items()
+                    ))
+                    # concurrent partial overwrites on all of them
+                    await asyncio.gather(*(
+                        io.write(k, b"mid", offset=500)
+                        for k in payloads
+                    ))
+                for k, v in payloads.items():
+                    got = await io.read(k)
+                    want = bytearray(v)
+                    want[500:503] = b"mid"
+                    assert got == bytes(want), k
+
+        run(main())
+
+
+class TestWatermarkSafety:
+    def test_watermark_never_passes_inflight_version(self):
+        """Pipelined commits: op B (newer version) completing while op A
+        is still fanning out must NOT advance the roll-forward watermark
+        past A — that would trim A's rollback stashes while A can still
+        fail and need them (review r3 finding)."""
+
+        async def main():
+            async with MiniCluster(n_osds=4) as cluster:
+                cl = await _single_pg_ec_cluster(cluster)
+                io = cl.io_ctx("ec1")
+                await io.write_full("A", b"a" * 4096)
+                await io.write_full("B", b"b" * 4096)
+                pool = cl.osdmap.lookup_pool("ec1")
+                pgid, _acting, prim = cl.osdmap.object_to_acting("A", pool.id)
+                primary = cluster.osds[prim]
+                key = str(pgid)
+
+                a_version = None
+                a_started = asyncio.Event()
+                release_a = asyncio.Event()
+                real_send = primary._send_sub_write
+
+                async def stalling_send(tid, pg, shard, osd, txn, entries):
+                    nonlocal a_version
+                    if entries and entries[-1].oid == "A":
+                        if a_version is None:
+                            a_version = entries[-1].version
+                            a_started.set()
+                        await release_a.wait()  # A's fan-out stalls
+                    return await real_send(tid, pg, shard, osd, txn, entries)
+
+                primary._send_sub_write = stalling_send
+                try:
+                    ta = asyncio.ensure_future(
+                        io.write("A", b"XX", offset=10)
+                    )
+                    await a_started.wait()
+                    # B commits fully while A is mid-fan-out
+                    async with asyncio.timeout(10):
+                        await io.write("B", b"YY", offset=10)
+                    wm = primary._pg_committed.get(key)
+                    assert wm is not None
+                    # watermark must sit strictly below A's version
+                    assert wm < a_version, (wm, a_version)
+                    release_a.set()
+                    async with asyncio.timeout(10):
+                        await ta
+                    # once nothing is in flight, the next commit advances
+                    # the watermark past both
+                    async with asyncio.timeout(10):
+                        await io.write("B", b"ZZ", offset=20)
+                    assert primary._pg_committed[key] >= a_version
+                finally:
+                    release_a.set()
+                    primary._send_sub_write = real_send
+                assert (await io.read("A"))[10:12] == b"XX"
+
+        run(main())
