@@ -28,6 +28,7 @@ class MiniCluster:
         heartbeat_interval: float = 0.0,
         failure_min_reporters: int = 1,
         store_dir: str | None = None,
+        store_kind: str = "wal",
         n_mons: int = 1,
         mon_config=None,
         crush_hosts: "list[list[int]] | None" = None,
@@ -65,6 +66,7 @@ class MiniCluster:
             self._mon_args["config"] = self._daemon_config()
         self.n_mons = n_mons
         self.store_dir = store_dir
+        self.store_kind = store_kind
         for rank in range(n_mons):
             self.mons[rank] = self._make_mon(rank)
         self.monmap: list[str] = []
@@ -76,7 +78,7 @@ class MiniCluster:
                 # format only never-formatted stores: reconstructing a
                 # MiniCluster over an existing store_dir must RECOVER the
                 # data (the durability contract), not wipe it
-                if not os.path.exists(s._journal_path):
+                if not s.formatted():
                     s.mkfs()
         self.osds: dict[int, OSD] = {}
         self.mgrs: dict[str, "object"] = {}  # name -> MgrDaemon
@@ -101,9 +103,12 @@ class MiniCluster:
             return MemStore()
         # "flush" = survives process death (the failure mode the harness
         # injects); per-write fsync would only add host-power-loss coverage
-        return WalStore(
-            os.path.join(self.store_dir, f"osd.{osd_id}"), sync="flush"
-        )
+        path = os.path.join(self.store_dir, f"osd.{osd_id}")
+        if self.store_kind == "blue":
+            from ..store.blue import BlueStore
+
+            return BlueStore(path, sync="flush")
+        return WalStore(path, sync="flush")
 
     def _make_mon(self, rank: int) -> Monitor:
         store_path = (
@@ -196,16 +201,15 @@ class MiniCluster:
     async def remount_osd(self, osd_id: int) -> OSD:
         """Simulate full process death: crash-kill the daemon (no store
         umount, so no checkpoint), abandon the live store object, and
-        re-open a fresh WalStore from its on-disk journal alone.
-        Requires ``store_dir`` (durable stores)."""
+        re-open a fresh durable store (WalStore journal replay /
+        BlueStore KV + block) from disk alone.  Requires ``store_dir``."""
         if self.store_dir is None:
-            raise RuntimeError("remount_osd requires store_dir (WalStore)")
+            raise RuntimeError("remount_osd requires store_dir (durable)")
         if osd_id in self.osds:
             await self.kill_osd(osd_id, crash=True)
-        old = self.stores[osd_id]
-        j = getattr(old, "_journal", None)
-        if j is not None:
-            j.close()  # free the fd; the bytes are already flushed
+        # free the old instance's fds without a checkpoint; the store
+        # owns the knowledge of which fds exist
+        self.stores[osd_id].crash_close()
         self.stores[osd_id] = self._make_store(osd_id)
         return await self.start_osd(osd_id)
 

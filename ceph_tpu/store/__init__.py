@@ -14,6 +14,7 @@ from .objectstore import (
 )
 from .memstore import MemStore
 from .wal import CrashPoint, WalStore
+from .blue import BitrotError, BlueStore
 
 __all__ = [
     "ObjectId",
@@ -22,6 +23,8 @@ __all__ = [
     "Transaction",
     "MemStore",
     "WalStore",
+    "BlueStore",
+    "BitrotError",
     "CrashPoint",
     "NeedsMkfs",
 ]

@@ -214,6 +214,19 @@ class WalStore(MemStore):
     def _checkpoint_path(self) -> str:
         return os.path.join(self.path, "checkpoint")
 
+    def formatted(self) -> bool:
+        """True if mkfs already ran on this path (mount will succeed)."""
+        return os.path.exists(self._journal_path)
+
+    def crash_close(self) -> None:
+        """Abandon the live store WITHOUT umount (no checkpoint): free
+        the fds so a fresh instance can re-open the same path — the
+        harness's simulated process death."""
+        if self._journal is not None:
+            self._journal.close()
+            self._journal = None
+        self._mounted = False
+
     # -- lifecycle
     def mkfs(self) -> None:
         os.makedirs(self.path, exist_ok=True)

@@ -9,11 +9,21 @@ round-trips, collection lifecycle) on the in-memory backend
 import pytest
 
 from ceph_tpu.store import CollectionId, MemStore, ObjectId, Transaction
+from ceph_tpu.store.blue import BlueStore
+from ceph_tpu.store.wal import WalStore
 
 
-@pytest.fixture
-def store():
-    s = MemStore()
+@pytest.fixture(params=["mem", "wal", "blue"])
+def store(request, tmp_path):
+    """The ObjectStore CONTRACT suite runs against every backend:
+    MemStore, WalStore (journal+checkpoint), and BlueStore (block file +
+    KV onodes + at-rest checksums)."""
+    if request.param == "mem":
+        s = MemStore()
+    elif request.param == "wal":
+        s = WalStore(str(tmp_path / "wal"), sync="none")
+    else:
+        s = BlueStore(str(tmp_path / "blue"), sync="none")
     s.mkfs()
     s.mount()
     yield s
