@@ -108,6 +108,10 @@ class MiniCluster:
             from ..store.blue import BlueStore
 
             return BlueStore(path, sync="flush")
+        if self.store_kind != "wal":
+            raise ValueError(
+                f"unknown store_kind {self.store_kind!r} (wal|blue)"
+            )
         return WalStore(path, sync="flush")
 
     def _make_mon(self, rank: int) -> Monitor:

@@ -212,9 +212,8 @@ class BlueStore(ObjectStore):
         """Abandon the live store WITHOUT umount (no KV checkpoint):
         free the fds so a fresh instance can re-open the same path —
         the harness's simulated process death."""
-        if self._db is not None and getattr(self._db, "_journal", None):
-            self._db._journal.close()
-            self._db._journal = None
+        if self._db is not None:
+            self._db.crash_close()
             self._db = None
         if self._block_fd is not None:
             os.close(self._block_fd)

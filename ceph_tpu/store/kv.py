@@ -160,6 +160,13 @@ class FileKVDB(MemDB):
             self._journal.close()
             self._journal = None
 
+    def crash_close(self) -> None:
+        """Free the journal fd WITHOUT checkpointing — simulated process
+        death; a fresh open() replays the journal from disk."""
+        if self._journal is not None:
+            self._journal.close()
+            self._journal = None
+
     # -- write path
     @staticmethod
     def _encode_op(op: tuple) -> list:
