@@ -798,9 +798,14 @@ def main():
     dev_platform: str | None = "__none__"
     for phase, platform in phases:
         remaining = t_end - time.time()
-        # keep reserve for the fallback + grid phases, except the last
+        # keep reserve for the fallback + grid phases, except the last —
+        # but scale with the budget rather than hard-capping: a long
+        # --full run must not lose the TPU phase to a fixed 200s lid
         is_last = phase == phases[-1][0]
-        timeout = min(remaining - (0 if is_last else 60), 200)
+        timeout = min(
+            remaining - (0 if is_last else 60),
+            max(200.0, 0.5 * remaining),
+        )
         if timeout < 30:
             log(f"phase {phase}: skipped, only {remaining:.0f}s left")
             continue

@@ -31,8 +31,18 @@ Supported shape (``supports_hier``):
 - one TAKE -> one CHOOSE[LEAF]_FIRSTN/INDEP -> EMIT (any target type);
 - modern tunables (choose_local_tries == choose_local_fallback_tries
   == 0); chooseleaf_vary_r / chooseleaf_stable fully supported;
-- multi-step rules (e.g. LRC per-layer chains) fall back to the scalar
-  mapper via CrushTester.
+- CHAINED rules — TAKE -> CHOOSE_INDEP -> ... -> CHOOSE[LEAF]_INDEP ->
+  EMIT, the LRC per-layer shape
+  (reference:src/erasure-code/lrc/ErasureCodeLrc.cc:44) — run on
+  device via ``_chain_engine``: each later step is one flattened
+  [X*width] engine dispatch rooted at the previous step's buckets.
+  Caveat: the f32 draw ambiguity compounds across a chain's many draws
+  (~10-15% of lanes flagged vs <1% single-step), and flagged lanes
+  recompute on the host through the batched exact numpy chain
+  (``_np_chain``) — still bit-exact, but chains land ~10x over the
+  scalar loop rather than the 300x of single-step shapes.  Only rules
+  the shape parser rejects (firstn chains, mid-chain clamps) fall back
+  to the scalar mapper, and CrushTester warns loudly when that happens.
 """
 
 from __future__ import annotations
@@ -115,6 +125,14 @@ class MapTables:
                     childtype[bi, ii] = float(cmap.buckets[it].type)
         self.I = I
         self.B = B
+        # dense bucket-id -> table-row lookup (ids are negative: index
+        # -1-id); -1 = not a bucket.  Lets a chained CHOOSE step resolve
+        # the previous step's output ids to rows ON DEVICE.
+        max_idx = max((-1 - bid for bid in bids), default=0)
+        id2row = np.full(max_idx + 1, -1, dtype=np.int32)
+        for bid in bids:
+            id2row[-1 - bid] = self.row_of[bid]
+        self.id2row = id2row
         self.depth = self._max_depth(cmap, bids)
         self.ebmax = float(eb.max()) if eb.size else 0.0
         # ONE packed [B, 5I+1] matrix: a single one-hot MXU matmul per
@@ -459,7 +477,12 @@ def choose_indep_hier(
     out = jnp.full((X, out_size), _UNDEF, dtype=jnp.int32)
     out2 = jnp.full((X, out_size), _UNDEF, dtype=jnp.int32)
     amb = jnp.zeros((X,), dtype=bool)
-    roots = jnp.full((X,), root_row, dtype=jnp.int32)
+    # root_row: a scalar (all lanes from one TAKE bucket) or an [X]
+    # array (chained CHOOSE: each lane descends from ITS previous-step
+    # bucket)
+    roots = jnp.broadcast_to(
+        jnp.asarray(root_row, dtype=jnp.int32), (X,)
+    )
 
     def cond(st):
         ftotal, out, out2, amb = st
@@ -740,7 +763,10 @@ def np_choose_indep_hier(
     X = len(x)
     out = np.full((X, out_size), _UNDEF, dtype=np.int64)
     out2 = np.full((X, out_size), _UNDEF, dtype=np.int64)
-    roots = np.full(X, root_row, dtype=np.int64)
+    # scalar root (one TAKE bucket) or per-lane roots (chained steps)
+    roots = np.broadcast_to(
+        np.asarray(root_row, dtype=np.int64), (X,)
+    ).copy()
     for ftotal in range(tries):
         if not (out == _UNDEF).any():
             break
@@ -808,9 +834,15 @@ def _np_leaf_indep(
 def np_do_rule_hier(cmap, ruleno, xs, result_max, weight=None) -> np.ndarray:
     """Host-exact batched crush_do_rule for supported hierarchical rules
     (the fallback engine; also an independent oracle for tests)."""
-    take, choose, tries, leaf_tries, vary_r, stable = _rule_shape(
+    take, chooses, tries, leaf_tries, vary_r, stable = _rule_shape(
         cmap, ruleno
     )
+    if len(chooses) > 1:
+        return _np_chain(
+            cmap, ruleno, take, chooses, tries, leaf_tries, xs,
+            result_max, weight,
+        )
+    choose = chooses[0]
     t = cmap.tunables
     firstn = choose.op in (
         CRUSH_RULE_CHOOSE_FIRSTN, CRUSH_RULE_CHOOSELEAF_FIRSTN
@@ -850,12 +882,89 @@ def np_do_rule_hier(cmap, ruleno, xs, result_max, weight=None) -> np.ndarray:
     return (out2 if leaf else out).astype(np.int32)
 
 
+def _np_chain(cmap, ruleno, take, chooses, tries, leaf_tries, xs,
+              result_max, weight) -> np.ndarray:
+    """Host-EXACT chained INDEP steps, batched (mirrors _chain_engine
+    with the exact numpy engine — no draw ambiguity on the host, real
+    table gathers).  Only lanes whose scalar semantics diverge from the
+    slotted model (a previous-step slot that is NONE/a device, which the
+    scalar interpreter COMPACTS over; or a mid-chain result_max clamp)
+    re-run the full scalar interpreter, and those are rare exhaustion
+    cases — not the ~10% of lanes the f32 device draw flags."""
+    if weight is None:
+        weight = cmap.get_weights()
+    T = tables_for(cmap)
+    NT = _np_tables(cmap)
+    xs = np.asarray(xs, dtype=np.uint32)
+    X = len(xs)
+    total = 1
+    for c in chooses:
+        total *= max(c.arg1, 1)
+    final_w = min(total, result_max)
+
+    def scalar_rows(idxs: np.ndarray, out: np.ndarray) -> None:
+        from .mapper import Workspace, crush_do_rule
+
+        ws = Workspace(cmap)
+        for i in idxs:
+            res = crush_do_rule(
+                cmap, ruleno, int(xs[i]), result_max, weight=weight,
+                workspace=ws,
+            )
+            out[i, :] = _NONE
+            out[i, : min(len(res), final_w)] = res[:final_w]
+
+    first = chooses[0]
+    n1 = first.arg1
+    cur, _o2 = np_choose_indep_hier(
+        NT, xs, T.row_of[take], weight, n1, n1, tries, 1,
+        first.arg2, False, T.depth,
+    )
+    width = n1
+    odd = np.zeros(X, dtype=bool)  # lanes needing scalar semantics
+    clamped = False
+    for step in chooses[1:]:
+        leaf_s = step.op == CRUSH_RULE_CHOOSELEAF_INDEP
+        n_s = step.arg1
+        if width * n_s > result_max:
+            clamped = True
+            break
+        recurse_tries = leaf_tries if leaf_tries else 1
+        is_bucket = cur < 0
+        idx = np.clip(-1 - cur, 0, T.id2row.shape[0] - 1)
+        rows = np.where(is_bucket, T.id2row[idx], -1)
+        valid = rows >= 0
+        odd |= (~valid).any(axis=1)
+        x_flat = np.repeat(xs, width)
+        rows_flat = np.where(valid, rows, 0).reshape(-1)
+        o, o2 = np_choose_indep_hier(
+            NT, x_flat, rows_flat, weight, n_s, n_s, tries,
+            recurse_tries, step.arg2, leaf_s, T.depth,
+        )
+        use = (o2 if leaf_s else o).reshape(X, width, n_s)
+        use = np.where(valid[:, :, None], use, _NONE)
+        cur = use.reshape(X, width * n_s)
+        width *= n_s
+    if clamped:
+        out = np.full((X, final_w), _NONE, dtype=np.int32)
+        scalar_rows(np.arange(X), out)
+        return out
+    out = cur.astype(np.int32)
+    if odd.any():
+        scalar_rows(np.nonzero(odd)[0], out)
+    return out
+
+
 # -- rule-level driver -------------------------------------------------------
 
 
 def _rule_shape(cmap: CrushMap, ruleno: int):
-    """(take_bucket_id, choose_step, tries, leaf_tries, vary_r, stable)
-    or None if the rule is not a single TAKE->CHOOSE->EMIT chain."""
+    """(take_bucket_id, [choose_steps...], tries, leaf_tries, vary_r,
+    stable) or None if the rule is not one TAKE -> CHOOSE+ -> EMIT
+    chain.  Multi-step chains (the LRC per-layer rules: TAKE ->
+    CHOOSE_INDEP locality -> CHOOSELEAF_INDEP domain -> EMIT,
+    reference:src/erasure-code/lrc/ErasureCodeLrc.cc:44 ruleset_steps)
+    return more than one choose step."""
     if ruleno < 0 or ruleno >= len(cmap.rules) or cmap.rules[ruleno] is None:
         return None
     t = cmap.tunables
@@ -864,7 +973,7 @@ def _rule_shape(cmap: CrushMap, ruleno: int):
     vary_r = t.chooseleaf_vary_r
     stable = t.chooseleaf_stable
     take = None
-    choose = None
+    chooses: list = []
     stage = 0
     for s in cmap.rules[ruleno].steps:
         if s.op == CRUSH_RULE_SET_CHOOSE_TRIES:
@@ -894,15 +1003,14 @@ def _rule_shape(cmap: CrushMap, ruleno: int):
             take = s.arg1
             stage = 1
         elif stage == 1 and s.op in _CHOOSE_OPS:
-            choose = s
-            stage = 2
-        elif stage == 2 and s.op == CRUSH_RULE_EMIT:
+            chooses.append(s)
+        elif stage == 1 and s.op == CRUSH_RULE_EMIT and chooses:
             stage = 3
         else:
             return None
-    if stage != 3 or take is None or choose is None:
+    if stage != 3 or take is None or not chooses:
         return None
-    return take, choose, tries, leaf_tries, vary_r, stable
+    return take, chooses, tries, leaf_tries, vary_r, stable
 
 
 def supports_hier(cmap: CrushMap, ruleno: int) -> bool:
@@ -913,11 +1021,25 @@ def supports_hier(cmap: CrushMap, ruleno: int) -> bool:
     shape = _rule_shape(cmap, ruleno)
     if shape is None:
         return False
-    take, choose, _tries, _lt, vary_r, _stable = shape
+    take, chooses, _tries, _lt, vary_r, _stable = shape
     if take not in cmap.buckets:
         return False
     if vary_r < 0 or vary_r > 3:
         return False
+    if len(chooses) > 1:
+        # chained steps (LRC per-layer rules): supported when every step
+        # is INDEP (firstn chains compact their output — different osize
+        # algebra), intermediates select BUCKET types with a positive
+        # count, and the slot product fits result-independent widths
+        indep_ops = (CRUSH_RULE_CHOOSE_INDEP, CRUSH_RULE_CHOOSELEAF_INDEP)
+        if any(c.op not in indep_ops for c in chooses):
+            return False
+        if any(c.arg1 <= 0 for c in chooses):
+            return False
+        for c in chooses[:-1]:
+            if c.op != CRUSH_RULE_CHOOSE_INDEP or c.arg2 == 0:
+                return False
+    choose = chooses[-1]
     leaf = choose.op in (
         CRUSH_RULE_CHOOSELEAF_FIRSTN, CRUSH_RULE_CHOOSELEAF_INDEP
     )
@@ -952,10 +1074,25 @@ def _hier_engine(cmap, ruleno, xs_np, result_max, weight):
     """Run the hierarchical engine; (out_dev [X,W], amb_dev [X]) or None
     (degenerate numrep).  Device arrays: callers choose what to fetch
     (vec_do_rule_hier fetches rows; vec_rule_stats bincounts on device)."""
-    take, choose, tries, leaf_tries, vary_r, stable = _rule_shape(
+    take, chooses, tries, leaf_tries, vary_r, stable = _rule_shape(
         cmap, ruleno
     )
     t = cmap.tunables
+    if weight is None:
+        weight = cmap.get_weights()
+    T = tables_for(cmap)
+    x = jnp.asarray(xs_np)
+    rw = jnp.asarray(np.array(weight, dtype=np.int32))
+    ebm = jnp.float32(T.ebmax)
+    root_row = T.row_of[take]
+
+    if len(chooses) > 1:
+        return _chain_engine(
+            cmap, T, x, rw, ebm, root_row, chooses, tries, leaf_tries,
+            result_max,
+        )
+
+    choose = chooses[0]
     firstn = choose.op in (
         CRUSH_RULE_CHOOSE_FIRSTN, CRUSH_RULE_CHOOSELEAF_FIRSTN
     )
@@ -966,13 +1103,6 @@ def _hier_engine(cmap, ruleno, xs_np, result_max, weight):
     if numrep <= 0:
         return None
     want_type = choose.arg2
-    if weight is None:
-        weight = cmap.get_weights()
-    T = tables_for(cmap)
-    x = jnp.asarray(xs_np)
-    rw = jnp.asarray(np.array(weight, dtype=np.int32))
-    ebm = jnp.float32(T.ebmax)
-    root_row = T.row_of[take]
 
     if firstn:
         if leaf_tries:
@@ -1001,6 +1131,81 @@ def _hier_engine(cmap, ruleno, xs_np, result_max, weight):
             leaf=bool(leaf), max_depth=int(T.depth),
         )
     return (out2 if leaf else out), amb
+
+
+def _chain_engine(cmap, T, x, rw, ebm, root_row, chooses, tries,
+                  leaf_tries, result_max):
+    """Chained INDEP steps on device (the LRC per-layer rules).
+
+    Scalar semantics (mapper.c do_rule CHOOSE loop + our pinned
+    crush/mapper.py): each later step runs crush_choose_indep once PER
+    BUCKET of the previous step's output, with outpos=0 and parent_r=0 —
+    i.e. an independent engine run rooted at that bucket — and the
+    per-bucket regions concatenate.  A previous-step slot that is NONE
+    or a device makes the scalar path COMPACT its output (the bucket is
+    skipped and osize does not advance); such lanes are flagged
+    ambiguous and recomputed exactly on the host."""
+    X = x.shape[0]
+    id2row = jnp.asarray(T.id2row)
+    nrow = T.id2row.shape[0]
+
+    # step 1 from the TAKE root (plain INDEP choose of buckets)
+    first = chooses[0]
+    n1 = first.arg1
+    cur, _o2, amb = choose_indep_hier(
+        T.tree(), x, root_row, rw, ebm,
+        numrep=int(n1), out_size=int(n1), tries=int(tries),
+        recurse_tries=1, want_type=int(first.arg2), leaf=False,
+        max_depth=int(T.depth),
+    )
+    width = n1
+    for step in chooses[1:]:
+        leaf_s = step.op == CRUSH_RULE_CHOOSELEAF_INDEP
+        n_s = step.arg1
+        if width * n_s > result_max:
+            # scalar would clamp per-slot out_size mid-chain; rare and
+            # shape-dependent — recompute everything exactly on the
+            # host.  Pad to the host fallback's width so the splice in
+            # vec_do_rule_hier shape-matches (values are irrelevant:
+            # every lane is flagged).
+            amb = amb | jnp.ones((X,), dtype=bool)
+            total = 1
+            for c in chooses:
+                total *= max(c.arg1, 1)
+            pad_w = min(total, result_max)
+            if pad_w > cur.shape[1]:
+                cur = jnp.concatenate(
+                    [cur, jnp.full((X, pad_w - cur.shape[1]), _NONE,
+                                   dtype=jnp.int32)], axis=1,
+                )
+            else:
+                cur = cur[:, :pad_w]
+            break
+        recurse_tries = leaf_tries if leaf_tries else 1
+        # ONE flattened dispatch per step (not one per column): lanes
+        # become [X*width] with x repeated per slot and each flat lane
+        # rooted at its slot's bucket; the [X*width, n_s] output
+        # reshapes to the slot-major concatenation the scalar produces
+        is_bucket = cur < 0  # NONE is positive, devices are >= 0
+        idx = jnp.clip(-1 - cur, 0, nrow - 1)
+        rows = jnp.where(is_bucket, id2row[idx], -1)  # [X, width]
+        valid = rows >= 0
+        amb = amb | (~valid).any(axis=1)
+        x_flat = jnp.repeat(x, width)
+        rows_flat = jnp.where(valid, rows, 0).reshape(-1)
+        o_s, o2_s, amb_s = choose_indep_hier(
+            T.tree(), x_flat, rows_flat, rw, ebm,
+            numrep=int(n_s), out_size=int(n_s), tries=int(tries),
+            recurse_tries=int(recurse_tries),
+            want_type=int(step.arg2), leaf=leaf_s,
+            max_depth=int(T.depth),
+        )
+        use = (o2_s if leaf_s else o_s).reshape(X, width, n_s)
+        use = jnp.where(valid[:, :, None], use, _NONE)
+        cur = use.reshape(X, width * n_s)
+        amb = amb | amb_s.reshape(X, width).any(axis=1)
+        width *= n_s
+    return cur, amb
 
 
 def vec_do_rule_hier(
