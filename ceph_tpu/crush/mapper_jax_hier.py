@@ -891,6 +891,13 @@ def _np_chain(cmap, ruleno, take, chooses, tries, leaf_tries, xs,
     scalar interpreter COMPACTS over; or a mid-chain result_max clamp)
     re-run the full scalar interpreter, and those are rare exhaustion
     cases — not the ~10% of lanes the f32 device draw flags."""
+    indep_ops = (CRUSH_RULE_CHOOSE_INDEP, CRUSH_RULE_CHOOSELEAF_INDEP)
+    if any(c.op not in indep_ops for c in chooses):
+        # supports_hier gates the production path; direct oracle use of
+        # a firstn chain must fail LOUDLY, not return indep semantics
+        raise ValueError(
+            "multi-step chains are only implemented for INDEP steps"
+        )
     if weight is None:
         weight = cmap.get_weights()
     T = tables_for(cmap)

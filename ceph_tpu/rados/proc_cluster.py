@@ -1,0 +1,190 @@
+"""ProcCluster: a REAL multi-process mini cluster on loopback.
+
+Each mon and OSD is its own OS process (``python -m
+ceph_tpu.tools.daemon``) with a durable store — the reference's tier-2
+testing model (reference:src/test/erasure-code/test-erasure-code.sh
+boots a mon + 11 real OSDs via run_mon/run_osd;
+reference:qa/workunits/ceph-helpers.sh).  Unlike the in-process
+MiniCluster:
+
+- ``kill_osd`` is a true ``SIGKILL`` of a separate process: no Python
+  state survives, the store's crash-replay path (WalStore journal /
+  BlueStore KV) is exercised exactly as a host power-off would,
+- daemon isolation bugs (accidentally shared mutable state) are
+  structurally impossible to paper over,
+- op execution is genuinely parallel across daemons (one interpreter
+  each).
+
+The controlling test stays in-process: it talks to the cluster only
+through RadosClient over TCP, like any client.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class ProcCluster:
+    def __init__(self, store_dir: str, n_osds: int = 3, n_mons: int = 1,
+                 store_kind: str = "wal", heartbeat_interval: float = 2.0,
+                 log_dir: str | None = None):
+        self.store_dir = store_dir
+        self.n_osds = n_osds
+        self.n_mons = n_mons
+        self.store_kind = store_kind
+        self.heartbeat_interval = heartbeat_interval
+        self.log_dir = log_dir  # per-daemon log files (None = discard)
+        self.monmap = [f"127.0.0.1:{_free_port()}" for _ in range(n_mons)]
+        self.mon_procs: dict[int, subprocess.Popen] = {}
+        self.osd_procs: dict[int, subprocess.Popen] = {}
+        self._clients: list = []
+
+    # -- spawning -------------------------------------------------------------
+    def _spawn(self, argv: list[str]) -> subprocess.Popen:
+        import pathlib
+
+        env = dict(os.environ)
+        # the repo root must be importable in the child (the framework
+        # is run from a checkout, not an installed package)
+        root = str(pathlib.Path(__file__).resolve().parents[2])
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in (root, env.get("PYTHONPATH", "")) if p
+        )
+        # daemons never touch the device; force the cheap jax backend so
+        # a fleet of processes doesn't fight over the TPU tunnel
+        env["JAX_PLATFORMS"] = env.get("CEPH_TPU_DAEMON_JAX", "cpu")
+        if self.log_dir:
+            os.makedirs(self.log_dir, exist_ok=True)
+            name = f"{argv[0]}.{argv[2]}"  # role.(rank|id)
+            out = open(os.path.join(self.log_dir, f"{name}.log"), "ab")
+        else:
+            out = subprocess.DEVNULL
+        try:
+            return subprocess.Popen(
+                [sys.executable, "-m", "ceph_tpu.tools.daemon", *argv,
+                 *([] if not self.log_dir else ["--verbose"])],
+                stdout=out, stderr=subprocess.STDOUT,
+                env=env, start_new_session=True,
+            )
+        finally:
+            if out is not subprocess.DEVNULL:
+                out.close()  # the child holds its own inherited copy
+
+    def spawn_mon(self, rank: int) -> None:
+        self.mon_procs[rank] = self._spawn([
+            "mon", "--rank", str(rank), "--addr", self.monmap[rank],
+            "--monmap", ",".join(self.monmap),
+            "--store", os.path.join(self.store_dir, f"mon.{rank}.db"),
+            "--max-osds", str(self.n_osds),
+        ])
+
+    def spawn_osd(self, osd_id: int) -> None:
+        self.osd_procs[osd_id] = self._spawn([
+            "osd", "--id", str(osd_id),
+            "--monmap", ",".join(self.monmap),
+            "--store", os.path.join(self.store_dir, f"osd.{osd_id}"),
+            "--store-kind", self.store_kind,
+            "--heartbeat-interval", str(self.heartbeat_interval),
+        ])
+
+    async def start(self) -> None:
+        os.makedirs(self.store_dir, exist_ok=True)
+        for r in range(self.n_mons):
+            self.spawn_mon(r)
+        for i in range(self.n_osds):
+            self.spawn_osd(i)
+        await self.wait_healthy()
+
+    async def wait_healthy(self, timeout: float = 60.0) -> None:
+        """Until every OSD is up in the map (client-visible health)."""
+        from .client import RadosClient
+
+        deadline = time.monotonic() + timeout
+        last = None
+        while time.monotonic() < deadline:
+            try:
+                cl = RadosClient(self.monmap)
+                await cl.connect()
+                up = [
+                    i for i in range(self.n_osds)
+                    if cl.osdmap.is_up(i)
+                ]
+                await cl.shutdown()
+                if len(up) == self.n_osds:
+                    return
+                last = f"{len(up)}/{self.n_osds} osds up"
+            except Exception as e:
+                last = repr(e)
+            await asyncio.sleep(0.3)
+        raise TimeoutError(f"cluster not healthy: {last}")
+
+    async def client(self):
+        from .client import RadosClient
+
+        cl = RadosClient(self.monmap)
+        await cl.connect()
+        self._clients.append(cl)
+        return cl
+
+    # -- fault injection ------------------------------------------------------
+    def kill9_osd(self, osd_id: int) -> None:
+        """True SIGKILL: the process dies NOW, mid-whatever-it-was-doing.
+        No umount, no flush beyond what already hit the page cache."""
+        proc = self.osd_procs.pop(osd_id)
+        os.killpg(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10)
+
+    async def restart_osd(self, osd_id: int) -> None:
+        """Remount the dead OSD's store from disk in a fresh process."""
+        self.spawn_osd(osd_id)
+
+    async def wait_osd_state(self, cl, osd_id: int, up: bool,
+                             timeout: float = 60.0) -> None:
+        async with asyncio.timeout(timeout):
+            while cl.osdmap is None or cl.osdmap.is_up(osd_id) != up:
+                await asyncio.sleep(0.2)
+
+    # -- teardown -------------------------------------------------------------
+    async def stop(self) -> None:
+        for cl in self._clients:
+            try:
+                await cl.shutdown()
+            except Exception:
+                pass
+        for procs in (self.osd_procs, self.mon_procs):
+            for proc in procs.values():
+                try:
+                    os.killpg(proc.pid, signal.SIGTERM)
+                except ProcessLookupError:
+                    pass
+        deadline = time.monotonic() + 10
+        for procs in (self.osd_procs, self.mon_procs):
+            for proc in procs.values():
+                try:
+                    proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                    proc.wait(timeout=5)
+        self.osd_procs.clear()
+        self.mon_procs.clear()
+
+    async def __aenter__(self) -> "ProcCluster":
+        await self.start()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        await self.stop()

@@ -7,10 +7,16 @@ the connection lines every other CLI needs, and serves until Ctrl-C.
 Usage:
   vstart --osds 4 --mons 3 --mgr --mds --rgw [--auth]
          [--store-dir DIR] [--crush-hosts 2x2]
+  vstart --multiprocess --osds 4 --store-dir DIR   # real daemons
   # then, from other shells:
   rados -m <mon> lspools
   ceph -m <mon> status
   rbd -m <mon> -p rbd create img --size 1048576
+
+``--multiprocess`` boots every mon/OSD as its OWN process with a durable
+store (the reference's run_mon/run_osd tier,
+reference:src/test/erasure-code/test-erasure-code.sh:32-38) — kill -9 a
+daemon and watch the cluster absorb it.
 """
 
 from __future__ import annotations
@@ -33,7 +39,41 @@ def _parse_hosts(spec: str | None, n_osds: int):
     return [list(range(h * per, (h + 1) * per)) for h in range(hosts)]
 
 
+async def _run_multiprocess(args) -> int:
+    from ..rados.proc_cluster import ProcCluster
+    from .daemon import _until_term
+
+    if not args.store_dir:
+        raise SystemExit("--multiprocess requires --store-dir (durable stores)")
+    unsupported = [
+        flag for flag, on in (
+            ("--mgr", args.mgr), ("--mds", args.mds), ("--rgw", args.rgw),
+            ("--auth", args.auth), ("--crush-hosts", args.crush_hosts),
+        ) if on
+    ]
+    if unsupported:
+        raise SystemExit(
+            f"--multiprocess does not support {' '.join(unsupported)} yet"
+        )
+    pc = ProcCluster(
+        args.store_dir, n_osds=args.osds, n_mons=args.mons,
+        log_dir=args.store_dir + "/logs",
+    )
+    await pc.start()
+    print(f"mon:    {','.join(pc.monmap)}")
+    for i, proc in sorted(pc.osd_procs.items()):
+        print(f"osd.{i}: pid {proc.pid}")
+    print(f"logs:   {args.store_dir}/logs", flush=True)
+    print("ready — Ctrl-C to stop", flush=True)
+    await _until_term()
+    print("stopping...", flush=True)
+    await pc.stop()
+    return 0
+
+
 async def _run(args) -> int:
+    if args.multiprocess:
+        return await _run_multiprocess(args)
     cluster = MiniCluster(
         n_osds=args.osds,
         n_mons=args.mons,
@@ -105,6 +145,9 @@ def main(argv=None) -> int:
     p.add_argument("--rgw", action="store_true")
     p.add_argument("--rgw-port", type=int, default=0)
     p.add_argument("--auth", action="store_true", help="enable cephx")
+    p.add_argument("--multiprocess", action="store_true",
+                   help="each daemon is its own OS process (needs "
+                        "--store-dir)")
     p.add_argument("--store-dir", default=None,
                    help="durable WalStores here (default: in-memory)")
     p.add_argument("--crush-hosts", default=None, metavar="HxP",

