@@ -48,7 +48,7 @@ from ..crush.map import CrushMap
 from ..models import registry
 from ..msg import AsyncMessenger, Connection, Dispatcher, messages
 from ..msg.message import Message
-from ..osd.osdmap import OSDMap
+from ..osd.osdmap import OSDMap, POOL_TYPE_REPLICATED
 
 logger = logging.getLogger("ceph_tpu.mon")
 
@@ -893,6 +893,11 @@ class Monitor(Dispatcher):
                 "osd down": self._cmd_osd_down,
                 "osd out": self._cmd_osd_out,
                 "osd in": self._cmd_osd_in,
+                "osd tier add": self._cmd_tier_add,
+                "osd tier remove": self._cmd_tier_remove,
+                "osd tier cache-mode": self._cmd_tier_cache_mode,
+                "osd tier set-overlay": self._cmd_tier_set_overlay,
+                "osd tier remove-overlay": self._cmd_tier_remove_overlay,
                 "status": self._cmd_status,
             }.get(prefix)
             if handler is None:
@@ -901,6 +906,90 @@ class Monitor(Dispatcher):
         except Exception as e:  # command errors must not kill the mon
             logger.exception("%s: command %r failed", self.name, prefix)
             return -EINVAL, str(e), None
+
+    # -- cache tiering (reference:src/mon/OSDMonitor.cc "osd tier *"
+    # command family) -------------------------------------------------------
+
+    def _tier_pools(self, cmd: dict):
+        base = self.osdmap.lookup_pool(cmd["pool"])
+        tier = self.osdmap.lookup_pool(cmd["tierpool"])
+        if base is None or tier is None:
+            raise ValueError("no such pool")
+        return base, tier
+
+    def _cmd_tier_add(self, cmd: dict) -> tuple[int, str, Any]:
+        base, tier = self._tier_pools(cmd)
+        if tier.tier_of >= 0 and tier.tier_of != base.id:
+            return -EINVAL, f"{tier.name} is already a tier", None
+        if tier.id == base.id:
+            return -EINVAL, "a pool cannot tier itself", None
+        if tier.type != POOL_TYPE_REPLICATED:
+            # the reference requires a replicated cache in front of an
+            # EC base (EC pools can't host the tiering metadata ops)
+            return -EINVAL, "cache tier must be a replicated pool", None
+        tier.tier_of = base.id
+        if tier.id not in base.tiers:
+            base.tiers.append(tier.id)
+        self._mark_dirty()
+        return 0, f"pool {tier.name} is now a tier of {base.name}", None
+
+    def _cmd_tier_remove(self, cmd: dict) -> tuple[int, str, Any]:
+        base, tier = self._tier_pools(cmd)
+        if base.read_tier == tier.id or base.write_tier == tier.id:
+            return -EINVAL, "remove the overlay first", None
+        if tier.id in base.tiers:
+            base.tiers.remove(tier.id)
+        tier.tier_of = -1
+        tier.cache_mode = "none"
+        self._mark_dirty()
+        return 0, "", None
+
+    def _cmd_tier_cache_mode(self, cmd: dict) -> tuple[int, str, Any]:
+        tier = self.osdmap.lookup_pool(cmd["pool"])
+        mode = cmd.get("mode", "")
+        if tier is None:
+            return -ENOENT, "no such pool", None
+        if tier.tier_of < 0:
+            return -EINVAL, f"{tier.name} is not a tier", None
+        if mode not in ("none", "writeback"):
+            return -EINVAL, f"unsupported cache mode {mode!r}", None
+        base = self.osdmap.pools.get(tier.tier_of)
+        if (
+            mode == "none" and base is not None
+            and tier.id in (base.read_tier, base.write_tier)
+        ):
+            # clients still redirect to the cache while the overlay is
+            # up; mode=none would stop promotion and strand every
+            # non-resident object behind ENOENT (review r3 finding)
+            return -EINVAL, "remove the overlay before mode none", None
+        tier.cache_mode = mode
+        for key in ("hit_set_count", "hit_set_period",
+                    "cache_target_full_ratio", "cache_target_dirty_ratio",
+                    "cache_min_flush_age", "cache_min_evict_age"):
+            if key in cmd:
+                setattr(tier, key, type(getattr(tier, key))(cmd[key]))
+        self._mark_dirty()
+        return 0, "", None
+
+    def _cmd_tier_set_overlay(self, cmd: dict) -> tuple[int, str, Any]:
+        base, tier = self._tier_pools(cmd)
+        if tier.tier_of != base.id:
+            return -EINVAL, f"{tier.name} is not a tier of {base.name}", None
+        if tier.cache_mode == "none":
+            return -EINVAL, "set a cache-mode before the overlay", None
+        base.read_tier = tier.id
+        base.write_tier = tier.id
+        self._mark_dirty()
+        return 0, f"overlay for {base.name} is now {tier.name}", None
+
+    def _cmd_tier_remove_overlay(self, cmd: dict) -> tuple[int, str, Any]:
+        base = self.osdmap.lookup_pool(cmd["pool"])
+        if base is None:
+            return -ENOENT, "no such pool", None
+        base.read_tier = -1
+        base.write_tier = -1
+        self._mark_dirty()
+        return 0, "", None
 
     def _cmd_ec_profile_set(self, cmd: dict) -> tuple[int, str, Any]:
         name = cmd["name"]
@@ -987,7 +1076,15 @@ class Monitor(Dispatcher):
 
     # pool vars an operator may tune at runtime (reference:OSDMonitor.cc
     # prepare_command 'osd pool set' — the subset this data path reads)
-    _POOL_VARS = {"size": int, "min_size": int}
+    _POOL_VARS = {
+        "size": int, "min_size": int,
+        # cache tiering knobs (reference pg_pool_t tiering options)
+        "hit_set_count": int, "hit_set_period": float,
+        "cache_target_full_ratio": float,
+        "cache_target_dirty_ratio": float,
+        "cache_min_flush_age": float, "cache_min_evict_age": float,
+        "target_max_objects": int, "target_max_bytes": int,
+    }
 
     def _cmd_pool_set(self, cmd: dict) -> tuple[int, str, Any]:
         pool = self.osdmap.lookup_pool(cmd["pool"])

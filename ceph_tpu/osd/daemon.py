@@ -309,6 +309,9 @@ class OSD(Dispatcher):
         from .scrub import ScrubManager
 
         self.recovery = RecoveryManager(self)
+        from .tiering import TieringService
+
+        self.tiering = TieringService(self)
         self.scrub = ScrubManager(
             self,
             interval=(
@@ -380,6 +383,7 @@ class OSD(Dispatcher):
         self.recovery.start()
         self.recovery.kick()  # reconcile whatever the map says we lead
         self.scrub.start()
+        self.tiering.start()
         await self._start_admin_socket()
         return self.addr
 
@@ -540,6 +544,7 @@ class OSD(Dispatcher):
             self.config.unobserve(opt, cb)
         self.recovery.stop()
         self.scrub.stop()
+        self.tiering.stop()
         if self._hb_task:
             self._hb_task.cancel()
         if self._wd_task:
@@ -582,6 +587,10 @@ class OSD(Dispatcher):
                 err = msg.errors[0] if msg.errors else 0
                 data = msg.blobs[0] if msg.blobs else b""
                 w.complete(msg.shard, data, msg.attrs, err)  # attrs: flat {key: str}
+        elif isinstance(msg, messages.MOSDOpReply):
+            # replies to the OSD's own internal ops (tier traffic to the
+            # base pool — the OSD acting as its own Objecter)
+            self.tiering.on_reply(msg)
         elif isinstance(msg, messages.MWatchNotifyAck):
             nw = self._notify_waiters.get(msg.notify_id)
             if nw:
@@ -780,6 +789,13 @@ class OSD(Dispatcher):
             return await self._watch_execute(pg, pool, acting, msg, conn)
         if pool.type == POOL_TYPE_ERASURE:
             return await self._ec_execute(pg, pool, acting, msg)
+        tiered = pool.tier_of >= 0 and pool.cache_mode == "writeback"
+        if tiered:
+            # cache-pool op (reference:PrimaryLogPG maybe_handle_cache):
+            # record the hit, promote on miss, inject the dirty marker —
+            # BEFORE the pg lock (promote takes it itself)
+            await self.tiering.prepare(pg, pool, acting, msg)
+        names = [op.get("op") for op in msg.ops]  # prepare may inject
         if any(n in self._REP_LOCKED_OPS for n in names):
             # every replicated mutation plans against current state
             # (snap clone decisions, cls read-modify-write, projected
@@ -787,9 +803,13 @@ class OSD(Dispatcher):
             # ops on the PG (the reference holds the PG lock across
             # execute_ctx); the commit path skips re-locking
             async with self.pg_lock(pg):
-                return await self._rep_execute(pg, pool, acting, msg,
-                                               locked=True)
-        return await self._rep_execute(pg, pool, acting, msg)
+                result = await self._rep_execute(pg, pool, acting, msg,
+                                                 locked=True)
+        else:
+            result = await self._rep_execute(pg, pool, acting, msg)
+        if tiered:
+            await self.tiering.finish(pg, pool, acting, msg, result[0])
+        return result
 
     def _handle_pgls(self, conn: Connection, msg) -> None:
         """List this PG's objects from the primary's own shard (every
@@ -2329,6 +2349,10 @@ class OSD(Dispatcher):
         blobs: list[bytes] = []
         txn = Transaction().create_collection(cid)
         mutates = False
+        # an earlier op in THIS batch creates the object: later ops'
+        # existence checks must see the projected state, not pre-state
+        # (rados compound-op semantics: ops execute sequentially)
+        batch_created = False
         log_op = "modify"
         try:
             projected_size = self.store.stat(cid, oid)
@@ -2390,6 +2414,7 @@ class OSD(Dispatcher):
                 txn.remove(cid, oid).write(cid, oid, 0, data)
                 projected_size = len(data)
                 mutates = True
+                batch_created = True
                 log_op = "modify"
                 out.append({"rval": 0})
             elif name == "write":
@@ -2398,6 +2423,7 @@ class OSD(Dispatcher):
                 txn.write(cid, oid, off, data)
                 projected_size = max(projected_size, off + len(data))
                 mutates = True
+                batch_created = True
                 log_op = "modify"
                 out.append({"rval": 0})
             elif name == "append":
@@ -2405,6 +2431,7 @@ class OSD(Dispatcher):
                 txn.write(cid, oid, projected_size, data)
                 projected_size += len(data)
                 mutates = True
+                batch_created = True
                 log_op = "modify"
                 out.append({"rval": 0})
             elif name == "truncate":
@@ -2499,6 +2526,15 @@ class OSD(Dispatcher):
                 )
                 mutates = True
                 out.append({"rval": 0})
+            elif name == "tier.dirty":
+                # internal cache-tier marker (ceph_tpu.osd.tiering):
+                # rides the mutating batch so dirty-tracking commits in
+                # the SAME transaction as the write it marks
+                from .tiering import DIRTY_KEY
+
+                txn.setattr(cid, oid, DIRTY_KEY, b"1")
+                mutates = True
+                out.append({"rval": 0})
             elif name == "rmxattr":
                 if not self.store.exists(cid, oid):
                     out.append({"rval": -ENOENT})
@@ -2539,8 +2575,15 @@ class OSD(Dispatcher):
                 txn.omap_setkeys(cid, oid, kv)
                 mutates = True
                 out.append({"rval": 0})
+            elif name == "omap_clear":
+                if not (self.store.exists(cid, oid) or batch_created):
+                    out.append({"rval": -ENOENT})
+                    return -ENOENT, out, blobs
+                txn.omap_clear(cid, oid)
+                mutates = True
+                out.append({"rval": 0})
             elif name == "omap_rmkeys":
-                if not self.store.exists(cid, oid):
+                if not (self.store.exists(cid, oid) or batch_created):
                     out.append({"rval": -ENOENT})
                     return -ENOENT, out, blobs
                 txn.omap_rmkeys(cid, oid, list(op.get("keys", [])))

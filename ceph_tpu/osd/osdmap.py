@@ -121,6 +121,23 @@ class Pool:
     snap_seq: int = 0
     snaps: dict = field(default_factory=dict)  # snapid -> name
     removed_snaps: list = field(default_factory=list)
+    # cache tiering (reference:osd_types.h pg_pool_t:1283-1292):
+    # tier_of >= 0 makes this pool a cache TIER of that base pool;
+    # read_tier/write_tier on the BASE redirect client ops to the cache
+    # (the overlay); cache_mode drives the OSD's promote/flush behavior
+    tier_of: int = -1
+    tiers: list = field(default_factory=list)
+    read_tier: int = -1
+    write_tier: int = -1
+    cache_mode: str = "none"  # none | writeback
+    hit_set_count: int = 4
+    hit_set_period: float = 60.0
+    cache_target_full_ratio: float = 0.8
+    cache_target_dirty_ratio: float = 0.4
+    cache_min_flush_age: float = 0.0
+    cache_min_evict_age: float = 0.0
+    target_max_objects: int = 0  # 0 = no cap; agent evicts toward
+    target_max_bytes: int = 0    # full_ratio * target when set
 
     @property
     def pg_num_mask(self) -> int:

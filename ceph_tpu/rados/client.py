@@ -404,6 +404,13 @@ class RadosClient(Dispatcher):
             pool = self.osdmap.lookup_pool(pool_name)
             if pool is None:
                 raise RadosError(-ENOENT, f"no pool {pool_name!r}")
+            if pool.read_tier >= 0 and pool.read_tier in self.osdmap.pools:
+                # cache-tier overlay (reference:osdc/Objecter.cc
+                # _calc_target read_tier/write_tier): ops target the
+                # CACHE pool; its OSDs promote/flush against the base.
+                # This framework sets read_tier == write_tier, so one
+                # redirect covers both directions.
+                pool = self.osdmap.pools[pool.read_tier]
             pg = self.osdmap.object_locator_to_pg(oid, pool.id)
             _up, _upp, _acting, primary = self.osdmap.pg_to_up_acting_osds(pg)
             addr = self.osdmap.get_addr(primary) if primary >= 0 else None
