@@ -362,7 +362,7 @@ def bench_grid(quick: bool, deadline: float | None,
     from ceph_tpu.ops.gf import gf
     from ceph_tpu.ops.gf_jax import (
         bytes_to_u32,
-        make_bitmatrix_matmul,
+        make_bitmatrix_matmul_u32,
         make_gf_matmul_u32,
         u32_to_bytes,
     )
@@ -392,6 +392,26 @@ def bench_grid(quick: bool, deadline: float | None,
             np.asarray(matrix, dtype=np.int64), inp_u8[:, :cols]
         )
 
+    def _engine(matrix, n4, *, bitmatrix):
+        """Fused Pallas kernel when the TPU + lane count allow it, else
+        the XLA kernel — both u32-native (the r3 grid pinned XLA even on
+        TPU; the bitmatrix family only has a fused engine as of r4)."""
+        from ceph_tpu.ops import gf_pallas
+        from ceph_tpu.ops.gf_jax import _probe_compile
+
+        k_cols = int(np.asarray(matrix).shape[1])
+        if gf_pallas._have_pallas_tpu() and n4 % gf_pallas.BLOCK == 0:
+            if bitmatrix:
+                cand = gf_pallas.make_bitmatrix_matmul_pallas(matrix)
+            else:
+                cand = gf_pallas.make_gf_matmul_pallas(matrix, W)
+            if _probe_compile(cand, k_cols):
+                return cand, "pallas"
+            log("grid child: pallas demoted (Mosaic refused)")
+        if bitmatrix:
+            return make_bitmatrix_matmul_u32(matrix), "xla"
+        return make_gf_matmul_u32(matrix, W), "xla"
+
     def run_cfg(name, enc_matrix, data_u8, dec_matrix, dec_input_u8,
                 *, bitmatrix=False):
         """Measure encode + reconstruct for one config.  BOTH kernels'
@@ -401,25 +421,20 @@ def bench_grid(quick: bool, deadline: float | None,
         e.g. an LRC local group — review r3 finding)."""
         enc_bytes = data_u8.size
         dec_bytes = dec_input_u8.size
-        if bitmatrix:
-            enc = make_bitmatrix_matmul(enc_matrix)
-            dec = make_bitmatrix_matmul(dec_matrix)
-            dev_in = jax.device_put(data_u8, dev)
-            dec_in = jax.device_put(dec_input_u8, dev)
-        else:
-            enc = make_gf_matmul_u32(enc_matrix)
-            dec = make_gf_matmul_u32(dec_matrix)
-            dev_in = jax.device_put(bytes_to_u32(data_u8), dev)
-            dec_in = jax.device_put(bytes_to_u32(dec_input_u8), dev)
+        enc, eng_e = _engine(
+            enc_matrix, data_u8.shape[1] // 4, bitmatrix=bitmatrix
+        )
+        dec, eng_d = _engine(
+            dec_matrix, dec_input_u8.shape[1] // 4, bitmatrix=bitmatrix
+        )
+        dev_in = jax.device_put(bytes_to_u32(data_u8), dev)
+        dec_in = jax.device_put(bytes_to_u32(dec_input_u8), dev)
         for fn, dev_arr, host_arr, matrix in (
             (enc, dev_in, data_u8, enc_matrix),
             (dec, dec_in, dec_input_u8, dec_matrix),
         ):
             out_dev = np.asarray(jax.jit(fn)(dev_arr))
-            head = (
-                out_dev[:, :256] if bitmatrix
-                else u32_to_bytes(out_dev[:, :64])  # 64 u32 = 256 bytes
-            )
+            head = u32_to_bytes(out_dev[:, :64])  # 64 u32 = 256 bytes
             np.testing.assert_array_equal(
                 head, _np_oracle(matrix, host_arr, bitmatrix)
             )
@@ -435,6 +450,7 @@ def bench_grid(quick: bool, deadline: float | None,
             "combined_gbps": round(
                 (enc_bytes + dec_bytes) / (t_enc + t_dec) / 1e9, 3
             ),
+            "engine": eng_e if eng_e == eng_d else f"{eng_e}/{eng_d}",
         }
 
     def native_ratio(cfg, matrix, k):
@@ -564,40 +580,88 @@ def bench_grid(quick: bool, deadline: float | None,
 
 def bench_crush(deadline: float | None, platform: str | None) -> dict:
     """crushtool --test 1M-object placement sim (BASELINE config 5's
-    second half): the vectorized mapper over 10^6 x values vs the scalar
-    python mapper (the reference's single-thread C loop class)."""
+    second half) ON THE DEVICE (VERDICT r3 Weak #3: the cpu pin meant the
+    SURVEY §3.5 north star was never measured where it counts).
+
+    Two map shapes: the flat 64-device straw2 rule (the
+    ``crushtool --test`` default shape, reference:src/crush/
+    CrushTester.cc:648) and a racks->hosts->devices chooseleaf rule (the
+    production shape, hier engine).  Placement statistics are bincounted
+    on device (mapper_jax.vec_rule_stats) so only counts cross the
+    tunnel; a sampled lane subset is fetched and checked bit-exact
+    against the scalar oracle.  Baselines measured in the same run: the
+    python scalar mapper and the native C straw2 engine
+    (native/crush_cpu.cc, the reference's single-thread mapper.c class).
+    """
     import jax
 
     if platform:
         jax.config.update("jax_platforms", platform)
+    dev = jax.devices()[0]
     from ceph_tpu.crush import mapper, mapper_jax
     from ceph_tpu.crush.map import CrushMap
 
-    n_dev, nrep, n_x = 64, 3, 1_000_000
+    def left() -> float:
+        return float("inf") if deadline is None else deadline - time.time()
+
+    out: dict = {"platform": str(dev)}
+    shapes: dict[str, tuple] = {}
+    n_dev, nrep = 64, 3
     cmap = CrushMap.flat(n_dev)
     rule = cmap.add_simple_rule(cmap.root_id(), 0, indep=False, max_size=nrep)
-    xs = np.arange(n_x, dtype=np.uint32)
-    # warm (compile)
-    mapper_jax.vec_do_rule(cmap, rule, xs[:1024], nrep)
-    t0 = time.perf_counter()
-    outv = mapper_jax.vec_do_rule(cmap, rule, xs, nrep)
-    t_vec = time.perf_counter() - t0
-    # scalar baseline on a sample, extrapolated
-    sample = 2000
-    t0 = time.perf_counter()
-    for x in range(sample):
-        mapper.crush_do_rule(cmap, rule, x, nrep)
-    t_scalar_per = (time.perf_counter() - t0) / sample
-    # spot-agreement on the sample prefix
-    for x in range(0, sample, 97):
-        assert list(outv[x]) == mapper.crush_do_rule(cmap, rule, x, nrep)
-    return {
-        "mappings": n_x,
-        "vec_seconds": round(t_vec, 3),
-        "mappings_per_sec": round(n_x / t_vec, 0),
-        "scalar_per_mapping_us": round(t_scalar_per * 1e6, 2),
-        "vs_scalar": round(t_scalar_per * n_x / t_vec, 1),
-    }
+    shapes["flat_64"] = (cmap, rule, nrep, 1_000_000)
+    # 16 hosts x 4 devices, chooseleaf firstn over hosts — the hier engine
+    hmap = CrushMap.hierarchical(
+        [[h * 4 + d for d in range(4)] for h in range(16)]
+    )
+    hrule = hmap.add_simple_rule(hmap.root_id(), 1, indep=False, max_size=nrep)
+    shapes["chooseleaf_16x4"] = (hmap, hrule, nrep, 1_000_000)
+
+    for name, (m, rn, nr, n_x) in shapes.items():
+        if left() < 20:
+            break
+        try:
+            xs = np.arange(n_x, dtype=np.uint32)
+            # warm at full shape (one compile), then time the second call
+            mapper_jax.vec_rule_stats(m, rn, xs, nr)
+            t0 = time.perf_counter()
+            counts, bad = mapper_jax.vec_rule_stats(m, rn, xs, nr)
+            t_vec = time.perf_counter() - t0
+            # bit-exact spot check: 128 sampled lanes vs the scalar oracle
+            sample_xs = np.linspace(0, n_x - 1, 128, dtype=np.uint32)
+            vec_rows = mapper_jax.vec_do_rule(m, rn, sample_xs, nr)
+            for i, x in enumerate(sample_xs):
+                ref = mapper.crush_do_rule(m, rn, int(x), nr)
+                assert list(vec_rows[i]) == ref, (int(x), list(vec_rows[i]), ref)
+            # python scalar baseline on a sample
+            s = 1000
+            t0 = time.perf_counter()
+            for x in range(s):
+                mapper.crush_do_rule(m, rn, x, nr)
+            t_scalar_per = (time.perf_counter() - t0) / s
+            cfg = {
+                "mappings": n_x,
+                "vec_seconds": round(t_vec, 3),
+                "mappings_per_sec": round(n_x / t_vec, 0),
+                "placed": int(sum(counts.values())),
+                "bad_mappings": int(bad),
+                "scalar_per_mapping_us": round(t_scalar_per * 1e6, 2),
+                "vs_scalar": round(t_scalar_per * n_x / t_vec, 1),
+            }
+            try:  # native C straw2 single-thread cost (honest C baseline)
+                from ceph_tpu.utils import native_crush
+
+                t_c = native_crush.bench_flat(m, rn, nr, min(200_000, n_x))
+                cfg["native_c_per_mapping_us"] = round(t_c * 1e6, 3)
+                cfg["vs_native_c"] = round(t_c * n_x / t_vec, 2)
+            except Exception as e:
+                log(f"crush: native C baseline unavailable: {e!r}")
+            out[name] = cfg
+            log(f"crush {name}: {cfg['mappings_per_sec']:.0f} mappings/s "
+                f"(vs_scalar {cfg['vs_scalar']}x)")
+        except Exception as e:
+            log(f"crush {name} failed: {e!r}")
+    return out
 
 
 def _bench_codec_stack(deadline: float | None) -> float:
@@ -668,52 +732,153 @@ def _kill_child(proc) -> None:
         pass
 
 
-def run_child(phase: str, platform: str | None, batch: int, quick: bool,
-              timeout: float, mode: str | None = None) -> dict | None:
-    """Run one accelerator phase as a killable subprocess; parse its JSON."""
-    cmd = [sys.executable, os.path.abspath(__file__), "--_child",
-           "--batch", str(batch)]
-    if mode:
-        cmd.append(f"--_{mode}")
-    if platform:
-        cmd += ["--platform", platform]
-    if quick:
-        cmd.append("--quick")
-    cmd += ["--_deadline", str(time.time() + timeout - 5)]
+def _spawn(phase: str, extra: list[str], timeout: float):
+    cmd = [sys.executable, os.path.abspath(__file__), "--_child", *extra]
     log(f"phase {phase}: starting child (timeout {timeout:.0f}s)")
     proc = subprocess.Popen(
         cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         start_new_session=True,  # own pgid so _kill_child can nuke the tree
     )
     _CHILDREN.append(proc)
+    return proc
+
+
+def probe_device(platform: str | None, timeout: float) -> str | None:
+    """~20s killable device-acquisition probe (VERDICT r3 #1): answers
+    with the device string, or None if ``jax.devices()`` hangs/fails.
+    The parent never touches the device itself."""
+    extra = ["--_probe"]
+    if platform:
+        extra += ["--platform", platform]
+    proc = _spawn(f"probe[{platform or 'tpu'}]", extra, timeout)
     try:
         out, err = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
         _kill_child(proc)
-        out, err = proc.communicate()
-        log(f"phase {phase}: child TIMED OUT after {timeout:.0f}s, killed")
-        for line in (err or "").splitlines():
-            log(f"  {line}")  # shows where the child was stuck
+        log(f"probe[{platform or 'tpu'}]: HUNG (no device in "
+            f"{timeout:.0f}s), killed")
         return None
     finally:
         _CHILDREN.remove(proc)
-    for line in err.splitlines():
-        log(f"  {line}")
-    if proc.returncode != 0:
-        log(f"phase {phase}: child failed rc={proc.returncode}: "
-            f"{err.strip()[-500:]}")
-        return None
     for line in reversed(out.splitlines()):
         try:
-            return json.loads(line)
-        except json.JSONDecodeError:
+            obj = json.loads(line)
+            log(f"probe[{platform or 'tpu'}]: ok: {obj['platform']}")
+            return obj["platform"]
+        except (json.JSONDecodeError, KeyError):
             continue
-    log(f"phase {phase}: no JSON in child output")
+    log(f"probe[{platform or 'tpu'}]: failed rc={proc.returncode}: "
+        f"{(err or '').strip()[-300:]}")
     return None
+
+
+def run_combo(phase: str, platform: str | None, batch: int, quick: bool,
+              timeout: float, skip: set[str] = frozenset(),
+              on_result=None) -> dict:
+    """One warmed child runs headline -> grid -> crush over a SINGLE
+    device acquisition (VERDICT r3 #1: pay acquisition once), streaming
+    a tagged JSON line per completed sub-phase so partial progress
+    survives a later hang.  Returns {kind: result}."""
+    import threading
+
+    extra = ["--_combo", "--batch", str(batch),
+             "--_deadline", str(time.time() + timeout - 5)]
+    if platform:
+        extra += ["--platform", platform]
+    if quick:
+        extra.append("--quick")
+    if skip:
+        extra += ["--_skip", ",".join(sorted(skip))]
+    proc = _spawn(phase, extra, timeout)
+    results: dict[str, dict] = {}
+
+    def _drain_err():
+        for line in proc.stderr:
+            log(f"  {line.rstrip()}")
+
+    def _drain_out():
+        for line in proc.stdout:
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            kind = obj.pop("kind", None)
+            if kind:
+                results[kind] = obj
+                log(f"phase {phase}: sub-phase '{kind}' answered")
+                if on_result is not None:
+                    try:
+                        on_result(kind, obj)
+                    except Exception as e:
+                        log(f"on_result({kind}) failed: {e!r}")
+
+    threads = [threading.Thread(target=_drain_err, daemon=True),
+               threading.Thread(target=_drain_out, daemon=True)]
+    for t in threads:
+        t.start()
+    end = time.time() + timeout
+    while proc.poll() is None and time.time() < end:
+        time.sleep(0.25)
+    if proc.poll() is None:
+        log(f"phase {phase}: child TIMED OUT after {timeout:.0f}s, killed "
+            f"(kept sub-phases: {sorted(results)})")
+        _kill_child(proc)
+    _CHILDREN.remove(proc)
+    for t in threads:
+        t.join(timeout=3)
+    return results
+
+
+def combo_main(args) -> None:
+    """Child-side combo: acquire the device ONCE, then headline -> grid
+    -> crush, emitting one tagged JSON line per phase."""
+    deadline = args._deadline or (time.time() + 600)
+    skip = set(filter(None, (args._skip or "").split(",")))
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    dev = jax.devices()[0]
+    log(f"combo child: device ready: {dev}")
+    print(json.dumps({"kind": "ready", "platform": str(dev)}), flush=True)
+
+    def sub_deadline(frac: float) -> float:
+        return min(time.time() + frac * (deadline - time.time()), deadline)
+
+    if "headline" not in skip and deadline - time.time() > 20:
+        try:
+            res = bench_device(args.batch, args.quick, sub_deadline(0.45),
+                               args.platform)
+            print(json.dumps({"kind": "headline", **res}), flush=True)
+        except Exception as e:
+            log(f"combo child: headline failed: {e!r}")
+    if "grid" not in skip and deadline - time.time() > 30:
+        try:
+            res = bench_grid(args.quick, sub_deadline(0.7), args.platform)
+            print(json.dumps({"kind": "grid", **res}), flush=True)
+        except Exception as e:
+            log(f"combo child: grid failed: {e!r}")
+    if "crush" not in skip and deadline - time.time() > 15:
+        try:
+            res = bench_crush(deadline, args.platform)
+            print(json.dumps({"kind": "crush", **res}), flush=True)
+        except Exception as e:
+            log(f"combo child: crush failed: {e!r}")
 
 
 def child_main(args) -> None:
     deadline = args._deadline or None
+    if args._probe:
+        import jax
+
+        if args.platform:
+            jax.config.update("jax_platforms", args.platform)
+        dev = jax.devices()[0]
+        print(json.dumps({"ok": True, "platform": str(dev)}), flush=True)
+        return
+    if args._combo:
+        combo_main(args)
+        return
     if args._grid:
         res = bench_grid(args.quick, deadline, args.platform)
     elif args._crush:
@@ -757,6 +922,9 @@ def main():
     ap.add_argument("--_child", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--_grid", action="store_true", help=argparse.SUPPRESS)
     ap.add_argument("--_crush", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--_probe", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--_combo", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--_skip", default=None, help=argparse.SUPPRESS)
     ap.add_argument("--_deadline", type=float, default=None, help=argparse.SUPPRESS)
     args = ap.parse_args()
 
@@ -787,66 +955,115 @@ def main():
     except Exception as e:
         log(f"phase native-mc failed: {e!r}")
 
-    phases = []
-    if args.platform:
-        phases.append((f"jax-{args.platform}", args.platform))
-    else:
-        phases.append(("tpu", None))
-        phases.append(("jax-cpu", "cpu"))
-
+    # accumulated results per backend; TPU results trump jax-cpu ones
     results = [native_line]
-    dev_platform: str | None = "__none__"
-    for phase, platform in phases:
-        remaining = t_end - time.time()
-        # keep reserve for the fallback + grid phases, except the last —
-        # but scale with the budget rather than hard-capping: a long
-        # --full run must not lose the TPU phase to a fixed 200s lid
-        is_last = phase == phases[-1][0]
-        timeout = min(
-            remaining - (0 if is_last else 60),
-            max(200.0, 0.5 * remaining),
-        )
-        if timeout < 30:
-            log(f"phase {phase}: skipped, only {remaining:.0f}s left")
-            continue
-        dev = run_child(phase, platform, args.batch, quick, timeout)
-        if dev is not None:
-            line = result_line(dev, cpu, phase)
-            results.append(line)
-            emit(line)
-            dev_platform = platform
-            break  # first accelerator phase that answers wins
+    acc: dict[str, dict[str, dict]] = {}  # backend -> {kind: result}
 
-    final = max(results, key=lambda r: r["value"])
-    if mc is not None:
-        final["native_multicore_gbps"] = round(mc["combined_gbps"], 3)
-        final["multicore_workers"] = mc["workers"]
-        final["vs_multicore"] = round(
-            final["value"] / mc["combined_gbps"], 3
-        )
-    emit(final)
-
-    # the rest of the BASELINE grid (configs 1, 3, 4, 5) on the same
-    # backend that answered, then the crush 1M-x placement sim
-    if dev_platform != "__none__":
-        remaining = t_end - time.time()
-        if remaining > 60:
-            grid = run_child(
-                "grid", dev_platform, args.batch, quick,
-                min(remaining - 40, 240), mode="grid",
+    def assemble() -> dict:
+        """Best headline + grid/crush from the best backend that has them."""
+        final = dict(max(results, key=lambda r: r["value"]))
+        if mc is not None:
+            final["native_multicore_gbps"] = round(mc["combined_gbps"], 3)
+            final["multicore_workers"] = mc["workers"]
+            final["vs_multicore"] = round(
+                final["value"] / mc["combined_gbps"], 3
             )
-            if grid is not None and grid.get("configs"):
-                final["configs"] = grid["configs"]
-                emit(final)
-    remaining = t_end - time.time()
-    if remaining > 30:
-        crush = run_child(
-            "crush", "cpu", args.batch, quick,
-            min(remaining - 5, 120), mode="crush",
+        for backend in ("tpu", "jax-cpu", f"jax-{args.platform}"):
+            r = acc.get(backend, {})
+            if "configs" not in final and r.get("grid", {}).get("configs"):
+                final["configs"] = r["grid"]["configs"]
+                final["configs_platform"] = r["grid"].get("platform", backend)
+            if "crush_1m" not in final and r.get("crush"):
+                final["crush_1m"] = r["crush"]
+        return final
+
+    def collect(backend: str):
+        def on_result(kind: str, obj: dict) -> None:
+            acc.setdefault(backend, {})[kind] = obj
+            if kind == "headline":
+                line = result_line(obj, cpu, backend)
+                results.append(line)
+                emit(line)
+            else:
+                emit(assemble())  # refresh the last line with grid/crush
+        return on_result
+
+    def combo_done(backend: str) -> bool:
+        """Done = every sub-phase produced actual MEASUREMENTS.  A child
+        that answered with an empty shell (deadline-exhausted grid with
+        no configs, crush with only the platform tag) must count as NOT
+        done so the retry loop re-runs it (r4 review finding)."""
+        r = acc.get(backend, {})
+        return (
+            "combined_gbps" in r.get("headline", {})
+            and bool(r.get("grid", {}).get("configs"))
+            and any(
+                isinstance(v, dict) and "mappings_per_sec" in v
+                for v in r.get("crush", {}).values()
+            )
         )
-        if crush is not None:
-            final["crush_1m"] = crush
-            emit(final)
+
+    if args.platform:
+        backend = f"jax-{args.platform}"
+        remaining = t_end - time.time()
+        run_combo(backend, args.platform, args.batch, quick,
+                  max(30.0, remaining - 10), on_result=collect(backend))
+    else:
+        # VERDICT r3 #1: the TPU phase must be un-losable.  Schedule:
+        # probe TPU -> on answer run the full combo there; on hang fall
+        # back to jax-cpu to SECURE numbers, then keep re-probing the
+        # TPU until the budget runs out (a transient tunnel outage must
+        # not forfeit the round's headline).
+        probe_t = 30.0
+        while True:
+            remaining = t_end - time.time()
+            if remaining < 45 or combo_done("tpu"):
+                break
+            got_tpu = bool(acc.get("tpu", {}).get("headline"))
+            plat = probe_device(None, min(probe_t, remaining - 10))
+            if plat is not None and "cpu" in plat.lower():
+                # the default backend IS cpu (no axon/TPU configured):
+                # re-probing will never find one — run the cpu combo and
+                # stop instead of burning the budget on probes
+                log("default jax backend is CPU; no TPU to wait for")
+                if not acc.get("jax-cpu"):
+                    run_combo("jax-cpu", "cpu", args.batch, quick,
+                              max(40.0, t_end - time.time() - 10),
+                              on_result=collect("jax-cpu"))
+                break
+            if plat is not None:
+                remaining = t_end - time.time()
+                reserve = 0 if acc.get("jax-cpu") else 90
+                tpu_r = acc.get("tpu", {})
+                skip = set()
+                if "combined_gbps" in tpu_r.get("headline", {}):
+                    skip.add("headline")
+                if tpu_r.get("grid", {}).get("configs"):
+                    skip.add("grid")
+                if any(isinstance(v, dict) and "mappings_per_sec" in v
+                       for v in tpu_r.get("crush", {}).values()):
+                    skip.add("crush")
+                run_combo("tpu", None, args.batch, quick,
+                          max(40.0, remaining - reserve - 10), skip=skip,
+                          on_result=collect("tpu"))
+                if combo_done("tpu") or t_end - time.time() < 45:
+                    break
+                continue  # partial TPU answer: re-probe and finish it
+            if not acc.get("jax-cpu") and not got_tpu:
+                remaining = t_end - time.time()
+                # cap so at least 2 more TPU probes fit afterwards, but
+                # never below a usable floor: with ~60s left a quick cpu
+                # headline still beats no accelerator number at all
+                # (r4 review: the uncapped formula went negative)
+                run_combo("jax-cpu", "cpu", args.batch, quick,
+                          max(30.0, min(max(120.0, 0.4 * remaining),
+                                        remaining - 75)),
+                          on_result=collect("jax-cpu"))
+                continue
+            # cpu numbers are in hand; pace the TPU re-probes
+            time.sleep(min(25.0, max(5.0, (t_end - time.time()) * 0.1)))
+
+    emit(assemble())
     log("done")
 
 

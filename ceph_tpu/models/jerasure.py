@@ -11,18 +11,24 @@ profile parsing (k/m/w/packetsize, :75), per-technique construction:
 - ``blaum_roth``     (:243) — m=2 bit-matrix code (w+1 prime)
 - ``liber8tion``     (:254) — m=2, w=8 bit-matrix code
 
-``blaum_roth`` is the real published construction (ring multiplication
-matrices over F2[x]/M_p, Blaum & Roth 1999 — the algorithm behind
-jerasure's technique; NOTE bit/row layout parity with the reference C is
-unverified, since neither the jerasure source nor its corpus is
-available in this tree).  ``liber8tion`` is a capability-equivalent stand-in: the
+``blaum_roth`` and ``liberation`` are the real published constructions
+(ring multiplication matrices over F2[x]/M_p, Blaum & Roth 1999;
+rotation + single-excess-bit matrices, Plank FAST'08) — both are
+PAPER-PINNED: tests/test_paper_pins.py re-derives the bit-matrices with
+independent plain-python ring arithmetic, checks encode end-to-end
+through the packet layout, verifies the minimal-density bound, and
+proves the MDS property for every 2-erasure (the jerasure C itself is
+not available in this tree — submodule not checked out — so byte-level
+pinning against it is impossible here; the math is pinned instead).
+``liber8tion`` is a capability-equivalent stand-in: the
 original's bit-matrices exist only as search-found tables in Plank's
 paper/jerasure C code (w=8 admits no closed form — rotation-based
 minimal-density sets provably fail for rotation pairs differing by 4),
 so it is built as the GF(2^8) companion-power RAID-6 bit-matrix
 (X_j = C^j, MDS by field structure): same geometry (m=2, w=8, k<=8),
-same XOR-schedule execution, same fault tolerance, denser matrix and
-different parity bytes than the reference.
+same XOR-schedule execution, same fault tolerance (MDS verified in
+tests/test_paper_pins.py), denser matrix and different parity bytes
+than the reference.
 """
 
 from __future__ import annotations

@@ -184,16 +184,10 @@ def make_gf_matmul(matrix: np.ndarray, w: int = 8):
             if pallas_inner is None:
                 # probe-compile ONCE on a tiny block: a Mosaic lowering
                 # failure must demote to the XLA engine, not turn a perf
-                # optimization into an I/O failure (review r2 finding)
-                try:
-                    cand = gf_pallas.make_gf_matmul_pallas(matrix, w)
-                    probe = jnp.zeros(
-                        (k, gf_pallas.BLOCK), dtype=jnp.uint32
-                    )
-                    jax.block_until_ready(jax.jit(cand)(probe))
-                    pallas_inner = cand
-                except Exception:
-                    pallas_inner = False
+                # optimization into an I/O failure (review r2 finding;
+                # AOT-compiled so it also works under an outer jit)
+                cand = gf_pallas.make_gf_matmul_pallas(matrix, w)
+                pallas_inner = cand if _probe_compile(cand, k) else False
             if pallas_inner is not False:
                 return _as_u8(pallas_inner(d32))
         return _as_u8(inner(d32))
@@ -218,19 +212,32 @@ def make_xor_parity():
     return fn
 
 
-def make_bitmatrix_matmul(bitmatrix: np.ndarray):
-    """Compile a packet XOR kernel: packets [K, P] uint8 -> out [M, P].
+def _probe_compile(cand, k_rows: int):
+    """AOT-compile ``cand`` on one [k_rows, BLOCK] block; True iff Mosaic
+    accepts it.  Uses jit(...).lower(...).compile() — NOT a traced call —
+    so the probe works identically whether the caller is running eagerly
+    or is itself being traced under an outer jax.jit (review r4: a traced
+    probe either deferred the Mosaic failure past the except or poisoned
+    the cache with a ConcretizationTypeError)."""
+    from . import gf_pallas
 
-    ``bitmatrix`` is a static GF(2) [M, K] matrix (rows select which input
-    packets XOR into each output packet).  This is the whole-packet XOR
-    formulation of cauchy/liberation coding: no per-byte math at all.
-    """
+    try:
+        spec = jax.ShapeDtypeStruct((k_rows, gf_pallas.BLOCK), jnp.uint32)
+        jax.jit(cand).lower(spec).compile()
+        return True
+    except Exception:
+        return False
+
+
+def make_bitmatrix_matmul_u32(bitmatrix: np.ndarray):
+    """XLA whole-packet XOR kernel on u32 lanes: [K, N4] -> [M, N4].
+    The single source of the XLA formulation — the uint8 router below
+    and bench.py's grid both build on it."""
     bm = np.asarray(bitmatrix) != 0
     M, K = bm.shape
 
-    def fn(packets: jax.Array) -> jax.Array:
-        assert packets.shape[0] == K
-        p32 = _as_u32(packets)
+    def fn(p32: jax.Array) -> jax.Array:
+        assert p32.shape[0] == K
         zero = jnp.zeros(p32.shape[1:], dtype=jnp.uint32)
         outs = []
         for i in range(M):
@@ -239,7 +246,45 @@ def make_bitmatrix_matmul(bitmatrix: np.ndarray):
                 if bm[i, j]:
                     acc = acc ^ p32[j]
             outs.append(acc)
-        return _as_u8(jnp.stack(outs))
+        return jnp.stack(outs)
+
+    return fn
+
+
+def make_bitmatrix_matmul(bitmatrix: np.ndarray):
+    """Compile a packet XOR kernel: packets [K, P] uint8 -> out [M, P].
+
+    ``bitmatrix`` is a static GF(2) [M, K] matrix (rows select which input
+    packets XOR into each output packet).  This is the whole-packet XOR
+    formulation of cauchy/liberation coding: no per-byte math at all.
+
+    On TPU with tiling lane counts the fused Pallas engine takes over
+    (each input packet row crosses HBM once instead of once per output —
+    see gf_pallas.make_bitmatrix_matmul_pallas); parity bytes are
+    identical either way.
+    """
+    bm = np.asarray(bitmatrix) != 0
+    M, K = bm.shape
+    xla = make_bitmatrix_matmul_u32(bm)
+    pallas_inner = None  # None = unbuilt, False = Mosaic refused, fn = ok
+
+    def fn(packets: jax.Array) -> jax.Array:
+        nonlocal pallas_inner
+        assert packets.shape[0] == K
+        p32 = _as_u32(packets)
+        from . import gf_pallas
+
+        if (
+            gf_pallas._have_pallas_tpu()
+            and p32.shape[-1] % gf_pallas.BLOCK == 0
+            and pallas_inner is not False
+        ):
+            if pallas_inner is None:
+                cand = gf_pallas.make_bitmatrix_matmul_pallas(bm)
+                pallas_inner = cand if _probe_compile(cand, K) else False
+            if pallas_inner is not False:
+                return _as_u8(pallas_inner(p32))
+        return _as_u8(xla(p32))
 
     return fn
 

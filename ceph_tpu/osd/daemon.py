@@ -2535,6 +2535,30 @@ class OSD(Dispatcher):
                 txn.setattr(cid, oid, DIRTY_KEY, b"1")
                 mutates = True
                 out.append({"rval": 0})
+            elif name == "tier.whiteout":
+                # record "base delete pending" in the pg meta omap, in
+                # the SAME transaction as the cache delete: until the
+                # base delete is confirmed, promote must treat the
+                # object as deleted (advisor r3: an acked delete must
+                # not silently un-delete via re-promotion).  Analog of
+                # the reference's whiteout object flag
+                # (reference:src/osd/PrimaryLogPG.cc CEPH_OSD_OP_DELETE
+                # whiteout path).
+                from .pg_log import meta_oid
+                from .tiering import whiteout_key
+
+                txn.omap_setkeys(
+                    cid, meta_oid(-1), {whiteout_key(msg.oid): b"1"}
+                )
+                mutates = True
+                out.append({"rval": 0})
+            elif name == "tier.clear_whiteout":
+                from .pg_log import meta_oid
+                from .tiering import whiteout_key
+
+                txn.omap_rmkeys(cid, meta_oid(-1), [whiteout_key(msg.oid)])
+                mutates = True
+                out.append({"rval": 0})
             elif name == "rmxattr":
                 if not self.store.exists(cid, oid):
                     out.append({"rval": -ENOENT})
@@ -2668,6 +2692,50 @@ class OSD(Dispatcher):
                         pgid=str(pg), tid=tid, from_osd=self.osd_id,
                         txn=ops, log=[entry.to_dict()],
                         at_version=entry.version.to_list(),
+                        epoch=self._epoch(), blobs=blobs,
+                    )
+                )
+            async with asyncio.timeout(self.subop_timeout):
+                await waiter.event.wait()
+        except TimeoutError:
+            return -EIO
+        finally:
+            del self._write_waiters[tid]
+        if any(r != 0 for r in waiter.results.values()):
+            return -EIO
+        return 0
+
+    async def _meta_rep_commit(
+        self, pg: PGid, acting: list[int], txn: Transaction
+    ) -> int:
+        """Replicate a PG-metadata-only transaction (no pg_log entry, no
+        object version): used for bookkeeping that must survive primary
+        failover but describes no object mutation — e.g. clearing a
+        cache-tier whiteout once the base delete is confirmed.  Caller
+        holds no object-level ordering requirement."""
+        replicas = [o for o in acting if o != CRUSH_ITEM_NONE]
+        tid = self._new_tid()
+        waiter = _Waiter(set(replicas), {o: o for o in replicas})
+        self._write_waiters[tid] = waiter
+        ops, blobs = messages.encode_txn(txn)
+        try:
+            for osd in replicas:
+                if osd == self.osd_id:
+                    waiter.complete(
+                        osd, self._apply_sub_write(txn, str(pg), -1, [])
+                    )
+                    continue
+                try:
+                    conn = await self.messenger.connect(
+                        self.osdmap.get_addr(osd), f"osd.{osd}"
+                    )
+                except (ConnectionError, OSError):
+                    waiter.complete(osd, -EIO)
+                    continue
+                conn.send(
+                    messages.MOSDRepOp(
+                        pgid=str(pg), tid=tid, from_osd=self.osd_id,
+                        txn=ops, log=[], at_version=[0, 0],
                         epoch=self._epoch(), blobs=blobs,
                     )
                 )

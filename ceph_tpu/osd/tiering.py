@@ -46,6 +46,20 @@ logger = logging.getLogger("ceph_tpu.osd.tiering")
 
 # raw (non-user) xattr marking a cache object as not-yet-flushed
 DIRTY_KEY = "_tier_dirty_"
+
+# pg-meta omap key prefix recording "client delete acked, base delete
+# pending" (the reference's whiteout).  The oid is hex-encoded so the key
+# can never contain "." — every "."-keyed entry in the pgmeta omap is
+# parsed as a pg_log record (ceph_tpu/osd/pg_log.py read_log).
+_WHITEOUT_PREFIX = "tierwh/"
+
+
+def whiteout_key(oid: str) -> str:
+    return _WHITEOUT_PREFIX + oid.encode().hex()
+
+
+def _whiteout_oid(key: str) -> str:
+    return bytes.fromhex(key[len(_WHITEOUT_PREFIX):]).decode()
 # ops that need the object's EXISTING state: a miss must promote first.
 # This is everything except "delete" — even writefull and setxattr keep
 # rados semantics only relative to prior state (xattrs survive
@@ -151,25 +165,84 @@ class TieringService:
         osd = self.osd
         cid = CollectionId(str(pg))
         missing = not osd.store.exists(cid, ObjectId(msg.oid))
-        if missing and any(n not in _NEED_STATE_EXEMPT for n in names):
+        whiteouted = missing and self._has_whiteout(cid, msg.oid)
+        if (
+            missing and not whiteouted
+            and any(n not in _NEED_STATE_EXEMPT for n in names)
+        ):
+            # a pending whiteout means the object was deleted here but
+            # the base copy may still exist: promoting it would
+            # resurrect an acked delete (advisor r3 finding)
             await self._promote(pg, pool, acting, msg.oid)
         if any(n in _WRITE_OPS for n in names) and "delete" not in names:
             # same-batch dirty marking: the rep engine executes the
             # injected op inside the SAME transaction as the mutation
             msg.ops = list(msg.ops) + [{"op": "tier.dirty"}]
+            if whiteouted:
+                # the client recreates a deleted object: the new data
+                # supersedes the pending base delete (a later flush
+                # overwrites the stale base copy), so drop the whiteout
+                # atomically with the creating write
+                msg.ops = list(msg.ops) + [{"op": "tier.clear_whiteout"}]
+        elif "delete" in names:
+            # record the pending base delete IN the delete transaction:
+            # if propagation to the base fails below, the whiteout (not
+            # a re-promotion) defines what a later miss sees
+            msg.ops = list(msg.ops) + [{"op": "tier.whiteout"}]
+
+    def _has_whiteout(self, cid: CollectionId, oid: str) -> bool:
+        from .pg_log import meta_oid
+
+        try:
+            omap = self.osd.store.omap_get(cid, meta_oid(-1))
+        except KeyError:
+            return False
+        return whiteout_key(oid) in omap
+
+    def _pending_whiteouts(self, cid: CollectionId) -> list[str]:
+        from .pg_log import meta_oid
+
+        try:
+            omap = self.osd.store.omap_get(cid, meta_oid(-1))
+        except KeyError:
+            return []
+        return [
+            _whiteout_oid(k) for k in omap if k.startswith(_WHITEOUT_PREFIX)
+        ]
+
+    async def _clear_whiteout(self, pg, acting, oid: str) -> None:
+        from .pg_log import meta_oid
+
+        cid = CollectionId(str(pg))
+        txn = Transaction().omap_rmkeys(
+            cid, meta_oid(-1), [whiteout_key(oid)]
+        )
+        r = await self.osd._meta_rep_commit(pg, acting, txn)
+        if r != 0:
+            logger.warning(
+                "%s: clearing whiteout for %s failed: %s",
+                self.osd.name, oid, r,
+            )
 
     async def finish(self, pg, pool, acting, msg, result: int) -> None:
-        """Post-op: propagate a successful client delete to the base."""
+        """Post-op: propagate a successful client delete to the base.
+
+        The whiteout recorded in the delete transaction (prepare) stays
+        until the base confirms; on failure the agent loop retries —
+        never losing an acked delete (advisor r3 finding)."""
         if result != 0 or "delete" not in [o.get("op") for o in msg.ops]:
             return
         base = self.osd.osdmap.pools.get(pool.tier_of)
         if base is None:
             return
         reply = await self._pool_op(base.id, msg.oid, [{"op": "delete"}], [])
-        if reply is not None and reply.result not in (0, -2):  # ENOENT ok
+        if reply is not None and reply.result in (0, -2):  # ENOENT ok
+            await self._clear_whiteout(pg, acting, msg.oid)
+        else:
             logger.warning(
-                "%s: tier delete of %s in base %s failed: %s",
-                self.osd.name, msg.oid, base.name, reply.result,
+                "%s: tier delete of %s in base %s failed (%s); whiteout "
+                "kept, agent will retry", self.osd.name, msg.oid,
+                base.name, None if reply is None else reply.result,
             )
 
     async def _promote(self, pg, pool, acting, oid: str) -> None:
@@ -333,6 +406,18 @@ class TieringService:
         from . import snaps as snaps_mod
         from .pg_log import is_stash_name
 
+        # retry pending base deletes (whiteouts) before anything else:
+        # while one is pending, a miss on that oid must not re-promote
+        for w_oid in self._pending_whiteouts(cid):
+            if osd.store.exists(cid, ObjectId(w_oid)):
+                # object was recreated; whiteout is stale (clear should
+                # have ridden the write — sweep it here regardless)
+                await self._clear_whiteout(pg, acting, w_oid)
+                continue
+            reply = await self._pool_op(base.id, w_oid, [{"op": "delete"}], [])
+            if reply is not None and reply.result in (0, -2):
+                await self._clear_whiteout(pg, acting, w_oid)
+
         now = time.monotonic()
         tr = self.tracker(pg, pool)
         objects = []
@@ -440,12 +525,33 @@ class TieringService:
         ops = [{"op": "writefull", "data": 0}]
         blobs = [data]
         plen = len(osd.USER_XATTR_PREFIX)
+        cache_keys = set()
         for k, v in attrs.items():
             if k.startswith(osd.USER_XATTR_PREFIX):
+                cache_keys.add(k[plen:])
                 ops.append(
                     {"op": "setxattr", "key": k[plen:], "data": len(blobs)}
                 )
                 blobs.append(bytes(v))
+        # xattrs REMOVED on the cache copy must not survive on the base
+        # (advisor r3: flush->evict->re-promote resurrected them): fetch
+        # the base's current keys and ride rmxattr for the stale ones in
+        # the same (atomic) mutating batch as the writefull.  A FAILED
+        # probe aborts the flush (object stays dirty, agent retries):
+        # proceeding without the rmxattr set would mark the object clean
+        # while a stale key survives — the very bug this closes (r4
+        # review finding)
+        probe = await self._pool_op(base.id, o.name, [{"op": "getxattrs"}], [])
+        if probe is None or probe.result not in (0, -2):  # ENOENT: no base copy
+            logger.warning(
+                "%s: flush of %s deferred: base xattr probe failed (%s)",
+                osd.name, o.name, None if probe is None else probe.result,
+            )
+            return
+        if probe.result == 0:
+            base_keys = set(probe.out[0].get("attrs", {}))
+            for stale in sorted(base_keys - cache_keys):
+                ops.append({"op": "rmxattr", "key": stale})
         if base_omap:
             ops.append({"op": "omap_clear"})
             if omap:

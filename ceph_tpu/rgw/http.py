@@ -55,31 +55,47 @@ _SIGNED_SUBRESOURCES = ("uploads", "uploadId", "partNumber")
 
 
 def string_to_sign(method: str, target: str, headers: dict) -> str:
-    """AWS signature-v2 StringToSign for this gateway's API subset.
+    """AWS signature-v2 StringToSign, canonicalized the way real S3 v2
+    clients compute it (advisor r3: query-string-order subresources and
+    ignored x-amz-* headers broke interop with standard signers):
 
-    method, content-md5, content-type, date (x-amz-date wins), then the
-    canonical resource: the decoded path plus any signed subresources in
-    query-string order (reference:src/rgw/rgw_auth_s3.h canonical header).
+    method, content-md5, content-type, date (empty when x-amz-date is
+    present — the amz header then rides in the canonical-headers block),
+    lowercased x-amz-* headers sorted and folded ``key:value\\n``, then
+    the canonical resource: the decoded path plus signed subresources
+    sorted lexicographically (reference:src/rgw/rgw_auth_s3.cc
+    rgw_create_s3_canonical_header).
     """
     parts = urlsplit(target)
     resource = unquote(parts.path) or "/"
-    sub = [
+    sub = sorted(
         p for p in parts.query.split("&")
         if p and p.split("=", 1)[0] in _SIGNED_SUBRESOURCES
-    ]
+    )
     if sub:
         resource += "?" + "&".join(sub)
     # header keys are case-insensitive on the wire; the server lowercases
     # them on receipt, so the client side must sign over the same view
-    h = {k.lower(): v for k, v in headers.items()}
-    date = h.get("x-amz-date") or h.get("date", "")
+    h = {k.lower(): v.strip() if isinstance(v, str) else v
+         for k, v in headers.items()}
+    amz = sorted(
+        (k, v) for k, v in h.items()
+        if k.startswith("x-amz-") and k != "x-amz-date"
+    )
+    if "x-amz-date" in h:
+        # per the v2 spec the Date line is empty and x-amz-date is folded
+        # with the other amz headers
+        date = ""
+        amz = sorted(amz + [("x-amz-date", h["x-amz-date"])])
+    else:
+        date = h.get("date", "")
+    amz_block = "".join(f"{k}:{v}\n" for k, v in amz)
     return "\n".join([
         method.upper(),
         h.get("content-md5", ""),
         h.get("content-type", ""),
         date,
-        resource,
-    ])
+    ]) + "\n" + amz_block + resource
 
 
 def sign_request(secret_key: str, method: str, target: str,

@@ -162,7 +162,15 @@ class _Onode:
 
 
 def _okey(cid: CollectionId, oid: ObjectId) -> str:
-    return f"{cid.pg}{_SEP}{oid.name}{_SEP}{oid.shard}"
+    """Onode KV key.  The name is escaped so a client-controlled object
+    name containing the separator cannot collide with another key or
+    break the split in list_objects (advisor r3 finding)."""
+    name = oid.name.replace("%", "%25").replace(_SEP, "%1F")
+    return f"{cid.pg}{_SEP}{name}{_SEP}{oid.shard}"
+
+
+def _okey_name(escaped: str) -> str:
+    return escaped.replace("%1F", _SEP).replace("%25", "%")
 
 
 class BlueStore(ObjectStore):
@@ -632,7 +640,7 @@ class BlueStore(ObjectStore):
             for key in self._onodes:
                 c, name, shard = key.split(_SEP)
                 if c == cid.pg:
-                    out.append(ObjectId(name, int(shard)))
+                    out.append(ObjectId(_okey_name(name), int(shard)))
             return sorted(out, key=lambda o: (o.name, o.shard))
 
     # -- fsck (BlueStore fsck analog) ----------------------------------------

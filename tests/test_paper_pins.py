@@ -1,0 +1,219 @@
+"""Paper-pinned verification of the bit-matrix code family (VERDICT r3
+Missing #2 / Next #4).
+
+The jerasure C source is not in the reference tree (the submodule is not
+checked out) so the jerasure family cannot be byte-pinned the way the
+ISA family is (tests/test_isa_oracle.py compiles the vendored ec_base.c
+in place).  What CAN be pinned is the published mathematics: liberation
+(Plank, "The RAID-6 Liberation Codes", FAST'08) and blaum_roth (Blaum &
+Roth, "On Lowest-Density MDS Codes", IEEE Trans. IT 1999) are
+closed-form constructions.  This file re-derives both with INDEPENDENT
+implementations — plain-python polynomial/ring arithmetic sharing no
+code with ceph_tpu.models.jerasure — and checks:
+
+- the generated bit-matrices are identical entry-for-entry,
+- encode via the codec (packet layout included) equals encode computed
+  directly from the ring algebra,
+- the MDS property holds for every 2-erasure pattern,
+- liberation meets the minimal-density bound (kw + k - 1 ones in Q).
+
+liber8tion stays a documented capability stand-in: its matrix is
+search-found tabulated data (Plank, "The RAID-6 Liber8tion Code", 2009)
+that exists only in the paper/jerasure source, neither available here.
+Its MDS property is still verified below.
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.models.jerasure import (
+    JerasureCodec,
+    blaum_roth_bitmatrix,
+    liberation_bitmatrix,
+)
+
+# ---------------------------------------------------------------------------
+# independent ring algebra: polynomials over F2 as python ints (bit i = x^i)
+
+
+def _poly_mulx_mod_Mp(a: int, w: int) -> int:
+    """a * x in R_p = F2[x]/M_p(x), M_p = 1 + x + ... + x^w (p = w+1)."""
+    a <<= 1
+    if a >> w & 1:  # x^w = 1 + x + ... + x^{w-1}
+        a ^= (1 << (w + 1)) - 1  # clears bit w, flips bits 0..w-1
+    return a & ((1 << w) - 1)
+
+
+def _poly_mul_xj(a: int, j: int, w: int) -> int:
+    for _ in range(j):
+        a = _poly_mulx_mod_Mp(a, w)
+    return a
+
+
+def _rotate_poly(a: int, j: int, w: int) -> int:
+    """a * x^j in F2[x]/(x^w - 1) — cyclic rotation (liberation's ring)."""
+    j %= w
+    return ((a << j) | (a >> (w - j))) & ((1 << w) - 1)
+
+
+def _apply_bitmatrix(bm: np.ndarray, bits: list[int], w: int) -> list[int]:
+    """bits: one int per data device (bit i = packet/row i).  Returns one
+    int per output row block... here per coding device (w rows each)."""
+    rows, cols = bm.shape
+    k = cols // w
+    out = []
+    for dev in range(rows // w):
+        acc = 0
+        for r in range(w):
+            bit = 0
+            for j in range(k):
+                for c in range(w):
+                    if bm[dev * w + r, j * w + c]:
+                        bit ^= (bits[j] >> c) & 1
+            acc |= bit << r
+        out.append(acc)
+    return out
+
+
+def _mds_all_pairs(bm: np.ndarray, k: int, w: int) -> None:
+    """Every 2-erasure of [I; BM] must be recoverable: the remaining
+    k*w rows of the (k+2)w x kw GF(2) generator have full rank."""
+    gen = np.vstack([np.eye(k * w, dtype=np.uint8), np.asarray(bm)])
+    blocks = [gen[d * w:(d + 1) * w] for d in range(k + 2)]
+    for a in range(k + 2):
+        for b in range(a + 1, k + 2):
+            rows = np.vstack(
+                [blocks[d] for d in range(k + 2) if d not in (a, b)]
+            ).astype(np.uint8)
+            # GF(2) rank by elimination
+            m = rows.copy()
+            rank = 0
+            for col in range(k * w):
+                piv = None
+                for r in range(rank, m.shape[0]):
+                    if m[r, col]:
+                        piv = r
+                        break
+                if piv is None:
+                    continue
+                m[[rank, piv]] = m[[piv, rank]]
+                for r in range(m.shape[0]):
+                    if r != rank and m[r, col]:
+                        m[r] ^= m[rank]
+                rank += 1
+            assert rank == k * w, f"erasing devices {(a, b)} not recoverable"
+
+
+# ---------------------------------------------------------------------------
+
+
+class TestBlaumRothPaperPin:
+    @pytest.mark.parametrize("k,w", [(4, 4), (6, 6), (10, 10), (4, 12)])
+    def test_bitmatrix_equals_ring_construction(self, k, w):
+        """Q block for device j must be multiplication-by-x^j over the
+        basis {1..x^{w-1}} of R_p — rebuilt here by applying x^j to each
+        basis vector with independent int arithmetic."""
+        bm = blaum_roth_bitmatrix(k, w)
+        for j in range(k):
+            P = bm[:w, j * w:(j + 1) * w]
+            assert np.array_equal(P, np.eye(w, dtype=np.uint8))
+            Q = bm[w:, j * w:(j + 1) * w]
+            for c in range(w):  # image of basis vector x^c
+                img = _poly_mul_xj(1 << c, j, w)
+                col = sum((int(Q[r, c]) & 1) << r for r in range(w))
+                assert col == img, (j, c, bin(col), bin(img))
+
+    @pytest.mark.parametrize("k,w", [(4, 4), (6, 6)])
+    def test_encode_matches_ring_algebra_through_codec(self, k, w):
+        """P = sum D_j, Q = sum x^j D_j computed with the independent
+        ring — through the codec's real packet layout."""
+        codec = JerasureCodec.create({
+            "technique": "blaum_roth", "k": str(k), "m": "2",
+            "w": str(w), "packetsize": "4",
+        })
+        rng = np.random.default_rng(5)
+        data = rng.integers(
+            0, 256, size=(k, w * codec.packetsize), dtype=np.uint8
+        )
+        out = codec.encode_chunks(data)
+        # per byte-column b of each packet: device bits across rows
+        ps = codec.packetsize
+        for byte_idx in range(0, ps, 3):
+            for bit in range(8):
+                bits = []
+                for j in range(k):
+                    v = 0
+                    for r in range(w):
+                        v |= (
+                            (int(data[j, r * ps + byte_idx]) >> bit) & 1
+                        ) << r
+                    bits.append(v)
+                p = 0
+                q = 0
+                for j, d in enumerate(bits):
+                    p ^= d
+                    q ^= _poly_mul_xj(d, j, w)
+                got_p = sum(
+                    ((int(out[0, r * ps + byte_idx]) >> bit) & 1) << r
+                    for r in range(w)
+                )
+                got_q = sum(
+                    ((int(out[1, r * ps + byte_idx]) >> bit) & 1) << r
+                    for r in range(w)
+                )
+                assert got_p == p and got_q == q
+
+    @pytest.mark.parametrize("k,w", [(4, 4), (6, 6), (6, 10)])
+    def test_mds_all_pairs(self, k, w):
+        _mds_all_pairs(blaum_roth_bitmatrix(k, w), k, w)
+
+
+class TestLiberationPaperPin:
+    @pytest.mark.parametrize("k,w", [(5, 5), (7, 7), (3, 7), (11, 11)])
+    def test_bitmatrix_equals_independent_formula(self, k, w):
+        """Q_j maps basis vector e_c to e_{(c-j) mod w} (the inverse
+        cyclic rotation: as a bit-matrix, a one at (i, (i+j) mod w) per
+        row i) plus, for j>0, one extra bit at row i = j(w-1)/2 mod w,
+        col (i+j-1) mod w — rebuilt with independent rotation
+        arithmetic.  Note the convention: rotating the ROWS by j equals
+        multiplying coefficient vectors by x^{-j}; either orientation
+        yields a minimal-density MDS code (the transpose symmetry), the
+        pinned one is this module's documented layout."""
+        bm = liberation_bitmatrix(k, w)
+        for j in range(k):
+            P = bm[:w, j * w:(j + 1) * w]
+            assert np.array_equal(P, np.eye(w, dtype=np.uint8))
+            Q = np.zeros((w, w), dtype=np.uint8)
+            for c in range(w):
+                img = _rotate_poly(1 << c, -j % w, w)  # e_c -> e_{c-j}
+                for r in range(w):
+                    Q[r, c] = (img >> r) & 1
+            if j > 0:
+                i = (j * ((w - 1) // 2)) % w
+                Q[i, (i + j - 1) % w] ^= 1
+            assert np.array_equal(bm[w:, j * w:(j + 1) * w], Q), j
+
+    @pytest.mark.parametrize("k,w", [(5, 5), (7, 7), (5, 11)])
+    def test_minimal_density_bound(self, k, w):
+        """Plank FAST'08: the Q half of a minimal-density RAID-6 code
+        for prime w carries exactly kw + k - 1 ones."""
+        bm = liberation_bitmatrix(k, w)
+        assert int(bm[w:].sum()) == k * w + k - 1
+
+    @pytest.mark.parametrize("k,w", [(5, 5), (7, 7), (4, 11)])
+    def test_mds_all_pairs(self, k, w):
+        _mds_all_pairs(liberation_bitmatrix(k, w), k, w)
+
+
+class TestLiber8tionStandIn:
+    @pytest.mark.parametrize("k", [4, 6, 8])
+    def test_mds_all_pairs(self, k):
+        """The stand-in must still be a real RAID-6 code: every double
+        failure recoverable (bytes differ from jerasure by design —
+        see models/jerasure.py docstring)."""
+        codec = JerasureCodec.create({
+            "technique": "liber8tion", "k": str(k), "m": "2",
+            "packetsize": "4",
+        })
+        bm = np.asarray(codec.bitmatrix)
+        _mds_all_pairs(bm[8:] if bm.shape[0] == (k + 2) * 8 else bm, k, 8)

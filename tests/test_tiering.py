@@ -216,6 +216,94 @@ class TestWriteback:
 
         run(main())
 
+    def test_flush_removes_stale_base_xattrs(self):
+        """An xattr deleted on the cache copy must not resurrect from
+        the base after flush -> evict -> re-promote (advisor r3)."""
+
+        async def main():
+            async with MiniCluster(n_osds=4) as cluster:
+                cl = await cluster.client()
+                await _tiered(cl, base_type="replicated")
+                io = cl.io_ctx("base")
+                await io.write_full("obj", b"payload")
+                await io.setxattr("obj", "keep", b"k")
+                await io.setxattr("obj", "drop", b"d")
+                await _agent_pass_all(cluster)  # flush both to base
+                await io.rmxattr("obj", "drop")  # re-dirties the cache copy
+                await _agent_pass_all(cluster)  # flush must rm it on base
+                bosd, bcid, boid = _primary_store(cluster, cl, "base", "obj")
+                battrs = bosd.store.getattrs(bcid, boid)
+                user = {
+                    k for k in battrs
+                    if k.startswith(bosd.USER_XATTR_PREFIX)
+                }
+                assert bosd.USER_XATTR_PREFIX + "keep" in user
+                assert bosd.USER_XATTR_PREFIX + "drop" not in user, (
+                    "deleted xattr survived the flush on the base copy"
+                )
+                # evict the (clean) cache copy and re-promote via read
+                cosd, ccid, coid = _primary_store(cluster, cl, "cache", "obj")
+                pool = cl.osdmap.lookup_pool("cache")
+                pg, acting, _p = cl.osdmap.object_to_acting("obj", pool.id)
+                await cosd.tiering._evict_object(
+                    pg, pool, acting, ccid, ObjectId("obj")
+                )
+                assert await io.read("obj") == b"payload"
+                xs = await io.getxattrs("obj")
+                assert xs == {"keep": b"k"}, xs
+
+        run(main())
+
+    def test_failed_base_delete_keeps_whiteout_no_resurrect(self):
+        """If propagating an acked delete to the base fails, the object
+        must stay deleted (whiteout blocks re-promotion) and the agent
+        must finish the base delete later (advisor r3)."""
+
+        async def main():
+            async with MiniCluster(n_osds=4) as cluster:
+                cl = await cluster.client()
+                await _tiered(cl, base_type="replicated")
+                io = cl.io_ctx("base")
+                await io.write_full("doomed", b"data")
+                await _agent_pass_all(cluster)  # flushed to base
+                bosd, bcid, boid = _primary_store(
+                    cluster, cl, "base", "doomed"
+                )
+                assert bosd.store.exists(bcid, boid)
+                # break delete propagation on every cache primary
+                originals = {}
+                for osd in cluster.osds.values():
+                    orig = osd.tiering._pool_op
+                    originals[osd.osd_id] = orig
+
+                    async def failing(pool_id, oid, ops, blobs, *a,
+                                      _orig=orig, **kw):
+                        if any(o.get("op") == "delete" for o in ops):
+                            return None  # base unreachable
+                        return await _orig(pool_id, oid, ops, blobs, *a, **kw)
+
+                    osd.tiering._pool_op = failing
+                await io.remove("doomed")  # acked despite base failure
+                # base copy still there, but the client must see ENOENT
+                assert bosd.store.exists(bcid, boid)
+                with pytest.raises(Exception):
+                    await io.read("doomed")  # must NOT re-promote
+                # heal the base path; the agent retries the delete
+                for osd in cluster.osds.values():
+                    osd.tiering._pool_op = originals[osd.osd_id]
+                await _agent_pass_all(cluster)
+                async with asyncio.timeout(10):
+                    while bosd.store.exists(bcid, boid):
+                        await asyncio.sleep(0.05)
+                        await _agent_pass_all(cluster)
+                with pytest.raises(Exception):
+                    await io.read("doomed")
+                # whiteouts are cleaned up once confirmed
+                cosd, ccid, _ = _primary_store(cluster, cl, "cache", "doomed")
+                assert cosd.tiering._pending_whiteouts(ccid) == []
+
+        run(main())
+
     def test_evict_cold_objects_and_repromote(self):
         async def main():
             async with MiniCluster(n_osds=4) as cluster:
