@@ -62,6 +62,16 @@ OPTIONS: dict[str, Option] = _opts(
     Option("ms_dispatch_throttle_bytes", int, 0,
            "in-flight inbound byte budget per messenger (0 = off; "
            "reference default 100MB)"),
+    Option("osd_subop_retries", int, 2,
+           "re-send rounds for sub-ops lost to transient socket "
+           "failures before the op fails (sub-writes are idempotent; "
+           "the reference recovers the same way via messenger "
+           "reconnect/replay)"),
+    Option("ms_inject_socket_failures", int, 0,
+           "fault injection: sever a connection once per ~N socket "
+           "operations, mid-frame when sending (0 = off; the "
+           "reference's ms_inject_socket_failures, "
+           "config_opts.h:209)"),
     # osd: liveness
     Option("osd_heartbeat_interval", float, 0.0,
            "peer ping period (s); 0 disables (reference default 6)"),

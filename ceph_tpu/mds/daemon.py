@@ -182,9 +182,15 @@ class MDSDaemon(Dispatcher):
     async def ms_dispatch(self, conn: Connection, msg: Message) -> None:
         if isinstance(msg, messages.MOSDMapMsg):
             if self.osdmap is None or msg.epoch > self.osdmap.epoch:
-                from ..osd.osdmap import OSDMap
+                from ..osd.osdmap import advance_map
 
-                self.osdmap = OSDMap.from_dict(msg.osdmap)
+                m = advance_map(
+                    self.osdmap, msg.epoch, msg.osdmap, msg.incrementals
+                )
+                if m is None:
+                    conn.send(messages.MMonGetMap(have=None))
+                    return
+                self.osdmap = m
                 is_me = self.osdmap.mds_name == self.name
                 if is_me and not self.active:
                     logger.info("%s: now the ACTIVE mds", self.name)

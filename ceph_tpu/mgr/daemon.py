@@ -135,7 +135,15 @@ class MgrDaemon(Dispatcher):
     async def ms_dispatch(self, conn: Connection, msg: Message) -> None:
         if isinstance(msg, messages.MOSDMapMsg):
             if self.osdmap is None or msg.epoch > self.osdmap.epoch:
-                self.osdmap = OSDMap.from_dict(msg.osdmap)
+                from ..osd.osdmap import advance_map
+
+                m = advance_map(
+                    self.osdmap, msg.epoch, msg.osdmap, msg.incrementals
+                )
+                if m is None:
+                    conn.send(messages.MMonGetMap(have=None))
+                    return
+                self.osdmap = m
                 was = self.active
                 self.active = self.osdmap.mgr_name == self.name
                 if self.active and not was:

@@ -48,7 +48,7 @@ from ..crush.map import CrushMap
 from ..models import registry
 from ..msg import AsyncMessenger, Connection, Dispatcher, messages
 from ..msg.message import Message
-from ..osd.osdmap import OSDMap, POOL_TYPE_REPLICATED
+from ..osd.osdmap import Incremental, OSDMap, POOL_TYPE_REPLICATED
 
 logger = logging.getLogger("ceph_tpu.mon")
 
@@ -58,6 +58,8 @@ EEXIST = 17
 EAGAIN = 11
 
 MON_REPORTER_BASE = 1_000_000  # synthetic reporter ids for forwarding mons
+
+INC_CACHE_EPOCHS = 500  # in-memory delta window for subscriber catch-up
 
 DEFAULT_EC_PROFILE = {
     # reference:src/common/config_opts.h:677 osd_pool_default_erasure_code_profile
@@ -113,6 +115,12 @@ class Monitor(Dispatcher):
         self.osdmap.epoch = 1
         self.osdmap.set_erasure_code_profile("default", DEFAULT_EC_PROFILE)
         self._subs: set[Connection] = set()
+        # epoch deltas (reference:src/osd/OSDMap.h:111 Incremental):
+        # epoch -> wire dict, kept for INC_CACHE_EPOCHS so subscriber
+        # pushes and catch-up ranges cost O(churn) instead of O(map)
+        self._inc_cache: dict[int, dict] = {}
+        self._last_map_dict: dict | None = self.osdmap.to_dict()
+        self._sub_epochs: dict[Connection, int] = {}  # last epoch sent
         self._boot_conns: dict[int, Connection] = {}  # osd id -> its conn
         self._failure_reports: dict[int, set[int]] = {}  # target -> reporters
         self.addr = ""
@@ -242,12 +250,12 @@ class Monitor(Dispatcher):
 
     # -- persistence (MonitorDBStore-lite) -----------------------------------
 
-    def _save_store(self) -> None:
+    def _save_store(self, inc: dict | None = None) -> None:
         if self._db_store is None:
             return
         self._db_store.save(
             self.osdmap.to_dict(), self.election_epoch,
-            self.map_committed_epoch,
+            self.map_committed_epoch, inc=inc,
         )
 
     def _load_store(self) -> None:
@@ -257,6 +265,17 @@ class Monitor(Dispatcher):
         if data is None:
             return
         self.osdmap = OSDMap.from_dict(data)
+        self._last_map_dict = data
+        # re-arm the in-memory delta cache from the stored chain so
+        # subscriber catch-up stays O(churn) across a mon restart (r4
+        # review: a fresh cache made every post-restart push a full
+        # map).  Walk backwards until the stored chain ends.
+        epoch = int(data["epoch"])
+        for e in range(epoch, max(0, epoch - INC_CACHE_EPOCHS), -1):
+            chain = self._db_store.get_incrementals(e - 1, e)
+            if not chain:
+                break
+            self._inc_cache[e] = chain[0]
         self.election_epoch = self._db_store.election_epoch()
         self.map_committed_epoch = self._db_store.committed_epoch()
         acc = self._db_store.accepted()
@@ -289,8 +308,15 @@ class Monitor(Dispatcher):
             _bg(self._handle_failure(msg))
         elif isinstance(msg, messages.MMonGetMap):
             self._subs.add(conn)
-            if msg.have is None or msg.have < self.osdmap.epoch:
+            if msg.have is None:
+                # explicit full-map request (bootstrap or a receiver that
+                # could not bridge a delta chain): never answer with incs
+                self._sub_epochs.pop(conn, None)
                 self._send_map(conn)
+            elif msg.have < self.osdmap.epoch:
+                self._send_map(conn, have=msg.have)
+            else:
+                self._sub_epochs[conn] = msg.have
         elif isinstance(msg, messages.MOSDMapMsg):
             # a newer committed map from the leader (peon catch-up).
             # Stamp the SENDER's commit epoch — stamping our own
@@ -298,7 +324,17 @@ class Monitor(Dispatcher):
             # the quorum's) would let this map out-rank genuinely newer
             # commits in a later recovery (review r3 finding)
             if msg.epoch > self.osdmap.epoch:
-                self.osdmap = OSDMap.from_dict(msg.osdmap)
+                from ..osd.osdmap import advance_map
+
+                m = advance_map(
+                    self.osdmap, msg.epoch, msg.osdmap, msg.incrementals
+                )
+                if m is None:
+                    # delta chain does not reach us: ask for the full map
+                    conn.send(messages.MMonGetMap(have=None))
+                    return
+                self.osdmap = m
+                self._last_map_dict = self.osdmap.to_dict()
                 if msg.committed_epoch is not None:
                     self.map_committed_epoch = msg.committed_epoch
                 self._save_store()
@@ -328,6 +364,7 @@ class Monitor(Dispatcher):
 
     def ms_handle_reset(self, conn: Connection) -> None:
         self._subs.discard(conn)
+        self._sub_epochs.pop(conn, None)
         for osd, c in list(self._boot_conns.items()):
             if c is conn:
                 del self._boot_conns[osd]
@@ -429,7 +466,7 @@ class Monitor(Dispatcher):
         for ack in acks.values():
             ce = ack.committed_epoch or 0
             if ack.osdmap and (ce, ack.map_epoch) > committed:
-                self.osdmap = OSDMap.from_dict(ack.osdmap)
+                self._adopt_map(ack.osdmap)
                 self.map_committed_epoch = ce
                 committed = (ce, ack.map_epoch)
         best = self._accepted_register()
@@ -450,7 +487,7 @@ class Monitor(Dispatcher):
                 "election epoch %d (dead leader's in-flight commit)",
                 self.name, best["version"], best["epoch"],
             )
-            self.osdmap = OSDMap.from_dict(best["value"])
+            self._adopt_map(best["value"])
         self._pending_commit.clear()
         self._sync_accepted()
         # whatever we now hold is chosen at THIS election's epoch: the
@@ -562,7 +599,7 @@ class Monitor(Dispatcher):
                 self._pending_commit.clear()
                 self._sync_accepted()
                 if msg.map_epoch > self.osdmap.epoch and msg.osdmap:
-                    self.osdmap = OSDMap.from_dict(msg.osdmap)
+                    self._adopt_map(msg.osdmap)
                     self.map_committed_epoch = msg.epoch
                     self._save_store()
                     self._publish_subs()
@@ -702,8 +739,11 @@ class Monitor(Dispatcher):
             if entry is not None and msg.version > self.osdmap.epoch:
                 _epoch, value = entry
                 self.osdmap = OSDMap.from_dict(value)
+                # consecutive commit: _record_inc keeps the peon's delta
+                # chain alive so ITS subscribers also get O(churn) pushes
+                inc = self._record_inc(value)
                 self.map_committed_epoch = msg.epoch
-                self._save_store()
+                self._save_store(inc=inc)
                 self._publish_subs()
 
     def _valid_osd_id(self, osd) -> bool:
@@ -763,6 +803,41 @@ class Monitor(Dispatcher):
                 await self._publish()
 
     # -- map distribution / replication
+
+    def _record_inc(self, new_dict: dict) -> dict | None:
+        """Diff the committed map against its predecessor; cache and
+        return the delta (None when continuity is unknown — e.g. right
+        after adopting a foreign map)."""
+        inc = None
+        prev = self._last_map_dict
+        if prev is not None and int(prev["epoch"]) == int(new_dict["epoch"]) - 1:
+            inc = Incremental.diff(prev, new_dict).to_dict()
+            self._inc_cache[int(new_dict["epoch"])] = inc
+            floor = int(new_dict["epoch"]) - INC_CACHE_EPOCHS
+            for e in [e for e in self._inc_cache if e <= floor]:
+                del self._inc_cache[e]
+        self._last_map_dict = new_dict
+        return inc
+
+    def _adopt_map(self, map_dict: dict) -> None:
+        """Replace the map wholesale (election recovery / peer catch-up):
+        delta continuity restarts from here."""
+        self.osdmap = OSDMap.from_dict(map_dict)
+        self._last_map_dict = map_dict
+
+    def _collect_incs(self, base: int, cur: int) -> list[dict] | None:
+        """Contiguous delta chain (base, cur]; None if any epoch is
+        missing from the cache (sender falls back to the full map)."""
+        if base >= cur:
+            return []
+        out = []
+        for e in range(base + 1, cur + 1):
+            inc = self._inc_cache.get(e)
+            if inc is None or int(inc["base"]) != e - 1:
+                return None
+            out.append(inc)
+        return out
+
     async def _publish(self) -> bool:
         """Commit a map mutation: bump the epoch, replicate to a majority
         (multi-mon), persist, push to subscribers.  Returns False when no
@@ -770,10 +845,11 @@ class Monitor(Dispatcher):
         callers surface -EAGAIN; the next quorum re-syncs from the
         leader's map)."""
         self.osdmap.epoch += 1
+        inc = self._record_inc(self.osdmap.to_dict())
         ok = True
         if not self.solo and self.is_leader:
             version = self.osdmap.epoch
-            value = self.osdmap.to_dict()
+            value = self._last_map_dict
             self._paxos_acks[version] = set()
             ev = self._paxos_events[version] = asyncio.Event()
             try:
@@ -818,7 +894,7 @@ class Monitor(Dispatcher):
                 self._paxos_events.pop(version, None)
         elif self.solo:
             self.map_committed_epoch = self.election_epoch
-        self._save_store()
+        self._save_store(inc=inc)
         self._publish_subs()
         return ok
 
@@ -826,13 +902,27 @@ class Monitor(Dispatcher):
         for conn in list(self._subs):
             self._send_map(conn)
 
-    def _send_map(self, conn: Connection) -> None:
-        conn.send(
-            messages.MOSDMapMsg(
-                epoch=self.osdmap.epoch, osdmap=self.osdmap.to_dict(),
+    def _send_map(self, conn: Connection, have: int | None = None) -> None:
+        """Push the current map: a contiguous delta chain when we know
+        what the receiver holds (O(churn) bytes — the reference's
+        MOSDMap incremental_maps path), else the full snapshot."""
+        cur = self.osdmap.epoch
+        base = have if have is not None else self._sub_epochs.get(conn)
+        incs = self._collect_incs(base, cur) if base is not None else None
+        if incs is not None and 0 < len(incs):
+            conn.send(messages.MOSDMapMsg(
+                epoch=cur, osdmap=None,
                 committed_epoch=self.map_committed_epoch,
-            )
-        )
+                incrementals=incs,
+            ))
+        elif incs is not None and not incs:
+            pass  # receiver is already current
+        else:
+            conn.send(messages.MOSDMapMsg(
+                epoch=cur, osdmap=self.osdmap.to_dict(),
+                committed_epoch=self.map_committed_epoch,
+            ))
+        self._sub_epochs[conn] = cur
 
     async def _command_and_reply(
         self, conn: Connection, msg: messages.MMonCommand

@@ -147,13 +147,18 @@ class MMonGetMap(Message):
 
 @register
 class MOSDMapMsg(Message):
-    """OSDMap epoch push (reference:src/messages/MOSDMap.h); full map as
-    dict in ``osdmap``."""
+    """OSDMap epoch push (reference:src/messages/MOSDMap.h).
+
+    Carries EITHER a contiguous list of epoch deltas in ``incrementals``
+    (the common case — O(churn) bytes, the reference's
+    MOSDMap::incremental_maps) or the full map dict in ``osdmap``
+    (bootstrap / gap recovery).  Receivers that cannot bridge the chain
+    re-request with MMonGetMap(have=None)."""
 
     TYPE = "osd_map"
     # committed_epoch: election epoch the map was committed in (set on
     # mon->mon catch-up pushes; recovery orders maps by (epoch, version))
-    FIELDS = ("epoch", "osdmap", "committed_epoch")
+    FIELDS = ("epoch", "osdmap", "committed_epoch", "incrementals")
 
 
 @register

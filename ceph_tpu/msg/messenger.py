@@ -71,6 +71,23 @@ class Connection:
                 buf = await self._sendq.get()
                 if buf is None:
                     break
+                if self.messenger._inject_failure():
+                    # fault injection (ms_inject_socket_failures analog,
+                    # reference:src/common/config_opts.h:209): sever the
+                    # link MID-FRAME — the peer sees a truncated read,
+                    # we see a dead connection; both must recover via
+                    # reconnect + op resend, never by trusting the frame
+                    logger.info(
+                        "%s: INJECTING socket failure to %s (mid-frame)",
+                        self.messenger.name, self.peer_name,
+                    )
+                    self._writer.write(_LEN.pack(len(buf)))
+                    self._writer.write(buf[: max(1, len(buf) // 2)])
+                    try:
+                        await self._writer.drain()
+                    finally:
+                        self._writer.transport.abort()
+                    break
                 self._writer.write(_LEN.pack(len(buf)))
                 self._writer.write(buf)
                 await self._writer.drain()
@@ -83,6 +100,15 @@ class Connection:
             while True:
                 hdr = await self._reader.readexactly(_LEN.size)
                 (n,) = _LEN.unpack(hdr)
+                if self.messenger._inject_failure():
+                    # receive-side injection: drop the link with a frame
+                    # half-read (reference injects on both directions)
+                    logger.info(
+                        "%s: INJECTING socket failure from %s (mid-read)",
+                        self.messenger.name, self.peer_name,
+                    )
+                    self._writer.transport.abort()
+                    break
                 # the dispatch throttle bounds in-flight inbound bytes:
                 # waiting HERE exerts TCP backpressure on the peer
                 # (reference:Messenger policy throttler semantics)
@@ -155,6 +181,15 @@ class AsyncMessenger:
         # ticket and inbound banners are verified (see _accept)
         self.auth = None  # ceph_tpu.auth.AuthContext | None
         self.auth_mon_mode = False  # mon: admit unauth conns for MAuth
+        # fault injection: ~1 per N socket ops severs the link mid-frame
+        # (reference ms_inject_socket_failures); seeded from a STABLE
+        # digest of the name (str hash() is salted per process and
+        # would make failures unreproducible across runs)
+        self.inject_socket_failures = 0
+        import random as _random
+        import zlib as _zlib
+
+        self._inject_rng = _random.Random(_zlib.crc32(name.encode()))
         from ..common.throttle import Throttle
 
         # bounds in-flight inbound bytes across all connections
@@ -167,6 +202,11 @@ class AsyncMessenger:
         self.reconnect_backoff = cfg.ms_reconnect_backoff
         self.connect_timeout = cfg.ms_connect_timeout
         self.dispatch_throttle.limit = cfg.ms_dispatch_throttle_bytes
+        self.inject_socket_failures = cfg.ms_inject_socket_failures
+
+    def _inject_failure(self) -> bool:
+        n = self.inject_socket_failures
+        return n > 0 and self._inject_rng.randrange(n) == 0
 
     # -- lifecycle
     async def bind(self, host: str = "127.0.0.1", port: int = 0) -> str:

@@ -566,7 +566,7 @@ class OSD(Dispatcher):
 
     async def ms_dispatch(self, conn: Connection, msg: Message) -> None:
         if isinstance(msg, messages.MOSDMapMsg):
-            self._handle_map(msg)
+            self._handle_map(msg, conn)
         elif isinstance(msg, messages.MOSDOp):
             # run as a task: the op blocks on shard round-trips and must not
             # stall the connection reader (sharded op queue analog)
@@ -656,10 +656,20 @@ class OSD(Dispatcher):
     def _epoch(self) -> int:
         return self.osdmap.epoch if self.osdmap else 0
 
-    def _handle_map(self, msg: messages.MOSDMapMsg) -> None:
+    def _handle_map(self, msg: messages.MOSDMapMsg,
+                    conn: Connection | None = None) -> None:
         if self.osdmap is not None and msg.epoch <= self.osdmap.epoch:
             return
-        self.osdmap = OSDMap.from_dict(msg.osdmap)
+        from .osdmap import advance_map
+
+        m = advance_map(self.osdmap, msg.epoch, msg.osdmap, msg.incrementals)
+        if m is None:
+            # delta chain does not bridge to our epoch: fetch a full map
+            # (reference:src/osd/OSD.cc handle_osd_map request_full path)
+            if conn is not None:
+                conn.send(messages.MMonGetMap(have=None))
+            return
+        self.osdmap = m
         self._codecs.clear()  # pools/profiles may have changed
         self._map_event.set()
         self.recovery.kick()  # acting sets may have changed
@@ -1364,6 +1374,45 @@ class OSD(Dispatcher):
 
         return await self._ec_fan_out(pg, present, build_txn, [entry], version)
 
+    async def _gather_subops(self, waiter: "_Waiter", send_round,
+                             keys: list) -> None:
+        """Fan out sub-ops and gather acks, RE-SENDING keys lost to
+        transient failures (severed sockets, dropped replies) up to
+        osd_subop_retries extra rounds.  Safe because sub-op
+        transactions are idempotent (absolute-offset writes + keyed log
+        entries) and the caller holds the lock that serializes
+        same-object mutations — the role of the reference messenger's
+        reconnect/replay semantics
+        (reference:src/msg/async/AsyncConnection.cc replay on reconnect,
+        exercised by the msgr-failures thrash matrix).  ESTALE results
+        (a demoted primary) are definitive and never retried."""
+        attempts = 1 + max(
+            0, int(getattr(self.config, "osd_subop_retries", 2))
+        )
+        targets = list(keys)
+        for attempt in range(attempts):
+            await send_round(targets)
+            try:
+                async with asyncio.timeout(self.subop_timeout):
+                    await waiter.event.wait()
+            except TimeoutError:
+                pass
+            retry = sorted(
+                set(waiter.pending)
+                | {k for k, r in waiter.results.items() if r == -EIO}
+            )
+            if not retry or attempt == attempts - 1:
+                return
+            logger.info(
+                "%s: re-sending %d sub-op(s) after transient loss: %s",
+                self.name, len(retry), retry,
+            )
+            for k in retry:
+                waiter.results.pop(k, None)
+                waiter.pending.add(k)
+            waiter.event.clear()
+            targets = retry
+
     async def _ec_fan_out(
         self, pg: PGid, present: list[tuple[int, int]], build_txn,
         entries: list[PGLogEntry], version: Eversion,
@@ -1374,7 +1423,8 @@ class OSD(Dispatcher):
         watermark advance on success (reference:src/osd/ECBackend.cc:1389
         submit_transaction -> :1946 try_finish_rmw)."""
         tid = self._new_tid()
-        waiter = _Waiter({s for s, _ in present}, dict(present))
+        by_shard = dict(present)
+        waiter = _Waiter({s for s, _ in present}, by_shard)
         self._write_waiters[tid] = waiter
         # register as in-flight BEFORE any sub-write leaves: with
         # pipelined per-object commits, the roll-forward watermark must
@@ -1382,20 +1432,25 @@ class OSD(Dispatcher):
         # its rollback stashes (see _mark_committed)
         inflight = self._pg_inflight.setdefault(str(pg), set())
         inflight.add(version)
-        try:
-            for shard, osd in present:
+
+        async def send_round(shards):
+            for shard in shards:
                 await self._send_sub_write(
-                    tid, pg, shard, osd, build_txn(shard), entries
+                    tid, pg, shard, by_shard[shard], build_txn(shard),
+                    entries,
                 )
-            async with asyncio.timeout(self.subop_timeout):
-                await waiter.event.wait()
-        except TimeoutError:
-            logger.warning("%s: ec commit tid=%d timed out on %s",
-                           self.name, tid, waiter.pending)
-            return -EIO
+
+        try:
+            await self._gather_subops(
+                waiter, send_round, [s for s, _ in present]
+            )
         finally:
             del self._write_waiters[tid]
             inflight.discard(version)
+        if waiter.pending:
+            logger.warning("%s: ec commit tid=%d timed out on %s",
+                           self.name, tid, waiter.pending)
+            return -EIO
         if any(r != 0 for r in waiter.results.values()):
             if any(r == -ESTALE for r in waiter.results.values()):
                 return -EAGAIN  # demoted primary; client re-targets
@@ -2673,8 +2728,9 @@ class OSD(Dispatcher):
         waiter = _Waiter(set(replicas), {o: o for o in replicas})
         self._write_waiters[tid] = waiter
         ops, blobs = messages.encode_txn(txn)
-        try:
-            for osd in replicas:
+
+        async def send_round(osds):
+            for osd in osds:
                 if osd == self.osd_id:
                     waiter.complete(
                         osd, self._apply_sub_write(txn, str(pg), -1, [entry])
@@ -2695,12 +2751,13 @@ class OSD(Dispatcher):
                         epoch=self._epoch(), blobs=blobs,
                     )
                 )
-            async with asyncio.timeout(self.subop_timeout):
-                await waiter.event.wait()
-        except TimeoutError:
-            return -EIO
+
+        try:
+            await self._gather_subops(waiter, send_round, replicas)
         finally:
             del self._write_waiters[tid]
+        if waiter.pending:
+            return -EIO
         if any(r != 0 for r in waiter.results.values()):
             return -EIO
         return 0
@@ -2718,8 +2775,9 @@ class OSD(Dispatcher):
         waiter = _Waiter(set(replicas), {o: o for o in replicas})
         self._write_waiters[tid] = waiter
         ops, blobs = messages.encode_txn(txn)
-        try:
-            for osd in replicas:
+
+        async def send_round(osds):
+            for osd in osds:
                 if osd == self.osd_id:
                     waiter.complete(
                         osd, self._apply_sub_write(txn, str(pg), -1, [])
@@ -2739,13 +2797,14 @@ class OSD(Dispatcher):
                         epoch=self._epoch(), blobs=blobs,
                     )
                 )
-            async with asyncio.timeout(self.subop_timeout):
-                await waiter.event.wait()
-        except TimeoutError:
-            return -EIO
+
+        try:
+            await self._gather_subops(waiter, send_round, replicas)
         finally:
             del self._write_waiters[tid]
-        if any(r != 0 for r in waiter.results.values()):
+        if waiter.pending or any(
+            r != 0 for r in waiter.results.values()
+        ):
             return -EIO
         return 0
 

@@ -515,12 +515,30 @@ class OSDMap:
         rule.step(CRUSH_RULE_EMIT)
         return self.crush.add_rule(rule)
 
+    def apply_incremental(self, inc: "Incremental") -> "OSDMap":
+        """Return the successor map this delta produces (reference:
+        src/osd/OSDMap.cc apply_incremental).  Raises ValueError on an
+        epoch gap — the caller must fetch a full map instead."""
+        if inc.base_epoch != self.epoch:
+            raise ValueError(
+                f"incremental for base epoch {inc.base_epoch} cannot "
+                f"apply to map epoch {self.epoch}"
+            )
+        d = self.to_dict()
+        inc.apply_to_dict(d)
+        return OSDMap.from_dict(d)
+
     # -- wire form (reference: OSDMap::encode/decode) ------------------------
 
     def to_dict(self) -> dict:
         from ..crush.encoding import crush_to_dict
         from dataclasses import asdict
 
+        # every container is COPIED: the dict must be a snapshot, not a
+        # view — Incremental.diff retains the previous epoch's dict, and
+        # an aliased sub-dict would mutate in lockstep with the live map,
+        # silently erasing the change from the delta (r4 bug: a profile
+        # set vanished from the mon's delta log)
         return {
             "epoch": self.epoch,
             "fsid": self.fsid,
@@ -528,11 +546,18 @@ class OSDMap:
             "max_osd": self.max_osd,
             "osd_state": list(self.osd_state),
             "osd_weight": list(self.osd_weight),
-            "osd_primary_affinity": self.osd_primary_affinity,
+            "osd_primary_affinity": (
+                None if self.osd_primary_affinity is None
+                else list(self.osd_primary_affinity)
+            ),
             "osd_addrs": {str(k): v for k, v in self.osd_addrs.items()},
             "pools": {str(pid): asdict(p) for pid, p in self.pools.items()},
-            "erasure_code_profiles": self.erasure_code_profiles,
-            "pg_temp": {str(pg): osds for pg, osds in self.pg_temp.items()},
+            "erasure_code_profiles": {
+                k: dict(v) for k, v in self.erasure_code_profiles.items()
+            },
+            "pg_temp": {
+                str(pg): list(osds) for pg, osds in self.pg_temp.items()
+            },
             "primary_temp": {str(pg): o for pg, o in self.primary_temp.items()},
             "mgr_name": self.mgr_name,
             "mgr_addr": self.mgr_addr,
@@ -576,3 +601,114 @@ class OSDMap:
         m.mds_addr = d.get("mds_addr", "")
         m.mds_standbys = [tuple(x) for x in d.get("mds_standbys", [])]
         return m
+
+
+class Incremental:
+    """Epoch delta between consecutive OSDMaps (reference:src/osd/
+    OSDMap.h:111 ``class Incremental``).
+
+    The reference's Incremental is a typed field-set (new_up_client,
+    new_weight, new_pools, ...); here the map's canonical wire form is
+    already a JSON-shaped dict, so the delta is STRUCTURAL: a recursive
+    diff of the two dicts, recording leaf sets and deletions by path.
+    That covers every present and future map field (pools, crush,
+    pg_temp, mgr/mds seats) with one mechanism, and its size is
+    O(changed entries) — the property that makes per-epoch distribution
+    and storage scale with churn instead of cluster size.
+
+    Wire form: ``{"epoch": E, "base": E-1, "set": [[path, value], ...],
+    "del": [path, ...]}`` where path is a list of dict keys.  Lists and
+    scalars are replaced wholesale (osd_state/osd_weight are int lists —
+    cheap; crush replaces only when the topology actually changed).
+    """
+
+    def __init__(self, epoch: int, base_epoch: int,
+                 sets: list, dels: list):
+        self.epoch = epoch
+        self.base_epoch = base_epoch
+        self.sets = sets  # [(path list, new value)]
+        self.dels = dels  # [path list]
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def diff(cls, old: dict, new: dict) -> "Incremental":
+        """Delta producing ``new`` from ``old`` (both OSDMap.to_dict())."""
+        sets: list = []
+        dels: list = []
+
+        def walk(path: list, a, b) -> None:
+            if isinstance(a, dict) and isinstance(b, dict):
+                for k in a:
+                    if k not in b:
+                        dels.append(path + [k])
+                for k, bv in b.items():
+                    if k not in a:
+                        sets.append((path + [k], bv))
+                    elif a[k] != bv:
+                        walk(path + [k], a[k], bv)
+            else:
+                sets.append((list(path), b))
+
+        walk([], old, new)
+        return cls(int(new["epoch"]), int(old["epoch"]), sets, dels)
+
+    # -- application ---------------------------------------------------------
+
+    def apply_to_dict(self, d: dict) -> dict:
+        for path in self.dels:
+            node = d
+            for k in path[:-1]:
+                node = node[k]
+            node.pop(path[-1], None)
+        for path, value in self.sets:
+            node = d
+            for k in path[:-1]:
+                node = node.setdefault(k, {})
+            node[path[-1]] = value
+        return d
+
+    # -- wire ----------------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return {
+            "epoch": self.epoch,
+            "base": self.base_epoch,
+            "set": [[list(p), v] for p, v in self.sets],
+            "del": [list(p) for p in self.dels],
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Incremental":
+        return cls(
+            int(d["epoch"]), int(d["base"]),
+            [(list(p), v) for p, v in d["set"]],
+            [list(p) for p in d["del"]],
+        )
+
+
+def advance_map(current: "OSDMap | None", epoch: int,
+                full_dict: dict | None,
+                incrementals: "list[dict] | None") -> "OSDMap | None":
+    """Shared MOSDMapMsg application for every map consumer (OSD,
+    client, mgr, mds — the reference's handle_osd_map incremental path,
+    reference:src/osd/OSD.cc handle_osd_map).
+
+    Applies the contiguous incremental chain when it reaches from
+    ``current`` to ``epoch``; falls back to the full dict when present.
+    Returns the advanced map, ``current`` when already up to date, or
+    None when there is a gap the message cannot bridge (caller must
+    request a full map)."""
+    if current is not None and epoch <= current.epoch:
+        return current
+    m = current
+    for inc_d in incrementals or []:
+        inc = Incremental.from_dict(inc_d)
+        if m is None or inc.base_epoch != m.epoch:
+            continue  # chain does not touch our epoch (yet)
+        m = m.apply_incremental(inc)
+    if m is not None and m.epoch == epoch:
+        return m
+    if full_dict is not None:
+        return OSDMap.from_dict(full_dict)
+    return None

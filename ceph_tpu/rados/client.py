@@ -192,7 +192,15 @@ class RadosClient(Dispatcher):
     async def ms_dispatch(self, conn: Connection, msg: Message) -> None:
         if isinstance(msg, messages.MOSDMapMsg):
             if self.osdmap is None or msg.epoch > self.osdmap.epoch:
-                self.osdmap = OSDMap.from_dict(msg.osdmap)
+                from ..osd.osdmap import advance_map
+
+                m = advance_map(
+                    self.osdmap, msg.epoch, msg.osdmap, msg.incrementals
+                )
+                if m is None:
+                    conn.send(messages.MMonGetMap(have=None))
+                    return
+                self.osdmap = m
                 for fut in self._map_waiters:
                     if not fut.done():
                         fut.set_result(None)

@@ -33,8 +33,12 @@ class MiniCluster:
         mon_config=None,
         crush_hosts: "list[list[int]] | None" = None,
         auth: bool = False,
+        config_overrides: "dict | None" = None,
     ):
         self.n_osds = n_osds
+        # extra daemon config (e.g. ms_inject_socket_failures for the
+        # msgr-failure thrash variant) merged into every OSD's Config
+        self.config_overrides = dict(config_overrides or {})
         # cephx: one generated keyring shared by all daemons + the admin
         # client (the vstart --cephx flow)
         self.auth = auth
@@ -88,15 +92,19 @@ class MiniCluster:
         self._clients: list[RadosClient] = []
 
     def _daemon_config(self):
-        """A fresh Config carrying the cephx knobs (None when auth is
-        off, so daemons keep their own defaults)."""
-        if not self.auth:
+        """A fresh Config carrying the cephx knobs plus any test-driven
+        overrides (None when nothing is set, so daemons keep their own
+        defaults)."""
+        overrides = dict(self.config_overrides)
+        if self.auth:
+            overrides.update({
+                "auth_supported": "cephx", "keyring": self._keyring_path,
+            })
+        if not overrides:
             return None
         from ..common import Config
 
-        return Config(overrides={
-            "auth_supported": "cephx", "keyring": self._keyring_path,
-        })
+        return Config(overrides=overrides)
 
     def _make_store(self, osd_id: int) -> ObjectStore:
         if self.store_dir is None:

@@ -497,6 +497,15 @@ class BlueStore(ObjectStore):
             src_oid, dst_oid = op[2], op[3]
             skey, dkey = _okey(cid, src_oid), _okey(cid, dst_oid)
             src = staged[skey] if skey in staged else self._onodes.get(skey)
+            if name == "try_stash":
+                dst_exists = (
+                    staged[dkey] is not None if dkey in staged
+                    else dkey in self._onodes
+                )
+                if dst_exists:
+                    # stash-if-absent (see Transaction.try_stash): a
+                    # re-sent sub-write keeps the true pre-write stash
+                    return
             if src is None:
                 if name == "clone":
                     raise KeyError(f"no object {src_oid} in {cid}")

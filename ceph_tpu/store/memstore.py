@@ -171,7 +171,9 @@ class MemStore(ObjectStore):
             (_, cid, src, dst) = op
             coll = self._coll(cid)
             obj = coll.get(src)
-            if obj is not None:
+            if obj is not None and dst not in coll:
+                # stash-if-absent: a re-applied (re-sent) sub-write must
+                # not overwrite the true pre-write copy
                 self._obj(cid, dst, create=True).clone_from(obj)
         elif name == "stash_restore":
             (_, cid, stash, dst) = op

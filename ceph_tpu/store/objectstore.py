@@ -114,11 +114,18 @@ class Transaction:
     def try_stash(
         self, cid: CollectionId, src: ObjectId, stash: ObjectId
     ) -> "Transaction":
-        """Clone ``src`` (data+attrs+omap) to ``stash`` iff it exists,
-        else no-op. The EC write path stashes the pre-write object in the
-        same transaction as the overwrite so an interrupted fan-out can
-        roll back (the role of the reference's pg-log rollback info,
-        reference:doc/dev/osd_internals/erasure_coding/ecbackend.rst)."""
+        """Clone ``src`` (data+attrs+omap) to ``stash`` iff src exists
+        AND the stash does not already exist, else no-op.  The EC write
+        path stashes the pre-write object in the same transaction as the
+        overwrite so an interrupted fan-out can roll back (the role of
+        the reference's pg-log rollback info, reference:doc/dev/
+        osd_internals/erasure_coding/ecbackend.rst).
+
+        The stash-if-absent rule is what makes sub-write transactions
+        idempotent under re-send (osd_subop_retries): stash names are
+        version-unique (snap clones snapid-unique), so on a re-applied
+        txn the stash already holds the true PRE-write copy and must not
+        be clobbered with post-write data (r4 review finding)."""
         self.ops.append(("try_stash", cid, src, stash))
         return self
 

@@ -27,8 +27,16 @@ class Client(Dispatcher):
 
     async def ms_dispatch(self, conn, msg):
         if isinstance(msg, messages.MOSDMapMsg):
+            from ceph_tpu.osd.osdmap import advance_map
+
             self.maps.append(msg.epoch)
-            self.osdmap = OSDMap.from_dict(msg.osdmap)
+            m = advance_map(
+                self.osdmap, msg.epoch, msg.osdmap, msg.incrementals
+            )
+            if m is None:
+                conn.send(messages.MMonGetMap(have=None))
+                return
+            self.osdmap = m
         elif isinstance(msg, messages.MMonCommandReply):
             self.replies[msg.tid] = msg
 

@@ -1,0 +1,272 @@
+"""OSDMap Incrementals + mon delta log (VERDICT r3 Missing #4 / Next #5).
+
+The reference versions the cluster map as per-epoch deltas
+(reference:src/osd/OSDMap.h:111 class Incremental) distributed to
+clients/OSDs and stored in the mon store with periodic full snapshots.
+These tests pin: delta correctness over every mutation kind, O(churn)
+wire/store size, store reconstruction from checkpoint+chain, client
+catch-up through incrementals, and gap recovery via full-map refetch.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from ceph_tpu.mon.store import CHECKPOINT_EVERY, MonitorDBStore
+from ceph_tpu.osd.osdmap import (
+    Incremental,
+    OSDMap,
+    PGid,
+    Pool,
+    advance_map,
+    build_simple,
+)
+
+
+def _mutations(m: OSDMap):
+    """One generator per mutation family the mon performs."""
+    yield lambda: m.mark_down(1)
+    yield lambda: m.mark_up(1, addr="127.0.0.1:7001")
+    yield lambda: m.mark_out(2)
+    yield lambda: m.mark_in(2)
+    yield lambda: m.add_pool(Pool(id=7, name="p7", pg_num=4, pgp_num=4))
+    yield lambda: m.set_erasure_code_profile("ec1", {"k": "2", "m": "1"})
+    yield lambda: m.pg_temp.update({PGid(7, 0): [3, 1, 0]})
+    yield lambda: m.pg_temp.pop(PGid(7, 0))
+    yield lambda: setattr(m, "mgr_name", "mgr.x")
+
+
+class TestIncremental:
+    def test_diff_apply_roundtrip_every_mutation(self):
+        m = build_simple(6)
+        for mutate in _mutations(m):
+            old = m.to_dict()
+            mutate()
+            m.epoch += 1
+            new = m.to_dict()
+            inc = Incremental.diff(old, new)
+            # delta applies a COPY of old to exactly new
+            rebuilt = inc.apply_to_dict(json.loads(json.dumps(old)))
+            assert rebuilt == json.loads(json.dumps(new))
+            # and wire round-trips
+            inc2 = Incremental.from_dict(
+                json.loads(json.dumps(inc.to_dict()))
+            )
+            rebuilt2 = inc2.apply_to_dict(json.loads(json.dumps(old)))
+            assert rebuilt2 == json.loads(json.dumps(new))
+
+    def test_delta_is_small(self):
+        """O(churn): marking one osd down must not ship the pool table
+        or the crush map."""
+        m = build_simple(16)
+        m.add_pool(Pool(id=1, name="data", pg_num=64, pgp_num=64))
+        old = m.to_dict()
+        m.mark_down(5)
+        m.epoch += 1
+        inc = Incremental.diff(old, m.to_dict())
+        wire = json.dumps(inc.to_dict())
+        full = json.dumps(m.to_dict())
+        assert len(wire) < len(full) / 10, (len(wire), len(full))
+        touched = {p[0] for p, _v in inc.sets}
+        assert "pools" not in touched and "crush" not in touched
+
+    def test_apply_incremental_epoch_gate(self):
+        m = build_simple(4)
+        old = m.to_dict()
+        m.mark_down(0)
+        m.epoch += 2  # skip an epoch
+        inc = Incremental.diff(old, m.to_dict())
+        with pytest.raises(ValueError):
+            build_simple(4).apply_incremental(
+                Incremental(inc.epoch, inc.base_epoch + 1, inc.sets,
+                            inc.dels)
+            )
+
+    def test_advance_map_chain_and_gap(self):
+        m0 = build_simple(4)
+        dicts = [m0.to_dict()]
+        m = m0
+        incs = []
+        for i in range(3):
+            d_old = m.to_dict()
+            m = OSDMap.from_dict(d_old)
+            m.mark_down(i)
+            m.epoch += 1
+            incs.append(Incremental.diff(d_old, m.to_dict()).to_dict())
+            dicts.append(m.to_dict())
+        # full chain advances
+        got = advance_map(m0, m.epoch, None, incs)
+        assert got is not None and got.to_dict() == m.to_dict()
+        # broken chain with no full -> None (caller refetches)
+        assert advance_map(m0, m.epoch, None, incs[1:]) is None
+        # broken chain WITH full -> full wins
+        got = advance_map(m0, m.epoch, m.to_dict(), incs[1:])
+        assert got is not None and got.epoch == m.epoch
+
+
+class TestMonStoreDeltaLog:
+    def _commit_epochs(self, store, m, n):
+        for i in range(n):
+            old = m.to_dict()
+            m.mark_down(i % 4) if i % 2 == 0 else m.mark_up(i % 4)
+            m.epoch += 1
+            inc = Incremental.diff(old, m.to_dict()).to_dict()
+            store.save(m.to_dict(), election_epoch=1, inc=inc)
+
+    def test_store_grows_by_deltas_with_checkpoints(self, tmp_path):
+        store = MonitorDBStore(str(tmp_path / "mon.db"))
+        m = build_simple(4)
+        store.save(m.to_dict(), election_epoch=1)  # bootstrap full
+        n = 80
+        self._commit_epochs(store, m, n)
+        fulls = store.db.keys("osdmap")
+        incs = store.db.keys("osdmap_inc")
+        assert len(incs) >= n - len(fulls), (len(incs), len(fulls))
+        # one checkpoint per cadence window, not one full per epoch
+        assert len(fulls) <= n // CHECKPOINT_EVERY + 2, len(fulls)
+        # latest epoch reconstructs exactly
+        assert store.get_map() == m.to_dict()
+        # an intermediate (delta-stored) epoch reconstructs too
+        mid = m.epoch - CHECKPOINT_EVERY // 2
+        assert store.get_map(mid)["epoch"] == mid
+        # catch-up ranges serve from the delta log
+        chain = store.get_incrementals(m.epoch - 5, m.epoch)
+        assert chain is not None and len(chain) == 5
+        store.close()
+
+    def test_store_reload_after_restart(self, tmp_path):
+        path = str(tmp_path / "mon.db")
+        store = MonitorDBStore(path)
+        m = build_simple(4)
+        store.save(m.to_dict(), election_epoch=3)
+        self._commit_epochs(store, m, 10)
+        store.close()
+        store2 = MonitorDBStore(path)
+        assert store2.get_map() == m.to_dict()
+        assert store2.last_committed() == m.epoch
+        store2.close()
+
+    def test_mon_restart_rearms_delta_cache(self, tmp_path):
+        """After a mon restart the stored delta chain must keep serving
+        O(churn) catch-up pushes (r4 review: a cold cache made every
+        post-restart push a full map)."""
+        from ceph_tpu.mon import Monitor
+
+        path = str(tmp_path / "mon.db")
+        mon = Monitor(name="mon.0", max_osds=4, store_path=path)
+        base = mon.osdmap.to_dict()
+        for i in range(6):
+            old = mon.osdmap.to_dict()
+            mon.osdmap.mark_down(i % 3) if i % 2 == 0 \
+                else mon.osdmap.mark_up(i % 3)
+            mon.osdmap.epoch += 1
+            inc = Incremental.diff(old, mon.osdmap.to_dict()).to_dict()
+            mon._inc_cache[mon.osdmap.epoch] = inc
+            mon._last_map_dict = mon.osdmap.to_dict()
+            mon._save_store(inc=inc)
+        top = mon.osdmap.epoch
+        base5 = mon._db_store.get_map(top - 5)
+        mon._db_store.close()
+        mon2 = Monitor(name="mon.0", max_osds=4, store_path=path)
+        assert mon2.osdmap.epoch == top
+        # the first commit checkpoints as a full map, the rest are
+        # deltas: the re-armed cache must serve that whole delta tail
+        chain = mon2._collect_incs(top - 5, top)
+        assert chain is not None and len(chain) == 5, (
+            "delta cache not re-armed from the store"
+        )
+        rebuilt = dict(base5)
+        for inc_d in chain:
+            Incremental.from_dict(inc_d).apply_to_dict(rebuilt)
+        assert rebuilt == mon2.osdmap.to_dict()
+        mon2._db_store.close()
+
+    def test_foreign_adoption_writes_full(self, tmp_path):
+        """inc=None (adopted map, unknown continuity) must checkpoint."""
+        store = MonitorDBStore(str(tmp_path / "mon.db"))
+        m = build_simple(4)
+        store.save(m.to_dict(), election_epoch=1)
+        m.epoch += 7  # jump (foreign map)
+        store.save(m.to_dict(), election_epoch=2, inc=None)
+        assert store.get_map() == m.to_dict()
+        store.close()
+
+
+class TestClusterCatchUp:
+    def test_client_follows_churn_via_incrementals(self):
+        """A connected client tracks N map mutations; the mon's pushes
+        after the first full map are delta-only."""
+        from ceph_tpu.msg import messages
+        from ceph_tpu.rados import MiniCluster
+
+        async def main():
+            async with MiniCluster(n_osds=4) as cluster:
+                cl = await cluster.client()
+                mon = next(iter(cluster.mons.values()))
+                sent_full = [0]
+                sent_inc = [0]
+                orig = mon._send_map
+
+                def counting(conn, have=None):
+                    before = mon._sub_epochs.get(conn)
+                    orig(conn, have)
+                    # classify what was sent by inspecting the cache
+                    cur = mon.osdmap.epoch
+                    base = have if have is not None else before
+                    incs = (
+                        mon._collect_incs(base, cur)
+                        if base is not None else None
+                    )
+                    if incs:
+                        sent_inc[0] += 1
+                    elif incs is None:
+                        sent_full[0] += 1
+
+                mon._send_map = counting
+                e0 = cl.osdmap.epoch
+                for i in range(6):
+                    code, _s, _ = await cl.command(
+                        {"prefix": "osd out", "id": i % 3}
+                        if i % 2 == 0
+                        else {"prefix": "osd in", "id": i % 3}
+                    )
+                    assert code == 0
+                async with asyncio.timeout(10):
+                    while cl.osdmap.epoch < e0 + 6:
+                        await asyncio.sleep(0.02)
+                assert sent_inc[0] >= 6, (sent_inc, sent_full)
+                # the client's delta-built map equals the mon's map
+                assert cl.osdmap.to_dict() == mon.osdmap.to_dict()
+
+        asyncio.run(main())
+
+    def test_client_gap_recovers_with_full_map(self):
+        """A client whose epoch predates the mon's delta window must
+        recover via a full-map refetch."""
+        from ceph_tpu.msg import messages
+        from ceph_tpu.rados import MiniCluster
+
+        async def main():
+            async with MiniCluster(n_osds=4) as cluster:
+                cl = await cluster.client()
+                mon = next(iter(cluster.mons.values()))
+                e0 = cl.osdmap.epoch
+                for i in range(4):
+                    await cl.command({"prefix": "osd out", "id": 0})
+                    await cl.command({"prefix": "osd in", "id": 0})
+                async with asyncio.timeout(10):
+                    while cl.osdmap.epoch < e0 + 8:
+                        await asyncio.sleep(0.02)
+                # simulate a pruned delta window + a stale subscriber
+                mon._inc_cache.clear()
+                stale = OSDMap.from_dict(cl.osdmap.to_dict())
+                stale.epoch = e0
+                cl.osdmap = stale
+                await cl.command({"prefix": "osd out", "id": 1})
+                async with asyncio.timeout(10):
+                    while cl.osdmap.epoch < mon.osdmap.epoch:
+                        await asyncio.sleep(0.02)
+                assert cl.osdmap.to_dict() == mon.osdmap.to_dict()
+
+        asyncio.run(main())

@@ -213,3 +213,31 @@ def test_queue_transaction_callbacks(store):
     )
     assert fired == ["applied", "commit"]
     assert store.read(CID, OID) == b"x"
+
+
+def test_try_stash_is_stash_if_absent(store):
+    """Re-applying a sub-write transaction (osd_subop_retries re-send
+    after an ack was lost) must keep the TRUE pre-write stash: try_stash
+    is a no-op when the stash already exists (r4: a clobbered stash
+    would roll back to post-write data)."""
+    _mkcoll(store)
+    stash = ObjectId("obj\x00stash\x000000000001.000000000001", 0)
+    store.apply(Transaction().write(CID, OID, 0, b"OLD-DATA"))
+    txn = (
+        Transaction()
+        .try_stash(CID, OID, stash)
+        .write(CID, OID, 0, b"NEW-DATA")
+        .setattr(CID, OID, "_oi", b"v2")
+    )
+    store.apply(txn)
+    assert store.read(CID, stash) == b"OLD-DATA"
+    # the re-sent duplicate applies the same txn again
+    store.apply(txn)
+    assert store.read(CID, stash) == b"OLD-DATA", (
+        "re-applied txn clobbered the pre-write stash"
+    )
+    assert store.read(CID, OID) == b"NEW-DATA"
+    # rollback restores the genuine old bytes and consumes the stash
+    store.apply(Transaction().stash_restore(CID, stash, OID))
+    assert store.read(CID, OID) == b"OLD-DATA"
+    assert not store.exists(CID, stash)
