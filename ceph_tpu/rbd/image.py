@@ -46,13 +46,19 @@ class RBD:
         )
 
     async def create(
-        self, name: str, size: int, order: int = DEFAULT_ORDER
+        self, name: str, size: int, order: int = DEFAULT_ORDER,
+        features: "list[str] | None" = None,
     ) -> None:
         """reference:librbd::create — claim the name atomically in the
         directory (cls rbd.dir_add, serialized under the PG lock), then
-        write the header."""
+        write the header.  ``features=["journaling"]`` turns on the
+        crash-consistent op journal (ceph_tpu.rbd.journal)."""
         if not (12 <= order <= 26):
             raise RbdError(-EINVAL, f"order {order} out of range")
+        known = {"journaling"}
+        bad = set(features or ()) - known
+        if bad:
+            raise RbdError(-EINVAL, f"unknown features {sorted(bad)}")
         image_id = secrets.token_hex(8)  # process-independent, 64-bit
         try:
             await self.io.exec(RBD_DIRECTORY, "rbd", "dir_add",
@@ -65,6 +71,7 @@ class RBD:
             "order": str(order).encode(),
             "snap_seq": b"0",
             "snaps": b"{}",
+            "features": json.dumps(sorted(features or [])).encode(),
         })
 
     async def remove(self, name: str) -> None:
@@ -76,6 +83,10 @@ class RBD:
             if img.parent is not None:
                 await img._deregister_child()  # free the parent snap
             await img._remove_data_objects(img.size_bytes)
+            if "journaling" in img.features:
+                from .journal import JOURNAL_PREFIX
+
+                await img._remove_quiet(JOURNAL_PREFIX + img.image_id)
             await self.io.remove(img.header)
         finally:
             await img.close()
@@ -152,6 +163,8 @@ class Image:
         self.parent: dict | None = None
         self._parent_img: "Image | None" = None  # opened lazily at the snap
         self._copyup_locks: dict[int, asyncio.Lock] = {}
+        self.features: list[str] = []
+        self._journal = None  # ImageJournal when 'journaling' is on
 
     # -- lifecycle ---------------------------------------------------------
     @classmethod
@@ -179,6 +192,14 @@ class Image:
             img._cache = ObjectCacher(img.io, max_bytes=cache_bytes)
         if snap_name is not None:
             img.set_snap(snap_name)
+        if "journaling" in img.features and snap_name is None:
+            # crash-replay BEFORE serving I/O (reference:librbd
+            # Journal<I>::open -> journal::Replay): a previous writer's
+            # acked-but-unapplied ops land now
+            from .journal import ImageJournal
+
+            img._journal = ImageJournal(img)
+            await img._journal.replay()
         # watch the header: other clients' resizes/snap ops invalidate us
         # (reference:ImageCtx::register_watch)
         img._watch_cookie = await img.io.watch(
@@ -191,6 +212,11 @@ class Image:
             return
         self._closed = True
         await self._cache_flush()
+        if self._journal is not None:
+            try:
+                await self._journal.commit(force=True)
+            except (RadosError, ConnectionError, OSError):
+                pass  # replay at the next open covers the tail
         if self._parent_img is not None:
             await self._parent_img.close()
             self._parent_img = None
@@ -210,6 +236,7 @@ class Image:
         self.size_bytes = int(h["size"])
         self.order = int(h["order"])
         self.snaps = json.loads(h.get("snaps", b"{}"))
+        self.features = json.loads(h.get("features", b"[]"))
         raw_parent = h.get("parent")
         self.parent = json.loads(raw_parent) if raw_parent else None
         self._apply_snapc()
@@ -286,6 +313,20 @@ class Image:
         self._check_open_rw()
         if offset + len(data) > self.size_bytes:
             raise RbdError(-EINVAL, "write past end of image")
+        if self._journal is not None:
+            # journal-first (reference:librbd journaling write path):
+            # the event is durable before any data object changes, so a
+            # client dying anywhere after this point leaves a replayable
+            # record instead of a torn multi-object write
+            await self._journal.append("write", {"off": offset}, data)
+        await self._apply_write_data(offset, data)
+        if self._journal is not None:
+            await self._journal.commit()
+        return len(data)
+
+    async def _apply_write_data(self, offset: int, data: bytes) -> None:
+        """The data-object half of a write — used by the normal path
+        and by journal replay (idempotent: absolute offsets)."""
         if self.parent is not None:
             await asyncio.gather(*(
                 self._ensure_copyup(objectno)
@@ -303,7 +344,6 @@ class Image:
             else:
                 ops.append(self.io.write(name, chunk, offset=obj_off))
         await asyncio.gather(*ops)
-        return len(data)
 
     async def read(self, offset: int, length: int) -> bytes:
         if self._closed:
@@ -389,6 +429,15 @@ class Image:
         """Punch a hole (reference:librbd discard -> zero/truncate/remove
         per object)."""
         self._check_open_rw()
+        if self._journal is not None:
+            await self._journal.append(
+                "discard", {"off": offset, "len": length}
+            )
+        await self._apply_discard_data(offset, length)
+        if self._journal is not None:
+            await self._journal.commit()
+
+    async def _apply_discard_data(self, offset: int, length: int) -> None:
         ops = []
         for objectno, obj_off, run in self._extents(offset, length):
             name = self._data_name(objectno)
@@ -450,6 +499,13 @@ class Image:
         """Grow or shrink (reference:librbd::resize; shrink removes the
         now-out-of-range data objects)."""
         self._check_open_rw()
+        if self._journal is not None:
+            await self._journal.append("resize", {"size": int(new_size)})
+        await self._apply_resize(new_size)
+        if self._journal is not None:
+            await self._journal.commit()
+
+    async def _apply_resize(self, new_size: int) -> None:
         old = self.size_bytes
         if new_size < old:
             first_dead = -(-new_size // self.object_size)

@@ -1,0 +1,232 @@
+"""RBD image journal tests (VERDICT r3 Missing #5 / Next #9 — the
+crash-consistency half of rbd-mirror, reference:src/librbd/journal/ +
+reference:src/journal/).
+
+The acceptance case: a client dies BETWEEN journaling a write and
+applying it to the data objects; a later open replays the journal and
+the write is there.  Plus: torn-tail discard, commit-position batching,
+replay idempotency, discard/resize events, and journal trim.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.rados import MiniCluster
+from ceph_tpu.rbd import RBD, Image
+from ceph_tpu.rbd.journal import (
+    COMMIT_KEY,
+    JOURNAL_PREFIX,
+    decode_frames,
+    encode_frame,
+)
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+ORDER = 14  # 16 KiB objects
+OBJ = 1 << ORDER
+
+
+async def _journaled_image(cl, name="jimg", size=8 * OBJ):
+    await cl.create_pool("rbd", "replicated", size=2)
+    io = cl.io_ctx("rbd")
+    rbd = RBD(io)
+    await rbd.create(name, size, order=ORDER, features=["journaling"])
+    return io, rbd
+
+
+class TestFraming:
+    def test_roundtrip_and_torn_tail(self):
+        f1 = encode_frame({"tid": 1, "op": "write", "off": 0}, b"abc")
+        f2 = encode_frame({"tid": 2, "op": "discard", "off": 9, "len": 4})
+        buf = f1 + f2
+        frames = list(decode_frames(buf))
+        assert [h["tid"] for _e, h, _p in frames] == [1, 2]
+        assert frames[0][2] == b"abc" and frames[1][2] == b""
+        # torn tail: partial third frame is silently dropped
+        f3 = encode_frame({"tid": 3, "op": "write", "off": 5}, b"zz")
+        for cut in (1, 7, len(f3) - 1):
+            frames = list(decode_frames(buf + f3[:cut]))
+            assert [h["tid"] for _e, h, _p in frames] == [1, 2]
+        # corrupt tail: flipped byte in the last frame
+        bad = bytearray(buf + f3)
+        bad[-1] ^= 0xFF
+        frames = list(decode_frames(bytes(bad)))
+        assert [h["tid"] for _e, h, _p in frames] == [1, 2]
+
+    def test_decode_from_offset(self):
+        f1 = encode_frame({"tid": 1, "op": "write", "off": 0}, b"abc")
+        f2 = encode_frame({"tid": 2, "op": "write", "off": 3}, b"de")
+        frames = list(decode_frames(f1 + f2, start=len(f1)))
+        assert len(frames) == 1 and frames[0][1]["tid"] == 2
+
+
+class TestCrashReplay:
+    def test_client_dies_between_journal_and_data_write(self):
+        """The acceptance case: the journal holds an event the data
+        objects never saw; a fresh open replays it."""
+
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                cl = await cluster.client()
+                io, _rbd = await _journaled_image(cl)
+                img = await Image.open(io, "jimg")
+                await img.write(0, b"base" * 1000)
+
+                # "crash": journal the event, then die before data ops
+                async def dead_apply(offset, data):
+                    raise RuntimeError("client died mid-write")
+
+                img._apply_write_data = dead_apply
+                with pytest.raises(RuntimeError):
+                    await img.write(OBJ - 100, b"X" * 300)  # spans 2 objects
+                # no close() — the client is gone
+
+                img2 = await Image.open(io, "jimg")
+                got = await img2.read(OBJ - 100, 300)
+                assert got == b"X" * 300, (
+                    "journaled write lost: replay did not apply it"
+                )
+                # earlier base data intact
+                assert await img2.read(0, 4000) == (b"base" * 1000)
+                await img2.close()
+
+        run(main())
+
+    def test_replay_is_idempotent_across_reopens(self):
+        """Dying again before the commit position advances means the
+        same events replay twice — byte-identical result."""
+
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                cl = await cluster.client()
+                io, _rbd = await _journaled_image(cl)
+                img = await Image.open(io, "jimg")
+                await img.write(100, b"A" * 500)
+                await img.write(OBJ, b"B" * 500)
+                # wipe the commit position: simulates dying before any
+                # commit flush (commit batching is COMMIT_EVERY=16)
+                await io.omap_set(img.header, {COMMIT_KEY: b"0"})
+                for _ in range(2):
+                    reopened = await Image.open(io, "jimg")
+                    assert await reopened.read(100, 500) == b"A" * 500
+                    assert await reopened.read(OBJ, 500) == b"B" * 500
+                    await reopened.close()
+
+        run(main())
+
+    def test_discard_and_resize_replay(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                cl = await cluster.client()
+                io, _rbd = await _journaled_image(cl)
+                img = await Image.open(io, "jimg")
+                await img.write(0, b"D" * (2 * OBJ))
+
+                real_discard = img._apply_discard_data
+
+                async def dead_discard(offset, length):
+                    raise RuntimeError("died mid-discard")
+
+                img._apply_discard_data = dead_discard
+                with pytest.raises(RuntimeError):
+                    await img.discard(0, OBJ)
+                img2 = await Image.open(io, "jimg")
+                assert await img2.read(0, OBJ) == b"\x00" * OBJ
+                assert await img2.read(OBJ, OBJ) == b"D" * OBJ
+                await img2.close()
+
+        run(main())
+
+    def test_torn_journal_tail_ignored_on_open(self):
+        """A half-appended frame (client died mid-append, before the op
+        was acked) must not break open/replay."""
+
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                cl = await cluster.client()
+                io, _rbd = await _journaled_image(cl)
+                img = await Image.open(io, "jimg")
+                await img.write(0, b"ok" * 100)
+                await img.close()
+                frame = encode_frame(
+                    {"tid": 99, "op": "write", "off": 0}, b"GARBAGE" * 50
+                )
+                await io.append(JOURNAL_PREFIX + img.image_id, frame[:17])
+                img2 = await Image.open(io, "jimg")
+                assert await img2.read(0, 200) == b"ok" * 100
+                # the torn tail was TRUNCATED at open, so a new event
+                # appended now is replayable — even if the writer dies
+                # again before applying it
+                async def dead_apply(offset, data):
+                    raise RuntimeError("died again")
+
+                real_apply = img2._apply_write_data
+                img2._apply_write_data = dead_apply
+                with pytest.raises(RuntimeError):
+                    await img2.write(500, b"more")
+                img3 = await Image.open(io, "jimg")
+                assert await img3.read(500, 4) == b"more", (
+                    "event appended after a torn tail was unreplayable"
+                )
+                await img3.close()
+
+        run(main())
+
+
+class TestJournalMaintenance:
+    def test_commit_position_advances_and_trims(self):
+        async def main():
+            from ceph_tpu.rbd import journal as J
+
+            async with MiniCluster(n_osds=3) as cluster:
+                cl = await cluster.client()
+                io, _rbd = await _journaled_image(cl)
+                img = await Image.open(io, "jimg")
+                old_trim = J.TRIM_BYTES
+                J.TRIM_BYTES = 4096  # force a trim quickly
+                try:
+                    for i in range(J.COMMIT_EVERY + 2):
+                        await img.write(0, bytes([i]) * 600)
+                    # commit flushed at least once
+                    h = await io.omap_get(img.header)
+                    assert int(h.get(COMMIT_KEY, b"0")) >= 0
+                    await img.close()  # force-commits + trims
+                    h = await io.omap_get(img.header)
+                    # after trim the position resets and the journal
+                    # object is gone or empty
+                    committed = int(h[COMMIT_KEY])
+                    try:
+                        jlen = len(
+                            await io.read(JOURNAL_PREFIX + img.image_id)
+                        )
+                    except Exception:
+                        jlen = 0
+                    assert committed == jlen, (committed, jlen)
+                finally:
+                    J.TRIM_BYTES = old_trim
+                img2 = await Image.open(io, "jimg")
+                assert (await img2.read(0, 600))[:1] == bytes(
+                    [J.COMMIT_EVERY + 1]
+                )
+                await img2.close()
+
+        run(main())
+
+    def test_unjournaled_image_has_no_journal(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                cl = await cluster.client()
+                await cl.create_pool("rbd", "replicated", size=2)
+                io = cl.io_ctx("rbd")
+                rbd = RBD(io)
+                await rbd.create("plain", 4 * OBJ, order=ORDER)
+                img = await Image.open(io, "plain")
+                assert img._journal is None
+                await img.write(0, b"x" * 100)
+                await img.close()
+
+        run(main())
