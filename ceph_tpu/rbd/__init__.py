@@ -19,5 +19,6 @@ write ownership and header watch/notify for cache invalidation.
 """
 
 from .image import RBD, Image, RbdError  # noqa: F401
+from .mirror import ImageMirrorer  # noqa: F401
 
-__all__ = ["RBD", "Image", "RbdError"]
+__all__ = ["RBD", "Image", "ImageMirrorer", "RbdError"]

@@ -95,6 +95,21 @@ class ImageJournal:
         await self.image.io.append(self.oid, frame)
         self.end += len(frame)
 
+    async def _min_client_position(self) -> "int | None":
+        """Smallest registered mirror-client position, or None when no
+        clients are registered (reference:JournalMetadata minimum commit
+        position over registered clients)."""
+        from .mirror import CLIENT_PREFIX
+
+        try:
+            h = await self.image.io.omap_get(self.image.header)
+        except RadosError:
+            return None
+        positions = [
+            int(v) for k, v in h.items() if k.startswith(CLIENT_PREFIX)
+        ]
+        return min(positions) if positions else None
+
     async def commit(self, *, force: bool = False) -> None:
         """Advance the durable commit position (batched: an opener
         replays at most COMMIT_EVERY idempotent events)."""
@@ -119,14 +134,26 @@ class ImageJournal:
     async def _trim(self) -> None:
         """Everything is committed: drop the journal object and reset
         the positions (the reference prunes whole journal objects once
-        the commit position passes them).  ORDER MATTERS: the durable
-        position resets to 0 BEFORE the object is removed — a crash in
-        between replays the (idempotent) committed events again, while
-        the reverse order would leave a stale position that makes every
-        later replay skip real events (r4 review)."""
-        await self.image.io.omap_set(
-            self.image.header, {COMMIT_KEY: b"0"}
-        )
+        the commit position passes them).  A registered mirror client
+        that has NOT consumed the journal holds the trim — rbd-mirror
+        must never lose events (reference minimum-commit-position
+        rule).  ORDER MATTERS: the durable positions reset BEFORE the
+        object is removed — a crash in between replays the (idempotent)
+        committed events again, while the reverse order would leave a
+        stale position that makes every later replay skip real events
+        (r4 review)."""
+        min_client = await self._min_client_position()
+        if min_client is not None and min_client < self.end:
+            return  # a mirror peer still needs these events
+        from .mirror import CLIENT_PREFIX
+
+        kv = {COMMIT_KEY: b"0"}
+        if min_client is not None:
+            h = await self.image.io.omap_get(self.image.header)
+            for k in h:
+                if k.startswith(CLIENT_PREFIX):
+                    kv[k] = b"0"  # clients consumed everything: reset
+        await self.image.io.omap_set(self.image.header, kv)
         try:
             await self.image.io.remove(self.oid)
         except RadosError as e:

@@ -17,5 +17,6 @@ Surfaces: :class:`RGWStore` (the programmatic S3 API),
 """
 
 from .store import RGWError, RGWStore  # noqa: F401
+from .sync import ZoneSyncer  # noqa: F401
 
-__all__ = ["RGWStore", "RGWError"]
+__all__ = ["RGWStore", "RGWError", "ZoneSyncer"]

@@ -180,11 +180,21 @@ class S3Server:
             json.dumps(obj).encode(),
         )
 
-    # -- request routing (RGWHandler_REST_S3 analog) -------------------------
+    # -- request routing (RGWHandler_REST_S3 analog; /auth + /v1 take
+    # the Swift handler, reference:src/rgw/rgw_rest_swift.cc +
+    # rgw_swift_auth.cc TempAuth) ---------------------------------------------
     async def _route(
         self, method: str, target: str, headers: dict, body: bytes
     ) -> tuple[int, dict, bytes]:
         try:
+            swift_path = urlsplit(target).path
+            # exact-segment matches only: an S3 bucket named "authors"
+            # or "auth-logs" must keep routing to the S3 handler (r4
+            # review: a bare startswith hijacked those buckets)
+            if swift_path == "/auth" or swift_path.startswith("/auth/"):
+                return await self._swift_auth(headers)
+            if swift_path == "/v1" or swift_path.startswith("/v1/"):
+                return await self._swift(method, target, headers, body)
             user = await self._auth(method, target, headers)
             if user is None:
                 h, b = self._json({"error": "access denied"})
@@ -314,3 +324,200 @@ class S3Server:
         info = await self.store.bucket_info(bucket)
         if info["owner"] != user["uid"]:
             raise RGWError(-13, "access denied")
+
+    # ===================== Swift API (rgw_rest_swift analog) ================
+
+    SWIFT_TOKEN_TTL = 3600.0
+
+    def _swift_token(self, user: dict, now: float | None = None) -> str:
+        """Stateless TempAuth-style token: uid + expiry, HMAC-signed
+        with the user's secret key (reference:rgw_swift_auth.cc builds
+        the same self-validating token from the swift key)."""
+        import time as _time
+
+        exp = int(
+            (now if now is not None else _time.time())
+            + self.SWIFT_TOKEN_TTL
+        )
+        sig = hmac.new(
+            user["secret_key"].encode(),
+            f"{user['uid']}|{exp}".encode(), hashlib.sha1,
+        ).hexdigest()
+        raw = json.dumps(
+            {"uid": user["uid"], "exp": exp, "sig": sig}
+        ).encode()
+        return "AUTH_tk" + base64.urlsafe_b64encode(raw).decode()
+
+    async def _swift_user(self, headers: dict) -> dict | None:
+        """Validate X-Auth-Token; returns the user or None."""
+        import time as _time
+
+        token = headers.get("x-auth-token", "")
+        if not token.startswith("AUTH_tk"):
+            return None
+        try:
+            d = json.loads(base64.urlsafe_b64decode(token[7:]))
+            uid, exp, sig = d["uid"], int(d["exp"]), d["sig"]
+        except (ValueError, KeyError, TypeError):
+            return None
+        if exp < _time.time():
+            return None
+        try:
+            user = await self.store.get_user(uid)
+        except RGWError:
+            return None
+        want = hmac.new(
+            user["secret_key"].encode(),
+            f"{uid}|{exp}".encode(), hashlib.sha1,
+        ).hexdigest()
+        if not hmac.compare_digest(sig, want):
+            return None
+        return user
+
+    async def _swift_auth(self, headers: dict) -> tuple[int, dict, bytes]:
+        """GET /auth/v1.0 with X-Auth-User "<uid>:swift" + X-Auth-Key
+        (the user's secret key) -> X-Auth-Token + X-Storage-Url
+        (Swift TempAuth, reference:rgw_swift_auth.cc)."""
+        auth_user = headers.get("x-auth-user", "")
+        auth_key = headers.get("x-auth-key", "")
+        uid = auth_user.split(":", 1)[0]
+        try:
+            user = await self.store.get_user(uid)
+        except RGWError:
+            return 401, *self._json({"error": "bad credentials"})
+        if not hmac.compare_digest(auth_key, user["secret_key"]):
+            return 401, *self._json({"error": "bad credentials"})
+        return 200, {
+            "x-auth-token": self._swift_token(user),
+            "x-storage-token": self._swift_token(user),
+            "x-storage-url": f"http://{self.addr}/v1/AUTH_{uid}",
+        }, b""
+
+    async def _swift(
+        self, method: str, target: str, headers: dict, body: bytes
+    ) -> tuple[int, dict, bytes]:
+        user = await self._swift_user(headers)
+        if user is None:
+            return 401, *self._json({"error": "invalid token"})
+        parts = urlsplit(target)
+        q = {
+            k: v[0] for k, v in parse_qs(
+                parts.query, keep_blank_values=True
+            ).items()
+        }
+        segs = unquote(parts.path).strip("/").split("/", 3)
+        # segs: ["v1", "AUTH_<acct>", container?, object?]
+        if len(segs) < 2 or not segs[1].startswith("AUTH_"):
+            return 404, *self._json({"error": "bad path"})
+        if segs[1] != f"AUTH_{user['uid']}":
+            return 403, *self._json({"error": "wrong account"})
+        container = segs[2] if len(segs) > 2 and segs[2] else None
+        obj = segs[3] if len(segs) > 3 and segs[3] else None
+        if container is None:
+            return await self._swift_account(method, user)
+        if obj is None:
+            return await self._swift_container(method, user, container, q)
+        return await self._swift_object(
+            method, user, container, obj, body, headers
+        )
+
+    async def _swift_account(self, method: str, user: dict):
+        if method not in ("GET", "HEAD"):
+            return 405, *self._json({"error": "bad method"})
+        names = await self.store.list_buckets(user["uid"])
+        if method == "HEAD":
+            return 204, {"x-account-container-count": str(len(names))}, b""
+        return 200, {"content-type": "text/plain"}, (
+            "\n".join(names) + ("\n" if names else "")
+        ).encode()
+
+    async def _swift_container(
+        self, method: str, user: dict, container: str, q: dict
+    ):
+        store = self.store
+        if method == "PUT":
+            try:
+                info = await store.bucket_info(container)
+            except RGWError as e:
+                if -e.code != 2:  # ENOENT: fresh name
+                    raise
+                await store.create_bucket(container, user["uid"])
+                return 201, {}, b""
+            if info["owner"] != user["uid"]:
+                # the container namespace is global: taken by another
+                # account is a 403, never a phantom "Created"
+                return 403, *self._json({"error": "access denied"})
+            return 202, {}, b""  # owner re-create: Swift Accepted
+        await self._check_owner(user, container)
+        if method == "DELETE":
+            await store.delete_bucket(container)
+            return 204, {}, b""
+        if method == "HEAD":
+            stats = await store.bucket_stats(container)
+            return 204, {
+                "x-container-object-count": str(stats["num_objects"]),
+                "x-container-bytes-used": str(stats["size_bytes"]),
+            }, b""
+        if method == "GET":
+            listing = await store.list_objects(
+                container,
+                prefix=q.get("prefix", ""),
+                marker=q.get("marker", ""),
+                delimiter=q.get("delimiter", ""),
+                max_keys=int(q.get("limit", 10000)),
+            )
+            names = [e["key"] for e in listing["contents"]]
+            names += listing.get("common_prefixes", [])
+            if q.get("format") == "json":
+                return 200, *self._json([
+                    {
+                        "name": e["key"], "bytes": e["size"],
+                        "hash": e["etag"],
+                    }
+                    for e in listing["contents"]
+                ])
+            return 200, {"content-type": "text/plain"}, (
+                "\n".join(sorted(names)) + ("\n" if names else "")
+            ).encode()
+        return 405, *self._json({"error": "bad method"})
+
+    async def _swift_object(
+        self, method: str, user: dict, container: str, obj: str,
+        body: bytes, headers: dict,
+    ):
+        await self._check_owner(user, container)
+        store = self.store
+        if method == "PUT":
+            entry = await store.put_object(
+                container, obj, body,
+                content_type=headers.get(
+                    "content-type", "application/octet-stream"
+                ),
+            )
+            return 201, {"etag": entry["etag"]}, b""
+        if method == "GET":
+            data, entry = await store.get_object(container, obj)
+            return 200, {
+                "content-type": entry.get(
+                    "content_type", "application/octet-stream"
+                ),
+                "etag": entry["etag"],
+            }, data
+        if method == "HEAD":
+            entry = await store.head_object(container, obj)
+            return 200, {
+                "content-length": str(entry["size"]),
+                "etag": entry["etag"],
+            }, b""
+        if method == "DELETE":
+            await store.delete_object(container, obj)
+            return 204, {}, b""
+        if method == "COPY":
+            dest = headers.get("destination", "")
+            dc, _, dk = dest.strip("/").partition("/")
+            if not dc or not dk:
+                return 400, *self._json({"error": "bad Destination"})
+            await self._check_owner(user, dc)
+            entry = await store.copy_object(container, obj, dc, dk)
+            return 201, {"etag": entry["etag"]}, b""
+        return 405, *self._json({"error": "bad method"})

@@ -32,33 +32,94 @@ def _now() -> float:
     return time.time()
 
 
+DATALOG_OBJ = "zone_datalog"  # per-zone change log (rgw_data_sync feed)
+DATALOG_MAX = 10000  # entries kept; laggards past this full-resync
+DATALOG_TRIM = 1000
+DATALOG_TRIMMED_KEY = "~trimmed_to"  # sorts after time_ns keys
+
+
 class RGWStore:
     """One gateway's view of the cluster (RGWRados analog)."""
 
-    def __init__(self, client: RadosClient):
+    def __init__(self, client: RadosClient, zone: str = ""):
+        # ``zone`` suffixes the pool names so multiple zones can share
+        # one cluster (the reference's zone-qualified pool placement,
+        # reference:src/rgw/rgw_zone.cc)
+        suffix = f".{zone}" if zone else ""
         self.client = client
-        self.meta = client.io_ctx(META_POOL)
-        self.index = client.io_ctx(INDEX_POOL)
-        self.data = client.io_ctx(DATA_POOL)
+        self.zone = zone
+        self.meta = client.io_ctx(META_POOL + suffix)
+        self.index = client.io_ctx(INDEX_POOL + suffix)
+        self.data = client.io_ctx(DATA_POOL + suffix)
+        self._log_seq = 0
+        self._log_count: int | None = None  # lazy; avoids per-op scans
+
+    # -- zone change log (reference:src/rgw/rgw_datalog.cc — every index
+    # mutation is recorded so a peer zone's sync agent can replay it;
+    # bounded: peers further behind than DATALOG_MAX detect the gap and
+    # full-resync, the reference's full-sync fallback) -----------------------
+    async def _log_change(self, op: str, bucket: str, key: str) -> None:
+        import time as _t
+
+        self._log_seq += 1
+        lk = f"{_t.time_ns():020d}{self._log_seq % 1000000:06d}"
+        await self.meta.omap_set(DATALOG_OBJ, {
+            lk: json.dumps(
+                {"op": op, "bucket": bucket, "key": key, "t": _now()}
+            ).encode()
+        })
+        if self._log_count is None:
+            raw = await self._omap(self.meta, DATALOG_OBJ)
+            self._log_count = sum(
+                1 for k in raw if k != DATALOG_TRIMMED_KEY
+            )
+        else:
+            self._log_count += 1
+        # the approximate counter keeps the write path free of full-log
+        # scans (r4 review); the real fetch happens only when a trim is
+        # actually due
+        if self._log_count > DATALOG_MAX and (
+            self._log_seq % DATALOG_TRIM == 0
+        ):
+            raw = await self._omap(self.meta, DATALOG_OBJ)
+            entries = sorted(k for k in raw if k != DATALOG_TRIMMED_KEY)
+            if len(entries) > DATALOG_MAX:
+                drop = entries[: len(entries) - DATALOG_MAX]
+                # the durable trim watermark lets a peer tell "behind
+                # the trimmed window" (full resync) from "caught up on
+                # an empty log" (incremental from here)
+                await self.meta.omap_set(
+                    DATALOG_OBJ, {DATALOG_TRIMMED_KEY: drop[-1].encode()}
+                )
+                await self.meta.omap_rmkeys(DATALOG_OBJ, drop)
+            self._log_count = min(len(entries), DATALOG_MAX)
+
+    async def datalog(self) -> "tuple[dict[str, dict], str]":
+        """(entries, trimmed_to watermark)."""
+        raw = await self._omap(self.meta, DATALOG_OBJ)
+        trimmed = raw.pop(DATALOG_TRIMMED_KEY, b"").decode()
+        return {k: json.loads(v) for k, v in raw.items()}, trimmed
 
     @classmethod
     async def create(
         cls, client: RadosClient,
         data_pool_type: str = "replicated",
         data_profile: str | None = None,
+        zone: str = "",
     ) -> "RGWStore":
         """Bootstrap: ensure the gateway pools exist
         (reference:rgw_rados.cc open_root_pool-style lazy creation).
         ``data_pool_type="erasure"`` puts object DATA on an EC pool —
         the omap-bearing index/meta pools stay replicated, the
         reference's .rgw.buckets.index split."""
-        for pool in (META_POOL, INDEX_POOL):
+        suffix = f".{zone}" if zone else ""
+        for pool in (META_POOL + suffix, INDEX_POOL + suffix):
             await client.create_pool(pool, "replicated")
         kw = {}
         if data_pool_type == "erasure" and data_profile:
             kw["erasure_code_profile"] = data_profile
-        await client.create_pool(DATA_POOL, data_pool_type, **kw)
-        return cls(client)
+        await client.create_pool(DATA_POOL + suffix, data_pool_type, **kw)
+        return cls(client, zone=zone)
 
     # -- users (reference:src/rgw/rgw_user.cc) -------------------------------
     async def create_user(
@@ -174,6 +235,7 @@ class RGWStore:
         await self.index.omap_set(
             self._index_obj(bucket), {key: json.dumps(entry).encode()}
         )
+        await self._log_change("put", bucket, key)
         return entry
 
     async def get_object(self, bucket: str, key: str) -> tuple[bytes, dict]:
@@ -193,6 +255,7 @@ class RGWStore:
             raise RGWError(-ENOENT, f"no object {bucket}/{key}")
         await self._data_obj(bucket, key).remove()
         await self.index.omap_rmkeys(self._index_obj(bucket), [key])
+        await self._log_change("del", bucket, key)
 
     async def copy_object(
         self, src_bucket: str, src_key: str, dst_bucket: str, dst_key: str
@@ -342,6 +405,7 @@ class RGWStore:
             [self._upload_key(key, upload)]
             + [self._part_key(key, upload, n) for n in parts],
         )
+        await self._log_change("put", bucket, key)
         return entry
 
     async def abort_multipart(

