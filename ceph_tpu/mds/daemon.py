@@ -28,6 +28,7 @@ META_POOL = ".cephfs.meta"
 DATA_POOL = ".cephfs.data"
 JOURNAL_OBJ = "mds_journal"
 META_OBJ = "mds_meta"
+SUBTREE_OBJ = "mds_subtrees"  # path -> owning rank (the subtree map)
 ROOT_INO = 1
 
 EEXIST = 17
@@ -35,8 +36,20 @@ EINVAL = 22
 ENOTDIR = 20
 EISDIR = 21
 ENOTEMPTY = 39
+EXDEV = 18
+EREMOTE = 66  # op belongs to another rank: reply carries the redirect
 
 JOURNAL_TRIM_EVERY = 256  # applied events kept before a trim
+MAX_MDS_RANKS = 16  # ino-allocation stride: rank r allocates r mod 16
+
+
+def _norm_path(path: str) -> str:
+    return "/" + "/".join(p for p in path.split("/") if p)
+
+
+def _parent_path(path: str) -> str:
+    parts = [p for p in path.split("/") if p]
+    return "/" + "/".join(parts[:-1])
 
 
 def _dir_obj(ino: int) -> str:
@@ -82,6 +95,20 @@ class MDSDaemon(Dispatcher):
         self._journal_seq = 0
         self._applied_seq = 0
         self._lock = asyncio.Lock()  # one metadata mutation at a time
+        # multi-active (reference:src/mds/MDSMap.h ranks): assigned by
+        # the mon; each rank has its own journal and owns the subtrees
+        # the subtree map assigns it
+        self.rank: int | None = None
+
+    @property
+    def _journal_obj(self) -> str:
+        # rank 0 keeps the legacy name so single-active stores upgrade
+        r = self.rank or 0
+        return JOURNAL_OBJ if r == 0 else f"{JOURNAL_OBJ}.{r}"
+
+    def _meta_key(self, base: str) -> str:
+        r = self.rank or 0
+        return base if r == 0 else f"{base}.{r}"
 
     # -- lifecycle -----------------------------------------------------------
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
@@ -113,13 +140,18 @@ class MDSDaemon(Dispatcher):
         await self.messenger.shutdown()
 
     async def _recover(self) -> None:
-        """Journal replay (reference:src/mds/MDLog.cc replay): re-apply
-        every event past the trim point — events are idempotent, so a
-        crash between journal write and dir update just replays."""
+        """Journal replay for THIS RANK (reference:src/mds/MDLog.cc
+        replay; rejoin of a failed rank's standby): re-apply every
+        event past the trim point — events are idempotent, so a crash
+        between journal write and dir update just replays."""
         meta = await self._omap(self.meta, META_OBJ)
-        self._next_ino = int(meta.get("next_ino", b"1"))
-        self._applied_seq = int(meta.get("applied_seq", b"0"))
-        journal = await self._omap(self.meta, JOURNAL_OBJ)
+        self._next_ino = int(
+            meta.get(self._meta_key("next_ino"), b"1")
+        )
+        self._applied_seq = int(
+            meta.get(self._meta_key("applied_seq"), b"0")
+        )
+        journal = await self._omap(self.meta, self._journal_obj)
         seqs = sorted(int(k) for k in journal)
         self._journal_seq = seqs[-1] if seqs else 0
         replayed = 0
@@ -131,11 +163,18 @@ class MDSDaemon(Dispatcher):
             self._applied_seq = seq
             replayed += 1
         if replayed:
-            logger.info("%s: replayed %d journal events", self.name, replayed)
+            logger.info(
+                "%s: rank %s replayed %d journal events",
+                self.name, self.rank, replayed,
+            )
             await self._checkpoint()
-        # ensure the root directory exists
-        if not await self._dir_exists(ROOT_INO):
-            await self.meta.omap_set(_dir_obj(ROOT_INO), {})
+        if self.rank == 0:
+            # rank 0 owns the root: ensure it and the subtree map exist
+            if not await self._dir_exists(ROOT_INO):
+                await self.meta.omap_set(_dir_obj(ROOT_INO), {})
+            table = await self._omap(self.meta, SUBTREE_OBJ)
+            if not table:
+                await self.meta.omap_set(SUBTREE_OBJ, {"/": b"0"})
 
     # -- beacon (same shape as the mgr's; MDSMonitor beacon analog) ----------
     @property
@@ -191,16 +230,30 @@ class MDSDaemon(Dispatcher):
                     conn.send(messages.MMonGetMap(have=None))
                     return
                 self.osdmap = m
-                is_me = self.osdmap.mds_name == self.name
-                if is_me and not self.active:
-                    logger.info("%s: now the ACTIVE mds", self.name)
-                    # adopt the journal tail BEFORE serving: an op that
-                    # raced replay would allocate inos the un-replayed
-                    # tail already owns
+                ranks = self.osdmap.mds_ranks or (
+                    [[self.osdmap.mds_name, self.osdmap.mds_addr]]
+                    if self.osdmap.mds_name else []
+                )
+                my_rank = next(
+                    (i for i, (n, _a) in enumerate(ranks)
+                     if n == self.name),
+                    None,
+                )
+                if my_rank is not None and (
+                    not self.active or self.rank != my_rank
+                ):
+                    logger.info(
+                        "%s: now ACTIVE as mds rank %d", self.name, my_rank
+                    )
+                    # adopt THIS RANK's journal tail BEFORE serving: an
+                    # op that raced replay would allocate inos the
+                    # un-replayed tail already owns
+                    self.rank = my_rank
                     await self._recover()
                     self.active = True
-                elif not is_me:
+                elif my_rank is None:
                     self.active = False
+                    self.rank = None
         elif isinstance(msg, messages.MMonCommandReply):
             if (msg.code == -11 and isinstance(msg.out, dict)
                     and msg.out.get("addr")):
@@ -226,7 +279,8 @@ class MDSDaemon(Dispatcher):
             else:
                 result, out = await handler(dict(msg.args or {}))
         except FSOpError as e:
-            result, out = e.code, {"error": str(e)}
+            result = e.code
+            out = e.out if e.out is not None else {"error": str(e)}
         except asyncio.CancelledError:
             raise
         except Exception as e:
@@ -241,7 +295,8 @@ class MDSDaemon(Dispatcher):
         """Write-ahead: the event hits RADOS before the dirs change."""
         self._journal_seq += 1
         await self.meta.omap_set(
-            JOURNAL_OBJ, {str(self._journal_seq): json.dumps(ev).encode()}
+            self._journal_obj,
+            {str(self._journal_seq): json.dumps(ev).encode()},
         )
 
     async def _mark_applied(self) -> None:
@@ -253,13 +308,14 @@ class MDSDaemon(Dispatcher):
         """Persist allocator + trim point, drop applied journal entries
         (reference:MDLog trim)."""
         await self.meta.omap_set(META_OBJ, {
-            "next_ino": str(self._next_ino).encode(),
-            "applied_seq": str(self._applied_seq).encode(),
+            self._meta_key("next_ino"): str(self._next_ino).encode(),
+            self._meta_key("applied_seq"):
+                str(self._applied_seq).encode(),
         })
-        journal = await self._omap(self.meta, JOURNAL_OBJ)
+        journal = await self._omap(self.meta, self._journal_obj)
         dead = [k for k in journal if int(k) <= self._applied_seq]
         if dead:
-            await self.meta.omap_rmkeys(JOURNAL_OBJ, dead)
+            await self.meta.omap_rmkeys(self._journal_obj, dead)
 
     async def _apply_event(self, ev: dict) -> None:
         """Idempotent application of one journal event to dir objects."""
@@ -267,9 +323,14 @@ class MDSDaemon(Dispatcher):
         if kind == "link":
             # replay must advance the allocator past every ino it sees,
             # or a failed-over MDS hands out inos that collide with live
-            # files (shared data objects = corruption)
+            # files (shared data objects = corruption).  The counter is
+            # in ALLOCATION units: invert the striped formula with a
+            # ceiling so even another rank's ino (a renamed-in entry)
+            # bounds us safely (r4 review: the un-inverted form blew the
+            # counter up ~16x per replay)
             self._next_ino = max(
-                self._next_ino, int(ev["inode"]["ino"]) - ROOT_INO
+                self._next_ino,
+                (int(ev["inode"]["ino"]) - ROOT_INO) // MAX_MDS_RANKS + 1,
             )
             await self.meta.omap_set(
                 _dir_obj(ev["dir"]),
@@ -340,12 +401,120 @@ class MDSDaemon(Dispatcher):
         raise AssertionError("unreachable")
 
     def _alloc_ino(self) -> int:
+        """Rank-striped allocation: rank r hands out inos congruent to
+        r mod MAX_MDS_RANKS, so concurrent active ranks can never
+        collide (the reference partitions its inotable per rank,
+        reference:src/mds/InoTable.h)."""
         self._next_ino += 1
-        return self._next_ino + ROOT_INO
+        return (
+            self._next_ino * MAX_MDS_RANKS + (self.rank or 0) + ROOT_INO
+        )
+
+    # -- subtree authority (reference:src/mds/MDCache.h subtree map +
+    # Migrator.cc export; collapsed to an authoritative path->rank table
+    # in the shared metadata pool) -------------------------------------------
+
+    _SUBTREE_TTL = 2.0
+
+    async def _subtree_table(self, fresh: bool = False) -> dict[str, int]:
+        """The subtree map, cached briefly (r4 review: a full omap read
+        per metadata op under the global lock).  Safe because ownership
+        only ever changes THROUGH the current owner (_op_export), which
+        invalidates its own cache — a stale cache can produce an extra
+        redirect hop, never a stale-positive claim of ownership."""
+        now = time.monotonic()
+        cache = getattr(self, "_subtree_cache", None)
+        if not fresh and cache is not None and (
+            now - cache[0] < self._SUBTREE_TTL
+        ):
+            return cache[1]
+        raw = await self._omap(self.meta, SUBTREE_OBJ)
+        table = {_norm_path(k): int(v) for k, v in raw.items()}
+        self._subtree_cache = (now, table)
+        return table
+
+    def _invalidate_subtrees(self) -> None:
+        self._subtree_cache = None
+
+    async def _authority(self, path: str) -> int:
+        """Longest-prefix owner of ``path`` in the subtree map; vacant
+        table or unmatched paths belong to rank 0."""
+        table = await self._subtree_table()
+        p = _norm_path(path)
+        best, best_len = 0, -1
+        for pref, rank in table.items():
+            if pref == "/" or p == pref or p.startswith(pref + "/"):
+                if len(pref) > best_len:
+                    best, best_len = rank, len(pref)
+        return best
+
+    async def _subtree_boundary_below(self, path: str) -> "str | None":
+        """A subtree-map entry at or beneath ``path`` (other than the
+        root default), or None.  Directory renames/removals across such
+        a boundary are refused: the table is keyed by path, so moving
+        the directory would silently re-home the exported subtree (the
+        reference freezes subtree bounds during such ops)."""
+        table = await self._subtree_table()
+        p = _norm_path(path)
+        for pref in table:
+            if pref == "/":
+                continue
+            if pref == p or pref.startswith(p + "/"):
+                return pref
+        return None
+
+    async def _require_auth(self, path: str) -> None:
+        """Mutations re-validate authority with a FRESH table read
+        UNDER the op lock: an export committing between dispatch and
+        lock acquisition must not let the old owner mutate (the
+        reference's export freeze/unfreeze exclusion)."""
+        auth = await self._authority(path)
+        if auth == self.rank:
+            return
+        ranks = self.osdmap.mds_ranks if self.osdmap else []
+        addr = (
+            ranks[auth][1]
+            if 0 <= auth < len(ranks) and ranks[auth][0] else ""
+        )
+        if not addr:
+            raise FSOpError(-11, f"rank {auth} has no active mds")
+        raise FSOpError(
+            -EREMOTE, f"subtree owned by rank {auth}",
+            out={"redirect": auth, "addr": addr},
+        )
+
+    async def _op_export(self, args: dict) -> tuple[int, dict]:
+        """Move a subtree's authority to another rank (reference:
+        src/mds/Migrator.cc collapsed: dir objects live in shared
+        RADOS, so migration IS the table handoff — flush our journal,
+        then commit the new owner)."""
+        path = _norm_path(args["path"])
+        rank = int(args["rank"])
+        ranks = self.osdmap.mds_ranks if self.osdmap else []
+        if not (0 <= rank < len(ranks)) or not ranks[rank][0]:
+            return -EINVAL, {"error": f"rank {rank} is not active"}
+        async with self._lock:
+            self._invalidate_subtrees()  # decide on the durable table
+            await self._require_auth(path)
+            _parent, _name, inode = await self._resolve(path)
+            if inode is None or inode["type"] != "dir":
+                return -ENOTDIR, {"error": f"{path!r} is not a directory"}
+            # drain: everything journaled here is applied before the
+            # handoff, so the new owner starts from committed state
+            await self._checkpoint()
+            await self.meta.omap_set(
+                SUBTREE_OBJ, {path: str(rank).encode()}
+            )
+            self._invalidate_subtrees()
+        logger.info(
+            "%s: exported subtree %s -> rank %d", self.name, path, rank
+        )
+        return 0, {"path": path, "rank": rank}
 
     # -- ops (reference:src/mds/Server.cc handle_client_*) -------------------
     async def _op_mkdir(self, args: dict) -> tuple[int, dict]:
         async with self._lock:
+            await self._require_auth(_parent_path(args["path"]))
             parent, name, inode = await self._resolve(args["path"])
             if not name:
                 return -EEXIST, {"error": "/ exists"}
@@ -363,6 +532,7 @@ class MDSDaemon(Dispatcher):
 
     async def _op_create(self, args: dict) -> tuple[int, dict]:
         async with self._lock:
+            await self._require_auth(_parent_path(args["path"]))
             parent, name, inode = await self._resolve(args["path"])
             if inode is not None:
                 if inode["type"] == "dir":
@@ -399,6 +569,7 @@ class MDSDaemon(Dispatcher):
 
     async def _op_unlink(self, args: dict) -> tuple[int, dict]:
         async with self._lock:
+            await self._require_auth(_parent_path(args["path"]))
             parent, name, inode = await self._resolve(args["path"])
             if inode is None:
                 return -ENOENT, {"error": f"no such entry {name!r}"}
@@ -415,6 +586,7 @@ class MDSDaemon(Dispatcher):
 
     async def _op_rmdir(self, args: dict) -> tuple[int, dict]:
         async with self._lock:
+            await self._require_auth(_parent_path(args["path"]))
             parent, name, inode = await self._resolve(args["path"])
             if inode is None:
                 return -ENOENT, {"error": f"no such entry {name!r}"}
@@ -423,6 +595,10 @@ class MDSDaemon(Dispatcher):
             children = await self._omap(self.meta, _dir_obj(inode["ino"]))
             if children:
                 return -ENOTEMPTY, {"error": "directory not empty"}
+            boundary = await self._subtree_boundary_below(args["path"])
+            if boundary is not None:
+                return -16, {"error": f"subtree boundary {boundary!r}: "
+                                      "export it back before rmdir"}
             for ev in (
                 {"kind": "unlink", "dir": parent, "name": name},
                 {"kind": "rmdir_obj", "ino": inode["ino"]},
@@ -443,6 +619,20 @@ class MDSDaemon(Dispatcher):
                 # it as an unreachable cycle (POSIX EINVAL)
                 return -EINVAL, {"error": "cannot move a directory "
                                           "into itself"}
+            await self._require_auth(_parent_path(args["src"]))
+            for side in ("src", "dst"):
+                boundary = await self._subtree_boundary_below(args[side])
+                if boundary is not None:
+                    # the subtree map is path-keyed: renaming over a
+                    # boundary would silently re-home the export
+                    return -16, {"error": f"subtree boundary "
+                                          f"{boundary!r} under {side}"}
+            dst_auth = await self._authority(_parent_path(args["dst"]))
+            if dst_auth != self.rank:
+                # the reference migrates for cross-rank renames
+                # (Migrator); here the subtree handoff is explicit, so
+                # clients see the POSIX cross-device answer instead
+                return -EXDEV, {"error": "rename crosses mds subtrees"}
             sparent, sname, sinode = await self._resolve(args["src"])
             if sinode is None:
                 return -ENOENT, {"error": f"no such entry {sname!r}"}
@@ -469,6 +659,7 @@ class MDSDaemon(Dispatcher):
 
     async def _op_setattr(self, args: dict) -> tuple[int, dict]:
         async with self._lock:
+            await self._require_auth(_parent_path(args["path"]))
             parent, name, inode = await self._resolve(args["path"])
             if inode is None:
                 return -ENOENT, {"error": f"no such entry {name!r}"}
@@ -489,6 +680,7 @@ class MDSDaemon(Dispatcher):
 
 
 class FSOpError(Exception):
-    def __init__(self, code: int, msg: str):
+    def __init__(self, code: int, msg: str, out: dict | None = None):
         super().__init__(msg)
         self.code = code
+        self.out = out

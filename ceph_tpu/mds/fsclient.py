@@ -18,6 +18,7 @@ from .daemon import DATA_POOL, data_obj
 logger = logging.getLogger("ceph_tpu.cephfs")
 
 EAGAIN = 11
+EREMOTE = 66  # forwarded to the authoritative rank (multi-active)
 
 
 class FSError(RadosError):
@@ -43,6 +44,7 @@ class CephFSClient:
     async def _mds(self, op: str, **args) -> dict:
         cl = self.client
         last = None
+        target: "tuple[str, str] | None" = None  # (addr, name) override
         for _attempt in range(cl.max_retries):
             m = cl.osdmap
             if m is None or not m.mds_addr:
@@ -50,8 +52,10 @@ class CephFSClient:
                     m.epoch if m else -1, cl.op_timeout
                 )
                 continue
+            addr, name = target or (m.mds_addr, m.mds_name)
+            target = None
             try:
-                conn = await cl.messenger.connect(m.mds_addr, m.mds_name)
+                conn = await cl.messenger.connect(addr, name)
                 # the client's own allocator: private counters collide
                 # in the shared _op_futs map across mounts
                 tid = next(cl._tid)
@@ -75,6 +79,19 @@ class CephFSClient:
                 # standby answered / failover raced: wait for a map that
                 # names the real active and retry (Objecter-style resend)
                 await cl._wait_for_map_change(cl.osdmap.epoch, 2.0)
+                continue
+            if (
+                reply.result == -EREMOTE
+                and isinstance(reply.out, dict)
+                and reply.out.get("addr")
+            ):
+                # multi-active: the subtree lives on another rank —
+                # follow the forward (reference:Server.cc
+                # respond_to_request forwarding to the auth mds)
+                rank = reply.out.get("redirect")
+                target = (
+                    reply.out["addr"], f"mds.rank{rank}"
+                )
                 continue
             if reply.result < 0:
                 raise FSError(
@@ -110,6 +127,12 @@ class CephFSClient:
 
     async def rename(self, src: str, dst: str) -> None:
         await self._mds("rename", src=src, dst=dst)
+
+    async def export_subtree(self, path: str, rank: int) -> dict:
+        """Move a subtree's authority to another MDS rank (admin op,
+        reference: `ceph mds export dir`); routed to the current owner
+        via the redirect protocol like any other op."""
+        return await self._mds("export", path=path, rank=rank)
 
     async def statfs(self) -> dict:
         return await self._mds("statfs")

@@ -979,6 +979,7 @@ class Monitor(Dispatcher):
                 "mgr prune-standbys": lambda c: self._cmd_svc_prune("mgr", c),
                 "mds beacon": lambda c: self._cmd_svc_beacon("mds", c),
                 "mds fail": lambda c: self._cmd_svc_fail("mds", c),
+                "fs set max_mds": self._cmd_fs_set_max_mds,
                 "mds prune-standbys": lambda c: self._cmd_svc_prune("mds", c),
                 "osd down": self._cmd_osd_down,
                 "osd out": self._cmd_osd_out,
@@ -1382,7 +1383,124 @@ class Monitor(Dispatcher):
         setattr(m, f"{svc}_addr", addr)
         setattr(m, f"{svc}_standbys", standbys)
 
+    # -- multi-active MDS rank table (reference:src/mon/MDSMonitor.cc
+    # maybe_promote_standby / MDSMap in-rank assignment) --------------------
+
+    def _mds_ranks(self) -> list[list[str]]:
+        """The rank table grown to mds_max (vacant slots are ["",""]);
+        occupied slots past a shrunken mds_max are kept until they fail
+        (the reference requires deactivation to shrink)."""
+        m = self.osdmap
+        ranks = [list(r) for r in m.mds_ranks]
+        if not ranks and m.mds_name:
+            ranks = [[m.mds_name, m.mds_addr]]  # upgraded single-active
+        want = max(1, int(m.mds_max))
+        while len(ranks) < want:
+            ranks.append(["", ""])
+        while len(ranks) > want and not ranks[-1][0]:
+            ranks.pop()
+        return ranks
+
+    def _mds_set_ranks(self, ranks: list[list[str]],
+                       standbys: list) -> None:
+        m = self.osdmap
+        m.mds_ranks = [list(r) for r in ranks]
+        # rank 0 mirrors into the legacy single-active fields
+        m.mds_name, m.mds_addr = (
+            ranks[0] if ranks and ranks[0][0] else ("", "")
+        )
+        m.mds_standbys = standbys
+        self._mark_dirty()
+
+    def _cmd_mds_beacon(self, cmd: dict) -> tuple[int, str, Any]:
+        name, addr = cmd["name"], cmd["addr"]
+        self._svc_beacons[("mds", name)] = time.monotonic()
+        ranks = self._mds_ranks()
+        standbys = list(self.osdmap.mds_standbys)
+        for i, (n, a) in enumerate(ranks):
+            if n == name:
+                if a != addr:  # restarted on a new port
+                    ranks[i][1] = addr
+                    self._mds_set_ranks(ranks, standbys)
+                return 0, "", {"active": True, "rank": i}
+        for i, (n, _a) in enumerate(ranks):
+            if not n:
+                ranks[i] = [name, addr]
+                self._mds_set_ranks(
+                    ranks, [(sn, sa) for sn, sa in standbys if sn != name]
+                )
+                logger.info(
+                    "%s: mds %s takes rank %d", self.name, name, i
+                )
+                return 0, "", {"active": True, "rank": i}
+        known = dict(standbys)
+        if known.get(name) != addr:
+            known[name] = addr
+            self._mds_set_ranks(ranks, sorted(known.items()))
+        return 0, "", {"active": False}
+
+    def _cmd_mds_fail(self, cmd: dict) -> tuple[int, str, Any]:
+        """Vacate the named daemon's rank (or rank 0) and promote a
+        FRESH standby into exactly that rank, so its journal and
+        subtrees are adopted by the successor."""
+        ranks = self._mds_ranks()
+        target = cmd.get("name") or (ranks[0][0] if ranks else "")
+        standbys = list(self.osdmap.mds_standbys)
+        for i, (n, _a) in enumerate(ranks):
+            if n == target and n:
+                self._svc_beacons.pop(("mds", n), None)
+                live = [
+                    (sn, sa) for sn, sa in standbys
+                    if self._svc_fresh("mds", sn)
+                ]
+                if live:
+                    (new, new_addr), *_rest = live
+                    ranks[i] = [new, new_addr]
+                    standbys = [t for t in standbys if t[0] != new]
+                    logger.info(
+                        "%s: mds rank %d failed over %s -> %s",
+                        self.name, i, target, new,
+                    )
+                else:
+                    ranks[i] = ["", ""]
+                self._mds_set_ranks(ranks, standbys)
+                return 0, f"mds {target} failed", None
+        return 0, f"mds {target!r} holds no rank", None
+
+    def _cmd_fs_set_max_mds(self, cmd: dict) -> tuple[int, str, Any]:
+        from ..mds.daemon import MAX_MDS_RANKS
+
+        n = int(cmd.get("val", cmd.get("max_mds", 1)))
+        if not 1 <= n <= MAX_MDS_RANKS:
+            # the ino-allocation stripe has MAX_MDS_RANKS lanes; a rank
+            # past it would collide with rank (r mod stripe) and corrupt
+            # shared data objects (r4 review)
+            return (
+                -EINVAL,
+                f"max_mds must be in [1, {MAX_MDS_RANKS}]",
+                None,
+            )
+        self.osdmap.mds_max = n
+        ranks = self._mds_ranks()
+        standbys = list(self.osdmap.mds_standbys)
+        for i, (rn, _a) in enumerate(ranks):
+            if rn:
+                continue
+            live = [
+                (sn, sa) for sn, sa in standbys
+                if self._svc_fresh("mds", sn)
+            ]
+            if not live:
+                break
+            (new, new_addr), *_ = live
+            ranks[i] = [new, new_addr]
+            standbys = [t for t in standbys if t[0] != new]
+        self._mds_set_ranks(ranks, standbys)
+        return 0, f"max_mds = {n}", {"ranks": ranks}
+
     def _cmd_svc_beacon(self, svc: str, cmd: dict) -> tuple[int, str, Any]:
+        if svc == "mds":
+            return self._cmd_mds_beacon(cmd)
         name, addr = cmd["name"], cmd["addr"]
         active, active_addr, standbys = self._svc_fields(svc)
         self._svc_beacons[(svc, name)] = time.monotonic()
@@ -1416,6 +1534,8 @@ class Monitor(Dispatcher):
         """Demote the active daemon (operator command / beacon-staleness
         path); the first standby with a FRESH beacon is promoted — a
         dead standby would just re-fail a tick later."""
+        if svc == "mds":
+            return self._cmd_mds_fail(cmd)
         active, _addr, standbys = self._svc_fields(svc)
         if not active:
             return 0, f"no active {svc}", None
@@ -1447,6 +1567,9 @@ class Monitor(Dispatcher):
         """Leader-side staleness check, called from the tick path: an
         active daemon silent past the grace is failed over; long-dead
         standbys are pruned from the map."""
+        if svc == "mds":
+            self._check_mds_beacons(grace)
+            return
         active, _addr, standbys = self._svc_fields(svc)
         now = time.monotonic()
         for n, _a in standbys:
@@ -1476,6 +1599,33 @@ class Monitor(Dispatcher):
             # slow commit from queueing a SECOND fail that would demote
             # the freshly promoted standby too.
             self._spawn_svc_cmd(svc, {"prefix": f"{svc} fail"})
+
+    def _check_mds_beacons(self, grace: float) -> None:
+        """Per-rank staleness: each occupied rank is failed over
+        independently (one rank's death must not demote the others)."""
+        now = time.monotonic()
+        for n, _a in self.osdmap.mds_standbys:
+            self._svc_beacons.setdefault(("mds", n), now)
+        if any(
+            not self._svc_fresh("mds", n, grace=grace * 3)
+            for n, _a in self.osdmap.mds_standbys
+        ) and not self._svc_fail_pending["mds"]:
+            self._spawn_svc_cmd(
+                "mds",
+                {"prefix": "mds prune-standbys", "grace": grace * 3},
+            )
+        for rn, _addr in self._mds_ranks():
+            if not rn:
+                continue
+            last = self._svc_beacons.get(("mds", rn))
+            if last is None:
+                self._svc_beacons[("mds", rn)] = now
+                continue
+            if now - last > grace and not self._svc_fail_pending["mds"]:
+                self._spawn_svc_cmd(
+                    "mds", {"prefix": "mds fail", "name": rn}
+                )
+                return  # one at a time; the next tick handles the rest
 
     def _spawn_svc_cmd(self, svc: str, cmd: dict) -> None:
         self._svc_fail_pending[svc] = True

@@ -216,6 +216,11 @@ class OSDMap:
         self.mds_name = ""
         self.mds_addr = ""
         self.mds_standbys: list[tuple[str, str]] = []
+        # multi-active MDS (reference:src/mds/MDSMap.h in/up rank maps):
+        # rank -> [name, addr] ("" = vacant/failed rank awaiting a
+        # standby); mds_name/mds_addr mirror rank 0 for older callers
+        self.mds_ranks: list[list[str]] = []
+        self.mds_max = 1
 
     # -- device lifecycle ----------------------------------------------------
 
@@ -565,6 +570,8 @@ class OSDMap:
             "mds_name": self.mds_name,
             "mds_addr": self.mds_addr,
             "mds_standbys": list(self.mds_standbys),
+            "mds_ranks": [list(r) for r in self.mds_ranks],
+            "mds_max": self.mds_max,
         }
 
     @classmethod
@@ -600,6 +607,8 @@ class OSDMap:
         m.mds_name = d.get("mds_name", "")
         m.mds_addr = d.get("mds_addr", "")
         m.mds_standbys = [tuple(x) for x in d.get("mds_standbys", [])]
+        m.mds_ranks = [list(x) for x in d.get("mds_ranks", [])]
+        m.mds_max = int(d.get("mds_max", 1))
         return m
 
 

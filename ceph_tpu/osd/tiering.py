@@ -127,11 +127,17 @@ class BloomHitSet:
         import struct
 
         nbits, k, inserted = struct.unpack_from(">IIQ", raw)
+        nbytes = (nbits + 7) // 8
+        if len(raw) < 16 + nbytes or nbits == 0 or k == 0:
+            # a truncated payload must fail HERE, inside from_omap's
+            # corruption guard — not as an IndexError in the agent's
+            # hot path later (r4 review)
+            raise ValueError("truncated bloom hit set")
         hs = cls.__new__(cls)
         hs.nbits = nbits
         hs.k = k
         hs.inserted = inserted
-        hs.bits = bytearray(raw[16 : 16 + (nbits + 7) // 8])
+        hs.bits = bytearray(raw[16 : 16 + nbytes])
         return hs
 
 

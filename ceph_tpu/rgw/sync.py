@@ -124,12 +124,19 @@ class ZoneSyncer:
         return {"phase": "incremental", "applied": applied}
 
     async def _full_sync(self) -> int:
+        """Reconcile, not just copy: destination objects and buckets
+        that no longer exist at the source are DELETED (r4 review: a
+        trim-gap recovery that only copied left deleted-at-source data
+        serving forever — the reference's full sync diffs the bucket
+        index the same way)."""
         await self._sync_users()
         applied = 0
-        for bucket in await self.src.list_buckets():
+        src_buckets = await self.src.list_buckets()
+        for bucket in src_buckets:
             if not await self._ensure_bucket(bucket):
                 continue
             listing = await self.src.list_objects(bucket, max_keys=1000000)
+            src_keys = {e["key"] for e in listing["contents"]}
             for e in listing["contents"]:
                 try:
                     data, meta = await self.src.get_object(bucket, e["key"])
@@ -143,4 +150,31 @@ class ZoneSyncer:
                                           "binary/octet-stream"),
                 )
                 applied += 1
+            dst_listing = await self.dst.list_objects(
+                bucket, max_keys=1000000
+            )
+            for e in dst_listing["contents"]:
+                if e["key"] not in src_keys:
+                    try:
+                        await self.dst.delete_object(bucket, e["key"])
+                        applied += 1
+                    except RGWError as err:
+                        if -err.code != ENOENT:
+                            raise
+        for bucket in await self.dst.list_buckets():
+            if bucket in src_buckets:
+                continue
+            listing = await self.dst.list_objects(bucket, max_keys=1000000)
+            for e in listing["contents"]:
+                try:
+                    await self.dst.delete_object(bucket, e["key"])
+                except RGWError as err:
+                    if -err.code != ENOENT:
+                        raise
+            try:
+                await self.dst.delete_bucket(bucket)
+                applied += 1
+            except RGWError as err:
+                if -err.code != ENOENT:
+                    raise
         return applied

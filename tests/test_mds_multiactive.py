@@ -1,0 +1,154 @@
+"""Multi-active MDS tests (VERDICT r3 Missing #7 —
+reference:src/mds/MDSMap.h rank assignment, src/mds/Migrator.cc subtree
+export, MDSMonitor.cc per-rank failover): two active ranks serve
+disjoint subtrees, exports hand authority over with a journal flush,
+clients follow redirects transparently, a failed rank's standby rejoins
+into exactly that rank (replaying its journal), and rank-striped ino
+allocation never collides."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.mds import CephFSClient, FSError
+from ceph_tpu.mds.daemon import MAX_MDS_RANKS, ROOT_INO
+from ceph_tpu.rados import MiniCluster
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+async def _fs(cluster) -> CephFSClient:
+    cl = await cluster.client()
+    return await CephFSClient.mount(cl)
+
+
+async def _two_active(cluster, names=("mds.a", "mds.b")):
+    for n in names:
+        await cluster.start_mds(n)
+    await cluster.wait_for_active_mds()
+    cl = await cluster.client()
+    code, status, _out = await cl.command(
+        {"prefix": "fs set max_mds", "val": 2}
+    )
+    assert code == 0, status
+    async with asyncio.timeout(10):
+        while sum(
+            1 for m in cluster.mdss.values() if m.active
+        ) < 2:
+            await asyncio.sleep(0.02)
+    ranks = {m.rank: m for m in cluster.mdss.values() if m.active}
+    assert set(ranks) == {0, 1}
+    return cl, ranks
+
+
+class TestMultiActive:
+    def test_two_ranks_and_subtree_export(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                _cl, ranks = await _two_active(cluster)
+                fs = await _fs(cluster)
+                await fs.mkdir("/shared")
+                await fs.mkdir("/shared/sub")
+                # export /shared to rank 1; ops under it now redirect
+                out = await fs.export_subtree("/shared", 1)
+                assert out["rank"] == 1
+                # mutations under the subtree must be SERVED by rank 1
+                served = {0: [], 1: []}
+                for r, mds in ranks.items():
+                    orig = mds._op_mkdir
+
+                    async def traced(args, _r=r, _orig=orig):
+                        res = await _orig(args)
+                        served[_r].append(args["path"])
+                        return res
+
+                    mds._op_mkdir = traced
+                await fs.mkdir("/shared/sub/deep")  # redirect -> rank 1
+                await fs.mkdir("/top")              # rank 0 (root)
+                assert served[1] == ["/shared/sub/deep"], served
+                assert served[0] == ["/top"], served
+                entries = await fs.readdir("/shared/sub")
+                assert list(entries) == ["deep"]
+                st = await fs.stat("/shared/sub/deep")
+                # rank-striped ino: allocated by rank 1
+                assert (st["ino"] - ROOT_INO) % MAX_MDS_RANKS == 1
+                st0 = await fs.stat("/top")
+                assert (st0["ino"] - ROOT_INO) % MAX_MDS_RANKS == 0
+
+        run(main())
+
+    def test_cross_subtree_rename_is_exdev(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                _cl, _ranks = await _two_active(cluster)
+                fs = await _fs(cluster)
+                await fs.mkdir("/a")
+                await fs.mkdir("/b")
+                await fs.export_subtree("/b", 1)
+                await fs.write_file("/a/f", b"x")
+                with pytest.raises(FSError) as ei:
+                    await fs.rename("/a/f", "/b/f")
+                assert ei.value.code == -18  # EXDEV
+                # same-subtree rename still fine
+                await fs.rename("/a/f", "/a/g")
+                assert await fs.read_file("/a/g") == b"x"
+
+        run(main())
+
+    def test_rank_failover_rejoins_with_journal(self):
+        """Kill rank 1; the standby must be promoted into RANK 1
+        specifically, replay rank 1's journal, and keep serving the
+        exported subtree."""
+
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                cl, ranks = await _two_active(cluster)
+                await cluster.start_mds("mds.c")  # standby
+                fs = await _fs(cluster)
+                await fs.mkdir("/exp")
+                await fs.export_subtree("/exp", 1)
+                await fs.write_file("/exp/file", b"survives")
+                victim = ranks[1].name
+                await cluster.kill_mds(victim)
+                code, _s, _o = await cl.command(
+                    {"prefix": "mds fail", "name": victim}
+                )
+                assert code == 0
+                async with asyncio.timeout(15):
+                    while not any(
+                        m.active and m.rank == 1
+                        for m in cluster.mdss.values()
+                    ):
+                        await asyncio.sleep(0.05)
+                successor = next(
+                    m for m in cluster.mdss.values()
+                    if m.active and m.rank == 1
+                )
+                assert successor.name == "mds.c"
+                # the exported subtree still serves (journal rejoined)
+                assert await fs.read_file("/exp/file") == b"survives"
+                await fs.write_file("/exp/more", b"new writes ok")
+                assert await fs.read_file("/exp/more") == b"new writes ok"
+
+        run(main())
+
+    def test_ino_allocators_never_collide(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                _cl, _ranks = await _two_active(cluster)
+                fs = await _fs(cluster)
+                await fs.mkdir("/r0")
+                await fs.mkdir("/r1")
+                await fs.export_subtree("/r1", 1)
+                inos = set()
+                for i in range(12):
+                    await fs.write_file(f"/r0/f{i}", b"0")
+                    await fs.write_file(f"/r1/f{i}", b"1")
+                for i in range(12):
+                    inos.add((await fs.stat(f"/r0/f{i}"))["ino"])
+                    inos.add((await fs.stat(f"/r1/f{i}"))["ino"])
+                assert len(inos) == 24, "ino collision across ranks"
+
+        run(main())
