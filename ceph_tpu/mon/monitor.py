@@ -335,6 +335,13 @@ class Monitor(Dispatcher):
                     return
                 self.osdmap = m
                 self._last_map_dict = self.osdmap.to_dict()
+                # accepted values at or below the adopted COMMITTED
+                # epoch are superseded: keeping them would let a later
+                # delta propose seed from the dead branch (r4 review)
+                for v in [v for v in self._pending_commit
+                          if v <= self.osdmap.epoch]:
+                    del self._pending_commit[v]
+                self._sync_accepted()
                 if msg.committed_epoch is not None:
                     self.map_committed_epoch = msg.committed_epoch
                 self._save_store()
@@ -701,6 +708,42 @@ class Monitor(Dispatcher):
 
     # -- replicated commit (Paxos-lite) ---------------------------------------
 
+    def _paxos_decode_value(self, msg: messages.MMonPaxos) -> "dict | None":
+        """Materialize the FULL map dict a propose carries: snapshot,
+        legacy bare dict, or a delta applied to this mon's own state
+        (the O(churn) wire form).  None = cannot derive (caller answers
+        need_full).  The accepted REGISTER always stores full maps, so
+        election recovery is untouched by the wire encoding."""
+        import json as _json
+
+        val = msg.value
+        if not isinstance(val, dict):
+            return None
+        if "inc" in val and "epoch" not in val:
+            inc_d = val["inc"]
+            base_epoch = int(inc_d["base"])
+            base_dict = None
+            # COMMITTED state first: an accepted-but-uncommitted value
+            # at the base version may have been superseded by another
+            # quorum's commit we later caught up to (r4 review: seeding
+            # the delta from the stale register forked the map)
+            if self.osdmap.epoch == base_epoch:
+                base_dict = self._last_map_dict or self.osdmap.to_dict()
+            else:
+                pend = self._pending_commit.get(base_epoch)
+                if pend is not None and (
+                    int(pend[1].get("epoch", -1)) == base_epoch
+                ):
+                    base_dict = pend[1]
+            if base_dict is None:
+                return None
+            full = _json.loads(_json.dumps(base_dict))  # private copy
+            Incremental.from_dict(inc_d).apply_to_dict(full)
+            return full
+        if "full" in val and "epoch" not in val:
+            return val["full"]
+        return val  # legacy bare map dict
+
     async def _handle_paxos(self, msg: messages.MMonPaxos) -> None:
         if msg.op == "propose":
             if msg.rank != self.leader_rank or msg.epoch < self.election_epoch:
@@ -709,11 +752,20 @@ class Monitor(Dispatcher):
                 # get its proposal accepted (reference Paxos rejects
                 # lower proposal numbers in the accept phase)
                 return
+            full = self._paxos_decode_value(msg)
+            if full is None:
+                # we lack the delta's base (restarted / lagging): ask
+                # the leader to re-propose with the snapshot
+                await self._send_peer(msg.rank, messages.MMonPaxos(
+                    op="need_full", epoch=msg.epoch, rank=self.rank,
+                    version=msg.version, value=None,
+                ))
+                return
             # keep only the newest pending value: uncommitted older
             # snapshots are superseded and would otherwise accumulate
             for v in [v for v in self._pending_commit if v < msg.version]:
                 del self._pending_commit[v]
-            self._pending_commit[msg.version] = (msg.epoch, msg.value)
+            self._pending_commit[msg.version] = (msg.epoch, full)
             # persist the accepted register BEFORE acking: the ack is a
             # durable promise — if we crash and restart, the election
             # recovery must still be able to surface this value
@@ -723,6 +775,20 @@ class Monitor(Dispatcher):
                 op="ack", epoch=msg.epoch, rank=self.rank,
                 version=msg.version, value=None,
             ))
+        elif msg.op == "need_full":
+            # a peon could not apply our delta: re-propose the snapshot
+            # to exactly that rank (reference Paxos catch-up share)
+            if (
+                self.is_leader
+                and msg.version == self.osdmap.epoch
+                and msg.epoch >= self._victory_epoch
+            ):
+                await self._send_peer(msg.rank, messages.MMonPaxos(
+                    op="propose", epoch=self.election_epoch,
+                    rank=self.rank, version=msg.version,
+                    value={"full": self._last_map_dict
+                           or self.osdmap.to_dict()},
+                ))
         elif msg.op == "ack":
             acks = self._paxos_acks.get(msg.version)
             if acks is not None:
@@ -745,6 +811,15 @@ class Monitor(Dispatcher):
                 self.map_committed_epoch = msg.epoch
                 self._save_store(inc=inc)
                 self._publish_subs()
+            elif entry is None and msg.version > self.osdmap.epoch:
+                # the quorum committed a version we never accepted (our
+                # need_full round-trip raced the majority): catch up
+                # from the leader instead of silently staying stale
+                # (r4 review — with full-value proposes this could not
+                # happen; deltas opened the window)
+                await self._send_peer(msg.rank, messages.MMonGetMap(
+                    have=self.osdmap.epoch
+                ))
 
     def _valid_osd_id(self, osd) -> bool:
         return isinstance(osd, int) and 0 <= osd < self.osdmap.max_osd
@@ -849,7 +924,7 @@ class Monitor(Dispatcher):
         ok = True
         if not self.solo and self.is_leader:
             version = self.osdmap.epoch
-            value = self._last_map_dict
+            full_value = self._last_map_dict
             self._paxos_acks[version] = set()
             ev = self._paxos_events[version] = asyncio.Event()
             try:
@@ -857,11 +932,19 @@ class Monitor(Dispatcher):
                 # peons reject the first round's (now stale) epoch; once
                 # it settles — with us still leading — re-propose at the
                 # new epoch instead of failing the client op (the
-                # reference's Paxos waits for a writeable quorum)
+                # reference's Paxos waits for a writeable quorum).
+                # Round 1 ships the DELTA (O(churn) wire, the multi-
+                # decree-log property of the reference's Paxos over
+                # MonitorDBStore); a peon that cannot apply it answers
+                # need_full, and retry rounds ship the snapshot
                 for round_ in range(3):
                     if round_ and not self.is_leader:
                         ok = False
                         break
+                    value = (
+                        {"inc": inc} if inc is not None and round_ == 0
+                        else {"full": full_value}
+                    )
                     for r in self._peer_ranks():
                         await self._send_peer(r, messages.MMonPaxos(
                             op="propose", epoch=self.election_epoch,

@@ -200,9 +200,14 @@ class MMonElection(Message):
 
 @register
 class MMonPaxos(Message):
-    """Replicated map commit (reference:src/mon/Paxos.cc, collapsed to a
-    leader-driven majority-ack log over full-map values): ``op`` is
-    propose | ack | commit; ``version`` is the map epoch being committed."""
+    """Replicated map commit (reference:src/mon/Paxos.cc): ``op`` is
+    propose | ack | need_full | commit; ``version`` is the map epoch
+    being committed.  ``value`` is {"full": map_dict} or — the common
+    case, O(churn) bytes like the reference's versioned transaction
+    log — {"inc": incremental_dict}; a peon that cannot derive the full
+    map from its own state answers need_full and the leader re-proposes
+    with the snapshot.  (A bare map dict is the pre-delta wire form,
+    still accepted.)"""
 
     TYPE = "mon_paxos"
     FIELDS = ("op", "epoch", "rank", "version", "value")

@@ -416,3 +416,114 @@ def test_stale_exleader_cannot_reassert_over_dead_interim_leader():
                     await asyncio.sleep(0.05)
 
     asyncio.run(main())
+
+
+def test_paxos_proposes_ship_deltas_with_full_fallback():
+    """VERDICT r3 Weak #5: commits must not carry full maps in steady
+    state.  Round-1 proposes carry the epoch delta (O(churn)); a peon
+    that cannot derive the base answers need_full and still converges
+    via the snapshot re-propose."""
+
+    async def main():
+        async with MiniCluster(n_osds=3, n_mons=3) as cluster:
+            cl = await cluster.client()
+            peons = [
+                m for m in cluster.mons.values() if not m.is_leader
+            ]
+            leader = next(
+                m for m in cluster.mons.values() if m.is_leader
+            )
+            seen = []
+            p0 = peons[0]
+            orig = p0._handle_paxos
+
+            async def spy(msg):
+                if msg.op == "propose" and isinstance(msg.value, dict):
+                    seen.append(
+                        "inc" if "inc" in msg.value else "full"
+                    )
+                return await orig(msg)
+
+            p0._handle_paxos = spy
+            for i in range(3):
+                code, _s, _ = await cl.command(
+                    {"prefix": "osd out", "id": 0}
+                    if i % 2 == 0 else {"prefix": "osd in", "id": 0}
+                )
+                assert code == 0
+            assert "inc" in seen, f"no delta proposes observed: {seen}"
+            assert all(k == "inc" for k in seen), (
+                f"steady-state proposes regressed to snapshots: {seen}"
+            )
+            # break the delta path on one peon ONCE: the need_full
+            # round trip must still land the commit everywhere
+            real_decode = p0._paxos_decode_value
+            broke = []
+
+            def breaking(msg):
+                if not broke and isinstance(msg.value, dict) \
+                        and "inc" in msg.value:
+                    broke.append(1)
+                    return None
+                return real_decode(msg)
+
+            p0._paxos_decode_value = breaking
+            code, _s, _ = await cl.command({"prefix": "osd out", "id": 1})
+            assert code == 0
+            async with asyncio.timeout(10):
+                while any(
+                    m.osdmap.epoch != leader.osdmap.epoch
+                    for m in cluster.mons.values()
+                ):
+                    await asyncio.sleep(0.02)
+            assert broke, "the break never triggered"
+            for m in cluster.mons.values():
+                assert m.osdmap.to_dict() == leader.osdmap.to_dict()
+
+    asyncio.run(main())
+
+
+def test_unknown_commit_triggers_leader_catchup():
+    """A peon whose need_full raced the majority sees a commit for a
+    version it never accepted: it must pull the map from the leader
+    rather than silently staying one epoch stale (r4 review)."""
+
+    async def main():
+        async with MiniCluster(n_osds=3, n_mons=3) as cluster:
+            cl = await cluster.client()
+            from ceph_tpu.msg import messages
+
+            peon = next(
+                m for m in cluster.mons.values() if not m.is_leader
+            )
+            leader = next(
+                m for m in cluster.mons.values() if m.is_leader
+            )
+            pulled = []
+            orig = peon._send_peer
+
+            async def spy(r, msg):
+                if isinstance(msg, messages.MMonGetMap):
+                    pulled.append(msg.have)
+                return await orig(r, msg)
+
+            peon._send_peer = spy
+            # simulate the race: hand the peon a commit for a version
+            # it has no pending entry for
+            await peon._handle_paxos(messages.MMonPaxos(
+                op="commit", epoch=peon.election_epoch,
+                rank=leader.rank, version=peon.osdmap.epoch + 1,
+                value=None,
+            ))
+            assert pulled and pulled[0] == peon.osdmap.epoch
+            # and a real mutation still converges everywhere
+            code, _s, _ = await cl.command({"prefix": "osd out", "id": 2})
+            assert code == 0
+            async with asyncio.timeout(10):
+                while any(
+                    m.osdmap.epoch != leader.osdmap.epoch
+                    for m in cluster.mons.values()
+                ):
+                    await asyncio.sleep(0.02)
+
+    asyncio.run(main())
