@@ -129,10 +129,11 @@ class ImageMirrorer:
         stored = int(h.get(self._client_key, b"-1"))
         if stored < 0:
             raise RbdError(-22, "mirror client was deregistered")
-        if stored < self.position:
-            # the source trimmed (all clients had consumed the journal)
-            # and offsets reset; adopt the stored (reset) position
-            self.position = stored
+        # the REGISTRATION is authoritative (it is what holds trim and
+        # what a trim resets); the in-memory position is just its cache,
+        # so a fresh ImageMirrorer (e.g. the CLI's `rbd mirror sync`)
+        # resumes exactly where the registered peer left off
+        self.position = stored
         try:
             buf = await self.src_io.read(JOURNAL_PREFIX + self.image_id)
         except RadosError as e:

@@ -36,7 +36,28 @@ async def _cmd_create(rbd, io, args) -> int:
     kw = {}
     if args.order:
         kw["order"] = args.order
+    if getattr(args, "journaling", False):
+        kw["features"] = ["journaling"]
     await rbd.create(args.image, args.size, **kw)
+    return 0
+
+
+async def _cmd_mirror(rbd, io, args) -> int:
+    """One-way journal mirroring into another pool (rbd-mirror lite,
+    reference:src/tools/rbd_mirror)."""
+    from ..rbd.mirror import ImageMirrorer, resolve_image_id
+
+    dst_io = io.client.io_ctx(args.dest_pool)
+    m = ImageMirrorer(io, dst_io, args.image, mirror_id=args.id)
+    if args.mirror_cmd == "bootstrap":
+        await m.bootstrap()
+        print(f"bootstrapped {args.image} -> pool {args.dest_pool} "
+              f"(position {m.position})")
+        return 0
+    # sync resumes from the registered position (held by the source)
+    m.image_id = await resolve_image_id(io, args.image)
+    applied = await m.sync()
+    print(f"replayed {applied} event(s)")
     return 0
 
 
@@ -134,8 +155,14 @@ async def _cmd_import(rbd, io, args) -> int:
         sys.stdin.buffer.read() if args.path == "-"
         else open(args.path, "rb").read()
     )
-    await rbd.create(args.image, len(data))
+    try:
+        await rbd.create(args.image, len(data))
+    except RadosError as e:
+        if e.code != -17:  # EEXIST: import into the existing image
+            raise
     img = await Image.open(io, args.image)
+    if img.size_bytes < len(data):
+        await img.resize(len(data))
     try:
         step = 4 << 20
         for off in range(0, len(data), step):
@@ -206,6 +233,13 @@ def main(argv=None) -> int:
     c.add_argument("image")
     c.add_argument("--size", type=int, required=True)
     c.add_argument("--order", type=int, default=None)
+    c.add_argument("--journaling", action="store_true",
+                   help="crash-consistent op journal (enables mirroring)")
+    mi = sub.add_parser("mirror")
+    mi.add_argument("mirror_cmd", choices=["bootstrap", "sync"])
+    mi.add_argument("image")
+    mi.add_argument("--dest-pool", required=True)
+    mi.add_argument("--id", default="peer")
     sub.add_parser("ls")
     for verb in ("info", "rm"):
         v = sub.add_parser(verb)
@@ -247,6 +281,7 @@ def main(argv=None) -> int:
         "children": _cmd_children,
         "import": _cmd_import, "export": _cmd_export,
         "bench": _cmd_bench, "lock": _cmd_lock,
+        "mirror": _cmd_mirror,
     }[args.cmd]
 
     async def run() -> int:

@@ -48,6 +48,20 @@ def test_vstart_serves_clis(tmp_path):
         assert "obj" in cli("rados_cli", "-p", "p", "ls")
         status = cli("ceph_cli", "status")
         assert "3 up" in status and "mgr" in status
+        # journaled image + one-way mirror via the CLI (rbd-mirror lite)
+        cli("rados_cli", "mkpool", "rbd1", "replicated")
+        cli("rados_cli", "mkpool", "rbd2", "replicated")
+        cli("rbd_cli", "-p", "rbd1", "create", "vol",
+            "--size", "1048576", "--journaling")
+        img = tmp_path / "img.bin"
+        img.write_bytes(b"M" * 65536)
+        cli("rbd_cli", "-p", "rbd1", "import", str(img), "vol")
+        out = cli("rbd_cli", "-p", "rbd1", "mirror", "bootstrap", "vol",
+                  "--dest-pool", "rbd2")
+        assert "bootstrapped" in out
+        exp = tmp_path / "out.bin"
+        cli("rbd_cli", "-p", "rbd2", "export", "vol", str(exp))
+        assert exp.read_bytes()[:65536] == b"M" * 65536
     finally:
         proc.send_signal(signal.SIGINT)
         try:
