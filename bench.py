@@ -975,6 +975,15 @@ def main():
                 final["configs_platform"] = r["grid"].get("platform", backend)
             if "crush_1m" not in final and r.get("crush"):
                 final["crush_1m"] = r["crush"]
+            if "stack_gbps" not in final and (
+                r.get("headline", {}).get("stack_gbps")
+            ):
+                # the codec-stack number is measured on the cpu backend
+                # only; surface it in the final line even when another
+                # backend's headline wins
+                final["stack_gbps"] = round(
+                    r["headline"]["stack_gbps"], 3
+                )
         return final
 
     def collect(backend: str):

@@ -56,7 +56,17 @@ async def _cmd_mirror(rbd, io, args) -> int:
         return 0
     # sync resumes from the registered position (held by the source)
     m.image_id = await resolve_image_id(io, args.image)
-    applied = await m.sync()
+    try:
+        applied = await m.sync()
+    except RadosError as e:
+        if "deregistered" in str(e):
+            print(
+                f"error: {args.image} is not registered for mirror id "
+                f"{args.id!r}; run `rbd mirror bootstrap` first",
+                file=sys.stderr,
+            )
+            return 1
+        raise
     print(f"replayed {applied} event(s)")
     return 0
 
@@ -161,7 +171,9 @@ async def _cmd_import(rbd, io, args) -> int:
         if e.code != -17:  # EEXIST: import into the existing image
             raise
     img = await Image.open(io, args.image)
-    if img.size_bytes < len(data):
+    if img.size_bytes != len(data):
+        # the image must EQUAL the imported file afterwards: growing
+        # only (and keeping a stale tail) would export mixed bytes
         await img.resize(len(data))
     try:
         step = 4 << 20
