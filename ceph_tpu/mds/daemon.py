@@ -230,10 +230,7 @@ class MDSDaemon(Dispatcher):
                     conn.send(messages.MMonGetMap(have=None))
                     return
                 self.osdmap = m
-                ranks = self.osdmap.mds_ranks or (
-                    [[self.osdmap.mds_name, self.osdmap.mds_addr]]
-                    if self.osdmap.mds_name else []
-                )
+                ranks = self.osdmap.mds_rank_table()
                 my_rank = next(
                     (i for i, (n, _a) in enumerate(ranks)
                      if n == self.name),

@@ -44,6 +44,14 @@ class StatusModule(MgrModule):
                        "num_up_osds": up, "num_in_osds": inn},
             "mgrmap": {"active": m.mgr_name,
                        "standbys": [n for n, _ in m.mgr_standbys]},
+            "mdsmap": {
+                # "" = vacant rank (failed, or awaiting a standby):
+                # surfaced as-is so the renderer can count ACTIVE ranks
+                # honestly instead of branding unfilled slots "failed"
+                "ranks": [n for n, _a in m.mds_rank_table()],
+                "max_mds": m.mds_max,
+                "standbys": [n for n, _ in m.mds_standbys],
+            },
             "pgmap": {
                 "num_pgs": len(pgs),
                 "num_objects": objects,

@@ -1474,9 +1474,7 @@ class Monitor(Dispatcher):
         occupied slots past a shrunken mds_max are kept until they fail
         (the reference requires deactivation to shrink)."""
         m = self.osdmap
-        ranks = [list(r) for r in m.mds_ranks]
-        if not ranks and m.mds_name:
-            ranks = [[m.mds_name, m.mds_addr]]  # upgraded single-active
+        ranks = m.mds_rank_table()
         want = max(1, int(m.mds_max))
         while len(ranks) < want:
             ranks.append(["", ""])

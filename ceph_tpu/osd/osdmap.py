@@ -520,6 +520,17 @@ class OSDMap:
         rule.step(CRUSH_RULE_EMIT)
         return self.crush.add_rule(rule)
 
+    def mds_rank_table(self) -> list[list[str]]:
+        """The active-MDS rank table ([name, addr] per rank; "" pairs =
+        vacant/failed slots awaiting a standby), with the legacy
+        single-active fields as the upgrade fallback — the ONE place
+        this fallback lives (mon, mds, and mgr all read it here)."""
+        if self.mds_ranks:
+            return [list(r) for r in self.mds_ranks]
+        if self.mds_name:
+            return [[self.mds_name, self.mds_addr]]
+        return []
+
     def apply_incremental(self, inc: "Incremental") -> "OSDMap":
         """Return the successor map this delta produces (reference:
         src/osd/OSDMap.cc apply_incremental).  Raises ValueError on an

@@ -28,6 +28,12 @@ from .journal import JOURNAL_PREFIX, decode_frames
 CLIENT_PREFIX = "journal_client/"
 
 
+class MirrorNotRegistered(RbdError):
+    """sync() without a live registration (never bootstrapped, or
+    deregistered): callers distinguish this from other -EINVAL-class
+    failures by TYPE, not by message text."""
+
+
 async def resolve_image_id(io: IoCtx, name: str) -> str:
     try:
         d = await io.omap_get(RBD_DIRECTORY)
@@ -128,7 +134,10 @@ class ImageMirrorer:
         h = await self.src_io.omap_get(src_header)
         stored = int(h.get(self._client_key, b"-1"))
         if stored < 0:
-            raise RbdError(-22, "mirror client was deregistered")
+            raise MirrorNotRegistered(
+                -22, "no journal-client registration (bootstrap first, "
+                     "or the client was deregistered)"
+            )
         # the REGISTRATION is authoritative (it is what holds trim and
         # what a trim resets); the in-memory position is just its cache,
         # so a fresh ImageMirrorer (e.g. the CLI's `rbd mirror sync`)

@@ -47,6 +47,16 @@ def _print_status(out: dict) -> None:
     mg = out["mgrmap"]
     stand = f", standbys: {', '.join(mg['standbys'])}" if mg["standbys"] else ""
     print(f"  mgr:     {mg['active'] or '(none)'}{stand}")
+    md = out.get("mdsmap") or {}
+    if md.get("ranks"):
+        ms = f", standbys: {', '.join(md['standbys'])}" \
+            if md.get("standbys") else ""
+        occupied = sum(1 for n in md["ranks"] if n)
+        ranks = ", ".join(
+            f"{i}={n or '(vacant)'}" for i, n in enumerate(md["ranks"])
+        )
+        print(f"  mds:     {occupied}/{md['max_mds']} active "
+              f"({ranks}){ms}")
     pm = out["pgmap"]
     print(f"  data:    {pm['num_pools']} pools, {pm['num_pgs']} pgs, "
           f"{pm['num_objects']} objects, {pm['data_bytes']} bytes")

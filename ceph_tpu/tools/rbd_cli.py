@@ -54,19 +54,19 @@ async def _cmd_mirror(rbd, io, args) -> int:
         print(f"bootstrapped {args.image} -> pool {args.dest_pool} "
               f"(position {m.position})")
         return 0
+    from ..rbd.mirror import MirrorNotRegistered
+
     # sync resumes from the registered position (held by the source)
     m.image_id = await resolve_image_id(io, args.image)
     try:
         applied = await m.sync()
-    except RadosError as e:
-        if "deregistered" in str(e):
-            print(
-                f"error: {args.image} is not registered for mirror id "
-                f"{args.id!r}; run `rbd mirror bootstrap` first",
-                file=sys.stderr,
-            )
-            return 1
-        raise
+    except MirrorNotRegistered:
+        print(
+            f"error: {args.image} is not registered for mirror id "
+            f"{args.id!r}; run `rbd mirror bootstrap` first",
+            file=sys.stderr,
+        )
+        return 1
     print(f"replayed {applied} event(s)")
     return 0
 
