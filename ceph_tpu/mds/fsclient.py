@@ -47,12 +47,25 @@ class CephFSClient:
         target: "tuple[str, str] | None" = None  # (addr, name) override
         for _attempt in range(cl.max_retries):
             m = cl.osdmap
-            if m is None or not m.mds_addr:
+            entry = None
+            if m is not None:
+                # bootstrap from ANY occupied rank, not just rank 0
+                # (advisor r4: rank 0 vacant with other ranks active
+                # blocked every op forever; the EREMOTE redirect
+                # protocol routes from whichever rank answers first)
+                if m.mds_addr:
+                    entry = (m.mds_addr, m.mds_name)
+                else:
+                    for rname, raddr in m.mds_rank_table():
+                        if raddr:
+                            entry = (raddr, rname)
+                            break
+            if entry is None:
                 await cl._wait_for_map_change(
                     m.epoch if m else -1, cl.op_timeout
                 )
                 continue
-            addr, name = target or (m.mds_addr, m.mds_name)
+            addr, name = target or entry
             target = None
             try:
                 conn = await cl.messenger.connect(addr, name)

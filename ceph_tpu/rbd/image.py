@@ -164,14 +164,20 @@ class Image:
         self._parent_img: "Image | None" = None  # opened lazily at the snap
         self._copyup_locks: dict[int, asyncio.Lock] = {}
         self.features: list[str] = []
+        self.read_only = False
         self._journal = None  # ImageJournal when 'journaling' is on
 
     # -- lifecycle ---------------------------------------------------------
     @classmethod
     async def open(
         cls, io: IoCtx, name: str, snap_name: str | None = None,
-        cache_bytes: int = 0,
+        cache_bytes: int = 0, read_only: bool = False,
     ) -> "Image":
+        """``read_only=True`` mirrors librbd's OPEN_FLAG_READ_ONLY
+        (reference:rbd_mirror opens the remote image read-only): no
+        ImageJournal is attached, so no replay/commit/trim ever runs
+        against the source's journal — a concurrent writer's positions
+        stay untouched.  Write entry points raise -EROFS."""
         d = {}
         try:
             d = await io.omap_get(RBD_DIRECTORY)
@@ -182,6 +188,7 @@ class Image:
         if raw is None:
             raise RbdError(-ENOENT, f"no image {name!r}")
         img = cls(io, name, raw.decode())
+        img.read_only = read_only
         await img._refresh()
         if cache_bytes > 0 and snap_name is None:
             # the librbd object cache (reference:librbd cache over
@@ -192,7 +199,8 @@ class Image:
             img._cache = ObjectCacher(img.io, max_bytes=cache_bytes)
         if snap_name is not None:
             img.set_snap(snap_name)
-        if "journaling" in img.features and snap_name is None:
+        if "journaling" in img.features and snap_name is None \
+                and not read_only:
             # crash-replay BEFORE serving I/O (reference:librbd
             # Journal<I>::open -> journal::Replay): a previous writer's
             # acked-but-unapplied ops land now
@@ -308,6 +316,8 @@ class Image:
             raise RbdError(-EINVAL, "image is closed")
         if self.snap_name is not None:
             raise RbdError(-EINVAL, "image opened at a snapshot: read-only")
+        if self.read_only:
+            raise RbdError(-30, "image opened read-only")  # -EROFS
 
     async def write(self, offset: int, data: bytes) -> int:
         self._check_open_rw()

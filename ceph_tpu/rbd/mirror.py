@@ -72,26 +72,22 @@ class ImageMirrorer:
         self.image_id = await resolve_image_id(self.src_io, self.name)
         src_header = HEADER_PREFIX + self.image_id
         h = await self.src_io.omap_get(src_header)
-        if "journaling" not in json.loads(h.get("features", b"[]")):
+        src_features = json.loads(h.get("features", b"[]"))
+        if "journaling" not in src_features:
             raise RbdError(-22, f"image {self.name!r} is not journaled")
-        # register FIRST at position 0 — from this instant the source
-        # cannot trim the journal out from under us — THEN capture the
-        # current extent and advance the registration to it (r4 review:
-        # reading the length before registering raced a trim into a
-        # stale position that silently skipped every future event)
+        # register at position 0 BEFORE anything else — from this
+        # instant the source cannot trim the journal out from under us —
+        # and STAY at 0: the retained journal may hold events a crashed
+        # writer durably appended but never applied to the data objects,
+        # and the read-only deep copy below cannot see them.  The first
+        # sync() replays the whole retained journal over the copy
+        # (replay is idempotent: absolute offsets), which lands exactly
+        # those events — the read-only-open equivalent of the rw open's
+        # pre-copy ImageJournal.replay() (code review r5).
         await self.src_io.omap_set(
             src_header, {self._client_key: b"0"}
         )
-        try:
-            jlen = len(await self.src_io.read(JOURNAL_PREFIX + self.image_id))
-        except RadosError as e:
-            if e.code != -ENOENT:
-                raise
-            jlen = 0
-        self.position = jlen
-        await self.src_io.omap_set(
-            src_header, {self._client_key: str(jlen).encode()}
-        )
+        self.position = 0
         size = int(h["size"])
         order = int(h["order"])
         from .image import RBD
@@ -99,12 +95,24 @@ class ImageMirrorer:
         rbd = RBD(self.dst_io)
         fresh = True
         try:
-            await rbd.create(self.name, size, order=order)
+            # propagate the source's features (reference:rbd_mirror
+            # creates the peer image with matching features): the copy
+            # is itself journaled, so it can be promoted and mirrored
+            # back symmetrically
+            await rbd.create(
+                self.name, size, order=order, features=src_features
+            )
         except RbdError as e:
             if e.code != -17:  # EEXIST: resume into the existing copy
                 raise
             fresh = False
-        src = await Image.open(self.src_io, self.name)
+        # the SOURCE is opened read-only (reference:rbd_mirror opens the
+        # remote image read-only): no ImageJournal attach, so bootstrap
+        # never replays/commits/trims the live writer's journal —
+        # close()'s force-commit used to trim-and-reset positions under
+        # a concurrent writer, leaving its in-memory counters pointing
+        # past the recreated journal (stale-position hazard)
+        src = await Image.open(self.src_io, self.name, read_only=True)
         dst = await Image.open(self.dst_io, self.name)
         try:
             if dst.size_bytes != src.size_bytes:

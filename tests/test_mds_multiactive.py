@@ -152,3 +152,36 @@ class TestMultiActive:
                 assert len(inos) == 24, "ino collision across ranks"
 
         run(main())
+
+    def test_client_mounts_with_rank0_vacant(self):
+        """Rank 0 down with no standby must not brick clients whose
+        subtree lives on a surviving rank (advisor r4: bootstrap only
+        read the legacy rank-0 mirror fields and waited in
+        _wait_for_map_change forever)."""
+
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                cl, ranks = await _two_active(cluster)
+                fs = await _fs(cluster)
+                await fs.mkdir("/sub")
+                await fs.export_subtree("/sub", 1)
+                await fs.write_file("/sub/f", b"alive")
+                victim = ranks[0].name
+                await cluster.kill_mds(victim)
+                code, _s, _o = await cl.command(
+                    {"prefix": "mds fail", "name": victim}
+                )
+                assert code == 0
+                # wait for a map showing rank 0 vacant, rank 1 occupied
+                async with asyncio.timeout(10):
+                    while True:
+                        m = cl.osdmap
+                        tbl = m.mds_rank_table() if m else []
+                        if (len(tbl) > 1 and not tbl[0][1] and tbl[1][1]):
+                            break
+                        await asyncio.sleep(0.05)
+                # a FRESH mount must bootstrap via the occupied rank
+                fs2 = await _fs(cluster)
+                assert await fs2.read_file("/sub/f") == b"alive"
+
+        run(main())

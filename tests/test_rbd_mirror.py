@@ -124,6 +124,59 @@ class TestMirror:
 
         run(main())
 
+    def test_bootstrap_with_live_writer_is_readonly(self):
+        """bootstrap() must open the SOURCE read-only (advisor r4
+        medium: a rw open attached an ImageJournal whose close()
+        force-commit could trim and reset the journal under a live
+        writer, leaving the writer's in-memory positions stale and a
+        later crash-replay silently skipping acked writes).  It must
+        also propagate the source's features so the copy is itself
+        journaled (promotable / symmetric)."""
+
+        async def main():
+            from ceph_tpu.rbd import journal as J
+
+            async with MiniCluster(n_osds=4) as cluster:
+                cl = await cluster.client()
+                sio, dio = await _setup(cl)
+                old_trim = J.TRIM_BYTES
+                J.TRIM_BYTES = 1024  # any mirror-side trim would show
+                try:
+                    img = await Image.open(sio, "vol")  # live writer
+                    await img.write(0, b"A" * 2000)  # journal > TRIM_BYTES
+                    m = ImageMirrorer(sio, dio, "vol")
+                    await m.bootstrap()  # writer still open
+                    # the mirror never attached a journal to the source,
+                    # so the writer's later events replay unharmed
+                    await img.write(OBJ, b"B" * 500)
+                    await img.close()
+                    assert await m.sync() >= 1
+                    dst = await Image.open(dio, "vol")
+                    assert await dst.read(0, 2000) == b"A" * 2000
+                    assert await dst.read(OBJ, 500) == b"B" * 500
+                    assert "journaling" in dst.features, (
+                        "source features not propagated to the mirror copy"
+                    )
+                    await dst.close()
+                finally:
+                    J.TRIM_BYTES = old_trim
+
+        run(main())
+
+    def test_readonly_open_rejects_writes(self):
+        async def main():
+            async with MiniCluster(n_osds=4) as cluster:
+                cl = await cluster.client()
+                sio, _dio = await _setup(cl)
+                ro = await Image.open(sio, "vol", read_only=True)
+                assert ro._journal is None
+                with pytest.raises(RbdError) as ei:
+                    await ro.write(0, b"x")
+                assert ei.value.code == -30  # EROFS
+                await ro.close()
+
+        run(main())
+
     def test_registered_client_holds_trim(self):
         """The source must not trim journal events a mirror peer has
         not consumed (minimum-commit-position rule) — and must trim

@@ -109,3 +109,64 @@ class TestMultisite:
                 )
 
         run(main())
+
+    def test_active_active_first_contact_preserves_local_writes(self):
+        """Full sync fires on first contact; with syncers running in
+        BOTH directions it must not destroy destination-zone writes
+        that have not replicated back yet (advisor r4 medium: the
+        unconditional reconcile-delete lost acked user data)."""
+
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                cl = await cluster.client()
+                a, b = await _zones(cl)
+                await a.create_user("u")
+                await a.create_bucket("ba", "u")
+                await a.put_object("ba", "ka", b"from-a")
+                await b.create_user("u")
+                await b.create_bucket("bb", "u")
+                await b.put_object("bb", "kb", b"from-b")
+
+                sab = ZoneSyncer(a, b, "zone-a")
+                sba = ZoneSyncer(b, a, "zone-b")
+                r = await sab.sync()
+                assert r["phase"] == "full"
+                # b's local bucket/object survived the a->b full sync
+                assert (await b.get_object("bb", "kb"))[0] == b"from-b"
+                r = await sba.sync()
+                assert r["phase"] == "full"
+                assert (await a.get_object("ba", "ka"))[0] == b"from-a"
+                # steady state: both zones converge to both objects
+                assert (await b.get_object("ba", "ka"))[0] == b"from-a"
+                assert (await a.get_object("bb", "kb"))[0] == b"from-b"
+
+        run(main())
+
+    def test_full_resync_deletes_only_tracked_entries(self):
+        """Reconcile-deletes are restricted to entries the syncer
+        itself created (sync_origin set); delete_mode="mirror" keeps
+        the old replica semantics for one-way topologies."""
+
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                cl = await cluster.client()
+                a, b = await _zones(cl)
+                await a.create_user("u")
+                await a.create_bucket("ba", "u")
+                await a.put_object("ba", "ka", b"from-a")
+                sab = ZoneSyncer(a, b, "zone-a")
+                await sab.sync()  # full: ka tracked at b
+                # source deletes ka; b gains a LOCAL write in the bucket
+                await a.delete_object("ba", "ka")
+                await b.put_object("ba", "local", b"mine")
+                await sab._full_sync()
+                with pytest.raises(Exception):
+                    await b.get_object("ba", "ka")  # tracked: deleted
+                assert (await b.get_object("ba", "local"))[0] == b"mine"
+                # mirror mode blind-deletes the local write too
+                await ZoneSyncer(a, b, "zone-a",
+                                 delete_mode="mirror")._full_sync()
+                with pytest.raises(Exception):
+                    await b.get_object("ba", "local")
+
+        run(main())
