@@ -1185,10 +1185,17 @@ def main():
             got_tpu = bool(acc.get("tpu", {}).get("headline"))
             probe_t = probe_schedule[min(probe_i, len(probe_schedule) - 1)]
             # never spend the whole remainder on one probe until cpu
-            # numbers are secured
+            # numbers are secured (cap takes precedence over the floor —
+            # r5 review: max() outside min() made the cap dead code, and
+            # an over-long probe pushed the cpu fallback past t_end)
             cap = remaining - 10 if acc.get("jax-cpu") else remaining * 0.3
             probe_i += 1
-            plat = probe_device(None, max(25.0, min(probe_t, cap)))
+            if cap < 20:
+                # too little left for a meaningful probe: secure cpu
+                # numbers instead (handled below), or wind down
+                plat = None
+            else:
+                plat = probe_device(None, min(cap, max(25.0, probe_t)))
             if plat is not None and "cpu" in plat.lower():
                 # the default backend IS cpu (no axon/TPU configured):
                 # re-probing will never find one — run the cpu combo and

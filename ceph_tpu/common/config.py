@@ -92,6 +92,11 @@ OPTIONS: dict[str, Option] = _opts(
     Option("osd_recovery_scan_timeout", float, 10.0,
            "peering scan round-trip budget (s)"),
     # erasure code
+    Option("osd_ec_mesh", bool, False,
+           "route EC encode/reconstruct through the device-mesh engine "
+           "(k+m shard rows on mesh rows, ICI all-gather reconstruct; "
+           "the messenger keeps carrying control traffic) — "
+           "ceph_tpu.parallel.engine"),
     Option("erasure_code_dir", str, "ceph_tpu.models",
            "plugin module prefix (dlopen dir analog)"),
     Option("osd_erasure_code_plugins", str, "jerasure isa lrc shec",

@@ -428,10 +428,13 @@ class MOSDPGScan(Message):
 @register
 class MOSDPGScanReply(Message):
     """``objects`` = {name: {"version": [e,v], "size": n}};
-    ``log`` = json-able pg_log entries in version order."""
+    ``log`` = json-able pg_log entries in version order;
+    ``info`` = PGShardInfo dict (les/last_update/log_len — the GetInfo
+    payload, reference pg_info_t); ``intervals`` = this member's
+    recorded past acting-set intervals (PastIntervals.to_json lists)."""
 
     TYPE = "pg_scan_reply"
-    FIELDS = ("pgid", "tid", "shard", "objects", "log")
+    FIELDS = ("pgid", "tid", "shard", "objects", "log", "info", "intervals")
 
 
 @register

@@ -54,6 +54,7 @@ from .pg_log import (
     PGLogEntry,
     add_log_entry_to_txn,
     is_stash_name,
+    meta_oid,
     stash_name,
     trim_stashes_to_txn,
 )
@@ -232,6 +233,17 @@ class OSD(Dispatcher):
         pec.add_counter("encode_bytes", "logical bytes encoded")
         pec.add_counter("decode_calls", "batched device decodes")
         pec.add_counter("decode_bytes", "shard bytes decoded")
+        pec.add_counter("mesh_encode_calls",
+                        "encodes dispatched to the device-mesh engine")
+        pec.add_counter("mesh_decode_calls",
+                        "reconstructs via the mesh all-gather path")
+        # the mesh EC data path (osd_ec_mesh): shard rows on mesh rows,
+        # ICI all-gather reconstruct; None = host/TCP-only path
+        self.ec_mesh = None
+        if getattr(cfg, "osd_ec_mesh", False):
+            from ..parallel.engine import get_mesh_engine
+
+            self.ec_mesh = get_mesh_engine()
         prec = self.perf.create("recovery")
         prec.add_counter("pushes", "objects/shards pushed")
         pscrub = self.perf.create("scrub")
@@ -277,6 +289,9 @@ class OSD(Dispatcher):
         # retried notifies join rather than re-fire (see _do_notify)
         self._notify_dedupe: dict[tuple, asyncio.Future] = {}
         self._pg_locks: dict[str, asyncio.Lock] = {}
+        # epoch when each local PG's current acting interval began
+        # (peering past-intervals bookkeeping, see _note_intervals)
+        self._interval_start: dict[str, int] = {}
         # (pgid, head oid) -> lock: serializes family META decisions and
         # commits (see obj_lock); the in-flight EXTENT table underneath
         # lets disjoint-extent writes to one object pipeline their
@@ -679,11 +694,66 @@ class OSD(Dispatcher):
             if conn is not None:
                 conn.send(messages.MMonGetMap(have=None))
             return
+        old = self.osdmap
         self.osdmap = m
         self._codecs.clear()  # pools/profiles may have changed
+        try:
+            self._note_intervals(old, m)
+        except Exception:
+            logger.exception("%s: interval recording failed", self.name)
         self._map_event.set()
         self.recovery.kick()  # acting sets may have changed
         self._kick_snap_trim()
+
+    def _note_intervals(self, old, new) -> None:
+        """Close acting-set intervals for locally-hosted PGs on map
+        advance (reference:src/osd/osd_types.cc
+        PastIntervals::check_new_interval): when a PG's acting set or
+        primary changed, append the closed interval to each local shard's
+        pgmeta omap.  Peering's prior set is the union of these records
+        across reachable members — how a new primary learns which
+        ex-members may hold writes from a stale interval."""
+        if old is None:
+            return
+        from .peering import PAST_INTERVALS_KEY, PastIntervals
+
+        try:
+            cids = self.store.list_collections()
+        except Exception:
+            return
+        by_pg: dict[str, list[tuple[CollectionId, int]]] = {}
+        for cid in cids:
+            base, _, s = cid.pg.partition("s")
+            try:
+                shard = int(s) if s else -1
+            except ValueError:
+                continue
+            by_pg.setdefault(base, []).append((cid, shard))
+        for pgid_s, locs in by_pg.items():
+            try:
+                pg = PGid.parse(pgid_s)
+                _u, _t, old_acting, old_primary = old.pg_to_up_acting_osds(pg)
+                _u2, _t2, new_acting, new_primary = new.pg_to_up_acting_osds(pg)
+            except Exception:
+                continue  # pool vanished / unparsable: nothing to record
+            if old_acting == new_acting and old_primary == new_primary:
+                continue
+            start = self._interval_start.get(pgid_s, old.epoch)
+            self._interval_start[pgid_s] = new.epoch
+            for cid, shard in locs:
+                try:
+                    raw = self.store.omap_get(cid, meta_oid(shard)).get(
+                        PAST_INTERVALS_KEY
+                    )
+                except KeyError:
+                    raw = None
+                past = PastIntervals.from_json(raw)
+                past.note_change(start, old.epoch, old_acting, old_primary)
+                txn = Transaction().omap_setkeys(
+                    cid, meta_oid(shard),
+                    {PAST_INTERVALS_KEY: past.to_json()},
+                )
+                self.store.apply(txn)
 
     def _kick_snap_trim(self) -> None:
         """Schedule clone trimming for pools whose removed_snaps grew
@@ -855,7 +925,9 @@ class OSD(Dispatcher):
                 )
             else:
                 shard = -1
-            objects, _log = self.recovery._local_scan(str(pg), shard)
+            objects, _log, _info, _ivs = self.recovery._local_scan(
+                str(pg), shard
+            )
             conn.send(messages.MPGLsReply(
                 tid=msg.tid, result=0,
                 # clones/snapdirs are internal names, not listable heads
@@ -1423,6 +1495,32 @@ class OSD(Dispatcher):
             "opname": opname, "attr_ops": attr_ops,
         }
 
+    # -- EC math routing: device-mesh engine vs host path --------------------
+    def _ec_encode_bufs(self, sinfo, codec, buf) -> dict[int, np.ndarray]:
+        """Encode router (VERDICT r4 #2): with ``osd_ec_mesh`` on and a
+        matrix codec, the k+m shard rows are computed BY the mesh (shard
+        rows on mesh rows, reference:src/osd/ECBackend.cc:1902-1926 as
+        device placement); otherwise the host ec_util path.  Bytes are
+        identical either way (pinned by tests/test_mesh_datapath.py)."""
+        if self.ec_mesh is not None and self.ec_mesh.supports(codec):
+            self.perf.get("ec").inc("mesh_encode_calls")
+            return self.ec_mesh.encode(sinfo, codec, buf)
+        return ec_util.encode(sinfo, codec, buf)
+
+    def _ec_decode_concat(self, sinfo, codec, chunks) -> bytes:
+        """Reconstruct router: missing rows rebuilt via the mesh's ICI
+        all-gather (reference:src/osd/ECBackend.cc:2187 as one
+        collective) when the engine applies."""
+        k = codec.get_data_chunk_count()
+        if (
+            self.ec_mesh is not None
+            and self.ec_mesh.supports(codec)
+            and any(r not in chunks for r in range(k))
+        ):
+            self.perf.get("ec").inc("mesh_decode_calls")
+            return self.ec_mesh.decode_concat(sinfo, codec, chunks)
+        return ec_util.decode_concat(sinfo, codec, chunks)
+
     async def _ec_mutate_execute(
         self, pg: PGid, pool: Pool, acting: list[int], oid: str,
         prep: dict, locked: bool,
@@ -1461,7 +1559,7 @@ class OSD(Dispatcher):
         c_off = 0
         if plan.will_write[1] > 0:
             buf = ec_transaction.merge_extents(plan, sinfo, old_exts, offset, data)
-            shard_bufs = ec_util.encode(sinfo, codec, buf)
+            shard_bufs = self._ec_encode_bufs(sinfo, codec, buf)
             c_off = sinfo.aligned_logical_offset_to_chunk_offset(plan.will_write[0])
             pec = self.perf.get("ec")
             pec.inc("encode_calls")
@@ -2246,7 +2344,7 @@ class OSD(Dispatcher):
                 pec = self.perf.get("ec")
                 pec.inc("decode_calls")
                 pec.inc("decode_bytes", sum(c.size for c in chunks.values()))
-                logical = ec_util.decode_concat(sinfo, codec, chunks)
+                logical = self._ec_decode_concat(sinfo, codec, chunks)
                 return 0, logical[off - s0 : end - s0]
             # else: a shard failed mid-read — loop retries with survivors
         return -EIO, b""

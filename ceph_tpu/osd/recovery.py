@@ -1,18 +1,32 @@
-"""Log-based recovery: peering-lite + shard backfill.
+"""Log-based recovery: peering + shard backfill.
 
 Re-expression of the reference recovery flow (reference:src/osd/PG.h:1654
 RecoveryMachine Peering/GetInfo/GetLog/GetMissing/Active/Recovering and
 reference:src/osd/ECBackend.cc:520 continue_recovery_op) for the
 mini-cluster:
 
-1. On every map epoch change, the primary of each PG scans the acting
-   shards (MOSDPGScan): each reports its object set (name -> version/size)
-   and its pg log tail.
-2. Logs are merged into the authoritative per-object state — newest
-   version wins, a delete entry at the newest version wins over older
-   modifies (the authoritative-log selection of
-   reference:src/osd/PGLog.cc merge_log, collapsed to last-writer-wins
-   because the single primary serializes all writes).
+1. On every map epoch change, the primary of each PG runs the peering
+   phases (ceph_tpu.osd.peering):
+   - GetInfo/GetLog: every acting shard reports its object set, pg log,
+     PGShardInfo (last_epoch_started + log-derived last_update), and
+     recorded past intervals in one MOSDPGScan round trip.
+   - prior set: past-interval members not in the acting set are scanned
+     as strays (reference PG::build_prior) — they may hold writes a
+     stale-interval primary landed during a partition.
+   - authoritative selection: find_best_info — max last_epoch_started
+     FIRST (interval order), then max last_update, then longest log.
+   - GetMissing: entries past the authoritative head on stale-interval
+     members are DIVERGENT — rolled back from their per-entry stashes
+     (reference:src/osd/PGLog.cc _merge_divergent_entries), never
+     merged.  Same-interval in-flight tails are arbitrated by the
+     decodability check below (roll forward iff >= k shards hold the
+     version; stash-rollback otherwise).
+   - activation: a clean pass persists the new last_epoch_started on
+     every reachable member, fencing older intervals out of future
+     find_best_info rounds.
+2. Authoritative-interval logs and object sets then merge into the
+   per-object state — newest version wins within the interval, a delete
+   entry at the newest version wins over older modifies.
 3. Divergence repair:
    - a shard missing an object (or holding a stale version) gets the
      object's chunk rebuilt — the primary reads+decodes the object from
@@ -38,7 +52,7 @@ import logging
 from ..msg import messages
 from ..store import CollectionId, ObjectId, Transaction
 from .ec_util import StripeHashes
-from . import ec_util
+from . import ec_util, peering
 from .osdmap import CRUSH_ITEM_NONE, PGid, Pool, POOL_TYPE_ERASURE
 from .pg_log import (
     Eversion,
@@ -93,28 +107,35 @@ class RecoveryManager:
     # -- scan plumbing --------------------------------------------------------
 
     def handle_scan(self, conn, msg: messages.MOSDPGScan) -> None:
-        """Shard side: report objects + log for one PG shard."""
-        objects, log = self._local_scan(msg.pgid, msg.store_shard)
+        """Shard side: report objects + log + info + past intervals for
+        one PG shard (GetInfo + GetLog in one round trip)."""
+        objects, log, info, intervals = self._local_scan(
+            msg.pgid, msg.store_shard
+        )
         conn.send(
             messages.MOSDPGScanReply(
                 pgid=msg.pgid, tid=msg.tid, shard=msg.shard,
-                objects=objects, log=log,
+                objects=objects, log=log, info=info, intervals=intervals,
             )
         )
 
     def handle_scan_reply(self, msg: messages.MOSDPGScanReply) -> None:
         w = self._scan_waiters.get(msg.tid)
         if w:
-            w.complete(msg.shard, msg.objects, msg.log)
+            w.complete(
+                msg.shard, msg.objects, msg.log, msg.info, msg.intervals
+            )
 
-    def _local_scan(self, pgid: str, shard: int) -> tuple[dict, list]:
+    def _local_scan(
+        self, pgid: str, shard: int
+    ) -> tuple[dict, list, dict, list]:
         store = self.osd.store
         cid = CollectionId(f"{pgid}s{shard}" if shard >= 0 else pgid)
         objects: dict[str, dict] = {}
         try:
             oids = store.list_objects(cid)
         except KeyError:
-            return {}, []
+            return {}, [], peering.PGShardInfo().to_dict(), []
         log_entries = read_log(store, cid, shard)
         # last applied version per object comes from the shard's own log —
         # replicated partial writes never rewrite the OI xattr, and EC
@@ -138,7 +159,22 @@ class RecoveryManager:
                 "size": oi.get("size", 0),
             }
         log = [e.to_dict() for e in log_entries]
-        return objects, log
+        # GetInfo payload: stored les + log-derived last_update, plus
+        # this member's recorded past intervals (for the prior set)
+        stored_info, intervals_raw = None, None
+        try:
+            omap = store.omap_get(cid, meta_oid(shard))
+            raw = omap.get(peering.INFO_KEY)
+            stored_info = json.loads(raw) if raw else None
+            intervals_raw = omap.get(peering.PAST_INTERVALS_KEY)
+        except KeyError:
+            pass
+        info = peering.derive_info(stored_info, log_entries).to_dict()
+        intervals = [
+            iv.to_list()
+            for iv in peering.PastIntervals.from_json(intervals_raw).intervals
+        ]
+        return objects, log, info, intervals
 
     # -- the recovery loop ----------------------------------------------------
 
@@ -194,10 +230,84 @@ class RecoveryManager:
         if not shards:
             return
 
+        # -- GetInfo + GetLog: one scan round trip per acting member
         scans = await self._scan_shards(pg, shards, erasure)
         if scans is None:
             return
-        authoritative = self._merge(scans)
+        infos = {
+            k: peering.derive_info(
+                r[2], [PGLogEntry.from_dict(e) for e in r[1]]
+            )
+            for k, r in scans.items()
+        }
+        auth_key = peering.find_best_info(infos)
+        auth_info = (
+            infos[auth_key] if auth_key is not None else peering.PGShardInfo()
+        )
+
+        # -- prior set (reference PG::build_prior): members of past
+        # intervals since the authoritative les may hold writes from a
+        # stale-interval primary; scan the reachable ones as strays
+        past = peering.PastIntervals()
+        for r in scans.values():
+            if r[3]:
+                past = past.merged_with(
+                    peering.PastIntervals(
+                        [peering.Interval.from_list(v) for v in r[3]]
+                    )
+                )
+        strays = self._stray_targets(
+            pg, erasure, shards, past, auth_info.last_epoch_started
+        )
+        stray_scans: dict[int, tuple] = {}
+        if strays:
+            got = await self._scan_shards(
+                pg, {k: m for k, (m, _s) in strays.items()}, erasure,
+                store_shards={k: s for k, (_m, s) in strays.items()},
+            )
+            stray_scans = got or {}
+
+        # -- GetMissing: a STALE-interval member's entries are valid
+        # only up to what the authoritative history knows about that
+        # object; anything past that is divergent — rolled back from
+        # stashes, never merged (reference:src/osd/PGLog.cc
+        # _merge_divergent_entries; ecbackend.rst rollback design).
+        # The boundary is PER OBJECT (the auth log's newest version of
+        # that oid), not the global head: a stale write at a lower
+        # global version must not slip under the cap (code review r5).
+        # Same-interval tails stay: the decodability check in
+        # _repair_object arbitrates in-flight writes (roll-forward when
+        # >= k shards hold the version, stash-rollback otherwise).
+        max_les = auth_info.last_epoch_started
+        auth_vers = (
+            self._object_versions(scans[auth_key])
+            if auth_key is not None else {}
+        )
+        # an EMPTY authoritative history cannot declare anything
+        # divergent: with no reachable member of the data's interval the
+        # safe state is "wait", never "destroy" (code review r5 — the
+        # down/incomplete rule, reference PG::choose_acting)
+        can_judge = bool(auth_vers) or auth_info.last_update > Eversion()
+        for key, r in {**scans, **stray_scans}.items():
+            if key == auth_key or not can_judge:
+                continue
+            stored_les = peering.PGShardInfo.from_dict(r[2]).last_epoch_started
+            if stored_les >= max_les and key in shards:
+                continue  # same interval, acting: in-flight tail
+            div = peering.divergent_entries_per_object(
+                auth_vers, [PGLogEntry.from_dict(e) for e in r[1]],
+            )
+            if not div:
+                continue
+            member, store_shard = (
+                strays[key] if key in stray_scans
+                else (shards[key], key if erasure else -1)
+            )
+            await self._rollback_divergent(
+                pg, erasure, member, store_shard, div
+            )
+
+        authoritative = self._merge(scans, infos, auth_info, auth_vers)
 
         for oid, state in authoritative.items():
             if state["op"] == "delete":
@@ -207,20 +317,91 @@ class RecoveryManager:
                 await self._repair_object(pg, pool, erasure, shards, scans,
                                           oid, state, acting)
 
+        # -- activation: a clean pass peers this interval — bump every
+        # reachable member's last_epoch_started so later-arriving writes
+        # from older intervals can never win find_best_info
+        # (reference PG::activate last_epoch_started update).
+        # Gate: only an interval that REACHED the PG's history may
+        # activate — bumping les from members that hold neither data,
+        # log, nor a prior les would fence out (and later destroy) the
+        # real data when its holders return (code review r5; the
+        # reference's down/incomplete peering states)
+        history_reached = any(
+            i.last_epoch_started > 0 or i.log_len > 0 or scans[k][0]
+            for k, i in infos.items()
+        )
+        if not self._retry_needed and history_reached:
+            await self._activate(pg, erasure, shards, infos)
+
+    @staticmethod
+    def _object_versions(scan: tuple) -> dict[str, Eversion]:
+        """The authoritative member's newest known version per object
+        (its listing + its log) — the per-object divergence boundary."""
+        vers: dict[str, Eversion] = {}
+        objects, log = scan[0], scan[1]
+        for oid, info in objects.items():
+            v = Eversion.from_list(info["version"])
+            if v > vers.get(oid, Eversion()):
+                vers[oid] = v
+        for e in log:
+            v = Eversion.from_list(e["version"])
+            if v > vers.get(e["oid"], Eversion()):
+                vers[e["oid"]] = v
+        return vers
+
+    def _stray_targets(
+        self, pg: PGid, erasure: bool, shards: dict[int, int],
+        past: peering.PastIntervals, since_les: int,
+    ) -> dict[int, tuple[int, int]]:
+        """{waiter_key: (osd_id, store_shard)} for reachable past-interval
+        members not in the current acting set.  For EC intervals the
+        member's index in the recorded acting list IS its shard key, so
+        its stale chunks/log live in that shard collection."""
+        osd = self.osd
+        acting_members = set(shards.values())
+        out: dict[int, tuple[int, int]] = {}
+        claimed: set[tuple[int, int]] = set()
+        for iv in sorted(
+            past.intervals, key=lambda iv: iv.last, reverse=True
+        ):
+            if iv.last < since_les:
+                continue
+            for idx, member in enumerate(iv.acting):
+                if not (0 <= member != CRUSH_ITEM_NONE) \
+                        or member in acting_members:
+                    continue
+                if not osd.osdmap or not osd.osdmap.get_addr(member):
+                    continue  # down: unreachable (see _repair_object defer)
+                s = idx if erasure else -1
+                if (member, s) in claimed:
+                    continue
+                claimed.add((member, s))
+                out[1000 + len(out)] = (member, s)
+        return out
+
     async def _scan_shards(
-        self, pg: PGid, shards: dict[int, int], erasure: bool
-    ) -> dict[int, tuple[dict, list]] | None:
-        """{shard_key: (objects, log)} from every member, local fast path."""
+        self, pg: PGid, shards: dict[int, int], erasure: bool,
+        store_shards: dict[int, int] | None = None,
+    ) -> dict[int, tuple[dict, list, dict | None, list | None]] | None:
+        """{key: (objects, log, info, intervals)} from every member,
+        local fast path.  ``store_shards`` overrides the shard
+        collection scanned per key (stray members keep their chunks in
+        the shard collection of the interval they served)."""
         osd = self.osd
         tid = osd._new_tid()
         waiter = _ScanWaiter(set(shards), dict(shards))
         self._scan_waiters[tid] = waiter
         try:
             for key, member in shards.items():
-                shard_field = key if erasure else -1
+                if store_shards is not None:
+                    shard_field = store_shards[key]
+                else:
+                    shard_field = key if erasure else -1
                 if member == osd.osd_id:
-                    objects, log = self._local_scan(str(pg), shard_field)
-                    waiter.complete(key, objects, log)
+                    objects, log, info, ivs = self._local_scan(
+                        str(pg), shard_field
+                    )
+                    waiter.complete(key, objects, log, info, ivs)
                     continue
                 addr = osd.osdmap.get_addr(member)
                 if not addr:
@@ -251,15 +432,33 @@ class RecoveryManager:
             del self._scan_waiters[tid]
 
     @staticmethod
-    def _merge(scans: dict[int, tuple[dict, list]]) -> dict[str, dict]:
-        """Authoritative per-object state from merged logs + object sets.
+    def _merge(
+        scans: dict[int, tuple],
+        infos: dict[int, "peering.PGShardInfo"] | None = None,
+        auth_info: "peering.PGShardInfo | None" = None,
+        auth_vers: dict[str, Eversion] | None = None,
+    ) -> dict[str, dict]:
+        """Authoritative per-object state (the merge_log outcome,
+        reference:src/osd/PGLog.cc).
 
-        Log entries carry (op, version); object listings carry the version
-        actually stored. Newest version wins; delete-at-newest wins.
+        Members of the AUTHORITATIVE interval (les == max les) merge in
+        full: newest version wins, delete-at-newest wins — within one
+        interval the primary serialized all writes, so version order is
+        write order.  A STALE-interval member contributes, per object,
+        only up to the version the authoritative history knows for that
+        object (code review r5: a global-head cap let stale writes at
+        lower version tuples through); everything past that is the
+        divergent set the caller rolled back, never state.
         """
         state: dict[str, dict] = {}
+        max_les = auth_info.last_epoch_started if auth_info else 0
 
-        def consider(oid: str, op: str, version: list[int]) -> None:
+        def consider(oid: str, op: str, version: list[int],
+                     capped: bool) -> None:
+            if capped:
+                known = (auth_vers or {}).get(oid)
+                if known is None or Eversion.from_list(version) > known:
+                    return  # stale member past the auth history for oid
             cur = state.get(oid)
             if (
                 cur is None
@@ -270,12 +469,77 @@ class RecoveryManager:
             ):
                 state[oid] = {"op": op, "version": list(version)}
 
-        for _shard, (objects, log) in scans.items():
+        for shard, r in scans.items():
+            objects, log = r[0], r[1]
+            les = (
+                infos[shard].last_epoch_started
+                if infos and shard in infos else max_les
+            )
+            capped = les < max_les
             for oid, info in objects.items():
-                consider(oid, "modify", info["version"])
+                consider(oid, "modify", info["version"], capped)
             for e in log:
-                consider(e["oid"], e["op"], e["version"])
+                consider(e["oid"], e["op"], e["version"], capped)
         return state
+
+    async def _rollback_divergent(
+        self, pg: PGid, erasure: bool, member: int, store_shard: int,
+        entries: list[PGLogEntry],
+    ) -> None:
+        """Undo divergent entries on one member, newest-first: restore
+        each entry's stash (or remove the object the entry created) and
+        retract the log record (reference:src/osd/PGLog.cc
+        _merge_divergent_entries; stash mechanics per
+        doc/dev/osd_internals/erasure_coding/ecbackend.rst)."""
+        osd = self.osd
+        cid = CollectionId(
+            f"{pg}s{store_shard}" if erasure else str(pg)
+        )
+        for e in entries:  # newest-first from peering.divergent_entries
+            soid = ObjectId(e.oid, store_shard if erasure else -1)
+            txn = Transaction().create_collection(cid)
+            if e.op == "modify" and e.prior_version == Eversion():
+                txn.remove(cid, soid)  # entry created it: undo = remove
+            elif e.op == "modify" and e.stash:
+                txn.stash_restore(
+                    cid, ObjectId(e.stash, store_shard if erasure else -1),
+                    soid,
+                )
+            # no stash (trimmed, or a delete entry): content cannot be
+            # restored locally — retract the log record and let the
+            # repair pass push the authoritative version over it
+            txn.omap_rmkeys(
+                cid, meta_oid(store_shard), [e.version.key()]
+            )
+            logger.warning(
+                "%s: rolling back divergent %s v%s on osd.%d shard %d",
+                osd.name, e.oid, e.version, member, store_shard,
+            )
+            if not await self._push_txn(pg, store_shard, member, txn, None):
+                self._retry_needed = True
+
+    async def _activate(
+        self, pg: PGid, erasure: bool, shards: dict[int, int],
+        infos: dict[int, "peering.PGShardInfo"],
+    ) -> None:
+        """Peering completed for this interval: persist the new
+        last_epoch_started on every reachable member (reference
+        PG::activate).  From here on, any write a stale-interval primary
+        managed to land loses find_best_info on les, whatever its
+        version numbers say."""
+        osd = self.osd
+        les = osd._epoch()
+        for key, member in shards.items():
+            if infos.get(key) and infos[key].last_epoch_started >= les:
+                continue  # already at (or past) this interval
+            store_shard = key if erasure else -1
+            cid = CollectionId(f"{pg}s{store_shard}" if erasure else str(pg))
+            txn = Transaction().create_collection(cid).omap_setkeys(
+                cid, meta_oid(store_shard),
+                {peering.INFO_KEY: json.dumps({"les": les}).encode()},
+            )
+            if not await self._push_txn(pg, store_shard, member, txn, None):
+                self._retry_needed = True
 
     async def _fresh_versions(
         self, pg: PGid, erasure: bool, shards: dict[int, int], oid: str
@@ -456,7 +720,8 @@ class RecoveryManager:
             padded = (
                 sinfo.pad_to_stripe(data) if data else b"\x00" * sinfo.stripe_width
             )
-            shard_bufs = ec_util.encode(sinfo, codec, padded)
+            # routes through the mesh engine when osd_ec_mesh is on
+            shard_bufs = osd._ec_encode_bufs(sinfo, codec, padded)
             km = codec.get_chunk_count()
             hashes = StripeHashes(km, sinfo.chunk_size)
             hashes.set_range(0, shard_bufs)
@@ -586,15 +851,18 @@ class _ScanWaiter:
     def __init__(self, pending: set[int], members: dict[int, int] | None = None):
         self.pending = set(pending)
         self.members = dict(members or {})
-        self.results: dict[int, tuple[dict, list]] = {}
+        self.results: dict[int, tuple[dict, list, dict | None, list | None]] = {}
         self.event = asyncio.Event()
         if not self.pending:
             self.event.set()
 
-    def complete(self, shard: int, objects: dict, log: list) -> None:
+    def complete(
+        self, shard: int, objects: dict, log: list,
+        info: dict | None = None, intervals: list | None = None,
+    ) -> None:
         if shard in self.pending:
             self.pending.discard(shard)
-            self.results[shard] = (objects, log)
+            self.results[shard] = (objects, log, info, intervals)
             if not self.pending:
                 self.event.set()
 

@@ -221,6 +221,12 @@ class ZoneSyncer:
                     data, meta = await self.src.get_object(bucket, e["key"])
                 except RGWError as err:
                     if -err.code == ENOENT:
+                        # deleted at the source mid-pass: the key was
+                        # pre-tracked but never put — untrack it, or the
+                        # stale entry later authorizes deleting a
+                        # destination-local write of the same name
+                        # (code review r5)
+                        await self._untrack(self._okey(bucket, e["key"]))
                         continue
                     raise
                 await self.dst.put_object(
