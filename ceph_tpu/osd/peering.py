@@ -106,17 +106,6 @@ class PastIntervals:
         if len(self.intervals) > MAX_INTERVALS:
             del self.intervals[: len(self.intervals) - MAX_INTERVALS]
 
-    def members_since(self, epoch: int) -> set[int]:
-        """Every OSD that was acting in an interval overlapping
-        [epoch, now) — the prior set (reference PG::build_prior)."""
-        out: set[int] = set()
-        for iv in self.intervals:
-            if iv.last >= epoch:
-                out.update(
-                    a for a in iv.acting if 0 <= a != CRUSH_ITEM_NONE
-                )
-        return out
-
     def to_json(self) -> bytes:
         return json.dumps([iv.to_list() for iv in self.intervals]).encode()
 
@@ -161,17 +150,6 @@ def find_best_info(
             k,
         ),
     )
-
-
-def divergent_entries(
-    auth_last_update: Eversion, peer_log: list[PGLogEntry]
-) -> list[PGLogEntry]:
-    """Entries on a peer strictly past the authoritative head — the
-    merge_log divergence set (reference:src/osd/PGLog.cc
-    _merge_divergent_entries).  They are returned newest-first, the
-    order rollback must apply (each restore exposes the next stash)."""
-    div = [e for e in peer_log if e.version > auth_last_update]
-    return sorted(div, key=lambda e: e.version, reverse=True)
 
 
 def divergent_entries_per_object(

@@ -266,6 +266,36 @@ class RecoveryManager:
                 store_shards={k: s for k, (_m, s) in strays.items()},
             )
             stray_scans = got or {}
+            # find_best_info must see stray infos too (code review r5):
+            # a past-interval member that peered a NEWER interval than
+            # the whole acting set holds the authoritative history — the
+            # acting set's view must not outvote it
+            stray_infos = {
+                k: peering.derive_info(
+                    r[2], [PGLogEntry.from_dict(e) for e in r[1]]
+                )
+                for k, r in stray_scans.items()
+            }
+            best_all = peering.find_best_info({**infos, **stray_infos})
+            if best_all is not None and best_all in stray_infos and (
+                stray_infos[best_all].last_epoch_started
+                > auth_info.last_epoch_started
+            ):
+                # the newest peered interval lives OUTSIDE the acting
+                # set: anything we rolled back or repaired now would
+                # destroy acked writes.  Defer — the surviving holders
+                # are up (we just scanned them), so the map/backfill
+                # will converge acting toward them (reference: the
+                # pg_temp/backfill path; PG waits rather than judges)
+                logger.warning(
+                    "%s: %s authoritative history is on stray osd.%d "
+                    "(les %d > acting %d): deferring recovery pass",
+                    osd.name, pg, strays[best_all][0],
+                    stray_infos[best_all].last_epoch_started,
+                    auth_info.last_epoch_started,
+                )
+                self._retry_needed = True
+                return
 
         # -- GetMissing: a STALE-interval member's entries are valid
         # only up to what the authoritative history knows about that
@@ -292,8 +322,11 @@ class RecoveryManager:
             if key == auth_key or not can_judge:
                 continue
             stored_les = peering.PGShardInfo.from_dict(r[2]).last_epoch_started
-            if stored_les >= max_les and key in shards:
-                continue  # same interval, acting: in-flight tail
+            if stored_les >= max_les:
+                # same-interval member (acting or stray): an in-flight
+                # tail, arbitrated by the decodability machinery — never
+                # unconditionally rolled back
+                continue
             div = peering.divergent_entries_per_object(
                 auth_vers, [PGLogEntry.from_dict(e) for e in r[1]],
             )
@@ -370,7 +403,8 @@ class RecoveryManager:
                 if not (0 <= member != CRUSH_ITEM_NONE) \
                         or member in acting_members:
                     continue
-                if not osd.osdmap or not osd.osdmap.get_addr(member):
+                if not osd.osdmap or not osd.osdmap.is_up(member) \
+                        or not osd.osdmap.get_addr(member):
                     continue  # down: unreachable (see _repair_object defer)
                 s = idx if erasure else -1
                 if (member, s) in claimed:
@@ -410,8 +444,14 @@ class RecoveryManager:
                 try:
                     conn = await osd.messenger.connect(addr, f"osd.{member}")
                 except (ConnectionError, OSError):
-                    # stale map: member already dead; a newer epoch re-kicks
+                    # stale map: member already dead.  Mark the PASS
+                    # failed — an unreachable member completed as an
+                    # empty scan would feed les=0 into find_best_info
+                    # and let a stale member win authority for this
+                    # pass (code review r5); abort like a timeout does
+                    # and let the newer epoch re-kick.
                     waiter.complete(key, {}, [])
+                    waiter.failed.add(key)
                     self._retry_needed = True
                     continue
                 conn.send(
@@ -425,6 +465,13 @@ class RecoveryManager:
                     await waiter.event.wait()
             except TimeoutError:
                 logger.warning("%s: scan of %s timed out", osd.name, pg)
+                self._retry_needed = True
+                return None
+            if waiter.failed:
+                logger.info(
+                    "%s: scan of %s lost members %s; pass aborted",
+                    osd.name, pg, sorted(waiter.failed),
+                )
                 self._retry_needed = True
                 return None
             return waiter.results
@@ -852,6 +899,7 @@ class _ScanWaiter:
         self.pending = set(pending)
         self.members = dict(members or {})
         self.results: dict[int, tuple[dict, list, dict | None, list | None]] = {}
+        self.failed: set[int] = set()  # members lost mid-scan: pass aborts
         self.event = asyncio.Event()
         if not self.pending:
             self.event.set()
@@ -869,4 +917,5 @@ class _ScanWaiter:
     def fail_member(self, osd_id: int) -> None:
         for key in list(self.pending):
             if self.members.get(key) == osd_id:
+                self.failed.add(key)
                 self.complete(key, {}, [])

@@ -40,14 +40,6 @@ import numpy as np
 from ..utils.buffers import as_u8
 
 
-def _largest_common_divisor(k: int, n: int) -> int:
-    best = 1
-    for d in range(1, min(k, n) + 1):
-        if k % d == 0 and n % d == 0:
-            best = d
-    return best
-
-
 class MeshEcEngine:
     """Compiled-program cache + mesh factory for the EC data path."""
 
@@ -96,7 +88,7 @@ class MeshEcEngine:
         from jax.sharding import Mesh
 
         n = len(self.devices)
-        shard = _largest_common_divisor(k, n)
+        shard = math.gcd(k, n)
         pg = n // shard
         mesh = Mesh(
             np.asarray(self.devices).reshape(pg, shard), ("pg", "shard")

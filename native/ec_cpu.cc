@@ -17,6 +17,11 @@
 #include <cstdint>
 #include <cstring>
 
+#if defined(__GFNI__) && defined(__AVX512BW__) && defined(__AVX512F__)
+#include <immintrin.h>
+#define CEPH_TPU_GFNI 1
+#endif
+
 namespace {
 
 // GF(2^8), poly 0x11d — lane-parallel double on uint64 (8 byte lanes)
@@ -24,6 +29,81 @@ static inline uint64_t gf8_double64(uint64_t x) {
   uint64_t high = (x >> 7) & 0x0101010101010101ULL;
   return ((x & 0x7f7f7f7f7f7f7f7fULL) << 1) ^ (high * 0x1dULL);
 }
+
+#ifdef CEPH_TPU_GFNI
+// GFNI path: multiply-by-constant in GF(2^8)/0x11d expressed as an 8x8
+// bit-matrix for vgf2p8affineqb (the ISA-L-class technique; the fixed
+// gf2p8mulb polynomial is 0x11b, so the affine form is what makes the
+// 0x11d field natively executable).  64 bytes per instruction on zmm.
+static inline uint8_t gf8_mul1(uint8_t a, uint8_t b) {
+  uint8_t p = 0;
+  while (b) {
+    if (b & 1) p ^= a;
+    b >>= 1;
+    a = (uint8_t)((a << 1) ^ ((a & 0x80) ? 0x1d : 0));
+  }
+  return p;
+}
+
+// row for output bit j = mask of source bits feeding it; stored at
+// byte (7-j) of the matrix qword (verified against _mm_gf2p8affine)
+static uint64_t gf8_affine_matrix(uint8_t c) {
+  uint8_t p[8];
+  for (int k = 0; k < 8; ++k) p[k] = gf8_mul1(c, (uint8_t)(1 << k));
+  uint64_t A = 0;
+  for (int j = 0; j < 8; ++j) {
+    uint8_t row = 0;
+    for (int k = 0; k < 8; ++k) row |= (uint8_t)(((p[k] >> j) & 1) << k);
+    A |= ((uint64_t)row) << (8 * (7 - j));
+  }
+  return A;
+}
+
+// parity[i] ^= mul(matrix[i][j], data[j]) for all i, one data pass.
+// aff: per-cell affine qwords [m*k]; n % 64 handled with a tail buffer.
+static void gf8_encode_gfni(const uint64_t* aff, int k, int m,
+                            const uint8_t* const* data,
+                            uint8_t* const* parity, int64_t n) {
+  const int64_t body = n & ~63LL;
+  for (int64_t off = 0; off < body; off += 64) {
+    __m512i acc[8];
+    for (int i = 0; i < m; ++i) acc[i] = _mm512_setzero_si512();
+    for (int j = 0; j < k; ++j) {
+      __m512i src = _mm512_loadu_si512(
+          (const void*)(data[j] + off));
+      for (int i = 0; i < m; ++i) {
+        uint64_t A = aff[i * k + j];
+        if (!A) continue;
+        acc[i] = _mm512_xor_si512(
+            acc[i], _mm512_gf2p8affine_epi64_epi8(
+                        src, _mm512_set1_epi64((long long)A), 0));
+      }
+    }
+    for (int i = 0; i < m; ++i)
+      _mm512_storeu_si512((void*)(parity[i] + off), acc[i]);
+  }
+  if (body < n) {  // tail: pad into a 64B buffer
+    alignas(64) uint8_t sbuf[64], pbuf[8][64];
+    for (int i = 0; i < m; ++i) std::memset(pbuf[i], 0, 64);
+    for (int j = 0; j < k; ++j) {
+      std::memset(sbuf, 0, 64);
+      std::memcpy(sbuf, data[j] + body, (size_t)(n - body));
+      __m512i src = _mm512_load_si512((const void*)sbuf);
+      for (int i = 0; i < m; ++i) {
+        uint64_t A = aff[i * k + j];
+        if (!A) continue;
+        __m512i acc = _mm512_load_si512((const void*)pbuf[i]);
+        acc = _mm512_xor_si512(
+            acc, _mm512_gf2p8affine_epi64_epi8(
+                     src, _mm512_set1_epi64((long long)A), 0));
+        _mm512_store_si512((void*)pbuf[i], acc);
+      }
+    }
+    for (int i = 0; i < m; ++i)
+      std::memcpy(parity[i] + body, pbuf[i], (size_t)(n - body));
+  }
+}
+#endif  // CEPH_TPU_GFNI
 
 static inline uint64_t gf16_double64(uint64_t x) {
   uint64_t high = (x >> 15) & 0x0001000100010001ULL;
@@ -38,6 +118,16 @@ extern "C" {
 // data: k pointers to n-byte chunks; parity: m pointers to n-byte chunks.
 void gf8_encode(const int* matrix, int k, int m, const uint8_t* const* data,
                 uint8_t* const* parity, int64_t n) {
+#ifdef CEPH_TPU_GFNI
+  if (m <= 8) {
+    uint64_t aff[32 * 8];
+    for (int i = 0; i < m; ++i)
+      for (int j = 0; j < k; ++j)
+        aff[i * k + j] = gf8_affine_matrix((uint8_t)matrix[i * k + j]);
+    gf8_encode_gfni(aff, k, m, data, parity, n);
+    return;
+  }
+#endif
   // powers[j][b] = 2^b * data[j], built lazily per 8-byte block to stay in
   // registers/cache: process in blocks of BLK bytes.
   constexpr int64_t BLK = 4096;
@@ -81,6 +171,50 @@ void gf8_encode_flat(const int* matrix, int k, int m, const uint8_t* data,
   for (int j = 0; j < k; ++j) dptr[j] = data + j * n;
   for (int i = 0; i < m; ++i) pptr[i] = parity + i * n;
   gf8_encode(matrix, k, m, dptr, pptr, n);
+}
+
+// Fused stripe-layout encode: one pass over the client buffer produces
+// the per-shard buffers (the OSD's deliverable) AND the parity — no
+// separate transpose pass re-reading the data (the ceph_tpu codec
+// stack's hot entry; ECUtil::encode's per-stripe loop collapsed).
+// in: [S, k, cs] stripes; shards: flat [(k+m), S*cs] output whose rows
+// are the shard buffers. cs % 8 == 0.
+void gf8_encode_stripes(const int* matrix, int k, int m, int64_t S,
+                        int64_t cs, const uint8_t* in, uint8_t* shards) {
+  const uint8_t* dptr[32];
+  uint8_t* pptr[32];
+  const int64_t shard_len = S * cs;
+#ifdef CEPH_TPU_GFNI
+  if (m <= 8) {
+    // affine table built ONCE for the whole batch (r5 review: building
+    // it per stripe cost as much as the vector work at small chunks)
+    uint64_t aff[32 * 8];
+    for (int i = 0; i < m; ++i)
+      for (int j = 0; j < k; ++j)
+        aff[i * k + j] = gf8_affine_matrix((uint8_t)matrix[i * k + j]);
+    for (int64_t s = 0; s < S; ++s) {
+      const uint8_t* base = in + s * k * cs;
+      for (int j = 0; j < k; ++j) {
+        dptr[j] = base + j * cs;
+        std::memcpy(shards + j * shard_len + s * cs, dptr[j], cs);
+      }
+      for (int i = 0; i < m; ++i)
+        pptr[i] = shards + (k + i) * shard_len + s * cs;
+      gf8_encode_gfni(aff, k, m, dptr, pptr, cs);
+    }
+    return;
+  }
+#endif
+  for (int64_t s = 0; s < S; ++s) {
+    const uint8_t* base = in + s * k * cs;
+    for (int j = 0; j < k; ++j) {
+      dptr[j] = base + j * cs;
+      std::memcpy(shards + j * shard_len + s * cs, dptr[j], cs);
+    }
+    for (int i = 0; i < m; ++i)
+      pptr[i] = shards + (k + i) * shard_len + s * cs;
+    gf8_encode(matrix, k, m, dptr, pptr, cs);
+  }
 }
 
 void gf8_mul_region(uint8_t c, const uint8_t* src, uint8_t* dst, int64_t n) {

@@ -52,14 +52,14 @@ class TestFindBestInfo:
         assert peering.find_best_info(infos) == 1
 
     def test_divergent_entries_newest_first(self):
-        head = Eversion(3, 4)
+        auth = {"a": Eversion(3, 3), "b": Eversion(3, 4), "c": Eversion(3, 4)}
         log = [
             PGLogEntry("modify", "a", Eversion(3, 3), Eversion()),
             PGLogEntry("modify", "b", Eversion(3, 5), Eversion(3, 3)),
             PGLogEntry("modify", "c", Eversion(3, 6), Eversion(3, 5)),
         ]
-        div = peering.divergent_entries(head, log)
-        assert [e.oid for e in div] == ["c", "b"]
+        div = peering.divergent_entries_per_object(auth, log)
+        assert [e.oid for e in div] == ["c", "b"]  # newest-first rollback
 
     def test_per_object_divergence_catches_low_version_stale_writes(self):
         """r5 review finding: a stale write numerically BELOW the global
@@ -77,17 +77,22 @@ class TestFindBestInfo:
             ("x", Eversion(5, 10)), ("y", Eversion(4, 2))
         ]
 
-    def test_past_intervals_roundtrip_and_prior_set(self):
+    def test_past_intervals_roundtrip_and_merge(self):
         p = peering.PastIntervals()
         p.note_change(2, 5, [1, 2, 3], 1)
         p.note_change(6, 9, [4, 2, peering.CRUSH_ITEM_NONE], 4)
         p2 = peering.PastIntervals.from_json(p.to_json())
-        assert p2.members_since(6) == {4, 2}
-        assert p2.members_since(3) == {1, 2, 3, 4}
+        assert [iv.to_list() for iv in p2.intervals] == [
+            [2, 5, [1, 2, 3], 1],
+            [6, 9, [4, 2, peering.CRUSH_ITEM_NONE], 4],
+        ]
         merged = p2.merged_with(
             peering.PastIntervals([peering.Interval(10, 12, (7,), 7)])
         )
-        assert merged.members_since(0) == {1, 2, 3, 4, 7}
+        assert len(merged.intervals) == 3
+        # dedup by (first, last)
+        again = merged.merged_with(p2)
+        assert len(again.intervals) == 3
 
 
 # -- service: the judge's scenarios ------------------------------------------
