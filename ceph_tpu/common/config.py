@@ -91,6 +91,19 @@ OPTIONS: dict[str, Option] = _opts(
            "pause before retrying a partial recovery pass (s)"),
     Option("osd_recovery_scan_timeout", float, 10.0,
            "peering scan round-trip budget (s)"),
+    Option("osd_max_backfills", int, 1,
+           "PG recovery/backfill reservations granted concurrently per "
+           "OSD, in each of the local and remote reserver roles "
+           "(reference:src/common/config_opts.h:621)"),
+    Option("osd_recovery_max_active", int, 3,
+           "concurrent object recovery pushes per recovering PG "
+           "(reference:src/common/config_opts.h:801)"),
+    Option("osd_recovery_max_chunk", int, 8 << 20,
+           "replicated recovery push segment size in bytes "
+           "(reference:src/common/config_opts.h:803)"),
+    Option("osd_recovery_reserve_timeout", float, 30.0,
+           "budget for acquiring local+remote recovery reservations "
+           "before the pass defers (s)"),
     # erasure code
     Option("osd_ec_mesh", bool, False,
            "route EC encode/reconstruct through the device-mesh engine "

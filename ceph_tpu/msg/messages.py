@@ -451,3 +451,15 @@ class MOSDPGPush(Message):
 class MOSDPGPushReply(Message):
     TYPE = "pg_push_reply"
     FIELDS = ("pgid", "tid", "from_osd", "results")
+
+
+@register
+class MRecoveryReserve(Message):
+    """Recovery/backfill remote-reservation protocol
+    (reference:src/messages/MRecoveryReserve.h + MBackfillReserve.h):
+    ``op`` is request | grant | release.  A grant may arrive long after
+    the request — the target queues it behind its ``osd_max_backfills``
+    remote slots (reference:src/osd/OSD.h remote_reserver)."""
+
+    TYPE = "recovery_reserve"
+    FIELDS = ("pgid", "tid", "from_osd", "op", "prio")

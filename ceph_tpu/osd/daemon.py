@@ -246,6 +246,17 @@ class OSD(Dispatcher):
             self.ec_mesh = get_mesh_engine()
         prec = self.perf.create("recovery")
         prec.add_counter("pushes", "objects/shards pushed")
+        prec.add_counter("reservation_waits",
+                         "recovery passes that queued for a reservation")
+        # admission control (reference:src/osd/OSD.h local_reserver /
+        # remote_reserver; config_opts.h:621 osd_max_backfills): two
+        # independent slot pools so primaries reserving toward each
+        # other cannot deadlock
+        from .reservations import AsyncReserver
+
+        _backfills = cfg.get("osd_max_backfills")
+        self.local_reserver = AsyncReserver(_backfills)
+        self.remote_reserver = AsyncReserver(_backfills)
         pscrub = self.perf.create("scrub")
         pscrub.add_counter("scrubs", "PG deep scrubs completed")
         pscrub.add_counter("errors", "inconsistencies found")
@@ -265,6 +276,13 @@ class OSD(Dispatcher):
             ("osd_heartbeat_grace",
              lambda _n, v: setattr(self, "heartbeat_grace", v)),
             ("osd_scrub_interval", self._on_scrub_interval),
+            # raising osd_max_backfills must immediately grant queued
+            # reservations (the reference's config-observer on the
+            # AsyncReservers)
+            ("osd_max_backfills", lambda _n, v: (
+                self.local_reserver.set_max(v),
+                self.remote_reserver.set_max(v),
+            )),
         ]
         for opt, cb in self._observers:
             cfg.observe(opt, cb)
@@ -636,6 +654,8 @@ class OSD(Dispatcher):
             self.recovery.handle_scan(conn, msg)
         elif isinstance(msg, messages.MOSDPGScanReply):
             self.recovery.handle_scan_reply(msg)
+        elif isinstance(msg, messages.MRecoveryReserve):
+            self.recovery.handle_reserve(conn, msg)
         elif isinstance(msg, messages.MPing):
             conn.send(messages.MPingReply(stamp=msg.stamp, epoch=self._epoch()))
         elif isinstance(msg, messages.MPingReply):
@@ -668,6 +688,13 @@ class OSD(Dispatcher):
         for w in list(self._read_waiters.values()):
             w.fail_member(peer)
         self.recovery.fail_member(peer)
+        # remote reservations a dead primary held OR had queued here must
+        # free their slots, or one crashed peer starves every later
+        # recovery (reference: the reservation cancel on pg interval
+        # change)
+        self.remote_reserver.cancel_where(
+            lambda k: isinstance(k, tuple) and k and k[0] == peer
+        )
 
     def _peer_osd_id(self, conn: Connection) -> int:
         name = conn.peer_name

@@ -78,6 +78,14 @@ class RecoveryManager:
         self._task: asyncio.Task | None = None
         self._wakeup = asyncio.Event()
         self._retry_needed = False
+        # remote-reservation round trips in flight (tid -> (future, osd))
+        self._reserve_waiters: dict[int, tuple[asyncio.Future, int]] = {}
+        # grant tasks running on behalf of remote primaries
+        self._grant_tasks: set[asyncio.Task] = set()
+        # osd_recovery_max_active instrumentation: concurrent object
+        # pushes this primary has in flight, with high-water mark
+        self.active_pushes = 0
+        self.max_active_pushes = 0
 
     def start(self) -> None:
         if self._task is None:
@@ -87,6 +95,9 @@ class RecoveryManager:
         if self._task is not None:
             self._task.cancel()
             self._task = None
+        for t in list(self._grant_tasks):
+            t.cancel()
+        self._grant_tasks.clear()
 
     @property
     def recoveries_done(self) -> int:
@@ -102,6 +113,9 @@ class RecoveryManager:
         """A peer's connection reset: release scans it owed us."""
         for w in list(self._scan_waiters.values()):
             w.fail_member(osd_id)
+        for tid, (fut, member) in list(self._reserve_waiters.items()):
+            if member == osd_id and not fut.done():
+                fut.set_exception(ConnectionError(f"osd.{osd_id} reset"))
         self._retry_needed = True
 
     # -- scan plumbing --------------------------------------------------------
@@ -125,6 +139,158 @@ class RecoveryManager:
             w.complete(
                 msg.shard, msg.objects, msg.log, msg.info, msg.intervals
             )
+
+    # -- reservation protocol (admission control) ------------------------------
+
+    def handle_reserve(self, conn, msg: messages.MRecoveryReserve) -> None:
+        """Both sides of the remote-reservation exchange
+        (reference:src/messages/MRecoveryReserve.h): as push TARGET we
+        queue the request on our remote reserver and send the grant when
+        a slot frees; as PRIMARY we resolve the waiting future."""
+        if msg.op == "request":
+            key = (msg.from_osd, msg.pgid)
+            fut = self.osd.remote_reserver.request(key, msg.prio or 0)
+            if not fut.done():
+                # contention is visible on the OSD whose slots are full
+                self.osd.perf.get("recovery").inc("reservation_waits")
+
+            async def _grant():
+                try:
+                    await fut
+                except asyncio.CancelledError:
+                    return
+                try:
+                    conn.send(
+                        messages.MRecoveryReserve(
+                            pgid=msg.pgid, tid=msg.tid,
+                            from_osd=self.osd.osd_id, op="grant", prio=0,
+                        )
+                    )
+                except (ConnectionError, OSError):
+                    # primary vanished before the grant: free the slot
+                    self.osd.remote_reserver.cancel(key)
+
+            t = asyncio.ensure_future(_grant())
+            self._grant_tasks.add(t)
+            t.add_done_callback(self._grant_tasks.discard)
+        elif msg.op == "grant":
+            entry = self._reserve_waiters.get(msg.tid)
+            if entry and not entry[0].done():
+                entry[0].set_result(True)
+        elif msg.op == "release":
+            self.osd.remote_reserver.cancel((msg.from_osd, msg.pgid))
+
+    async def _acquire_reservations(
+        self, pg: PGid, members: set[int]
+    ) -> list[int] | None:
+        """Local slot first, then one remote slot per distinct push
+        target (reference PG states WaitLocalRecoveryReserved ->
+        WaitRemoteRecoveryReserved).  Returns the remote members to
+        release later, or None when the budget ran out — the caller
+        defers the pass, releasing everything, so a queued cluster
+        cannot deadlock on criss-cross reservations."""
+        osd = self.osd
+        perf = osd.perf.get("recovery")
+        timeout = osd.config.get("osd_recovery_reserve_timeout")
+        lkey = ("local", str(pg))
+        lfut = osd.local_reserver.request(lkey)
+        if not lfut.done():
+            perf.inc("reservation_waits")
+        try:
+            async with asyncio.timeout(timeout):
+                await lfut
+        except TimeoutError:
+            osd.local_reserver.cancel(lkey)
+            return None
+        except asyncio.CancelledError:
+            osd.local_reserver.cancel(lkey)
+            raise
+        held: list[int] = []
+        for member in sorted(m for m in members if m != osd.osd_id):
+            ok = await self._reserve_remote(pg, member, timeout)
+            if not ok:
+                self._release_reservations(pg, held)
+                return None
+            held.append(member)
+        # self-pushes take our own remote slot directly (local fast path)
+        if osd.osd_id in members:
+            sfut = osd.remote_reserver.request((osd.osd_id, str(pg)))
+            if not sfut.done():
+                perf.inc("reservation_waits")
+            try:
+                async with asyncio.timeout(timeout):
+                    await sfut
+            except TimeoutError:
+                osd.remote_reserver.cancel((osd.osd_id, str(pg)))
+                self._release_reservations(pg, held)
+                return None
+            held.append(osd.osd_id)
+        return held
+
+    async def _reserve_remote(
+        self, pg: PGid, member: int, timeout: float
+    ) -> bool:
+        osd = self.osd
+        addr = osd.osdmap.get_addr(member) if osd.osdmap else None
+        if not addr:
+            return False
+        tid = osd._new_tid()
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._reserve_waiters[tid] = (fut, member)
+        try:
+            conn = await osd.messenger.connect(addr, f"osd.{member}")
+            conn.send(
+                messages.MRecoveryReserve(
+                    pgid=str(pg), tid=tid, from_osd=osd.osd_id,
+                    op="request", prio=0,
+                )
+            )
+            async with asyncio.timeout(timeout):
+                await fut
+            return True
+        except (TimeoutError, ConnectionError, OSError):
+            # withdraw: the target may still grant later; an explicit
+            # release keeps its queue clean
+            try:
+                conn = await osd.messenger.connect(addr, f"osd.{member}")
+                conn.send(
+                    messages.MRecoveryReserve(
+                        pgid=str(pg), tid=tid, from_osd=osd.osd_id,
+                        op="release", prio=0,
+                    )
+                )
+            except (ConnectionError, OSError):
+                pass
+            return False
+        finally:
+            self._reserve_waiters.pop(tid, None)
+
+    def _release_reservations(self, pg: PGid, remote_members: list[int]) -> None:
+        osd = self.osd
+        osd.local_reserver.cancel(("local", str(pg)))
+        for member in remote_members:
+            if member == osd.osd_id:
+                osd.remote_reserver.cancel((osd.osd_id, str(pg)))
+                continue
+            addr = osd.osdmap.get_addr(member) if osd.osdmap else None
+            if not addr:
+                continue
+
+            async def _send_release(addr=addr, member=member):
+                try:
+                    conn = await osd.messenger.connect(addr, f"osd.{member}")
+                    conn.send(
+                        messages.MRecoveryReserve(
+                            pgid=str(pg), tid=0, from_osd=osd.osd_id,
+                            op="release", prio=0,
+                        )
+                    )
+                except (ConnectionError, OSError):
+                    pass  # peer death already freed the slot (ms_handle_reset)
+
+            t = asyncio.ensure_future(_send_release())
+            self._grant_tasks.add(t)
+            t.add_done_callback(self._grant_tasks.discard)
 
     def _local_scan(
         self, pgid: str, shard: int
@@ -342,13 +508,62 @@ class RecoveryManager:
 
         authoritative = self._merge(scans, infos, auth_info, auth_vers)
 
+        # -- admission control: peering above ran unthrottled (the
+        # reference never throttles GetInfo/GetLog), but data movement
+        # needs a local + per-target remote reservation slot
+        # (osd_max_backfills) and runs at most osd_recovery_max_active
+        # object pushes concurrently (reference:src/common/
+        # config_opts.h:621,:801; PG.h WaitLocalRecoveryReserved)
+        work: list[tuple[str, dict]] = []
         for oid, state in authoritative.items():
             if state["op"] == "delete":
-                await self._propagate_delete(pg, pool, erasure, shards, scans,
-                                             oid, state)
-            else:
-                await self._repair_object(pg, pool, erasure, shards, scans,
-                                          oid, state, acting)
+                if any(oid in scans.get(k, ({}, []))[0] for k in shards):
+                    work.append((oid, state))
+            elif self._scan_stale(scans, shards, oid, state):
+                work.append((oid, state))
+        if work:
+            held = await self._acquire_reservations(pg, set(shards.values()))
+            if held is None:
+                self._retry_needed = True
+                return
+            try:
+                max_active = max(
+                    1, int(osd.config.get("osd_recovery_max_active"))
+                )
+                sem = asyncio.Semaphore(max_active)
+
+                async def _one(oid: str, state: dict) -> None:
+                    async with sem:
+                        self.active_pushes += 1
+                        self.max_active_pushes = max(
+                            self.max_active_pushes, self.active_pushes
+                        )
+                        try:
+                            if state["op"] == "delete":
+                                await self._propagate_delete(
+                                    pg, pool, erasure, shards, scans, oid,
+                                    state,
+                                )
+                            else:
+                                await self._repair_object(
+                                    pg, pool, erasure, shards, scans, oid,
+                                    state, acting,
+                                )
+                        finally:
+                            self.active_pushes -= 1
+
+                results = await asyncio.gather(
+                    *(_one(o, s) for o, s in work), return_exceptions=True
+                )
+                for r in results:
+                    if isinstance(r, BaseException):
+                        logger.error(
+                            "%s: recovery push in %s failed: %r",
+                            osd.name, pg, r,
+                        )
+                        self._retry_needed = True
+            finally:
+                self._release_reservations(pg, held)
 
         # -- activation: a clean pass peers this interval — bump every
         # reachable member's last_epoch_started so later-arriving writes
@@ -365,6 +580,22 @@ class RecoveryManager:
         )
         if not self._retry_needed and history_reached:
             await self._activate(pg, erasure, shards, infos)
+
+    @staticmethod
+    def _scan_stale(
+        scans: dict[int, tuple], shards: dict[int, int], oid: str,
+        state: dict,
+    ) -> bool:
+        """True when any acting member's scan disagrees with the
+        authoritative version — the cheap trigger for a repair."""
+        return any(
+            tuple(
+                scans.get(key, ({}, []))[0].get(oid, {}).get(
+                    "version", [-1, -1]
+                )
+            ) != tuple(state["version"])
+            for key in shards
+        )
 
     @staticmethod
     def _object_versions(scan: tuple) -> dict[str, Eversion]:
@@ -651,13 +882,7 @@ class RecoveryManager:
     ) -> None:
         # cheap pre-filter on scan-era data; the real decision re-reads
         # fresh state under the pg lock (a client op may have raced)
-        scan_stale = any(
-            tuple(
-                scans.get(key, ({}, []))[0].get(oid, {}).get("version", [-1, -1])
-            ) != tuple(state["version"])
-            for key in shards
-        )
-        if not scan_stale:
+        if not self._scan_stale(scans, shards, oid, state):
             return
         osd = self.osd
         lock = osd.ec_exclusive(pg, oid) if erasure else osd.pg_lock(pg)
@@ -842,19 +1067,39 @@ class RecoveryManager:
         attrs: dict[str, bytes], entry: PGLogEntry | None,
     ) -> bool:
         """Push one whole replicated object (data + attrs) to a member —
-        the single txn shape shared by recovery backfill and scrub repair
-        (reference:src/osd/ReplicatedBackend.cc push)."""
+        the txn shape shared by recovery backfill and scrub repair
+        (reference:src/osd/ReplicatedBackend.cc push).  Objects larger
+        than ``osd_recovery_max_chunk`` go in bounded segments
+        (reference:src/common/config_opts.h:803, 8 MiB default): the log
+        entry rides only the FINAL segment, so a crash mid-push leaves
+        an unlogged partial object that the next pass simply re-pushes."""
         cid = CollectionId(str(pg))
         soid = ObjectId(oid)
-        txn = (
-            Transaction()
-            .create_collection(cid)
-            .remove(cid, soid)
-            .write(cid, soid, 0, bytes(data))
+        max_chunk = max(
+            1, int(self.osd.config.get("osd_recovery_max_chunk"))
         )
-        for ak, av in attrs.items():
-            txn.setattr(cid, soid, ak, av)
-        return await self._push_txn(pg, -1, member, txn, entry)
+        data = bytes(data)
+        segments = [
+            (off, data[off:off + max_chunk])
+            for off in range(0, max(len(data), 1), max_chunk)
+        ]
+        for i, (off, seg) in enumerate(segments):
+            final = i == len(segments) - 1
+            txn = Transaction()
+            if i == 0:
+                txn.create_collection(cid).remove(cid, soid)
+                if not seg:
+                    txn.write(cid, soid, 0, b"")
+            if seg:
+                txn.write(cid, soid, off, seg)
+            if final:
+                for ak, av in attrs.items():
+                    txn.setattr(cid, soid, ak, av)
+            if not await self._push_txn(
+                pg, -1, member, txn, entry if final else None
+            ):
+                return False
+        return True
 
     async def _push_txn(
         self, pg: PGid, shard: int, member: int, txn: Transaction,

@@ -1,0 +1,109 @@
+"""Recovery/backfill admission control.
+
+The reference throttles data movement (never peering) with two
+mechanisms this module re-expresses for the asyncio OSD:
+
+- ``AsyncReserver`` (reference:src/common/AsyncReserver.h): a per-OSD
+  counting reserver.  Each recovering PG takes one slot; at most
+  ``osd_max_backfills`` slots are granted concurrently
+  (reference:src/common/config_opts.h:621, default 1) and the rest queue
+  FIFO by priority.  Every OSD runs TWO independent reservers — local
+  (as primary) and remote (as push target) — exactly because sharing one
+  pool between the two roles deadlocks when two primaries reserve
+  toward each other (reference:src/osd/OSD.h local_reserver /
+  remote_reserver; PG.h WaitLocalRecoveryReserved /
+  WaitRemoteRecoveryReserved states).
+
+- ``osd_recovery_max_active`` (config_opts.h:801, default 3): a cap on
+  concurrent object recovery operations once a PG holds its
+  reservations; enforced in RecoveryManager with a semaphore.
+
+Both capacities are runtime-tunable: ``set_max`` re-evaluates the queue
+so raising the limit immediately grants waiters (the reference's
+config-observer path on osd_max_backfills).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Hashable
+
+
+class AsyncReserver:
+    """Counting reserver with priority-FIFO queueing.
+
+    ``request`` returns an awaitable that resolves when the slot is
+    granted; ``cancel`` releases a granted slot *or* withdraws a queued
+    request (the reference's cancel_reservation, which callers invoke on
+    both paths).  ``max_granted`` is a high-water mark for tests and
+    perf dumps.
+    """
+
+    def __init__(self, max_allowed: int):
+        self._max = max(0, int(max_allowed))
+        self.granted: set[Hashable] = set()
+        # queue of (priority, seq, key, future); lower seq = older
+        self._queue: list[tuple[int, int, Hashable, asyncio.Future]] = []
+        self._seq = 0
+        self.max_granted = 0
+
+    @property
+    def max_allowed(self) -> int:
+        return self._max
+
+    def set_max(self, n: int) -> None:
+        self._max = max(0, int(n))
+        self._do_queued()
+
+    def request(self, key: Hashable, prio: int = 0) -> asyncio.Future:
+        """Queue a reservation; the future resolves to True on grant.
+        A key already granted or queued resolves/raises consistently:
+        duplicate requests return the existing state (idempotent, like
+        the reference's assert-free re-request after an interval
+        change)."""
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        if key in self.granted:
+            fut.set_result(True)
+            return fut
+        for _p, _s, k, f in self._queue:
+            if k == key:
+                return f
+        self._queue.append((prio, self._seq, key, fut))
+        self._seq += 1
+        self._do_queued()
+        return fut
+
+    def cancel_where(self, pred) -> None:
+        """Cancel every granted AND queued key matching ``pred`` — the
+        peer-death path must free queued requests too, or a slot granted
+        to a dead primary after its reset leaks forever (its release
+        will never arrive and the grant send is a silent no-op on the
+        closed connection)."""
+        # queue first: releasing a granted slot promotes the next queued
+        # request, which could be another key of the same dead peer
+        for key in [k for _p, _s, k, _f in list(self._queue) if pred(k)]:
+            self.cancel(key)
+        for key in [k for k in list(self.granted) if pred(k)]:
+            self.cancel(key)
+
+    def cancel(self, key: Hashable) -> None:
+        if key in self.granted:
+            self.granted.discard(key)
+            self._do_queued()
+            return
+        for i, (_p, _s, k, f) in enumerate(self._queue):
+            if k == key:
+                del self._queue[i]
+                if not f.done():
+                    f.cancel()
+                return
+
+    def _do_queued(self) -> None:
+        # higher priority first, then request order
+        self._queue.sort(key=lambda e: (-e[0], e[1]))
+        while self._queue and len(self.granted) < self._max:
+            _p, _s, key, fut = self._queue.pop(0)
+            self.granted.add(key)
+            self.max_granted = max(self.max_granted, len(self.granted))
+            if not fut.done():
+                fut.set_result(True)
