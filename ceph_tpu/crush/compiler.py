@@ -126,7 +126,11 @@ def decompile_crushmap(m: CrushMap) -> str:
     out.append("")
     out.append("# devices")
     for d in range(m.max_devices):
-        out.append(f"device {d} {m.item_names.get(d, f'osd.{d}')}")
+        line = f"device {d} {m.item_names.get(d, f'osd.{d}')}"
+        cls = m.device_class(d) if hasattr(m, "device_class") else None
+        if cls:
+            line += f" class {cls}"
+        out.append(line)
 
     out.append("")
     out.append("# types")
@@ -167,6 +171,8 @@ def decompile_crushmap(m: CrushMap) -> str:
         out.append("}")
 
     for bid in sorted(m.buckets, reverse=True):  # -1, -2, ...
+        if m.shadow_parent(bid) is not None:
+            continue  # shadow trees are derived state, never printed
         emit_bucket(bid)
 
     out.append("")
@@ -197,8 +203,16 @@ def decompile_crushmap(m: CrushMap) -> str:
                 tname = m.type_names.get(s.arg2, f"type{s.arg2}")
                 out.append(f"\tstep {verb} {mode} {s.arg1} type {tname}")
             elif s.op == CRUSH_RULE_TAKE:
-                iname = m.item_names.get(s.arg1, f"bucket{-1 - s.arg1}")
-                out.append(f"\tstep take {iname}")
+                owner = m.shadow_parent(s.arg1)
+                if owner is not None:
+                    orig, cid = owner
+                    oname = m.item_names.get(orig, f"bucket{-1 - orig}")
+                    out.append(
+                        f"\tstep take {oname} class {m.class_names[cid]}"
+                    )
+                else:
+                    iname = m.item_names.get(s.arg1, f"bucket{-1 - s.arg1}")
+                    out.append(f"\tstep take {iname}")
             else:
                 raise CrushCompileError(f"cannot decompile step op {s.op}")
         out.append("}")
@@ -257,6 +271,9 @@ def _compile_toks(
             item_id[name] = did
             if not name.startswith("device"):
                 m.item_names[did] = name
+            if pos < len(toks) and toks[pos] == "class":
+                m.set_device_class(did, toks[pos + 1])
+                pos += 2
         elif tok == "type":
             tid, name = int(toks[pos + 1]), toks[pos + 2]
             pos += 3
@@ -400,8 +417,20 @@ def _parse_rule(
                 iname = toks[pos + 2]
                 if iname not in item_id:
                     raise CrushCompileError(f"step take: unknown {iname!r}")
-                r.step(CRUSH_RULE_TAKE, item_id[iname])
+                target = item_id[iname]
                 pos += 3
+                if pos < len(toks) and toks[pos] == "class":
+                    cname = toks[pos + 1]
+                    pos += 2
+                    # rules follow buckets in the text form, so the
+                    # shadow forest can be materialized on first use
+                    if not m.class_bucket:
+                        m.populate_classes()
+                    try:
+                        target = m.class_shadow(target, cname)
+                    except KeyError as e:
+                        raise CrushCompileError(str(e)) from None
+                r.step(CRUSH_RULE_TAKE, target)
             elif verb in _SET_STEPS:
                 r.step(_SET_STEPS[verb], int(toks[pos + 2]))
                 pos += 3

@@ -57,6 +57,20 @@ def crush_to_dict(cmap: CrushMap) -> dict:
         "rule_names": {
             str(k): v for k, v in getattr(cmap, "rule_names", {}).items()
         },
+        # device classes (reference encodes class_map/class_name/
+        # class_bucket the same way; shadow buckets travel in "buckets")
+        "class_names": {str(k): v for k, v in cmap.class_names.items()},
+        "class_map": {str(k): v for k, v in cmap.class_map.items()},
+        "class_bucket": {
+            str(b): {str(c): s for c, s in by_class.items()}
+            for b, by_class in cmap.class_bucket.items()
+        },
+        # id reservations must survive the wire: a rebuild on the far
+        # side may never hand a rule-held shadow id to a different
+        # (bucket, class)
+        "shadow_ids": [
+            [b, c, s] for (b, c), s in cmap._shadow_ids.items()
+        ],
     }
 
 
@@ -82,4 +96,23 @@ def crush_from_dict(d: dict) -> CrushMap:
     cmap.rule_names = {
         int(k): v for k, v in d.get("rule_names", {}).items()
     }
+    cmap.class_names = {
+        int(k): v for k, v in d.get("class_names", {}).items()
+    }
+    cmap.class_map = {int(k): v for k, v in d.get("class_map", {}).items()}
+    cmap.class_bucket = {
+        int(b): {int(c): s for c, s in by_class.items()}
+        for b, by_class in d.get("class_bucket", {}).items()
+    }
+    cmap._shadow_owner = {
+        sid: (bid, cid)
+        for bid, by_class in cmap.class_bucket.items()
+        for cid, sid in by_class.items()
+    }
+    cmap._shadow_ids = {
+        (bid, cid): sid for bid, cid, sid in d.get("shadow_ids", [])
+    }
+    # older encodings: derive the reservations from the live shadows
+    for sid, (bid, cid) in cmap._shadow_owner.items():
+        cmap._shadow_ids.setdefault((bid, cid), sid)
     return cmap

@@ -177,6 +177,21 @@ class CrushMap:
         self.tunables = tunables or Tunables.jewel()
         self.type_names: dict[int, str] = {0: "osd"}
         self.item_names: dict[int, str] = {}
+        # device classes (reference:src/crush/CrushWrapper.h class_map /
+        # class_name / class_bucket): tags on devices plus per-class
+        # shadow hierarchies so `step take <root> class <c>` can place
+        # onto hdd-only / ssd-only subtrees
+        self.class_names: dict[int, str] = {}     # class id -> name
+        self.class_map: dict[int, int] = {}       # device id -> class id
+        # original bucket id -> {class id -> shadow bucket id}
+        self.class_bucket: dict[int, dict[int, int]] = {}
+        # shadow bucket id -> (original bucket id, class id)
+        self._shadow_owner: dict[int, tuple[int, int]] = {}
+        # (original id, class id) -> shadow id, RETAINED across rebuilds:
+        # rules hold shadow ids in their TAKE steps, so an id assigned
+        # once may never be recycled for a different (bucket, class) —
+        # the reference reuses old class_bucket ids for the same reason
+        self._shadow_ids: dict[tuple[int, int], int] = {}
 
     # -- structure queries -------------------------------------------------
     @property
@@ -329,9 +344,14 @@ class CrushMap:
         ruleset: int | None = None,
         indep: bool = False,
         max_size: int = 10,
+        device_class: str | None = None,
     ) -> int:
         """CrushWrapper::add_simple_ruleset analog: take root, chooseleaf
-        across ``fault_domain_type``, emit."""
+        across ``fault_domain_type``, emit.  With ``device_class`` the
+        take step targets the class's shadow tree of ``root_id`` (the
+        `create-replicated <name> <root> <type> <class>` path)."""
+        if device_class is not None:
+            root_id = self.class_shadow(root_id, device_class)
         if ruleset is None:
             used = {r.ruleset for r in self.rules if r}
             ruleset = 0
@@ -393,12 +413,173 @@ class CrushMap:
         for bid, n in self.item_names.items():
             if n == name:
                 return bid
-        # fall back: the bucket that is nobody's child
+        # fall back: the bucket that is nobody's child (shadow roots
+        # excluded — they mirror an original root, they don't add one)
         children = {i for b in self.buckets.values() for i in b.items}
-        roots = [bid for bid in self.buckets if bid not in children]
+        roots = [
+            bid for bid in self.buckets
+            if bid not in children and bid not in self._shadow_owner
+        ]
         if len(roots) == 1:
             return roots[0]
         raise KeyError(name)
+
+    # -- device classes ----------------------------------------------------
+    def class_id(self, name: str, create: bool = False) -> int:
+        """reference:CrushWrapper.h get_class_id / get_or_create_class_id."""
+        for cid, n in self.class_names.items():
+            if n == name:
+                return cid
+        if not create:
+            raise KeyError(f"unknown device class {name!r}")
+        cid = max(self.class_names, default=-1) + 1
+        self.class_names[cid] = name
+        return cid
+
+    def set_device_class(self, dev: int, name: str) -> int:
+        """Tag device ``dev`` with class ``name`` (the `ceph osd crush
+        set-device-class` mutation).  Shadow trees are NOT rebuilt here;
+        call :meth:`populate_classes` once after a batch of tags."""
+        if dev < 0:
+            raise ValueError("device classes apply to devices, not buckets")
+        cid = self.class_id(name, create=True)
+        self.class_map[dev] = cid
+        return cid
+
+    def remove_device_class(self, dev: int) -> None:
+        self.class_map.pop(dev, None)
+
+    def device_class(self, dev: int) -> str | None:
+        cid = self.class_map.get(dev)
+        return None if cid is None else self.class_names.get(cid)
+
+    def class_shadow(self, bucket_id: int, class_name: str) -> int:
+        """The shadow bucket mirroring ``bucket_id`` restricted to
+        ``class_name`` devices (reference:CrushWrapper.h
+        get_item_id("<name>~<class>"))."""
+        cid = self.class_id(class_name)
+        try:
+            return self.class_bucket[bucket_id][cid]
+        except KeyError:
+            raise KeyError(
+                f"no shadow tree for bucket {bucket_id} class "
+                f"{class_name!r}; call populate_classes()"
+            ) from None
+
+    def shadow_parent(self, bucket_id: int) -> tuple[int, int] | None:
+        """(original id, class id) when ``bucket_id`` is a shadow, else
+        None — the decompiler and OSDMap dumps use it to hide shadows."""
+        return self._shadow_owner.get(bucket_id)
+
+    def populate_classes(self) -> None:
+        """(Re)build one shadow hierarchy per class in use
+        (reference:CrushWrapper.cc populate_classes /
+        device_class_clone): every original bucket gets a clone per
+        class holding only that class's devices (and the clones of its
+        child buckets), weights re-derived through the normal builder so
+        straw lengths / tree nodes / list sums regenerate for the
+        filtered membership.
+
+        Shadow ids are STABLE: a (bucket, class) pair keeps its id
+        across rebuilds — rules hold these ids in TAKE steps — and a
+        class that lost all its devices keeps (empty) shadows rather
+        than freeing ids another class could silently inherit.  The
+        rebuild is exception-safe: on any error the previous shadow
+        forest is restored before the error propagates.
+        """
+        saved_buckets = {
+            sid: self.buckets.get(sid) for sid in self._shadow_owner
+        }
+        saved_names = {
+            sid: self.item_names.get(sid) for sid in self._shadow_owner
+        }
+        saved_cb = {b: dict(v) for b, v in self.class_bucket.items()}
+        saved_owner = dict(self._shadow_owner)
+        for sid in list(self._shadow_owner):
+            self.buckets.pop(sid, None)
+            self.item_names.pop(sid, None)
+        self.class_bucket.clear()
+        self._shadow_owner.clear()
+        try:
+            self._rebuild_shadows()
+        except Exception:
+            for sid in list(self._shadow_owner):  # discard partial work
+                self.buckets.pop(sid, None)
+                self.item_names.pop(sid, None)
+            for sid, b in saved_buckets.items():
+                if b is not None:
+                    self.buckets[sid] = b
+            for sid, n in saved_names.items():
+                if n is not None:
+                    self.item_names[sid] = n
+            self.class_bucket = saved_cb
+            self._shadow_owner = saved_owner
+            raise
+
+    def _rebuild_shadows(self) -> None:
+        # classes currently tagged PLUS classes that ever had shadows:
+        # an id once handed to a rule must stay pinned to its
+        # (bucket, class), even while the class is temporarily empty
+        used = sorted(
+            set(self.class_map.values())
+            | {cid for _b, cid in self._shadow_ids}
+        )
+        if not used:
+            return
+        originals = sorted(
+            (b for b in self.buckets if b not in self._shadow_owner),
+            reverse=True,
+        )
+
+        def alloc(bid: int, cid: int) -> int:
+            sid = self._shadow_ids.get((bid, cid))
+            if sid is None:
+                sid = -1
+                taken = set(self._shadow_ids.values())
+                while sid in self.buckets or sid in taken:
+                    sid -= 1
+                self._shadow_ids[(bid, cid)] = sid
+            return sid
+
+        for cid in used:
+            cname = self.class_names[cid]
+            done: dict[int, int] = {}
+
+            def clone(bid: int, cid=cid, cname=cname, done=done) -> int:
+                if bid in done:
+                    return done[bid]
+                b = self.buckets[bid]
+                items: list[int] = []
+                weights: list[int] = []
+                for j, item in enumerate(b.items):
+                    if item >= 0:
+                        if self.class_map.get(item) != cid:
+                            continue
+                        items.append(item)
+                        weights.append(_item_weight_of(b, j))
+                    else:
+                        sub = clone(item)
+                        items.append(sub)
+                        weights.append(self.buckets[sub].weight)
+                alg = b.alg
+                if alg == CRUSH_BUCKET_UNIFORM and len(set(weights)) > 1:
+                    # a filtered uniform bucket can hold unequal child
+                    # weights the uniform layout cannot express; straw2
+                    # preserves the weight semantics for the shadow
+                    alg = CRUSH_BUCKET_STRAW2
+                name = self.item_names.get(bid, f"bucket{-1 - bid}")
+                sid = self.make_bucket(
+                    alg, b.type, items, weights,
+                    bucket_id=alloc(bid, cid), name=f"{name}~{cname}",
+                )
+                self.buckets[sid].hash = b.hash
+                done[bid] = sid
+                self.class_bucket.setdefault(bid, {})[cid] = sid
+                self._shadow_owner[sid] = (bid, cid)
+                return sid
+
+            for bid in originals:
+                clone(bid)
 
     def get_weights(self, out: Iterable[int] = (), reweight: dict[int, float] | None = None) -> list[int]:
         """Device in/out weight vector for do_rule (OSDMap osd_weight analog).
@@ -412,6 +593,15 @@ class CrushMap:
         for d, f in (reweight or {}).items():
             w[d] = int(f * 0x10000)
         return w
+
+
+def _item_weight_of(b: Bucket, j: int) -> int:
+    """Weight of item slot ``j`` across the bucket variants."""
+    if b.alg == CRUSH_BUCKET_UNIFORM:
+        return b.item_weight
+    if b.alg == CRUSH_BUCKET_TREE:
+        return b.node_weights[2 * j + 1]
+    return b.item_weights[j]
 
 
 def calc_straws(weights: Sequence[int], version: int = 0) -> list[int]:

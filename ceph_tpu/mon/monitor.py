@@ -1067,6 +1067,10 @@ class Monitor(Dispatcher):
                 "osd down": self._cmd_osd_down,
                 "osd out": self._cmd_osd_out,
                 "osd in": self._cmd_osd_in,
+                "osd crush set-device-class": self._cmd_crush_set_class,
+                "osd crush rm-device-class": self._cmd_crush_rm_class,
+                "osd crush class ls": self._cmd_crush_class_ls,
+                "osd crush class ls-osd": self._cmd_crush_class_ls_osd,
                 "osd tier add": self._cmd_tier_add,
                 "osd tier remove": self._cmd_tier_remove,
                 "osd tier cache-mode": self._cmd_tier_cache_mode,
@@ -1231,7 +1235,8 @@ class Monitor(Dispatcher):
             )
         else:
             pool = self.osdmap.create_replicated_pool(
-                name, size=int(cmd.get("size", 3)), pg_num=pg_num
+                name, size=int(cmd.get("size", 3)), pg_num=pg_num,
+                device_class=cmd.get("device_class") or None,
             )
         self._mark_dirty()
         return 0, "", {"pool_id": pool.id}
@@ -1295,6 +1300,68 @@ class Monitor(Dispatcher):
             "type": "erasure" if pool.is_erasure() else "replicated",
             "erasure_code_profile": pool.erasure_code_profile,
         }
+
+    # -- device classes (reference:src/mon/OSDMonitor.cc
+    # "osd crush set-device-class"; shadow trees in CrushWrapper) -----------
+
+    def _cmd_crush_set_class(self, cmd: dict) -> tuple[int, str, Any]:
+        """Tag OSDs with a device class and rebuild the class shadow
+        trees so `take <root> class <c>` rules can target them."""
+        cls = cmd.get("class", "")
+        if not cls or "~" in cls:
+            return -EINVAL, f"invalid class name {cls!r}", None
+        ids = cmd.get("ids", [])
+        if isinstance(ids, (int, str)):
+            ids = [ids]
+        osds = []
+        for raw in ids:
+            o = int(str(raw).removeprefix("osd."))
+            if not (0 <= o < self.osdmap.max_osd):
+                return -ENOENT, f"no osd.{o}", None
+            osds.append(o)
+        if not osds:
+            return -EINVAL, "no osd ids given", None
+        for o in osds:
+            self.osdmap.crush.set_device_class(o, cls)
+        self.osdmap.crush.populate_classes()
+        self._mark_dirty()
+        return 0, f"set {len(osds)} osd(s) to class {cls!r}", None
+
+    def _cmd_crush_rm_class(self, cmd: dict) -> tuple[int, str, Any]:
+        ids = cmd.get("ids", [])
+        if isinstance(ids, (int, str)):
+            ids = [ids]
+        # validate everything BEFORE mutating: a bad id mid-list must
+        # not leave a partial, never-committed class removal behind
+        osds = []
+        for raw in ids:
+            try:
+                o = int(str(raw).removeprefix("osd."))
+            except ValueError:
+                return -EINVAL, f"invalid osd id {raw!r}", None
+            if not (0 <= o < self.osdmap.max_osd):
+                return -ENOENT, f"no osd.{o}", None
+            osds.append(o)
+        if not osds:
+            return -EINVAL, "no osd ids given", None
+        for o in osds:
+            self.osdmap.crush.remove_device_class(o)
+        self.osdmap.crush.populate_classes()
+        self._mark_dirty()
+        return 0, f"removed class from {len(osds)} osd(s)", None
+
+    def _cmd_crush_class_ls(self, cmd: dict) -> tuple[int, str, Any]:
+        return 0, "", sorted(self.osdmap.crush.class_names.values())
+
+    def _cmd_crush_class_ls_osd(self, cmd: dict) -> tuple[int, str, Any]:
+        cls = cmd.get("class", "")
+        try:
+            cid = self.osdmap.crush.class_id(cls)
+        except KeyError:
+            return -ENOENT, f"unknown class {cls!r}", None
+        return 0, "", sorted(
+            d for d, c in self.osdmap.crush.class_map.items() if c == cid
+        )
 
     def _cmd_osd_reweight(self, cmd: dict) -> tuple[int, str, Any]:
         """reference:OSDMonitor 'osd reweight' — scale an osd's in-weight

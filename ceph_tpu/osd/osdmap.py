@@ -414,14 +414,24 @@ class OSDMap:
     def _next_pool_id(self) -> int:
         return max(self.pools, default=0) + 1
 
+    def _ensure_shadow_trees(self) -> None:
+        """Classes may be tagged without a populate (e.g. a compiled map
+        with class tags but no class rules): build the shadow forest
+        before a pool rule needs it, like the compiler's lazy path."""
+        if self.crush.class_map and not self.crush.class_bucket:
+            self.crush.populate_classes()
+
     def create_replicated_pool(
         self, name: str, size: int = 3, pg_num: int = 8,
-        fault_domain_type: int = 0,
+        fault_domain_type: int = 0, device_class: str | None = None,
     ) -> Pool:
+        if device_class:
+            self._ensure_shadow_trees()
         root = self.crush.root_id()
         ruleset = len([r for r in self.crush.rules if r])
         self.crush.add_simple_rule(
             root, fault_domain_type, RULE_TYPE_REPLICATED, ruleset=ruleset,
+            device_class=device_class,
         )
         pool = Pool(
             id=self._next_pool_id(), name=name, type=POOL_TYPE_REPLICATED,
@@ -452,6 +462,13 @@ class OSDMap:
         k = codec.get_data_chunk_count()
         km = codec.get_chunk_count()
         root = self.crush.root_id(profile.get("ruleset-root", "default"))
+        # profile-directed class placement (the reference's
+        # crush-device-class EC-profile key): take the class's shadow
+        # tree of the profile root
+        device_class = profile.get("crush-device-class")
+        if device_class:
+            self._ensure_shadow_trees()
+            root = self.crush.class_shadow(root, device_class)
         ruleset = len([r for r in self.crush.rules if r])
         steps = codec.get_ruleset_steps()
         added = False

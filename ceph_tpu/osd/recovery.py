@@ -206,25 +206,32 @@ class RecoveryManager:
             osd.local_reserver.cancel(lkey)
             raise
         held: list[int] = []
-        for member in sorted(m for m in members if m != osd.osd_id):
-            ok = await self._reserve_remote(pg, member, timeout)
-            if not ok:
-                self._release_reservations(pg, held)
-                return None
-            held.append(member)
-        # self-pushes take our own remote slot directly (local fast path)
-        if osd.osd_id in members:
-            sfut = osd.remote_reserver.request((osd.osd_id, str(pg)))
-            if not sfut.done():
-                perf.inc("reservation_waits")
-            try:
-                async with asyncio.timeout(timeout):
-                    await sfut
-            except TimeoutError:
-                osd.remote_reserver.cancel((osd.osd_id, str(pg)))
-                self._release_reservations(pg, held)
-                return None
-            held.append(osd.osd_id)
+        try:
+            for member in sorted(m for m in members if m != osd.osd_id):
+                ok = await self._reserve_remote(pg, member, timeout)
+                if not ok:
+                    self._release_reservations(pg, held)
+                    return None
+                held.append(member)
+            # self-pushes take our own remote slot directly (local fast
+            # path)
+            if osd.osd_id in members:
+                sfut = osd.remote_reserver.request((osd.osd_id, str(pg)))
+                if not sfut.done():
+                    perf.inc("reservation_waits")
+                try:
+                    async with asyncio.timeout(timeout):
+                        await sfut
+                except TimeoutError:
+                    osd.remote_reserver.cancel((osd.osd_id, str(pg)))
+                    self._release_reservations(pg, held)
+                    return None
+                held.append(osd.osd_id)
+        except asyncio.CancelledError:
+            # daemon stop/restart mid-acquisition: the local slot and
+            # every slot gathered so far must not outlive the task
+            self._release_reservations(pg, held)
+            raise
         return held
 
     async def _reserve_remote(
