@@ -54,6 +54,7 @@ class MethodContext:
         getxattr: Callable[[str], bytes | None],
         setxattr: Callable[[str, bytes], None] | None = None,
         omap_get: Callable[[], dict[str, bytes]] | None = None,
+        omap_get_keys: Callable[[list[str]], dict[str, bytes]] | None = None,
         omap_set: Callable[[dict[str, bytes]], None] | None = None,
         omap_rm: Callable[[list[str]], None] | None = None,
         write_full: Callable[[bytes], None] | None = None,
@@ -63,6 +64,7 @@ class MethodContext:
         self._getxattr = getxattr
         self._setxattr = setxattr
         self._omap_get = omap_get
+        self._omap_get_keys = omap_get_keys
         self._omap_set = omap_set
         self._omap_rm = omap_rm
         self._write_full = write_full
@@ -77,6 +79,14 @@ class MethodContext:
 
     def omap_get(self) -> dict[str, bytes]:
         return self._omap_get() if self._omap_get else {}
+
+    def omap_get_keys(self, keys: list[str]) -> dict[str, bytes]:
+        """Keyed lookup — O(len(keys)), not a full-index copy; hot-path
+        methods (single-entry get/put/rm) must use this."""
+        if self._omap_get_keys:
+            return self._omap_get_keys(list(keys))
+        omap = self.omap_get()
+        return {k: omap[k] for k in keys if k in omap}
 
     # -- writes (WR methods only)
     def _need_wr(self) -> None:
@@ -165,4 +175,4 @@ def _load_builtins() -> None:
     if _loaded:
         return
     _loaded = True
-    from . import lock, rbd_cls, refcount  # noqa: F401
+    from . import lock, numops, rbd_cls, refcount, rgw_index  # noqa: F401

@@ -1,0 +1,162 @@
+"""RGW bucket-index helpers (reference:src/cls/rgw/cls_rgw.cc).
+
+The reference keeps each bucket's object listing in an omap index whose
+mutations run IN the OSD so the per-bucket stats header (entry count,
+byte total) updates atomically with the entry — a client-side
+omap_set could never keep the two consistent under concurrent writers.
+This class mirrors the subset RGW's data path needs:
+
+- ``init``           bucket_init_index: fresh header
+- ``put``            bucket_complete_op(ADD): upsert entry + stats delta
+- ``rm``             bucket_complete_op(DEL): drop entry + stats delta
+- ``get``            single-entry lookup
+- ``list``           bucket_list: server-side paged listing with
+                     marker/prefix (the reference pages through omap the
+                     same way)
+- ``stats``          header read (bucket stats without listing)
+- ``check``          bucket_check_index: recompute vs header
+- ``rebuild``        bucket_rebuild_index: reset header from entries
+
+Entries are JSON dicts (size/etag/mtime/...); the header lives in an
+xattr (the reference uses the omap header slot).  Keys under the
+reserved ``.upload.`` prefix are NAMESPACE entries (multipart
+bookkeeping — the analog of the reference's special instance
+namespace): written via plain omap by the gateway, excluded from the
+header, ``list``, ``check`` and ``rebuild``, and surfaced only as a
+count in ``stats``.  Other dot-prefixed keys are ordinary object keys
+(S3 allows them).
+"""
+
+from __future__ import annotations
+
+import json
+
+from . import (
+    CLS_METHOD_RD,
+    CLS_METHOD_WR,
+    ClsError,
+    EINVAL,
+    ENOENT,
+    MethodContext,
+    register_class,
+)
+
+HEADER_KEY = "rgw_index_header"
+NS_PREFIX = ".upload."  # reserved multipart namespace
+
+cls = register_class("rgw")
+
+
+def _header(ctx: MethodContext) -> dict:
+    return ctx.get_json(HEADER_KEY) or {"entries": 0, "bytes": 0}
+
+
+def _put_header(ctx: MethodContext, hdr: dict) -> None:
+    ctx.set_json(HEADER_KEY, hdr)
+
+
+@cls.method("init", CLS_METHOD_WR)
+def init(ctx: MethodContext, input: dict) -> dict:
+    _put_header(ctx, {"entries": 0, "bytes": 0})
+    return {}
+
+
+@cls.method("put", CLS_METHOD_RD | CLS_METHOD_WR)
+def put(ctx: MethodContext, input: dict) -> dict:
+    key = input.get("key")
+    entry = input.get("entry")
+    if not key or not isinstance(entry, dict):
+        raise ClsError(EINVAL, "rgw.put: need key + entry dict")
+    hdr = _header(ctx)
+    if not key.startswith(NS_PREFIX):  # namespace entries skip the header
+        old = ctx.omap_get_keys([key]).get(key)
+        if old is not None:
+            hdr["entries"] -= 1
+            hdr["bytes"] -= json.loads(old).get("size", 0)
+        hdr["entries"] += 1
+        hdr["bytes"] += int(entry.get("size", 0))
+        _put_header(ctx, hdr)
+    ctx.omap_set({key: json.dumps(entry).encode()})
+    return {"header": hdr}
+
+
+@cls.method("rm", CLS_METHOD_RD | CLS_METHOD_WR)
+def rm(ctx: MethodContext, input: dict) -> dict:
+    key = input.get("key")
+    if not key:
+        raise ClsError(EINVAL, "rgw.rm: need key")
+    old = ctx.omap_get_keys([key]).get(key)
+    if old is None:
+        raise ClsError(ENOENT, f"rgw.rm: no entry {key!r}")
+    hdr = _header(ctx)
+    if not key.startswith(NS_PREFIX):
+        hdr["entries"] -= 1
+        hdr["bytes"] -= json.loads(old).get("size", 0)
+        _put_header(ctx, hdr)
+    ctx.omap_rm([key])
+    return {"header": hdr}
+
+
+@cls.method("get", CLS_METHOD_RD)
+def get(ctx: MethodContext, input: dict) -> dict:
+    key = input.get("key")
+    if not key:
+        raise ClsError(EINVAL, "rgw.get: need key")
+    raw = ctx.omap_get_keys([key]).get(key)
+    if raw is None:
+        raise ClsError(ENOENT, f"no entry {key!r}")
+    return {"entry": json.loads(raw)}
+
+
+@cls.method("list", CLS_METHOD_RD)
+def list_(ctx: MethodContext, input: dict) -> dict:
+    """Paged listing: entries strictly after ``marker``, filtered by
+    ``prefix``, at most ``max_entries`` — plus ``truncated`` so the
+    caller pages exactly like the reference's bucket_list."""
+    marker = input.get("marker", "")
+    prefix = input.get("prefix", "")
+    max_entries = int(input.get("max_entries", 1000))
+    if max_entries <= 0:
+        raise ClsError(EINVAL, "rgw.list: max_entries must be positive")
+    omap = ctx.omap_get()
+    keys = sorted(
+        k for k in omap
+        if k > marker and not k.startswith(NS_PREFIX)
+        and (not prefix or k.startswith(prefix))
+    )
+    page = keys[:max_entries]
+    return {
+        "entries": {k: json.loads(omap[k]) for k in page},
+        "truncated": len(keys) > max_entries,
+        "next_marker": page[-1] if page else marker,
+    }
+
+
+@cls.method("stats", CLS_METHOD_RD)
+def stats(ctx: MethodContext, input: dict) -> dict:
+    meta = sum(1 for k in ctx.omap_get() if k.startswith(NS_PREFIX))
+    return {"header": _header(ctx), "meta_entries": meta}
+
+
+def _recount(omap: dict[str, bytes]) -> dict:
+    hdr = {"entries": 0, "bytes": 0}
+    for k, raw in omap.items():
+        if k.startswith(NS_PREFIX):
+            continue
+        hdr["entries"] += 1
+        hdr["bytes"] += json.loads(raw).get("size", 0)
+    return hdr
+
+
+@cls.method("check", CLS_METHOD_RD)
+def check(ctx: MethodContext, input: dict) -> dict:
+    actual = _recount(ctx.omap_get())
+    hdr = _header(ctx)
+    return {"header": hdr, "actual": actual, "consistent": hdr == actual}
+
+
+@cls.method("rebuild", CLS_METHOD_RD | CLS_METHOD_WR)
+def rebuild(ctx: MethodContext, input: dict) -> dict:
+    hdr = _recount(ctx.omap_get())
+    _put_header(ctx, hdr)
+    return {"header": hdr}

@@ -1315,7 +1315,10 @@ class Monitor(Dispatcher):
             ids = [ids]
         osds = []
         for raw in ids:
-            o = int(str(raw).removeprefix("osd."))
+            try:
+                o = int(str(raw).removeprefix("osd."))
+            except ValueError:
+                return -EINVAL, f"invalid osd id {raw!r}", None
             if not (0 <= o < self.osdmap.max_osd):
                 return -ENOENT, f"no osd.{o}", None
             osds.append(o)

@@ -2538,6 +2538,12 @@ class OSD(Dispatcher):
             except KeyError:
                 return {}
 
+        def _omap_get_keys(keys: list[str]) -> dict[str, bytes]:
+            try:
+                return self.store.omap_get_keys(cid, oid, keys)
+            except KeyError:
+                return {}
+
         def _omap_set(kv: dict[str, bytes]) -> None:
             _mark()
             txn.touch(cid, oid)
@@ -2554,7 +2560,8 @@ class OSD(Dispatcher):
 
         ctx = cls_mod.MethodContext(
             read=_read, getxattr=_getx, setxattr=_setx,
-            omap_get=_omap_get, omap_set=_omap_set, omap_rm=_omap_rm,
+            omap_get=_omap_get, omap_get_keys=_omap_get_keys,
+            omap_set=_omap_set, omap_rm=_omap_rm,
             write_full=_write_full, writable=method.is_write,
         )
         try:

@@ -256,21 +256,37 @@ class RecoveryManager:
                 await fut
             return True
         except (TimeoutError, ConnectionError, OSError):
-            # withdraw: the target may still grant later; an explicit
-            # release keeps its queue clean
+            self._withdraw_remote(pg, addr, member)
+            return False
+        except asyncio.CancelledError:
+            # task cancelled mid-wait (stop/repeering): the target may
+            # grant later with nobody listening — withdraw or its slot
+            # leaks for good
+            self._withdraw_remote(pg, addr, member)
+            raise
+        finally:
+            self._reserve_waiters.pop(tid, None)
+
+    def _withdraw_remote(self, pg: PGid, addr, member: int) -> None:
+        """Fire-and-forget release keeping the target's queue clean when
+        a request is abandoned (timeout, error, cancellation)."""
+        osd = self.osd
+
+        async def _send():
             try:
                 conn = await osd.messenger.connect(addr, f"osd.{member}")
                 conn.send(
                     messages.MRecoveryReserve(
-                        pgid=str(pg), tid=tid, from_osd=osd.osd_id,
+                        pgid=str(pg), tid=0, from_osd=osd.osd_id,
                         op="release", prio=0,
                     )
                 )
             except (ConnectionError, OSError):
-                pass
-            return False
-        finally:
-            self._reserve_waiters.pop(tid, None)
+                pass  # peer death frees the slot via ms_handle_reset
+
+        t = asyncio.ensure_future(_send())
+        self._grant_tasks.add(t)
+        t.add_done_callback(self._grant_tasks.discard)
 
     def _release_reservations(self, pg: PGid, remote_members: list[int]) -> None:
         osd = self.osd

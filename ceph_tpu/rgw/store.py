@@ -167,6 +167,56 @@ class RGWStore:
     def _index_obj(self, bucket: str) -> str:
         return f".index.{bucket}"
 
+    # -- in-OSD index ops (reference:src/cls/rgw — the bucket index is
+    # mutated by class methods so the stats header stays atomic with the
+    # entries; ceph_tpu.cls.rgw_index) --------------------------------------
+    async def _index_put(self, bucket: str, key: str, entry: dict) -> None:
+        await self.index.exec(
+            self._index_obj(bucket), "rgw", "put",
+            {"key": key, "entry": entry},
+        )
+
+    async def _index_rm(self, bucket: str, key: str) -> None:
+        try:
+            await self.index.exec(
+                self._index_obj(bucket), "rgw", "rm", {"key": key}
+            )
+        except RadosError as e:
+            if e.code != -ENOENT:
+                raise
+
+    async def _index_stats(self, bucket: str) -> dict:
+        try:
+            return await self.index.exec(
+                self._index_obj(bucket), "rgw", "stats", {}
+            )
+        except RadosError as e:
+            if e.code != -ENOENT:
+                raise
+            return {"header": {"entries": 0, "bytes": 0}, "meta_entries": 0}
+
+    async def _index_pages(
+        self, bucket: str, prefix: str = "", marker: str = "",
+    ):
+        """Yield {key: entry} pages from the in-OSD paged listing."""
+        obj = self._index_obj(bucket)
+        while True:
+            try:
+                page = await self.index.exec(
+                    obj, "rgw", "list",
+                    {"prefix": prefix, "marker": marker,
+                     "max_entries": 1000},
+                )
+            except RadosError as e:
+                if e.code == -ENOENT:
+                    return
+                raise
+            if page["entries"]:
+                yield page["entries"]
+            if not page["truncated"]:
+                return
+            marker = page["next_marker"]
+
     async def create_bucket(self, bucket: str, owner: str) -> None:
         if not bucket or "/" in bucket:
             raise RGWError(-EINVAL, f"bad bucket name {bucket!r}")
@@ -182,7 +232,7 @@ class RGWStore:
                 {"owner": owner, "created": _now()}
             ).encode()
         })
-        await self.index.omap_set(self._index_obj(bucket), {})
+        await self.index.exec(self._index_obj(bucket), "rgw", "init", {})
 
     async def bucket_info(self, bucket: str) -> dict:
         buckets = await self._omap(self.meta, BUCKETS_OBJ)
@@ -200,8 +250,10 @@ class RGWStore:
 
     async def delete_bucket(self, bucket: str) -> None:
         await self.bucket_info(bucket)
-        index = await self._omap(self.index, self._index_obj(bucket))
-        if index:
+        st = await self._index_stats(bucket)
+        # in-progress multipart uploads (namespace entries) block the
+        # delete too, like S3
+        if st["header"]["entries"] or st.get("meta_entries"):
             raise RGWError(-ENOTEMPTY, f"bucket {bucket!r} not empty")
         try:
             await self.index.remove(self._index_obj(bucket))
@@ -232,9 +284,7 @@ class RGWStore:
             "mtime": _now(),
             "content_type": content_type,
         }
-        await self.index.omap_set(
-            self._index_obj(bucket), {key: json.dumps(entry).encode()}
-        )
+        await self._index_put(bucket, key, entry)
         await self._log_change("put", bucket, key)
         return entry
 
@@ -254,7 +304,7 @@ class RGWStore:
         if entry is None:
             raise RGWError(-ENOENT, f"no object {bucket}/{key}")
         await self._data_obj(bucket, key).remove()
-        await self.index.omap_rmkeys(self._index_obj(bucket), [key])
+        await self._index_rm(bucket, key)
         await self._log_change("del", bucket, key)
 
     async def copy_object(
@@ -274,41 +324,39 @@ class RGWStore:
         under ``prefix``, collapsed into common prefixes at
         ``delimiter`` (reference:rgw_op.cc RGWListBucket)."""
         await self.bucket_info(bucket)
-        index = await self._omap(self.index, self._index_obj(bucket))
-        keys = sorted(
-            k for k in index
-            if k.startswith(prefix) and not k.startswith(".upload.")
-        )
         contents: list[dict] = []
         common: list[str] = []
         truncated = False
         last_item = ""  # key OR common prefix — next_marker must be the
         # last item RETURNED, else delimiter pages repeat/loop (S3 rule)
-        for k in keys:
-            if k <= marker:
-                continue
-            if (delimiter and marker.endswith(delimiter)
-                    and k.startswith(marker)):
-                # the marker was a common prefix: its whole rolled-up
-                # group was already returned on the previous page
-                continue
-            if delimiter:
-                rest = k[len(prefix):]
-                cut = rest.find(delimiter)
-                if cut >= 0:
-                    cp = prefix + rest[: cut + len(delimiter)]
-                    if not common or common[-1] != cp:
-                        if len(contents) + len(common) >= max_keys:
-                            truncated = True
-                            break
-                        common.append(cp)
-                        last_item = cp
+        # pages come from the in-OSD class already sorted, post-marker
+        # and prefix-filtered (reference cls_rgw bucket_list)
+        async for page in self._index_pages(bucket, prefix, marker):
+            for k in sorted(page):
+                if (delimiter and marker.endswith(delimiter)
+                        and k.startswith(marker)):
+                    # the marker was a common prefix: its whole
+                    # rolled-up group was already returned last page
                     continue
-            if len(contents) + len(common) >= max_keys:
-                truncated = True
+                if delimiter:
+                    rest = k[len(prefix):]
+                    cut = rest.find(delimiter)
+                    if cut >= 0:
+                        cp = prefix + rest[: cut + len(delimiter)]
+                        if not common or common[-1] != cp:
+                            if len(contents) + len(common) >= max_keys:
+                                truncated = True
+                                break
+                            common.append(cp)
+                            last_item = cp
+                        continue
+                if len(contents) + len(common) >= max_keys:
+                    truncated = True
+                    break
+                contents.append({"key": k, **page[k]})
+                last_item = k
+            if truncated:
                 break
-            contents.append({"key": k, **json.loads(index[k])})
-            last_item = k
         return {
             "contents": contents,
             "common_prefixes": common,
@@ -397,9 +445,7 @@ class RGWStore:
             "size": total, "etag": etag, "mtime": _now(),
             "content_type": "binary/octet-stream",
         }
-        await self.index.omap_set(
-            self._index_obj(bucket), {key: json.dumps(entry).encode()}
-        )
+        await self._index_put(bucket, key, entry)
         await self.index.omap_rmkeys(
             self._index_obj(bucket),
             [self._upload_key(key, upload)]
@@ -425,18 +471,29 @@ class RGWStore:
 
     # -- stats ----------------------------------------------------------------
     async def bucket_stats(self, bucket: str) -> dict:
+        """Served from the index header the class maintains atomically
+        with every entry mutation — no listing required
+        (reference:cls_rgw bucket stats via the omap header)."""
         info = await self.bucket_info(bucket)
-        index = await self._omap(self.index, self._index_obj(bucket))
-        objs = [
-            json.loads(v) for k, v in index.items()
-            if not k.startswith(".upload.")
-        ]
+        hdr = (await self._index_stats(bucket))["header"]
         return {
             "bucket": bucket,
             "owner": info["owner"],
-            "num_objects": len(objs),
-            "size_bytes": sum(o["size"] for o in objs),
+            "num_objects": hdr["entries"],
+            "size_bytes": hdr["bytes"],
         }
+
+    async def check_index(self, bucket: str, fix: bool = False) -> dict:
+        """bucket_check_index / bucket_rebuild_index analog
+        (reference:src/cls/rgw cls_rgw_bucket_check_index)."""
+        await self.bucket_info(bucket)
+        method = "rebuild" if fix else "check"
+        out = await self.index.exec(
+            self._index_obj(bucket), "rgw", method, {}
+        )
+        if fix:
+            return {"header": out["header"], "fixed": True}
+        return out
 
     # -- internals ------------------------------------------------------------
     async def _omap(self, io: IoCtx, obj: str) -> dict[str, bytes]:
@@ -448,9 +505,15 @@ class RGWStore:
             raise
 
     async def _index_entry(self, bucket: str, key: str) -> dict | None:
-        index = await self._omap(self.index, self._index_obj(bucket))
-        raw = index.get(key)
-        return json.loads(raw) if raw is not None else None
+        try:
+            out = await self.index.exec(
+                self._index_obj(bucket), "rgw", "get", {"key": key}
+            )
+        except RadosError as e:
+            if e.code == -ENOENT:
+                return None
+            raise
+        return out["entry"]
 
     async def _upload_meta(self, bucket: str, key: str, upload: str) -> dict:
         index = await self._omap(self.index, self._index_obj(bucket))

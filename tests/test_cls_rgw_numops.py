@@ -1,0 +1,213 @@
+"""cls breadth (VERDICT r4 Missing #7): numops + the RGW bucket-index
+class (reference:src/cls/numops/cls_numops.cc, src/cls/rgw/cls_rgw.cc).
+
+The point of in-OSD classes is atomic read-modify-write: concurrent
+writers through plain omap would lose updates; through the class every
+mutation commits under the PG lock with its stats header.
+"""
+
+import asyncio
+
+from ceph_tpu.rados import MiniCluster, RadosError
+from ceph_tpu.rgw.store import RGWStore
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+class TestNumops:
+    def test_add_mul_and_badmsg(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                cl = await cluster.client()
+                await cl.create_pool("p", "replicated")
+                io = cl.io_ctx("p")
+                out = await io.exec(
+                    "ctr", "numops", "add", {"key": "n", "value": 5}
+                )
+                assert out["value"] == "5"
+                out = await io.exec(
+                    "ctr", "numops", "add", {"key": "n", "value": -2}
+                )
+                assert out["value"] == "3"
+                out = await io.exec(
+                    "ctr", "numops", "mul", {"key": "n", "value": 2.5}
+                )
+                assert out["value"] == "7.5"
+                # non-numeric stored value answers EBADMSG like the
+                # reference
+                await io.omap_set("ctr", {"bad": b"not-a-number"})
+                try:
+                    await io.exec(
+                        "ctr", "numops", "add", {"key": "bad", "value": 1}
+                    )
+                    raise AssertionError("expected EBADMSG")
+                except RadosError as e:
+                    assert e.code == -74
+
+        run(main())
+
+    def test_concurrent_adds_lose_nothing(self):
+        """100 concurrent +1 calls => exactly 100: the in-OSD RMW is
+        atomic where client-side omap read+write would race."""
+
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                cl = await cluster.client()
+                await cl.create_pool("p", "replicated")
+                io = cl.io_ctx("p")
+                await asyncio.gather(*(
+                    io.exec("ctr", "numops", "add",
+                            {"key": "n", "value": 1})
+                    for _ in range(100)
+                ))
+                out = await io.exec(
+                    "ctr", "numops", "add", {"key": "n", "value": 0}
+                )
+                assert out["value"] == "100"
+
+        run(main())
+
+
+async def _store(cluster) -> RGWStore:
+    cl = await cluster.client()
+    return await RGWStore.create(cl)
+
+
+class TestRgwIndexClass:
+    def test_header_tracks_puts_and_deletes(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                store = await _store(cluster)
+                await store.create_user("u", "Display")
+                await store.create_bucket("b", "u")
+                for i in range(5):
+                    await store.put_object("b", f"k{i}", bytes(32 * (i + 1)))
+                st = await store.bucket_stats("b")
+                assert st["num_objects"] == 5
+                assert st["size_bytes"] == 32 * (1 + 2 + 3 + 4 + 5)
+                # overwrite replaces, not double-counts
+                await store.put_object("b", "k0", bytes(64))
+                st = await store.bucket_stats("b")
+                assert st["num_objects"] == 5
+                assert st["size_bytes"] == 64 + 32 * (2 + 3 + 4 + 5)
+                await store.delete_object("b", "k4")
+                st = await store.bucket_stats("b")
+                assert st["num_objects"] == 4
+                assert st["size_bytes"] == 64 + 32 * (2 + 3 + 4)
+
+        run(main())
+
+    def test_concurrent_puts_keep_header_exact(self):
+        """The header survives 40 concurrent writers byte-exact — the
+        atomicity plain client-side omap cannot give."""
+
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                store = await _store(cluster)
+                await store.create_user("u", "D")
+                await store.create_bucket("b", "u")
+                await asyncio.gather(*(
+                    store.put_object("b", f"k{i:03d}", bytes(100))
+                    for i in range(40)
+                ))
+                st = await store.bucket_stats("b")
+                assert st["num_objects"] == 40
+                assert st["size_bytes"] == 4000
+                chk = await store.check_index("b")
+                assert chk["consistent"], chk
+
+        run(main())
+
+    def test_paged_listing_via_class(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                store = await _store(cluster)
+                await store.create_user("u", "D")
+                await store.create_bucket("b", "u")
+                for i in range(12):
+                    await store.put_object("b", f"d/{i:02d}", b"x")
+                # page through with max_keys=5
+                seen, marker = [], ""
+                while True:
+                    out = await store.list_objects(
+                        "b", prefix="d/", marker=marker, max_keys=5
+                    )
+                    seen += [c["key"] for c in out["contents"]]
+                    if not out["truncated"]:
+                        break
+                    marker = out["next_marker"]
+                assert seen == [f"d/{i:02d}" for i in range(12)]
+
+        run(main())
+
+    def test_check_and_rebuild_fix_corrupt_header(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                store = await _store(cluster)
+                await store.create_user("u", "D")
+                await store.create_bucket("b", "u")
+                await store.put_object("b", "k", bytes(500))
+                # corrupt the header behind the class's back
+                await store.index.exec(
+                    ".index.b", "rgw", "init", {}
+                )
+                chk = await store.check_index("b")
+                assert not chk["consistent"]
+                fixed = await store.check_index("b", fix=True)
+                assert fixed["header"] == {"entries": 1, "bytes": 500}
+                st = await store.bucket_stats("b")
+                assert st["num_objects"] == 1 and st["size_bytes"] == 500
+
+        run(main())
+
+    def test_dot_prefixed_object_keys_are_ordinary(self):
+        """Only the reserved .upload. namespace is special — S3 allows
+        keys starting with '.' and they must list/count normally
+        (review r5 finding)."""
+
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                store = await _store(cluster)
+                await store.create_user("u", "D")
+                await store.create_bucket("b", "u")
+                await store.put_object("b", ".hidden", b"secret")
+                await store.put_object("b", "plain", b"data")
+                st = await store.bucket_stats("b")
+                assert st["num_objects"] == 2
+                assert st["size_bytes"] == len(b"secret") + len(b"data")
+                out = await store.list_objects("b")
+                assert [c["key"] for c in out["contents"]] == \
+                    [".hidden", "plain"]
+                data, _e = await store.get_object("b", ".hidden")
+                assert data == b"secret"
+                await store.delete_object("b", ".hidden")
+                await store.delete_object("b", "plain")
+                await store.delete_bucket("b")  # now truly empty
+
+        run(main())
+
+    def test_multipart_meta_invisible_to_stats_and_listing(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                store = await _store(cluster)
+                await store.create_user("u", "D")
+                await store.create_bucket("b", "u")
+                upload = await store.init_multipart("b", "big")
+                await store.upload_part("b", "big", upload, 1, bytes(256))
+                st = await store.bucket_stats("b")
+                assert st["num_objects"] == 0 and st["size_bytes"] == 0
+                out = await store.list_objects("b")
+                assert out["contents"] == []
+                # but the in-flight upload blocks bucket deletion
+                try:
+                    await store.delete_bucket("b")
+                    raise AssertionError("expected ENOTEMPTY")
+                except Exception as e:
+                    assert "not empty" in str(e)
+                await store.complete_multipart("b", "big", upload)
+                st = await store.bucket_stats("b")
+                assert st["num_objects"] == 1 and st["size_bytes"] == 256
+
+        run(main())
