@@ -55,6 +55,9 @@ class MethodContext:
         setxattr: Callable[[str, bytes], None] | None = None,
         omap_get: Callable[[], dict[str, bytes]] | None = None,
         omap_get_keys: Callable[[list[str]], dict[str, bytes]] | None = None,
+        omap_get_range: Callable[
+            [str, str, int], tuple[dict[str, bytes], bool]
+        ] | None = None,
         omap_set: Callable[[dict[str, bytes]], None] | None = None,
         omap_rm: Callable[[list[str]], None] | None = None,
         write_full: Callable[[bytes], None] | None = None,
@@ -65,6 +68,7 @@ class MethodContext:
         self._setxattr = setxattr
         self._omap_get = omap_get
         self._omap_get_keys = omap_get_keys
+        self._omap_get_range = omap_get_range
         self._omap_set = omap_set
         self._omap_rm = omap_rm
         self._write_full = write_full
@@ -87,6 +91,24 @@ class MethodContext:
             return self._omap_get_keys(list(keys))
         omap = self.omap_get()
         return {k: omap[k] for k in keys if k in omap}
+
+    def omap_get_range(
+        self, *, start_after: str = "", prefix: str = "",
+        max_entries: int = 1000,
+    ) -> tuple[dict[str, bytes], bool]:
+        """One sorted page strictly after ``start_after`` under
+        ``prefix``: (page, truncated).  Pagers (rgw list) must use this
+        instead of omap_get — a full-index copy per 1000-entry page
+        turns listing into O(n^2/1000)."""
+        if self._omap_get_range:
+            return self._omap_get_range(start_after, prefix, max_entries)
+        omap = self.omap_get()
+        keys = sorted(
+            k for k in omap
+            if k > start_after and (not prefix or k.startswith(prefix))
+        )
+        page = keys[:max_entries]
+        return {k: omap[k] for k in page}, len(keys) > max_entries
 
     # -- writes (WR methods only)
     def _need_wr(self) -> None:

@@ -33,8 +33,7 @@ def _apply(ctx: MethodContext, input: dict, op) -> dict:
         diff = float(input["value"])
     except (KeyError, TypeError, ValueError):
         raise ClsError(EINVAL, "numops: need numeric value") from None
-    omap = ctx.omap_get()
-    raw = omap.get(key)
+    raw = ctx.omap_get_keys([key]).get(key)
     if raw is None:
         cur = 0.0
     else:

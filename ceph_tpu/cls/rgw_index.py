@@ -18,13 +18,20 @@ This class mirrors the subset RGW's data path needs:
 - ``rebuild``        bucket_rebuild_index: reset header from entries
 
 Entries are JSON dicts (size/etag/mtime/...); the header lives in an
-xattr (the reference uses the omap header slot).  Keys under the
-reserved ``.upload.`` prefix are NAMESPACE entries (multipart
-bookkeeping — the analog of the reference's special instance
-namespace): written via plain omap by the gateway, excluded from the
-header, ``list``, ``check`` and ``rebuild``, and surfaced only as a
-count in ``stats``.  Other dot-prefixed keys are ordinary object keys
-(S3 allows them).
+xattr (the reference uses the omap header slot).  The omap keyspace is
+NAMESPACED the way the reference's bucket-index is (cls_rgw's
+instance/ns key encoding): object entries live under ``o:<key>`` —
+written only by this class — and multipart bookkeeping lives under
+``m:...`` (META_NS), written via plain omap by the gateway.  Because
+EVERY user key is stored tag-prefixed, no S3-legal key (including ones
+that look like the meta namespace) can collide with or hide in the
+meta namespace.  Meta entries are excluded from the header, ``list``,
+``check`` and ``rebuild`` and surfaced only as a count in ``stats``.
+
+Listing uses the store's ranged omap pages (MethodContext
+.omap_get_range): each ``list`` call returns one page without copying
+the whole index, and ``stats``'s meta count scans only the META_NS
+range — O(live uploads), not O(objects).
 """
 
 from __future__ import annotations
@@ -42,7 +49,8 @@ from . import (
 )
 
 HEADER_KEY = "rgw_index_header"
-NS_PREFIX = ".upload."  # reserved multipart namespace
+OBJ_NS = "o:"   # object entries: every user key is stored as OBJ_NS+key
+META_NS = "m:"  # multipart bookkeeping, written via plain omap
 
 cls = register_class("rgw")
 
@@ -67,16 +75,16 @@ def put(ctx: MethodContext, input: dict) -> dict:
     entry = input.get("entry")
     if not key or not isinstance(entry, dict):
         raise ClsError(EINVAL, "rgw.put: need key + entry dict")
+    okey = OBJ_NS + key
     hdr = _header(ctx)
-    if not key.startswith(NS_PREFIX):  # namespace entries skip the header
-        old = ctx.omap_get_keys([key]).get(key)
-        if old is not None:
-            hdr["entries"] -= 1
-            hdr["bytes"] -= json.loads(old).get("size", 0)
-        hdr["entries"] += 1
-        hdr["bytes"] += int(entry.get("size", 0))
-        _put_header(ctx, hdr)
-    ctx.omap_set({key: json.dumps(entry).encode()})
+    old = ctx.omap_get_keys([okey]).get(okey)
+    if old is not None:
+        hdr["entries"] -= 1
+        hdr["bytes"] -= json.loads(old).get("size", 0)
+    hdr["entries"] += 1
+    hdr["bytes"] += int(entry.get("size", 0))
+    _put_header(ctx, hdr)
+    ctx.omap_set({okey: json.dumps(entry).encode()})
     return {"header": hdr}
 
 
@@ -85,15 +93,15 @@ def rm(ctx: MethodContext, input: dict) -> dict:
     key = input.get("key")
     if not key:
         raise ClsError(EINVAL, "rgw.rm: need key")
-    old = ctx.omap_get_keys([key]).get(key)
+    okey = OBJ_NS + key
+    old = ctx.omap_get_keys([okey]).get(okey)
     if old is None:
         raise ClsError(ENOENT, f"rgw.rm: no entry {key!r}")
     hdr = _header(ctx)
-    if not key.startswith(NS_PREFIX):
-        hdr["entries"] -= 1
-        hdr["bytes"] -= json.loads(old).get("size", 0)
-        _put_header(ctx, hdr)
-    ctx.omap_rm([key])
+    hdr["entries"] -= 1
+    hdr["bytes"] -= json.loads(old).get("size", 0)
+    _put_header(ctx, hdr)
+    ctx.omap_rm([okey])
     return {"header": hdr}
 
 
@@ -102,7 +110,7 @@ def get(ctx: MethodContext, input: dict) -> dict:
     key = input.get("key")
     if not key:
         raise ClsError(EINVAL, "rgw.get: need key")
-    raw = ctx.omap_get_keys([key]).get(key)
+    raw = ctx.omap_get_keys([OBJ_NS + key]).get(OBJ_NS + key)
     if raw is None:
         raise ClsError(ENOENT, f"no entry {key!r}")
     return {"entry": json.loads(raw)}
@@ -112,51 +120,66 @@ def get(ctx: MethodContext, input: dict) -> dict:
 def list_(ctx: MethodContext, input: dict) -> dict:
     """Paged listing: entries strictly after ``marker``, filtered by
     ``prefix``, at most ``max_entries`` — plus ``truncated`` so the
-    caller pages exactly like the reference's bucket_list."""
+    caller pages exactly like the reference's bucket_list.  Marker and
+    prefix are user-space keys; the OBJ_NS tag is applied (and
+    stripped) here."""
     marker = input.get("marker", "")
     prefix = input.get("prefix", "")
     max_entries = int(input.get("max_entries", 1000))
     if max_entries <= 0:
         raise ClsError(EINVAL, "rgw.list: max_entries must be positive")
-    omap = ctx.omap_get()
-    keys = sorted(
-        k for k in omap
-        if k > marker and not k.startswith(NS_PREFIX)
-        and (not prefix or k.startswith(prefix))
+    page, truncated = ctx.omap_get_range(
+        start_after=OBJ_NS + marker, prefix=OBJ_NS + prefix,
+        max_entries=max_entries,
     )
-    page = keys[:max_entries]
+    names = sorted(page)
     return {
-        "entries": {k: json.loads(omap[k]) for k in page},
-        "truncated": len(keys) > max_entries,
-        "next_marker": page[-1] if page else marker,
+        "entries": {k[len(OBJ_NS):]: json.loads(page[k]) for k in names},
+        "truncated": truncated,
+        "next_marker": names[-1][len(OBJ_NS):] if names else marker,
     }
 
 
 @cls.method("stats", CLS_METHOD_RD)
 def stats(ctx: MethodContext, input: dict) -> dict:
-    meta = sum(1 for k in ctx.omap_get() if k.startswith(NS_PREFIX))
+    meta = 0
+    after = ""
+    while True:
+        page, truncated = ctx.omap_get_range(
+            start_after=after, prefix=META_NS, max_entries=1000
+        )
+        meta += len(page)
+        if not truncated or not page:
+            break
+        after = max(page)
     return {"header": _header(ctx), "meta_entries": meta}
 
 
-def _recount(omap: dict[str, bytes]) -> dict:
+def _recount(ctx: MethodContext) -> dict:
     hdr = {"entries": 0, "bytes": 0}
-    for k, raw in omap.items():
-        if k.startswith(NS_PREFIX):
-            continue
-        hdr["entries"] += 1
-        hdr["bytes"] += json.loads(raw).get("size", 0)
+    after = ""
+    while True:
+        page, truncated = ctx.omap_get_range(
+            start_after=after, prefix=OBJ_NS, max_entries=1000
+        )
+        for raw in page.values():
+            hdr["entries"] += 1
+            hdr["bytes"] += json.loads(raw).get("size", 0)
+        if not truncated or not page:
+            break
+        after = max(page)
     return hdr
 
 
 @cls.method("check", CLS_METHOD_RD)
 def check(ctx: MethodContext, input: dict) -> dict:
-    actual = _recount(ctx.omap_get())
+    actual = _recount(ctx)
     hdr = _header(ctx)
     return {"header": hdr, "actual": actual, "consistent": hdr == actual}
 
 
 @cls.method("rebuild", CLS_METHOD_RD | CLS_METHOD_WR)
 def rebuild(ctx: MethodContext, input: dict) -> dict:
-    hdr = _recount(ctx.omap_get())
+    hdr = _recount(ctx)
     _put_header(ctx, hdr)
     return {"header": hdr}

@@ -2544,6 +2544,17 @@ class OSD(Dispatcher):
             except KeyError:
                 return {}
 
+        def _omap_get_range(
+            start_after: str, prefix: str, max_entries: int
+        ) -> tuple[dict[str, bytes], bool]:
+            try:
+                return self.store.omap_get_range(
+                    cid, oid, start_after=start_after, prefix=prefix,
+                    max_entries=max_entries,
+                )
+            except KeyError:
+                return {}, False
+
         def _omap_set(kv: dict[str, bytes]) -> None:
             _mark()
             txn.touch(cid, oid)
@@ -2561,6 +2572,7 @@ class OSD(Dispatcher):
         ctx = cls_mod.MethodContext(
             read=_read, getxattr=_getx, setxattr=_setx,
             omap_get=_omap_get, omap_get_keys=_omap_get_keys,
+            omap_get_range=_omap_get_range,
             omap_set=_omap_set, omap_rm=_omap_rm,
             write_full=_write_full, writable=method.is_write,
         )
@@ -3011,6 +3023,38 @@ class OSD(Dispatcher):
                     "keys": {k: len(blobs) + i for i, k in enumerate(keys)},
                 })
                 blobs.extend(omap[k] for k in keys)
+            elif name == "omap_get_keys":
+                try:
+                    got = self.store.omap_get_keys(
+                        cid, read_oid, list(op.get("keys", []))
+                    )
+                except KeyError:
+                    out.append({"rval": -ENOENT})
+                    return -ENOENT, out, blobs
+                keys = sorted(got)
+                out.append({
+                    "rval": 0,
+                    "keys": {k: len(blobs) + i for i, k in enumerate(keys)},
+                })
+                blobs.extend(got[k] for k in keys)
+            elif name == "omap_get_range":
+                try:
+                    page, truncated = self.store.omap_get_range(
+                        cid, read_oid,
+                        start_after=str(op.get("start_after", "")),
+                        prefix=str(op.get("prefix", "")),
+                        max_entries=int(op.get("max_entries", 1000)),
+                    )
+                except KeyError:
+                    out.append({"rval": -ENOENT})
+                    return -ENOENT, out, blobs
+                keys = sorted(page)
+                out.append({
+                    "rval": 0,
+                    "keys": {k: len(blobs) + i for i, k in enumerate(keys)},
+                    "truncated": truncated,
+                })
+                blobs.extend(page[k] for k in keys)
             else:
                 out.append({"rval": -EINVAL})
                 return -EINVAL, out, blobs

@@ -888,6 +888,41 @@ class IoCtx:
             k: bytes(reply.blobs[bi]) for k, bi in out.get("keys", {}).items()
         }
 
+    async def omap_get_keys(
+        self, oid: str, keys: list[str]
+    ) -> dict[str, bytes]:
+        """Keyed omap lookup: only the named keys travel the wire
+        (reference:librados omap_get_vals_by_keys)."""
+        reply = await self._op_r(
+            oid, [{"op": "omap_get_keys", "keys": list(keys)}], []
+        )
+        if reply.result < 0:
+            raise RadosError(reply.result, f"omap_get_keys {oid}")
+        out = reply.out[0]
+        return {
+            k: bytes(reply.blobs[bi]) for k, bi in out.get("keys", {}).items()
+        }
+
+    async def omap_get_range(
+        self, oid: str, *, start_after: str = "", prefix: str = "",
+        max_entries: int = 1000,
+    ) -> tuple[dict[str, bytes], bool]:
+        """One sorted page of omap entries strictly after
+        ``start_after`` under ``prefix``: (page, truncated) — the
+        reference's omap_get_vals(start_after, filter_prefix,
+        max_return)."""
+        reply = await self._op_r(
+            oid, [{"op": "omap_get_range", "start_after": start_after,
+                   "prefix": prefix, "max_entries": int(max_entries)}], []
+        )
+        if reply.result < 0:
+            raise RadosError(reply.result, f"omap_get_range {oid}")
+        out = reply.out[0]
+        page = {
+            k: bytes(reply.blobs[bi]) for k, bi in out.get("keys", {}).items()
+        }
+        return page, bool(out.get("truncated"))
+
     async def omap_rmkeys(self, oid: str, keys: list[str]) -> None:
         reply = await self._op_w(
             oid, [{"op": "omap_rmkeys", "keys": list(keys)}], []

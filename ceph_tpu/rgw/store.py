@@ -9,6 +9,7 @@ import json
 import secrets
 import time
 
+from ..cls.rgw_index import META_NS
 from ..rados.client import ENOENT, IoCtx, RadosClient, RadosError
 from ..rados.striper import StripedObject
 
@@ -197,6 +198,7 @@ class RGWStore:
 
     async def _index_pages(
         self, bucket: str, prefix: str = "", marker: str = "",
+        page_size: int = 1000,
     ):
         """Yield {key: entry} pages from the in-OSD paged listing."""
         obj = self._index_obj(bucket)
@@ -205,7 +207,7 @@ class RGWStore:
                 page = await self.index.exec(
                     obj, "rgw", "list",
                     {"prefix": prefix, "marker": marker,
-                     "max_entries": 1000},
+                     "max_entries": page_size},
                 )
             except RadosError as e:
                 if e.code == -ENOENT:
@@ -330,8 +332,15 @@ class RGWStore:
         last_item = ""  # key OR common prefix — next_marker must be the
         # last item RETURNED, else delimiter pages repeat/loop (S3 rule)
         # pages come from the in-OSD class already sorted, post-marker
-        # and prefix-filtered (reference cls_rgw bucket_list)
-        async for page in self._index_pages(bucket, prefix, marker):
+        # and prefix-filtered (reference cls_rgw bucket_list).  Without
+        # a delimiter each index entry yields at most one result, so
+        # cap the page at the caller's budget (+1 for the truncated
+        # probe) like the reference's bucket_list num_entries; with a
+        # delimiter a whole page can roll up into one common prefix, so
+        # keep full pages (review r5 finding)
+        page_size = 1000 if delimiter else max(1, min(1000, max_keys + 1))
+        async for page in self._index_pages(bucket, prefix, marker,
+                                            page_size):
             for k in sorted(page):
                 if (delimiter and marker.endswith(delimiter)
                         and k.startswith(marker)):
@@ -369,10 +378,13 @@ class RGWStore:
         return f"{bucket}/{key}.{upload}.{n:05d}"
 
     def _upload_key(self, key: str, upload: str) -> str:
-        return f".upload.{key}.{upload}"
+        # META_NS-tagged: object entries all live under the index
+        # class's OBJ_NS tag, so no S3-legal key — '.upload.…' included
+        # — can collide with multipart bookkeeping (review r5 finding)
+        return f"{META_NS}upload.{key}.{upload}"
 
     def _part_key(self, key: str, upload: str, n: int) -> str:
-        return f".upload.{key}.{upload}.part.{n:05d}"
+        return f"{META_NS}upload.{key}.{upload}.part.{n:05d}"
 
     async def init_multipart(self, bucket: str, key: str) -> str:
         await self.bucket_info(bucket)
@@ -408,12 +420,27 @@ class RGWStore:
     async def _upload_parts(
         self, bucket: str, key: str, upload: str
     ) -> dict[int, dict]:
-        index = await self._omap(self.index, self._index_obj(bucket))
+        """Ranged read over this upload's part prefix — O(parts), not a
+        full index copy (review r5 finding)."""
+        obj = self._index_obj(bucket)
         prefix = f"{self._upload_key(key, upload)}.part."
-        return {
-            int(k[len(prefix):]): json.loads(v)
-            for k, v in index.items() if k.startswith(prefix)
-        }
+        parts: dict[int, dict] = {}
+        after = ""
+        while True:
+            try:
+                page, truncated = await self.index.omap_get_range(
+                    obj, start_after=after, prefix=prefix,
+                    max_entries=1000,
+                )
+            except RadosError as e:
+                if e.code == -ENOENT:
+                    return parts
+                raise
+            for k, v in page.items():
+                parts[int(k[len(prefix):])] = json.loads(v)
+            if not truncated or not page:
+                return parts
+            after = max(page)
 
     async def complete_multipart(
         self, bucket: str, key: str, upload: str
@@ -516,8 +543,16 @@ class RGWStore:
         return out["entry"]
 
     async def _upload_meta(self, bucket: str, key: str, upload: str) -> dict:
-        index = await self._omap(self.index, self._index_obj(bucket))
-        raw = index.get(self._upload_key(key, upload))
+        ukey = self._upload_key(key, upload)
+        try:
+            got = await self.index.omap_get_keys(
+                self._index_obj(bucket), [ukey]
+            )
+        except RadosError as e:
+            if e.code != -ENOENT:
+                raise
+            got = {}
+        raw = got.get(ukey)
         if raw is None:
             raise RGWError(-ENOENT, f"no upload {upload!r} for {key!r}")
         return json.loads(raw)

@@ -633,6 +633,19 @@ class BlueStore(ObjectStore):
             omap = self._onode(cid, oid).omap
             return {k: omap[k] for k in keys if k in omap}
 
+    def omap_get_range(
+        self, cid: CollectionId, oid: ObjectId, *,
+        start_after: str = "", prefix: str = "", max_entries: int = 1000,
+    ) -> tuple[dict[str, bytes], bool]:
+        with self._lock:
+            omap = self._onode(cid, oid).omap
+            keys = sorted(
+                k for k in omap
+                if k > start_after and (not prefix or k.startswith(prefix))
+            )
+            page = keys[:max_entries]
+            return {k: omap[k] for k in page}, len(keys) > max_entries
+
     def list_collections(self) -> list[CollectionId]:
         with self._lock:
             return [CollectionId(c) for c in sorted(self._colls)]

@@ -242,6 +242,26 @@ class ObjectStore(abc.ABC):
         self, cid: CollectionId, oid: ObjectId, keys: Iterable[str]
     ) -> dict[str, bytes]: ...
 
+    def omap_get_range(
+        self, cid: CollectionId, oid: ObjectId, *,
+        start_after: str = "", prefix: str = "", max_entries: int = 1000,
+    ) -> tuple[dict[str, bytes], bool]:
+        """One sorted page of omap entries strictly after ``start_after``
+        under ``prefix``: (page, truncated).  The analog of the
+        reference's get_omap_iterator + bounded iteration
+        (reference:src/os/ObjectStore.h omap iterators) — pagers (the
+        rgw index class) must use this instead of copying the whole
+        omap per page.  Default walks the full map once (no per-page
+        value copy in the overrides); a sorted-index store can override
+        with a seek."""
+        omap = self.omap_get(cid, oid)
+        keys = sorted(
+            k for k in omap
+            if k > start_after and (not prefix or k.startswith(prefix))
+        )
+        page = keys[:max_entries]
+        return {k: omap[k] for k in page}, len(keys) > max_entries
+
     # -- enumeration
     @abc.abstractmethod
     def list_collections(self) -> list[CollectionId]: ...

@@ -66,13 +66,15 @@ def provenance(plugin: str, profile: dict[str, str]) -> str:
         )
     if plugin == "jerasure" and technique == "liber8tion":
         return (
-            "capability stand-in: jerasure's liber8tion matrix is "
-            "search-found tabulated data (Plank 2009) present only in "
-            "the paper/jerasure C source, neither available in this "
-            "environment (submodule not checked out, no network); "
-            "parity bytes intentionally differ — MDS verified in "
-            "tests/test_paper_pins.py; these bytes pin THIS framework "
-            "across versions"
+            "same-property reconstruction: jerasure's liber8tion matrix "
+            "is search-found tabulated data (Plank 2009) present only "
+            "in the paper/jerasure C source, neither available in this "
+            "environment (submodule not checked out, no network); this "
+            "framework's table is its own deterministic search result "
+            "(tools/search_liber8tion.py) with the paper's defining "
+            "properties — MDS and minimum density (kw+k-1 ones) proven "
+            "in tests/test_paper_pins.py; parity bytes intentionally "
+            "differ and these bytes pin THIS framework across versions"
         )
     if plugin == "jerasure" and technique in ("cauchy_orig", "cauchy_good"):
         return (

@@ -163,9 +163,9 @@ class TestRgwIndexClass:
         run(main())
 
     def test_dot_prefixed_object_keys_are_ordinary(self):
-        """Only the reserved .upload. namespace is special — S3 allows
-        keys starting with '.' and they must list/count normally
-        (review r5 finding)."""
+        """Only the tagged meta namespace is special — S3 allows keys
+        starting with '.' and they must list/count normally (review r5
+        finding)."""
 
         async def main():
             async with MiniCluster(n_osds=3) as cluster:
@@ -185,6 +185,38 @@ class TestRgwIndexClass:
                 await store.delete_object("b", ".hidden")
                 await store.delete_object("b", "plain")
                 await store.delete_bucket("b")  # now truly empty
+
+        run(main())
+
+    def test_meta_lookalike_keys_are_ordinary_objects(self):
+        """S3-legal keys that LOOK like reserved bookkeeping —
+        '.upload.…' (the old flat-namespace prefix) and 'm:upload…'
+        (the tagged meta namespace itself) — must behave as ordinary
+        objects: visible, counted, listed, deletable (review r5
+        finding: the flat '.upload.' check made such objects invisible
+        and the bucket un-deletable)."""
+
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                store = await _store(cluster)
+                await store.create_user("u", "D")
+                await store.create_bucket("b", "u")
+                tricky = [".upload.x", ".upload.x.deadbeef.part.00001",
+                          "m:upload.y", "o:z"]
+                for i, key in enumerate(tricky):
+                    await store.put_object("b", key, bytes(10 + i))
+                st = await store.bucket_stats("b")
+                assert st["num_objects"] == len(tricky)
+                out = await store.list_objects("b")
+                assert sorted(c["key"] for c in out["contents"]) == \
+                    sorted(tricky)
+                chk = await store.check_index("b")
+                assert chk["consistent"]
+                for key in tricky:
+                    data, _e = await store.get_object("b", key)
+                    assert data == bytes(10 + tricky.index(key))
+                    await store.delete_object("b", key)
+                await store.delete_bucket("b")  # truly empty now
 
         run(main())
 
