@@ -193,21 +193,49 @@ def _measure_rate(name, fn, data, data_bytes, quick, deadline) -> float:
     when the spread drowns in timer noise."""
     make = _make_chained(fn)
     t_lo_T, t_hi_T = (2, 130) if quick else (4, 260)
-    reps = 3 if quick else 5
-    lo, hi = make(t_lo_T), make(t_hi_T)
+    # 5 reps: min-of-3 through the tunnel left the short-chain time with
+    # enough jitter to swing the marginal 2x (r5 observed pallas encode
+    # 152 vs 367 GB/s across runs)
+    reps = 5
+    # the marginal is only meaningful when the chain spread clears the
+    # tunnel's timing jitter (±3 ms observed) by a wide margin: a 2.5 ms
+    # spread once reported a 796 GB/s "reconstruct" on a ~30 GB/s
+    # workload.  For fast kernels on small data, ESCALATE the long chain
+    # until the spread is unambiguous instead of guessing from noise.
+    MIN_SPREAD = 12e-3
+
+    lo = make(t_lo_T)
     r = lo(data); _ = np.asarray(r.ravel()[:1])   # compile
-    r = hi(data); _ = np.asarray(r.ravel()[:1])
-    best_lo = best_hi = float("inf")
+    best_lo = float("inf")
     for _ in range(reps):
         t = time.time(); r = lo(data); _ = np.asarray(r.ravel()[:1])
         best_lo = min(best_lo, time.time() - t)
-        t = time.time(); r = hi(data); _ = np.asarray(r.ravel()[:1])
-        best_hi = min(best_hi, time.time() - t)
-        if deadline is not None and time.time() > deadline:
+
+    best_hi = float("inf")
+    meas_T = t_hi_T  # the chain length best_hi was actually measured at
+    for _esc in range(3):
+        meas_T = t_hi_T
+        hi = make(t_hi_T)
+        r = hi(data); _ = np.asarray(r.ravel()[:1])   # compile
+        best_hi = float("inf")
+        for _ in range(reps):
+            t = time.time(); r = hi(data); _ = np.asarray(r.ravel()[:1])
+            best_hi = min(best_hi, time.time() - t)
+            if deadline is not None and time.time() > deadline:
+                break
+        if best_hi - best_lo > MIN_SPREAD:
             break
-    delta = (best_hi - best_lo) / (t_hi_T - t_lo_T)
-    per = delta if delta * (t_hi_T - t_lo_T) > 2e-3 else best_hi / t_hi_T
-    log(f"child: {name}: T{t_lo_T}={best_lo*1e3:.1f}ms T{t_hi_T}="
+        if deadline is not None and time.time() > deadline - 5:
+            break
+        if best_hi > 1.0:  # never escalate an already-long chain
+            break
+        t_hi_T *= 8
+    delta = (best_hi - best_lo) / (meas_T - t_lo_T)
+    per = (
+        delta if best_hi - best_lo > MIN_SPREAD
+        else best_hi / meas_T  # conservative floor incl. dispatch
+    )
+    log(f"child: {name}: T{t_lo_T}={best_lo*1e3:.1f}ms T{meas_T}="
         f"{best_hi*1e3:.1f}ms -> {data_bytes / per / 1e9:.1f} GB/s")
     return per
 
@@ -249,18 +277,24 @@ def bench_device(batch: int, quick: bool, deadline: float | None,
     # vs xla comparison must be measured on device, not asserted from
     # the code comment).  XLA is always available; pallas joins when the
     # platform + lane count allow it.
-    cands: list[tuple[str, object, object]] = [
-        ("xla", make_gf_matmul_u32(P, W), make_gf_matmul_u32(RM, W))
+    # (name, enc, dec, probe_n4): probe_n4 is a lane count the engine's
+    # block constraint accepts, used for the small dec parity probe
+    cands: list[tuple[str, object, object, int]] = [
+        ("xla", make_gf_matmul_u32(P, W), make_gf_matmul_u32(RM, W), 4096)
     ]
     if (platform or "tpu") != "cpu":
         try:
             from ceph_tpu.ops.gf_pallas import BLOCK, make_gf_matmul_pallas
 
-            if jax.devices()[0].platform == "tpu" and (
-                (batch * CHUNK) // 4
-            ) % BLOCK == 0:
-                cands.insert(0, ("pallas", make_gf_matmul_pallas(P, W),
-                                 make_gf_matmul_pallas(RM, W)))
+            n4 = (batch * CHUNK) // 4
+            # prefer the larger block at bench shapes (~4% on a v5e)
+            blk = next((b for b in (8192, BLOCK) if n4 % b == 0), None)
+            if jax.devices()[0].platform == "tpu" and blk:
+                cands.insert(
+                    0,
+                    ("pallas", make_gf_matmul_pallas(P, W, block=blk),
+                     make_gf_matmul_pallas(RM, W, block=blk), blk),
+                )
         except Exception as e:  # the XLA engine is always available
             log(f"child: pallas unavailable ({e!r}); using xla engine")
     log(f"child: GF engine candidates: {[c[0] for c in cands]}")
@@ -278,12 +312,12 @@ def bench_device(batch: int, quick: bool, deadline: float | None,
     # the phase (the import-time try above can't see compile errors)
     head_ref = native.encode(P, data_u8[:, :4096])
     live: list[tuple[str, object, object]] = []
-    for name, enc32, dec32 in cands:
+    for name, enc32, dec32, probe_n4 in cands:
         try:
             parity_dev = jax.jit(enc32)(data)
             # the recovery matrix lowers a DIFFERENT unroll — probe it
             # too, or a dec-only Mosaic failure still kills the phase
-            jax.block_until_ready(jax.jit(dec32)(data[:, :4096]))
+            jax.block_until_ready(jax.jit(dec32)(data[:, :probe_n4]))
             head = np.asarray(parity_dev[:, :1024]).view(np.uint8)
             if not np.array_equal(head, head_ref):
                 # wrong bytes is the exact failure class this probe
@@ -430,12 +464,19 @@ def bench_grid(quick: bool, deadline: float | None,
 
         k_cols = int(np.asarray(matrix).shape[1])
         cands: list[tuple[object, str]] = []
-        if gf_pallas._have_pallas_tpu() and n4 % gf_pallas.BLOCK == 0:
+        blk = next(
+            (b for b in (8192, gf_pallas.BLOCK) if n4 % b == 0), None
+        )
+        if gf_pallas._have_pallas_tpu() and blk:
             if bitmatrix:
-                cand = gf_pallas.make_bitmatrix_matmul_pallas(matrix)
+                cand = gf_pallas.make_bitmatrix_matmul_pallas(
+                    matrix, block=blk
+                )
             else:
-                cand = gf_pallas.make_gf_matmul_pallas(matrix, W)
-            if _probe_compile(cand, k_cols):
+                cand = gf_pallas.make_gf_matmul_pallas(
+                    matrix, W, block=blk
+                )
+            if _probe_compile(cand, k_cols, block=blk):
                 cands.append((cand, "pallas"))
             else:
                 log("grid child: pallas demoted (Mosaic refused)")
@@ -1291,6 +1332,39 @@ def main():
                 "env_pins": _DIAG.get("start", {}).get("env"),
                 "probe_attempts": _DIAG["probe_attempts"],
             }
+            # ...and the most recent LIVE capture committed to the repo
+            # (TPU_EVIDENCE_r*.json, recorded by an in-round run of this
+            # same harness against the real chip) so a dead tunnel at
+            # bench time doesn't erase the round's measured numbers
+            try:
+                import glob as _glob
+
+                here = os.path.dirname(os.path.abspath(__file__))
+
+                def _round_no(p: str) -> int:
+                    import re as _re
+
+                    m = _re.search(r"_r(\d+)\.json$", p)
+                    return int(m.group(1)) if m else -1
+
+                # numeric round sort: lexicographic puts r10 before r9
+                paths = sorted(
+                    _glob.glob(os.path.join(here, "TPU_EVIDENCE_r*.json")),
+                    key=_round_no,
+                )
+                if paths:
+                    with open(paths[-1]) as f:
+                        prior = json.load(f)
+                    if prior.get("phase") == "tpu":
+                        final["prior_tpu_capture"] = {
+                            "source": os.path.basename(paths[-1]),
+                            **{k: prior[k] for k in
+                               ("value", "unit", "encode_gbps",
+                                "reconstruct_gbps", "platform", "engines")
+                               if k in prior},
+                        }
+            except Exception:
+                pass
         return final
 
     def collect(backend: str):

@@ -102,13 +102,11 @@ class MethodContext:
         turns listing into O(n^2/1000)."""
         if self._omap_get_range:
             return self._omap_get_range(start_after, prefix, max_entries)
-        omap = self.omap_get()
-        keys = sorted(
-            k for k in omap
-            if k > start_after and (not prefix or k.startswith(prefix))
+        from ..store.objectstore import omap_range_page
+
+        return omap_range_page(
+            self.omap_get(), start_after, prefix, max_entries
         )
-        page = keys[:max_entries]
-        return {k: omap[k] for k in page}, len(keys) > max_entries
 
     # -- writes (WR methods only)
     def _need_wr(self) -> None:
@@ -177,14 +175,91 @@ def register_class(name: str) -> ObjectClass:
     return _classes[name]
 
 
-def get_class(name: str) -> ObjectClass | None:
+class ClsLoadError(Exception):
+    """External class file exists but failed to load (the reference's
+    dlopen/_cls_init failure path, reference:src/osd/ClassHandler.cc
+    open_class -> -EIO)."""
+
+
+def get_class(name: str, class_dir: str | None = None) -> ObjectClass | None:
+    """Look up a class; on miss, try ``class_dir`` — the dlopen analog
+    (reference:src/osd/ClassHandler.cc open_class loads
+    ``$osd_class_dir/libcls_<name>.so``; here ``cls_<name>.py``).
+
+    The external module registers itself via :func:`register_class` at
+    import, exactly like the built-ins.  A broken file raises
+    :class:`ClsLoadError` (the OSD answers the op with -EIO); a missing
+    file is a plain miss (-EOPNOTSUPP), so a typo'd class name cannot
+    be confused with a broken deployment."""
     _load_builtins()
+    if name not in _classes and class_dir and _CLASS_NAME_RE.match(name):
+        _load_external(name, class_dir)
     return _classes.get(name)
 
 
 def list_classes() -> list[str]:
     _load_builtins()
     return sorted(_classes)
+
+
+import re
+
+# dlopen'd class names in the reference are library identifiers; keep
+# the same shape so a hostile class name can't traverse paths
+_CLASS_NAME_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
+
+# (name, dir) -> ClsLoadError for a broken file, None for loaded/missing;
+# a broken class stays broken on every call (the reference caches the
+# open_class status too) rather than decaying into a name miss
+_external_status: dict[tuple[str, str], "ClsLoadError | None"] = {}
+
+
+def _load_external(name: str, class_dir: str) -> None:
+    import importlib.util
+    import os
+
+    key = (name, class_dir)
+    if key in _external_status:
+        err = _external_status[key]
+        if err is not None:
+            raise err
+        return
+    path = os.path.join(class_dir, f"cls_{name}.py")
+    if not os.path.isfile(path):
+        # NOT cached: a class file deployed after the first lookup must
+        # take effect without an OSD restart (review r5 finding)
+        return
+    _external_status[key] = None
+    before = set(_classes)
+    try:
+        spec = importlib.util.spec_from_file_location(
+            f"ceph_tpu_external_cls_{name}", path
+        )
+        if spec is None or spec.loader is None:
+            raise ClsLoadError(f"cannot load class file {path!r}")
+        mod = importlib.util.module_from_spec(spec)
+        try:
+            spec.loader.exec_module(mod)
+        except BaseException as e:
+            # BaseException: a class file calling sys.exit() (or raising
+            # anything else exotic) must become a cached -EIO with full
+            # rollback, not kill the OSD or leave a half-registered
+            # class served (review r5 finding)
+            raise ClsLoadError(
+                f"class {name!r} at {path!r} failed: {e!r}"
+            ) from e
+        if name not in _classes:
+            raise ClsLoadError(
+                f"class file {path!r} loaded but never registered {name!r}"
+            )
+    except ClsLoadError as e:
+        # roll back any classes the crashing file registered before it
+        # died: a half-initialized class must answer -EIO on every call,
+        # never serve its surviving half (review r5 finding)
+        for added in set(_classes) - before:
+            del _classes[added]
+        _external_status[key] = e
+        raise
 
 
 _loaded = False
@@ -197,4 +272,12 @@ def _load_builtins() -> None:
     if _loaded:
         return
     _loaded = True
-    from . import lock, numops, rbd_cls, refcount, rgw_index  # noqa: F401
+    from . import (  # noqa: F401
+        lock,
+        log,
+        numops,
+        rbd_cls,
+        refcount,
+        rgw_index,
+        version,
+    )

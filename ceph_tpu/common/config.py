@@ -112,6 +112,10 @@ OPTIONS: dict[str, Option] = _opts(
            "ceph_tpu.parallel.engine"),
     Option("erasure_code_dir", str, "ceph_tpu.models",
            "plugin module prefix (dlopen dir analog)"),
+    Option("osd_class_dir", str, "",
+           "directory of external object-class files cls_<name>.py "
+           "(reference: osd_class_dir + ClassHandler dlopen of "
+           "libcls_<name>.so); empty = built-ins only"),
     Option("osd_erasure_code_plugins", str, "jerasure isa lrc shec",
            "plugins preloaded at daemon start"),
     Option("osd_pool_default_erasure_code_profile", str,

@@ -235,17 +235,20 @@ def make_xor_parity():
     return fn
 
 
-def _probe_compile(cand, k_rows: int):
-    """AOT-compile ``cand`` on one [k_rows, BLOCK] block; True iff Mosaic
+def _probe_compile(cand, k_rows: int, block: int | None = None):
+    """AOT-compile ``cand`` on one [k_rows, block] block; True iff Mosaic
     accepts it.  Uses jit(...).lower(...).compile() — NOT a traced call —
     so the probe works identically whether the caller is running eagerly
     or is itself being traced under an outer jax.jit (review r4: a traced
     probe either deferred the Mosaic failure past the except or poisoned
-    the cache with a ConcretizationTypeError)."""
+    the cache with a ConcretizationTypeError).  ``block`` must match the
+    block the candidate was built with (default BLOCK)."""
     from . import gf_pallas
 
     try:
-        spec = jax.ShapeDtypeStruct((k_rows, gf_pallas.BLOCK), jnp.uint32)
+        spec = jax.ShapeDtypeStruct(
+            (k_rows, block or gf_pallas.BLOCK), jnp.uint32
+        )
         jax.jit(cand).lower(spec).compile()
         return True
     except Exception:

@@ -2500,7 +2500,14 @@ class OSD(Dispatcher):
         from .. import cls as cls_mod
 
         info = {"mutated": False, "new_size": None}
-        kls = cls_mod.get_class(op.get("cls", ""))
+        try:
+            kls = cls_mod.get_class(
+                op.get("cls", ""),
+                class_dir=self.config.get("osd_class_dir") or None,
+            )
+        except cls_mod.ClsLoadError as e:
+            logger.error("cls load failed: %s", e)
+            return -EIO, {"error": str(e)}, info
         method = kls.methods.get(op.get("method", "")) if kls else None
         if method is None:
             return -EOPNOTSUPP, {

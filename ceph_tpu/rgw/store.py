@@ -437,7 +437,13 @@ class RGWStore:
                     return parts
                 raise
             for k, v in page.items():
-                parts[int(k[len(prefix):])] = json.loads(v)
+                suffix = k[len(prefix):]
+                if not suffix.isdigit():
+                    # another upload's meta key for an S3-legal object
+                    # key like 'a.<U>.part.00001' sorts inside this
+                    # prefix range — skip it (review r5 finding)
+                    continue
+                parts[int(suffix)] = json.loads(v)
             if not truncated or not page:
                 return parts
             after = max(page)

@@ -53,6 +53,7 @@ from .objectstore import (
     ObjectId,
     ObjectStore,
     Transaction,
+    omap_range_page,
 )
 
 _SEP = "\x1f"
@@ -638,13 +639,10 @@ class BlueStore(ObjectStore):
         start_after: str = "", prefix: str = "", max_entries: int = 1000,
     ) -> tuple[dict[str, bytes], bool]:
         with self._lock:
-            omap = self._onode(cid, oid).omap
-            keys = sorted(
-                k for k in omap
-                if k > start_after and (not prefix or k.startswith(prefix))
+            return omap_range_page(
+                self._onode(cid, oid).omap, start_after, prefix,
+                max_entries,
             )
-            page = keys[:max_entries]
-            return {k: omap[k] for k in page}, len(keys) > max_entries
 
     def list_collections(self) -> list[CollectionId]:
         with self._lock:

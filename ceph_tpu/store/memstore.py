@@ -11,7 +11,13 @@ from __future__ import annotations
 import threading
 from typing import Iterable
 
-from .objectstore import CollectionId, ObjectId, ObjectStore, Transaction
+from .objectstore import (
+    CollectionId,
+    ObjectId,
+    ObjectStore,
+    Transaction,
+    omap_range_page,
+)
 
 
 class _Object:
@@ -254,13 +260,10 @@ class MemStore(ObjectStore):
     ) -> tuple[dict[str, bytes], bool]:
         with self._lock:
             self._assert_mounted()
-            omap = self._obj(cid, oid, create=False).omap
-            keys = sorted(
-                k for k in omap
-                if k > start_after and (not prefix or k.startswith(prefix))
+            return omap_range_page(
+                self._obj(cid, oid, create=False).omap,
+                start_after, prefix, max_entries,
             )
-            page = keys[:max_entries]
-            return {k: omap[k] for k in page}, len(keys) > max_entries
 
     # -- enumeration
     def list_collections(self) -> list[CollectionId]:

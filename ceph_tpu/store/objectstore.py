@@ -179,6 +179,23 @@ class Transaction:
         return len(self.ops)
 
 
+def omap_range_page(
+    omap: dict[str, bytes], start_after: str, prefix: str,
+    max_entries: int,
+) -> tuple[dict[str, bytes], bool]:
+    """The single range-page semantics shared by every store and the
+    cls MethodContext fallback: sorted keys strictly after
+    ``start_after`` under ``prefix``, one page + truncated flag.  Store
+    overrides call this under their lock on the live dict (no full
+    value copy)."""
+    keys = sorted(
+        k for k in omap
+        if k > start_after and (not prefix or k.startswith(prefix))
+    )
+    page = keys[:max_entries]
+    return {k: omap[k] for k in page}, len(keys) > max_entries
+
+
 class ObjectStore(abc.ABC):
     """Transactional object store (reference:src/os/ObjectStore.h).
 
@@ -254,13 +271,9 @@ class ObjectStore(abc.ABC):
         omap per page.  Default walks the full map once (no per-page
         value copy in the overrides); a sorted-index store can override
         with a seek."""
-        omap = self.omap_get(cid, oid)
-        keys = sorted(
-            k for k in omap
-            if k > start_after and (not prefix or k.startswith(prefix))
+        return omap_range_page(
+            self.omap_get(cid, oid), start_after, prefix, max_entries
         )
-        page = keys[:max_entries]
-        return {k: omap[k] for k in page}, len(keys) > max_entries
 
     # -- enumeration
     @abc.abstractmethod

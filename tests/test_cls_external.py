@@ -1,0 +1,177 @@
+"""External object classes from osd_class_dir — the dlopen analog
+(reference:src/osd/ClassHandler.cc open_class loads
+``$osd_class_dir/libcls_<name>.so``; here ``cls_<name>.py``).
+
+Mirrors the EC registry's broken-plugin strategy (SURVEY §4): a working
+external class serves ops like a built-in; a file that crashes at import
+answers -EIO on every call (broken deployment, loudly); an absent file
+stays -EOPNOTSUPP (plain name miss)."""
+
+import asyncio
+import textwrap
+
+import pytest
+
+from ceph_tpu.rados import MiniCluster, RadosError
+
+EOPNOTSUPP = 95
+EIO = 5
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+WORKING = textwrap.dedent(
+    """
+    from ceph_tpu.cls import (
+        CLS_METHOD_RD, CLS_METHOD_WR, MethodContext, register_class,
+    )
+
+    cls = register_class("extecho")
+
+
+    @cls.method("echo", CLS_METHOD_RD)
+    def echo(ctx: MethodContext, input: dict) -> dict:
+        return {"echo": input.get("msg", "")}
+
+
+    @cls.method("bump", CLS_METHOD_RD | CLS_METHOD_WR)
+    def bump(ctx: MethodContext, input: dict) -> dict:
+        raw = ctx.omap_get_keys(["n"]).get("n")
+        n = int(raw) if raw else 0
+        ctx.omap_set({"n": str(n + 1).encode()})
+        return {"n": n + 1}
+    """
+)
+
+BROKEN = "raise RuntimeError('bad class file')\n"
+
+NON_REGISTERING = "x = 1  # loads fine but registers nothing\n"
+
+HALF_REGISTERED = textwrap.dedent(
+    """
+    from ceph_tpu.cls import CLS_METHOD_RD, register_class
+
+    cls = register_class("exthalf")
+
+
+    @cls.method("a", CLS_METHOD_RD)
+    def a(ctx, input):
+        return {"ok": True}
+
+
+    raise RuntimeError("died after registering method a")
+    """
+)
+
+
+@pytest.fixture(autouse=True)
+def _isolate_cls_registry():
+    """The class registry is process-global (one ClassHandler per OSD in
+    the reference; one per test process here) — snapshot/restore it so
+    an external class loaded by one test can't leak into the next."""
+    import ceph_tpu.cls as cls_mod
+
+    cls_mod._load_builtins()  # snapshot AFTER the built-ins exist
+    saved = dict(cls_mod._classes)
+    saved_status = dict(cls_mod._external_status)
+    yield
+    cls_mod._classes.clear()
+    cls_mod._classes.update(saved)
+    cls_mod._external_status.clear()
+    cls_mod._external_status.update(saved_status)
+
+
+@pytest.fixture()
+def class_dir(tmp_path):
+    (tmp_path / "cls_extecho.py").write_text(WORKING)
+    (tmp_path / "cls_extbroken.py").write_text(BROKEN)
+    (tmp_path / "cls_extsilent.py").write_text(NON_REGISTERING)
+    (tmp_path / "cls_exthalf.py").write_text(HALF_REGISTERED)
+    return str(tmp_path)
+
+
+class TestExternalClasses:
+    def test_external_class_served_like_builtin(self, class_dir):
+        async def main():
+            async with MiniCluster(
+                n_osds=3, config_overrides={"osd_class_dir": class_dir}
+            ) as cluster:
+                cl = await cluster.client()
+                await cl.create_pool("p", "replicated")
+                io = cl.io_ctx("p")
+                await io.write_full("obj", b"x")
+                out = await io.exec("obj", "extecho", "echo",
+                                    {"msg": "hi"})
+                assert out["echo"] == "hi"
+                for want in (1, 2, 3):  # stateful RMW through omap
+                    out = await io.exec("obj", "extecho", "bump", {})
+                    assert out["n"] == want
+
+        run(main())
+
+    def test_broken_class_file_is_EIO_not_a_miss(self, class_dir):
+        async def main():
+            async with MiniCluster(
+                n_osds=3, config_overrides={"osd_class_dir": class_dir}
+            ) as cluster:
+                cl = await cluster.client()
+                await cl.create_pool("p", "replicated")
+                io = cl.io_ctx("p")
+                await io.write_full("obj", b"x")
+                for name in ("extbroken", "extsilent"):
+                    with pytest.raises(RadosError) as ei:
+                        await io.exec("obj", name, "any", {})
+                    assert ei.value.code == -EIO, name
+                    # and it STAYS broken on retry (cached status), not
+                    # decaying into -EOPNOTSUPP
+                    with pytest.raises(RadosError) as ei:
+                        await io.exec("obj", name, "any", {})
+                    assert ei.value.code == -EIO, name
+                # a file that registers a method THEN crashes must not
+                # serve the surviving half — -EIO on every call, even
+                # on the method it managed to register (review r5)
+                for _ in range(2):
+                    with pytest.raises(RadosError) as ei:
+                        await io.exec("obj", "exthalf", "a", {})
+                    assert ei.value.code == -EIO
+
+        run(main())
+
+    def test_missing_class_or_no_dir_stays_op_not_supported(
+        self, class_dir
+    ):
+        async def main():
+            async with MiniCluster(
+                n_osds=3, config_overrides={"osd_class_dir": class_dir}
+            ) as cluster:
+                cl = await cluster.client()
+                await cl.create_pool("p", "replicated")
+                io = cl.io_ctx("p")
+                await io.write_full("obj", b"x")
+                with pytest.raises(RadosError) as ei:
+                    await io.exec("obj", "nosuchclass", "m", {})
+                assert ei.value.code == -EOPNOTSUPP
+                # path traversal shapes are rejected as plain misses
+                with pytest.raises(RadosError) as ei:
+                    await io.exec("obj", "../evil", "m", {})
+                assert ei.value.code == -EOPNOTSUPP
+
+        run(main())
+
+    def test_builtins_unaffected_without_class_dir(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                cl = await cluster.client()
+                await cl.create_pool("p", "replicated")
+                io = cl.io_ctx("p")
+                await io.write_full("obj", b"x")
+                out = await io.exec("obj", "numops", "add",
+                                    {"key": "k", "value": "2"})
+                assert out["value"] == "2"
+                with pytest.raises(RadosError) as ei:
+                    await io.exec("obj", "extecho", "echo", {})
+                assert ei.value.code == -EOPNOTSUPP
+
+        run(main())
