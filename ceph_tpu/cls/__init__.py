@@ -229,7 +229,6 @@ def _load_external(name: str, class_dir: str) -> None:
         # NOT cached: a class file deployed after the first lookup must
         # take effect without an OSD restart (review r5 finding)
         return
-    _external_status[key] = None
     before = set(_classes)
     try:
         spec = importlib.util.spec_from_file_location(
@@ -252,14 +251,19 @@ def _load_external(name: str, class_dir: str) -> None:
             raise ClsLoadError(
                 f"class file {path!r} loaded but never registered {name!r}"
             )
-    except ClsLoadError as e:
+    except BaseException as e:
         # roll back any classes the crashing file registered before it
         # died: a half-initialized class must answer -EIO on every call,
-        # never serve its surviving half (review r5 finding)
+        # never serve its surviving half; cache EVERY failure as broken
+        # so nothing decays into a name miss (review r5 findings)
         for added in set(_classes) - before:
             del _classes[added]
-        _external_status[key] = e
-        raise
+        err = (e if isinstance(e, ClsLoadError)
+               else ClsLoadError(f"class {name!r} at {path!r}: {e!r}"))
+        _external_status[key] = err
+        raise err from (None if err is e else e)
+    # success only: cached as loaded
+    _external_status[key] = None
 
 
 _loaded = False

@@ -42,12 +42,15 @@ cls = register_class("log")
 
 
 def _ts_key(ts: float, counter: int) -> str:
-    # fixed-width: 17.6f covers dates far past 2100 with µs resolution
-    return f"{PREFIX}{ts:017.6f}_{counter:08d}"
+    # fixed-width: 17.6f covers dates far past 2100 with µs resolution;
+    # 12-digit seq keeps lexicographic == numeric to 10^12 entries
+    return f"{PREFIX}{ts:017.6f}_{counter:012d}"
 
 
 def _header(ctx: MethodContext) -> dict:
-    return ctx.get_json(HEADER_KEY) or {"max_marker": "", "max_time": 0.0}
+    return ctx.get_json(HEADER_KEY) or {
+        "max_marker": "", "max_time": 0.0, "seq": 0,
+    }
 
 
 @cls.method("add", CLS_METHOD_RD | CLS_METHOD_WR)
@@ -56,21 +59,19 @@ def add(ctx: MethodContext, input: dict) -> dict:
     if not isinstance(entries, list) or not entries:
         raise ClsError(EINVAL, "log.add: need entries list")
     hdr = _header(ctx)
-    # resume the counter after the current max key so same-timestamp
-    # appends across calls stay distinct and ordered
-    counter = 0
-    if hdr["max_marker"]:
-        try:
-            counter = int(hdr["max_marker"].rsplit("_", 1)[1]) + 1
-        except (IndexError, ValueError):
-            counter = 0
+    # the counter is a header-resident GLOBAL sequence, never derived
+    # from max_marker: entries added with a timestamp older than
+    # max_time would re-derive the same counter and silently overwrite
+    # each other (review r5 finding, reproduced) — and out-of-order
+    # timestamps are exactly the clock-skew case a shared log sees
+    seq = int(hdr.get("seq", 0))
     kv: dict[str, bytes] = {}
     for e in entries:
         if "section" not in e and "name" not in e and "data" not in e:
             raise ClsError(EINVAL, "log.add: entry needs section/name/data")
         ts = float(e.get("ts", hdr["max_time"]))
-        key = _ts_key(ts, counter)
-        counter += 1
+        key = _ts_key(ts, seq)
+        seq += 1
         kv[key] = json.dumps({
             "ts": ts,
             "section": str(e.get("section", "")),
@@ -81,6 +82,7 @@ def add(ctx: MethodContext, input: dict) -> dict:
             hdr["max_marker"] = key
         if ts > hdr["max_time"]:
             hdr["max_time"] = ts
+    hdr["seq"] = seq
     ctx.omap_set(kv)
     ctx.set_json(HEADER_KEY, hdr)
     return {"header": hdr}

@@ -133,6 +133,8 @@ OPTIONS: dict[str, Option] = _opts(
     # mon
     Option("mon_failure_min_reporters", int, 1,
            "distinct reporters before an osd is marked down"),
+    Option("mon_cluster_log_max", int, 1000,
+           "cluster-log ring entries kept at the mon (ceph log last)"),
     Option("mon_lease_interval", float, 1.0,
            "multi-mon lease/heartbeat period (s)"),
     Option("mon_election_timeout", float, 2.0,

@@ -150,6 +150,17 @@ class Monitor(Dispatcher):
         self._electing = False
         self._election_task: asyncio.Task | None = None
         self._commit_lock = asyncio.Lock()
+        # cluster log (reference:src/mon/LogMonitor.cc + LogClient):
+        # severity-tagged events from every daemon, bounded ring,
+        # surfaced by `ceph log last`.  The reference paxos-commits log
+        # summaries; here the ring is mon-local (mirroring the memory
+        # log's crash semantics) with a best-effort append to the store
+        # path for post-mortem reads
+        from collections import deque
+
+        self._cluster_log: deque = deque(
+            maxlen=int(self.config.mon_cluster_log_max)
+        )
         # (svc, name) -> last beacon; svc in ("mgr", "mds")
         self._svc_beacons: dict[tuple[str, str], float] = {}
         self._svc_fail_pending = {"mgr": False, "mds": False}
@@ -306,6 +317,8 @@ class Monitor(Dispatcher):
             _bg(self._handle_boot(conn, msg))
         elif isinstance(msg, messages.MOSDFailure):
             _bg(self._handle_failure(msg))
+        elif isinstance(msg, messages.MLog):
+            self._handle_clog(msg)
         elif isinstance(msg, messages.MMonGetMap):
             self._subs.add(conn)
             if msg.have is None:
@@ -853,7 +866,59 @@ class Monitor(Dispatcher):
                 self.osdmap.mark_in(osd)
             self._failure_reports.pop(osd, None)
             logger.info("%s: osd.%d booted at %s", self.name, osd, msg.addr)
+            self.clog_append(self.name, "info",
+                             f"osd.{osd} boot ({msg.addr})")
             await self._publish()
+
+    def _handle_clog(self, msg: messages.MLog) -> None:
+        for e in list(msg.entries or []):
+            self.clog_append(
+                str(e.get("name", "?")), str(e.get("level", "info")),
+                str(e.get("msg", "")), stamp=e.get("stamp"),
+            )
+
+    def clog_append(self, name: str, level: str, text: str,
+                    stamp: float | None = None) -> None:
+        """Append one cluster-log entry (LogMonitor ingest); the mon
+        itself logs map-level events (osd down/boot) through this."""
+        entry = {
+            "stamp": float(stamp) if stamp is not None else time.time(),
+            "name": name,
+            "level": level if level in ("error", "warn", "info") else "info",
+            "msg": text,
+        }
+        self._cluster_log.append(entry)
+        if self.store_path:
+            try:
+                import json as _json
+                import os as _os
+
+                with open(_os.path.join(
+                        self.store_path, "cluster.log"), "a") as f:
+                    f.write(_json.dumps(entry) + "\n")
+            except OSError:
+                pass  # observability must never take down the mon
+
+    def _cmd_log_last(self, cmd: dict) -> tuple[int, str, Any]:
+        """``ceph log last [n] [level]`` (reference:src/mon/
+        LogMonitor.cc summary dump)."""
+        n = int(cmd.get("num", cmd.get("n", 20)))
+        level = cmd.get("level")
+        entries = list(self._cluster_log)
+        if level:
+            order = {"error": 2, "warn": 1, "info": 0}
+            if level not in order:
+                return -EINVAL, f"bad level {level!r}", None
+            entries = [
+                e for e in entries
+                if order[e["level"]] >= order[level]
+            ]
+        tail = entries[-n:] if n > 0 else []
+        lines = "\n".join(
+            f"{e['stamp']:.3f} {e['name']} [{e['level'][:3].upper()}] "
+            f"{e['msg']}" for e in tail
+        )
+        return 0, lines, {"entries": tail}
 
     async def _handle_failure(self, msg: messages.MOSDFailure) -> None:
         target = msg.target_osd
@@ -872,6 +937,11 @@ class Monitor(Dispatcher):
                 logger.info(
                     "%s: osd.%d marked down (%d reporters)",
                     self.name, target, len(reporters),
+                )
+                self.clog_append(
+                    self.name, "warn",
+                    f"osd.{target} failed ({len(reporters)} reporters "
+                    f"from different hosts)",
                 )
                 self.osdmap.mark_down(target)
                 self._failure_reports.pop(target, None)
@@ -1064,6 +1134,7 @@ class Monitor(Dispatcher):
                 "mds fail": lambda c: self._cmd_svc_fail("mds", c),
                 "fs set max_mds": self._cmd_fs_set_max_mds,
                 "mds prune-standbys": lambda c: self._cmd_svc_prune("mds", c),
+                "log last": self._cmd_log_last,
                 "osd down": self._cmd_osd_down,
                 "osd out": self._cmd_osd_out,
                 "osd in": self._cmd_osd_in,

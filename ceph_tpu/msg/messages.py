@@ -99,6 +99,24 @@ def decode_txn(ops_in: list, blobs: list[bytes]) -> Transaction:
     return txn
 
 
+# -- cluster log -------------------------------------------------------------
+
+
+@register
+class MLog(Message):
+    """Daemon -> mon cluster-log entries (reference:src/messages/MLog.h,
+    fed by common/LogClient's clog handle): severity-tagged cluster
+    events — scrub corruption, crc mismatches, rollbacks — forwarded to
+    the monitor and surfaced by ``ceph log last``.
+
+    ``entries`` = [{"stamp": float, "name": str, "level": "error|warn|
+    info", "msg": str}].
+    """
+
+    TYPE = "log"
+    FIELDS = ("entries",)
+
+
 # -- heartbeat / liveness ----------------------------------------------------
 
 

@@ -458,6 +458,23 @@ class OSD(Dispatcher):
             await asyncio.sleep(0.2)
         raise ConnectionError(f"no mon reachable: {last}")
 
+    def clog(self, level: str, msg: str) -> None:
+        """Best-effort cluster-log send (reference:common/LogClient —
+        ECBackend.cc:956 clog_error and the scrub repair flow report
+        corruption this way): fire-and-forget to the mon; a daemon that
+        cannot reach its mon must never block or crash on
+        observability."""
+        conn = self._mon_conn
+        if conn is None:
+            return
+        try:
+            conn.send(messages.MLog(entries=[{
+                "stamp": time.time(), "name": self.name,
+                "level": level, "msg": msg,
+            }]))
+        except Exception:
+            pass
+
     def _on_mon_reset(self) -> None:
         """Our mon died: fail over to another one (reference MonClient
         hunting)."""

@@ -816,6 +816,11 @@ class RecoveryManager:
                 "%s: rolling back divergent %s v%s on osd.%d shard %d",
                 osd.name, e.oid, e.version, member, store_shard,
             )
+            osd.clog(
+                "warn",
+                f"pg {pg} rolling back divergent {e.oid} v{e.version} "
+                f"on osd.{member} shard {store_shard}",
+            )
             if not await self._push_txn(pg, store_shard, member, txn, None):
                 self._retry_needed = True
 

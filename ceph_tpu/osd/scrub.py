@@ -124,6 +124,14 @@ class ScrubManager:
         pscrub.inc("errors", len(report["errors"]))
         pscrub.inc("repaired", report["repaired"])
         report["clean"] = not report["errors"]
+        if report["errors"]:
+            # corruption is cluster-visible news (reference: scrub
+            # errors go to clog and `ceph health`)
+            self.osd.clog(
+                "error",
+                f"pg {pg} deep-scrub: {len(report['errors'])} errors, "
+                f"{report['repaired']} repaired",
+            )
         return report
 
     def _scrub_targets(

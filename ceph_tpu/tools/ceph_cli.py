@@ -72,7 +72,17 @@ def main(argv=None) -> int:
                    default="plain")
     p.add_argument("words", nargs="+", help="command words")
     args = p.parse_args(argv)
-    prefix = " ".join(args.words)
+    words = list(args.words)
+    extra: dict = {}
+    # `ceph log last [n] [level]` (reference CLI shape)
+    if words[:2] == ["log", "last"]:
+        for w in words[2:]:
+            if w.isdigit():
+                extra["num"] = int(w)
+            else:
+                extra["level"] = w
+        words = words[:2]
+    prefix = " ".join(words)
     from ..rados.client import resolve_mon_arg
 
     mon = resolve_mon_arg(args.mon)
@@ -80,12 +90,15 @@ def main(argv=None) -> int:
     async def run() -> int:
         client = await RadosClient(mon).connect()
         try:
+            status = ""
             if prefix in MGR_COMMANDS:
                 rc, out = await _mgr_command(client, {"prefix": prefix})
                 if rc:
                     return rc
             else:
-                code, status, out = await client.command({"prefix": prefix})
+                code, status, out = await client.command(
+                    {"prefix": prefix, **extra}
+                )
                 if code < 0:
                     print(f"error: {status}", file=sys.stderr)
                     return 1
@@ -93,6 +106,11 @@ def main(argv=None) -> int:
                 print(json.dumps(out, indent=1, sort_keys=True))
             elif prefix == "status" and isinstance(out, dict):
                 _print_status(out)
+            elif prefix == "log last":
+                # the mon formats the lines (single source of the
+                # format); entries ride `out` for -f json
+                if status:
+                    print(status)
             elif isinstance(out, str):
                 print(out, end="")
             else:

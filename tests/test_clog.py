@@ -1,0 +1,79 @@
+"""Cluster log: clog from daemons -> mon LogMonitor ring -> `ceph log
+last` (reference:src/mon/LogMonitor.cc, common/LogClient,
+messages/MLog.h).  Corruption found by scrub and peering rollbacks are
+cluster-visible events, not just daemon-local log lines.
+"""
+
+import asyncio
+import os
+
+from ceph_tpu.rados import MiniCluster
+
+from .test_scrub import _corrupt_shard, _find_shard_holder
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+class TestClusterLog:
+    def test_boot_events_and_log_last(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                cl = await cluster.client()
+                await asyncio.sleep(0.1)  # boots drain to the mon
+                code, _s, out = await cl.command({"prefix": "log last"})
+                assert code == 0
+                boots = [e for e in out["entries"]
+                         if "boot" in e["msg"] and e["level"] == "info"]
+                assert len(boots) == 3, out["entries"]
+                # bounded tail
+                code, _s, out = await cl.command(
+                    {"prefix": "log last", "num": 1}
+                )
+                assert code == 0 and len(out["entries"]) == 1
+
+        run(main())
+
+    def test_scrub_corruption_reaches_the_cluster_log(self):
+        async def main():
+            async with MiniCluster(n_osds=4) as cluster:
+                cl = await cluster.client()
+                await cl.create_pool("ecpool", "erasure")
+                io = cl.io_ctx("ecpool")
+                await io.write_full("victim", os.urandom(3000))
+                osd_id, cid, oid = _find_shard_holder(
+                    cluster, None, "victim"
+                )
+                _corrupt_shard(cluster, osd_id, cid, oid)
+                reports = await cl.scrub_pool("ecpool")
+                assert any(not r["clean"] for r in reports)
+                await asyncio.sleep(0.1)  # clog send is fire-and-forget
+                code, _s, out = await cl.command(
+                    {"prefix": "log last", "level": "error"}
+                )
+                assert code == 0
+                assert any(
+                    "deep-scrub" in e["msg"] and "errors" in e["msg"]
+                    for e in out["entries"]
+                ), out["entries"]
+                # the info-level boot noise is filtered out at `error`
+                assert all(e["level"] == "error" for e in out["entries"])
+
+        run(main())
+
+    def test_osd_failure_is_logged_by_the_mon(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                cl = await cluster.client()
+                await cluster.kill_osd(2)
+                await cluster.wait_for_osd_down(2)
+                code, _s, out = await cl.command(
+                    {"prefix": "log last", "level": "warn"}
+                )
+                assert code == 0
+                assert any(
+                    "osd.2 failed" in e["msg"] for e in out["entries"]
+                ), out["entries"]
+
+        run(main())

@@ -141,6 +141,29 @@ class TestClsLog:
 
         run(main())
 
+    def test_out_of_order_timestamps_never_collide(self):
+        """Entries added with a timestamp OLDER than max_time (clock
+        skew between writers) must not overwrite each other: the key
+        counter is a header-resident global sequence, not derived from
+        max_marker (review r5 finding, reproduced as data loss)."""
+
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                io = await _io(cluster)
+                await io.exec("obj", "log", "add", {"entries": [
+                    {"ts": 100.0, "section": "s", "name": "late",
+                     "data": ""}]})
+                for n in ("early1", "early2"):
+                    await io.exec("obj", "log", "add", {"entries": [
+                        {"ts": 50.0, "section": "s", "name": n,
+                         "data": ""}]})
+                out = await io.exec("obj", "log", "list", {})
+                assert [e["name"] for e in out["entries"]] == [
+                    "early1", "early2", "late"
+                ]
+
+        run(main())
+
     def test_same_timestamp_entries_stay_distinct_and_ordered(self):
         async def main():
             async with MiniCluster(n_osds=3) as cluster:
