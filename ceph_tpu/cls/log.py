@@ -42,8 +42,10 @@ cls = register_class("log")
 
 
 def _ts_key(ts: float, counter: int) -> str:
-    # fixed-width: 17.6f covers dates far past 2100 with µs resolution;
-    # 12-digit seq keeps lexicographic == numeric to 10^12 entries
+    # ON-DISK FORMAT, frozen: fixed-width 17.6f covers dates far past
+    # 2100 with µs resolution; the 12-digit seq keeps lexicographic ==
+    # numeric to 10^12 entries.  Widths must never change again — keys
+    # of different widths interleave wrongly under the same timestamp
     return f"{PREFIX}{ts:017.6f}_{counter:012d}"
 
 

@@ -9,11 +9,62 @@ from typing import Any
 from .daemon import MgrDaemon, MgrModule
 
 
+def _health_checks(m, mgr, *, up: int, inn: int, exists: int) -> list[dict]:
+    """Structured health checks (the reference's health system: mon/
+    PGMonitor summaries at this version, reported with the later
+    stable check codes — OSD_DOWN, PG_DEGRADED, PG_AVAILABILITY,
+    OSD_SCRUB_ERRORS).  Each check: {code, severity, summary}."""
+    from ..osd.osdmap import CRUSH_ITEM_NONE
+
+    checks: list[dict] = []
+    down = exists - up
+    if down > 0:
+        checks.append({
+            "code": "OSD_DOWN", "severity": "HEALTH_WARN",
+            "summary": f"{down} osds down",
+        })
+    degraded = 0
+    unavailable = 0
+    for pid, pool in m.pools.items():
+        for pg in m.pgs_of_pool(pid):
+            _up, _upp, acting, _ap = m.pg_to_up_acting_osds(pg)
+            # replicated acting DROPS down osds; EC acting keeps NONE
+            # holes — in both cases "alive < pool.size" is degraded
+            alive = sum(1 for o in acting if o != CRUSH_ITEM_NONE)
+            if alive < pool.size:
+                degraded += 1
+            if alive < pool.min_size:
+                unavailable += 1
+    if unavailable:
+        checks.append({
+            "code": "PG_AVAILABILITY", "severity": "HEALTH_ERR",
+            "summary": f"reduced data availability: {unavailable} pgs "
+                       "below min_size",
+        })
+    if degraded:
+        checks.append({
+            "code": "PG_DEGRADED", "severity": "HEALTH_WARN",
+            "summary": f"degraded redundancy: {degraded} pgs degraded",
+        })
+    outstanding = 0
+    for st in mgr.live_osd_stats().values():
+        scrub = (st.get("perf") or {}).get("scrub") or {}
+        # the CURRENT-inconsistency gauge, not lifetime counters: the
+        # cumulative errors counter re-counts a bad shard every pass
+        outstanding += int(scrub.get("unrepaired", 0) or 0)
+    if outstanding:
+        checks.append({
+            "code": "OSD_SCRUB_ERRORS", "severity": "HEALTH_ERR",
+            "summary": f"{outstanding} unrepaired scrub errors",
+        })
+    return checks
+
+
 class StatusModule(MgrModule):
     """`ceph -s` body: cluster health + services + data + io summary."""
 
     NAME = "status"
-    COMMANDS = {"status": "status"}
+    COMMANDS = {"status": "status", "health": "status"}
 
     def status(self, mgr: MgrDaemon, cmd: dict) -> tuple[int, str, Any]:
         m = mgr.osdmap
@@ -25,7 +76,12 @@ class StatusModule(MgrModule):
         pgs = mgr.pg_summary()
         objects = sum(p.get("objects", 0) for p in pgs.values())
         data = sum(p.get("bytes", 0) for p in pgs.values())
-        health = "HEALTH_OK" if up == inn == exists else "HEALTH_WARN"
+        checks = _health_checks(m, mgr, up=up, inn=inn, exists=exists)
+        health = max(
+            (c["severity"] for c in checks),
+            key=("HEALTH_OK", "HEALTH_WARN", "HEALTH_ERR").index,
+            default="HEALTH_OK",
+        )
         io = {
             "op_per_sec": sum(
                 r.get("op_per_sec", 0) for r in mgr.io_rates.values()
@@ -39,6 +95,7 @@ class StatusModule(MgrModule):
         }
         return 0, "", {
             "health": health,
+            "checks": checks,
             "monmap_epoch": m.epoch,
             "osdmap": {"epoch": m.epoch, "num_osds": exists,
                        "num_up_osds": up, "num_in_osds": inn},

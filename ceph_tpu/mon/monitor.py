@@ -161,6 +161,8 @@ class Monitor(Dispatcher):
         self._cluster_log: deque = deque(
             maxlen=int(self.config.mon_cluster_log_max)
         )
+        self._clog_buf: list[str] = []
+        self._clog_flush_scheduled = False
         # (svc, name) -> last beacon; svc in ("mgr", "mds")
         self._svc_beacons: dict[tuple[str, str], float] = {}
         self._svc_fail_pending = {"mgr": False, "mds": False}
@@ -318,7 +320,14 @@ class Monitor(Dispatcher):
         elif isinstance(msg, messages.MOSDFailure):
             _bg(self._handle_failure(msg))
         elif isinstance(msg, messages.MLog):
-            self._handle_clog(msg)
+            # the ring lives where leadership lives (the reference
+            # paxos-commits log entries): a peon forwards, like
+            # MOSDBoot/MOSDFailure, or `log last` at the leader would
+            # silently miss entries from OSDs homed at peons
+            if self.is_leader or self.solo:
+                self._handle_clog(msg)
+            elif self.leader_rank is not None:
+                _bg(self._send_peer(self.leader_rank, msg))
         elif isinstance(msg, messages.MMonGetMap):
             self._subs.add(conn)
             if msg.have is None:
@@ -889,15 +898,48 @@ class Monitor(Dispatcher):
         }
         self._cluster_log.append(entry)
         if self.store_path:
-            try:
-                import json as _json
-                import os as _os
+            import json as _json
 
-                with open(_os.path.join(
-                        self.store_path, "cluster.log"), "a") as f:
-                    f.write(_json.dumps(entry) + "\n")
-            except OSError:
-                pass  # observability must never take down the mon
+            # batched + off-loop: per-entry synchronous file I/O in the
+            # dispatch path would stall paxos/lease traffic under a log
+            # storm (review r5 finding)
+            self._clog_buf.append(_json.dumps(entry))
+            if not self._clog_flush_scheduled:
+                self._clog_flush_scheduled = True
+                coro = self._flush_clog()
+                try:
+                    _bg(coro)
+                except RuntimeError:  # no loop (tests poking directly)
+                    coro.close()
+                    self._clog_flush_scheduled = False
+                    self._write_clog("\n".join(self._clog_buf) + "\n")
+                    self._clog_buf.clear()
+
+    async def _flush_clog(self) -> None:
+        await asyncio.sleep(0.05)  # batch window
+        self._clog_flush_scheduled = False
+        buf, self._clog_buf = self._clog_buf, []
+        if not buf:
+            return
+        data = "\n".join(buf) + "\n"
+        await asyncio.get_running_loop().run_in_executor(
+            None, self._write_clog, data
+        )
+
+    def _write_clog(self, data: str) -> None:
+        """Append to <store>/cluster.log, rotating at 4 MiB (one .old
+        generation) so the file stays bounded like the ring."""
+        import os as _os
+
+        path = _os.path.join(self.store_path, "cluster.log")
+        try:
+            if (_os.path.exists(path)
+                    and _os.path.getsize(path) > (4 << 20)):
+                _os.replace(path, path + ".old")
+            with open(path, "a") as f:
+                f.write(data)
+        except OSError:
+            pass  # observability must never take down the mon
 
     def _cmd_log_last(self, cmd: dict) -> tuple[int, str, Any]:
         """``ceph log last [n] [level]`` (reference:src/mon/

@@ -261,6 +261,10 @@ class OSD(Dispatcher):
         pscrub.add_counter("scrubs", "PG deep scrubs completed")
         pscrub.add_counter("errors", "inconsistencies found")
         pscrub.add_counter("repaired", "inconsistencies repaired")
+        pscrub.add_gauge(
+            "unrepaired",
+            "CURRENT unrepaired inconsistencies (latest pass per pg)",
+        )
         self._inflight: dict[int, dict] = {}  # OpTracker-lite
         self._mon_conn: Connection | None = None
         self._op_seq = 0  # server-side tracker key (client tids collide)

@@ -48,6 +48,11 @@ class ScrubManager:
         self.osd = osd
         self.interval = interval
         self._task: asyncio.Task | None = None
+        # pg -> unrepaired count from its LATEST pass: the health check
+        # needs the CURRENT inconsistency, not lifetime counters — the
+        # cumulative errors counter re-counts the same bad shard every
+        # pass, so errors-repaired inflates forever (review r5 finding)
+        self._unrepaired: dict[str, int] = {}
 
     # stats read through the perf counters so the manager and `perf dump`
     # can never disagree (review r2 finding)
@@ -123,6 +128,10 @@ class ScrubManager:
         pscrub.inc("scrubs")
         pscrub.inc("errors", len(report["errors"]))
         pscrub.inc("repaired", report["repaired"])
+        self._unrepaired[str(pg)] = (
+            len(report["errors"]) - report["repaired"]
+        )
+        pscrub.set("unrepaired", sum(self._unrepaired.values()))
         report["clean"] = not report["errors"]
         if report["errors"]:
             # corruption is cluster-visible news (reference: scrub

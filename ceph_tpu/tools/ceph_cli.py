@@ -23,7 +23,8 @@ import sys
 from ..msg import messages
 from ..rados.client import RadosClient, RadosError
 
-MGR_COMMANDS = {"status", "df", "pg dump", "metrics", "mgr module ls"}
+MGR_COMMANDS = {"status", "health", "df", "pg dump", "metrics",
+                "mgr module ls"}
 
 
 async def _mgr_command(client: RadosClient, cmd: dict):
@@ -41,6 +42,9 @@ async def _mgr_command(client: RadosClient, cmd: dict):
 
 def _print_status(out: dict) -> None:
     print(f"  health:  {out['health']}")
+    for c in out.get("checks", []):
+        print(f"           [{c['severity'].removeprefix('HEALTH_')}] "
+              f"{c['code']}: {c['summary']}")
     om = out["osdmap"]
     print(f"  osd:     {om['num_osds']} osds: {om['num_up_osds']} up, "
           f"{om['num_in_osds']} in (epoch {om['epoch']})")
@@ -106,6 +110,11 @@ def main(argv=None) -> int:
                 print(json.dumps(out, indent=1, sort_keys=True))
             elif prefix == "status" and isinstance(out, dict):
                 _print_status(out)
+            elif prefix == "health" and isinstance(out, dict):
+                detail = "; ".join(
+                    c["summary"] for c in out.get("checks", [])
+                )
+                print(out["health"] + (f" {detail}" if detail else ""))
             elif prefix == "log last":
                 # the mon formats the lines (single source of the
                 # format); entries ride `out` for -f json

@@ -16,6 +16,16 @@ def run(coro):
     asyncio.run(coro)
 
 
+class _FakeAuthedConn:
+    """Just enough Connection for a direct ms_dispatch delivery."""
+
+    authenticated = True
+    peer_name = "osd.9"
+
+    def send(self, msg):  # pragma: no cover - replies unused
+        pass
+
+
 class TestClusterLog:
     def test_boot_events_and_log_last(self):
         async def main():
@@ -59,6 +69,46 @@ class TestClusterLog:
                 ), out["entries"]
                 # the info-level boot noise is filtered out at `error`
                 assert all(e["level"] == "error" for e in out["entries"])
+
+        run(main())
+
+    def test_peon_forwards_clog_to_the_leader(self):
+        """An entry received by a peon must reach the leader's ring —
+        `ceph log last` is always served by the leader after redirect,
+        and OSDs home at whichever mon answered first (review r5
+        finding)."""
+
+        async def main():
+            from ceph_tpu.msg import messages
+
+            async with MiniCluster(n_osds=3, n_mons=3) as cluster:
+                cl = await cluster.client()
+                leader = next(
+                    m for m in cluster.mons.values() if m.is_leader
+                )
+                peon = next(
+                    m for m in cluster.mons.values() if not m.is_leader
+                )
+                # deliver straight to the peon's dispatch, as an OSD
+                # homed there would
+                await peon.ms_dispatch(
+                    _FakeAuthedConn(), messages.MLog(entries=[{
+                        "stamp": 1.0, "name": "osd.9",
+                        "level": "error", "msg": "synthetic corruption",
+                    }]),
+                )
+                async with asyncio.timeout(5):
+                    while not any(
+                        "synthetic corruption" in e["msg"]
+                        for e in leader._cluster_log
+                    ):
+                        await asyncio.sleep(0.02)
+                code, _s, out = await cl.command(
+                    {"prefix": "log last", "level": "error"}
+                )
+                assert code == 0
+                assert any("synthetic corruption" in e["msg"]
+                           for e in out["entries"])
 
         run(main())
 

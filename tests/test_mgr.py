@@ -130,6 +130,95 @@ class TestMgrStats:
 
         run(main())
 
+    def test_health_checks_follow_osd_failures(self):
+        """Structured health (reference health system): OSD_DOWN +
+        PG_DEGRADED at one failure (WARN), PG_AVAILABILITY (ERR) once
+        a pool drops below min_size."""
+
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                await cluster.start_mgr()
+                await cluster.wait_for_active_mgr()
+                cl = await cluster.client()
+                await cl.create_pool("data", "replicated", size=3)
+                io = cl.io_ctx("data")
+                await io.write_full("obj", b"x" * 100)
+
+                st = await _mgr_cmd(cluster, cl, "health")
+                assert st["health"] == "HEALTH_OK" and not st["checks"]
+
+                await cluster.kill_osd(2)
+                await cluster.wait_for_osd_down(2)
+                async with asyncio.timeout(10):
+                    while True:
+                        st = await _mgr_cmd(cluster, cl, "health")
+                        if st["health"] == "HEALTH_WARN":
+                            break
+                        await asyncio.sleep(0.05)
+                codes = {c["code"]: c for c in st["checks"]}
+                assert "OSD_DOWN" in codes
+                assert "1 osds down" in codes["OSD_DOWN"]["summary"]
+                assert "PG_DEGRADED" in codes
+                assert codes["PG_DEGRADED"]["severity"] == "HEALTH_WARN"
+
+                await cluster.kill_osd(1)
+                await cluster.wait_for_osd_down(1)
+                async with asyncio.timeout(10):
+                    while True:
+                        st = await _mgr_cmd(cluster, cl, "health")
+                        if st["health"] == "HEALTH_ERR":
+                            break
+                        await asyncio.sleep(0.05)
+                codes = {c["code"] for c in st["checks"]}
+                assert "PG_AVAILABILITY" in codes  # below min_size=2
+
+        run(main())
+
+    def test_scrub_errors_raise_and_clear_health(self):
+        """OSD_SCRUB_ERRORS reflects CURRENT inconsistency: repair-off
+        scrub raises HEALTH_ERR without double-counting across passes,
+        and a repair pass clears it (review r5 finding: the cumulative
+        errors-repaired arithmetic inflated forever)."""
+        from .test_scrub import _corrupt_shard, _find_shard_holder
+
+        async def main():
+            async with MiniCluster(n_osds=4) as cluster:
+                await cluster.start_mgr()
+                await cluster.wait_for_active_mgr()
+                cl = await cluster.client()
+                await cl.create_pool("ecpool", "erasure")
+                io = cl.io_ctx("ecpool")
+                await io.write_full("victim", b"\x5a" * 3000)
+                osd_id, cid, oid = _find_shard_holder(
+                    cluster, None, "victim"
+                )
+                _corrupt_shard(cluster, osd_id, cid, oid)
+
+                for _ in range(2):  # two passes: count must not inflate
+                    await cl.scrub_pool("ecpool", repair=False)
+                async with asyncio.timeout(10):
+                    while True:
+                        st = await _mgr_cmd(cluster, cl, "health")
+                        codes = {c["code"]: c for c in st["checks"]}
+                        if "OSD_SCRUB_ERRORS" in codes:
+                            break
+                        await asyncio.sleep(0.05)
+                assert st["health"] == "HEALTH_ERR"
+                assert "1 unrepaired" in \
+                    codes["OSD_SCRUB_ERRORS"]["summary"]
+
+                await cl.scrub_pool("ecpool", repair=True)
+                async with asyncio.timeout(10):
+                    while True:
+                        st = await _mgr_cmd(cluster, cl, "health")
+                        if not any(c["code"] == "OSD_SCRUB_ERRORS"
+                                   for c in st["checks"]):
+                            break
+                        await asyncio.sleep(0.05)
+                assert st["health"] == "HEALTH_OK"
+
+        run(main())
+
     def test_io_rates_appear(self):
         async def main():
             async with MiniCluster(n_osds=3) as cluster:
