@@ -51,6 +51,7 @@ from . import (
 HEADER_KEY = "rgw_index_header"
 OBJ_NS = "o:"   # object entries: every user key is stored as OBJ_NS+key
 META_NS = "m:"  # multipart bookkeeping, written via plain omap
+CANNED_ACLS = ("private", "public-read")  # rgw_acl.cc canned subset
 
 cls = register_class("rgw")
 
@@ -138,6 +139,44 @@ def list_(ctx: MethodContext, input: dict) -> dict:
         "truncated": truncated,
         "next_marker": names[-1][len(OBJ_NS):] if names else marker,
     }
+
+
+@cls.method("set_acl", CLS_METHOD_RD | CLS_METHOD_WR)
+def set_acl(ctx: MethodContext, input: dict) -> dict:
+    """Atomic acl update on one index entry: the RMW runs under the PG
+    lock, so a concurrent put_object cannot be clobbered by a stale
+    entry written back (review r5 finding — the client-side head+put
+    version lost size/etag updates)."""
+    key = input.get("key")
+    acl = input.get("acl")
+    if not key or acl not in CANNED_ACLS:
+        raise ClsError(EINVAL, "rgw.set_acl: need key + canned acl")
+    okey = OBJ_NS + key
+    raw = ctx.omap_get_keys([okey]).get(okey)
+    if raw is None:
+        raise ClsError(ENOENT, f"no entry {key!r}")
+    entry = json.loads(raw)
+    entry["acl"] = acl
+    ctx.omap_set({okey: json.dumps(entry).encode()})
+    return {"entry": entry}
+
+
+@cls.method("bucket_set_acl", CLS_METHOD_RD | CLS_METHOD_WR)
+def bucket_set_acl(ctx: MethodContext, input: dict) -> dict:
+    """Atomic acl update on a bucket record (runs on the meta pool's
+    buckets object): cannot resurrect a concurrently deleted bucket or
+    clobber a concurrent create."""
+    bucket = input.get("bucket")
+    acl = input.get("acl")
+    if not bucket or acl not in CANNED_ACLS:
+        raise ClsError(EINVAL, "rgw.bucket_set_acl: need bucket + acl")
+    raw = ctx.omap_get_keys([bucket]).get(bucket)
+    if raw is None:
+        raise ClsError(ENOENT, f"no bucket {bucket!r}")
+    rec = json.loads(raw)
+    rec["acl"] = acl
+    ctx.omap_set({bucket: json.dumps(rec).encode()})
+    return {"bucket": rec}
 
 
 @cls.method("stats", CLS_METHOD_RD)

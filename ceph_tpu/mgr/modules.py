@@ -9,6 +9,27 @@ from typing import Any
 from .daemon import MgrDaemon, MgrModule
 
 
+_SEVERITIES = ("HEALTH_OK", "HEALTH_WARN", "HEALTH_ERR")
+
+
+def _worst_severity(checks: list[dict]) -> str:
+    return max((c["severity"] for c in checks),
+               key=_SEVERITIES.index, default="HEALTH_OK")
+
+
+def _cluster_health(mgr) -> tuple[str, list[dict]]:
+    """(overall, checks) for the current map + reports; the single
+    source for `ceph status`, `ceph health` and the prometheus gauge."""
+    m = mgr.osdmap
+    checks = _health_checks(
+        m, mgr,
+        up=sum(1 for o in range(m.max_osd) if m.is_up(o)),
+        inn=sum(1 for o in range(m.max_osd) if m.is_in(o)),
+        exists=sum(1 for o in range(m.max_osd) if m.exists(o)),
+    )
+    return _worst_severity(checks), checks
+
+
 def _health_checks(m, mgr, *, up: int, inn: int, exists: int) -> list[dict]:
     """Structured health checks (the reference's health system: mon/
     PGMonitor summaries at this version, reported with the later
@@ -77,11 +98,7 @@ class StatusModule(MgrModule):
         objects = sum(p.get("objects", 0) for p in pgs.values())
         data = sum(p.get("bytes", 0) for p in pgs.values())
         checks = _health_checks(m, mgr, up=up, inn=inn, exists=exists)
-        health = max(
-            (c["severity"] for c in checks),
-            key=("HEALTH_OK", "HEALTH_WARN", "HEALTH_ERR").index,
-            default="HEALTH_OK",
-        )
+        health = _worst_severity(checks)
         io = {
             "op_per_sec": sum(
                 r.get("op_per_sec", 0) for r in mgr.io_rates.values()
@@ -179,6 +196,13 @@ class PrometheusModule(MgrModule):
 
     def metrics(self, mgr: MgrDaemon, cmd: dict) -> tuple[int, str, Any]:
         lines: list[str] = []
+        # ceph_health_status: 0 OK / 1 WARN / 2 ERR (the reference
+        # prometheus module's health gauge)
+        if mgr.osdmap is not None:
+            worst, _checks = _cluster_health(mgr)
+            lines.append(
+                f"ceph_health_status {_SEVERITIES.index(worst)}"
+            )
         for osd, st in sorted(mgr.live_osd_stats().items()):
             for subsys, counters in sorted(st["perf"].items()):
                 for key, val in sorted(counters.items()):

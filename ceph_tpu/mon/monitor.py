@@ -163,6 +163,11 @@ class Monitor(Dispatcher):
         )
         self._clog_buf: list[str] = []
         self._clog_flush_scheduled = False
+        # serializes the file op itself: two overlapping flushes on the
+        # multi-threaded default executor could rotate concurrently
+        import threading
+
+        self._clog_file_lock = threading.Lock()
         # (svc, name) -> last beacon; svc in ("mgr", "mds")
         self._svc_beacons: dict[tuple[str, str], float] = {}
         self._svc_fail_pending = {"mgr": False, "mds": False}
@@ -257,6 +262,12 @@ class Monitor(Dispatcher):
         self._lease_task = self._watch_task = self._election_task = None
         self._tick_task = None
         await self.messenger.shutdown()
+        if self._clog_buf and self.store_path:
+            # a clean shutdown must not drop the batch window's worth of
+            # entries — the crash-adjacent ones matter most post-mortem
+            # (review r5 finding)
+            buf, self._clog_buf = self._clog_buf, []
+            self._write_clog("\n".join(buf) + "\n")
         if self._db_store is not None:
             self._db_store.close()
             self._db_store = None
@@ -928,16 +939,18 @@ class Monitor(Dispatcher):
 
     def _write_clog(self, data: str) -> None:
         """Append to <store>/cluster.log, rotating at 4 MiB (one .old
-        generation) so the file stays bounded like the ring."""
+        generation) so the file stays bounded like the ring.  The lock
+        makes rotate+append atomic across executor threads."""
         import os as _os
 
         path = _os.path.join(self.store_path, "cluster.log")
         try:
-            if (_os.path.exists(path)
-                    and _os.path.getsize(path) > (4 << 20)):
-                _os.replace(path, path + ".old")
-            with open(path, "a") as f:
-                f.write(data)
+            with self._clog_file_lock:
+                if (_os.path.exists(path)
+                        and _os.path.getsize(path) > (4 << 20)):
+                    _os.replace(path, path + ".old")
+                with open(path, "a") as f:
+                    f.write(data)
         except OSError:
             pass  # observability must never take down the mon
 

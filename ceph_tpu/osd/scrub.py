@@ -102,12 +102,25 @@ class ScrubManager:
         reports = []
         if osd.osdmap is None:
             return reports
+        led: set[str] = set()
         for pool in list(osd.osdmap.pools.values()):
             for pg in osd.osdmap.pgs_of_pool(pool.id):
                 _up, _upp, acting, primary = osd.osdmap.pg_to_up_acting_osds(pg)
                 if primary != osd.osd_id:
                     continue
+                led.add(str(pg))
                 reports.append(await self.scrub_pg(pg, pool, acting, repair))
+        # prune gauge state for PGs this OSD no longer leads (primary
+        # moved, pool deleted): a stale entry would pin OSD_SCRUB_ERRORS
+        # at HEALTH_ERR forever after the NEW primary repairs the pg
+        # (review r5 finding)
+        stale = set(self._unrepaired) - led
+        if stale:
+            for k in stale:
+                del self._unrepaired[k]
+            self.osd.perf.get("scrub").set(
+                "unrepaired", sum(self._unrepaired.values())
+            )
         return reports
 
     async def scrub_pg(

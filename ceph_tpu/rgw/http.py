@@ -115,6 +115,41 @@ def auth_header(access_key: str, secret_key: str, method: str,
     return f"AWS {access_key}:{sign_request(secret_key, method, target, headers)}"
 
 
+def _parse_range(header: str | None, size: int):
+    """``Range: bytes=a-b`` / ``bytes=a-`` / ``bytes=-n`` -> (off, len);
+    None = no/ignorable range (serve 200 full, per RFC 7233 for
+    unsupported units or multi-range), "bad" = unsatisfiable (416)."""
+    if not header or not header.startswith("bytes="):
+        return None
+    spec = header[len("bytes="):]
+    if "," in spec:  # multi-range unsupported: serve the full object
+        return None
+    start_s, _, end_s = spec.partition("-")
+    try:
+        if not start_s:  # suffix form: last n bytes
+            n = int(end_s)
+            if n < 0:
+                return None  # 'bytes=--5': malformed spec, ignore
+            if n == 0:  # valid form, nothing satisfiable
+                return "bad"
+            n = min(n, size)
+            return (size - n, n) if size else "bad"
+        start = int(start_s)
+        if end_s:
+            end = int(end_s)
+            if end < start:
+                # RFC 7233: an EXPLICIT last-pos below first-pos is an
+                # invalid byte-range-spec — ignore, serve 200
+                return None
+        else:
+            end = size - 1  # open-ended: to the last byte
+        if start >= size:
+            return "bad"  # syntactically valid but unsatisfiable
+        return start, min(end, size - 1) - start + 1
+    except ValueError:
+        return None  # malformed: ignore the header
+
+
 class S3Server:
     def __init__(self, store: RGWStore):
         self.store = store
@@ -196,7 +231,14 @@ class S3Server:
             if swift_path == "/v1" or swift_path.startswith("/v1/"):
                 return await self._swift(method, target, headers, body)
             user = await self._auth(method, target, headers)
-            if user is None:
+            if user is None and not (
+                method in ("GET", "HEAD")
+                and not headers.get("authorization")
+            ):
+                # bad credentials always fail; a credential-less read
+                # proceeds as the ANONYMOUS principal and succeeds only
+                # on public-read resources (reference: rgw anonymous
+                # user + RGWAccessControlPolicy verification)
                 h, b = self._json({"error": "access denied"})
                 return 403, h, b
             parts = urlsplit(target)
@@ -210,7 +252,8 @@ class S3Server:
             if not bucket:
                 return await self._svc(method, user)
             if not key:
-                return await self._bucket(method, user, bucket, q)
+                return await self._bucket(method, user, bucket, q,
+                                          headers)
             return await self._object(
                 method, user, bucket, key, q, body, headers
             )
@@ -243,22 +286,47 @@ class S3Server:
             return None
         return user
 
-    async def _svc(self, method: str, user: dict):
+    async def _svc(self, method: str, user: dict | None):
+        if user is None:
+            return 403, *self._json({"error": "access denied"})
         if method != "GET":
             return 405, *self._json({"error": "bad method"})
         names = await self.store.list_buckets(user["uid"])
         return 200, *self._json({"owner": user["uid"], "buckets": names})
 
-    async def _bucket(self, method: str, user: dict, bucket: str, q: dict):
+    async def _bucket(
+        self, method: str, user: dict | None, bucket: str, q: dict,
+        headers: dict | None = None,
+    ):
+        if method == "PUT" and "acl" in q:
+            await self._check_owner(user, bucket)
+            await self.store.set_bucket_acl(bucket, q.get("acl") or "")
+            return 200, {}, b""
+        if method == "GET" and "acl" in q:
+            await self._check_owner(user, bucket)
+            info = await self.store.bucket_info(bucket)
+            return 200, *self._json({
+                "owner": info["owner"],
+                "acl": info.get("acl", "private"),
+            })
         if method == "PUT":
-            await self.store.create_bucket(bucket, user["uid"])
+            if user is None:
+                return 403, *self._json({"error": "access denied"})
+            await self.store.create_bucket(
+                bucket, user["uid"],
+                acl=(headers or {}).get("x-amz-acl", "private"),
+            )
             return 200, *self._json({"bucket": bucket})
         if method == "DELETE":
             await self._check_owner(user, bucket)
             await self.store.delete_bucket(bucket)
             return 204, {}, b""
         if method == "GET":
-            await self._check_owner(user, bucket)
+            # listing: owner, or anyone on a public-read bucket
+            info = await self.store.bucket_info(bucket)
+            if (user is None or info["owner"] != user["uid"]) and \
+                    info.get("acl", "private") != "public-read":
+                raise RGWError(-13, "access denied")
             listing = await self.store.list_objects(
                 bucket,
                 prefix=q.get("prefix", ""),
@@ -270,12 +338,18 @@ class S3Server:
         return 405, *self._json({"error": "bad method"})
 
     async def _object(
-        self, method: str, user: dict, bucket: str, key: str,
+        self, method: str, user: dict | None, bucket: str, key: str,
         q: dict, body: bytes, headers: dict,
     ):
-        await self._check_owner(user, bucket)
         store = self.store
+        if method in ("PUT", "POST", "DELETE"):
+            # writes are owner-only (the canned subset has no
+            # public-read-write), incl. the ?acl subresource
+            await self._check_owner(user, bucket)
         if method == "PUT":
+            if "acl" in q:
+                await store.set_object_acl(bucket, key, q.get("acl") or "")
+                return 200, {}, b""
             if "uploadId" in q:
                 out = await store.upload_part(
                     bucket, key, q["uploadId"],
@@ -287,6 +361,7 @@ class S3Server:
                 content_type=headers.get(
                     "content-type", "binary/octet-stream"
                 ),
+                acl=headers.get("x-amz-acl", "private"),
             )
             return 200, {"etag": entry["etag"]}, b""
         if method == "POST":
@@ -299,19 +374,60 @@ class S3Server:
                 )
                 return 200, *self._json(entry)
             return 400, *self._json({"error": "bad post"})
-        if method == "GET":
-            data, entry = await store.get_object(bucket, key)
-            return 200, {
+        if method in ("GET", "HEAD"):
+            info = await self.store.bucket_info(bucket)
+            is_owner = user is not None and info["owner"] == user["uid"]
+            try:
+                entry = await store.head_object(bucket, key)
+            except RGWError as e:
+                if e.code == -2 and not is_owner:
+                    # non-owners get 403 whether or not the key exists
+                    # (404 here is an existence oracle for private
+                    # buckets — review r5 finding; matches real S3)
+                    raise RGWError(-13, "access denied") from None
+                raise
+            await self._check_read(user, is_owner, entry)
+            if method == "GET" and "acl" in q:
+                info = await store.bucket_info(bucket)
+                return 200, *self._json({
+                    "owner": info["owner"],
+                    "acl": entry.get("acl", "private"),
+                })
+            # conditional requests (reference:rgw_op.cc RGWGetObj
+            # if_match/if_nomatch)
+            etag = entry["etag"]
+            inm = headers.get("if-none-match")
+            if inm and inm.strip('"') in (etag, "*"):
+                return 304, {"etag": etag}, b""
+            im = headers.get("if-match")
+            if im and im.strip('"') not in (etag, "*"):
+                return 412, *self._json({"error": "precondition failed"})
+            base = {
                 "content-type": entry.get("content_type",
                                           "binary/octet-stream"),
-                "etag": entry["etag"],
-            }, data
-        if method == "HEAD":
-            entry = await store.head_object(bucket, key)
-            return 200, {
-                "content-length": str(entry["size"]),
-                "etag": entry["etag"],
-            }, b""
+                "etag": etag,
+                "accept-ranges": "bytes",
+            }
+            if method == "HEAD":
+                return 200, {**base,
+                             "content-length": str(entry["size"])}, b""
+            rng = _parse_range(headers.get("range"), entry["size"])
+            if rng == "bad":
+                return 416, {
+                    "content-range": f"bytes */{entry['size']}"
+                }, b""
+            if rng is not None:
+                off, length = rng
+                data, _e = await store.get_object_range(
+                    bucket, key, off, length, entry=entry
+                )
+                return 206, {
+                    **base,
+                    "content-range": f"bytes {off}-{off + len(data) - 1}"
+                                     f"/{entry['size']}",
+                }, data
+            data, _e = await store.get_object(bucket, key)
+            return 200, base, data
         if method == "DELETE":
             if "uploadId" in q:
                 await store.abort_multipart(bucket, key, q["uploadId"])
@@ -320,9 +436,20 @@ class S3Server:
             return 204, {}, b""
         return 405, *self._json({"error": "bad method"})
 
-    async def _check_owner(self, user: dict, bucket: str) -> None:
+    async def _check_read(
+        self, user: dict | None, is_owner: bool, entry: dict
+    ) -> None:
+        """Owner, or anyone (authenticated or anonymous) when the
+        OBJECT is public-read — the canned subset of the reference's
+        RGWAccessControlPolicy::verify_permission."""
+        if entry.get("acl", "private") == "public-read":
+            return
+        if not is_owner:
+            raise RGWError(-13, "access denied")
+
+    async def _check_owner(self, user: dict | None, bucket: str) -> None:
         info = await self.store.bucket_info(bucket)
-        if info["owner"] != user["uid"]:
+        if user is None or info["owner"] != user["uid"]:
             raise RGWError(-13, "access denied")
 
     # ===================== Swift API (rgw_rest_swift analog) ================

@@ -9,7 +9,7 @@ import json
 import secrets
 import time
 
-from ..cls.rgw_index import META_NS
+from ..cls.rgw_index import CANNED_ACLS, META_NS
 from ..rados.client import ENOENT, IoCtx, RadosClient, RadosError
 from ..rados.striper import StripedObject
 
@@ -27,6 +27,11 @@ ENOTEMPTY = 39
 
 class RGWError(RadosError):
     pass
+
+
+def _check_acl(acl: str) -> None:
+    if acl not in CANNED_ACLS:
+        raise RGWError(-EINVAL, f"unsupported canned acl {acl!r}")
 
 
 def _now() -> float:
@@ -219,9 +224,12 @@ class RGWStore:
                 return
             marker = page["next_marker"]
 
-    async def create_bucket(self, bucket: str, owner: str) -> None:
+    async def create_bucket(
+        self, bucket: str, owner: str, acl: str = "private"
+    ) -> None:
         if not bucket or "/" in bucket:
             raise RGWError(-EINVAL, f"bad bucket name {bucket!r}")
+        _check_acl(acl)
         await self.get_user(owner)
         buckets = await self._omap(self.meta, BUCKETS_OBJ)
         if bucket in buckets:
@@ -231,10 +239,38 @@ class RGWStore:
             return  # idempotent re-create by the owner, like S3
         await self.meta.omap_set(BUCKETS_OBJ, {
             bucket: json.dumps(
-                {"owner": owner, "created": _now()}
+                {"owner": owner, "created": _now(), "acl": acl}
             ).encode()
         })
         await self.index.exec(self._index_obj(bucket), "rgw", "init", {})
+
+    async def set_bucket_acl(self, bucket: str, acl: str) -> None:
+        """Canned-ACL subset of the reference's RGWAccessControlPolicy
+        (reference:src/rgw/rgw_acl.cc): private | public-read.  The
+        update is an in-OSD class op, atomic under the PG lock."""
+        _check_acl(acl)
+        try:
+            await self.meta.exec(
+                BUCKETS_OBJ, "rgw", "bucket_set_acl",
+                {"bucket": bucket, "acl": acl},
+            )
+        except RadosError as e:
+            if e.code == -ENOENT:
+                raise RGWError(-ENOENT, f"no bucket {bucket!r}") from None
+            raise
+
+    async def set_object_acl(self, bucket: str, key: str, acl: str) -> None:
+        _check_acl(acl)
+        try:
+            await self.index.exec(
+                self._index_obj(bucket), "rgw", "set_acl",
+                {"key": key, "acl": acl},
+            )
+        except RadosError as e:
+            if e.code == -ENOENT:
+                raise RGWError(-ENOENT, f"no object {bucket}/{key}") \
+                    from None
+            raise
 
     async def bucket_info(self, bucket: str) -> dict:
         buckets = await self._omap(self.meta, BUCKETS_OBJ)
@@ -271,10 +307,12 @@ class RGWStore:
     async def put_object(
         self, bucket: str, key: str, data: bytes,
         content_type: str = "binary/octet-stream",
+        acl: str = "private",
     ) -> dict:
         await self.bucket_info(bucket)
         if not key:
             raise RGWError(-EINVAL, "empty object key")
+        _check_acl(acl)
         sobj = self._data_obj(bucket, key)
         old = await self._index_entry(bucket, key)
         if old is not None:
@@ -285,6 +323,7 @@ class RGWStore:
             "etag": hashlib.md5(data).hexdigest(),
             "mtime": _now(),
             "content_type": content_type,
+            "acl": acl,
         }
         await self._index_put(bucket, key, entry)
         await self._log_change("put", bucket, key)
@@ -293,6 +332,23 @@ class RGWStore:
     async def get_object(self, bucket: str, key: str) -> tuple[bytes, dict]:
         entry = await self.head_object(bucket, key)
         data = await self._data_obj(bucket, key).read(0, entry["size"])
+        return data, entry
+
+    async def get_object_range(
+        self, bucket: str, key: str, off: int, length: int,
+        entry: dict | None = None,
+    ) -> tuple[bytes, dict]:
+        """Ranged read straight from the striper — only the covered
+        stripes travel (reference:rgw_op.cc RGWGetObj range support).
+        Callers that already hold the index ``entry`` pass it to avoid
+        a second omap lookup (and a TOCTOU against an overwrite)."""
+        if entry is None:
+            entry = await self.head_object(bucket, key)
+        size = entry["size"]
+        if off < 0 or off >= size or length <= 0:
+            raise RGWError(-EINVAL, "range out of bounds")
+        length = min(length, size - off)
+        data = await self._data_obj(bucket, key).read(off, length)
         return data, entry
 
     async def head_object(self, bucket: str, key: str) -> dict:

@@ -122,6 +122,7 @@ class TestMgrStats:
                 assert sum(p["objects"] for p in dump["pgs"]) == 12
 
                 metrics = await _mgr_cmd(cluster, cl, "metrics")
+                assert "ceph_health_status 0" in metrics
                 assert 'ceph_osd_op{daemon="osd.' in metrics
                 assert "ceph_pg_objects{" in metrics
 

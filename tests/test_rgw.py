@@ -14,6 +14,44 @@ from ceph_tpu.rados import MiniCluster
 from ceph_tpu.rgw import RGWError, RGWStore
 
 
+async def _http(addr, method, path, body=b"", headers=None, creds=None):
+    """One signed (or anonymous) HTTP round trip against the gateway."""
+    from ceph_tpu.rgw.http import auth_header
+
+    host, port = addr.rsplit(":", 1)
+    reader, writer = await asyncio.open_connection(host, int(port))
+    try:
+        h = {"content-length": str(len(body)), **(headers or {})}
+        if creds is not None:
+            h.setdefault("date", "Thu, 01 Jan 2026 00:00:00 GMT")
+            h["authorization"] = auth_header(
+                creds["access_key"], creds["secret_key"],
+                method, path, h,
+            )
+        head = f"{method} {path} HTTP/1.1\r\n" + "".join(
+            f"{k}: {v}\r\n" for k, v in h.items()
+        ) + "\r\n"
+        writer.write(head.encode() + body)
+        await writer.drain()
+        status_line = (await reader.readline()).decode()
+        status = int(status_line.split()[1])
+        resp_headers = {}
+        while True:
+            line = await reader.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = line.decode().partition(":")
+            resp_headers[k.strip().lower()] = v.strip()
+        n = int(resp_headers.get("content-length", 0))
+        payload = (
+            await reader.readexactly(n)
+            if n and method != "HEAD" else b""
+        )
+        return status, resp_headers, payload
+    finally:
+        writer.close()
+
+
 def run(coro):
     asyncio.run(coro)
 
@@ -275,41 +313,7 @@ class TestHTTPGateway:
         """Real HTTP against the S3Server: auth, bucket CRUD, object
         round-trip, listing, multipart."""
 
-        from ceph_tpu.rgw.http import auth_header
-
-        async def http(addr, method, path, body=b"", headers=None, creds=None):
-            host, port = addr.rsplit(":", 1)
-            reader, writer = await asyncio.open_connection(host, int(port))
-            try:
-                h = {"content-length": str(len(body)), **(headers or {})}
-                if creds is not None:
-                    h.setdefault("date", "Thu, 01 Jan 2026 00:00:00 GMT")
-                    h["authorization"] = auth_header(
-                        creds["access_key"], creds["secret_key"],
-                        method, path, h,
-                    )
-                head = f"{method} {path} HTTP/1.1\r\n" + "".join(
-                    f"{k}: {v}\r\n" for k, v in h.items()
-                ) + "\r\n"
-                writer.write(head.encode() + body)
-                await writer.drain()
-                status_line = (await reader.readline()).decode()
-                status = int(status_line.split()[1])
-                resp_headers = {}
-                while True:
-                    line = await reader.readline()
-                    if line in (b"\r\n", b"\n", b""):
-                        break
-                    k, _, v = line.decode().partition(":")
-                    resp_headers[k.strip().lower()] = v.strip()
-                n = int(resp_headers.get("content-length", 0))
-                payload = (
-                    await reader.readexactly(n)
-                    if n and method != "HEAD" else b""
-                )
-                return status, resp_headers, payload
-            finally:
-                writer.close()
+        http = _http
 
         async def main():
             async with MiniCluster(n_osds=3) as cluster:
@@ -395,6 +399,205 @@ class TestHTTPGateway:
                     st, _, _ = await http(addr, "GET", "/photos",
                                           creds=stolen)
                     assert st == 403
+                finally:
+                    await srv.stop()
+
+        run(main())
+
+
+class TestACLRangeConditional:
+    """Canned ACLs, ranged reads, conditional GETs (reference:
+    src/rgw/rgw_acl.cc canned subset; rgw_op.cc RGWGetObj range +
+    if_match)."""
+
+    def test_canned_acls_and_anonymous_reads(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                s = await _store(cluster)
+                user = await s.create_user("alice")
+                from ceph_tpu.rgw.http import S3Server
+
+                srv = S3Server(s)
+                addr = await srv.start()
+                try:
+                    await _http(addr, "PUT", "/pub", creds=user)
+                    body = b"public bytes"
+                    st, _, _ = await _http(
+                        addr, "PUT", "/pub/open.txt", body=body,
+                        headers={"x-amz-acl": "public-read"}, creds=user,
+                    )
+                    assert st == 200
+                    st, _, _ = await _http(
+                        addr, "PUT", "/pub/secret.txt", body=b"s",
+                        creds=user,
+                    )
+                    assert st == 200
+                    # anonymous (no Authorization header at all)
+                    st, _, payload = await _http(
+                        addr, "GET", "/pub/open.txt"
+                    )
+                    assert st == 200 and payload == body
+                    st, _, _ = await _http(addr, "GET", "/pub/secret.txt")
+                    assert st == 403
+                    # anonymous listing denied until the BUCKET is public
+                    st, _, _ = await _http(addr, "GET", "/pub")
+                    assert st == 403
+                    st, _, _ = await _http(
+                        addr, "PUT", "/pub?acl=public-read", creds=user
+                    )
+                    assert st == 200
+                    st, _, payload = await _http(addr, "GET", "/pub")
+                    assert st == 200
+                    names = [c["key"] for c in
+                             json.loads(payload)["contents"]]
+                    assert names == ["open.txt", "secret.txt"]
+                    # acl subresource reads back; flipping object acl
+                    # closes anonymous access again
+                    st, _, payload = await _http(
+                        addr, "GET", "/pub/open.txt?acl", creds=user
+                    )
+                    assert json.loads(payload)["acl"] == "public-read"
+                    st, _, _ = await _http(
+                        addr, "PUT", "/pub/open.txt?acl=private",
+                        creds=user,
+                    )
+                    assert st == 200
+                    st, _, _ = await _http(addr, "GET", "/pub/open.txt")
+                    assert st == 403
+                    # bad canned name rejected; anonymous WRITE rejected
+                    st, _, _ = await _http(
+                        addr, "PUT", "/pub?acl=public-read-write",
+                        creds=user,
+                    )
+                    assert st == 400
+                    st, _, _ = await _http(addr, "PUT", "/pub/x",
+                                           body=b"y")
+                    assert st == 403
+                finally:
+                    await srv.stop()
+
+        run(main())
+
+    def test_range_reads(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                s = await _store(cluster)
+                user = await s.create_user("alice")
+                from ceph_tpu.rgw.http import S3Server
+
+                srv = S3Server(s)
+                addr = await srv.start()
+                try:
+                    await _http(addr, "PUT", "/b", creds=user)
+                    body = bytes(range(256)) * 64  # 16 KiB, multi-stripe
+                    await _http(addr, "PUT", "/b/o", body=body,
+                                creds=user)
+                    cases = {
+                        "bytes=0-99": body[:100],
+                        "bytes=100-199": body[100:200],
+                        "bytes=16300-": body[16300:],
+                        "bytes=-50": body[-50:],
+                        "bytes=0-999999": body,  # end clamped
+                    }
+                    for hdr, want in cases.items():
+                        st, h, payload = await _http(
+                            addr, "GET", "/b/o",
+                            headers={"range": hdr}, creds=user,
+                        )
+                        assert st == 206, hdr
+                        assert payload == want, hdr
+                        assert h["content-range"].endswith(
+                            f"/{len(body)}"
+                        ), hdr
+                    st, h, _ = await _http(
+                        addr, "GET", "/b/o",
+                        headers={"range": "bytes=999999-"}, creds=user,
+                    )
+                    assert st == 416
+                    assert h["content-range"] == f"bytes */{len(body)}"
+                    # multi-range and non-byte units: full 200 per RFC
+                    for hdr in ("bytes=0-1,5-9", "lines=0-4"):
+                        st, _, payload = await _http(
+                            addr, "GET", "/b/o",
+                            headers={"range": hdr}, creds=user,
+                        )
+                        assert st == 200 and payload == body, hdr
+                finally:
+                    await srv.stop()
+
+        run(main())
+
+    def test_conditional_requests(self):
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                s = await _store(cluster)
+                user = await s.create_user("alice")
+                from ceph_tpu.rgw.http import S3Server
+
+                srv = S3Server(s)
+                addr = await srv.start()
+                try:
+                    await _http(addr, "PUT", "/b", creds=user)
+                    body = b"versioned content"
+                    st, h, _ = await _http(addr, "PUT", "/b/o",
+                                           body=body, creds=user)
+                    etag = h["etag"]
+                    st, _, _ = await _http(
+                        addr, "GET", "/b/o",
+                        headers={"if-none-match": etag}, creds=user,
+                    )
+                    assert st == 304
+                    st, _, payload = await _http(
+                        addr, "GET", "/b/o",
+                        headers={"if-none-match": "deadbeef"}, creds=user,
+                    )
+                    assert st == 200 and payload == body
+                    st, _, _ = await _http(
+                        addr, "GET", "/b/o",
+                        headers={"if-match": etag}, creds=user,
+                    )
+                    assert st == 200
+                    st, _, _ = await _http(
+                        addr, "GET", "/b/o",
+                        headers={"if-match": "deadbeef"}, creds=user,
+                    )
+                    assert st == 412
+                finally:
+                    await srv.stop()
+
+        run(main())
+
+    def test_no_existence_oracle_for_private_buckets(self):
+        """Non-owners get 403 for present AND absent keys alike — a
+        404 on a private bucket would leak which keys exist (review r5
+        finding; matches real S3)."""
+
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                s = await _store(cluster)
+                user = await s.create_user("alice")
+                from ceph_tpu.rgw.http import S3Server
+
+                srv = S3Server(s)
+                addr = await srv.start()
+                try:
+                    await _http(addr, "PUT", "/priv", creds=user)
+                    await _http(addr, "PUT", "/priv/real", body=b"x",
+                                creds=user)
+                    for path in ("/priv/real", "/priv/ghost"):
+                        st, _, _ = await _http(addr, "GET", path)
+                        assert st == 403, path
+                    # the owner still sees the truthful 404
+                    st, _, _ = await _http(addr, "GET", "/priv/ghost",
+                                           creds=user)
+                    assert st == 404
+                    # invalid specs are ignored per RFC (200), not 416
+                    for hdr in ("bytes=5-3", "bytes=--5"):
+                        st, _, payload = await _http(
+                            addr, "GET", "/priv/real",
+                            headers={"range": hdr}, creds=user,
+                        )
+                        assert st == 200 and payload == b"x", hdr
                 finally:
                     await srv.stop()
 
