@@ -163,6 +163,7 @@ class Monitor(Dispatcher):
         )
         self._clog_buf: list[str] = []
         self._clog_flush_scheduled = False
+        self._log_subs: set[Connection] = set()  # `ceph -w` followers
         # serializes the file op itself: two overlapping flushes on the
         # multi-threaded default executor could rotate concurrently
         import threading
@@ -339,6 +340,18 @@ class Monitor(Dispatcher):
                 self._handle_clog(msg)
             elif self.leader_rank is not None:
                 _bg(self._send_peer(self.leader_rank, msg))
+        elif isinstance(msg, messages.MLogSub):
+            # follow the ring where it lives: clients pin the leader
+            # with a command round-trip before subscribing (ceph -w).
+            # Always ACK/NACK — a silent discard on a mid-election mon
+            # left the watcher blocked forever (review r5 finding)
+            ok = bool(msg.sub) and (self.is_leader or self.solo)
+            if ok:
+                self._log_subs.add(conn)
+            else:
+                self._log_subs.discard(conn)
+            if msg.sub:
+                conn.send(messages.MLogSub(sub=ok))
         elif isinstance(msg, messages.MMonGetMap):
             self._subs.add(conn)
             if msg.have is None:
@@ -404,6 +417,7 @@ class Monitor(Dispatcher):
 
     def ms_handle_reset(self, conn: Connection) -> None:
         self._subs.discard(conn)
+        self._log_subs.discard(conn)
         self._sub_epochs.pop(conn, None)
         for osd, c in list(self._boot_conns.items()):
             if c is conn:
@@ -908,6 +922,11 @@ class Monitor(Dispatcher):
             "msg": text,
         }
         self._cluster_log.append(entry)
+        for c in list(self._log_subs):  # live followers (ceph -w)
+            try:
+                c.send(messages.MLog(entries=[entry]))
+            except Exception:
+                self._log_subs.discard(c)
         if self.store_path:
             import json as _json
 
@@ -969,11 +988,9 @@ class Monitor(Dispatcher):
                 if order[e["level"]] >= order[level]
             ]
         tail = entries[-n:] if n > 0 else []
-        lines = "\n".join(
-            f"{e['stamp']:.3f} {e['name']} [{e['level'][:3].upper()}] "
-            f"{e['msg']}" for e in tail
-        )
-        return 0, lines, {"entries": tail}
+        # rendering is the CLI's job (ceph_cli._fmt_log_entry — the
+        # single source of the line format); the command returns data
+        return 0, "", {"entries": tail}
 
     async def _handle_failure(self, msg: messages.MOSDFailure) -> None:
         target = msg.target_osd

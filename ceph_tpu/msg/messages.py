@@ -117,6 +117,17 @@ class MLog(Message):
     FIELDS = ("entries",)
 
 
+@register
+class MLogSub(Message):
+    """Client -> mon: (un)subscribe this connection to cluster-log
+    pushes (`ceph -w`, reference:src/mon/LogMonitor.cc log
+    subscriptions via MMonSubscribe 'log-info').  Entries then arrive
+    as MLog messages on the same connection."""
+
+    TYPE = "log_sub"
+    FIELDS = ("sub",)
+
+
 # -- heartbeat / liveness ----------------------------------------------------
 
 

@@ -78,6 +78,8 @@ class RadosClient(Dispatcher):
         self._op_futs: dict[int, asyncio.Future] = {}
         self._fut_conns: dict[int, Connection] = {}
         self._map_waiters: list[asyncio.Future] = []
+        self._log_watchers: list[asyncio.Queue] = []  # ceph -w feeds
+        self._logsub_fut: asyncio.Future | None = None  # sub ack/nack
         self._cmd_addr: str | None = None  # current mon target for commands
         self._sub_conn: Connection | None = None  # map subscription feed
         self._shutdown = False
@@ -222,6 +224,19 @@ class RadosClient(Dispatcher):
                 fut.set_result(msg)
         elif isinstance(msg, messages.MWatchNotify):
             await self._handle_watch_notify(conn, msg)
+        elif isinstance(msg, messages.MLog):
+            for q in self._log_watchers:
+                for e in list(msg.entries or []):
+                    if q.full():  # slow consumer: drop its oldest
+                        try:
+                            q.get_nowait()
+                        except asyncio.QueueEmpty:
+                            pass
+                    q.put_nowait(e)
+        elif isinstance(msg, messages.MLogSub):
+            fut = self._logsub_fut
+            if fut is not None and not fut.done():
+                fut.set_result(bool(msg.sub))
 
     async def _handle_watch_notify(
         self, conn: Connection, msg: messages.MWatchNotify
@@ -320,6 +335,44 @@ class RadosClient(Dispatcher):
         finally:
             self._op_futs.pop(tid, None)
             self._fut_conns.pop(tid, None)
+
+    async def watch_cluster_log(
+        self, maxsize: int = 1000
+    ) -> "asyncio.Queue[dict]":
+        """Subscribe to live cluster-log entries (`ceph -w`,
+        reference:LogMonitor log subscriptions): returns a BOUNDED
+        queue the dispatcher feeds (a slow consumer loses its oldest
+        entries, never memory).  A command round trip first pins
+        _cmd_addr at the leader; the mon ACKs the sub, and an election
+        racing the pin is retried.  Pass the queue back to
+        :meth:`unwatch_cluster_log` when done.  If the leader later
+        changes, the feed goes quiet until re-subscribed (the reference
+        CLI re-buffers across mon failover the same way)."""
+        for _attempt in range(self.max_retries):
+            await self.command({"prefix": "log last", "num": 0})
+            conn = await self._mon_conn(self._cmd_addr)
+            fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            self._logsub_fut = fut
+            try:
+                conn.send(messages.MLogSub(sub=True))
+                async with asyncio.timeout(self.op_timeout):
+                    ok = await fut
+            except (TimeoutError, ConnectionError, OSError):
+                ok = False
+            finally:
+                self._logsub_fut = None
+            if ok:
+                q: asyncio.Queue = asyncio.Queue(maxsize)
+                self._log_watchers.append(q)
+                return q
+            await asyncio.sleep(0.2)  # mid-election: re-pin and retry
+        raise RadosError(-EAGAIN, "could not subscribe to cluster log")
+
+    def unwatch_cluster_log(self, q: "asyncio.Queue[dict]") -> None:
+        try:
+            self._log_watchers.remove(q)
+        except ValueError:
+            pass
 
     async def command(self, cmd: dict) -> tuple[int, str, Any]:
         """Mon command; follows leader redirects and fails over to other

@@ -50,8 +50,12 @@ _STATUS = {
 _ERRNO_HTTP = {2: 404, 17: 409, 39: 409, 13: 403, 22: 400}
 
 # Subresources that are part of the canonical resource string in AWS sig v2
-# (the subset this gateway implements).
-_SIGNED_SUBRESOURCES = ("uploads", "uploadId", "partNumber")
+# (the subset this gateway implements).  "acl" MUST be here (it is in
+# the reference's rgw_auth_s3.cc list): leaving it unsigned let a
+# captured signed PUT be replayed with ?acl=public-read appended to
+# flip an object public without a signature for that mutation
+# (review r5 security finding).
+_SIGNED_SUBRESOURCES = ("acl", "uploads", "uploadId", "partNumber")
 
 
 def string_to_sign(method: str, target: str, headers: dict) -> str:
@@ -113,6 +117,13 @@ def auth_header(access_key: str, secret_key: str, method: str,
                 target: str, headers: dict) -> str:
     """Convenience for clients: the full Authorization header value."""
     return f"AWS {access_key}:{sign_request(secret_key, method, target, headers)}"
+
+
+def _etag_set(header: str | None) -> set[str]:
+    """RFC 7232 If-(None-)Match value -> set of unquoted etags."""
+    if not header:
+        return set()
+    return {part.strip().strip('"') for part in header.split(",")}
 
 
 def _parse_range(header: str | None, size: int):
@@ -303,8 +314,7 @@ class S3Server:
             await self.store.set_bucket_acl(bucket, q.get("acl") or "")
             return 200, {}, b""
         if method == "GET" and "acl" in q:
-            await self._check_owner(user, bucket)
-            info = await self.store.bucket_info(bucket)
+            info = await self._check_owner(user, bucket)
             return 200, *self._json({
                 "owner": info["owner"],
                 "acl": info.get("acl", "private"),
@@ -366,7 +376,10 @@ class S3Server:
             return 200, {"etag": entry["etag"]}, b""
         if method == "POST":
             if "uploads" in q:
-                upload = await store.init_multipart(bucket, key)
+                upload = await store.init_multipart(
+                    bucket, key,
+                    acl=headers.get("x-amz-acl", "private"),
+                )
                 return 200, *self._json({"uploadId": upload})
             if "uploadId" in q:
                 entry = await store.complete_multipart(
@@ -388,19 +401,19 @@ class S3Server:
                 raise
             await self._check_read(user, is_owner, entry)
             if method == "GET" and "acl" in q:
-                info = await store.bucket_info(bucket)
                 return 200, *self._json({
                     "owner": info["owner"],
                     "acl": entry.get("acl", "private"),
                 })
             # conditional requests (reference:rgw_op.cc RGWGetObj
-            # if_match/if_nomatch)
+            # if_match/if_nomatch); headers may carry RFC 7232
+            # comma-separated etag lists
             etag = entry["etag"]
-            inm = headers.get("if-none-match")
-            if inm and inm.strip('"') in (etag, "*"):
+            inm = _etag_set(headers.get("if-none-match"))
+            if inm and (etag in inm or "*" in inm):
                 return 304, {"etag": etag}, b""
-            im = headers.get("if-match")
-            if im and im.strip('"') not in (etag, "*"):
+            im = _etag_set(headers.get("if-match"))
+            if im and etag not in im and "*" not in im:
                 return 412, *self._json({"error": "precondition failed"})
             base = {
                 "content-type": entry.get("content_type",
@@ -447,10 +460,13 @@ class S3Server:
         if not is_owner:
             raise RGWError(-13, "access denied")
 
-    async def _check_owner(self, user: dict | None, bucket: str) -> None:
+    async def _check_owner(self, user: dict | None, bucket: str) -> dict:
+        """Owner gate; returns the bucket info it fetched so callers
+        don't re-read BUCKETS_OBJ."""
         info = await self.store.bucket_info(bucket)
         if user is None or info["owner"] != user["uid"]:
             raise RGWError(-13, "access denied")
+        return info
 
     # ===================== Swift API (rgw_rest_swift analog) ================
 

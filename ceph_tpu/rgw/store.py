@@ -442,13 +442,16 @@ class RGWStore:
     def _part_key(self, key: str, upload: str, n: int) -> str:
         return f"{META_NS}upload.{key}.{upload}.part.{n:05d}"
 
-    async def init_multipart(self, bucket: str, key: str) -> str:
+    async def init_multipart(
+        self, bucket: str, key: str, acl: str = "private"
+    ) -> str:
         await self.bucket_info(bucket)
+        _check_acl(acl)
         upload = secrets.token_hex(8)
         await self.index.omap_set(
             self._index_obj(bucket),
             {self._upload_key(key, upload): json.dumps(
-                {"key": key, "started": _now()}
+                {"key": key, "started": _now(), "acl": acl}
             ).encode()},
         )
         return upload
@@ -510,7 +513,7 @@ class RGWStore:
         """Assemble parts in part-number order into the final object
         (reference completes by linking manifests; a copy-through is the
         same contract at this scale)."""
-        await self._upload_meta(bucket, key, upload)
+        meta = await self._upload_meta(bucket, key, upload)
         parts = await self._upload_parts(bucket, key, upload)
         if not parts:
             raise RGWError(-EINVAL, "no parts uploaded")
@@ -533,6 +536,9 @@ class RGWStore:
         entry = {
             "size": total, "etag": etag, "mtime": _now(),
             "content_type": "binary/octet-stream",
+            # the acl requested at initiate-time (review r5: multipart
+            # objects could never be created public-read)
+            "acl": meta.get("acl", "private"),
         }
         await self._index_put(bucket, key, entry)
         await self.index.omap_rmkeys(

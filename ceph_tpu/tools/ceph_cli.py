@@ -69,13 +69,56 @@ def _print_status(out: dict) -> None:
           f"{io['rd_bytes_sec']:.0f} B/s rd, {io['wr_bytes_sec']:.0f} B/s wr")
 
 
+def _fmt_log_entry(e: dict) -> str:
+    return (f"{e['stamp']:.3f} {e['name']} "
+            f"[{e['level'][:3].upper()}] {e['msg']}")
+
+
+def _watch(args) -> int:
+    """`ceph -w`: print the recent cluster log, then follow live."""
+    from ..rados.client import resolve_mon_arg
+
+    mon = resolve_mon_arg(args.mon)
+
+    async def run() -> int:
+        client = await RadosClient(mon).connect()
+        try:
+            code, _status, out = await client.command(
+                {"prefix": "log last", "num": 20}
+            )
+            if code == 0:
+                for e in (out or {}).get("entries", []):
+                    print(_fmt_log_entry(e))
+            q = await client.watch_cluster_log()
+            while True:
+                print(_fmt_log_entry(await q.get()), flush=True)
+        except (RadosError, ConnectionError, TimeoutError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        except (KeyboardInterrupt, asyncio.CancelledError):
+            return 0
+        finally:
+            await client.shutdown()
+
+    try:
+        return asyncio.run(run())
+    except KeyboardInterrupt:
+        return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ceph", description=__doc__)
     p.add_argument("-m", "--mon", required=True)
     p.add_argument("-f", "--format", choices=["plain", "json"],
                    default="plain")
-    p.add_argument("words", nargs="+", help="command words")
+    p.add_argument("-w", "--watch", action="store_true",
+                   help="follow the cluster log (like `ceph -w`)")
+    p.add_argument("words", nargs="*", help="command words")
     args = p.parse_args(argv)
+    if args.watch:
+        return _watch(args)
+    if not args.words:
+        p.error("command words required (or -w)")
     words = list(args.words)
     extra: dict = {}
     # `ceph log last [n] [level]` (reference CLI shape)
@@ -115,11 +158,9 @@ def main(argv=None) -> int:
                     c["summary"] for c in out.get("checks", [])
                 )
                 print(out["health"] + (f" {detail}" if detail else ""))
-            elif prefix == "log last":
-                # the mon formats the lines (single source of the
-                # format); entries ride `out` for -f json
-                if status:
-                    print(status)
+            elif prefix == "log last" and isinstance(out, dict):
+                for e in out.get("entries", []):
+                    print(_fmt_log_entry(e))
             elif isinstance(out, str):
                 print(out, end="")
             else:

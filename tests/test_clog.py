@@ -112,6 +112,30 @@ class TestClusterLog:
 
         run(main())
 
+    def test_watch_cluster_log_follows_live(self):
+        """`ceph -w` analog: a subscriber's queue receives entries as
+        they land at the leader — here the mon's own osd-failure event
+        and a daemon clog send."""
+
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                cl = await cluster.client()
+                q = await cl.watch_cluster_log()
+                assert q.empty()  # history comes from `log last`, not q
+                cluster.osds[1].clog("error", "live event one")
+                e = await asyncio.wait_for(q.get(), 5)
+                assert e["msg"] == "live event one"
+                assert e["name"] == "osd.1" and e["level"] == "error"
+                await cluster.kill_osd(2)
+                await cluster.wait_for_osd_down(2)
+                async with asyncio.timeout(5):
+                    while True:
+                        e = await q.get()
+                        if "osd.2 failed" in e["msg"]:
+                            break
+
+        run(main())
+
     def test_osd_failure_is_logged_by_the_mon(self):
         async def main():
             async with MiniCluster(n_osds=3) as cluster:

@@ -602,3 +602,77 @@ class TestACLRangeConditional:
                     await srv.stop()
 
         run(main())
+
+    def test_acl_subresource_is_signed(self):
+        """?acl rides the sig-v2 canonical resource: a captured signed
+        PUT replayed with ?acl=public-read appended must NOT validate
+        (review r5 security finding)."""
+
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                s = await _store(cluster)
+                user = await s.create_user("alice")
+                from ceph_tpu.rgw.http import S3Server, auth_header
+
+                srv = S3Server(s)
+                addr = await srv.start()
+                try:
+                    await _http(addr, "PUT", "/b", creds=user)
+                    await _http(addr, "PUT", "/b/o", body=b"x",
+                                creds=user)
+                    # replay: signature computed for the BARE path,
+                    # request sent with ?acl appended
+                    h = {"content-length": "0",
+                         "date": "Thu, 01 Jan 2026 00:00:00 GMT"}
+                    h["authorization"] = auth_header(
+                        user["access_key"], user["secret_key"],
+                        "PUT", "/b/o", h,
+                    )
+                    st, _, _ = await _http(
+                        addr, "PUT", "/b/o?acl=public-read", headers=h
+                    )
+                    assert st == 403
+                    st, _, _ = await _http(addr, "GET", "/b/o")
+                    assert st == 403  # still private
+                finally:
+                    await srv.stop()
+
+        run(main())
+
+    def test_multipart_objects_honor_initiate_acl(self):
+        """x-amz-acl at multipart initiate carries into the completed
+        object (review r5 finding: multipart objects could never be
+        public-read)."""
+
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                s = await _store(cluster)
+                user = await s.create_user("alice")
+                from ceph_tpu.rgw.http import S3Server
+
+                srv = S3Server(s)
+                addr = await srv.start()
+                try:
+                    await _http(addr, "PUT", "/b", creds=user)
+                    st, _, payload = await _http(
+                        addr, "POST", "/b/big?uploads",
+                        headers={"x-amz-acl": "public-read"}, creds=user,
+                    )
+                    up = json.loads(payload)["uploadId"]
+                    part = b"P" * 4096
+                    await _http(
+                        addr, "PUT",
+                        f"/b/big?uploadId={up}&partNumber=1",
+                        body=part, creds=user,
+                    )
+                    st, _, _ = await _http(
+                        addr, "POST", f"/b/big?uploadId={up}", creds=user
+                    )
+                    assert st == 200
+                    st, _, payload = await _http(addr, "GET", "/b/big")
+                    assert st == 200 and payload == part  # anonymous
+
+                finally:
+                    await srv.stop()
+
+        run(main())
