@@ -1596,6 +1596,8 @@ class Monitor(Dispatcher):
         if not self._valid_osd_id(osd):
             return -EINVAL, f"bad osd id {osd}", None
         self.osdmap.mark_down(osd)
+        self.clog_append(self.name, "warn",
+                         f"osd.{osd} marked down (operator)")
         self._mark_dirty()
         return 0, "", None
 

@@ -80,6 +80,8 @@ class RadosClient(Dispatcher):
         self._map_waiters: list[asyncio.Future] = []
         self._log_watchers: list[asyncio.Queue] = []  # ceph -w feeds
         self._logsub_fut: asyncio.Future | None = None  # sub ack/nack
+        self._logsub_lock: asyncio.Lock | None = None  # serializes subs
+        self._logsub_conn: Connection | None = None  # where we're subbed
         self._cmd_addr: str | None = None  # current mon target for commands
         self._sub_conn: Connection | None = None  # map subscription feed
         self._shutdown = False
@@ -348,24 +350,30 @@ class RadosClient(Dispatcher):
         :meth:`unwatch_cluster_log` when done.  If the leader later
         changes, the feed goes quiet until re-subscribed (the reference
         CLI re-buffers across mon failover the same way)."""
-        for _attempt in range(self.max_retries):
-            await self.command({"prefix": "log last", "num": 0})
-            conn = await self._mon_conn(self._cmd_addr)
-            fut: asyncio.Future = asyncio.get_running_loop().create_future()
-            self._logsub_fut = fut
-            try:
-                conn.send(messages.MLogSub(sub=True))
-                async with asyncio.timeout(self.op_timeout):
-                    ok = await fut
-            except (TimeoutError, ConnectionError, OSError):
-                ok = False
-            finally:
-                self._logsub_fut = None
-            if ok:
-                q: asyncio.Queue = asyncio.Queue(maxsize)
-                self._log_watchers.append(q)
-                return q
-            await asyncio.sleep(0.2)  # mid-election: re-pin and retry
+        if self._logsub_lock is None:
+            self._logsub_lock = asyncio.Lock()
+        async with self._logsub_lock:  # one ack slot -> one sub at a time
+            for _attempt in range(self.max_retries):
+                await self.command({"prefix": "log last", "num": 0})
+                conn = await self._mon_conn(self._cmd_addr)
+                fut: asyncio.Future = (
+                    asyncio.get_running_loop().create_future()
+                )
+                self._logsub_fut = fut
+                try:
+                    conn.send(messages.MLogSub(sub=True))
+                    async with asyncio.timeout(self.op_timeout):
+                        ok = await fut
+                except (TimeoutError, ConnectionError, OSError):
+                    ok = False
+                finally:
+                    self._logsub_fut = None
+                if ok:
+                    q: asyncio.Queue = asyncio.Queue(maxsize)
+                    self._log_watchers.append(q)
+                    self._logsub_conn = conn
+                    return q
+                await asyncio.sleep(0.2)  # mid-election: re-pin + retry
         raise RadosError(-EAGAIN, "could not subscribe to cluster log")
 
     def unwatch_cluster_log(self, q: "asyncio.Queue[dict]") -> None:
@@ -373,6 +381,14 @@ class RadosClient(Dispatcher):
             self._log_watchers.remove(q)
         except ValueError:
             pass
+        if not self._log_watchers and self._logsub_conn is not None:
+            # tell the mon to stop streaming — otherwise it serializes
+            # every entry to this connection forever (review r5 finding)
+            try:
+                self._logsub_conn.send(messages.MLogSub(sub=False))
+            except Exception:
+                pass
+            self._logsub_conn = None
 
     async def command(self, cmd: dict) -> tuple[int, str, Any]:
         """Mon command; follows leader redirects and fails over to other

@@ -83,15 +83,26 @@ def _watch(args) -> int:
     async def run() -> int:
         client = await RadosClient(mon).connect()
         try:
+            # subscribe FIRST, then fetch history: entries landing in
+            # the subscribe window buffer in the queue instead of being
+            # lost (review r5 finding); the history set dedupes the
+            # overlap
+            q = await client.watch_cluster_log()
             code, _status, out = await client.command(
                 {"prefix": "log last", "num": 20}
             )
+            seen = set()
             if code == 0:
                 for e in (out or {}).get("entries", []):
+                    seen.add((e["stamp"], e["name"], e["msg"]))
                     print(_fmt_log_entry(e))
-            q = await client.watch_cluster_log()
             while True:
-                print(_fmt_log_entry(await q.get()), flush=True)
+                e = await q.get()
+                key = (e["stamp"], e["name"], e["msg"])
+                if key in seen:
+                    seen.discard(key)  # overlap with history: once only
+                    continue
+                print(_fmt_log_entry(e), flush=True)
         except (RadosError, ConnectionError, TimeoutError) as e:
             print(f"error: {e}", file=sys.stderr)
             return 1
@@ -116,11 +127,21 @@ def main(argv=None) -> int:
     p.add_argument("words", nargs="*", help="command words")
     args = p.parse_args(argv)
     if args.watch:
+        if args.words:
+            p.error("-w takes no command words")
         return _watch(args)
     if not args.words:
         p.error("command words required (or -w)")
     words = list(args.words)
     extra: dict = {}
+    health_detail = False
+    if words == ["health", "detail"]:
+        words, health_detail = ["health"], True
+    # `ceph osd down|out|in <id>` (reference CLI shape)
+    if (len(words) == 3 and words[0] == "osd"
+            and words[1] in ("down", "out", "in")
+            and words[2].lstrip("-").isdigit()):
+        extra["id"] = int(words.pop())
     # `ceph log last [n] [level]` (reference CLI shape)
     if words[:2] == ["log", "last"]:
         for w in words[2:]:
@@ -154,10 +175,16 @@ def main(argv=None) -> int:
             elif prefix == "status" and isinstance(out, dict):
                 _print_status(out)
             elif prefix == "health" and isinstance(out, dict):
-                detail = "; ".join(
-                    c["summary"] for c in out.get("checks", [])
-                )
-                print(out["health"] + (f" {detail}" if detail else ""))
+                if health_detail:
+                    print(out["health"])
+                    for c in out.get("checks", []):
+                        print(f"[{c['severity'].removeprefix('HEALTH_')}]"
+                              f" {c['code']}: {c['summary']}")
+                else:
+                    detail = "; ".join(
+                        c["summary"] for c in out.get("checks", [])
+                    )
+                    print(out["health"] + (f" {detail}" if detail else ""))
             elif prefix == "log last" and isinstance(out, dict):
                 for e in out.get("entries", []):
                     print(_fmt_log_entry(e))

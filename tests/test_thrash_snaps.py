@@ -39,13 +39,26 @@ def _patch(data: bytes, off: int, chunk: bytes) -> bytes:
     return base[:off] + chunk + base[end:]
 
 
-@pytest.mark.parametrize("pool_type", ["replicated", "erasure"])
+@pytest.mark.parametrize(
+    "pool_type", ["replicated", "erasure", "erasure-mesh"]
+)
 def test_thrash_with_snapshots(pool_type):
+    """erasure-mesh runs the same storm over the device-mesh EC engine
+    (osd_ec_mesh: encode + degraded reconstruct through shard_map
+    collectives) — the flagship TPU-native data path must survive
+    SIGKILL thrash exactly like the TCP path, not just the quiet
+    mesh-vs-TCP byte-parity test."""
+
     async def main():
         rng = random.Random(20260730)
-        async with MiniCluster(n_osds=6) as cluster:
+        overrides = (
+            {"osd_ec_mesh": True} if pool_type == "erasure-mesh" else None
+        )
+        async with MiniCluster(
+            n_osds=6, config_overrides=overrides
+        ) as cluster:
             cl = await cluster.client()
-            if pool_type == "erasure":
+            if pool_type.startswith("erasure"):
                 code, status, _ = await cl.command({
                     "prefix": "osd erasure-code-profile set", "name": "rs32",
                     "profile": {"plugin": "jerasure",
@@ -136,5 +149,13 @@ def test_thrash_with_snapshots(pool_type):
                     model.drop_snap(sname)
             await asyncio.sleep(0.6)  # settle recovery + trim
             await verify()
+            if pool_type == "erasure-mesh":
+                # the storm must actually have exercised the mesh
+                # engine, or this parametrization proves nothing
+                enc = sum(
+                    o.perf.get("ec").get("mesh_encode_calls")
+                    for o in cluster.osds.values()
+                )
+                assert enc > 0, "mesh engine never dispatched"
 
     run(main())
