@@ -40,11 +40,15 @@ async def _mgr_command(client: RadosClient, cmd: dict):
     return 0, reply.out
 
 
+def _fmt_check(c: dict) -> str:
+    return (f"[{c['severity'].removeprefix('HEALTH_')}] "
+            f"{c['code']}: {c['summary']}")
+
+
 def _print_status(out: dict) -> None:
     print(f"  health:  {out['health']}")
     for c in out.get("checks", []):
-        print(f"           [{c['severity'].removeprefix('HEALTH_')}] "
-              f"{c['code']}: {c['summary']}")
+        print(f"           {_fmt_check(c)}")
     om = out["osdmap"]
     print(f"  osd:     {om['num_osds']} osds: {om['num_up_osds']} up, "
           f"{om['num_in_osds']} in (epoch {om['epoch']})")
@@ -139,9 +143,12 @@ def main(argv=None) -> int:
         words, health_detail = ["health"], True
     # `ceph osd down|out|in <id>` (reference CLI shape)
     if (len(words) == 3 and words[0] == "osd"
-            and words[1] in ("down", "out", "in")
-            and words[2].lstrip("-").isdigit()):
-        extra["id"] = int(words.pop())
+            and words[1] in ("down", "out", "in")):
+        try:
+            extra["id"] = int(words[2])
+            words.pop()
+        except ValueError:
+            pass  # let the mon answer the unknown-command error
     # `ceph log last [n] [level]` (reference CLI shape)
     if words[:2] == ["log", "last"]:
         for w in words[2:]:
@@ -178,8 +185,7 @@ def main(argv=None) -> int:
                 if health_detail:
                     print(out["health"])
                     for c in out.get("checks", []):
-                        print(f"[{c['severity'].removeprefix('HEALTH_')}]"
-                              f" {c['code']}: {c['summary']}")
+                        print(_fmt_check(c))
                 else:
                     detail = "; ".join(
                         c["summary"] for c in out.get("checks", [])
