@@ -1427,7 +1427,11 @@ def main():
             done = combo_done("tpu")
             # single-run headline jitter through the tunnel is 530-750
             # GB/s: leftover budget buys extra headline passes, and the
-            # best answered line wins (bounded: never past 3 total)
+            # best answered line wins.  Bound: <=2 parent retries; each
+            # retry combo (and the initial one) may ALSO run the child-
+            # side second pass when its own deadline allows, so the
+            # worst case is a handful of measurements, all inside the
+            # deadlines that already cap every chain
             more_headline = (
                 done and remaining > 140 and headline_passes < 2
             )
@@ -1479,7 +1483,7 @@ def main():
                 if more_headline:
                     skip.discard("headline")
                     headline_passes += 1
-                    timeout = min(timeout, 110.0)  # one pass only
+                    timeout = min(timeout, 110.0)  # bound the retry
                 run_combo("tpu", None, args.batch, quick, timeout,
                           skip=skip, on_result=collect("tpu"))
                 if t_end - time.time() < 45:

@@ -145,6 +145,9 @@ class Monitor(Dispatcher):
         # be absorbed from overheard proposals without winning): the
         # deposition rule in _handle_lease compares against this
         self._victory_epoch = 0
+        self._quorum_ranks: list[int] = [rank]  # last victory's quorum
+        self._lease_ok: dict[int, bool] = {}  # leader's live peer view
+        self._monmap_epoch = 1  # bumped by set_monmap, NOT elections
         self._paxos_acks: dict[int, set[int]] = {}  # version -> ranks
         self._paxos_events: dict[int, asyncio.Event] = {}
         self._electing = False
@@ -218,6 +221,8 @@ class Monitor(Dispatcher):
             return False
 
     def set_monmap(self, addrs: list[str]) -> None:
+        if self.monmap and addrs != self.monmap:
+            self._monmap_epoch += 1
         self.monmap = list(addrs)
         if self.solo:
             self.leader_rank = self.rank
@@ -549,6 +554,10 @@ class Monitor(Dispatcher):
         self.map_committed_epoch = epoch
         self._victory_epoch = epoch
         self.leader_rank = self.rank
+        # the quorum this victory was formed over (ceph quorum_status);
+        # the lease loop refreshes the live view from scratch
+        self._quorum_ranks = sorted({self.rank, *acks.keys()})
+        self._lease_ok = {}
         self._save_store()
         logger.info(
             "%s: won election epoch %d (map epoch %d)",
@@ -680,10 +689,14 @@ class Monitor(Dispatcher):
         try:
             while self.is_leader:
                 for r in self._peer_ranks():
-                    await self._send_peer(r, messages.MMonLease(
+                    ok = await self._send_peer(r, messages.MMonLease(
                         epoch=self.election_epoch, rank=self.rank,
                         map_epoch=self.osdmap.epoch,
                     ))
+                    # live reachability view for quorum_status: the
+                    # victory-time membership alone goes stale the
+                    # moment a peon dies (review r5 finding)
+                    self._lease_ok[r] = ok
                 await asyncio.sleep(self.config.mon_lease_interval)
         except asyncio.CancelledError:
             pass
@@ -992,6 +1005,41 @@ class Monitor(Dispatcher):
         # single source of the line format); the command returns data
         return 0, "", {"entries": tail}
 
+    def _cmd_quorum_status(self, cmd: dict) -> tuple[int, str, Any]:
+        """``ceph quorum_status`` / ``ceph mon stat``
+        (reference:src/mon/Monitor.cc handle_command quorum_status):
+        the quorum the current term was formed over, the leader, and
+        the monmap."""
+        if self.solo:
+            quorum = [self.rank]
+        else:
+            # victory-time members currently answering leases, plus any
+            # member the lease loop has not probed yet — a live view,
+            # not the stale election snapshot (review r5 finding)
+            quorum = sorted(
+                r for r in set(self._quorum_ranks)
+                if r == self.rank or self._lease_ok.get(r, True)
+            )
+        return 0, "", {
+            "election_epoch": self.election_epoch,
+            "quorum": quorum,
+            "quorum_names": [f"mon.{r}" for r in quorum],
+            "quorum_leader_name": (
+                f"mon.{self.leader_rank}"
+                if self.leader_rank is not None else ""
+            ),
+            "monmap": {
+                "epoch": self._monmap_epoch,
+                "mons": [
+                    {"rank": r, "name": f"mon.{r}", "addr": a}
+                    for r, a in enumerate(self.monmap)
+                ] if self.monmap else [
+                    {"rank": self.rank, "name": self.name,
+                     "addr": self.addr}
+                ],
+            },
+        }
+
     async def _handle_failure(self, msg: messages.MOSDFailure) -> None:
         target = msg.target_osd
         if not self._valid_osd_id(target) or not self.osdmap.is_up(target):
@@ -1207,6 +1255,8 @@ class Monitor(Dispatcher):
                 "fs set max_mds": self._cmd_fs_set_max_mds,
                 "mds prune-standbys": lambda c: self._cmd_svc_prune("mds", c),
                 "log last": self._cmd_log_last,
+                "quorum_status": self._cmd_quorum_status,
+                "mon stat": self._cmd_quorum_status,
                 "osd down": self._cmd_osd_down,
                 "osd out": self._cmd_osd_out,
                 "osd in": self._cmd_osd_in,

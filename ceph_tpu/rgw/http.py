@@ -119,6 +119,15 @@ def auth_header(access_key: str, secret_key: str, method: str,
     return f"AWS {access_key}:{sign_request(secret_key, method, target, headers)}"
 
 
+def _amz_meta(headers: dict) -> dict:
+    """x-amz-meta-* request headers -> user metadata dict
+    (reference:rgw_op.cc rgw_get_request_metadata)."""
+    return {
+        k[len("x-amz-meta-"):]: v
+        for k, v in headers.items() if k.startswith("x-amz-meta-")
+    }
+
+
 def _etag_set(header: str | None) -> set[str]:
     """RFC 7232 If-(None-)Match value -> set of unquoted etags."""
     if not header:
@@ -372,6 +381,7 @@ class S3Server:
                     "content-type", "binary/octet-stream"
                 ),
                 acl=headers.get("x-amz-acl", "private"),
+                meta=_amz_meta(headers),
             )
             return 200, {"etag": entry["etag"]}, b""
         if method == "POST":
@@ -379,6 +389,7 @@ class S3Server:
                 upload = await store.init_multipart(
                     bucket, key,
                     acl=headers.get("x-amz-acl", "private"),
+                    meta=_amz_meta(headers),
                 )
                 return 200, *self._json({"uploadId": upload})
             if "uploadId" in q:
@@ -420,6 +431,8 @@ class S3Server:
                                           "binary/octet-stream"),
                 "etag": etag,
                 "accept-ranges": "bytes",
+                **{f"x-amz-meta-{k}": v
+                   for k, v in (entry.get("meta") or {}).items()},
             }
             if method == "HEAD":
                 return 200, {**base,

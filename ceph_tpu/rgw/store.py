@@ -308,6 +308,7 @@ class RGWStore:
         self, bucket: str, key: str, data: bytes,
         content_type: str = "binary/octet-stream",
         acl: str = "private",
+        meta: dict | None = None,
     ) -> dict:
         await self.bucket_info(bucket)
         if not key:
@@ -325,6 +326,10 @@ class RGWStore:
             "content_type": content_type,
             "acl": acl,
         }
+        if meta:
+            # user metadata (x-amz-meta-*, reference:rgw_op.cc
+            # rgw_get_request_metadata -> RGW_ATTR_META_PREFIX attrs)
+            entry["meta"] = {str(k): str(v) for k, v in meta.items()}
         await self._index_put(bucket, key, entry)
         await self._log_change("put", bucket, key)
         return entry
@@ -366,12 +371,16 @@ class RGWStore:
         await self._log_change("del", bucket, key)
 
     async def copy_object(
-        self, src_bucket: str, src_key: str, dst_bucket: str, dst_key: str
+        self, src_bucket: str, src_key: str, dst_bucket: str, dst_key: str,
+        meta: dict | None = None,
     ) -> dict:
+        """S3 copy; user metadata follows the COPY directive by default
+        (source meta carried), or is REPLACED when ``meta`` is given."""
         data, entry = await self.get_object(src_bucket, src_key)
         return await self.put_object(
             dst_bucket, dst_key, data,
             content_type=entry.get("content_type", "binary/octet-stream"),
+            meta=meta if meta is not None else entry.get("meta"),
         )
 
     async def list_objects(
@@ -443,16 +452,20 @@ class RGWStore:
         return f"{META_NS}upload.{key}.{upload}.part.{n:05d}"
 
     async def init_multipart(
-        self, bucket: str, key: str, acl: str = "private"
+        self, bucket: str, key: str, acl: str = "private",
+        meta: dict | None = None,
     ) -> str:
         await self.bucket_info(bucket)
         _check_acl(acl)
         upload = secrets.token_hex(8)
+        rec = {"key": key, "started": _now(), "acl": acl}
+        if meta:
+            # metadata supplied at CreateMultipartUpload rides the
+            # upload record into the completed entry, like real S3
+            rec["meta"] = {str(k): str(v) for k, v in meta.items()}
         await self.index.omap_set(
             self._index_obj(bucket),
-            {self._upload_key(key, upload): json.dumps(
-                {"key": key, "started": _now(), "acl": acl}
-            ).encode()},
+            {self._upload_key(key, upload): json.dumps(rec).encode()},
         )
         return upload
 
@@ -540,6 +553,8 @@ class RGWStore:
             # objects could never be created public-read)
             "acl": meta.get("acl", "private"),
         }
+        if meta.get("meta"):
+            entry["meta"] = meta["meta"]
         await self._index_put(bucket, key, entry)
         await self.index.omap_rmkeys(
             self._index_obj(bucket),

@@ -530,3 +530,43 @@ def test_unknown_commit_triggers_leader_catchup():
                     await asyncio.sleep(0.02)
 
     asyncio.run(main())
+
+
+def test_quorum_status_reflects_membership():
+    """`ceph quorum_status` (reference:Monitor.cc handle_command):
+    full quorum after boot; after the leader dies the new term's
+    quorum excludes it."""
+
+    async def main():
+        async with MiniCluster(n_osds=3, n_mons=3) as cluster:
+            cl = await cluster.client()
+            # retried: the lease loop needs a beat to confirm peers
+            async with asyncio.timeout(10):
+                while True:
+                    code, _s, out = await cl.command(
+                        {"prefix": "quorum_status"}
+                    )
+                    assert code == 0
+                    if out["quorum"] == [0, 1, 2]:
+                        break
+                    await asyncio.sleep(0.1)
+            assert out["quorum_leader_name"] == "mon.0"
+            assert len(out["monmap"]["mons"]) == 3
+            assert out["monmap"]["epoch"] == 1  # elections don't bump it
+            # kill the leader: ranks 1+2 re-elect; the new quorum
+            # excludes rank 0
+            await cluster.mons[0].stop()
+            async with asyncio.timeout(15):
+                while True:
+                    try:
+                        code, _s, out = await cl.command(
+                            {"prefix": "quorum_status"}
+                        )
+                        if (code == 0 and out["quorum"] == [1, 2]
+                                and out["quorum_leader_name"] == "mon.1"):
+                            break
+                    except Exception:
+                        pass
+                    await asyncio.sleep(0.2)
+
+    asyncio.run(main())

@@ -676,3 +676,68 @@ class TestACLRangeConditional:
                     await srv.stop()
 
         run(main())
+
+
+class TestUserMetadata:
+    def test_meta_roundtrip_and_copy_directive(self):
+        """x-amz-meta-* stores with the object and comes back on
+        GET/HEAD (reference:rgw_op.cc rgw_get_request_metadata); copy
+        carries it by default (COPY directive)."""
+
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                s = await _store(cluster)
+                user = await s.create_user("alice")
+                from ceph_tpu.rgw.http import S3Server
+
+                srv = S3Server(s)
+                addr = await srv.start()
+                try:
+                    await _http(addr, "PUT", "/b", creds=user)
+                    st, _, _ = await _http(
+                        addr, "PUT", "/b/o", body=b"x",
+                        headers={"x-amz-meta-color": "teal",
+                                 "x-amz-meta-rev": "7"},
+                        creds=user,
+                    )
+                    assert st == 200
+                    for method in ("GET", "HEAD"):
+                        st, h, _ = await _http(addr, method, "/b/o",
+                                               creds=user)
+                        assert st == 200
+                        assert h["x-amz-meta-color"] == "teal"
+                        assert h["x-amz-meta-rev"] == "7"
+                    # store-level copy carries the metadata (COPY)
+                    await s.copy_object("b", "o", "b", "o2")
+                    st, h, _ = await _http(addr, "HEAD", "/b/o2",
+                                           creds=user)
+                    assert h["x-amz-meta-color"] == "teal"
+                    # ...unless REPLACEd
+                    await s.copy_object("b", "o", "b", "o3",
+                                        meta={"rev": "8"})
+                    st, h, _ = await _http(addr, "HEAD", "/b/o3",
+                                           creds=user)
+                    assert "x-amz-meta-color" not in h
+                    assert h["x-amz-meta-rev"] == "8"
+                    # metadata at CreateMultipartUpload survives into
+                    # the completed object (review r5 finding)
+                    st, _, payload = await _http(
+                        addr, "POST", "/b/big?uploads",
+                        headers={"x-amz-meta-origin": "mp"}, creds=user,
+                    )
+                    up = json.loads(payload)["uploadId"]
+                    await _http(addr, "PUT",
+                                f"/b/big?uploadId={up}&partNumber=1",
+                                body=b"P" * 2048, creds=user)
+                    st, _, _ = await _http(
+                        addr, "POST", f"/b/big?uploadId={up}",
+                        creds=user,
+                    )
+                    assert st == 200
+                    st, h, _ = await _http(addr, "HEAD", "/b/big",
+                                           creds=user)
+                    assert h["x-amz-meta-origin"] == "mp"
+                finally:
+                    await srv.stop()
+
+        run(main())
