@@ -409,17 +409,23 @@ class CrushMap:
         m.make_bucket(alg, 2, host_ids, host_weights, name="default")
         return m
 
+    def tree_roots(self) -> list[int]:
+        """Bucket ids that are nobody's child, shadow (device-class)
+        hierarchies excluded — the single source of the roots rule
+        (used by root_id, `ceph osd tree`, and the tester)."""
+        children = {i for b in self.buckets.values() for i in b.items}
+        return [
+            bid for bid in self.buckets
+            if bid not in children and bid not in self._shadow_owner
+        ]
+
     def root_id(self, name: str = "default") -> int:
         for bid, n in self.item_names.items():
             if n == name:
                 return bid
         # fall back: the bucket that is nobody's child (shadow roots
         # excluded — they mirror an original root, they don't add one)
-        children = {i for b in self.buckets.values() for i in b.items}
-        roots = [
-            bid for bid in self.buckets
-            if bid not in children and bid not in self._shadow_owner
-        ]
+        roots = self.tree_roots()
         if len(roots) == 1:
             return roots[0]
         raise KeyError(name)

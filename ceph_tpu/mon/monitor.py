@@ -147,7 +147,12 @@ class Monitor(Dispatcher):
         self._victory_epoch = 0
         self._quorum_ranks: list[int] = [rank]  # last victory's quorum
         self._lease_ok: dict[int, bool] = {}  # leader's live peer view
-        self._monmap_epoch = 1  # bumped by set_monmap, NOT elections
+        # monmap version for quorum_status (NOT the election epoch).
+        # Runtime monmap mutation (mon add/rm) is not a feature here —
+        # set_monmap runs once at boot with the static deployment — so
+        # the counter is interface parity, not durable state; it is
+        # deliberately not persisted
+        self._monmap_epoch = 1
         self._paxos_acks: dict[int, set[int]] = {}  # version -> ranks
         self._paxos_events: dict[int, asyncio.Event] = {}
         self._electing = False
@@ -1005,6 +1010,54 @@ class Monitor(Dispatcher):
         # single source of the line format); the command returns data
         return 0, "", {"entries": tail}
 
+    def _cmd_osd_tree(self, cmd: dict) -> tuple[int, str, Any]:
+        """``ceph osd tree`` (reference:src/mon/OSDMonitor.cc 'osd
+        tree' -> CrushWrapper dump_tree): the CRUSH hierarchy with
+        bucket weights and per-OSD status/reweight.  Shadow (device-
+        class) buckets are skipped, like the reference without
+        --show-shadow."""
+        from ..crush.map import _item_weight_of
+
+        crush = self.osdmap.crush
+        nodes: list[dict] = []
+
+        def walk(item: int, depth: int, weight: int) -> None:
+            if item >= 0:
+                reweight = (
+                    self.osdmap.osd_weight[item] / 0x10000
+                    if item < len(self.osdmap.osd_weight) else 0.0
+                )
+                nodes.append({
+                    "id": item,
+                    "name": crush.item_names.get(item, f"osd.{item}"),
+                    "type": "osd",
+                    "depth": depth,
+                    "crush_weight": round(weight / 0x10000, 5),
+                    "status": (
+                        "up" if self.osdmap.is_up(item) else "down"
+                    ),
+                    "reweight": round(reweight, 5),
+                    "class": crush.device_class(item),
+                })
+                return
+            b = crush.buckets.get(item)
+            if b is None:
+                return
+            nodes.append({
+                "id": item,
+                "name": crush.item_names.get(item, str(item)),
+                "type": crush.type_names.get(b.type, str(b.type)),
+                "depth": depth,
+                "crush_weight": round(b.weight / 0x10000, 5),
+            })
+            for j, child in enumerate(b.items):
+                walk(child, depth + 1, _item_weight_of(b, j))
+
+        # -1 (usually "default") first
+        for r in sorted(crush.tree_roots(), reverse=True):
+            walk(r, 0, crush.buckets[r].weight)
+        return 0, "", {"nodes": nodes}
+
     def _cmd_quorum_status(self, cmd: dict) -> tuple[int, str, Any]:
         """``ceph quorum_status`` / ``ceph mon stat``
         (reference:src/mon/Monitor.cc handle_command quorum_status):
@@ -1257,6 +1310,7 @@ class Monitor(Dispatcher):
                 "log last": self._cmd_log_last,
                 "quorum_status": self._cmd_quorum_status,
                 "mon stat": self._cmd_quorum_status,
+                "osd tree": self._cmd_osd_tree,
                 "osd down": self._cmd_osd_down,
                 "osd out": self._cmd_osd_out,
                 "osd in": self._cmd_osd_in,

@@ -152,6 +152,8 @@ class ZoneSyncer:
                 bucket, key, data,
                 content_type=meta.get("content_type",
                                       "binary/octet-stream"),
+                acl=meta.get("acl", "private"),
+                meta=meta.get("meta"),
             )
         elif op == "del":
             try:
@@ -233,6 +235,8 @@ class ZoneSyncer:
                     bucket, e["key"], data,
                     content_type=meta.get("content_type",
                                           "binary/octet-stream"),
+                    acl=meta.get("acl", "private"),
+                    meta=meta.get("meta"),
                 )
                 applied += 1
             dst_listing = await self.dst.list_objects(

@@ -191,6 +191,21 @@ def main(argv=None) -> int:
                         c["summary"] for c in out.get("checks", [])
                     )
                     print(out["health"] + (f" {detail}" if detail else ""))
+            elif prefix == "osd tree" and isinstance(out, dict):
+                print(f"{'ID':>4} {'CLASS':>5} {'WEIGHT':>9} "
+                      f"TYPE NAME{'':<24} STATUS REWEIGHT")
+                for n in out.get("nodes", []):
+                    name = "  " * n["depth"] + (
+                        f"{n['type']} {n['name']}" if n["type"] != "osd"
+                        else n["name"]
+                    )
+                    if n["type"] == "osd":
+                        print(f"{n['id']:>4} {n.get('class') or '-':>5} "
+                              f"{n['crush_weight']:>9.5f} {name:<33}"
+                              f"{n['status']:>7} {n['reweight']:>8.5f}")
+                    else:
+                        print(f"{n['id']:>4} {'':>5} "
+                              f"{n['crush_weight']:>9.5f} {name}")
             elif prefix == "log last" and isinstance(out, dict):
                 for e in out.get("entries", []):
                     print(_fmt_log_entry(e))

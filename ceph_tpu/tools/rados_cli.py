@@ -162,6 +162,59 @@ async def _cmd_listsnaps(client, args) -> int:
     return 0
 
 
+async def _cmd_listomapkeys(client, args) -> int:
+    io = client.io_ctx(_need_pool(args))
+    after = ""
+    while True:
+        page, more = await io.omap_get_range(
+            args.obj, start_after=after, max_entries=1000
+        )
+        for k in sorted(page):
+            print(k)
+        if not more or not page:
+            return 0
+        after = max(page)
+
+
+async def _cmd_listomapvals(client, args) -> int:
+    io = client.io_ctx(_need_pool(args))
+    after = ""
+    while True:
+        page, more = await io.omap_get_range(
+            args.obj, start_after=after, max_entries=1000
+        )
+        for k in sorted(page):
+            v = page[k]
+            print(f"{k} ({len(v)} bytes):")
+            sys.stdout.buffer.write(v)
+            print()
+        if not more or not page:
+            return 0
+        after = max(page)
+
+
+async def _cmd_getomapval(client, args) -> int:
+    io = client.io_ctx(_need_pool(args))
+    got = await io.omap_get_keys(args.obj, [args.key])
+    if args.key not in got:
+        print(f"error: no key {args.key!r}", file=sys.stderr)
+        return 1
+    sys.stdout.buffer.write(got[args.key])
+    return 0
+
+
+async def _cmd_setomapval(client, args) -> int:
+    io = client.io_ctx(_need_pool(args))
+    await io.omap_set(args.obj, {args.key: args.value.encode()})
+    return 0
+
+
+async def _cmd_rmomapkey(client, args) -> int:
+    io = client.io_ctx(_need_pool(args))
+    await io.omap_rmkeys(args.obj, [args.key])
+    return 0
+
+
 async def _cmd_setxattr(client, args) -> int:
     io = client.io_ctx(_need_pool(args))
     await io.setxattr(args.obj, args.key, args.value.encode())
@@ -292,6 +345,23 @@ def main(argv=None) -> int:
     rx.add_argument("obj")
     rx.add_argument("key")
 
+    # omap (reference:rados.cc listomapkeys/listomapvals/getomapval/
+    # setomapval/rmomapkey)
+    lok = sub.add_parser("listomapkeys")
+    lok.add_argument("obj")
+    lov = sub.add_parser("listomapvals")
+    lov.add_argument("obj")
+    gov = sub.add_parser("getomapval")
+    gov.add_argument("obj")
+    gov.add_argument("key")
+    sov = sub.add_parser("setomapval")
+    sov.add_argument("obj")
+    sov.add_argument("key")
+    sov.add_argument("value")
+    rok = sub.add_parser("rmomapkey")
+    rok.add_argument("obj")
+    rok.add_argument("key")
+
     sc = sub.add_parser("scrub")
     sc.add_argument("--no-repair", action="store_true")
 
@@ -309,6 +379,11 @@ def main(argv=None) -> int:
         "stat": _cmd_stat,
         "setxattr": _cmd_setxattr, "getxattr": _cmd_getxattr,
         "listxattr": _cmd_listxattr, "rmxattr": _cmd_rmxattr,
+        "listomapkeys": _cmd_listomapkeys,
+        "listomapvals": _cmd_listomapvals,
+        "getomapval": _cmd_getomapval,
+        "setomapval": _cmd_setomapval,
+        "rmomapkey": _cmd_rmomapkey,
         "mksnap": _cmd_mksnap, "rmsnap": _cmd_rmsnap,
         "lssnap": _cmd_lssnap, "rollback": _cmd_rollback,
         "listsnaps": _cmd_listsnaps,

@@ -170,3 +170,34 @@ class TestMultisite:
                     await b.get_object("ba", "local")
 
         run(main())
+
+
+def test_sync_carries_acl_and_user_metadata():
+    """Replication must not strip x-amz-meta or the canned acl
+    (review r5 finding): both the full and incremental paths carry
+    them to the destination zone."""
+
+    async def main():
+        async with MiniCluster(n_osds=3) as cluster:
+            cl = await cluster.client()
+            src, dst = await _zones(cl)
+            await src.create_user("alice")
+            await src.create_bucket("b", "alice")
+            await src.put_object(
+                "b", "k-full", b"one", acl="public-read",
+                meta={"color": "teal"},
+            )
+            s = ZoneSyncer(src, dst, "zone-a")
+            await s.sync()  # full
+            _d, e = await dst.get_object("b", "k-full")
+            assert e.get("acl") == "public-read"
+            assert e.get("meta") == {"color": "teal"}
+            await src.put_object(
+                "b", "k-inc", b"two", meta={"rev": "9"}
+            )
+            r = await s.sync()
+            assert r["phase"] == "incremental"
+            _d, e = await dst.get_object("b", "k-inc")
+            assert e.get("meta") == {"rev": "9"}
+
+    run(main())

@@ -202,3 +202,83 @@ def test_rados_cli_end_to_end(tmp_path, capsys):
             assert "obj1" not in capsys.readouterr().out
 
     asyncio.run(main())
+
+
+def test_rados_cli_omap_verbs(capsys):
+    """listomapkeys/listomapvals/getomapval/setomapval/rmomapkey
+    (reference:src/tools/rados/rados.cc omap verbs) — omap rides
+    replicated pools only."""
+    import asyncio
+
+    from ceph_tpu.rados import MiniCluster
+    from ceph_tpu.tools import rados_cli
+
+    async def main():
+        async with MiniCluster(n_osds=3) as cluster:
+            mon = cluster.mon.addr
+            loop = asyncio.get_running_loop()
+
+            def cli(*argv):
+                return rados_cli.main(["-m", mon, *argv])
+
+            run = lambda *a: loop.run_in_executor(None, cli, *a)  # noqa: E731
+            assert await run("mkpool", "meta", "replicated") == 0
+            cl = await cluster.client()
+            io = cl.io_ctx("meta")
+            await io.write_full("obj", b"x")
+            capsys.readouterr()
+            assert await run("-p", "meta", "setomapval", "obj",
+                             "alpha", "1") == 0
+            assert await run("-p", "meta", "setomapval", "obj",
+                             "beta", "2") == 0
+            assert await run("-p", "meta", "listomapkeys", "obj") == 0
+            out = capsys.readouterr().out
+            assert out.splitlines()[-2:] == ["alpha", "beta"]
+            assert await run("-p", "meta", "getomapval", "obj",
+                             "beta") == 0
+            assert capsys.readouterr().out.endswith("2")
+            assert await run("-p", "meta", "listomapvals", "obj") == 0
+            out = capsys.readouterr().out
+            assert "alpha (1 bytes):" in out and "beta (1 bytes):" in out
+            assert await run("-p", "meta", "rmomapkey", "obj",
+                             "alpha") == 0
+            assert await run("-p", "meta", "listomapkeys", "obj") == 0
+            assert "alpha" not in capsys.readouterr().out
+            # missing key is a clean error, not a traceback
+            assert await run("-p", "meta", "getomapval", "obj",
+                             "ghost") == 1
+
+    asyncio.run(main())
+
+
+def test_ceph_osd_tree(capsys):
+    """`ceph osd tree` renders the CRUSH hierarchy with status and
+    weights (reference:OSDMonitor 'osd tree')."""
+    import asyncio
+
+    from ceph_tpu.rados import MiniCluster
+    from ceph_tpu.tools import ceph_cli
+
+    async def main():
+        async with MiniCluster(
+            n_osds=4, crush_hosts=[[0, 1], [2, 3]]
+        ) as cluster:
+            mon = cluster.mon.addr
+            await cluster.kill_osd(3)
+            await cluster.wait_for_osd_down(3)
+            loop = asyncio.get_running_loop()
+            rc = await loop.run_in_executor(
+                None, ceph_cli.main, ["-m", mon, "osd", "tree"]
+            )
+            assert rc == 0
+            out = capsys.readouterr().out
+            lines = out.splitlines()
+            assert lines[0].split() == [
+                "ID", "CLASS", "WEIGHT", "TYPE", "NAME", "STATUS",
+                "REWEIGHT",
+            ]
+            assert sum("host" in ln for ln in lines) == 2
+            assert any("osd.3" in ln and "down" in ln for ln in lines)
+            assert any("osd.0" in ln and "up" in ln for ln in lines)
+
+    asyncio.run(main())
