@@ -119,13 +119,23 @@ def auth_header(access_key: str, secret_key: str, method: str,
     return f"AWS {access_key}:{sign_request(secret_key, method, target, headers)}"
 
 
-def _amz_meta(headers: dict) -> dict:
-    """x-amz-meta-* request headers -> user metadata dict
-    (reference:rgw_op.cc rgw_get_request_metadata)."""
+def _prefixed_meta(headers: dict, prefix: str) -> dict:
+    """Prefixed request headers -> user metadata dict — ONE rule for
+    both APIs (the reference maps x-amz-meta-* and X-Object-Meta-* onto
+    the same RGW_ATTR_META_PREFIX attrs, reference:rgw_op.cc
+    rgw_get_request_metadata, so metadata round-trips across APIs)."""
     return {
-        k[len("x-amz-meta-"):]: v
-        for k, v in headers.items() if k.startswith("x-amz-meta-")
+        k[len(prefix):]: v
+        for k, v in headers.items() if k.startswith(prefix)
     }
+
+
+def _amz_meta(headers: dict) -> dict:
+    return _prefixed_meta(headers, "x-amz-meta-")
+
+
+def _swift_meta(headers: dict) -> dict:
+    return _prefixed_meta(headers, "x-object-meta-")
 
 
 def _etag_set(header: str | None) -> set[str]:
@@ -649,6 +659,7 @@ class S3Server:
                 content_type=headers.get(
                     "content-type", "application/octet-stream"
                 ),
+                meta=_swift_meta(headers),
             )
             return 201, {"etag": entry["etag"]}, b""
         if method == "GET":
@@ -658,12 +669,19 @@ class S3Server:
                     "content_type", "application/octet-stream"
                 ),
                 "etag": entry["etag"],
+                **{f"x-object-meta-{k}": v
+                   for k, v in (entry.get("meta") or {}).items()},
             }, data
         if method == "HEAD":
             entry = await store.head_object(container, obj)
             return 200, {
                 "content-length": str(entry["size"]),
+                "content-type": entry.get(
+                    "content_type", "application/octet-stream"
+                ),
                 "etag": entry["etag"],
+                **{f"x-object-meta-{k}": v
+                   for k, v in (entry.get("meta") or {}).items()},
             }, b""
         if method == "DELETE":
             await store.delete_object(container, obj)

@@ -162,35 +162,37 @@ async def _cmd_listsnaps(client, args) -> int:
     return 0
 
 
-async def _cmd_listomapkeys(client, args) -> int:
-    io = client.io_ctx(_need_pool(args))
+async def _omap_pages(io, obj):
+    """Yield (key, value) in omap order, one ranged page at a time —
+    the single copy of the start_after/truncated paging protocol."""
     after = ""
     while True:
         page, more = await io.omap_get_range(
-            args.obj, start_after=after, max_entries=1000
+            obj, start_after=after, max_entries=1000
         )
         for k in sorted(page):
-            print(k)
+            yield k, page[k]
         if not more or not page:
-            return 0
+            return
         after = max(page)
+
+
+async def _cmd_listomapkeys(client, args) -> int:
+    io = client.io_ctx(_need_pool(args))
+    async for k, _v in _omap_pages(io, args.obj):
+        print(k)
+    return 0
 
 
 async def _cmd_listomapvals(client, args) -> int:
     io = client.io_ctx(_need_pool(args))
-    after = ""
-    while True:
-        page, more = await io.omap_get_range(
-            args.obj, start_after=after, max_entries=1000
-        )
-        for k in sorted(page):
-            v = page[k]
-            print(f"{k} ({len(v)} bytes):")
-            sys.stdout.buffer.write(v)
-            print()
-        if not more or not page:
-            return 0
-        after = max(page)
+    async for k, v in _omap_pages(io, args.obj):
+        print(f"{k} ({len(v)} bytes):")
+        sys.stdout.flush()  # keep text/binary layers in order when piped
+        sys.stdout.buffer.write(v)
+        sys.stdout.buffer.flush()
+        print()
+    return 0
 
 
 async def _cmd_getomapval(client, args) -> int:

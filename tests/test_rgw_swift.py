@@ -268,3 +268,40 @@ class TestSwift:
                 await srv.stop()
 
         run(main())
+
+
+def test_object_metadata_roundtrips_across_both_apis():
+    """X-Object-Meta-* stores into the same user-metadata slot the S3
+    side serves as x-amz-meta-* (the reference maps both prefixes onto
+    the same attrs)."""
+
+    async def main():
+        async with MiniCluster(n_osds=3) as cluster:
+            cl = await cluster.client()
+            store, user, srv, addr = await _gateway(cl)
+            loop = asyncio.get_running_loop()
+
+            def ex(*a, **kw):
+                return loop.run_in_executor(None, lambda: _req(*a, **kw))
+
+            st, h, _ = await ex(addr, "GET", "/auth/v1.0", None, {
+                "X-Auth-User": "acct:swift",
+                "X-Auth-Key": user["secret_key"],
+            })
+            token = {k.lower(): v for k, v in h.items()}["x-auth-token"]
+            base = f"/v1/AUTH_{user['uid']}"
+            T = {"X-Auth-Token": token}
+            await ex(addr, "PUT", f"{base}/c", None, T)
+            st, _h, _ = await ex(
+                addr, "PUT", f"{base}/c/o", b"x",
+                {**T, "X-Object-Meta-Color": "teal"},
+            )
+            assert st == 201
+            st, h, _ = await ex(addr, "HEAD", f"{base}/c/o", None, T)
+            hl = {k.lower(): v for k, v in h.items()}
+            assert hl["x-object-meta-color"] == "teal"
+            # the S3 view of the same object serves the same metadata
+            entry = await store.head_object("c", "o")
+            assert entry["meta"] == {"color": "teal"}
+
+    run(main())
