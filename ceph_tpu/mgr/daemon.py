@@ -61,10 +61,18 @@ class MgrDaemon(Dispatcher):
         pm = self.perf.create("mgr")
         pm.add_counter("stats_received", "MPGStats ingested")
         pm.add_counter("commands", "module commands served")
-        from .modules import DfModule, PGDumpModule, PrometheusModule, StatusModule
+        from .modules import (
+            DfModule,
+            OsdDfModule,
+            PGDumpModule,
+            PgQueryModule,
+            PrometheusModule,
+            StatusModule,
+        )
 
         self.modules: list[MgrModule] = modules or [
-            StatusModule(), DfModule(), PGDumpModule(), PrometheusModule()
+            StatusModule(), DfModule(), OsdDfModule(), PgQueryModule(),
+            PGDumpModule(), PrometheusModule(),
         ]
         self._routes: dict[str, MgrModule] = {}
         for mod in self.modules:

@@ -136,6 +136,122 @@ class StatusModule(MgrModule):
         }
 
 
+class OsdDfModule(MgrModule):
+    """`ceph osd df`: per-OSD usage + pg count
+    (reference:src/mon/OSDMonitor.cc 'osd df' -> print_osd_utilization)."""
+
+    NAME = "osd_df"
+    COMMANDS = {"osd df": "osd_df"}
+
+    def osd_df(self, mgr: MgrDaemon, cmd: dict) -> tuple[int, str, Any]:
+        """Per-OSD HOSTED footprint, computed from the map + the
+        primaries' per-PG byte counts: every acting member of a PG
+        hosts it (replicated: a full copy; EC: ~bytes/k per shard).
+        OSD reports alone can't answer this — each OSD reports only
+        the PGs it LEADS (review r5 finding: counting those made a
+        balanced cluster look wildly imbalanced)."""
+        import math
+
+        from ..osd.osdmap import CRUSH_ITEM_NONE
+
+        m = mgr.osdmap
+        if m is None:
+            return 0, "", {"nodes": []}
+        pgsum = mgr.pg_summary()
+        hosted_pgs: dict[int, int] = {}
+        hosted_bytes: dict[int, int] = {}
+        for pid, pool in m.pools.items():
+            k = 1
+            if pool.is_erasure:
+                prof = m.erasure_code_profiles.get(
+                    pool.erasure_code_profile, {}
+                )
+                k = max(1, int(prof.get("k", 2)))
+            for pg in m.pgs_of_pool(pid):
+                _u, _up, acting, _ap = m.pg_to_up_acting_osds(pg)
+                pgb = pgsum.get(str(pg), {}).get("bytes", 0)
+                share = math.ceil(pgb / k)
+                for o in acting:
+                    if o == CRUSH_ITEM_NONE:
+                        continue
+                    hosted_pgs[o] = hosted_pgs.get(o, 0) + 1
+                    hosted_bytes[o] = hosted_bytes.get(o, 0) + share
+        rows = []
+        for osd in range(m.max_osd):
+            if not m.exists(osd):
+                continue
+            used = hosted_bytes.get(osd, 0)
+            rows.append({
+                "id": osd,
+                "name": f"osd.{osd}",
+                "status": "up" if m.is_up(osd) else "down",
+                "reweight": round(
+                    (m.osd_weight[osd] / 0x10000)
+                    if osd < len(m.osd_weight) else 0.0, 5
+                ),
+                "kb_used": used // 1024,
+                "bytes_used": used,
+                "pgs": hosted_pgs.get(osd, 0),
+            })
+        return 0, "", {
+            "nodes": rows,
+            "summary": {
+                "total_bytes_used": sum(r["bytes_used"] for r in rows),
+                "total_pgs": sum(r["pgs"] for r in rows),
+            },
+        }
+
+
+class PgQueryModule(MgrModule):
+    """`ceph pg query` for one pgid: mapping + the primary's latest
+    report (reference:src/mon/PGMap + the OSD's pg query)."""
+
+    NAME = "pg_query"
+    COMMANDS = {"pg query": "pg_query"}
+
+    def pg_query(self, mgr: MgrDaemon, cmd: dict) -> tuple[int, str, Any]:
+        m = mgr.osdmap
+        pgid = str(cmd.get("pgid", ""))
+        if m is None or not pgid:
+            return -22, "need pgid", None
+        from ..osd.osdmap import PGid
+
+        try:
+            pg = PGid.parse(pgid)
+        except (ValueError, TypeError):
+            return -22, f"bad pgid {pgid!r}", None
+        if pg.pool not in m.pools:
+            return -2, f"no pool {pg.pool}", None
+        if not 0 <= pg.seed < m.pools[pg.pool].pg_num:
+            # pg_to_up_acting_osds would silently FOLD an out-of-range
+            # seed onto a real PG and answer for the wrong one
+            # (review r5 finding); real ceph answers ENOENT
+            return -2, f"no pg {pgid}", None
+        up, up_primary, acting, acting_primary = m.pg_to_up_acting_osds(pg)
+        pst = mgr.pg_summary().get(str(pg), {})
+        state = "active+clean"
+        from ..osd.osdmap import CRUSH_ITEM_NONE
+
+        alive = sum(1 for o in acting if o != CRUSH_ITEM_NONE)
+        want = m.pools[pg.pool].size
+        if alive < want:
+            state = "active+undersized+degraded"
+        if alive < m.pools[pg.pool].min_size:
+            state = "down"
+        return 0, "", {
+            "pgid": str(pg),
+            "state": state,
+            "up": up, "up_primary": up_primary,
+            "acting": acting, "acting_primary": acting_primary,
+            "epoch": m.epoch,
+            "stats": {
+                "objects": pst.get("objects", 0),
+                "bytes": pst.get("bytes", 0),
+                "reported_by": pst.get("reporter"),
+            },
+        }
+
+
 class DfModule(MgrModule):
     """`ceph df`: per-pool usage from the primaries' reports."""
 

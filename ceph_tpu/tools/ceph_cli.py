@@ -23,8 +23,8 @@ import sys
 from ..msg import messages
 from ..rados.client import RadosClient, RadosError
 
-MGR_COMMANDS = {"status", "health", "df", "pg dump", "metrics",
-                "mgr module ls"}
+MGR_COMMANDS = {"status", "health", "df", "osd df", "pg dump",
+                "pg query", "metrics", "mgr module ls"}
 
 
 async def _mgr_command(client: RadosClient, cmd: dict):
@@ -149,6 +149,9 @@ def main(argv=None) -> int:
             words.pop()
         except ValueError:
             pass  # let the mon answer the unknown-command error
+    # `ceph pg query <pgid>` (reference CLI shape)
+    if words[:2] == ["pg", "query"] and len(words) == 3:
+        extra["pgid"] = words.pop()
     # `ceph log last [n] [level]` (reference CLI shape)
     if words[:2] == ["log", "last"]:
         for w in words[2:]:
@@ -167,7 +170,9 @@ def main(argv=None) -> int:
         try:
             status = ""
             if prefix in MGR_COMMANDS:
-                rc, out = await _mgr_command(client, {"prefix": prefix})
+                rc, out = await _mgr_command(
+                    client, {"prefix": prefix, **extra}
+                )
                 if rc:
                     return rc
             else:
@@ -191,6 +196,16 @@ def main(argv=None) -> int:
                         c["summary"] for c in out.get("checks", [])
                     )
                     print(out["health"] + (f" {detail}" if detail else ""))
+            elif prefix == "osd df" and isinstance(out, dict):
+                print(f"{'ID':>4} {'STATUS':>7} {'REWEIGHT':>9} "
+                      f"{'USED':>12} {'PGS':>5}")
+                for n in out.get("nodes", []):
+                    print(f"{n['id']:>4} {n['status']:>7} "
+                          f"{n['reweight']:>9.5f} "
+                          f"{n['bytes_used']:>12} {n['pgs']:>5}")
+                s = out.get("summary", {})
+                print(f"{'TOTAL':>12} {s.get('total_bytes_used', 0):>16} "
+                      f"{s.get('total_pgs', 0):>5}")
             elif prefix == "osd tree" and isinstance(out, dict):
                 print(f"{'ID':>4} {'CLASS':>5} {'WEIGHT':>9} "
                       f"TYPE NAME{'':<24} STATUS REWEIGHT")

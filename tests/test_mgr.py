@@ -290,3 +290,65 @@ class TestCephCLI:
                 assert "epoch" in out
 
         run(main())
+
+
+class TestOsdDfPgQuery:
+    def test_osd_df_and_pg_query(self):
+        """`ceph osd df` (per-OSD usage + pgs) and `ceph pg query`
+        (mapping, state, primary's stats) against a live cluster."""
+
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                await cluster.start_mgr()
+                await cluster.wait_for_active_mgr()
+                cl = await cluster.client()
+                await cl.create_pool("data", "replicated", size=3,
+                                     pg_num=8)
+                io = cl.io_ctx("data")
+                await io.write_full("obj", b"z" * 4096)
+                async with asyncio.timeout(15):
+                    while True:
+                        df = await _mgr_cmd(cluster, cl, "osd df")
+                        if (len(df["nodes"]) == 3
+                                and df["summary"]["total_bytes_used"] > 0):
+                            break
+                        await asyncio.sleep(0.1)
+                assert all(n["status"] == "up" for n in df["nodes"])
+                # size=3 on 3 OSDs: every OSD hosts every pg — the
+                # hosted footprint, not just primary-led pgs
+                assert all(n["pgs"] == 8 for n in df["nodes"])
+                assert all(n["bytes_used"] > 0 for n in df["nodes"])
+
+                pool = cl.osdmap.lookup_pool("data")
+                pg, acting, primary = cl.osdmap.object_to_acting(
+                    "obj", pool.id
+                )
+                from ceph_tpu.tools.ceph_cli import _mgr_command
+
+                rc, q = await _mgr_command(
+                    cl, {"prefix": "pg query", "pgid": str(pg)}
+                )
+                assert rc == 0
+                assert q["pgid"] == str(pg)
+                assert q["acting"] == acting
+                assert q["acting_primary"] == primary
+                assert q["state"] == "active+clean"
+                assert q["stats"]["objects"] >= 1
+
+                # degraded state surfaces after a kill
+                await cluster.kill_osd(acting[0])
+                await cluster.wait_for_osd_down(acting[0])
+                rc, q = await _mgr_command(
+                    cl, {"prefix": "pg query", "pgid": str(pg)}
+                )
+                assert rc == 0 and "degraded" in q["state"]
+
+                # bad pgid is a clean error; an out-of-range seed must
+                # NOT fold onto a real pg and answer for the wrong one
+                for bad in ("bogus", "1.ff", "99.0"):
+                    rc, _q = await _mgr_command(
+                        cl, {"prefix": "pg query", "pgid": bad}
+                    )
+                    assert rc == 1, bad
+
+        run(main())
