@@ -12,6 +12,18 @@ from .daemon import MgrDaemon, MgrModule
 _SEVERITIES = ("HEALTH_OK", "HEALTH_WARN", "HEALTH_ERR")
 
 
+def _pg_redundancy(m, pool, pg) -> tuple[int, bool, bool]:
+    """(alive, degraded, below_min_size) for one pg — the SINGLE copy
+    of the classification `ceph health` and `ceph pg query` share.
+    Replicated acting DROPS down osds; EC acting keeps NONE holes — in
+    both cases alive < pool.size is degraded."""
+    from ..osd.osdmap import CRUSH_ITEM_NONE
+
+    _up, _upp, acting, _ap = m.pg_to_up_acting_osds(pg)
+    alive = sum(1 for o in acting if o != CRUSH_ITEM_NONE)
+    return alive, alive < pool.size, alive < pool.min_size
+
+
 def _worst_severity(checks: list[dict]) -> str:
     return max((c["severity"] for c in checks),
                key=_SEVERITIES.index, default="HEALTH_OK")
@@ -48,13 +60,10 @@ def _health_checks(m, mgr, *, up: int, inn: int, exists: int) -> list[dict]:
     unavailable = 0
     for pid, pool in m.pools.items():
         for pg in m.pgs_of_pool(pid):
-            _up, _upp, acting, _ap = m.pg_to_up_acting_osds(pg)
-            # replicated acting DROPS down osds; EC acting keeps NONE
-            # holes — in both cases "alive < pool.size" is degraded
-            alive = sum(1 for o in acting if o != CRUSH_ITEM_NONE)
-            if alive < pool.size:
+            _alive, deg, below = _pg_redundancy(m, pool, pg)
+            if deg:
                 degraded += 1
-            if alive < pool.min_size:
+            if below:
                 unavailable += 1
     if unavailable:
         checks.append({
@@ -229,14 +238,13 @@ class PgQueryModule(MgrModule):
             return -2, f"no pg {pgid}", None
         up, up_primary, acting, acting_primary = m.pg_to_up_acting_osds(pg)
         pst = mgr.pg_summary().get(str(pg), {})
+        _alive, degraded, below = _pg_redundancy(
+            m, m.pools[pg.pool], pg
+        )
         state = "active+clean"
-        from ..osd.osdmap import CRUSH_ITEM_NONE
-
-        alive = sum(1 for o in acting if o != CRUSH_ITEM_NONE)
-        want = m.pools[pg.pool].size
-        if alive < want:
+        if degraded:
             state = "active+undersized+degraded"
-        if alive < m.pools[pg.pool].min_size:
+        if below:
             state = "down"
         return 0, "", {
             "pgid": str(pg),

@@ -277,6 +277,33 @@ class Image:
 
         return refresh_and_drop()
 
+    async def du(self) -> dict:
+        """Allocated bytes for the image HEAD: lists the pool once and
+        stats each existing rbd_data object — sparse extents never
+        written cost nothing (reference:src/tools/rbd/action/
+        DiskUsage.cc; head only: snap-level accounting would need
+        per-snap clone walks)."""
+        await self._cache_flush()  # dirty cached writes must be counted
+        prefix = f"{DATA_PREFIX}{self.image_id}."
+        used = 0
+        objects = 0
+        for name in await self.io.client.list_objects(self.io.pool_name):
+            if not name.startswith(prefix):
+                continue
+            try:
+                used += await self.io.stat(name)
+                objects += 1
+            except RadosError as e:
+                if e.code != -ENOENT:
+                    raise  # a real I/O failure must not under-report
+                # raced a discard/delete: the object is legitimately gone
+        return {
+            "name": self.name,
+            "provisioned": self.size_bytes,
+            "used": used,
+            "objects": objects,
+        }
+
     # -- layout ------------------------------------------------------------
     @property
     def object_size(self) -> int:

@@ -204,7 +204,7 @@ def main(argv=None) -> int:
                           f"{n['reweight']:>9.5f} "
                           f"{n['bytes_used']:>12} {n['pgs']:>5}")
                 s = out.get("summary", {})
-                print(f"{'TOTAL':>12} {s.get('total_bytes_used', 0):>16} "
+                print(f"{'TOTAL':>22} {s.get('total_bytes_used', 0):>12} "
                       f"{s.get('total_pgs', 0):>5}")
             elif prefix == "osd tree" and isinstance(out, dict):
                 print(f"{'ID':>4} {'CLASS':>5} {'WEIGHT':>9} "

@@ -77,6 +77,20 @@ async def _cmd_ls(rbd, io, args) -> int:
     return 0
 
 
+async def _cmd_du(rbd, io, args) -> int:
+    """`rbd du`: provisioned vs allocated bytes (sparse-aware),
+    reference:src/tools/rbd/action/DiskUsage.cc."""
+    img = await Image.open(io, args.image)
+    try:
+        d = await img.du()
+    finally:
+        await img.close()
+    print(f"{'NAME':<20} {'PROVISIONED':>12} {'USED':>12} {'OBJECTS':>8}")
+    print(f"{d['name']:<20} {d['provisioned']:>12} {d['used']:>12} "
+          f"{d['objects']:>8}")
+    return 0
+
+
 async def _cmd_info(rbd, io, args) -> int:
     img = await Image.open(io, args.image)
     try:
@@ -253,7 +267,7 @@ def main(argv=None) -> int:
     mi.add_argument("--dest-pool", required=True)
     mi.add_argument("--id", default="peer")
     sub.add_parser("ls")
-    for verb in ("info", "rm"):
+    for verb in ("info", "rm", "du"):
         v = sub.add_parser(verb)
         v.add_argument("image")
     r = sub.add_parser("resize")
@@ -288,6 +302,7 @@ def main(argv=None) -> int:
 
     fn = {
         "create": _cmd_create, "ls": _cmd_ls, "info": _cmd_info,
+        "du": _cmd_du,
         "rm": _cmd_rm, "resize": _cmd_resize, "snap": _cmd_snap,
         "clone": _cmd_clone, "flatten": _cmd_flatten,
         "children": _cmd_children,
