@@ -12,14 +12,13 @@ from .daemon import MgrDaemon, MgrModule
 _SEVERITIES = ("HEALTH_OK", "HEALTH_WARN", "HEALTH_ERR")
 
 
-def _pg_redundancy(m, pool, pg) -> tuple[int, bool, bool]:
-    """(alive, degraded, below_min_size) for one pg — the SINGLE copy
-    of the classification `ceph health` and `ceph pg query` share.
-    Replicated acting DROPS down osds; EC acting keeps NONE holes — in
-    both cases alive < pool.size is degraded."""
+def _pg_redundancy(pool, acting: list) -> tuple[int, bool, bool]:
+    """(alive, degraded, below_min_size) for one pg's acting set — the
+    SINGLE copy of the classification `ceph health` and `ceph pg
+    query` share.  Replicated acting DROPS down osds; EC acting keeps
+    NONE holes — in both cases alive < pool.size is degraded."""
     from ..osd.osdmap import CRUSH_ITEM_NONE
 
-    _up, _upp, acting, _ap = m.pg_to_up_acting_osds(pg)
     alive = sum(1 for o in acting if o != CRUSH_ITEM_NONE)
     return alive, alive < pool.size, alive < pool.min_size
 
@@ -47,8 +46,6 @@ def _health_checks(m, mgr, *, up: int, inn: int, exists: int) -> list[dict]:
     PGMonitor summaries at this version, reported with the later
     stable check codes — OSD_DOWN, PG_DEGRADED, PG_AVAILABILITY,
     OSD_SCRUB_ERRORS).  Each check: {code, severity, summary}."""
-    from ..osd.osdmap import CRUSH_ITEM_NONE
-
     checks: list[dict] = []
     down = exists - up
     if down > 0:
@@ -60,7 +57,8 @@ def _health_checks(m, mgr, *, up: int, inn: int, exists: int) -> list[dict]:
     unavailable = 0
     for pid, pool in m.pools.items():
         for pg in m.pgs_of_pool(pid):
-            _alive, deg, below = _pg_redundancy(m, pool, pg)
+            _up, _upp, acting, _ap = m.pg_to_up_acting_osds(pg)
+            _alive, deg, below = _pg_redundancy(pool, acting)
             if deg:
                 degraded += 1
             if below:
@@ -239,7 +237,7 @@ class PgQueryModule(MgrModule):
         up, up_primary, acting, acting_primary = m.pg_to_up_acting_osds(pg)
         pst = mgr.pg_summary().get(str(pg), {})
         _alive, degraded, below = _pg_redundancy(
-            m, m.pools[pg.pool], pg
+            m.pools[pg.pool], acting
         )
         state = "active+clean"
         if degraded:

@@ -1058,6 +1058,32 @@ class Monitor(Dispatcher):
             walk(r, 0, crush.buckets[r].weight)
         return 0, "", {"nodes": nodes}
 
+    def _cmd_osd_map(self, cmd: dict) -> tuple[int, str, Any]:
+        """``ceph osd map <pool> <object>``
+        (reference:src/mon/OSDMonitor.cc 'osd map'): the object's pg
+        and its current up/acting mapping."""
+        pool_name = str(cmd.get("pool", ""))
+        obj = str(cmd.get("object", ""))
+        if not pool_name or not obj:
+            return -EINVAL, "need pool + object", None
+        pool = self.osdmap.lookup_pool(pool_name)
+        if pool is None:
+            return -ENOENT, f"no pool {pool_name!r}", None
+        raw_pg = self.osdmap.object_locator_to_pg(obj, pool.id)
+        pg = pool.raw_pg_to_pg(raw_pg)
+        up, up_primary, acting, acting_primary = \
+            self.osdmap.pg_to_up_acting_osds(pg)
+        return 0, "", {
+            "epoch": self.osdmap.epoch,
+            "pool": pool_name,
+            "pool_id": pool.id,
+            "objname": obj,
+            "raw_pgid": str(raw_pg),
+            "pgid": str(pg),
+            "up": up, "up_primary": up_primary,
+            "acting": acting, "acting_primary": acting_primary,
+        }
+
     def _cmd_quorum_status(self, cmd: dict) -> tuple[int, str, Any]:
         """``ceph quorum_status`` / ``ceph mon stat``
         (reference:src/mon/Monitor.cc handle_command quorum_status):
@@ -1311,6 +1337,7 @@ class Monitor(Dispatcher):
                 "quorum_status": self._cmd_quorum_status,
                 "mon stat": self._cmd_quorum_status,
                 "osd tree": self._cmd_osd_tree,
+                "osd map": self._cmd_osd_map,
                 "osd down": self._cmd_osd_down,
                 "osd out": self._cmd_osd_out,
                 "osd in": self._cmd_osd_in,

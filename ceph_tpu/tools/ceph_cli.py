@@ -152,6 +152,10 @@ def main(argv=None) -> int:
     # `ceph pg query <pgid>` (reference CLI shape)
     if words[:2] == ["pg", "query"] and len(words) == 3:
         extra["pgid"] = words.pop()
+    # `ceph osd map <pool> <object>` (reference CLI shape)
+    if words[:2] == ["osd", "map"] and len(words) == 4:
+        extra["object"] = words.pop()
+        extra["pool"] = words.pop()
     # `ceph log last [n] [level]` (reference CLI shape)
     if words[:2] == ["log", "last"]:
         for w in words[2:]:
@@ -196,6 +200,12 @@ def main(argv=None) -> int:
                         c["summary"] for c in out.get("checks", [])
                     )
                     print(out["health"] + (f" {detail}" if detail else ""))
+            elif prefix == "osd map" and isinstance(out, dict):
+                print(f"osdmap e{out['epoch']} pool '{out['pool']}' "
+                      f"({out['pool_id']}) object '{out['objname']}' -> "
+                      f"pg {out['raw_pgid']} ({out['pgid']}) -> up "
+                      f"({out['up']}, p{out['up_primary']}) acting "
+                      f"({out['acting']}, p{out['acting_primary']})")
             elif prefix == "osd df" and isinstance(out, dict):
                 print(f"{'ID':>4} {'STATUS':>7} {'REWEIGHT':>9} "
                       f"{'USED':>12} {'PGS':>5}")

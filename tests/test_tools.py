@@ -282,3 +282,40 @@ def test_ceph_osd_tree(capsys):
             assert any("osd.0" in ln and "up" in ln for ln in lines)
 
     asyncio.run(main())
+
+
+def test_ceph_osd_map(capsys):
+    """`ceph osd map <pool> <obj>` agrees with the client's own
+    mapping (reference:OSDMonitor 'osd map')."""
+    import asyncio
+
+    from ceph_tpu.rados import MiniCluster
+    from ceph_tpu.tools import ceph_cli
+
+    async def main():
+        async with MiniCluster(n_osds=3) as cluster:
+            mon = cluster.mon.addr
+            cl = await cluster.client()
+            await cl.create_pool("data", "replicated", size=3)
+            pool = cl.osdmap.lookup_pool("data")
+            pg, acting, primary = cl.osdmap.object_to_acting(
+                "thing", pool.id
+            )
+            loop = asyncio.get_running_loop()
+            rc = await loop.run_in_executor(
+                None, ceph_cli.main,
+                ["-m", mon, "osd", "map", "data", "thing"],
+            )
+            assert rc == 0
+            out = capsys.readouterr().out
+            assert f"({pg})" in out
+            assert f"p{primary}" in out
+            assert str(acting) in out
+            # unknown pool is a clean error
+            rc = await loop.run_in_executor(
+                None, ceph_cli.main,
+                ["-m", mon, "osd", "map", "nope", "thing"],
+            )
+            assert rc == 1
+
+    asyncio.run(main())
