@@ -133,11 +133,44 @@ class MgrDaemon(Dispatcher):
                         cmd={"prefix": "mgr beacon", "name": self.name,
                              "addr": self.addr},
                     ))
+                    if self.active:
+                        tid = self._check_pool_quotas(conn, tid)
                 except (ConnectionError, OSError):
                     self._mon_conn = None
                 await asyncio.sleep(interval)
         except asyncio.CancelledError:
             pass
+
+    def _check_pool_quotas(self, conn: Connection, tid: int) -> int:
+        """Flip FLAG_FULL_QUOTA through the mon when a pool's usage
+        (the primaries' reports) crosses its quota — the stats
+        authority drives the flag, like the reference's PGMonitor
+        (reference:src/mon/PGMonitor.cc check_full_osd_health analog
+        for pool quotas).  Approximate by design: stats lag writes."""
+        from ..osd.osdmap import FLAG_FULL_QUOTA
+
+        m = self.osdmap
+        if m is None:
+            return tid
+        usage = self.pool_usage()
+        for pid, pool in m.pools.items():
+            if not (pool.quota_max_objects or pool.quota_max_bytes):
+                continue
+            u = usage.get(pid, {"objects": 0, "bytes": 0})
+            over = (
+                (pool.quota_max_objects
+                 and u["objects"] >= pool.quota_max_objects)
+                or (pool.quota_max_bytes
+                    and u["bytes"] >= pool.quota_max_bytes)
+            )
+            have = bool(pool.flags & FLAG_FULL_QUOTA)
+            if bool(over) != have:
+                tid += 1
+                conn.send(messages.MMonCommand(tid=tid, cmd={
+                    "prefix": "osd pool quota-full",
+                    "pool": pool.name, "full": bool(over),
+                }))
+        return tid
 
     # -- dispatch ------------------------------------------------------------
     async def ms_dispatch(self, conn: Connection, msg: Message) -> None:
@@ -245,6 +278,18 @@ class MgrDaemon(Dispatcher):
                 continue
             live[osd] = st
         return live
+
+    def pool_usage(self) -> dict[int, dict]:
+        """{pool_id: {"objects", "bytes"}} aggregated from the per-PG
+        summary — the single copy of the pgid->pool keying (shared by
+        `ceph df` and the quota checker)."""
+        usage: dict[int, dict] = {}
+        for pgid, pst in self.pg_summary().items():
+            pid = int(pgid.split(".", 1)[0])
+            u = usage.setdefault(pid, {"objects": 0, "bytes": 0})
+            u["objects"] += pst.get("objects", 0)
+            u["bytes"] += pst.get("bytes", 0)
+        return usage
 
     def pg_summary(self) -> dict[str, dict]:
         """Authoritative per-PG view: the primary's report wins

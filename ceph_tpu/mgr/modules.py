@@ -268,15 +268,15 @@ class DfModule(MgrModule):
         m = mgr.osdmap
         if m is None:
             return 0, "", {"pools": []}
+        usage = mgr.pool_usage()
         per_pool: dict[int, dict] = {
-            pid: {"name": p.name, "objects": 0, "bytes": 0}
+            pid: {
+                "name": p.name,
+                "objects": usage.get(pid, {}).get("objects", 0),
+                "bytes": usage.get(pid, {}).get("bytes", 0),
+            }
             for pid, p in m.pools.items()
         }
-        for pgid, pst in mgr.pg_summary().items():
-            pool_id = int(pgid.split(".", 1)[0])
-            if pool_id in per_pool:
-                per_pool[pool_id]["objects"] += pst.get("objects", 0)
-                per_pool[pool_id]["bytes"] += pst.get("bytes", 0)
         stored = sum(
             st["store"].get("bytes_used", 0)
             for st in mgr.live_osd_stats().values()

@@ -48,7 +48,12 @@ from ..crush.map import CrushMap
 from ..models import registry
 from ..msg import AsyncMessenger, Connection, Dispatcher, messages
 from ..msg.message import Message
-from ..osd.osdmap import Incremental, OSDMap, POOL_TYPE_REPLICATED
+from ..osd.osdmap import (
+    FLAG_FULL_QUOTA,
+    Incremental,
+    OSDMap,
+    POOL_TYPE_REPLICATED,
+)
 
 logger = logging.getLogger("ceph_tpu.mon")
 
@@ -1317,6 +1322,9 @@ class Monitor(Dispatcher):
                 "osd pool rm": self._cmd_pool_rm,
                 "osd pool set": self._cmd_pool_set,
                 "osd pool get": self._cmd_pool_get,
+                "osd pool set-quota": self._cmd_pool_set_quota,
+                "osd pool get-quota": self._cmd_pool_get_quota,
+                "osd pool quota-full": self._cmd_pool_quota_full,
                 "osd reweight": self._cmd_osd_reweight,
                 "osd pool mksnap": self._cmd_pool_mksnap,
                 "osd pool rmsnap": self._cmd_pool_rmsnap,
@@ -1563,6 +1571,62 @@ class Monitor(Dispatcher):
             pool.min_size = max(1, val - 1)
         self._mark_dirty()  # the epoch bump re-peers every PG
         return 0, f"set pool {pool.name} {var} = {val}", None
+
+    def _cmd_pool_set_quota(self, cmd: dict) -> tuple[int, str, Any]:
+        """``ceph osd pool set-quota <pool> max_objects|max_bytes <n>``
+        (reference:src/mon/OSDMonitor.cc 'osd pool set-quota'); 0
+        clears the quota."""
+        pool = self.osdmap.lookup_pool(cmd.get("pool", ""))
+        if pool is None:
+            return -ENOENT, f"no pool {cmd.get('pool')!r}", None
+        field = cmd.get("field", "")
+        if field not in ("max_objects", "max_bytes"):
+            return -EINVAL, "field must be max_objects|max_bytes", None
+        try:
+            val = int(cmd.get("val"))
+        except (TypeError, ValueError):
+            return -EINVAL, f"bad value {cmd.get('val')!r}", None
+        if val < 0:
+            return -EINVAL, "quota must be >= 0 (0 clears)", None
+        setattr(pool, f"quota_{field}", val)
+        if val == 0 and pool.quota_max_bytes == 0 \
+                and pool.quota_max_objects == 0:
+            pool.flags &= ~FLAG_FULL_QUOTA  # cleared quota unfills
+        self._mark_dirty()
+        return 0, f"set-quota {field} = {val} for pool {pool.name}", None
+
+    def _cmd_pool_get_quota(self, cmd: dict) -> tuple[int, str, Any]:
+        pool = self.osdmap.lookup_pool(cmd.get("pool", ""))
+        if pool is None:
+            return -ENOENT, f"no pool {cmd.get('pool')!r}", None
+        return 0, "", {
+            "pool": pool.name,
+            "max_objects": pool.quota_max_objects,
+            "max_bytes": pool.quota_max_bytes,
+            "full": bool(pool.flags & FLAG_FULL_QUOTA),
+        }
+
+    def _cmd_pool_quota_full(self, cmd: dict) -> tuple[int, str, Any]:
+        """mgr -> mon: flip FLAG_FULL_QUOTA from the usage reports (the
+        reference's PGMonitor does this map mutation itself; here the
+        stats authority is the mgr, so it drives the flag)."""
+        pool = self.osdmap.lookup_pool(cmd.get("pool", ""))
+        if pool is None:
+            return -ENOENT, f"no pool {cmd.get('pool')!r}", None
+        want = bool(cmd.get("full"))
+        have = bool(pool.flags & FLAG_FULL_QUOTA)
+        if want == have:
+            return 0, "", None  # no epoch churn on repeats
+        if want:
+            pool.flags |= FLAG_FULL_QUOTA
+            self.clog_append(self.name, "warn",
+                             f"pool '{pool.name}' is full (quota)")
+        else:
+            pool.flags &= ~FLAG_FULL_QUOTA
+            self.clog_append(self.name, "info",
+                             f"pool '{pool.name}' quota-full cleared")
+        self._mark_dirty()
+        return 0, "", None
 
     def _cmd_pool_get(self, cmd: dict) -> tuple[int, str, Any]:
         pool = self.osdmap.lookup_pool(cmd["pool"])

@@ -70,6 +70,7 @@ _trace = tracepoint_provider("oprequest")
 ENOENT = 2
 EIO = 5
 EAGAIN = 11
+EDQUOT = 122  # pool quota full (reference: -EDQUOT on FLAG_FULL_QUOTA)
 EINVAL = 22
 ESTALE = 116
 EOPNOTSUPP = 95
@@ -850,6 +851,14 @@ class OSD(Dispatcher):
         ("rollback", "call", "setxattr", "rmxattr",
          "omap_setkeys", "omap_rmkeys", "omap_clear")
     )
+    # mutations a quota-full pool still REJECTS: everything that can
+    # grow data, incl. setxattr (creates missing objects) and omap
+    # writes — but NOT the space-freeing ops (delete/rmxattr/omap rm/
+    # clear), which are the way out of full.  "call" is handled by the
+    # method's own WR flag at the gate.
+    _QUOTA_GATED_OPS = (_REP_LOCKED_OPS
+                        - frozenset(("delete", "rmxattr", "omap_rmkeys",
+                                     "omap_clear", "call")))
 
     async def _handle_client_op(self, conn: Connection, msg: messages.MOSDOp) -> None:
         posd = self.perf.get("osd")
@@ -905,6 +914,32 @@ class OSD(Dispatcher):
             )
         )
 
+    def _quota_rejects(self, msg: messages.MOSDOp) -> bool:
+        """True iff this op batch contains a data-GROWING mutation
+        (review r5: gating only _WRITE_OPS let setxattr/omap writes
+        bypass the quota, and a delete+read batch was falsely
+        rejected).  cls calls gate on the method's WR flag."""
+        for op in msg.ops:
+            n = op.get("op")
+            if n in self._QUOTA_GATED_OPS:
+                return True
+            if n == "call":
+                from .. import cls as cls_mod
+
+                try:
+                    kls = cls_mod.get_class(
+                        op.get("cls", ""),
+                        class_dir=self.config.get("osd_class_dir")
+                        or None,
+                    )
+                except cls_mod.ClsLoadError:
+                    return True  # broken class: fail closed at the gate
+                method = (kls.methods.get(op.get("method", ""))
+                          if kls else None)
+                if method is not None and method.is_write:
+                    return True
+        return False
+
     async def _execute_op(
         self, msg: messages.MOSDOp, conn: Connection | None = None
     ) -> tuple[int, list, list[bytes]]:
@@ -921,6 +956,14 @@ class OSD(Dispatcher):
             # client raced a map change; it must re-target
             return -EAGAIN, [{"error": "not primary", "primary": primary}], []
         names = [op.get("op") for op in msg.ops]
+        from .osdmap import FLAG_FULL_QUOTA
+
+        if pool.flags & FLAG_FULL_QUOTA and self._quota_rejects(msg):
+            # quota-full pools reject data-growing mutations but allow
+            # deletions/space-freeing — the only way out of full
+            # (reference:PrimaryLogPG -EDQUOT on FLAG_FULL_QUOTA)
+            return -EDQUOT, [{"error": f"pool '{pool.name}' is full "
+                                       "(quota)"}], []
         if any(n in ("watch", "unwatch", "notify") for n in names):
             # backend-independent: watch state lives on the primary, not
             # in the object store (reference:src/osd/Watch.cc)

@@ -49,6 +49,7 @@ CEPH_OSD_MAX_PRIMARY_AFFINITY = 0x10000
 CEPH_OSD_DEFAULT_PRIMARY_AFFINITY = 0x10000
 
 FLAG_HASHPSPOOL = 1  # reference:pg_pool_t::FLAG_HASHPSPOOL
+FLAG_FULL_QUOTA = 1 << 10  # reference:pg_pool_t::FLAG_FULL_QUOTA
 
 
 def ceph_stable_mod(x: int, b: int, bmask: int) -> int:
@@ -115,6 +116,13 @@ class Pool:
     flags: int = FLAG_HASHPSPOOL
     erasure_code_profile: str = ""
     stripe_width: int = 0
+    # quotas (reference:pg_pool_t quota_max_bytes/objects): 0 = none.
+    # The mgr compares the primaries' usage reports against these and
+    # flips FLAG_FULL_QUOTA through the mon; enforcement is at the
+    # OSD's write admission (approximate, like the reference — stats
+    # lag the writes)
+    quota_max_bytes: int = 0
+    quota_max_objects: int = 0
     # snapshots (reference:osd_types.h pg_pool_t snap_seq/snaps/
     # removed_snaps): pool snaps are named and cluster-managed;
     # self-managed snaps only consume ids from the same sequence

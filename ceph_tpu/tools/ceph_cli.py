@@ -156,6 +156,14 @@ def main(argv=None) -> int:
     if words[:2] == ["osd", "map"] and len(words) == 4:
         extra["object"] = words.pop()
         extra["pool"] = words.pop()
+    # `ceph osd pool set-quota <pool> max_objects|max_bytes <n>` and
+    # `ceph osd pool get-quota <pool>` (reference CLI shapes)
+    if words[:3] == ["osd", "pool", "set-quota"] and len(words) == 6:
+        extra["val"] = words.pop()
+        extra["field"] = words.pop()
+        extra["pool"] = words.pop()
+    if words[:3] == ["osd", "pool", "get-quota"] and len(words) == 4:
+        extra["pool"] = words.pop()
     # `ceph log last [n] [level]` (reference CLI shape)
     if words[:2] == ["log", "last"]:
         for w in words[2:]:
@@ -236,6 +244,9 @@ def main(argv=None) -> int:
                     print(_fmt_log_entry(e))
             elif isinstance(out, str):
                 print(out, end="")
+            elif out is None:
+                if status:  # status-only replies (e.g. set-quota acks)
+                    print(status)
             else:
                 print(json.dumps(out, indent=1, sort_keys=True))
             return 0

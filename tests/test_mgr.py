@@ -352,3 +352,111 @@ class TestOsdDfPgQuery:
                     assert rc == 1, bad
 
         run(main())
+
+
+class TestPoolQuotas:
+    def test_quota_full_blocks_writes_until_space_freed(self):
+        """Pool quotas (reference:pg_pool_t quota_max_*): the mgr flips
+        FLAG_FULL_QUOTA from the primaries' usage reports, writes
+        answer -EDQUOT while full (deletes stay allowed — the only way
+        out), and freeing space clears the flag."""
+        from ceph_tpu.rados import RadosError
+
+        EDQUOT = 122
+
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                await cluster.start_mgr()
+                await cluster.wait_for_active_mgr()
+                cl = await cluster.client()
+                await cl.create_pool("q", "replicated", size=3)
+                code, _s, _o = await cl.command({
+                    "prefix": "osd pool set-quota", "pool": "q",
+                    "field": "max_objects", "val": "2",
+                })
+                assert code == 0
+                code, _s, quota = await cl.command({
+                    "prefix": "osd pool get-quota", "pool": "q",
+                })
+                assert quota["max_objects"] == 2 and not quota["full"]
+                io = cl.io_ctx("q")
+                await io.write_full("a", b"x")
+                await io.write_full("b", b"y")
+                # the mgr's next beacon tick notices >= 2 objects and
+                # flips the flag through the mon; writes then EDQUOT
+                async with asyncio.timeout(20):
+                    while True:
+                        try:
+                            await io.write_full("c", b"z")
+                            await io.remove("c")
+                        except RadosError as e:
+                            assert e.code == -EDQUOT
+                            break
+                        await asyncio.sleep(0.2)
+                # overwrites of EXISTING objects are also writes: EDQUOT
+                with pytest.raises(RadosError) as ei:
+                    await io.write_full("a", b"xx")
+                assert ei.value.code == -EDQUOT
+                # deletes are allowed while full
+                await io.remove("b")
+                # usage falls under quota: the mgr clears the flag and
+                # writes resume
+                async with asyncio.timeout(20):
+                    while True:
+                        try:
+                            await io.write_full("c2", b"z")
+                            break
+                        except RadosError as e:
+                            assert e.code == -EDQUOT
+                            await asyncio.sleep(0.2)
+                # raising the quota to 0 clears everything
+                code, _s, _o = await cl.command({
+                    "prefix": "osd pool set-quota", "pool": "q",
+                    "field": "max_objects", "val": "0",
+                })
+                assert code == 0
+
+        run(main())
+
+    def test_quota_gates_xattr_and_omap_growth(self):
+        """setxattr/omap writes also grow data: a quota-full pool must
+        reject them (review r5: only _WRITE_OPS were gated), while a
+        delete batched with a read stays allowed."""
+        from ceph_tpu.rados import RadosError
+
+        EDQUOT = 122
+
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                await cluster.start_mgr()
+                await cluster.wait_for_active_mgr()
+                cl = await cluster.client()
+                await cl.create_pool("q", "replicated", size=3)
+                code, _s, _o = await cl.command({
+                    "prefix": "osd pool set-quota", "pool": "q",
+                    "field": "max_objects", "val": "1",
+                })
+                assert code == 0
+                io = cl.io_ctx("q")
+                await io.write_full("a", b"x")
+                async with asyncio.timeout(20):
+                    while True:
+                        try:
+                            await io.write_full("c", b"z")
+                            await io.remove("c")
+                        except RadosError as e:
+                            assert e.code == -EDQUOT
+                            break
+                        await asyncio.sleep(0.2)
+                # xattr + omap growth is gated
+                with pytest.raises(RadosError) as ei:
+                    await io.setxattr("ghost", "k", b"v")
+                assert ei.value.code == -EDQUOT
+                with pytest.raises(RadosError) as ei:
+                    await io.omap_set("ghost2", {"k": b"v"})
+                assert ei.value.code == -EDQUOT
+                # reads and deletes still work while full
+                assert await io.read("a") == b"x"
+                await io.remove("a")
+
+        run(main())
