@@ -152,6 +152,9 @@ class MgrDaemon(Dispatcher):
         m = self.osdmap
         if m is None:
             return tid
+        if not any(p.quota_max_objects or p.quota_max_bytes
+                   for p in m.pools.values()):
+            return tid  # no quotas anywhere: skip the aggregation
         usage = self.pool_usage()
         for pid, pool in m.pools.items():
             if not (pool.quota_max_objects or pool.quota_max_bytes):

@@ -53,6 +53,27 @@ def _health_checks(m, mgr, *, up: int, inn: int, exists: int) -> list[dict]:
             "code": "OSD_DOWN", "severity": "HEALTH_WARN",
             "summary": f"{down} osds down",
         })
+    if m.cluster_flags:
+        # `osd set pause/noscrub/...` changes cluster behavior — the
+        # operator must see it in health, not just the scrolled-away
+        # clog line (reference: OSDMAP_FLAGS check)
+        checks.append({
+            "code": "OSDMAP_FLAGS", "severity": "HEALTH_WARN",
+            "summary": (
+                f"{','.join(sorted(m.cluster_flags))} flag(s) set"
+            ),
+        })
+    from ..osd.osdmap import FLAG_FULL_QUOTA
+
+    full_pools = [p.name for p in m.pools.values()
+                  if p.flags & FLAG_FULL_QUOTA]
+    if full_pools:
+        checks.append({
+            "code": "POOL_FULL", "severity": "HEALTH_WARN",
+            "summary": (
+                f"pool(s) {', '.join(sorted(full_pools))} full (quota)"
+            ),
+        })
     degraded = 0
     unavailable = 0
     for pid, pool in m.pools.items():
@@ -122,7 +143,8 @@ class StatusModule(MgrModule):
             "checks": checks,
             "monmap_epoch": m.epoch,
             "osdmap": {"epoch": m.epoch, "num_osds": exists,
-                       "num_up_osds": up, "num_in_osds": inn},
+                       "num_up_osds": up, "num_in_osds": inn,
+                       "flags": sorted(m.cluster_flags)},
             "mgrmap": {"active": m.mgr_name,
                        "standbys": [n for n, _ in m.mgr_standbys]},
             "mdsmap": {

@@ -1346,6 +1346,8 @@ class Monitor(Dispatcher):
                 "mon stat": self._cmd_quorum_status,
                 "osd tree": self._cmd_osd_tree,
                 "osd map": self._cmd_osd_map,
+                "osd set": self._cmd_osd_set_flag,
+                "osd unset": self._cmd_osd_unset_flag,
                 "osd down": self._cmd_osd_down,
                 "osd out": self._cmd_osd_out,
                 "osd in": self._cmd_osd_in,
@@ -1785,6 +1787,37 @@ class Monitor(Dispatcher):
 
     def _cmd_osd_dump(self, cmd: dict) -> tuple[int, str, Any]:
         return 0, "", self.osdmap.to_dict()
+
+    # `ceph osd set/unset` cluster flags (reference:OSDMonitor 'osd
+    # set' -> CEPH_OSDMAP_* flags).  noout is advisory here: this
+    # framework never auto-outs a down OSD, so there is nothing to
+    # suppress — accepted for tooling parity, documented as a no-op.
+    CLUSTER_FLAGS = ("pause", "noscrub", "nodeep-scrub", "norecover",
+                     "nobackfill", "noout")
+
+    def _cmd_osd_set_flag(self, cmd: dict) -> tuple[int, str, Any]:
+        flag = str(cmd.get("flag", ""))
+        if flag not in self.CLUSTER_FLAGS:
+            return -EINVAL, (f"unknown flag {flag!r} "
+                             f"(known: {', '.join(self.CLUSTER_FLAGS)})"), \
+                None
+        if flag in self.osdmap.cluster_flags:
+            return 0, f"{flag} is set", None
+        self.osdmap.cluster_flags.add(flag)
+        self.clog_append(self.name, "warn", f"flag {flag} set")
+        self._mark_dirty()
+        return 0, f"{flag} is set", None
+
+    def _cmd_osd_unset_flag(self, cmd: dict) -> tuple[int, str, Any]:
+        flag = str(cmd.get("flag", ""))
+        if flag not in self.CLUSTER_FLAGS:
+            return -EINVAL, f"unknown flag {flag!r}", None
+        if flag not in self.osdmap.cluster_flags:
+            return 0, f"{flag} is unset", None
+        self.osdmap.cluster_flags.discard(flag)
+        self.clog_append(self.name, "info", f"flag {flag} unset")
+        self._mark_dirty()
+        return 0, f"{flag} is unset", None
 
     def _cmd_osd_down(self, cmd: dict) -> tuple[int, str, Any]:
         osd = int(cmd["id"])

@@ -958,10 +958,31 @@ class OSD(Dispatcher):
         names = [op.get("op") for op in msg.ops]
         from .osdmap import FLAG_FULL_QUOTA
 
-        if pool.flags & FLAG_FULL_QUOTA and self._quota_rejects(msg):
+        if "pause" in self.osdmap.cluster_flags:
+            # `ceph osd set pause` stops client IO cluster-wide
+            # (reference blocks the op until unpause; here the client's
+            # bounded EAGAIN retry surfaces the pause instead of
+            # waiting forever — divergence documented)
+            return -EAGAIN, [{"error": "cluster IO paused "
+                                       "(osd unset pause to resume)"}], []
+        # quota gate: the pool itself, and — when this pool is a cache
+        # TIER — its base pool too: everything admitted to the cache
+        # eventually flushes to the base, so a quota-full base must
+        # stop new client writes AT the cache (review r5: clients were
+        # redirected to the cache pool and bypassed the base's quota
+        # entirely, while the agent's flushes wedged on EDQUOT)
+        quota_full = bool(pool.flags & FLAG_FULL_QUOTA)
+        if not quota_full and pool.tier_of >= 0:
+            base = self.osdmap.pools.get(pool.tier_of)
+            quota_full = base is not None and bool(
+                base.flags & FLAG_FULL_QUOTA
+            )
+        if quota_full and self._quota_rejects(msg):
             # quota-full pools reject data-growing mutations but allow
             # deletions/space-freeing — the only way out of full
-            # (reference:PrimaryLogPG -EDQUOT on FLAG_FULL_QUOTA)
+            # (reference:PrimaryLogPG -EDQUOT on FLAG_FULL_QUOTA).
+            # The tier agent's flush backlog keeps retrying on its
+            # periodic tick until the operator raises the quota.
             return -EDQUOT, [{"error": f"pool '{pool.name}' is full "
                                        "(quota)"}], []
         if any(n in ("watch", "unwatch", "notify") for n in names):

@@ -211,6 +211,9 @@ class OSDMap:
         self.osd_addrs: dict[int, str] = {}  # osd id -> "host:port"
         self.pools: dict[int, Pool] = {}
         self.pool_name: dict[str, int] = {}
+        # cluster-wide flags (reference:OSDMap CEPH_OSDMAP_PAUSERD/WR,
+        # NOSCRUB, NORECOVER, NOBACKFILL, NOOUT — `ceph osd set/unset`)
+        self.cluster_flags: set[str] = set()
         self.erasure_code_profiles: dict[str, dict[str, str]] = {}
         self.pg_temp: dict[PGid, list[int]] = {}
         self.primary_temp: dict[PGid, int] = {}
@@ -608,6 +611,7 @@ class OSDMap:
             "mds_standbys": list(self.mds_standbys),
             "mds_ranks": [list(r) for r in self.mds_ranks],
             "mds_max": self.mds_max,
+            "cluster_flags": sorted(self.cluster_flags),
         }
 
     @classmethod
@@ -645,6 +649,7 @@ class OSDMap:
         m.mds_standbys = [tuple(x) for x in d.get("mds_standbys", [])]
         m.mds_ranks = [list(x) for x in d.get("mds_ranks", [])]
         m.mds_max = int(d.get("mds_max", 1))
+        m.cluster_flags = set(d.get("cluster_flags", []))
         return m
 
 

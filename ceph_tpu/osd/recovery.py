@@ -391,6 +391,13 @@ class RecoveryManager:
         osd = self.osd
         if osd.osdmap is None:
             return
+        flags = osd.osdmap.cluster_flags
+        if "norecover" in flags or "nobackfill" in flags:
+            # `ceph osd set norecover|nobackfill` parks the pass; the
+            # unset's map epoch re-kicks it (recovery and backfill are
+            # one unified push path here, so either flag parks it)
+            self._retry_needed = False
+            return
         for pool in list(osd.osdmap.pools.values()):
             for pg in osd.osdmap.pgs_of_pool(pool.id):
                 _up, _upp, acting, primary = osd.osdmap.pg_to_up_acting_osds(pg)

@@ -81,6 +81,15 @@ class ScrubManager:
         try:
             while self.interval > 0:  # config set to 0 stops the loop
                 await asyncio.sleep(self.interval)
+                if self.osd.osdmap is not None and (
+                    {"noscrub", "nodeep-scrub"}
+                    & self.osd.osdmap.cluster_flags
+                ):
+                    # `ceph osd set noscrub` parks SCHEDULED scrubs
+                    # (operator-initiated scrub_pool stays allowed);
+                    # every scrub here is a deep scrub, so either flag
+                    # parks the loop
+                    continue
                 try:
                     await self.scrub_all(
                         repair=self.osd.config.osd_scrub_auto_repair

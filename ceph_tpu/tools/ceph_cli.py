@@ -50,8 +50,9 @@ def _print_status(out: dict) -> None:
     for c in out.get("checks", []):
         print(f"           {_fmt_check(c)}")
     om = out["osdmap"]
+    flags = f", flags {','.join(om['flags'])}" if om.get("flags") else ""
     print(f"  osd:     {om['num_osds']} osds: {om['num_up_osds']} up, "
-          f"{om['num_in_osds']} in (epoch {om['epoch']})")
+          f"{om['num_in_osds']} in (epoch {om['epoch']}){flags}")
     mg = out["mgrmap"]
     stand = f", standbys: {', '.join(mg['standbys'])}" if mg["standbys"] else ""
     print(f"  mgr:     {mg['active'] or '(none)'}{stand}")
@@ -141,6 +142,10 @@ def main(argv=None) -> int:
     health_detail = False
     if words == ["health", "detail"]:
         words, health_detail = ["health"], True
+    # `ceph osd set|unset <flag>` (reference CLI shape)
+    if (len(words) == 3 and words[0] == "osd"
+            and words[1] in ("set", "unset")):
+        extra["flag"] = words.pop()
     # `ceph osd down|out|in <id>` (reference CLI shape)
     if (len(words) == 3 and words[0] == "osd"
             and words[1] in ("down", "out", "in")):

@@ -397,6 +397,11 @@ class TestPoolQuotas:
                 with pytest.raises(RadosError) as ei:
                     await io.write_full("a", b"xx")
                 assert ei.value.code == -EDQUOT
+                # ...and the condition is a visible health check, not
+                # just a scrolled-away clog line (review r5 finding)
+                st = await _mgr_cmd(cluster, cl, "health")
+                assert any(c["code"] == "POOL_FULL"
+                           for c in st["checks"]), st
                 # deletes are allowed while full
                 await io.remove("b")
                 # usage falls under quota: the mgr clears the flag and

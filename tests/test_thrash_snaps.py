@@ -159,3 +159,87 @@ def test_thrash_with_snapshots(pool_type):
                 assert enc > 0, "mesh engine never dispatched"
 
     run(main())
+
+
+
+def test_cluster_flags_pause_and_norecover():
+    """`ceph osd set pause|norecover` (reference:CEPH_OSDMAP_* flags):
+    pause rejects client IO until unset; norecover parks degraded-pg
+    recovery, and the unset's epoch bump re-kicks it."""
+    import asyncio
+
+    import pytest
+
+    from ceph_tpu.rados import MiniCluster, RadosError
+
+    async def main():
+        async with MiniCluster(n_osds=4) as cluster:
+            cl = await cluster.client()
+            await cl.create_pool("p", "replicated", size=3, pg_num=8)
+            io = cl.io_ctx("p")
+            await io.write_full("obj", b"payload" * 100)
+
+            # unknown flag is a clean error
+            code, _s, _o = await cl.command(
+                {"prefix": "osd set", "flag": "nonsense"}
+            )
+            assert code < 0
+
+            code, _s, _o = await cl.command(
+                {"prefix": "osd set", "flag": "pause"}
+            )
+            assert code == 0
+            # the flag rides the next map push to the client
+            async with asyncio.timeout(10):
+                while "pause" not in cl.osdmap.cluster_flags:
+                    await asyncio.sleep(0.05)
+            # paused ops BLOCK at the OSD's EAGAIN + the client's
+            # map-wait retry (the reference blocks until unpause too);
+            # both reads and writes stall
+            for op in (io.write_full("obj2", b"x"), io.read("obj")):
+                with pytest.raises((RadosError, TimeoutError)):
+                    async with asyncio.timeout(2):
+                        await op
+            code, _s, _o = await cl.command(
+                {"prefix": "osd unset", "flag": "pause"}
+            )
+            assert code == 0
+            async with asyncio.timeout(15):
+                while True:
+                    try:
+                        await io.write_full("obj2", b"x")
+                        break
+                    except (RadosError, TimeoutError):
+                        await asyncio.sleep(0.1)
+
+            # norecover: kill an OSD, write degraded, set norecover,
+            # restart the OSD -> its copy stays stale; unset -> heals
+            code, _s, _o = await cl.command(
+                {"prefix": "osd set", "flag": "norecover"}
+            )
+            assert code == 0
+            pool = cl.osdmap.lookup_pool("p")
+            pg, acting, primary = cl.osdmap.object_to_acting(
+                "obj", pool.id
+            )
+            victim = next(o for o in acting if o != primary)
+            await cluster.kill_osd(victim)
+            await cluster.wait_for_osd_down(victim)
+            await io.write_full("obj", b"NEWDATA" * 100)
+            await cluster.restart_osd(victim)
+            await cluster.wait_for_osd_up(victim)
+            await asyncio.sleep(0.8)  # a recovery pass would run here
+            # norecover parked the pass: no pushes happened yet
+            pushes_before = cluster.osds[primary].perf.get(
+                "recovery").get("pushes")
+            code, _s, _o = await cl.command(
+                {"prefix": "osd unset", "flag": "norecover"}
+            )
+            assert code == 0
+            async with asyncio.timeout(15):
+                while cluster.osds[primary].perf.get(
+                        "recovery").get("pushes") <= pushes_before:
+                    await asyncio.sleep(0.1)
+            assert await io.read("obj") == b"NEWDATA" * 100
+
+    asyncio.run(main())
