@@ -277,6 +277,98 @@ class Image:
 
         return refresh_and_drop()
 
+    async def _object_at(
+        self, snap_name: str | None, objectno: int
+    ) -> bytes | None:
+        """One data object's bytes at a snap (None = head), or None if
+        the object does not exist there.  A clone's parent-backed hole
+        reads THROUGH to the parent (like Image.read) — an absent local
+        object on a clone is inherited data, not a discard.
+
+        Flips the shared IoCtx read-snap around the await (restored in
+        finally): callers must not interleave other reads on this
+        Image handle mid-call — export_diff documents itself as an
+        exclusive whole-image operation for this reason."""
+        sid = (int(self.snaps[snap_name]["id"])
+               if snap_name is not None else None)
+        restore = (int(self.snaps[self.snap_name]["id"])
+                   if self.snap_name is not None else None)
+        self.io.set_read(sid)
+        try:
+            return await self.io.read(
+                self._data_name(objectno), 0, self.object_size
+            )
+        except RadosError as e:
+            if e.code != -ENOENT:
+                raise
+        finally:
+            self.io.set_read(restore)
+        if self.parent is not None:
+            got = await self._parent_read(objectno, 0, self.object_size)
+            if got.rstrip(b"\x00"):
+                return got
+        return None
+
+    async def export_diff(self, from_snap: str | None,
+                          to_snap: str | None):
+        """Yield (objectno, data|None) for every data object that
+        differs between ``from_snap`` (None = the empty image, i.e. a
+        full export) and ``to_snap`` (None = head) —
+        reference:src/tools/rbd/action/ExportDiff.cc.  Object-granular
+        where the reference is extent-granular via clone-overlap
+        metadata: same incremental-backup contract, coarser grain.
+        data None = the object is ABSENT at the target (a discard).
+
+        An EXCLUSIVE whole-image operation: it flips the handle's read
+        snap per object (see _object_at), so interleave no other I/O
+        on this Image while iterating — open a dedicated handle (the
+        CLI does).  Reads are sequential for the same reason."""
+        for name, label in ((from_snap, "from"), (to_snap, "to")):
+            if name is not None and name not in self.snaps:
+                raise RbdError(-ENOENT, f"no {label} snap {name!r}")
+        await self._cache_flush()
+        from_size = (int(self.snaps[from_snap]["size"])
+                     if from_snap is not None else 0)
+        to_size = (int(self.snaps[to_snap]["size"])
+                   if to_snap is not None else self.size_bytes)
+        span = max(from_size, to_size)
+        nobjs = (span + self.object_size - 1) // self.object_size
+        for objectno in range(nobjs):
+            new = await self._object_at(to_snap, objectno)
+            if new is not None:
+                # clip to the image boundary: a shrunk image's tail
+                # object may physically extend past the logical size
+                # (io.zero keeps the object length), and an oversized
+                # record would fail the importer's bounds check
+                limit = to_size - objectno * self.object_size
+                if limit <= 0:
+                    new = None
+                elif len(new) > limit:
+                    new = new[:limit]
+            if from_snap is None:
+                old = None
+            else:
+                old = await self._object_at(from_snap, objectno)
+            if old == new:
+                continue
+            yield objectno, new
+
+    async def apply_diff_record(
+        self, objectno: int, data: bytes | None
+    ) -> None:
+        """Apply one export_diff record (import-diff side).  The whole
+        object span is discarded first: a shorter record over a longer
+        existing object must not leave stale tail bytes (review r5
+        finding — the source reads zeros there)."""
+        off = objectno * self.object_size
+        span = min(self.object_size, max(0, self.size_bytes - off))
+        if span > 0:
+            await self.discard(off, span)
+        if data is not None:
+            if off + len(data) > self.size_bytes:
+                raise RbdError(-EINVAL, "diff record past image size")
+            await self.write(off, data)
+
     async def du(self) -> dict:
         """Allocated bytes for the image HEAD: lists the pool once and
         stats each existing rbd_data object — sparse extents never
