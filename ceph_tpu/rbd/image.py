@@ -362,11 +362,14 @@ class Image:
         finding — the source reads zeros there)."""
         off = objectno * self.object_size
         span = min(self.object_size, max(0, self.size_bytes - off))
-        if span > 0:
+        if data is not None and off + len(data) > self.size_bytes:
+            raise RbdError(-EINVAL, "diff record past image size")
+        if span > 0 and (data is None or len(data) < span):
+            # only when the record does NOT cover the whole span: a
+            # shorter record over a longer existing object must not
+            # leave stale tail bytes
             await self.discard(off, span)
         if data is not None:
-            if off + len(data) > self.size_bytes:
-                raise RbdError(-EINVAL, "diff record past image size")
             await self.write(off, data)
 
     async def du(self) -> dict:

@@ -177,6 +177,31 @@ async def _omap_pages(io, obj):
         after = max(page)
 
 
+async def _cmd_cppool(client, args) -> int:
+    """`rados cppool <src> <dst>` (reference:rados.cc do_copy_pool):
+    copy every object — data, xattrs, omap — into an existing pool."""
+    src = client.io_ctx(args.src)
+    dst = client.io_ctx(args.dst)
+    names = await client.list_objects(args.src)
+    copied = 0
+    for oid in sorted(names):
+        data = await src.read(oid)
+        await dst.write_full(oid, data)
+        for k, v in (await src.getxattrs(oid)).items():
+            await dst.setxattr(oid, k, v)
+        try:
+            omap = await src.omap_get(oid)
+        except RadosError as e:
+            if e.code != -95:  # EOPNOTSUPP: EC pools have no omap
+                raise  # anything else is data loss, not a skip
+            omap = {}
+        if omap:
+            await dst.omap_set(oid, omap)
+        copied += 1
+    print(f"copied {copied} object(s) from {args.src} to {args.dst}")
+    return 0
+
+
 async def _cmd_listomapkeys(client, args) -> int:
     io = client.io_ctx(_need_pool(args))
     async for k, _v in _omap_pages(io, args.obj):
@@ -307,6 +332,9 @@ def main(argv=None) -> int:
                     choices=["replicated", "erasure"])
     mk.add_argument("--profile", default=None)
     mk.add_argument("--size", type=int, default=None)
+    cp = sub.add_parser("cppool")
+    cp.add_argument("src")
+    cp.add_argument("dst")
     rm = sub.add_parser("rmpool")
     rm.add_argument("name")
     sub.add_parser("df")
@@ -377,6 +405,7 @@ def main(argv=None) -> int:
     fn = {
         "lspools": _cmd_lspools, "mkpool": _cmd_mkpool,
         "rmpool": _cmd_rmpool, "df": _cmd_df,
+        "cppool": _cmd_cppool,
         "put": _cmd_put, "get": _cmd_get, "ls": _cmd_ls, "rm": _cmd_rm,
         "stat": _cmd_stat,
         "setxattr": _cmd_setxattr, "getxattr": _cmd_getxattr,

@@ -220,29 +220,31 @@ async def _cmd_export_diff(rbd, io, args) -> int:
             sys.stdout.buffer if args.path == "-"
             else open(args.path, "wb")
         )
-        to_size = (
-            int(img.snaps[args.snap]["size"]) if args.snap
-            else img.size_bytes
-        )
-        out.write(DIFF_MAGIC)
-        out.write((_json.dumps({
-            "from_snap": args.from_snap, "to_snap": args.snap,
-            "size": to_size, "object_size": img.object_size,
-        }) + "\n").encode())
-        records = 0
-        async for objectno, data in img.export_diff(
-            args.from_snap, args.snap
-        ):
+        try:
+            to_size = (
+                int(img.snaps[args.snap]["size"]) if args.snap
+                else img.size_bytes
+            )
+            out.write(DIFF_MAGIC)
             out.write((_json.dumps({
-                "objectno": objectno,
-                "len": None if data is None else len(data),
+                "from_snap": args.from_snap, "to_snap": args.snap,
+                "size": to_size, "object_size": img.object_size,
             }) + "\n").encode())
-            if data is not None:
-                out.write(data)
-            records += 1
-        out.write(b'{"end": true}\n')
-        if out is not sys.stdout.buffer:
-            out.close()
+            records = 0
+            async for objectno, data in img.export_diff(
+                args.from_snap, args.snap
+            ):
+                out.write((_json.dumps({
+                    "objectno": objectno,
+                    "len": None if data is None else len(data),
+                }) + "\n").encode())
+                if data is not None:
+                    out.write(data)
+                records += 1
+            out.write(b'{"end": true}\n')
+        finally:
+            if out is not sys.stdout.buffer:
+                out.close()  # flushed even on error: no silent partials
         print(f"exported {records} changed object(s)", file=sys.stderr)
     finally:
         await img.close()
@@ -280,15 +282,25 @@ async def _cmd_import_diff(rbd, io, args) -> int:
                 return 1
             if img.size_bytes != hdr["size"]:
                 await img.resize(hdr["size"])
-            while True:
-                rec = _json.loads(src.readline())
-                if rec.get("end"):
-                    break
-                data = (
-                    src.read(rec["len"]) if rec["len"] is not None
-                    else None
-                )
-                await img.apply_diff_record(rec["objectno"], data)
+            try:
+                while True:
+                    rec = _json.loads(src.readline())
+                    if rec.get("end"):
+                        break
+                    data = None
+                    if rec["len"] is not None:
+                        data = src.read(rec["len"])
+                        if len(data) != rec["len"]:
+                            raise ValueError("short record")
+                    await img.apply_diff_record(rec["objectno"], data)
+            except (ValueError, KeyError) as e:
+                # truncated/corrupt stream: a clean error, and NO
+                # to-snap — a retry after a fresh export re-applies
+                # over the partial state (records are idempotent)
+                print(f"error: corrupt diff stream ({e}); image "
+                      "partially imported, to-snap NOT created",
+                      file=sys.stderr)
+                return 1
             if hdr["to_snap"]:
                 await img.snap_create(hdr["to_snap"])
         finally:

@@ -410,6 +410,19 @@ def test_export_diff_import_diff_chain(tmp_path):
             finally:
                 await dst.close()
 
+            # a TRUNCATED stream is a clean error with no to-snap
+            blob = open(full, "rb").read()
+            trunc = str(tmp_path / "trunc.diff")
+            open(trunc, "wb").write(blob[: len(blob) // 2])
+            assert await run("create", "dstt", "--size", str(size),
+                             "--order", "20") == 0
+            assert await run("import-diff", trunc, "dstt") == 1
+            dstt = await Image.open(io, "dstt")
+            try:
+                assert dstt.snaps == {}
+            finally:
+                await dstt.close()
+
             # a different destination order is rejected, not corrupted
             assert await run("create", "dst22", "--size", str(size)) == 0
             assert await run("import-diff", full, "dst22") == 1

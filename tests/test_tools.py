@@ -319,3 +319,36 @@ def test_ceph_osd_map(capsys):
             assert rc == 1
 
     asyncio.run(main())
+
+
+def test_rados_cppool(capsys):
+    """`rados cppool` copies data + xattrs + omap between pools
+    (reference:rados.cc do_copy_pool)."""
+    import asyncio
+
+    from ceph_tpu.rados import MiniCluster
+    from ceph_tpu.tools import rados_cli
+
+    async def main():
+        async with MiniCluster(n_osds=3) as cluster:
+            mon = cluster.mon.addr
+            cl = await cluster.client()
+            await cl.create_pool("a", "replicated")
+            await cl.create_pool("b", "replicated")
+            io = cl.io_ctx("a")
+            await io.write_full("o1", b"one")
+            await io.write_full("o2", b"two")
+            await io.setxattr("o1", "k", b"v")
+            await io.omap_set("o2", {"mk": b"mv"})
+            loop = asyncio.get_running_loop()
+            rc = await loop.run_in_executor(
+                None, rados_cli.main, ["-m", mon, "cppool", "a", "b"]
+            )
+            assert rc == 0
+            assert "copied 2 object(s)" in capsys.readouterr().out
+            dio = cl.io_ctx("b")
+            assert await dio.read("o1") == b"one"
+            assert await dio.getxattr("o1", "k") == b"v"
+            assert (await dio.omap_get("o2"))["mk"] == b"mv"
+
+    asyncio.run(main())
