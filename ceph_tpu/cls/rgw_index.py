@@ -70,8 +70,16 @@ def init(ctx: MethodContext, input: dict) -> dict:
     return {}
 
 
+EDQUOT = 122
+
+
 @cls.method("put", CLS_METHOD_RD | CLS_METHOD_WR)
 def put(ctx: MethodContext, input: dict) -> dict:
+    """Upsert + stats delta; optional ``quota`` {max_objects,
+    max_bytes} is checked against the UPDATED header in the same
+    atomic op (the whole point of the in-OSD class: the reference's
+    bucket quota rides cls_rgw the same way, and a client-side check
+    would race concurrent writers past the cap)."""
     key = input.get("key")
     entry = input.get("entry")
     if not key or not isinstance(entry, dict):
@@ -84,6 +92,15 @@ def put(ctx: MethodContext, input: dict) -> dict:
         hdr["bytes"] -= json.loads(old).get("size", 0)
     hdr["entries"] += 1
     hdr["bytes"] += int(entry.get("size", 0))
+    quota = input.get("quota") or {}
+    max_objects = int(quota.get("max_objects") or 0)
+    max_bytes = int(quota.get("max_bytes") or 0)
+    if (max_objects and hdr["entries"] > max_objects) or (
+        max_bytes and hdr["bytes"] > max_bytes
+    ):
+        # overwrites that SHRINK usage still pass (delta already
+        # folded into hdr); only net growth past the cap rejects
+        raise ClsError(EDQUOT, "bucket quota exceeded")
     _put_header(ctx, hdr)
     ctx.omap_set({okey: json.dumps(entry).encode()})
     return {"header": hdr}
@@ -141,6 +158,26 @@ def list_(ctx: MethodContext, input: dict) -> dict:
     }
 
 
+@cls.method("quota_check", CLS_METHOD_RD)
+def quota_check(ctx: MethodContext, input: dict) -> dict:
+    """Pre-flight: would applying (delta_entries, delta_bytes) exceed
+    the quota?  Read-only — the gateway runs this BEFORE touching the
+    data object so an overwrite never destroys existing bytes only to
+    be refused (the atomic check inside ``put`` remains the
+    authoritative backstop for creates, where cleanup is safe)."""
+    quota = input.get("quota") or {}
+    max_objects = int(quota.get("max_objects") or 0)
+    max_bytes = int(quota.get("max_bytes") or 0)
+    hdr = _header(ctx)
+    entries = hdr["entries"] + int(input.get("delta_entries") or 0)
+    nbytes = hdr["bytes"] + int(input.get("delta_bytes") or 0)
+    if (max_objects and entries > max_objects) or (
+        max_bytes and nbytes > max_bytes
+    ):
+        raise ClsError(EDQUOT, "bucket quota exceeded")
+    return {"header": hdr}
+
+
 @cls.method("set_acl", CLS_METHOD_RD | CLS_METHOD_WR)
 def set_acl(ctx: MethodContext, input: dict) -> dict:
     """Atomic acl update on one index entry: the RMW runs under the PG
@@ -159,6 +196,29 @@ def set_acl(ctx: MethodContext, input: dict) -> dict:
     entry["acl"] = acl
     ctx.omap_set({okey: json.dumps(entry).encode()})
     return {"entry": entry}
+
+
+@cls.method("bucket_set_quota", CLS_METHOD_RD | CLS_METHOD_WR)
+def bucket_set_quota(ctx: MethodContext, input: dict) -> dict:
+    """Atomic quota update on a bucket record (meta pool's buckets
+    object) — reference:radosgw-admin quota set --bucket."""
+    bucket = input.get("bucket")
+    if not bucket:
+        raise ClsError(EINVAL, "rgw.bucket_set_quota: need bucket")
+    try:
+        max_objects = int(input.get("max_objects") or 0)
+        max_bytes = int(input.get("max_bytes") or 0)
+    except (TypeError, ValueError):
+        raise ClsError(EINVAL, "quota values must be integers") from None
+    if max_objects < 0 or max_bytes < 0:
+        raise ClsError(EINVAL, "quota values must be >= 0 (0 clears)")
+    raw = ctx.omap_get_keys([bucket]).get(bucket)
+    if raw is None:
+        raise ClsError(ENOENT, f"no bucket {bucket!r}")
+    rec = json.loads(raw)
+    rec["quota"] = {"max_objects": max_objects, "max_bytes": max_bytes}
+    ctx.omap_set({bucket: json.dumps(rec).encode()})
+    return {"bucket": rec}
 
 
 @cls.method("bucket_set_acl", CLS_METHOD_RD | CLS_METHOD_WR)

@@ -47,7 +47,8 @@ _STATUS = {
     409: "Conflict",
 }
 
-_ERRNO_HTTP = {2: 404, 17: 409, 39: 409, 13: 403, 22: 400}
+_ERRNO_HTTP = {2: 404, 17: 409, 39: 409, 13: 403, 22: 400,
+               122: 403}  # EDQUOT -> QuotaExceeded (403, like S3)
 
 # Subresources that are part of the canonical resource string in AWS sig v2
 # (the subset this gateway implements).  "acl" MUST be here (it is in

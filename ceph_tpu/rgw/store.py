@@ -176,11 +176,23 @@ class RGWStore:
     # -- in-OSD index ops (reference:src/cls/rgw — the bucket index is
     # mutated by class methods so the stats header stays atomic with the
     # entries; ceph_tpu.cls.rgw_index) --------------------------------------
-    async def _index_put(self, bucket: str, key: str, entry: dict) -> None:
-        await self.index.exec(
-            self._index_obj(bucket), "rgw", "put",
-            {"key": key, "entry": entry},
-        )
+    async def _index_put(
+        self, bucket: str, key: str, entry: dict,
+        quota: dict | None = None,
+    ) -> None:
+        inp: dict = {"key": key, "entry": entry}
+        if quota and (quota.get("max_objects") or quota.get("max_bytes")):
+            inp["quota"] = quota
+        try:
+            await self.index.exec(
+                self._index_obj(bucket), "rgw", "put", inp
+            )
+        except RadosError as e:
+            if e.code == -122:  # EDQUOT from the atomic quota check
+                raise RGWError(
+                    -122, f"bucket {bucket!r} quota exceeded"
+                ) from None
+            raise
 
     async def _index_rm(self, bucket: str, key: str) -> None:
         try:
@@ -190,6 +202,40 @@ class RGWStore:
         except RadosError as e:
             if e.code != -ENOENT:
                 raise
+
+    async def _quota_preflight(
+        self, bucket: str, quota: dict, *,
+        delta_entries: int, delta_bytes: int,
+    ) -> None:
+        try:
+            await self.index.exec(
+                self._index_obj(bucket), "rgw", "quota_check",
+                {"quota": quota, "delta_entries": delta_entries,
+                 "delta_bytes": delta_bytes},
+            )
+        except RadosError as e:
+            if e.code == -122:
+                raise RGWError(
+                    -122, f"bucket {bucket!r} quota exceeded"
+                ) from None
+            if e.code != -ENOENT:  # fresh bucket: empty index object
+                raise
+
+    async def set_bucket_quota(
+        self, bucket: str, max_objects: int = 0, max_bytes: int = 0
+    ) -> None:
+        """radosgw-admin quota set --bucket analog; 0 clears.  The
+        update is an in-OSD class op, atomic under the PG lock."""
+        try:
+            await self.meta.exec(
+                BUCKETS_OBJ, "rgw", "bucket_set_quota",
+                {"bucket": bucket, "max_objects": int(max_objects),
+                 "max_bytes": int(max_bytes)},
+            )
+        except RadosError as e:
+            if e.code == -ENOENT:
+                raise RGWError(-ENOENT, f"no bucket {bucket!r}") from None
+            raise
 
     async def _index_stats(self, bucket: str) -> dict:
         try:
@@ -310,12 +356,24 @@ class RGWStore:
         acl: str = "private",
         meta: dict | None = None,
     ) -> dict:
-        await self.bucket_info(bucket)
+        info = await self.bucket_info(bucket)
         if not key:
             raise RGWError(-EINVAL, "empty object key")
         _check_acl(acl)
         sobj = self._data_obj(bucket, key)
         old = await self._index_entry(bucket, key)
+        quota = info.get("quota")
+        if quota and (quota.get("max_objects") or quota.get("max_bytes")):
+            # pre-flight BEFORE any data mutation: an overwrite must
+            # never destroy the old bytes only to be refused.  The
+            # atomic in-put check backstops creates (safe cleanup);
+            # overwrite races past the cap are bounded by one object,
+            # like the reference's (far looser, async) quota accounting
+            await self._quota_preflight(
+                bucket, quota,
+                delta_entries=0 if old is not None else 1,
+                delta_bytes=len(data) - (old or {}).get("size", 0),
+            )
         if old is not None:
             await sobj.remove()  # overwrite drops the old extents
         await sobj.write(data, 0)
@@ -330,7 +388,15 @@ class RGWStore:
             # user metadata (x-amz-meta-*, reference:rgw_op.cc
             # rgw_get_request_metadata -> RGW_ATTR_META_PREFIX attrs)
             entry["meta"] = {str(k): str(v) for k, v in meta.items()}
-        await self._index_put(bucket, key, entry)
+        try:
+            await self._index_put(
+                bucket, key, entry,
+                quota=quota if old is None else None,
+            )
+        except RGWError as e:
+            if e.code == -122 and old is None:
+                await sobj.remove()  # lost the create race: no orphan
+            raise
         await self._log_change("put", bucket, key)
         return entry
 
@@ -476,6 +542,22 @@ class RGWStore:
         (standard S3 client behavior) must not lose each other in a
         read-modify-write of shared metadata."""
         await self._upload_meta(bucket, key, upload)
+        quota = (await self.bucket_info(bucket)).get("quota") or {}
+        if quota.get("max_bytes"):
+            # a byte-capped bucket must not accumulate unbounded PART
+            # data either (review r5: the cap was only evaluated at
+            # complete).  Pending parts are not in the index header, so
+            # fold this upload's existing parts into the delta —
+            # approximate under concurrent uploads, like the
+            # reference's async quota accounting
+            pending = sum(
+                p["size"] for p in
+                (await self._upload_parts(bucket, key, upload)).values()
+            )
+            await self._quota_preflight(
+                bucket, quota, delta_entries=0,
+                delta_bytes=pending + len(data),
+            )
         sobj = StripedObject(
             self.data, self._part_name(bucket, key, upload, part_num)
         )
@@ -530,21 +612,28 @@ class RGWStore:
         parts = await self._upload_parts(bucket, key, upload)
         if not parts:
             raise RGWError(-EINVAL, "no parts uploaded")
-        md5s = hashlib.md5()
-        total = 0
-        final = self._data_obj(bucket, key)
+        info = await self.bucket_info(bucket)
+        quota = info.get("quota")
         old = await self._index_entry(bucket, key)
-        if old is not None:
-            await final.remove()
-        for n in sorted(parts):
-            part = StripedObject(
-                self.data, self._part_name(bucket, key, upload, n)
+        if quota and (quota.get("max_objects") or quota.get("max_bytes")):
+            # before assembling over the destination object
+            await self._quota_preflight(
+                bucket, quota,
+                delta_entries=0 if old is not None else 1,
+                delta_bytes=sum(p["size"] for p in parts.values())
+                - (old or {}).get("size", 0),
             )
-            data = await part.read()
-            await final.write(data, total)
-            total += len(data)
+        # the atomic quota gate (create path) runs BEFORE any
+        # destination or part mutation: an EDQUOT lost-race here leaves
+        # parts and destination intact for a retry (review r5 finding —
+        # gating after assembly destroyed the upload).  The entry is
+        # indexed first, then the data assembles: the brief
+        # entry-before-data window reads short, like a crashed
+        # completion, and check_index covers the crash case
+        total = sum(parts[n]["size"] for n in parts)
+        md5s = hashlib.md5()
+        for n in sorted(parts):
             md5s.update(bytes.fromhex(parts[n]["etag"]))
-            await part.remove()
         etag = f"{md5s.hexdigest()}-{len(parts)}"
         entry = {
             "size": total, "etag": etag, "mtime": _now(),
@@ -555,7 +644,21 @@ class RGWStore:
         }
         if meta.get("meta"):
             entry["meta"] = meta["meta"]
-        await self._index_put(bucket, key, entry)
+        await self._index_put(
+            bucket, key, entry, quota=quota if old is None else None
+        )
+        final = self._data_obj(bucket, key)
+        if old is not None:
+            await final.remove()
+        off = 0
+        for n in sorted(parts):
+            part = StripedObject(
+                self.data, self._part_name(bucket, key, upload, n)
+            )
+            data = await part.read()
+            await final.write(data, off)
+            off += len(data)
+            await part.remove()
         await self.index.omap_rmkeys(
             self._index_obj(bucket),
             [self._upload_key(key, upload)]

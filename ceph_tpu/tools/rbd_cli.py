@@ -293,7 +293,7 @@ async def _cmd_import_diff(rbd, io, args) -> int:
                         if len(data) != rec["len"]:
                             raise ValueError("short record")
                     await img.apply_diff_record(rec["objectno"], data)
-            except (ValueError, KeyError) as e:
+            except (ValueError, KeyError, AttributeError, TypeError) as e:
                 # truncated/corrupt stream: a clean error, and NO
                 # to-snap — a retry after a fresh export re-applies
                 # over the partial state (records are idempotent)

@@ -46,6 +46,31 @@ async def _cmd_bucket(store: RGWStore, args) -> int:
     return 0
 
 
+async def _cmd_quota(store: RGWStore, args) -> int:
+    """`rgw_admin quota set|get --bucket B [--max-objects N]
+    [--max-size BYTES]` (reference:radosgw-admin quota set)."""
+    if args.sub == "set":
+        # unspecified flags PRESERVE the existing cap (the reference
+        # keeps unmentioned quota fields); pass an explicit 0 to clear
+        cur = (await store.bucket_info(args.bucket)).get("quota", {})
+        await store.set_bucket_quota(
+            args.bucket,
+            max_objects=(cur.get("max_objects", 0)
+                         if args.max_objects is None
+                         else args.max_objects),
+            max_bytes=(cur.get("max_bytes", 0)
+                       if args.max_size is None else args.max_size),
+        )
+        print(f"quota set on bucket {args.bucket!r}")
+    else:
+        info = await store.bucket_info(args.bucket)
+        print(json.dumps(
+            info.get("quota", {"max_objects": 0, "max_bytes": 0}),
+            indent=1,
+        ))
+    return 0
+
+
 async def _cmd_serve(store: RGWStore, args) -> int:
     server = S3Server(store)
     addr = await server.start(args.host, args.port)
@@ -72,6 +97,11 @@ def main(argv=None) -> int:
     b.add_argument("sub", choices=["ls", "stats"])
     b.add_argument("--uid", default=None)
     b.add_argument("--bucket")
+    q = sub.add_parser("quota")
+    q.add_argument("sub", choices=["set", "get"])
+    q.add_argument("--bucket", required=True)
+    q.add_argument("--max-objects", type=int, default=None)
+    q.add_argument("--max-size", type=int, default=None)
     s = sub.add_parser("serve")
     s.add_argument("--host", default="127.0.0.1")
     s.add_argument("--port", type=int, default=0)
@@ -86,7 +116,7 @@ def main(argv=None) -> int:
         try:
             store = await RGWStore.create(client)
             fn = {"user": _cmd_user, "bucket": _cmd_bucket,
-                  "serve": _cmd_serve}[args.cmd]
+                  "quota": _cmd_quota, "serve": _cmd_serve}[args.cmd]
             return await fn(store, args)
         except RadosError as e:
             print(f"error: {e}", file=sys.stderr)

@@ -8,8 +8,10 @@ mutation commits under the PG lock with its stats header.
 
 import asyncio
 
+import pytest
+
 from ceph_tpu.rados import MiniCluster, RadosError
-from ceph_tpu.rgw.store import RGWStore
+from ceph_tpu.rgw.store import RGWError, RGWStore
 
 
 def run(coro):
@@ -241,5 +243,95 @@ class TestRgwIndexClass:
                 await store.complete_multipart("b", "big", upload)
                 st = await store.bucket_stats("b")
                 assert st["num_objects"] == 1 and st["size_bytes"] == 256
+
+        run(main())
+
+
+class TestBucketQuota:
+    def test_quota_blocks_growth_atomically(self):
+        """radosgw-admin quota set analog: the cap is enforced in the
+        in-OSD index op (no client-side race window on creates);
+        deletes free space; shrinking overwrites pass; the HTTP
+        surface answers 403."""
+
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                store = await _store(cluster)
+                user = await store.create_user("u", "D")
+                await store.create_bucket("b", "u")
+                await store.set_bucket_quota("b", max_objects=2)
+                await store.put_object("b", "o1", b"x" * 100)
+                await store.put_object("b", "o2", b"y" * 100)
+                with pytest.raises(RGWError) as ei:
+                    await store.put_object("b", "o3", b"z")
+                assert ei.value.code == -122
+                # overwrite of an existing key is not growth
+                await store.put_object("b", "o1", b"x" * 50)
+                # delete frees a slot
+                await store.delete_object("b", "o2")
+                await store.put_object("b", "o3", b"z")
+                # byte quota: shrinking overwrite passes, growth fails
+                await store.set_bucket_quota("b", max_bytes=100)
+                await store.put_object("b", "o1", b"s" * 10)
+                with pytest.raises(RGWError) as ei:
+                    await store.put_object("b", "o1", b"G" * 4096)
+                assert ei.value.code == -122
+                # 0 clears
+                await store.set_bucket_quota("b")
+                await store.put_object("b", "o1", b"G" * 4096)
+                # quota on a missing bucket is a clean error
+                with pytest.raises(RGWError):
+                    await store.set_bucket_quota("nope", max_objects=1)
+
+        run(main())
+
+    def test_quota_over_http_is_403(self):
+        async def main():
+            from tests.test_rgw import _http
+
+            async with MiniCluster(n_osds=3) as cluster:
+                store = await _store(cluster)
+                user = await store.create_user("alice")
+                await store.create_bucket("b", "alice")
+                await store.set_bucket_quota("b", max_objects=1)
+                from ceph_tpu.rgw.http import S3Server
+
+                srv = S3Server(store)
+                addr = await srv.start()
+                try:
+                    st, _, _ = await _http(addr, "PUT", "/b/one",
+                                           body=b"1", creds=user)
+                    assert st == 200
+                    st, _, payload = await _http(addr, "PUT", "/b/two",
+                                                 body=b"2", creds=user)
+                    assert st == 403
+                    assert b"quota" in payload
+                finally:
+                    await srv.stop()
+
+        run(main())
+
+    def test_byte_quota_bounds_multipart_parts(self):
+        """A byte-capped bucket rejects part uploads past the cap
+        (review r5: the cap was only evaluated at complete), and an
+        EDQUOT completion race leaves parts intact for retry."""
+
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                store = await _store(cluster)
+                await store.create_user("u", "D")
+                await store.create_bucket("b", "u")
+                await store.set_bucket_quota("b", max_bytes=8192)
+                up = await store.init_multipart("b", "big")
+                await store.upload_part("b", "big", up, 1, b"P" * 4096)
+                with pytest.raises(RGWError) as ei:
+                    await store.upload_part("b", "big", up, 2,
+                                            b"Q" * 8192)
+                assert ei.value.code == -122
+                # a fitting completion still works, quota-checked
+                out = await store.complete_multipart("b", "big", up)
+                assert out["size"] == 4096
+                data, _e = await store.get_object("b", "big")
+                assert data == b"P" * 4096
 
         run(main())
