@@ -1320,6 +1320,7 @@ class Monitor(Dispatcher):
                 "osd pool create": self._cmd_pool_create,
                 "osd pool ls": self._cmd_pool_ls,
                 "osd pool rm": self._cmd_pool_rm,
+                "osd pool rename": self._cmd_pool_rename,
                 "osd pool set": self._cmd_pool_set,
                 "osd pool get": self._cmd_pool_get,
                 "osd pool set-quota": self._cmd_pool_set_quota,
@@ -1526,7 +1527,54 @@ class Monitor(Dispatcher):
         return 0, "", {"pool_id": pool.id}
 
     def _cmd_pool_ls(self, cmd: dict) -> tuple[int, str, Any]:
-        return 0, "", sorted(p.name for p in self.osdmap.pools.values())
+        if not cmd.get("detail"):
+            return 0, "", sorted(
+                p.name for p in self.osdmap.pools.values()
+            )
+        # `ceph osd pool ls detail` (reference:OSDMonitor): per-pool
+        # settings, flags and quotas
+        from ..osd.osdmap import POOL_TYPE_ERASURE
+
+        out = []
+        for pid in sorted(self.osdmap.pools):
+            p = self.osdmap.pools[pid]
+            row = {
+                "pool_id": pid, "pool_name": p.name,
+                "type": ("erasure" if p.type == POOL_TYPE_ERASURE
+                         else "replicated"),
+                "size": p.size, "min_size": p.min_size,
+                "pg_num": p.pg_num,
+                "crush_rule": p.crush_ruleset,
+                "quota_max_objects": p.quota_max_objects,
+                "quota_max_bytes": p.quota_max_bytes,
+                "flags": ([
+                    "full_quota"
+                ] if p.flags & FLAG_FULL_QUOTA else []),
+            }
+            if p.type == POOL_TYPE_ERASURE:
+                row["erasure_code_profile"] = p.erasure_code_profile
+            if p.tier_of >= 0:
+                row["tier_of"] = p.tier_of
+                row["cache_mode"] = p.cache_mode
+            out.append(row)
+        return 0, "", out
+
+    def _cmd_pool_rename(self, cmd: dict) -> tuple[int, str, Any]:
+        """``ceph osd pool rename <src> <dst>``
+        (reference:OSDMonitor 'osd pool rename')."""
+        pool = self.osdmap.lookup_pool(cmd.get("srcpool", ""))
+        if pool is None:
+            return -ENOENT, f"no pool {cmd.get('srcpool')!r}", None
+        dst = str(cmd.get("destpool", ""))
+        if not dst or "/" in dst:
+            return -EINVAL, f"bad pool name {dst!r}", None
+        if self.osdmap.lookup_pool(dst) is not None:
+            return -EEXIST, f"pool {dst!r} exists", None
+        del self.osdmap.pool_name[pool.name]
+        pool.name = dst
+        self.osdmap.pool_name[dst] = pool.id
+        self._mark_dirty()
+        return 0, f"pool renamed to {dst}", None
 
     def _cmd_pool_rm(self, cmd: dict) -> tuple[int, str, Any]:
         pool = self.osdmap.lookup_pool(cmd["pool"])

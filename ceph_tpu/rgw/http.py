@@ -284,7 +284,7 @@ class S3Server:
                 return await self._svc(method, user)
             if not key:
                 return await self._bucket(method, user, bucket, q,
-                                          headers)
+                                          headers, body)
             return await self._object(
                 method, user, bucket, key, q, body, headers
             )
@@ -327,8 +327,39 @@ class S3Server:
 
     async def _bucket(
         self, method: str, user: dict | None, bucket: str, q: dict,
-        headers: dict | None = None,
+        headers: dict | None = None, body: bytes = b"",
     ):
+        if method == "POST" and "delete" in q:
+            # bulk delete (S3 DeleteObjects): body {"objects": [keys]};
+            # per-key results, like the reference's multi-delete —
+            # missing keys report deleted (S3 semantics)
+            await self._check_owner(user, bucket)
+            try:
+                parsed = json.loads(body or b"{}")
+            except json.JSONDecodeError:
+                return 400, *self._json({"error": "bad delete body"})
+            if not isinstance(parsed, dict):
+                # valid-JSON scalars/lists must be the same clean 400,
+                # not an AttributeError traceback (review r5 finding)
+                return 400, *self._json({"error": "bad delete body"})
+            keys = parsed.get("objects") or []
+            if not isinstance(keys, list) or len(keys) > 1000:
+                return 400, *self._json(
+                    {"error": "objects must be a list of <= 1000 keys"}
+                )
+            deleted, errors = [], []
+            for k in keys:
+                try:
+                    await self.store.delete_object(bucket, str(k))
+                    deleted.append(str(k))
+                except RGWError as e:
+                    if e.code == -2:
+                        deleted.append(str(k))  # already gone: S3 says ok
+                    else:
+                        errors.append({"key": str(k), "error": str(e)})
+            return 200, *self._json(
+                {"deleted": deleted, "errors": errors}
+            )
         if method == "PUT" and "acl" in q:
             await self._check_owner(user, bucket)
             await self.store.set_bucket_acl(bucket, q.get("acl") or "")
@@ -347,6 +378,16 @@ class S3Server:
                 acl=(headers or {}).get("x-amz-acl", "private"),
             )
             return 200, *self._json({"bucket": bucket})
+        if method == "HEAD":
+            # bucket existence/access probe (S3 HeadBucket): mirrors
+            # the GET branch — owner or public-read bucket (boto-style
+            # head_bucket probes must agree with the reads that follow,
+            # review r5 finding); 404 when absent
+            info = await self.store.bucket_info(bucket)
+            if (user is None or info["owner"] != user["uid"]) and \
+                    info.get("acl", "private") != "public-read":
+                raise RGWError(-13, "access denied")
+            return 200, {}, b""
         if method == "DELETE":
             await self._check_owner(user, bucket)
             await self.store.delete_bucket(bucket)

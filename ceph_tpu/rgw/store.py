@@ -529,6 +529,11 @@ class RGWStore:
             # metadata supplied at CreateMultipartUpload rides the
             # upload record into the completed entry, like real S3
             rec["meta"] = {str(k): str(v) for k, v in meta.items()}
+        info = await self.bucket_info(bucket)
+        if info.get("quota"):
+            # snapshot for the per-part preflight: saves a BUCKETS_OBJ
+            # read per part; complete_multipart re-reads the live quota
+            rec["quota"] = info["quota"]
         await self.index.omap_set(
             self._index_obj(bucket),
             {self._upload_key(key, upload): json.dumps(rec).encode()},
@@ -541,22 +546,26 @@ class RGWStore:
         """Each part is its OWN index key — concurrent part uploads
         (standard S3 client behavior) must not lose each other in a
         read-modify-write of shared metadata."""
-        await self._upload_meta(bucket, key, upload)
-        quota = (await self.bucket_info(bucket)).get("quota") or {}
+        umeta = await self._upload_meta(bucket, key, upload)
+        quota = umeta.get("quota") or {}
         if quota.get("max_bytes"):
             # a byte-capped bucket must not accumulate unbounded PART
-            # data either (review r5: the cap was only evaluated at
-            # complete).  Pending parts are not in the index header, so
-            # fold this upload's existing parts into the delta —
-            # approximate under concurrent uploads, like the
-            # reference's async quota accounting
-            pending = sum(
-                p["size"] for p in
-                (await self._upload_parts(bucket, key, upload)).values()
+            # data (review r5: the cap was only evaluated at complete).
+            # O(1): credit a re-uploaded part's old size and the
+            # destination object being replaced — under-enforcement is
+            # safe here because complete_multipart's gate is the
+            # authoritative one; over-strictness would reject valid
+            # part retries and replacements (review r5)
+            pkey = self._part_key(key, upload, part_num)
+            got = await self.index.omap_get_keys(
+                self._index_obj(bucket), [pkey]
             )
+            old_part = json.loads(got[pkey])["size"] if pkey in got else 0
+            old_entry = await self._index_entry(bucket, key)
             await self._quota_preflight(
                 bucket, quota, delta_entries=0,
-                delta_bytes=pending + len(data),
+                delta_bytes=len(data) - old_part
+                - (old_entry or {}).get("size", 0),
             )
         sobj = StripedObject(
             self.data, self._part_name(bucket, key, upload, part_num)
@@ -623,13 +632,13 @@ class RGWStore:
                 delta_bytes=sum(p["size"] for p in parts.values())
                 - (old or {}).get("size", 0),
             )
-        # the atomic quota gate (create path) runs BEFORE any
-        # destination or part mutation: an EDQUOT lost-race here leaves
-        # parts and destination intact for a retry (review r5 finding —
-        # gating after assembly destroyed the upload).  The entry is
-        # indexed first, then the data assembles: the brief
-        # entry-before-data window reads short, like a crashed
-        # completion, and check_index covers the crash case
+        # data assembles BEFORE the index entry publishes (readers of
+        # an overwritten object keep a consistent view), and part
+        # objects are removed only after the index accepts — an EDQUOT
+        # lost-race on the create path removes the freshly built final
+        # and leaves every part intact for a retry (review r5: an
+        # earlier ordering destroyed the upload on that race, and a
+        # publish-first ordering broke concurrent readers)
         total = sum(parts[n]["size"] for n in parts)
         md5s = hashlib.md5()
         for n in sorted(parts):
@@ -644,9 +653,6 @@ class RGWStore:
         }
         if meta.get("meta"):
             entry["meta"] = meta["meta"]
-        await self._index_put(
-            bucket, key, entry, quota=quota if old is None else None
-        )
         final = self._data_obj(bucket, key)
         if old is not None:
             await final.remove()
@@ -658,7 +664,18 @@ class RGWStore:
             data = await part.read()
             await final.write(data, off)
             off += len(data)
-            await part.remove()
+        try:
+            await self._index_put(
+                bucket, key, entry, quota=quota if old is None else None
+            )
+        except RGWError as e:
+            if e.code == -122 and old is None:
+                await final.remove()  # parts survive for the retry
+            raise
+        for n in sorted(parts):
+            await StripedObject(
+                self.data, self._part_name(bucket, key, upload, n)
+            ).remove()
         await self.index.omap_rmkeys(
             self._index_obj(bucket),
             [self._upload_key(key, upload)]

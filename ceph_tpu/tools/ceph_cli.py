@@ -161,6 +161,13 @@ def main(argv=None) -> int:
     if words[:2] == ["osd", "map"] and len(words) == 4:
         extra["object"] = words.pop()
         extra["pool"] = words.pop()
+    # `ceph osd pool ls detail` / `ceph osd pool rename <src> <dst>`
+    if words == ["osd", "pool", "ls", "detail"]:
+        extra["detail"] = True
+        words = words[:3]
+    if words[:3] == ["osd", "pool", "rename"] and len(words) == 5:
+        extra["destpool"] = words.pop()
+        extra["srcpool"] = words.pop()
     # `ceph osd pool set-quota <pool> max_objects|max_bytes <n>` and
     # `ceph osd pool get-quota <pool>` (reference CLI shapes)
     if words[:3] == ["osd", "pool", "set-quota"] and len(words) == 6:

@@ -324,12 +324,27 @@ class TestBucketQuota:
                 await store.set_bucket_quota("b", max_bytes=8192)
                 up = await store.init_multipart("b", "big")
                 await store.upload_part("b", "big", up, 1, b"P" * 4096)
+                # a single part larger than the whole cap rejects at
+                # upload time (O(1) per-part gate)
                 with pytest.raises(RGWError) as ei:
-                    await store.upload_part("b", "big", up, 2,
-                                            b"Q" * 8192)
+                    await store.upload_part("b", "big", up, 9,
+                                            b"X" * 16384)
                 assert ei.value.code == -122
-                # a fitting completion still works, quota-checked
-                out = await store.complete_multipart("b", "big", up)
+                # a part RETRY is not growth (review r5: the first cut
+                # double-counted it and rejected legitimate retries)
+                await store.upload_part("b", "big", up, 1, b"P" * 4096)
+                # parts that individually fit but TOTAL over the cap
+                # reject at complete — the authoritative gate — with
+                # every part left intact for abort/retry
+                await store.upload_part("b", "big", up, 2, b"Q" * 8192)
+                with pytest.raises(RGWError) as ei:
+                    await store.complete_multipart("b", "big", up)
+                assert ei.value.code == -122
+                await store.abort_multipart("b", "big", up)
+                # a fitting upload completes, quota-checked
+                up2 = await store.init_multipart("b", "big")
+                await store.upload_part("b", "big", up2, 1, b"P" * 4096)
+                out = await store.complete_multipart("b", "big", up2)
                 assert out["size"] == 4096
                 data, _e = await store.get_object("b", "big")
                 assert data == b"P" * 4096

@@ -741,3 +741,55 @@ class TestUserMetadata:
                     await srv.stop()
 
         run(main())
+
+
+class TestBulkDeleteHeadBucket:
+    def test_multi_delete_and_head_bucket(self):
+        """POST /bucket?delete (S3 DeleteObjects) + HEAD bucket."""
+
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                s = await _store(cluster)
+                user = await s.create_user("alice")
+                other = await s.create_user("bob")
+                from ceph_tpu.rgw.http import S3Server
+
+                srv = S3Server(s)
+                addr = await srv.start()
+                try:
+                    await _http(addr, "PUT", "/b", creds=user)
+                    for k in ("a", "d/e", "f"):
+                        await _http(addr, "PUT", f"/b/{k}", body=b"x",
+                                    creds=user)
+                    st, _, payload = await _http(
+                        addr, "POST", "/b?delete",
+                        body=json.dumps(
+                            {"objects": ["a", "d/e", "ghost"]}
+                        ).encode(),
+                        creds=user,
+                    )
+                    assert st == 200
+                    out = json.loads(payload)
+                    # missing keys report deleted, per S3
+                    assert sorted(out["deleted"]) == ["a", "d/e", "ghost"]
+                    assert out["errors"] == []
+                    listing = await s.list_objects("b")
+                    assert [c["key"] for c in listing["contents"]] == ["f"]
+                    # HEAD bucket: owner 200, other 403, missing 404
+                    st, _, _ = await _http(addr, "HEAD", "/b",
+                                           creds=user)
+                    assert st == 200
+                    st, _, _ = await _http(addr, "HEAD", "/b",
+                                           creds=other)
+                    assert st == 403
+                    st, _, _ = await _http(addr, "HEAD", "/nosuch",
+                                           creds=user)
+                    assert st == 404
+                    # malformed bulk body is a clean 400
+                    st, _, _ = await _http(addr, "POST", "/b?delete",
+                                           body=b"not json", creds=user)
+                    assert st == 400
+                finally:
+                    await srv.stop()
+
+        run(main())
