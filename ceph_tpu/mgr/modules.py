@@ -12,6 +12,17 @@ from .daemon import MgrDaemon, MgrModule
 _SEVERITIES = ("HEALTH_OK", "HEALTH_WARN", "HEALTH_ERR")
 
 
+def _pg_state(pool, acting: list) -> str:
+    """The pg state string both `pg ls` and `pg query` report — one
+    derivation, or the two commands drift (review r5)."""
+    _alive, degraded, below = _pg_redundancy(pool, acting)
+    if below:
+        return "down"
+    if degraded:
+        return "active+undersized+degraded"
+    return "active+clean"
+
+
 def _pg_redundancy(pool, acting: list) -> tuple[int, bool, bool]:
     """(alive, degraded, below_min_size) for one pg's acting set — the
     SINGLE copy of the classification `ceph health` and `ceph pg
@@ -233,10 +244,39 @@ class OsdDfModule(MgrModule):
 
 class PgQueryModule(MgrModule):
     """`ceph pg query` for one pgid: mapping + the primary's latest
-    report (reference:src/mon/PGMap + the OSD's pg query)."""
+    report; `ceph pg ls [state-filter]` lists every pg with its state
+    (reference:src/mon/PGMap + the OSD's pg query)."""
 
     NAME = "pg_query"
-    COMMANDS = {"pg query": "pg_query"}
+    COMMANDS = {"pg query": "pg_query", "pg ls": "pg_ls"}
+
+    def pg_ls(self, mgr: MgrDaemon, cmd: dict) -> tuple[int, str, Any]:
+        m = mgr.osdmap
+        if m is None:
+            return 0, "", {"pgs": []}
+        want = cmd.get("states")  # substring filter, e.g. "degraded"
+        pgsum = mgr.pg_summary()
+        rows = []
+        for pid in sorted(m.pools):
+            pool = m.pools[pid]
+            for pg in m.pgs_of_pool(pid):
+                _u, _upp, acting, ap = m.pg_to_up_acting_osds(pg)
+                _alive, degraded, below = _pg_redundancy(pool, acting)
+                state = "active+clean"
+                if degraded:
+                    state = "active+undersized+degraded"
+                if below:
+                    state = "down"
+                if want and want not in state:
+                    continue
+                pst = pgsum.get(str(pg), {})
+                rows.append({
+                    "pgid": str(pg), "state": state,
+                    "acting": acting, "acting_primary": ap,
+                    "objects": pst.get("objects", 0),
+                    "bytes": pst.get("bytes", 0),
+                })
+        return 0, "", {"pgs": rows}
 
     def pg_query(self, mgr: MgrDaemon, cmd: dict) -> tuple[int, str, Any]:
         m = mgr.osdmap

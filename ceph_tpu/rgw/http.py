@@ -56,7 +56,8 @@ _ERRNO_HTTP = {2: 404, 17: 409, 39: 409, 13: 403, 22: 400,
 # captured signed PUT be replayed with ?acl=public-read appended to
 # flip an object public without a signature for that mutation
 # (review r5 security finding).
-_SIGNED_SUBRESOURCES = ("acl", "uploads", "uploadId", "partNumber")
+_SIGNED_SUBRESOURCES = ("acl", "delete", "uploads", "uploadId",
+                        "partNumber")
 
 
 def string_to_sign(method: str, target: str, headers: dict) -> str:

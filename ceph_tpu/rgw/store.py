@@ -517,6 +517,19 @@ class RGWStore:
     def _part_key(self, key: str, upload: str, n: int) -> str:
         return f"{META_NS}upload.{key}.{upload}.part.{n:05d}"
 
+    def _upload_pending_key(self, key: str, upload: str) -> str:
+        # pending-bytes counter for the per-part quota gate; 'pend'
+        # sorts outside the '.part.' prefix scan
+        return f"{META_NS}upload.{key}.{upload}.pend"
+
+    async def _bucket_rec(self, bucket: str) -> dict:
+        """O(1) keyed read of one bucket record (bucket_info copies the
+        whole BUCKETS_OBJ omap — fine for admin ops, not per-part)."""
+        got = await self.meta.omap_get_keys(BUCKETS_OBJ, [bucket])
+        if bucket not in got:
+            raise RGWError(-ENOENT, f"no bucket {bucket!r}")
+        return json.loads(got[bucket])
+
     async def init_multipart(
         self, bucket: str, key: str, acl: str = "private",
         meta: dict | None = None,
@@ -529,11 +542,6 @@ class RGWStore:
             # metadata supplied at CreateMultipartUpload rides the
             # upload record into the completed entry, like real S3
             rec["meta"] = {str(k): str(v) for k, v in meta.items()}
-        info = await self.bucket_info(bucket)
-        if info.get("quota"):
-            # snapshot for the per-part preflight: saves a BUCKETS_OBJ
-            # read per part; complete_multipart re-reads the live quota
-            rec["quota"] = info["quota"]
         await self.index.omap_set(
             self._index_obj(bucket),
             {self._upload_key(key, upload): json.dumps(rec).encode()},
@@ -546,25 +554,35 @@ class RGWStore:
         """Each part is its OWN index key — concurrent part uploads
         (standard S3 client behavior) must not lose each other in a
         read-modify-write of shared metadata."""
-        umeta = await self._upload_meta(bucket, key, upload)
-        quota = umeta.get("quota") or {}
+        await self._upload_meta(bucket, key, upload)
+        quota = (await self._bucket_rec(bucket)).get("quota") or {}
+        pkey = self._part_key(key, upload, part_num)
+        old_part = 0
         if quota.get("max_bytes"):
             # a byte-capped bucket must not accumulate unbounded PART
             # data (review r5: the cap was only evaluated at complete).
-            # O(1): credit a re-uploaded part's old size and the
-            # destination object being replaced — under-enforcement is
-            # safe here because complete_multipart's gate is the
-            # authoritative one; over-strictness would reject valid
-            # part retries and replacements (review r5)
-            pkey = self._part_key(key, upload, part_num)
+            # O(1) per part: the upload's PENDING total rides an
+            # atomic counter key (numops on the index object), a
+            # re-uploaded part's old size and the destination object
+            # being replaced are credited, and the LIVE quota is read
+            # (a snapshot wrongly rejected parts after the admin
+            # raised the cap — review r5).  Concurrent uploads to
+            # different keys still multiply the bound, like the
+            # reference's approximate quota accounting; complete's
+            # atomic gate is authoritative for the final object
             got = await self.index.omap_get_keys(
-                self._index_obj(bucket), [pkey]
+                self._index_obj(bucket),
+                [pkey, self._upload_pending_key(key, upload)],
             )
-            old_part = json.loads(got[pkey])["size"] if pkey in got else 0
+            old_part = (json.loads(got[pkey])["size"]
+                        if pkey in got else 0)
+            pending = int(
+                got.get(self._upload_pending_key(key, upload), b"0")
+            )
             old_entry = await self._index_entry(bucket, key)
             await self._quota_preflight(
                 bucket, quota, delta_entries=0,
-                delta_bytes=len(data) - old_part
+                delta_bytes=pending + len(data) - old_part
                 - (old_entry or {}).get("size", 0),
             )
         sobj = StripedObject(
@@ -574,10 +592,18 @@ class RGWStore:
         etag = hashlib.md5(data).hexdigest()
         await self.index.omap_set(
             self._index_obj(bucket),
-            {self._part_key(key, upload, part_num): json.dumps(
+            {pkey: json.dumps(
                 {"size": len(data), "etag": etag}
             ).encode()},
         )
+        if quota.get("max_bytes") and len(data) != old_part:
+            # atomic under the PG lock: concurrent parts of the same
+            # upload cannot lose each other's accounting
+            await self.index.exec(
+                self._index_obj(bucket), "numops", "add",
+                {"key": self._upload_pending_key(key, upload),
+                 "value": len(data) - old_part},
+            )
         return {"etag": etag}
 
     async def _upload_parts(
@@ -678,7 +704,8 @@ class RGWStore:
             ).remove()
         await self.index.omap_rmkeys(
             self._index_obj(bucket),
-            [self._upload_key(key, upload)]
+            [self._upload_key(key, upload),
+             self._upload_pending_key(key, upload)]
             + [self._part_key(key, upload, n) for n in parts],
         )
         await self._log_change("put", bucket, key)
@@ -695,7 +722,8 @@ class RGWStore:
             ).remove()
         await self.index.omap_rmkeys(
             self._index_obj(bucket),
-            [self._upload_key(key, upload)]
+            [self._upload_key(key, upload),
+             self._upload_pending_key(key, upload)]
             + [self._part_key(key, upload, n) for n in parts],
         )
 

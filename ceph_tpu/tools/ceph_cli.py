@@ -24,7 +24,7 @@ from ..msg import messages
 from ..rados.client import RadosClient, RadosError
 
 MGR_COMMANDS = {"status", "health", "df", "osd df", "pg dump",
-                "pg query", "metrics", "mgr module ls"}
+                "pg query", "pg ls", "metrics", "mgr module ls"}
 
 
 async def _mgr_command(client: RadosClient, cmd: dict):
@@ -154,9 +154,11 @@ def main(argv=None) -> int:
             words.pop()
         except ValueError:
             pass  # let the mon answer the unknown-command error
-    # `ceph pg query <pgid>` (reference CLI shape)
+    # `ceph pg query <pgid>` / `ceph pg ls [state]` (reference shapes)
     if words[:2] == ["pg", "query"] and len(words) == 3:
         extra["pgid"] = words.pop()
+    if words[:2] == ["pg", "ls"] and len(words) == 3:
+        extra["states"] = words.pop()
     # `ceph osd map <pool> <object>` (reference CLI shape)
     if words[:2] == ["osd", "map"] and len(words) == 4:
         extra["object"] = words.pop()
@@ -220,6 +222,13 @@ def main(argv=None) -> int:
                         c["summary"] for c in out.get("checks", [])
                     )
                     print(out["health"] + (f" {detail}" if detail else ""))
+            elif prefix == "pg ls" and isinstance(out, dict):
+                print(f"{'PG':<8} {'STATE':<28} {'OBJECTS':>8} "
+                      f"{'BYTES':>12} ACTING")
+                for r in out.get("pgs", []):
+                    print(f"{r['pgid']:<8} {r['state']:<28} "
+                          f"{r['objects']:>8} {r['bytes']:>12} "
+                          f"{r['acting']} p{r['acting_primary']}")
             elif prefix == "osd map" and isinstance(out, dict):
                 print(f"osdmap e{out['epoch']} pool '{out['pool']}' "
                       f"({out['pool_id']}) object '{out['objname']}' -> "

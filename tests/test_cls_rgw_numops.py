@@ -333,20 +333,19 @@ class TestBucketQuota:
                 # a part RETRY is not growth (review r5: the first cut
                 # double-counted it and rejected legitimate retries)
                 await store.upload_part("b", "big", up, 1, b"P" * 4096)
-                # parts that individually fit but TOTAL over the cap
-                # reject at complete — the authoritative gate — with
-                # every part left intact for abort/retry
-                await store.upload_part("b", "big", up, 2, b"Q" * 8192)
+                # the PENDING-bytes counter bounds accumulation at
+                # upload time (review r5: without it a byte-capped
+                # bucket accumulated unbounded part data)
                 with pytest.raises(RGWError) as ei:
-                    await store.complete_multipart("b", "big", up)
+                    await store.upload_part("b", "big", up, 2,
+                                            b"Q" * 8192)
                 assert ei.value.code == -122
-                await store.abort_multipart("b", "big", up)
-                # a fitting upload completes, quota-checked
-                up2 = await store.init_multipart("b", "big")
-                await store.upload_part("b", "big", up2, 1, b"P" * 4096)
-                out = await store.complete_multipart("b", "big", up2)
-                assert out["size"] == 4096
+                # a part that fits the remaining headroom passes, and
+                # the whole upload completes under the cap
+                await store.upload_part("b", "big", up, 2, b"Q" * 4096)
+                out = await store.complete_multipart("b", "big", up)
+                assert out["size"] == 8192
                 data, _e = await store.get_object("b", "big")
-                assert data == b"P" * 4096
+                assert data == b"P" * 4096 + b"Q" * 4096
 
         run(main())

@@ -343,6 +343,20 @@ class TestOsdDfPgQuery:
                 )
                 assert rc == 0 and "degraded" in q["state"]
 
+                # pg ls: every pg listed; the degraded filter finds
+                # the storm the kill created; a no-match filter is []
+                rc, ls = await _mgr_command(cl, {"prefix": "pg ls"})
+                assert rc == 0 and len(ls["pgs"]) == 8
+                rc, ls = await _mgr_command(
+                    cl, {"prefix": "pg ls", "states": "degraded"}
+                )
+                assert rc == 0 and len(ls["pgs"]) == 8
+                assert all("degraded" in r["state"] for r in ls["pgs"])
+                rc, ls = await _mgr_command(
+                    cl, {"prefix": "pg ls", "states": "nonsense"}
+                )
+                assert rc == 0 and ls["pgs"] == []
+
                 # bad pgid is a clean error; an out-of-range seed must
                 # NOT fold onto a real pg and answer for the wrong one
                 for bad in ("bogus", "1.ff", "99.0"):
