@@ -261,12 +261,7 @@ class PgQueryModule(MgrModule):
             pool = m.pools[pid]
             for pg in m.pgs_of_pool(pid):
                 _u, _upp, acting, ap = m.pg_to_up_acting_osds(pg)
-                _alive, degraded, below = _pg_redundancy(pool, acting)
-                state = "active+clean"
-                if degraded:
-                    state = "active+undersized+degraded"
-                if below:
-                    state = "down"
+                state = _pg_state(pool, acting)
                 if want and want not in state:
                     continue
                 pst = pgsum.get(str(pg), {})
@@ -298,14 +293,7 @@ class PgQueryModule(MgrModule):
             return -2, f"no pg {pgid}", None
         up, up_primary, acting, acting_primary = m.pg_to_up_acting_osds(pg)
         pst = mgr.pg_summary().get(str(pg), {})
-        _alive, degraded, below = _pg_redundancy(
-            m.pools[pg.pool], acting
-        )
-        state = "active+clean"
-        if degraded:
-            state = "active+undersized+degraded"
-        if below:
-            state = "down"
+        state = _pg_state(m.pools[pg.pool], acting)
         return 0, "", {
             "pgid": str(pg),
             "state": state,
