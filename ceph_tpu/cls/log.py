@@ -110,26 +110,29 @@ def list_(ctx: MethodContext, input: dict) -> dict:
     # keys strictly after start: omap_get_range is exclusive at
     # start_after, so the window's first key needs a just-below cursor
     start_after = start if marker else _just_below(lo)
+    # the truncated flag must mean "more entries IN THE [from, to)
+    # WINDOW", not "more keys under the prefix" (ADVICE r5: keys at or
+    # past `to` made the reply claim truncated=true and the caller's
+    # next page came back empty, so pagination never terminated).
+    # Gather one entry PAST the budget: its existence is the proof.
     entries = []
-    truncated = False
-    while len(entries) < max_entries:
+    while len(entries) <= max_entries:
         page, more = ctx.omap_get_range(
             start_after=start_after, prefix=PREFIX,
-            max_entries=min(1000, max_entries - len(entries)),
+            max_entries=min(1000, max_entries + 1 - len(entries)),
         )
         keys = [k for k in sorted(page) if k < hi]
         for k in keys:
             entries.append({"marker": k, **json.loads(page[k])})
+            if len(entries) > max_entries:
+                break
         if len(keys) < len(page):  # crossed the window's end
-            truncated = False
             break
-        truncated = more
         if not more or not page:
             break
         start_after = max(page)
-    if len(entries) > max_entries:
-        entries = entries[:max_entries]
-        truncated = True
+    truncated = len(entries) > max_entries
+    entries = entries[:max_entries]
     return {
         "entries": entries,
         "marker": entries[-1]["marker"] if entries else marker,

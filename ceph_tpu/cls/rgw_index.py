@@ -32,6 +32,14 @@ Listing uses the store's ranged omap pages (MethodContext
 .omap_get_range): each ``list`` call returns one page without copying
 the whole index, and ``stats``'s meta count scans only the META_NS
 range — O(live uploads), not O(objects).
+
+ON-DISK FORMAT BREAK (ADVICE r5, documented pre-release policy): the
+OBJ_NS/META_NS re-namespacing is not migrated.  Indexes written by the
+earlier flat layout (untagged object keys, ``.upload.`` meta keys) have
+their entries invisible to get/list/stats and their old meta keys
+orphaned.  Rebuild such buckets by re-putting their objects (or run
+``rebuild`` after re-tagging by hand); no automatic migration path
+exists — or will — before the first release freezes the format.
 """
 
 from __future__ import annotations

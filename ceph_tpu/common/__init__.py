@@ -11,10 +11,17 @@ unix admin socket serving `perf dump` / `config show|set` /
 
 from .config import Config, Option, OPTIONS
 from .perf_counters import PerfCounters, PerfCountersCollection
-from .admin_socket import AdminSocket
+from .admin_socket import AdminSocket, register_common
 from .heartbeat_map import HeartbeatHandle, HeartbeatMap
 from .lockdep import LockdepLock, LockOrderViolation, lockdep_enable
-from .tracing import TraceProvider, tracepoint_provider
+from .op_tracker import OpTracker, TrackedOp
+from .tracing import (
+    TraceProvider,
+    current_trace,
+    events_for_trace,
+    new_trace_id,
+    tracepoint_provider,
+)
 
 __all__ = [
     "Config",
@@ -23,11 +30,17 @@ __all__ = [
     "PerfCounters",
     "PerfCountersCollection",
     "AdminSocket",
+    "register_common",
     "HeartbeatHandle",
     "HeartbeatMap",
     "LockdepLock",
     "LockOrderViolation",
     "lockdep_enable",
+    "OpTracker",
+    "TrackedOp",
     "TraceProvider",
+    "current_trace",
+    "events_for_trace",
+    "new_trace_id",
     "tracepoint_provider",
 ]

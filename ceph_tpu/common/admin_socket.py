@@ -79,6 +79,47 @@ class AdminSocket:
             writer.close()
 
 
+def register_common(asok: "AdminSocket", *, perf=None, config=None) -> None:
+    """The observability commands every daemon serves — one wiring for
+    osd/mon/mgr so the surfaces cannot drift: ``perf dump``, ``config
+    show|diff|set``, ``log dump``, ``dump_tracepoints`` (optionally
+    filtered to one trace id via {"trace": ...})."""
+    if perf is not None:
+        asok.register("perf dump", lambda req: perf.dump(),
+                      "typed performance counters")
+    if config is not None:
+        asok.register("config show", lambda req: config.show(),
+                      "every option with its current value")
+        asok.register("config diff", lambda req: config.diff(),
+                      "options changed from defaults")
+
+        def _config_set(req: dict):
+            config.set(req["name"], req["value"])
+            return {"success": f"{req['name']} = {config.get(req['name'])}"}
+
+        asok.register("config set", _config_set, "set one option at runtime")
+
+    def _log_dump(req: dict) -> dict:
+        from .log import install
+
+        ml = install()
+        n = int(req.get("num", 200) or 200)
+        if n < 0:
+            return {"error": f"num must be >= 0, got {n}"}
+        return {"entries": ml.recent(n=n, level=req.get("level"))}
+
+    asok.register("log dump", _log_dump,
+                  "recent in-memory log entries (ring buffer)")
+
+    def _dump_tracepoints(req: dict) -> dict:
+        from .tracing import dump_all
+
+        return dump_all(trace=req.get("trace"))
+
+    asok.register("dump_tracepoints", _dump_tracepoints,
+                  "ring-buffer tracepoint events (optional trace filter)")
+
+
 async def admin_command(path: str, prefix: str, **kw) -> Any:
     """Client side: one command round trip (the `ceph daemon` CLI core)."""
     reader, writer = await asyncio.open_unix_connection(path)

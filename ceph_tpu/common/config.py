@@ -81,6 +81,14 @@ OPTIONS: dict[str, Option] = _opts(
     Option("osd_subop_timeout", float, 30.0,
            "shard sub-op round-trip budget (s)"),
     Option("osd_client_op_retries", int, 8, "client-visible op retries"),
+    # osd: op tracking (reference:src/common/TrackedOp + the
+    # osd_op_complaint_time / osd_op_history_size options)
+    Option("osd_op_complaint_time", float, 30.0,
+           "in-flight op age that counts as a slow request and feeds "
+           "the SLOW_OPS health warning (0 disables)"),
+    Option("osd_op_history_size", int, 20,
+           "completed ops kept for dump_historic_ops (and the "
+           "by-duration ring)"),
     # osd: scrub
     Option("osd_scrub_interval", float, 0.0,
            "background deep-scrub period (s); 0 = on-demand only"),
@@ -131,6 +139,8 @@ OPTIONS: dict[str, Option] = _opts(
     Option("osd_mgr_report_interval", float, 1.0,
            "osd -> mgr MPGStats period (s); 0 disables"),
     # mon
+    Option("mon_mgr_report_interval", float, 1.0,
+           "mon -> mgr perf-counter report period (s); 0 disables"),
     Option("mon_failure_min_reporters", int, 1,
            "distinct reporters before an osd is marked down"),
     Option("mon_cluster_log_max", int, 1000,

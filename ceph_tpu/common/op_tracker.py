@@ -1,0 +1,168 @@
+"""In-flight op tracking (reference:src/common/TrackedOp.{h,cc}).
+
+The reference's OpTracker wraps every client op in a TrackedOp carrying
+typed state transitions (queued -> dequeued -> sub_op_sent ->
+sub_op_applied -> replied), serves ``dump_ops_in_flight`` /
+``dump_historic_ops`` / ``dump_historic_ops_by_duration`` over the
+admin socket, and flags ops older than ``osd_op_complaint_time`` so the
+health system can raise SLOW_OPS.  Same shape here: a dict-backed
+TrackedOp per op, a recency ring plus a duration-sorted ring for
+history, and an index by trace id so sub-op replies (which arrive on a
+different dispatch context) can mark progress on the op they belong to.
+"""
+
+from __future__ import annotations
+
+import bisect
+import time
+from collections import deque
+from typing import Any
+
+# the canonical state sequence (reference OpRequest flag names)
+STATES = ("queued", "dequeued", "sub_op_sent", "sub_op_applied", "replied")
+
+
+class TrackedOp:
+    """One op's lifetime record."""
+
+    __slots__ = ("seq", "trace", "desc", "initiated_at", "events",
+                 "duration")
+
+    def __init__(self, seq: int, trace: str | None, desc: dict):
+        self.seq = seq
+        self.trace = trace
+        self.desc = dict(desc)          # tid/oid/pool/ops, json-able
+        self.initiated_at = time.monotonic()
+        self.events: list[tuple[str, float]] = [
+            ("queued", self.initiated_at)
+        ]
+        self.duration: float | None = None  # set on finish
+
+    def mark(self, state: str) -> None:
+        self.events.append((state, time.monotonic()))
+
+    @property
+    def state(self) -> str:
+        return self.events[-1][0]
+
+    def age(self, now: float | None = None) -> float:
+        return (now if now is not None else time.monotonic()) \
+            - self.initiated_at
+
+    def dump(self, now: float | None = None) -> dict:
+        out = dict(self.desc)
+        out["trace"] = self.trace
+        out["state"] = self.state
+        t0 = self.initiated_at
+        # per-stage timestamps relative to op start (stable under dump)
+        out["events"] = [
+            {"event": ev, "at": round(ts - t0, 6)} for ev, ts in self.events
+        ]
+        if self.duration is not None:
+            out["duration"] = self.duration
+        else:
+            out["age"] = self.age(now)
+        return out
+
+
+class OpTracker:
+    """Per-daemon op registry (OpTracker + OpHistory analog)."""
+
+    def __init__(self, history_size: int = 20):
+        self.history_size = max(1, int(history_size))
+        self._seq = 0
+        self._inflight: dict[int, TrackedOp] = {}
+        self._by_trace: dict[str, TrackedOp] = {}
+        self._historic: deque[TrackedOp] = deque(maxlen=self.history_size)
+        # longest-duration ring (OpHistory's duration-sorted set): kept
+        # sorted descending, bounded to history_size
+        self._slowest: list[TrackedOp] = []
+
+    # -- lifecycle
+    def create(self, trace: str | None = None, **desc: Any) -> TrackedOp:
+        self._seq += 1
+        op = TrackedOp(self._seq, trace, desc)
+        self._inflight[op.seq] = op
+        if trace is not None:
+            self._by_trace[trace] = op
+        return op
+
+    def mark(self, op: TrackedOp, state: str) -> None:
+        op.mark(state)
+
+    def mark_by_trace(self, trace: str | None, state: str) -> None:
+        """Progress an op from a different dispatch context (a sub-op
+        reply carries the op's trace id, not its tracker seq)."""
+        if trace is None:
+            return
+        op = self._by_trace.get(trace)
+        if op is not None:
+            op.mark(state)
+
+    def finish(self, op: TrackedOp, completed: bool = True) -> None:
+        """Retire an op; only COMPLETED ops (a reply actually left) go
+        to history — cancelled ops must not masquerade as served."""
+        self._inflight.pop(op.seq, None)
+        if op.trace is not None and self._by_trace.get(op.trace) is op:
+            del self._by_trace[op.trace]
+        if not completed:
+            return
+        op.duration = time.monotonic() - op.initiated_at
+        self._historic.append(op)
+        # duration-sorted ring maintenance on the hot path: one ordered
+        # insert (the list stays sorted descending), not a re-sort, and
+        # an op slower than nothing in a full ring costs O(1)
+        if (len(self._slowest) >= self.history_size
+                and op.duration <= (self._slowest[-1].duration or 0.0)):
+            return
+        bisect.insort(self._slowest, op,
+                      key=lambda o: -(o.duration or 0.0))
+        del self._slowest[self.history_size:]
+
+    # -- views
+    def oldest_start(self) -> float | None:
+        if not self._inflight:
+            return None
+        return min(o.initiated_at for o in self._inflight.values())
+
+    def slow_ops(self, complaint_time: float,
+                 now: float | None = None) -> list[TrackedOp]:
+        """In-flight ops older than the complaint threshold (the
+        reference's check_ops_in_flight / SLOW_OPS input)."""
+        if complaint_time <= 0:
+            return []
+        now = now if now is not None else time.monotonic()
+        return [
+            o for o in self._inflight.values()
+            if now - o.initiated_at > complaint_time
+        ]
+
+    # -- admin-socket command bodies
+    def dump_ops_in_flight(self) -> dict:
+        now = time.monotonic()
+        ops = [o.dump(now) for o in self._inflight.values()]
+        return {"num_ops": len(ops), "ops": ops}
+
+    def dump_historic_ops(self) -> dict:
+        return {"num_ops": len(self._historic),
+                "ops": [o.dump() for o in self._historic]}
+
+    def dump_historic_ops_by_duration(self) -> dict:
+        return {"num_ops": len(self._slowest),
+                "ops": [o.dump() for o in self._slowest]}
+
+    def register_admin(self, asok) -> None:
+        """The three reference dump commands, on any daemon's socket."""
+        asok.register(
+            "dump_ops_in_flight", lambda req: self.dump_ops_in_flight(),
+            "client ops currently executing",
+        )
+        asok.register(
+            "dump_historic_ops", lambda req: self.dump_historic_ops(),
+            "recently completed client ops (newest last)",
+        )
+        asok.register(
+            "dump_historic_ops_by_duration",
+            lambda req: self.dump_historic_ops_by_duration(),
+            "recently completed client ops, slowest first",
+        )

@@ -131,6 +131,16 @@ class PerfCountersCollection:
             self._subsystems[name] = pc
             return pc
 
+    def attach(self, pc: PerfCounters) -> PerfCounters:
+        """Adopt counters built elsewhere (the messenger builds its own
+        at construction time, before any daemon collection exists) so
+        they ride the daemon's ``perf dump`` / mgr report like native
+        subsystems (reference: logger registration in
+        perf_counters_collection_t::add)."""
+        with self._lock:
+            self._subsystems[pc.name] = pc
+            return pc
+
     def get(self, name: str) -> PerfCounters | None:
         return self._subsystems.get(name)
 

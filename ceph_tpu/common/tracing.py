@@ -8,16 +8,39 @@ admin socket ("dump_tracepoints") and inspectable in tests.
 
 Spans (``with provider.span("encode", oid=...)``) record begin/end
 pairs with the elapsed time, the EventTrace analog.
+
+Trace context (the blkin/zipkin trace-id analog the reference threads
+through Messenger/Objecter): ``current_trace`` is a contextvar the
+messenger stamps into every outbound frame and restores on dispatch, so
+one client op's id follows it across hops — client -> primary ->
+replica sub-ops -> EC encode — without any call-site plumbing (asyncio
+tasks inherit the context they were created under).  Every tracepoint
+auto-attaches the active id; :func:`events_for_trace` merges the
+per-provider rings back into that op's cross-daemon timeline.
 """
 
 from __future__ import annotations
 
 import contextlib
+import contextvars
+import itertools
 import time
 from collections import deque
 from typing import Any, Iterator
 
 _providers: dict[str, "TraceProvider"] = {}
+
+# the active trace id for this task tree (None = untraced work)
+current_trace: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "ceph_tpu_trace", default=None
+)
+_trace_seq = itertools.count(1)
+
+
+def new_trace_id(origin: str) -> str:
+    """Mint an origin-stamped trace id (``client.1:t17`` style) — unique
+    per process, readable in dumps."""
+    return f"{origin}:t{next(_trace_seq)}"
 
 
 class TraceProvider:
@@ -31,6 +54,7 @@ class TraceProvider:
     def point(self, event: str, **fields: Any) -> None:
         if not self.enabled:
             return
+        fields.setdefault("trace", current_trace.get())
         self._events.append(
             {"ts": time.monotonic(), "event": event, **fields}
         )
@@ -70,5 +94,24 @@ def tracepoint_provider(name: str) -> TraceProvider:
     return _providers[name]
 
 
-def dump_all() -> dict:
-    return {n: p.dump() for n, p in _providers.items()}
+def dump_all(trace: str | None = None) -> dict:
+    """Every provider's ring; ``trace`` filters each ring to one op."""
+    out = {n: p.dump() for n, p in _providers.items()}
+    if trace is not None:
+        for d in out.values():
+            d["events"] = [e for e in d["events"] if e.get("trace") == trace]
+    return out
+
+
+def events_for_trace(trace: str) -> list[dict]:
+    """One op's cross-daemon timeline: every provider's events carrying
+    this trace id, merged and time-ordered (the ``dump_tracepoints``
+    reconstruction contract)."""
+    merged = [
+        {**e, "provider": name}
+        for name, p in _providers.items()
+        for e in p.events()
+        if e.get("trace") == trace
+    ]
+    merged.sort(key=lambda e: e["ts"])
+    return merged

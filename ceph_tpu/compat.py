@@ -1,0 +1,65 @@
+"""Runtime compatibility shims.
+
+The codebase targets Python 3.11+ (``asyncio.timeout`` at every
+deadline site); CI containers may still run 3.10, where that context
+manager does not exist and every daemon/test that touches a deadline
+dies with AttributeError.  This module backports the 3.11 semantics —
+expiry cancels the task and surfaces as builtin ``TimeoutError``; a
+foreign cancellation passes through untouched — and installs it as
+``asyncio.timeout`` when (and only when) the stdlib lacks it.
+
+Imported for its side effect from :mod:`ceph_tpu` so every entry point
+(tests, tools, daemons) gets it before any event loop runs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+
+class _Timeout:
+    """Minimal asyncio.timeout backport (the 3.11 class, without
+    reschedule()): one deadline, armed at __aenter__."""
+
+    def __init__(self, delay: float | None):
+        self._delay = delay
+        self._handle = None
+        self._task = None
+        self._expired = False
+
+    async def __aenter__(self) -> "_Timeout":
+        self._task = asyncio.current_task()
+        if self._delay is not None:
+            loop = asyncio.get_running_loop()
+            self._handle = loop.call_later(self._delay, self._on_timeout)
+        return self
+
+    def _on_timeout(self) -> None:
+        self._expired = True
+        self._task.cancel()
+
+    async def __aexit__(self, et, ev, tb) -> bool:
+        if self._handle is not None:
+            self._handle.cancel()
+            self._handle = None
+        if self._expired and et is asyncio.CancelledError:
+            # our own expiry: surface as the 3.11 builtin TimeoutError
+            # (on 3.10 asyncio.TimeoutError is a DIFFERENT class that
+            # `except TimeoutError` does not catch).
+            # KNOWN LIMIT: if a foreign cancel lands in the same loop
+            # iteration as the expiry, it is indistinguishable from our
+            # own (3.10 has no Task.uncancel()/cancelling() counts, the
+            # exact machinery 3.11 added to solve this; async-timeout
+            # shares the flaw) and gets swallowed as TimeoutError —
+            # callers that both time out and get externally cancelled
+            # must tolerate one extra retry-loop pass on 3.10.
+            raise TimeoutError from ev
+        return False
+
+
+def install() -> None:
+    if not hasattr(asyncio, "timeout"):
+        asyncio.timeout = lambda delay: _Timeout(delay)
+
+
+install()

@@ -55,11 +55,17 @@ class MgrDaemon(Dispatcher):
         self.active = False
         # per-osd last report: {osd: {"pgs", "perf", "store", "ts", "epoch"}}
         self.osd_stats: dict[int, dict] = {}
+        # non-OSD daemon reports (mon/rgw via MDaemonStats):
+        # {name: {"perf", "ts"}}
+        self.daemon_stats: dict[str, dict] = {}
         self._prev_perf: dict[int, tuple[float, dict]] = {}  # io-rate basis
         self.io_rates: dict[int, dict[str, float]] = {}
         self.perf = PerfCountersCollection()
+        self.perf.attach(self.messenger.perf)
         pm = self.perf.create("mgr")
         pm.add_counter("stats_received", "MPGStats ingested")
+        pm.add_counter("daemon_stats_received",
+                       "non-OSD daemon reports ingested")
         pm.add_counter("commands", "module commands served")
         from .modules import (
             DfModule,
@@ -81,6 +87,7 @@ class MgrDaemon(Dispatcher):
         self._mon_conn: Connection | None = None
         self._redirect_addr: str | None = None  # leader hint from a peon
         self._beacon_task: asyncio.Task | None = None
+        self._admin = None
         self._stopping = False
 
     # -- lifecycle -----------------------------------------------------------
@@ -88,12 +95,29 @@ class MgrDaemon(Dispatcher):
         self.addr = await self.messenger.bind(host, port)
         await self._connect_mon()
         self._beacon_task = asyncio.ensure_future(self._beacon_loop())
+        path = self.config.admin_socket
+        if path:
+            from ..common import AdminSocket, register_common
+
+            self._admin = AdminSocket(path.replace("{name}", self.name))
+            register_common(self._admin, perf=self.perf,
+                            config=self.config)
+            self._admin.register(
+                "status",
+                lambda req: {"name": self.name, "addr": self.addr,
+                             "active": self.active},
+                "daemon identity and active/standby role",
+            )
+            await self._admin.start()
         return self.addr
 
     async def stop(self) -> None:
         self._stopping = True
         if self._beacon_task:
             self._beacon_task.cancel()
+        if self._admin is not None:
+            await self._admin.stop()
+            self._admin = None
         await self.messenger.shutdown()
 
     @property
@@ -200,6 +224,11 @@ class MgrDaemon(Dispatcher):
                 self._mon_conn = None
         elif isinstance(msg, messages.MPGStats):
             self._ingest_stats(msg)
+        elif isinstance(msg, messages.MDaemonStats):
+            self.perf.get("mgr").inc("daemon_stats_received")
+            self.daemon_stats[msg.name] = {
+                "perf": dict(msg.perf or {}), "ts": time.monotonic(),
+            }
         elif isinstance(msg, messages.MMonCommand):
             code, status, out = self.handle_command(msg.cmd)
             conn.send(messages.MMonCommandReply(
@@ -280,6 +309,18 @@ class MgrDaemon(Dispatcher):
             if self.osdmap is not None and not self.osdmap.is_up(osd):
                 continue
             live[osd] = st
+        return live
+
+    def live_daemon_stats(self) -> dict[str, dict]:
+        """Fresh non-OSD daemon reports (mon/rgw); stale entries age
+        out like OSD stats do."""
+        now = time.monotonic()
+        live: dict[str, dict] = {}
+        for name, st in list(self.daemon_stats.items()):
+            if now - st["ts"] > self.STALE_AFTER:
+                del self.daemon_stats[name]
+                continue
+            live[name] = st
         return live
 
     def pool_usage(self) -> dict[int, dict]:

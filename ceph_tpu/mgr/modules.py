@@ -107,15 +107,35 @@ def _health_checks(m, mgr, *, up: int, inn: int, exists: int) -> list[dict]:
             "summary": f"degraded redundancy: {degraded} pgs degraded",
         })
     outstanding = 0
+    slow_ops = 0
+    slow_oldest = 0.0
     for st in mgr.live_osd_stats().values():
-        scrub = (st.get("perf") or {}).get("scrub") or {}
+        perf = st.get("perf") or {}
+        scrub = perf.get("scrub") or {}
         # the CURRENT-inconsistency gauge, not lifetime counters: the
         # cumulative errors counter re-counts a bad shard every pass
         outstanding += int(scrub.get("unrepaired", 0) or 0)
+        osd_perf = perf.get("osd") or {}
+        slow_ops += int(osd_perf.get("slow_ops", 0) or 0)
+        slow_oldest = max(
+            slow_oldest,
+            float(osd_perf.get("slow_ops_oldest_sec", 0) or 0),
+        )
     if outstanding:
         checks.append({
             "code": "OSD_SCRUB_ERRORS", "severity": "HEALTH_ERR",
             "summary": f"{outstanding} unrepaired scrub errors",
+        })
+    if slow_ops:
+        # ops past osd_op_complaint_time, from the OSDs' OpTracker
+        # gauges (the reference's SLOW_OPS health check fed by
+        # check_ops_in_flight)
+        checks.append({
+            "code": "SLOW_OPS", "severity": "HEALTH_WARN",
+            "summary": (
+                f"{slow_ops} slow ops, oldest one blocked for "
+                f"{slow_oldest:.0f} sec"
+            ),
         })
     return checks
 
@@ -359,12 +379,55 @@ class PGDumpModule(MgrModule):
         }
 
 
+def _prom_escape(value) -> str:
+    """Prometheus label-value escaping (exposition format: backslash,
+    double-quote and newline must be escaped inside label values)."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 class PrometheusModule(MgrModule):
     """Prometheus-style exposition of every reported counter
-    (reference:src/pybind/mgr/prometheus)."""
+    (reference:src/pybind/mgr/prometheus).
+
+    Series naming: ``ceph_<subsystem>_<counter>{daemon="..."}``.  Avg /
+    time-avg counters flatten to the histogram-style triplet
+    ``_sum`` / ``_count`` / plain (the running average) — the shape the
+    reference module exports for longrunavgs."""
 
     NAME = "prometheus"
     COMMANDS = {"metrics": "metrics"}
+
+    @staticmethod
+    def _emit_daemon(lines: list[str], daemon: str, perf: dict) -> None:
+        """One daemon's full counter dump -> exposition lines; every
+        registered counter appears exactly once per daemon."""
+        lab = f'{{daemon="{_prom_escape(daemon)}"}}'
+        for subsys, counters in sorted((perf or {}).items()):
+            for key, val in sorted(counters.items()):
+                base = f"ceph_{subsys}_{key}"
+                if isinstance(val, dict):
+                    # PerfCounters avg dump: {avgcount, sum, avg, ...}
+                    s = float(val.get("sum") or 0.0)
+                    c = int(val.get("avgcount") or 0)
+                elif isinstance(val, (list, tuple)):
+                    # raw [sum, count, min, max] pairs (pre-dump form)
+                    s = float(val[0]) if val else 0.0
+                    c = int(val[1]) if len(val) > 1 else 0
+                elif isinstance(val, bool) or not isinstance(
+                    val, (int, float)
+                ):
+                    continue  # non-numeric: not a prometheus sample
+                else:
+                    lines.append(f"{base}{lab} {val}")
+                    continue
+                lines.append(f"{base}_sum{lab} {s}")
+                lines.append(f"{base}_count{lab} {c}")
+                lines.append(f"{base}{lab} {(s / c) if c else 0.0}")
 
     def metrics(self, mgr: MgrDaemon, cmd: dict) -> tuple[int, str, Any]:
         lines: list[str] = []
@@ -376,18 +439,15 @@ class PrometheusModule(MgrModule):
                 f"ceph_health_status {_SEVERITIES.index(worst)}"
             )
         for osd, st in sorted(mgr.live_osd_stats().items()):
-            for subsys, counters in sorted(st["perf"].items()):
-                for key, val in sorted(counters.items()):
-                    if isinstance(val, (list, tuple)):
-                        if len(val) >= 2 and val[1]:
-                            val = val[0] / val[1]  # avg pairs
-                        else:
-                            continue
-                    lines.append(
-                        f'ceph_{subsys}_{key}{{daemon="osd.{osd}"}} {val}'
-                    )
+            self._emit_daemon(lines, f"osd.{osd}", st["perf"])
+        # non-OSD daemons (mon elections/map publishes, rgw verbs) ride
+        # MDaemonStats reports; the mgr exports its own counters too
+        for name, st in sorted(mgr.live_daemon_stats().items()):
+            self._emit_daemon(lines, name, st["perf"])
+        self._emit_daemon(lines, mgr.name, mgr.perf.dump())
         for pgid, pst in sorted(mgr.pg_summary().items()):
             lines.append(
-                f'ceph_pg_objects{{pgid="{pgid}"}} {pst.get("objects", 0)}'
+                f'ceph_pg_objects{{pgid="{_prom_escape(pgid)}"}} '
+                f'{pst.get("objects", 0)}'
             )
         return 0, "", "\n".join(lines) + "\n"

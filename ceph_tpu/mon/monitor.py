@@ -111,10 +111,40 @@ class Monitor(Dispatcher):
         self.name = name
         self.messenger = AsyncMessenger(name, self)
         self.messenger.apply_config(self.config)
+        # observability (the reference mon's l_mon_* / paxos counters +
+        # rocksdb perf): elections, map publishes, command volume —
+        # dumped over the admin socket and reported to the active mgr
+        from ..common import PerfCountersCollection
+
+        self.perf = PerfCountersCollection()
+        self.perf.attach(self.messenger.perf)
+        pmon = self.perf.create("mon")
+        (pmon
+         .add_counter("election_calls", "elections this mon started")
+         .add_counter("election_wins", "elections this mon won")
+         .add_counter("map_publishes", "osdmap epochs committed+pushed")
+         .add_counter("commands", "mon commands handled")
+         .add_counter("failure_reports", "MOSDFailure reports ingested")
+         .add_counter("clog_entries", "cluster-log entries appended")
+         .add_gauge("map_epoch", "current osdmap epoch")
+         .add_gauge("subscribers", "map subscription connections")
+         .add_gauge("is_leader", "1 when this mon leads the quorum"))
+        self._admin = None
+        self._mgr_report_last = 0.0
         self.failure_min_reporters = (
             self.config.mon_failure_min_reporters
             if failure_min_reporters is None else failure_min_reporters
         )
+        # live knob: admin-socket `config set` must change failure-quorum
+        # behavior, not just `config show` (same review-r2 class the OSD
+        # observers fix); unobserved in stop() — a shared Config must not
+        # keep firing on dead daemons
+        self._observers = [
+            ("mon_failure_min_reporters",
+             lambda _n, v: setattr(self, "failure_min_reporters", v)),
+        ]
+        for opt, cb in self._observers:
+            self.config.observe(opt, cb)
         self.osdmap = OSDMap(crush or CrushMap.flat(max_osds))
         self.osdmap.set_max_osd(max_osds)
         self.osdmap.epoch = 1
@@ -243,21 +273,75 @@ class Monitor(Dispatcher):
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
         self.addr = await self.messenger.bind(host, port)
         self._tick_task = _bg(self._tick_loop())
+        await self._start_admin_socket()
         return self.addr
 
+    async def _start_admin_socket(self) -> None:
+        """`ceph daemon mon.N <cmd>` surface (the mon has the same
+        admin-socket contract as the OSD in the reference)."""
+        path = self.config.admin_socket
+        if not path:
+            return
+        from ..common import AdminSocket, register_common
+
+        self._admin = AdminSocket(path.replace("{name}", self.name))
+        register_common(self._admin, perf=self.perf, config=self.config)
+        self._admin.register(
+            "status",
+            lambda req: {
+                "name": self.name, "addr": self.addr, "rank": self.rank,
+                "epoch": self.osdmap.epoch, "leader": self.is_leader,
+            },
+            "daemon identity, rank and map epoch",
+        )
+        self._admin.register(
+            "quorum_status", lambda req: self._cmd_quorum_status({})[2],
+            "quorum membership and leader",
+        )
+        await self._admin.start()
+
     async def _tick_loop(self) -> None:
-        """Periodic housekeeping (Monitor::tick): currently mgr-beacon
-        staleness; leader-only mutations."""
+        """Periodic housekeeping (Monitor::tick): mgr-beacon staleness
+        (leader-only mutations) + this mon's perf report to the active
+        mgr (the reference's mon->mgr MMgrReport path)."""
         try:
             while True:
-                await asyncio.sleep(self.config.mon_lease_interval)
+                # the tick must wake at least as often as the mgr report
+                # period, or mon_mgr_report_interval below the lease
+                # interval silently quantizes up to it (_report_to_mgr
+                # self-throttles, so extra wakes cost nothing)
+                lease = self.config.mon_lease_interval
+                rep = self.config.mon_mgr_report_interval
+                await asyncio.sleep(min(lease, rep) if rep > 0 else lease)
                 if self.is_leader:
                     for svc in ("mgr", "mds"):
                         self.check_svc_beacons(
                             svc, grace=self.config.mon_lease_interval * 3
                         )
+                await self._report_to_mgr()
         except asyncio.CancelledError:
             pass
+
+    async def _report_to_mgr(self) -> None:
+        """Push this mon's counters to the active mgr so the prometheus
+        module can export mon series (elections, map publishes) next to
+        the OSDs' — best-effort, a dead mgr costs nothing."""
+        interval = self.config.mon_mgr_report_interval
+        if interval <= 0 or not self.osdmap.mgr_addr:
+            return
+        now = time.monotonic()
+        if now - self._mgr_report_last < interval:
+            return
+        self._mgr_report_last = now
+        pmon = self.perf.get("mon")
+        pmon.set("map_epoch", self.osdmap.epoch)
+        pmon.set("subscribers", len(self._subs))
+        pmon.set("is_leader", 1 if self.is_leader else 0)
+        from ..msg.messenger import send_daemon_stats
+
+        await send_daemon_stats(
+            self.messenger, self.osdmap, self.name, self.perf.dump()
+        )
 
     async def start_quorum(self) -> None:
         """Begin elections/lease-watching (call once every mon is bound
@@ -271,12 +355,17 @@ class Monitor(Dispatcher):
         self._election_task = _bg(self._start_election())
 
     async def stop(self) -> None:
+        for opt, cb in self._observers:
+            self.config.unobserve(opt, cb)
         for t in (self._lease_task, self._watch_task, self._election_task,
                   self._tick_task):
             if t is not None:
                 t.cancel()
         self._lease_task = self._watch_task = self._election_task = None
         self._tick_task = None
+        if self._admin is not None:
+            await self._admin.stop()
+            self._admin = None
         await self.messenger.shutdown()
         if self._clog_buf and self.store_path:
             # a clean shutdown must not drop the batch window's worth of
@@ -468,6 +557,7 @@ class Monitor(Dispatcher):
                 )
             while True:
                 self.election_epoch += 1
+                self.perf.get("mon").inc("election_calls")
                 self.leader_rank = None
                 self._election_acks = {}
                 epoch = self.election_epoch
@@ -522,6 +612,7 @@ class Monitor(Dispatcher):
         return {"epoch": pepoch, "version": version, "value": value}
 
     async def _declare_victory(self, epoch: int, acks) -> None:
+        self.perf.get("mon").inc("election_wins")
         # Paxos recovery over full-map snapshots: adopt the newest
         # COMMITTED map in the quorum, then — the collect/last phase —
         # the highest ACCEPTED proposal (ordered by (election epoch,
@@ -944,6 +1035,7 @@ class Monitor(Dispatcher):
             "level": level if level in ("error", "warn", "info") else "info",
             "msg": text,
         }
+        self.perf.get("mon").inc("clog_entries")
         self._cluster_log.append(entry)
         for c in list(self._log_subs):  # live followers (ceph -w)
             try:
@@ -1125,6 +1217,7 @@ class Monitor(Dispatcher):
         }
 
     async def _handle_failure(self, msg: messages.MOSDFailure) -> None:
+        self.perf.get("mon").inc("failure_reports")
         target = msg.target_osd
         if not self._valid_osd_id(target) or not self.osdmap.is_up(target):
             return
@@ -1194,6 +1287,10 @@ class Monitor(Dispatcher):
         callers surface -EAGAIN; the next quorum re-syncs from the
         leader's map)."""
         self.osdmap.epoch += 1
+        pmon = self.perf.get("mon")
+        pmon.inc("map_publishes")
+        pmon.set("map_epoch", self.osdmap.epoch)
+        pmon.set("subscribers", len(self._subs))
         inc = self._record_inc(self.osdmap.to_dict())
         ok = True
         if not self.solo and self.is_leader:
@@ -1311,6 +1408,7 @@ class Monitor(Dispatcher):
 
     def handle_command(self, cmd: dict) -> tuple[int, str, Any]:
         prefix = cmd.get("prefix", "")
+        self.perf.get("mon").inc("commands")
         try:
             handler = {
                 "osd erasure-code-profile set": self._cmd_ec_profile_set,

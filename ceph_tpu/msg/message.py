@@ -42,13 +42,21 @@ def register(cls: Type["Message"]) -> Type["Message"]:
 
 class Message:
     """Base message: subclasses set TYPE and FIELDS (json-able attribute
-    names); bulk bytes go in ``blobs`` (list of bytes)."""
+    names); bulk bytes go in ``blobs`` (list of bytes).
+
+    ``trace`` is the envelope-level trace id (the reference header's
+    blkin trace context): not a subclass field — it rides the frame
+    header on every message type, stamped by the sending connection
+    when unset and restored on decode, so one client op's id follows
+    its sub-ops and replies across daemons (common/tracing.py).
+    """
 
     TYPE = ""
     FIELDS: tuple[str, ...] = ()
 
     def __init__(self, **kw: Any):
         self.blobs: list[bytes] = [bytes(b) for b in kw.pop("blobs", [])]
+        self.trace: str | None = kw.pop("trace", None)
         for f in self.FIELDS:
             setattr(self, f, kw.pop(f, None))
         if kw:
@@ -71,15 +79,15 @@ class BadFrame(ValueError):
 
 
 def encode_frame(msg: Message, seq: int = 0) -> bytes:
-    header = json.dumps(
-        {
-            "type": msg.TYPE,
-            "seq": seq,
-            "fields": msg.fields(),
-            "blob_lens": [len(b) for b in msg.blobs],
-        },
-        separators=(",", ":"),
-    ).encode()
+    head = {
+        "type": msg.TYPE,
+        "seq": seq,
+        "fields": msg.fields(),
+        "blob_lens": [len(b) for b in msg.blobs],
+    }
+    if msg.trace is not None:
+        head["trace"] = msg.trace
+    header = json.dumps(head, separators=(",", ":")).encode()
     buf = bytearray()
     buf += MAGIC
     buf += struct.pack(">I", len(header))
@@ -115,4 +123,6 @@ def decode_frame(frame: bytes) -> tuple[Message, int]:
         off += n
     if off != len(body):
         raise BadFrame("blob length mismatch")
-    return cls.from_fields(header["fields"], blobs), header["seq"]
+    msg = cls.from_fields(header["fields"], blobs)
+    msg.trace = header.get("trace")
+    return msg, header["seq"]

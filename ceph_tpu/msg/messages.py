@@ -385,6 +385,18 @@ class MPGStats(Message):
 
 
 @register
+class MDaemonStats(Message):
+    """Any non-OSD daemon -> mgr: periodic perf-counter report (the
+    reference's MMgrReport from mons/rgw/mds).  ``name`` is the entity
+    ("mon.0", "rgw.zone"), ``perf`` a PerfCountersCollection dump
+    ({subsystem: {counter: value}}) — the prometheus module exports
+    every series with a daemon label."""
+
+    TYPE = "daemon_stats"
+    FIELDS = ("name", "perf")
+
+
+@register
 class MAuth(Message):
     """Client -> mon CephX bootstrap (reference:src/messages/MAuth.h).
     op = "get_nonce" | "authenticate" (with entity + proof)."""

@@ -20,6 +20,7 @@ import logging
 import struct
 from typing import Optional
 
+from ..common.tracing import current_trace, new_trace_id
 from .message import BadFrame, Message, decode_frame, encode_frame
 
 _LEN = struct.Struct(">I")
@@ -58,11 +59,23 @@ class Connection:
         self._closed = False
 
     def send(self, msg: Message) -> None:
-        """Queue a message; delivery is in send order (never blocks)."""
+        """Queue a message; delivery is in send order (never blocks).
+
+        Trace stamping happens HERE (the one choke point every outbound
+        message crosses): a message without a trace id inherits the
+        active context's (so sub-ops and replies carry their client
+        op's id), or is minted a fresh origin-stamped one (so a client
+        op starts a trace) — common/tracing.py."""
         if self._closed:
             return
+        if msg.trace is None:
+            msg.trace = (current_trace.get()
+                         or new_trace_id(self.messenger.name))
         self._send_seq += 1
         frame = encode_frame(msg, self._send_seq)
+        perf = self.messenger.perf
+        perf.inc("msg_send")
+        perf.inc("bytes_send", len(frame))
         self._sendq.put_nowait(frame)
 
     async def _writer_loop(self) -> None:
@@ -113,19 +126,31 @@ class Connection:
                 # waiting HERE exerts TCP backpressure on the peer
                 # (reference:Messenger policy throttler semantics)
                 await throttle.acquire(n)
+                perf = self.messenger.perf
+                perf.set("dispatch_queue_bytes", throttle.current)
                 try:
                     frame = await self._reader.readexactly(n)
                     msg, _seq = decode_frame(frame)
+                    perf.inc("msg_recv")
+                    perf.inc("bytes_recv", n)
+                    # restore the sender's trace context for this
+                    # dispatch (and every task it spawns): the id minted
+                    # at the client follows the op across daemons
+                    current_trace.set(msg.trace)
                     try:
-                        await self.messenger._dispatch(self, msg)
+                        with perf.time("dispatch_latency"):
+                            await self.messenger._dispatch(self, msg)
                     except Exception:
                         # a handler bug must not tear down the peer link
                         logger.exception(
                             "%s: dispatcher failed on %s from %s",
                             self.messenger.name, msg.TYPE, self.peer_name,
                         )
+                    finally:
+                        current_trace.set(None)
                 finally:
                     throttle.release(n)
+                    perf.set("dispatch_queue_bytes", throttle.current)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
         except BadFrame:
@@ -195,6 +220,25 @@ class AsyncMessenger:
         # bounds in-flight inbound bytes across all connections
         # (reference ms_dispatch_throttle_bytes); 0 = unthrottled
         self.dispatch_throttle = Throttle(f"{name}.dispatch", 0)
+        # wire-level observability (reference:src/msg/DispatchQueue.cc
+        # l_msgr_* counters): daemons attach this into their
+        # PerfCountersCollection so it rides `perf dump` / mgr reports
+        from ..common.perf_counters import PerfCounters
+
+        self.perf = PerfCounters("msgr")
+        (self.perf
+         .add_counter("msg_send", "messages queued for send")
+         .add_counter("msg_recv", "messages dispatched")
+         .add_counter("bytes_send", "frame bytes queued for send")
+         .add_counter("bytes_recv", "frame bytes received")
+         .add_counter("reconnects", "dial retries after a failed attempt")
+         .add_counter("conns_opened", "outbound connections established")
+         .add_counter("conns_accepted", "inbound connections accepted")
+         .add_counter("resets", "connections lost (either side)")
+         .add_gauge("dispatch_queue_bytes",
+                    "inbound bytes held by the dispatch throttle")
+         .add_time_avg("dispatch_latency",
+                       "handler wall time per inbound message"))
 
     def apply_config(self, cfg) -> None:
         """Adopt the ms_* options from a Config."""
@@ -300,6 +344,7 @@ class AsyncMessenger:
         except (ValueError, KeyError, TypeError, ConnectionError, OSError):
             writer.close()
             return
+        self.perf.inc("conns_accepted")
         self._start(conn)
 
     async def connect(self, addr: str, peer_name: str = "?") -> Connection:
@@ -332,6 +377,7 @@ class AsyncMessenger:
         last: Exception | None = None
         for attempt in range(max(1, self.reconnect_attempts)):
             if attempt:
+                self.perf.inc("reconnects")
                 await asyncio.sleep(self.reconnect_backoff * attempt)
             if self._stopped:
                 raise ConnectionResetError(
@@ -411,6 +457,7 @@ class AsyncMessenger:
             if writer is not None:
                 writer.close()  # a half-done handshake must not leak the fd
             raise
+        self.perf.inc("conns_opened")
         self._conns[addr] = conn
         self._start(conn)
         return conn
@@ -434,8 +481,27 @@ class AsyncMessenger:
         await self.dispatcher.ms_dispatch(conn, msg)
 
     def _handle_reset(self, conn: Connection) -> None:
+        self.perf.inc("resets")
         self._all.discard(conn)
         if self._conns.get(conn.peer_addr) is conn:
             del self._conns[conn.peer_addr]
         if not self._stopped:
             self.dispatcher.ms_handle_reset(conn)
+
+
+async def send_daemon_stats(messenger: "AsyncMessenger", osdmap,
+                            name: str, perf: dict) -> bool:
+    """One best-effort MDaemonStats push to the active mgr — the shared
+    report step for daemons without an MPGStats path (mon, rgw): resolve
+    the mgr from the osdmap, connect, send, swallow connection errors (a
+    dead mgr must cost the reporter nothing).  Returns True iff sent."""
+    if osdmap is None or not getattr(osdmap, "mgr_addr", None):
+        return False
+    from . import messages
+
+    try:
+        conn = await messenger.connect(osdmap.mgr_addr, osdmap.mgr_name)
+        conn.send(messages.MDaemonStats(name=name, perf=perf))
+        return True
+    except (ConnectionError, OSError):
+        return False

@@ -44,7 +44,7 @@ logger = logging.getLogger("ceph_tpu.rgw")
 _STATUS = {
     200: "OK", 201: "Created", 204: "No Content", 400: "Bad Request",
     403: "Forbidden", 404: "Not Found", 405: "Method Not Allowed",
-    409: "Conflict",
+    409: "Conflict", 500: "Internal Server Error",
 }
 
 _ERRNO_HTTP = {2: 404, 17: 409, 39: 409, 13: 403, 22: 400,
@@ -183,22 +183,79 @@ def _parse_range(header: str | None, size: int):
 
 
 class S3Server:
-    def __init__(self, store: RGWStore):
+    # request verbs tracked individually (everything else lands in
+    # "other"); the reference's l_rgw per-op counters
+    _VERBS = ("get", "put", "post", "head", "delete", "copy")
+
+    def __init__(self, store: RGWStore, stats_interval: float = 1.0,
+                 name: str | None = None):
         self.store = store
         self._server: asyncio.AbstractServer | None = None
         self.addr = ""
+        # mgr-report identity: must be instance-unique or two gateways
+        # serving the same zone clobber each other's mgr.daemon_stats
+        # entry (and their prometheus series flip-flop); the default
+        # appends the bound addr once start() knows it
+        self.name = name
+        # observability (reference:src/rgw/rgw_perf_counters via
+        # rgw_main): per-verb request counts + latency avgs, error
+        # classes, payload volume — a full collection (the gateway's
+        # rados-client messenger wire counters ride along, as they do
+        # for mon/osd) reported to the active mgr so the prometheus
+        # module exports rgw series
+        from ..common import PerfCountersCollection
+
+        self.perf_coll = PerfCountersCollection()
+        self.perf_coll.attach(store.client.messenger.perf)
+        self.perf = self.perf_coll.create("rgw")
+        for verb in (*self._VERBS, "other"):
+            self.perf.add_counter(f"req_{verb}", f"{verb.upper()} requests")
+            self.perf.add_time_avg(f"lat_{verb}",
+                                   f"{verb.upper()} wall time")
+        (self.perf
+         .add_counter("req_4xx", "requests answered 4xx")
+         .add_counter("req_5xx", "requests answered 5xx")
+         .add_counter("bytes_in", "request body bytes")
+         .add_counter("bytes_out", "response payload bytes"))
+        self.stats_interval = stats_interval
+        self._stats_task: asyncio.Task | None = None
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
         self._server = await asyncio.start_server(self._serve, host, port)
         h, p = self._server.sockets[0].getsockname()[:2]
         self.addr = f"{h}:{p}"
+        if self.stats_interval > 0:
+            self._stats_task = asyncio.ensure_future(self._stats_loop())
         return self.addr
 
     async def stop(self) -> None:
+        if self._stats_task is not None:
+            self._stats_task.cancel()
+            self._stats_task = None
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
             self._server = None
+
+    async def _stats_loop(self) -> None:
+        """Periodic MDaemonStats to the active mgr (the reference rgw's
+        MMgrReport): best-effort — a gateway must keep serving when the
+        mgr is down."""
+        from ..msg.messenger import send_daemon_stats
+
+        name = self.name or (
+            f"rgw.{self.store.zone or 'default'}({self.addr})"
+        )
+        try:
+            while True:
+                await asyncio.sleep(self.stats_interval)
+                client = self.store.client
+                await send_daemon_stats(
+                    client.messenger, client.osdmap, name,
+                    self.perf_coll.dump(),
+                )
+        except asyncio.CancelledError:
+            pass
 
     # -- http plumbing -------------------------------------------------------
     async def _serve(self, reader, writer) -> None:
@@ -222,9 +279,20 @@ class S3Server:
                 n = int(headers.get("content-length", 0) or 0)
                 if n:
                     body = await reader.readexactly(n)
-                status, out_headers, payload = await self._route(
-                    method.upper(), target, headers, body
-                )
+                verb = method.lower()
+                if verb not in self._VERBS:
+                    verb = "other"
+                self.perf.inc(f"req_{verb}")
+                self.perf.inc("bytes_in", len(body))
+                with self.perf.time(f"lat_{verb}"):
+                    status, out_headers, payload = await self._route(
+                        method.upper(), target, headers, body
+                    )
+                if 400 <= status < 500:
+                    self.perf.inc("req_4xx")
+                elif status >= 500:
+                    self.perf.inc("req_5xx")
+                self.perf.inc("bytes_out", len(payload))
                 reason = _STATUS.get(status, "?")
                 head = [f"HTTP/1.1 {status} {reason}"]
                 out_headers.setdefault("content-length", str(len(payload)))
@@ -296,7 +364,7 @@ class S3Server:
         except Exception:
             logger.exception("rgw: request failed")
             h, b = self._json({"error": "internal error"})
-            return 400, h, b
+            return 500, h, b
 
     async def _auth(
         self, method: str, target: str, headers: dict

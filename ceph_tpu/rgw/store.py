@@ -493,7 +493,18 @@ class RGWStore:
                 if len(contents) + len(common) >= max_keys:
                     truncated = True
                     break
-                contents.append({"key": k, **page[k]})
+                # PROJECTED entry, the S3 ListObjects shape: raw index
+                # records carry x-amz-meta-* user metadata and per-object
+                # ACLs, which must not leak to every principal allowed to
+                # list (ADVICE r5 security finding; real S3 exposes only
+                # key/size/etag/mtime)
+                e = page[k]
+                contents.append({
+                    "key": k,
+                    "size": e.get("size", 0),
+                    "etag": e.get("etag", ""),
+                    "mtime": e.get("mtime", 0),
+                })
                 last_item = k
             if truncated:
                 break
@@ -658,13 +669,21 @@ class RGWStore:
                 delta_bytes=sum(p["size"] for p in parts.values())
                 - (old or {}).get("size", 0),
             )
-        # data assembles BEFORE the index entry publishes (readers of
-        # an overwritten object keep a consistent view), and part
+        # data assembles BEFORE the index entry publishes, and part
         # objects are removed only after the index accepts — an EDQUOT
         # lost-race on the create path removes the freshly built final
         # and leaves every part intact for a retry (review r5: an
         # earlier ordering destroyed the upload on that race, and a
-        # publish-first ordering broke concurrent readers)
+        # publish-first ordering broke concurrent readers).
+        # OVERWRITE CAVEAT (ADVICE r5): when a previous object exists
+        # its striped data is removed and rewritten in place (the data
+        # object name is derived from the key, so there is no temp-name
+        # + swap-at-publish path without a manifest indirection) — a
+        # concurrent GET holding the OLD index entry can read torn or
+        # partially-assembled bytes during assembly.  The window is
+        # bounded by the assembly itself and matches put_object's
+        # overwrite semantics; the index entry is only published once
+        # the new data is fully in place.
         total = sum(parts[n]["size"] for n in parts)
         md5s = hashlib.md5()
         for n in sorted(parts):

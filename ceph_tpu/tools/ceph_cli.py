@@ -11,6 +11,12 @@ Usage:
   ceph -m MON metrics          # prometheus exposition text
   ceph -m MON mgr module ls
   ceph -m MON osd dump
+  ceph daemon NAME|SOCKET CMD  # admin-socket passthrough, e.g.
+                               #   ceph daemon osd.0 perf dump
+                               #   ceph daemon osd.0 dump_historic_ops
+                               #   ceph daemon /run/osd.0.asok help
+                               # NAME resolves via the admin_socket
+                               # config pattern (CEPH_TPU_ARGS)
 """
 
 from __future__ import annotations
@@ -122,15 +128,81 @@ def _watch(args) -> int:
         return 0
 
 
+def _daemon_command(args) -> int:
+    """`ceph daemon <name|socket> <cmd> [args]` — the reference's
+    admin-socket passthrough (reference:src/ceph.in admin_socket path):
+    one JSON round trip to the daemon's unix socket, no mon needed."""
+    words = list(args.words[1:])
+    if len(words) < 2:
+        print("usage: ceph daemon <name|socket-path> <command...>",
+              file=sys.stderr)
+        return 2
+    target, *rest = words
+    if "/" in target or target.endswith(".asok"):
+        path = target
+    else:
+        from ..common import Config
+
+        pattern = Config().admin_socket  # env/CEPH_TPU_ARGS layered
+        if not pattern:
+            print("error: no admin_socket configured (set CEPH_TPU_ARGS="
+                  "'--admin_socket /path/{name}.asok' or pass a socket "
+                  "path)", file=sys.stderr)
+            return 1
+        path = pattern.replace("{name}", target)
+
+    async def run() -> int:
+        from ..common.admin_socket import admin_command
+
+        try:
+            # the daemon's own command registry decides where the
+            # multi-word prefix ends — a client-side vocabulary would
+            # silently drift from what daemons register (`help` is
+            # built into every AdminSocket); longest match wins
+            known = await admin_command(path, "help")
+            prefixes = set(known) if isinstance(known, dict) else set()
+            for i in range(len(rest), 0, -1):
+                if " ".join(rest[:i]) in prefixes:
+                    prefix, leftover = " ".join(rest[:i]), rest[i:]
+                    break
+            else:
+                prefix, leftover = rest[0], rest[1:]
+            kw: dict = {}
+            positional = []
+            for w in leftover:
+                if "=" in w:
+                    k, _, v = w.partition("=")
+                    kw[k] = v
+                else:
+                    positional.append(w)
+            if prefix == "config set" and len(positional) == 2:
+                kw.setdefault("name", positional[0])
+                kw.setdefault("value", positional[1])
+            elif prefix == "log dump" and positional:
+                kw.setdefault("num", positional[0])
+            out = await admin_command(path, prefix, **kw)
+        except (ConnectionError, OSError) as e:
+            print(f"error: {path}: {e}", file=sys.stderr)
+            return 1
+        print(json.dumps(out, indent=1, sort_keys=True))
+        return 0 if not (isinstance(out, dict) and "error" in out) else 1
+
+    return asyncio.run(run())
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ceph", description=__doc__)
-    p.add_argument("-m", "--mon", required=True)
+    p.add_argument("-m", "--mon")
     p.add_argument("-f", "--format", choices=["plain", "json"],
                    default="plain")
     p.add_argument("-w", "--watch", action="store_true",
                    help="follow the cluster log (like `ceph -w`)")
     p.add_argument("words", nargs="*", help="command words")
     args = p.parse_args(argv)
+    if args.words and args.words[0] == "daemon":
+        return _daemon_command(args)
+    if not args.mon:
+        p.error("-m/--mon is required (except for `ceph daemon`)")
     if args.watch:
         if args.words:
             p.error("-w takes no command words")

@@ -1,0 +1,65 @@
+"""CI gate: every literal perf-counter key used anywhere in ceph_tpu is
+registered by a PerfCounters builder (tools/check_counters.py) — a
+typo'd key must fail here, not at runtime on a rarely-hit path."""
+
+import importlib.util
+import pathlib
+import sys
+
+
+def _load_tool():
+    path = (pathlib.Path(__file__).parent.parent
+            / "tools" / "check_counters.py")
+    spec = importlib.util.spec_from_file_location("check_counters", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["check_counters"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_package_counter_keys_all_registered():
+    cc = _load_tool()
+    pkg = pathlib.Path(__file__).parent.parent / "ceph_tpu"
+    problems = cc.check(pkg)
+    assert problems == [], "\n".join(problems)
+
+
+def test_detects_unregistered_key(tmp_path):
+    cc = _load_tool()
+    (tmp_path / "mod.py").write_text(
+        'class D:\n'
+        '    def __init__(self):\n'
+        '        posd = self.perf.create("osd")\n'
+        '        posd.add_counter("op")\n'
+        '    def run(self):\n'
+        '        posd = self.perf.get("osd")\n'
+        '        posd.inc("op")\n'
+        '        posd.inc("op_typo")\n'
+    )
+    problems = cc.check(tmp_path)
+    assert len(problems) == 1 and "op_typo" in problems[0]
+
+
+def test_chained_and_aliased_receivers(tmp_path):
+    cc = _load_tool()
+    (tmp_path / "mod.py").write_text(
+        'self.perf.get("ec").inc("chained_typo")\n'
+        'perf = messenger.perf\n'
+        'perf.set("gauge_typo", 1)\n'
+        'config.set("not_a_counter", 1)\n'  # non-perf receiver: ignored
+    )
+    problems = cc.check(tmp_path)
+    keys = {p.split("'")[1] for p in problems}
+    assert keys == {"chained_typo", "gauge_typo"}
+
+
+def test_cli_exit_codes(tmp_path):
+    cc = _load_tool()
+    (tmp_path / "ok.py").write_text(
+        'pc.add_counter("x")\n'
+    )
+    assert cc.main([str(tmp_path)]) == 0
+    (tmp_path / "bad.py").write_text(
+        'self.perf.get("a").inc("zzz_missing")\n'
+    )
+    assert cc.main([str(tmp_path)]) == 1

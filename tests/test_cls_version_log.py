@@ -141,6 +141,50 @@ class TestClsLog:
 
         run(main())
 
+    def test_truncated_reflects_window_not_prefix(self):
+        """ADVICE r5: `truncated` must mean "more entries in [from,
+        to)", not "more keys under the prefix" — keys at/past `to`
+        used to answer truncated=true forever, so window pagination
+        never terminated."""
+
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                io = await _io(cluster)
+                await io.exec("obj", "log", "add", {"entries": [
+                    {"ts": float(t), "section": "s", "name": f"e{t}",
+                     "data": ""}
+                    for t in range(12)
+                ]})
+                # window [0, 4) paged by 3: page 1 truncated, page 2
+                # (one entry left in the window, eight past it) NOT
+                out = await io.exec("obj", "log", "list", {
+                    "from": 0.0, "to": 4.0, "max_entries": 3,
+                })
+                assert [e["name"] for e in out["entries"]] == [
+                    "e0", "e1", "e2"
+                ]
+                assert out["truncated"]
+                out = await io.exec("obj", "log", "list", {
+                    "from": 0.0, "to": 4.0, "max_entries": 3,
+                    "marker": out["marker"],
+                })
+                assert [e["name"] for e in out["entries"]] == ["e3"]
+                assert not out["truncated"]
+                # exact fit: the window ends exactly at the page budget
+                out = await io.exec("obj", "log", "list", {
+                    "from": 0.0, "to": 3.0, "max_entries": 3,
+                })
+                assert len(out["entries"]) == 3
+                assert not out["truncated"]
+                # unbounded window still pages to completion
+                out = await io.exec("obj", "log", "list", {
+                    "max_entries": 12,
+                })
+                assert len(out["entries"]) == 12
+                assert not out["truncated"]
+
+        run(main())
+
     def test_out_of_order_timestamps_never_collide(self):
         """Entries added with a timestamp OLDER than max_time (clock
         skew between writers) must not overwrite each other: the key

@@ -1,0 +1,370 @@
+"""End-to-end observability (ISSUE 1 acceptance): op tracing across
+daemons, OpTracker admin dumps, SLOW_OPS health, and full-stack
+prometheus exposition.
+
+Mirrors the reference intents: OpTracker/TrackedOp
+(reference:src/common/TrackedOp.h), trace context propagation (the
+blkin ids the reference threads through Messenger), SLOW_OPS
+(reference health check fed by check_ops_in_flight), and the mgr
+prometheus module's per-daemon series.
+"""
+
+import asyncio
+import os
+
+from ceph_tpu.common import events_for_trace
+from ceph_tpu.common.admin_socket import admin_command
+from ceph_tpu.rados import MiniCluster
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+async def _mgr_cmd(client, prefix: str):
+    from ceph_tpu.tools.ceph_cli import _mgr_command
+
+    rc, out = await _mgr_command(client, {"prefix": prefix})
+    assert rc == 0, prefix
+    return out
+
+
+def _slow_down(osd, oid: str, delay: float):
+    """Wrap one OSD's op engine so ops on ``oid`` stall — the
+    artificially delayed op the SLOW_OPS acceptance check needs."""
+    orig = osd._execute_op
+
+    async def slow(msg, conn=None, _orig=orig):
+        if msg.oid == oid:
+            await asyncio.sleep(delay)
+        return await _orig(msg, conn)
+
+    osd._execute_op = slow
+
+
+class TestTracePropagation:
+    def test_one_trace_spans_client_primary_replicas(self):
+        """A replicated write's trace id appears at every hop: dequeue
+        on the primary, sub_op_sent fan-out, sub_op_applied on BOTH
+        replicas, and the reply."""
+
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                cl = await cluster.client()
+                await cl.create_pool("p", "replicated", size=3)
+                reply = await cl.operate(
+                    "p", "obj", [{"op": "writefull", "data": 0}],
+                    [b"x" * 512],
+                )
+                assert reply.result == 0
+                trace = reply.trace
+                assert trace, "reply must carry the op's trace id"
+                timeline = events_for_trace(trace)
+                names = [e["event"] for e in timeline]
+                assert "osd_dequeue_op" in names
+                assert "osd_sub_op_sent" in names
+                assert "osd_op_reply" in names
+                # every daemon that applied the write logged under the
+                # SAME id: primary self-delivery + both replicas
+                applied_osds = {
+                    e["osd"] for e in timeline
+                    if e["event"] == "osd_sub_op_applied"
+                }
+                assert len(applied_osds) == 3, timeline
+                # the merged timeline is time-ordered
+                ts = [e["ts"] for e in timeline]
+                assert ts == sorted(ts)
+
+        run(main())
+
+    def test_ec_write_traces_encode_and_shards(self, tmp_path):
+        """An EC write's trace reaches the codec boundary (ec provider
+        spans) and the shard sub-ops; dump_tracepoints serves the
+        filtered timeline over the admin socket."""
+
+        async def main():
+            sock = os.path.join(str(tmp_path), "{name}.asok")
+            async with MiniCluster(
+                n_osds=4,
+                config_overrides={"admin_socket": sock},
+            ) as cluster:
+                cl = await cluster.client()
+                await cl.create_pool("ecp", "erasure")
+                reply = await cl.operate(
+                    "ecp", "eobj", [{"op": "writefull", "data": 0}],
+                    [os.urandom(4096)],
+                )
+                assert reply.result == 0
+                trace = reply.trace
+                timeline = events_for_trace(trace)
+                enc = [e for e in timeline
+                       if e["event"] == "ec_encode_enter"]
+                assert enc and enc[0]["nbytes"] > 0
+                applied = {
+                    e["osd"] for e in timeline
+                    if e["event"] == "osd_sub_op_applied"
+                }
+                assert len(applied) >= 2  # k=2 m=1: three shards
+                # the admin-socket surface serves the same filtered view
+                path = sock.replace("{name}", "osd.0")
+                dump = await admin_command(
+                    path, "dump_tracepoints", trace=trace
+                )
+                assert all(
+                    e.get("trace") == trace
+                    for d in dump.values() for e in d["events"]
+                )
+                assert any(d["events"] for d in dump.values())
+
+        run(main())
+
+
+class TestOpTracker:
+    def test_in_flight_then_historic_with_stages(self, tmp_path):
+        """An op shows in dump_ops_in_flight while executing, then in
+        dump_historic_ops with per-stage timestamps; the by-duration
+        ring sorts slowest first."""
+
+        async def main():
+            sock = os.path.join(str(tmp_path), "{name}.asok")
+            async with MiniCluster(
+                n_osds=3,
+                config_overrides={"admin_socket": sock},
+            ) as cluster:
+                cl = await cluster.client()
+                await cl.create_pool("p", "replicated", size=3)
+                pool = cl.osdmap.lookup_pool("p")
+                # an object osd.0 leads, so we know which socket to ask
+                name, i = None, 0
+                while name is None:
+                    cand = f"o{i}"
+                    _pg, _a, primary = cl.osdmap.object_to_acting(
+                        cand, pool.id
+                    )
+                    if primary == 0:
+                        name = cand
+                    i += 1
+                _slow_down(cluster.osds[0], name, 0.6)
+                io = cl.io_ctx("p")
+                write = asyncio.ensure_future(
+                    io.write_full(name, b"z" * 128)
+                )
+                path = sock.replace("{name}", "osd.0")
+                try:
+                    async with asyncio.timeout(10):
+                        while True:
+                            ops = await admin_command(
+                                path, "dump_ops_in_flight"
+                            )
+                            if ops["num_ops"]:
+                                break
+                            await asyncio.sleep(0.02)
+                finally:
+                    await write
+                [op] = ops["ops"]
+                assert op["oid"] == name and op["trace"]
+                assert op["age"] > 0
+                assert [e["event"] for e in op["events"]][:2] == [
+                    "queued", "dequeued"
+                ]
+                # completed: in history, with ordered stage timestamps
+                hist = await admin_command(path, "dump_historic_ops")
+                mine = [o for o in hist["ops"] if o["oid"] == name]
+                assert mine and "duration" in mine[0]
+                events = mine[0]["events"]
+                stages = [e["event"] for e in events]
+                for want in ("queued", "dequeued", "sub_op_sent",
+                             "sub_op_applied", "replied"):
+                    assert want in stages, stages
+                ats = [e["at"] for e in events]
+                assert ats == sorted(ats)
+                # fast op + slow op: by-duration ring leads with slow
+                await io.write_full(name + "fast", b"q")
+                byd = await admin_command(
+                    path, "dump_historic_ops_by_duration"
+                )
+                durs = [o["duration"] for o in byd["ops"]]
+                assert durs == sorted(durs, reverse=True)
+                assert byd["ops"][0]["duration"] >= 0.6
+
+        run(main())
+
+
+class TestSlowOpsHealth:
+    def test_slow_op_raises_and_clears_slow_ops(self):
+        """An op past osd_op_complaint_time raises SLOW_OPS in `ceph
+        health` via the mgr; completion clears it."""
+
+        async def main():
+            async with MiniCluster(
+                n_osds=3,
+                config_overrides={
+                    "osd_op_complaint_time": 0.2,
+                    "osd_mgr_report_interval": 0.05,
+                },
+            ) as cluster:
+                await cluster.start_mgr()
+                await cluster.wait_for_active_mgr()
+                cl = await cluster.client()
+                await cl.create_pool("p", "replicated", size=3)
+                io = cl.io_ctx("p")
+                await io.write_full("ok", b"1")  # fast op: no warning
+                st = await _mgr_cmd(cl, "health")
+                assert not any(
+                    c["code"] == "SLOW_OPS" for c in st["checks"]
+                )
+                for osd in cluster.osds.values():
+                    _slow_down(osd, "laggard", 2.0)
+                write = asyncio.ensure_future(
+                    io.write_full("laggard", b"2")
+                )
+                try:
+                    async with asyncio.timeout(15):
+                        while True:
+                            st = await _mgr_cmd(cl, "health")
+                            codes = {c["code"]: c for c in st["checks"]}
+                            if "SLOW_OPS" in codes:
+                                break
+                            await asyncio.sleep(0.05)
+                finally:
+                    await write
+                assert st["health"] == "HEALTH_WARN"
+                assert "slow ops" in codes["SLOW_OPS"]["summary"]
+                # the op finished: the next reports clear the warning
+                async with asyncio.timeout(15):
+                    while True:
+                        st = await _mgr_cmd(cl, "health")
+                        if not any(c["code"] == "SLOW_OPS"
+                                   for c in st["checks"]):
+                            break
+                        await asyncio.sleep(0.05)
+
+        run(main())
+
+
+class TestCephDaemonCLI:
+    def test_daemon_passthrough(self, tmp_path):
+        """`ceph daemon <name|socket> <cmd>` reaches the admin socket
+        without a mon: perf dump, config set (positional form), and
+        name resolution through the admin_socket config pattern."""
+
+        async def main():
+            import json
+            import subprocess
+            import sys
+
+            sock = os.path.join(str(tmp_path), "{name}.asok")
+            async with MiniCluster(
+                n_osds=3, config_overrides={"admin_socket": sock},
+            ) as cluster:
+                cl = await cluster.client()
+                await cl.create_pool("p", "replicated", size=3)
+                await cl.io_ctx("p").write_full("o", b"x")
+                env = {
+                    k: v for k, v in os.environ.items()
+                    if k != "PYTHONPATH"
+                }
+                env["JAX_PLATFORMS"] = "cpu"
+                env["CEPH_TPU_NO_JIT"] = "1"
+                env["CEPH_TPU_ARGS"] = f"--admin_socket {sock}"
+
+                def ceph(*words, ok=True):
+                    r = subprocess.run(
+                        [sys.executable, "-m",
+                         "ceph_tpu.tools.ceph_cli", *words],
+                        env=env, capture_output=True, text=True,
+                        timeout=60, cwd=os.getcwd(),
+                    )
+                    assert (r.returncode == 0) == ok, (words, r.stderr)
+                    return r.stdout
+                # by explicit socket path
+                path = sock.replace("{name}", "osd.0")
+                out = json.loads(
+                    await asyncio.to_thread(ceph, "daemon", path,
+                                            "perf", "dump")
+                )
+                assert "osd" in out and "msgr" in out
+                # by daemon name via the config pattern
+                out = json.loads(await asyncio.to_thread(
+                    ceph, "daemon", "osd.1", "dump_historic_ops"
+                ))
+                assert "ops" in out
+                # config set, positional name/value form
+                out = json.loads(await asyncio.to_thread(
+                    ceph, "daemon", "osd.0", "config", "set",
+                    "osd_subop_timeout", "11",
+                ))
+                assert "success" in out
+                assert cluster.osds[0].subop_timeout == 11.0
+                # unknown command: nonzero exit, error surfaced
+                await asyncio.to_thread(
+                    ceph, "daemon", "osd.0", "no_such", ok=False
+                )
+
+        run(main())
+
+
+class TestFullStackMetrics:
+    def test_metrics_expose_all_subsystems(self):
+        """PrometheusModule.metrics carries messenger, mon, rgw and
+        EC-engine throughput series next to the osd ones (acceptance
+        item 4) — every daemon class reports into one exposition."""
+
+        async def main():
+            from ceph_tpu.rgw import RGWStore
+            from ceph_tpu.rgw.http import S3Server
+            from .test_rgw import _http
+
+            async with MiniCluster(
+                n_osds=4,
+                config_overrides={"osd_mgr_report_interval": 0.1},
+            ) as cluster:
+                await cluster.start_mgr()
+                await cluster.wait_for_active_mgr()
+                cl = await cluster.client()
+                await cl.create_pool("ecp", "erasure")
+                io = cl.io_ctx("ecp")
+                await io.write_full("eobj", os.urandom(8192))
+
+                store = await RGWStore.create(await cluster.client())
+                srv = S3Server(store, stats_interval=0.1)
+                addr = await srv.start()
+                try:
+                    user = await store.create_user("alice")
+                    st, _h, _b = await _http(
+                        addr, "PUT", "/b", creds=user
+                    )
+                    assert st == 200
+                    st, _h, _b = await _http(
+                        addr, "PUT", "/b/k", body=b"data", creds=user
+                    )
+                    assert st == 200
+                    want = (
+                        'ceph_msgr_msg_send{daemon="osd.',     # messenger
+                        'ceph_mon_map_publishes{daemon="mon.0"}',  # mon
+                        'ceph_rgw_req_put{daemon="rgw.default(',  # rgw
+                        # gateway wire counters ride its report too
+                        'ceph_msgr_msg_send{daemon="rgw.default(',
+                        'ceph_ec_encode_gbps{daemon="osd.',    # EC engine
+                        'ceph_osd_op_latency_sum{',   # avg flattening
+                        'ceph_osd_op_latency_count{',
+                        'ceph_mgr_commands{daemon="mgr.',  # the mgr itself
+                    )
+                    async with asyncio.timeout(20):
+                        while True:
+                            metrics = await _mgr_cmd(cl, "metrics")
+                            if all(w in metrics for w in want):
+                                break
+                            await asyncio.sleep(0.2)
+                    # EC gauge is a real throughput number
+                    line = next(
+                        ln for ln in metrics.splitlines()
+                        if ln.startswith("ceph_ec_encode_gbps")
+                        and not ln.endswith(" 0")
+                        and not ln.endswith(" 0.0")
+                    )
+                    assert float(line.rsplit(" ", 1)[1]) > 0
+                finally:
+                    await srv.stop()
+
+        run(main())

@@ -1,0 +1,118 @@
+"""Prometheus exposition contract (ISSUE 1 satellite): label escaping,
+avg-pair flattening to _sum/_count/avg, and exactly-once emission of
+every counter a live daemon registers."""
+
+import asyncio
+import re
+
+from ceph_tpu.common import PerfCountersCollection
+from ceph_tpu.mgr.modules import PrometheusModule, _prom_escape
+from ceph_tpu.rados import MiniCluster
+
+
+class _FakeMgr:
+    """Just enough MgrDaemon surface for PrometheusModule.metrics."""
+
+    def __init__(self, osd_stats=None, daemon_stats=None):
+        self.osdmap = None
+        self.name = "mgr.fake"
+        self.perf = PerfCountersCollection()
+        self._osd = osd_stats or {}
+        self._daemon = daemon_stats or {}
+
+    def live_osd_stats(self):
+        return self._osd
+
+    def live_daemon_stats(self):
+        return self._daemon
+
+    def pg_summary(self):
+        return {}
+
+
+def _metrics(mgr) -> str:
+    _code, _status, out = PrometheusModule().metrics(mgr, {})
+    return out
+
+
+def test_label_escaping():
+    assert _prom_escape('a"b') == 'a\\"b'
+    assert _prom_escape("a\\b") == "a\\\\b"
+    assert _prom_escape("a\nb") == "a\\nb"
+    mgr = _FakeMgr(daemon_stats={
+        'rgw."zone\\one"\n': {"perf": {"rgw": {"req_get": 3}}},
+    })
+    out = _metrics(mgr)
+    assert ('ceph_rgw_req_get{daemon="rgw.\\"zone\\\\one\\"\\n"} 3'
+            in out.splitlines())
+
+
+def test_avg_pairs_flatten_to_sum_count_avg():
+    mgr = _FakeMgr(osd_stats={
+        0: {"perf": {"osd": {
+            # dump form (dict) and legacy raw-pair form (list)
+            "op_latency": {"avgcount": 4, "sum": 2.0, "avg": 0.5,
+                           "min": 0.1, "max": 0.9},
+            "old_pair": [6.0, 3, 1.0, 3.0],
+            "zero_avg": {"avgcount": 0, "sum": 0.0},
+        }}},
+    })
+    lines = _metrics(mgr).splitlines()
+    assert 'ceph_osd_op_latency_sum{daemon="osd.0"} 2.0' in lines
+    assert 'ceph_osd_op_latency_count{daemon="osd.0"} 4' in lines
+    assert 'ceph_osd_op_latency{daemon="osd.0"} 0.5' in lines
+    assert 'ceph_osd_old_pair{daemon="osd.0"} 2.0' in lines
+    # an empty average exports 0.0, never a ZeroDivisionError
+    assert 'ceph_osd_zero_avg{daemon="osd.0"} 0.0' in lines
+
+
+def test_non_numeric_values_skipped():
+    mgr = _FakeMgr(daemon_stats={
+        "mon.0": {"perf": {"mon": {"commands": 2, "flavor": "classic"}}},
+    })
+    out = _metrics(mgr)
+    assert 'ceph_mon_commands{daemon="mon.0"} 2' in out
+    assert "flavor" not in out
+
+
+def test_live_daemon_counters_appear_exactly_once():
+    """Every counter a live OSD registers lands in metrics exactly once
+    (avg counters as exactly one _sum/_count/avg triplet)."""
+
+    async def main():
+        async with MiniCluster(
+            n_osds=3,
+            config_overrides={"osd_mgr_report_interval": 0.1},
+        ) as cluster:
+            await cluster.start_mgr()
+            await cluster.wait_for_active_mgr()
+            cl = await cluster.client()
+            await cl.create_pool("p", "replicated", size=3)
+            await cl.io_ctx("p").write_full("o", b"x" * 100)
+            from ceph_tpu.tools.ceph_cli import _mgr_command
+
+            async with asyncio.timeout(15):
+                while True:
+                    rc, metrics = await _mgr_command(
+                        cl, {"prefix": "metrics"}
+                    )
+                    assert rc == 0
+                    if 'ceph_osd_op{daemon="osd.0"}' in metrics:
+                        break
+                    await asyncio.sleep(0.1)
+            osd = cluster.osds[0]
+            expected: list[str] = []
+            for subsys, counters in osd.perf.dump().items():
+                for key, val in counters.items():
+                    base = f"ceph_{subsys}_{key}"
+                    if isinstance(val, dict):
+                        expected += [f"{base}_sum", f"{base}_count", base]
+                    else:
+                        expected.append(base)
+            lines = metrics.splitlines()
+            for series in expected:
+                pat = re.escape(series) + r'\{daemon="osd\.0"\} '
+                n = sum(1 for ln in lines if re.match(pat, ln))
+                assert n == 1, (series, n)
+
+    asyncio.run(main())

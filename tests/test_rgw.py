@@ -160,6 +160,48 @@ class TestObjects:
 
         run(main())
 
+    def test_listing_projects_entries_no_meta_or_acl_leak(self):
+        """ADVICE r5 security: ListObjects must expose only key/size/
+        etag/mtime — x-amz-meta-* user metadata and per-object ACLs of
+        private objects must not leak to any principal allowed to
+        list (e.g. anyone, on a public-read bucket)."""
+
+        async def main():
+            async with MiniCluster(n_osds=3) as cluster:
+                s = await _store(cluster)
+                await s.create_user("u")
+                await s.create_bucket("b", "u", acl="public-read")
+                await s.put_object(
+                    "b", "secretive", b"payload", acl="private",
+                    meta={"owner-ssn": "123-45-6789"},
+                )
+                out = await s.list_objects("b")
+                [entry] = out["contents"]
+                assert set(entry) == {"key", "size", "etag", "mtime"}
+                assert entry["key"] == "secretive"
+                assert entry["size"] == len(b"payload")
+                assert entry["etag"] == hashlib.md5(b"payload").hexdigest()
+                assert entry["mtime"] > 0
+                # ...and over HTTP: an anonymous listing of the
+                # public-read bucket carries no meta/acl either
+                srv = __import__(
+                    "ceph_tpu.rgw.http", fromlist=["S3Server"]
+                ).S3Server(s, stats_interval=0)
+                addr = await srv.start()
+                try:
+                    st, _h, payload = await _http(addr, "GET", "/b")
+                    assert st == 200
+                    body = json.loads(payload)
+                    assert "ssn" not in payload.decode()
+                    assert all(
+                        set(e) == {"key", "size", "etag", "mtime"}
+                        for e in body["contents"]
+                    )
+                finally:
+                    await srv.stop()
+
+        run(main())
+
     def test_copy(self):
         async def main():
             async with MiniCluster(n_osds=3) as cluster:

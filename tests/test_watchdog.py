@@ -80,7 +80,8 @@ class TestHeartbeatMap:
 
             cfg = Config(overrides={"osd_op_thread_timeout": 0.0})
             osd = OSD(0, "127.0.0.1:1", config=cfg)
-            osd._inflight[1] = {"_t0": time.monotonic() - 100.0}
+            op = osd.op_tracker.create(desc="wedged")
+            op.initiated_at = time.monotonic() - 100.0
             osd._refresh_op_handle()
             assert osd.hb_map.is_healthy()  # no deadline at all
 
@@ -106,7 +107,7 @@ class TestHeartbeatMap:
                 await osd.start()
                 cluster.osds[0] = osd
                 assert osd._wd_task is not None
-                osd._inflight[1] = {"_t0": time.monotonic()}  # wedged op
+                osd.op_tracker.create(desc="wedged")  # wedged op
                 osd._refresh_op_handle()
                 for _ in range(100):
                     if osd._stopping:
@@ -129,10 +130,11 @@ class TestHeartbeatMap:
             osd = OSD(0, "127.0.0.1:1", config=cfg)
             assert osd.hb_map.is_healthy()
             # simulate a wedged in-flight op without a cluster
-            osd._inflight[1] = {"_t0": time.monotonic() - 1.0}
+            op = osd.op_tracker.create(desc="wedged")
+            op.initiated_at = time.monotonic() - 1.0
             osd._refresh_op_handle()
             assert not osd.hb_map.is_healthy()
-            osd._inflight.clear()
+            osd.op_tracker.finish(op, completed=False)
             osd._refresh_op_handle()
             assert osd.hb_map.is_healthy()
 

@@ -1,0 +1,147 @@
+#!/usr/bin/env python3
+"""Static perf-counter consistency pass (CI gate).
+
+Every ``perf.get(...).inc/set/observe/time("key")`` call site must name
+a key some PerfCounters builder registered via
+``add_counter/add_gauge/add_avg/add_time_avg("key")`` — a typo'd key
+raises KeyError/TypeError only when that exact path runs, which for
+rarely-hit counters means production, not CI.  This pass walks the
+``ceph_tpu`` package's ASTs and fails fast on any literal key used but
+never registered.
+
+Scope rules (pragmatic, zero false positives on this codebase):
+- registrations: any ``*.add_counter/add_gauge/add_avg/add_time_avg``
+  call with a literal first argument, anywhere in the package;
+- usages: ``.inc/.set/.observe/.time`` calls with a literal first
+  argument whose receiver is perf-shaped — its dotted source contains
+  ``perf`` (``self.perf.get("osd").inc``), or it is a local alias
+  assigned from such an expression (``posd = self.perf.get("osd")``);
+- non-literal keys (f-strings like ``f"req_{verb}"``) are skipped on
+  both sides: the dynamic families register and use the same format
+  expressions, and literal typos are the failure class this gate owns.
+
+Usage: ``python tools/check_counters.py [package_dir]`` — exits 0 when
+clean, 1 with a per-site report otherwise.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+
+BUILDERS = {"add_counter", "add_gauge", "add_avg", "add_time_avg"}
+MUTATORS = {"inc", "set", "observe", "time"}
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted source of an attribute/name chain
+    (``self.messenger.perf`` -> "self.messenger.perf")."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif isinstance(node, ast.Call):
+        parts.append(_dotted(node.func))
+    return ".".join(reversed(parts))
+
+
+def _literal_first_arg(call: ast.Call) -> str | None:
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None
+
+
+def _perfish(expr: ast.AST, aliases: set[str]) -> bool:
+    """Is this receiver a PerfCounters? Either its dotted form names
+    perf somewhere, or it is a tracked local alias."""
+    src = _dotted(expr)
+    if "perf" in src.lower():
+        return True
+    head = src.split(".", 1)[0]
+    return head in aliases
+
+
+class _FileScan(ast.NodeVisitor):
+    def __init__(self, path: str):
+        self.path = path
+        self.registered: set[str] = set()
+        self.used: list[tuple[str, int, str]] = []  # (key, line, recv)
+        self.aliases: set[str] = set()
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # X = <perfish>.create("...") / .get("...") / PerfCounters(...)
+        # / <anything>.perf  — X then receives counter mutations
+        value = node.value
+        perfish = False
+        if isinstance(value, ast.Call):
+            f = value.func
+            if isinstance(f, ast.Attribute) and f.attr in ("create", "get"):
+                perfish = "perf" in _dotted(f.value).lower()
+            elif isinstance(f, ast.Name) and f.id == "PerfCounters":
+                perfish = True
+        elif isinstance(value, ast.Attribute):
+            perfish = "perf" in _dotted(value).lower()
+        if perfish:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.aliases.add(t.id)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            key = _literal_first_arg(node)
+            if f.attr in BUILDERS and key is not None:
+                self.registered.add(key)
+            elif f.attr in MUTATORS and key is not None \
+                    and _perfish(f.value, self.aliases):
+                self.used.append((key, node.lineno, _dotted(f.value)))
+        self.generic_visit(node)
+
+
+def check(package_dir: str | pathlib.Path) -> list[str]:
+    """Returns a list of violation strings (empty = clean)."""
+    package_dir = pathlib.Path(package_dir)
+    registered: set[str] = set()
+    used: list[tuple[pathlib.Path, str, int, str]] = []
+    for path in sorted(package_dir.rglob("*.py")):
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except SyntaxError as e:
+            return [f"{path}: unparsable: {e}"]
+        scan = _FileScan(str(path))
+        scan.visit(tree)
+        registered |= scan.registered
+        used.extend((path, k, ln, recv) for k, ln, recv in scan.used)
+    problems = []
+    for path, key, line, recv in used:
+        if key not in registered:
+            problems.append(
+                f"{path}:{line}: {recv}.…({key!r}) uses a counter key "
+                f"no builder registers"
+            )
+    return problems
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    pkg = argv[0] if argv else str(
+        pathlib.Path(__file__).resolve().parent.parent / "ceph_tpu"
+    )
+    problems = check(pkg)
+    for p in problems:
+        print(p, file=sys.stderr)
+    if problems:
+        print(f"{len(problems)} unregistered counter key(s)",
+              file=sys.stderr)
+        return 1
+    print("counter keys: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
