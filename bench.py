@@ -50,6 +50,29 @@ ERASED = [0]  # single-chunk reconstruct, per BASELINE config 2
 
 T0 = time.time()
 
+# every phase attempt (parent side), shipped in the final JSON line so a
+# child dying inside device acquisition still leaves a machine-readable
+# per-phase record instead of an empty trajectory (the BENCH_r05 mode)
+_PHASES: list = []
+
+
+def _phase_note(phase: str, status: str, seconds: float, **extra) -> None:
+    _PHASES.append({
+        "phase": phase, "status": status,
+        "seconds": round(seconds, 2), "t": round(time.time() - T0, 1),
+        **extra,
+    })
+
+
+def _kprof():
+    """The in-process kernel profiler (ceph_tpu.ops.profiler): phase
+    functions reset it on entry and attach its dump to their result, so
+    every emitted JSON line carries compile-vs-execute and jit-cache
+    evidence for the kernels that phase actually ran."""
+    from ceph_tpu.ops.profiler import profiler
+
+    return profiler()
+
 
 def log(msg: str) -> None:
     print(f"[bench +{time.time() - T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
@@ -311,13 +334,24 @@ def bench_device(batch: int, quick: bool, deadline: float | None,
     # pallas lowering failure here must DROP that candidate, not kill
     # the phase (the import-time try above can't see compile errors)
     head_ref = native.encode(P, data_u8[:, :4096])
+    prof = _kprof()
+    prof.reset()  # per-phase window (the bench analog of `perf reset`)
     live: list[tuple[str, object, object]] = []
     for name, enc32, dec32, probe_n4 in cands:
         try:
-            parity_dev = jax.jit(enc32)(data)
+            # first call on each engine = trace + XLA/Mosaic compile:
+            # timed into the profiler so the phase line splits compile
+            # from the steady-state rates recorded after the race
+            with prof.timed(f"gf_encode[{name}]",
+                            ("headline-enc", name, data.shape),
+                            nbytes=data_bytes, shape=data.shape):
+                parity_dev = jax.jit(enc32)(data)
             # the recovery matrix lowers a DIFFERENT unroll — probe it
             # too, or a dec-only Mosaic failure still kills the phase
-            jax.block_until_ready(jax.jit(dec32)(data[:, :probe_n4]))
+            with prof.timed(f"gf_decode[{name}]",
+                            ("headline-dec", name, probe_n4),
+                            nbytes=K * probe_n4 * 4):
+                jax.block_until_ready(jax.jit(dec32)(data[:, :probe_n4]))
             head = np.asarray(parity_dev[:, :1024]).view(np.uint8)
             if not np.array_equal(head, head_ref):
                 # wrong bytes is the exact failure class this probe
@@ -356,6 +390,14 @@ def bench_device(batch: int, quick: bool, deadline: float | None,
         )
         t_by_dir["enc"][name] = t_e
         t_by_dir["dec"][name] = t_d
+        # steady-state per-iteration rate -> jit-cache-hit records (the
+        # compile record above already claimed the miss for this key)
+        prof.record(f"gf_encode[{name}]",
+                    ("headline-enc", name, data.shape), t_e,
+                    nbytes=data_bytes, shape=data.shape, compiled=False)
+        prof.record(f"gf_decode[{name}]",
+                    ("headline-dec-full", name, data.shape), t_d,
+                    nbytes=data_bytes, shape=data.shape, compiled=False)
         engines[name] = {
             "encode_gbps": round(data_bytes / t_e / 1e9, 3),
             "reconstruct_gbps": round(data_bytes / t_d / 1e9, 3),
@@ -386,6 +428,9 @@ def bench_device(batch: int, quick: bool, deadline: float | None,
                 f"{out['stack_gbps']:.2f} GB/s")
         except Exception as e:  # the headline numbers must survive
             log(f"child: codec stack bench failed: {e!r}")
+    # the phase's kernel evidence rides its own JSON line (the codec
+    # stack above reported through the same profiler via matrix_codec)
+    out["kernel_profile"] = prof.dump()
     return out
 
 
@@ -432,6 +477,7 @@ def bench_grid(quick: bool, deadline: float | None,
     G8 = gf(8)
     rng = np.random.default_rng(7)
     out: dict[str, dict] = {}
+    _kprof().reset()  # grid gets its own kernel-profile window
 
     def left() -> float:
         return float("inf") if deadline is None else deadline - time.time()
@@ -692,7 +738,8 @@ def bench_grid(quick: bool, deadline: float | None,
         except Exception as e:
             log(f"grid child: shec failed: {e!r}")
 
-    return {"platform": str(dev), "configs": out}
+    return {"platform": str(dev), "configs": out,
+            "kernel_profile": _kprof().dump()}
 
 
 def bench_crush(deadline: float | None, platform: str | None) -> dict:
@@ -722,6 +769,7 @@ def bench_crush(deadline: float | None, platform: str | None) -> dict:
         return float("inf") if deadline is None else deadline - time.time()
 
     out: dict = {"platform": str(dev)}
+    _kprof().reset()  # crush phase window (vec_rule_stats reports in)
     shapes: dict[str, tuple] = {}
     n_dev, nrep = 64, 3
     cmap = CrushMap.flat(n_dev)
@@ -778,6 +826,7 @@ def bench_crush(deadline: float | None, platform: str | None) -> dict:
                 f"(vs_scalar {cfg['vs_scalar']}x)")
         except Exception as e:
             log(f"crush {name} failed: {e!r}")
+    out["kernel_profile"] = _kprof().dump()
     return out
 
 
@@ -986,6 +1035,7 @@ def probe_device(platform: str | None, timeout: float) -> str | None:
             out, err = "", ""
     finally:
         _CHILDREN.remove(proc)
+    t_spent = time.time() - T0 - attempt["t"]
     if hung:
         stack = (err or "").strip()
         attempt["result"] = "hung"
@@ -996,6 +1046,7 @@ def probe_device(platform: str | None, timeout: float) -> str | None:
             f"relay now: {attempt['relay']}")
         if stack:
             log(f"{name}: child stacks at hang:\n{stack[-1500:]}")
+        _phase_note(name, "hung-in-device-acquisition", t_spent)
         return None
     for line in reversed((out or "").splitlines()):
         try:
@@ -1005,11 +1056,15 @@ def probe_device(platform: str | None, timeout: float) -> str | None:
             continue
         attempt["result"] = f"ok: {plat}"
         log(f"{name}: ok: {plat}")
+        _phase_note(name, f"ok: {plat}", t_spent)
         return plat
     attempt["result"] = f"failed rc={proc.returncode}"
     attempt["stderr_tail"] = (err or "").strip()[-400:]
     log(f"{name}: failed rc={proc.returncode}: "
         f"{(err or '').strip()[-300:]}")
+    # a negative rc is a signal death — the backend-registration crash
+    # class (BENCH_r05: SIGABRT inside xla_bridge.backends)
+    _phase_note(name, f"child-died rc={proc.returncode}", t_spent)
     return None
 
 
@@ -1057,13 +1112,25 @@ def run_combo(phase: str, platform: str | None, batch: int, quick: bool,
                threading.Thread(target=_drain_out, daemon=True)]
     for t in threads:
         t.start()
-    end = time.time() + timeout
+    t_start = time.time()
+    end = t_start + timeout
     while proc.poll() is None and time.time() < end:
         time.sleep(0.25)
     if proc.poll() is None:
         log(f"phase {phase}: child TIMED OUT after {timeout:.0f}s, killed "
             f"(kept sub-phases: {sorted(results)})")
         _kill_child(proc)
+        _phase_note(phase, "timeout", time.time() - t_start,
+                    kept=sorted(results))
+    elif not results:
+        # the BENCH_r05 class: the child died (backend-registration
+        # abort) before any sub-phase answered — record it so the final
+        # line's phase breakdown shows WHERE the trajectory emptied out
+        _phase_note(phase, f"child-died rc={proc.returncode}",
+                    time.time() - t_start)
+    else:
+        _phase_note(phase, "ok", time.time() - t_start,
+                    kept=sorted(results))
     _CHILDREN.remove(proc)
     for t in threads:
         t.join(timeout=3)
@@ -1120,7 +1187,24 @@ def combo_main(args) -> None:
             log(f"combo child: headline retry failed: {e!r}")
 
 
+def _maybe_inject_fault() -> None:
+    """Test hook for the BENCH_r05 failure mode: with
+    CEPH_TPU_BENCH_FAULT=backend-death every bench CHILD dies the way
+    the axon PJRT plugin did — a hard abort during backend registration
+    (inside jax.devices() -> xla_bridge.backends), before any result
+    line.  The parent must still finish with a parseable final JSON
+    line (phase native-only or jax-cpu) carrying the phase record."""
+    if os.environ.get("CEPH_TPU_BENCH_FAULT") == "backend-death":
+        print(
+            'Fatal Python error: Aborted (injected CEPH_TPU_BENCH_FAULT)\n'
+            '  File "jax/_src/xla_bridge.py", line 824 in backends',
+            file=sys.stderr, flush=True,
+        )
+        os.abort()
+
+
 def child_main(args) -> None:
+    _maybe_inject_fault()  # dies HERE, like a backend-registration crash
     deadline = args._deadline or None
     if args._probe:
         import faulthandler
@@ -1153,6 +1237,7 @@ def child_main(args) -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+        _kprof().reset()
         res = {"stack_gbps": _bench_codec_stack(deadline)}
         try:
             # raw codec rate on the SAME backend for the honest ratio
@@ -1171,6 +1256,8 @@ def child_main(args) -> None:
             )
         except Exception as e:
             log(f"stack child: raw-rate bench failed: {e!r}")
+        # the ec_util path reports through matrix_codec's profiler taps
+        res["kernel_profile"] = _kprof().dump()
         print(json.dumps(res), flush=True)
         return
     if args._grid:
@@ -1202,6 +1289,10 @@ def result_line(dev: dict, cpu: dict, phase: str) -> dict:
         ),
         **({"engine": dev["engine"]} if "engine" in dev else {}),
         **({"engines": dev["engines"]} if "engines" in dev else {}),
+        **(
+            {"kernel_profile": dev["kernel_profile"]}
+            if "kernel_profile" in dev else {}
+        ),
     }
 
 
@@ -1238,7 +1329,9 @@ def main():
     _DIAG["start"] = _diag_snapshot("start")
 
     log("phase native: single-thread C++ baseline")
+    t0_nat = time.time()
     cpu = bench_native(quick=quick)
+    _phase_note("native", "ok", time.time() - t0_nat)
     log(f"phase native: encode {cpu['encode_gbps']:.2f} "
         f"reconstruct {cpu['reconstruct_gbps']:.2f} GB/s")
     # a parseable line exists from here on, whatever happens later
@@ -1247,11 +1340,14 @@ def main():
 
     # the HONEST baseline (VERDICT r2 Weak #2): all cores, not one thread
     mc: dict | None = None
+    t0_mc = time.time()
     try:
         mc = bench_native_multicore(quick=quick)
+        _phase_note("native-mc", "ok", time.time() - t0_mc)
         log(f"phase native-mc: {mc['workers']} workers, combined "
             f"{mc['combined_gbps']:.2f} GB/s")
     except Exception as e:
+        _phase_note("native-mc", f"failed: {e!r:.120}", time.time() - t0_mc)
         log(f"phase native-mc failed: {e!r}")
 
     # cpu codec-stack measurement (VERDICT r4 #4: stack_gbps must reach
@@ -1265,6 +1361,7 @@ def main():
         if stack_res or budget_s < 20:
             return
         stack_res["failed"] = True  # replaced on success; never re-run
+        t0_stack = time.time()
         try:
             proc = _spawn(
                 "stack",
@@ -1293,8 +1390,11 @@ def main():
             if "stack_gbps" in obj:
                 stack_res.pop("failed", None)
                 stack_res.update(obj)
-                log(f"stack child: {obj}")
+                _phase_note("stack", "ok", time.time() - t0_stack)
+                log(f"stack child: {json.dumps(obj)[:400]}")
                 return
+        _phase_note("stack", f"no-result rc={proc.returncode}",
+                    time.time() - t0_stack)
         log(f"stack child: no result (rc={proc.returncode})")
 
     # accumulated results per backend; TPU results trump jax-cpu ones
@@ -1335,6 +1435,22 @@ def main():
             for key in ("raw_cpu_gbps", "stack_vs_raw"):
                 if key in stack_res:
                     final[key] = stack_res[key]
+        if "kernel_profile" not in final:
+            # any backend's headline (or the serial stack child) that
+            # carried kernel evidence beats emitting none at all
+            for backend in ("tpu", "jax-cpu", f"jax-{args.platform}"):
+                kp = acc.get(backend, {}).get("headline", {}) \
+                        .get("kernel_profile")
+                if kp:
+                    final["kernel_profile"] = kp
+                    break
+            else:
+                if stack_res.get("kernel_profile"):
+                    final["kernel_profile"] = stack_res["kernel_profile"]
+        # the per-phase attempt record ALWAYS ships — on a child dying
+        # inside device acquisition this is the breakdown the bench
+        # trajectory was previously missing entirely
+        final["phases"] = list(_PHASES)
         if not acc.get("tpu"):
             # no TPU answered this round: ship the captured evidence in
             # the machine-readable line itself (VERDICT r4 #1: "a logged
@@ -1406,10 +1522,24 @@ def main():
             )
         )
 
+    def _cpu_batch(remaining: float) -> int:
+        """The jax-cpu fallback's batch: a 1-core host cannot push the
+        full 64 MiB chained scans through a short budget (a 45 s cpu
+        run died mid-headline with zero kernel evidence) — the marginal
+        rate is bytes-normalized, so a smaller batch trades noise for
+        actually finishing."""
+        if remaining < 180 and args.batch > 8:
+            log(f"cpu fallback: shrinking batch {args.batch} -> 8 "
+                f"({remaining:.0f}s left)")
+            return 8
+        return args.batch
+
     if args.platform:
         backend = f"jax-{args.platform}"
         remaining = t_end - time.time()
-        run_combo(backend, args.platform, args.batch, quick,
+        batch = (_cpu_batch(remaining) if args.platform == "cpu"
+                 else args.batch)
+        run_combo(backend, args.platform, batch, quick,
                   max(30.0, remaining - 10), on_result=collect(backend))
     else:
         # VERDICT r3 #1 / r4 #1: the TPU phase must be un-losable AND
@@ -1462,7 +1592,7 @@ def main():
                 # stop instead of burning the budget on probes
                 log("default jax backend is CPU; no TPU to wait for")
                 if not acc.get("jax-cpu"):
-                    run_combo("jax-cpu", "cpu", args.batch, quick,
+                    run_combo("jax-cpu", "cpu", _cpu_batch(t_end - time.time()), quick,
                               max(40.0, t_end - time.time() - 10),
                               on_result=collect("jax-cpu"))
                 break
@@ -1495,7 +1625,7 @@ def main():
                 # never below a usable floor: with ~60s left a quick cpu
                 # headline still beats no accelerator number at all
                 # (r4 review: the uncapped formula went negative)
-                run_combo("jax-cpu", "cpu", args.batch, quick,
+                run_combo("jax-cpu", "cpu", _cpu_batch(t_end - time.time()), quick,
                           max(30.0, min(max(120.0, 0.4 * remaining),
                                         remaining - 75)),
                           on_result=collect("jax-cpu"))

@@ -10,7 +10,14 @@ unix admin socket serving `perf dump` / `config show|set` /
 """
 
 from .config import Config, Option, OPTIONS
-from .perf_counters import PerfCounters, PerfCountersCollection
+from .perf_counters import (
+    PerfCounters,
+    PerfCountersCollection,
+    PerfHistogram,
+    PerfHistogramAxis,
+    latency_axis,
+    size_latency_axes,
+)
 from .admin_socket import AdminSocket, register_common
 from .heartbeat_map import HeartbeatHandle, HeartbeatMap
 from .lockdep import LockdepLock, LockOrderViolation, lockdep_enable
@@ -29,6 +36,10 @@ __all__ = [
     "OPTIONS",
     "PerfCounters",
     "PerfCountersCollection",
+    "PerfHistogram",
+    "PerfHistogramAxis",
+    "latency_axis",
+    "size_latency_axes",
     "AdminSocket",
     "register_common",
     "HeartbeatHandle",

@@ -20,6 +20,17 @@ logger = logging.getLogger("ceph_tpu.admin")
 Handler = Callable[[dict], Any]  # request dict -> json-able reply
 
 
+def _kernel_profiler():
+    """The process-global ops.profiler singleton, or None when the ops
+    package is unavailable (profiler.py itself never imports jax, so
+    this cannot initialize a backend)."""
+    try:
+        from ..ops.profiler import profiler
+    except Exception:  # pragma: no cover - broken partial install
+        return None
+    return profiler()
+
+
 class AdminSocket:
     def __init__(self, path: str):
         self.path = path
@@ -81,12 +92,48 @@ class AdminSocket:
 
 def register_common(asok: "AdminSocket", *, perf=None, config=None) -> None:
     """The observability commands every daemon serves — one wiring for
-    osd/mon/mgr so the surfaces cannot drift: ``perf dump``, ``config
-    show|diff|set``, ``log dump``, ``dump_tracepoints`` (optionally
-    filtered to one trace id via {"trace": ...})."""
+    osd/mon/mgr/rgw so the surfaces cannot drift: ``perf dump`` /
+    ``perf schema`` / ``perf reset``, ``dump_histograms``,
+    ``dump_kernel_profile``, ``config show|diff|set``, ``log dump``,
+    ``dump_tracepoints`` (optionally filtered to one trace id via
+    {"trace": ...})."""
     if perf is not None:
         asok.register("perf dump", lambda req: perf.dump(),
                       "typed performance counters")
+        asok.register("perf schema", lambda req: perf.schema(),
+                      "counter types/descriptions + histogram axes")
+
+        def _perf_reset(req: dict) -> dict:
+            names = perf.reset(req.get("name", "all"))
+            return {"success": f"reset {', '.join(names)}"}
+
+        asok.register("perf reset", _perf_reset,
+                      "zero accumulated counters ({'name': subsys|all})")
+
+        def _dump_histograms(req: dict) -> dict:
+            out = perf.dump_histograms()
+            kp = _kernel_profiler()
+            if kp is not None:
+                h = kp.dump_histograms()
+                if h:
+                    # the process-wide kernel engines ride next to the
+                    # daemon subsystems (every daemon in this process
+                    # shares the one jit cache they describe)
+                    out["kernel"] = h
+            return out
+
+        asok.register("dump_histograms", _dump_histograms,
+                      "log2-bucketed size/latency distributions")
+
+    def _dump_kernel_profile(req: dict):
+        kp = _kernel_profiler()
+        if kp is None:
+            return {"error": "kernel profiler unavailable"}
+        return kp.dump()
+
+    asok.register("dump_kernel_profile", _dump_kernel_profile,
+                  "JAX/Pallas kernel timings: compile vs execute, "
+                  "jit-cache hits/misses, batch shapes per engine")
     if config is not None:
         asok.register("config show", lambda req: config.show(),
                       "every option with its current value")

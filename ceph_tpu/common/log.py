@@ -11,8 +11,8 @@ crash-dump hook the daemons call on abort.
 from __future__ import annotations
 
 import logging
-import time
 from collections import deque
+from datetime import datetime
 
 _handler: "MemoryLog | None" = None
 
@@ -79,11 +79,17 @@ def memory_log() -> "MemoryLog | None":
 
 def dump_recent(n: int = 200) -> list[str]:
     """Crash-time dump (reference: dump_recent on assert): formatted
-    lines of the newest entries, newest last."""
+    lines of the newest entries, newest last.
+
+    Timestamps are full ISO-8601 with milliseconds (local time): a
+    bare %H:%M:%S had no date and no subsecond precision, so crash
+    dumps could not be correlated with trace events or prometheus
+    scrapes across a midnight boundary or within one busy second.
+    """
     if _handler is None:
         return []
     return [
-        f"{time.strftime('%H:%M:%S', time.localtime(e['ts']))} "
+        f"{datetime.fromtimestamp(e['ts']).isoformat(timespec='milliseconds')} "
         f"{e['level']:<8} {e['subsys']}: {e['msg']}"
         for e in _handler.recent(n)
     ]

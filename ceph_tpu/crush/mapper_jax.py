@@ -640,6 +640,27 @@ def vec_rule_stats(
     result_max: int,
     weight=None,
 ) -> tuple[dict[int, int], int]:
+    """Profiled entry over :func:`_vec_rule_stats` — every bulk-sim
+    call reports into the kernel profiler (ops.profiler): wall time,
+    jit-cache behavior keyed on the lane count, and batch shapes, so
+    ``dump_kernel_profile`` sees the CRUSH engine next to the EC ones."""
+    from ..ops.profiler import profiler
+
+    xs_np = np.asarray(xs, dtype=np.uint32)
+    with profiler().timed(
+        "crush_vec_stats", (ruleno, xs_np.shape, result_max),
+        nbytes=xs_np.size * 4, shape=xs_np.shape,
+    ):
+        return _vec_rule_stats(cmap, ruleno, xs_np, result_max, weight)
+
+
+def _vec_rule_stats(
+    cmap: CrushMap,
+    ruleno: int,
+    xs,
+    result_max: int,
+    weight=None,
+) -> tuple[dict[int, int], int]:
     """Bulk-sim statistics computed ON DEVICE: ({item: count}, bad_mappings).
 
     The CrushTester path: for 10^6 x a full [X, W] host fetch dwarfs the
@@ -760,6 +781,24 @@ def _flat_engine(cmap, ruleno, xs_np, result_max, weight):
 
 
 def vec_do_rule(
+    cmap: CrushMap,
+    ruleno: int,
+    xs,
+    result_max: int,
+    weight=None,
+) -> np.ndarray:
+    """Profiled entry over :func:`_vec_do_rule` (see vec_rule_stats)."""
+    from ..ops.profiler import profiler
+
+    xs_np = np.asarray(xs, dtype=np.uint32)
+    with profiler().timed(
+        "crush_vec_rule", (ruleno, xs_np.shape, result_max),
+        nbytes=xs_np.size * 4, shape=xs_np.shape,
+    ):
+        return _vec_do_rule(cmap, ruleno, xs_np, result_max, weight)
+
+
+def _vec_do_rule(
     cmap: CrushMap,
     ruleno: int,
     xs,

@@ -403,13 +403,63 @@ class PrometheusModule(MgrModule):
     COMMANDS = {"metrics": "metrics"}
 
     @staticmethod
-    def _emit_daemon(lines: list[str], daemon: str, perf: dict) -> None:
+    def _emit_histogram(lines: list[str], base: str, daemon_esc: str,
+                        hist: dict) -> None:
+        """One PerfHistogram dump -> prometheus histogram series:
+        ``<base>_bucket{le=...}`` cumulative counts plus ``_sum`` /
+        ``_count``.  The LAST axis is the ``le`` axis; a 2D (size x
+        latency) grid is flattened by summing the size axis away —
+        a pure column sum, so the flattening is deterministic and the
+        +Inf bucket always equals ``_count``."""
+        axes = hist.get("axes") or []
+        values = hist.get("values") or []
+        if not axes:
+            return
+        le_axis = axes[-1]
+        if len(axes) == 1:
+            counts = [int(v) for v in values]
+        else:
+            counts = [
+                sum(int(row[j]) for row in values)
+                for j in range(le_axis["buckets"])
+            ]
+        # bucket uppers mirror PerfHistogramAxis.upper()
+        amin, quant = float(le_axis["min"]), float(le_axis.get("quant", 1))
+        log2 = le_axis.get("scale", "log2") == "log2"
+        cum = 0
+        for i, c in enumerate(counts):
+            cum += c
+            if i >= len(counts) - 1:
+                le = "+Inf"
+            elif log2:
+                le = format(amin * (2 ** i), "g")
+            else:
+                le = format(amin + i * quant, "g")
+            lines.append(
+                f'{base}_bucket{{daemon="{daemon_esc}",le="{le}"}} {cum}'
+            )
+        lines.append(
+            f'{base}_sum{{daemon="{daemon_esc}"}} '
+            f'{float(hist.get("sum") or 0.0)}'
+        )
+        lines.append(
+            f'{base}_count{{daemon="{daemon_esc}"}} '
+            f'{int(hist.get("count") or 0)}'
+        )
+
+    @classmethod
+    def _emit_daemon(cls, lines: list[str], daemon: str, perf: dict) -> None:
         """One daemon's full counter dump -> exposition lines; every
         registered counter appears exactly once per daemon."""
-        lab = f'{{daemon="{_prom_escape(daemon)}"}}'
+        esc = _prom_escape(daemon)
+        lab = f'{{daemon="{esc}"}}'
         for subsys, counters in sorted((perf or {}).items()):
             for key, val in sorted(counters.items()):
                 base = f"ceph_{subsys}_{key}"
+                if isinstance(val, dict) and "histogram" in val:
+                    cls._emit_histogram(lines, base, esc,
+                                        val["histogram"])
+                    continue
                 if isinstance(val, dict):
                     # PerfCounters avg dump: {avgcount, sum, avg, ...}
                     s = float(val.get("sum") or 0.0)

@@ -40,6 +40,7 @@ from ..ops.gf_jax import (
     make_xor_parity_u32,
     u32_to_bytes,
 )
+from ..ops.profiler import profiler
 from .base import ErasureCode
 from .interface import ErasureCodeValidationError
 
@@ -147,15 +148,22 @@ class MatrixErasureCode(ErasureCode):
         """u32-lane fast path ([k, N4] uint32 -> [m, N4] uint32): the
         OSD data path (ec_util) keeps the whole pipeline in u32 so the
         only byte movement is the stripe-layout transpose."""
-        fn32 = _jit_matmul_u32(_mkey(self.matrix), self.w)
-        return np.asarray(fn32(d32))
+        mk = _mkey(self.matrix)
+        fn32 = _jit_matmul_u32(mk, self.w)
+        # kernel-boundary tap (ops.profiler): the (matrix, shape) key is
+        # the jit-cache signature, so compile-vs-cached splits honestly
+        with profiler().timed("gf_encode", (mk, d32.shape),
+                              nbytes=d32.size * 4, shape=d32.shape):
+            return np.asarray(fn32(d32))
 
     def encode_shards_u32(self, d3: np.ndarray) -> np.ndarray:
         """The OSD stack's hot entry: [S, k, C4] u32 stripe view ->
         [k+m, S*C4] u32 shard rows, transpose+matmul+concat fused in
         one device call (see _jit_encode_shards_u32)."""
         fn = _jit_encode_shards_u32(_mkey(self.matrix), self.w)
-        return np.asarray(fn(d3))
+        with profiler().timed("ec_shards", (_mkey(self.matrix), d3.shape),
+                              nbytes=d3.size * 4, shape=d3.shape):
+            return np.asarray(fn(d3))
 
     # -- decode -------------------------------------------------------------
 
@@ -207,12 +215,18 @@ class MatrixErasureCode(ErasureCode):
             # CPU host: the native GFNI/u64 engine reconstructs with no
             # host<->device copies (same routing policy as the encode
             # stack; bytes identical — the GF algebra is exact)
-            return _native.encode(RM, arr)
+            with profiler().timed("gf_decode_native",
+                                  (_mkey(RM), arr.shape),
+                                  nbytes=arr.size, shape=arr.shape,
+                                  compiled=False):
+                return _native.encode(RM, arr)
         if arr.shape[-1] % 4 == 0:
             # decode stays on the u32 lanes too (free host views, no
             # device relayout) — same policy as encode_chunks
             fn32 = _jit_matmul_u32(_mkey(RM), self.w)
-            return u32_to_bytes(np.asarray(fn32(bytes_to_u32(arr))))
+            with profiler().timed("gf_decode", (_mkey(RM), arr.shape),
+                                  nbytes=arr.size, shape=arr.shape):
+                return u32_to_bytes(np.asarray(fn32(bytes_to_u32(arr))))
         fn = _jit_matmul(_mkey(RM), self.w)
         return np.asarray(fn(arr))
 
@@ -280,16 +294,19 @@ class BitmatrixErasureCode(ErasureCode):
 
     def encode_chunks(self, data_chunks: np.ndarray) -> np.ndarray:
         pk = self._to_packets(np.asarray(data_chunks, dtype=np.uint8))
-        if pk.shape[-1] % 4 == 0:
-            fn32 = _jit_bitmatmul_u32(
-                self.bitmatrix.tobytes(), *self.bitmatrix.shape
-            )
-            out = u32_to_bytes(np.asarray(fn32(bytes_to_u32(pk))))
-        else:
-            fn = _jit_bitmatmul(
-                self.bitmatrix.tobytes(), *self.bitmatrix.shape
-            )
-            out = np.asarray(fn(pk))
+        with profiler().timed("bitmatrix_encode",
+                              (self.bitmatrix.tobytes(), pk.shape),
+                              nbytes=pk.size, shape=pk.shape):
+            if pk.shape[-1] % 4 == 0:
+                fn32 = _jit_bitmatmul_u32(
+                    self.bitmatrix.tobytes(), *self.bitmatrix.shape
+                )
+                out = u32_to_bytes(np.asarray(fn32(bytes_to_u32(pk))))
+            else:
+                fn = _jit_bitmatmul(
+                    self.bitmatrix.tobytes(), *self.bitmatrix.shape
+                )
+                out = np.asarray(fn(pk))
         return self._from_packets(out, self.m)
 
     def _recovery_bitmatrix(
@@ -342,12 +359,15 @@ class BitmatrixErasureCode(ErasureCode):
             )
         RM = self._recovery_bitmatrix(present, missing)
         pk = self._to_packets(np.asarray(chunks, dtype=np.uint8))
-        if pk.shape[-1] % 4 == 0:
-            fn32 = _jit_bitmatmul_u32(RM.tobytes(), *RM.shape)
-            out = u32_to_bytes(np.asarray(fn32(bytes_to_u32(pk))))
-        else:
-            fn = _jit_bitmatmul(RM.tobytes(), *RM.shape)
-            out = np.asarray(fn(pk))
+        with profiler().timed("bitmatrix_decode",
+                              (RM.tobytes(), pk.shape),
+                              nbytes=pk.size, shape=pk.shape):
+            if pk.shape[-1] % 4 == 0:
+                fn32 = _jit_bitmatmul_u32(RM.tobytes(), *RM.shape)
+                out = u32_to_bytes(np.asarray(fn32(bytes_to_u32(pk))))
+            else:
+                fn = _jit_bitmatmul(RM.tobytes(), *RM.shape)
+                out = np.asarray(fn(pk))
         return self._from_packets(out, len(missing))
 
 

@@ -18,6 +18,7 @@ import asyncio
 import json
 import logging
 import struct
+import time
 from typing import Optional
 
 from ..common.tracing import current_trace, new_trace_id
@@ -76,6 +77,7 @@ class Connection:
         perf = self.messenger.perf
         perf.inc("msg_send")
         perf.inc("bytes_send", len(frame))
+        perf.hist("send_bytes_histogram", len(frame))
         self._sendq.put_nowait(frame)
 
     async def _writer_loop(self) -> None:
@@ -138,8 +140,13 @@ class Connection:
                     # at the client follows the op across daemons
                     current_trace.set(msg.trace)
                     try:
-                        with perf.time("dispatch_latency"):
+                        t0 = time.perf_counter()
+                        try:
                             await self.messenger._dispatch(self, msg)
+                        finally:
+                            dt = time.perf_counter() - t0
+                            perf.observe("dispatch_latency", dt)
+                            perf.hist("dispatch_histogram", n, dt)
                     except Exception:
                         # a handler bug must not tear down the peer link
                         logger.exception(
@@ -223,7 +230,7 @@ class AsyncMessenger:
         # wire-level observability (reference:src/msg/DispatchQueue.cc
         # l_msgr_* counters): daemons attach this into their
         # PerfCountersCollection so it rides `perf dump` / mgr reports
-        from ..common.perf_counters import PerfCounters
+        from ..common.perf_counters import PerfCounters, PerfHistogramAxis
 
         self.perf = PerfCounters("msgr")
         (self.perf
@@ -238,7 +245,17 @@ class AsyncMessenger:
          .add_gauge("dispatch_queue_bytes",
                     "inbound bytes held by the dispatch throttle")
          .add_time_avg("dispatch_latency",
-                       "handler wall time per inbound message"))
+                       "handler wall time per inbound message")
+         # log2 frame-size / dispatch-time distributions: the averages
+         # above hide bimodal wire traffic (tiny heartbeats vs MiB
+         # sub-writes), which is exactly what a histogram separates
+         .add_histogram("send_bytes_histogram",
+                        "outbound frame size distribution",
+                        axes=[PerfHistogramAxis(
+                            "frame_bytes", min=64, buckets=20,
+                            unit="bytes")])
+         .add_histogram("dispatch_histogram",
+                        "inbound frame size x handler wall time"))
 
     def apply_config(self, cfg) -> None:
         """Adopt the ms_* options from a Config."""

@@ -1,0 +1,172 @@
+"""Kernel-boundary profiler for the JAX/Pallas EC kernels and the
+vectorized CRUSH mapper.
+
+The hot path the paper cares about — GF(2^8) encode/decode behind
+``ErasureCodePluginTPU`` and ``crush.mapper_jax`` — previously had zero
+internal visibility: a bench run dying inside backend acquisition left
+no phase breakdown at all (BENCH_r01..r05).  This module is the
+process-global timing tap every host-side kernel entry reports into:
+
+- **trace/compile vs execute split**: jitted callables compile once per
+  (program, input-shape) signature; the first call on a new signature
+  pays tracing + XLA/Mosaic compilation on top of the execution.  The
+  profiler keys every call on the caller-supplied signature and counts
+  first sightings as ``compile`` calls (their wall time includes the
+  first execution — JAX gives no portable hook to separate them; the
+  steady-state ``exec`` numbers are the clean ones) and repeats as
+  jit-cache ``hits``.
+- **per-engine batch shapes**: which [k, N] / [n_x] shapes actually hit
+  each engine, so batching regressions (a shape explosion defeating the
+  jit cache) are visible instead of inferred.
+- **per-engine latency histograms**: every call lands in a 2D
+  (bytes x seconds) log2 PerfHistogram, served via the admin-socket
+  ``dump_histograms`` command next to the daemon subsystems and dumped
+  by ``dump_kernel_profile``.
+
+Deliberately import-light: no jax import, so the admin socket (and
+tools that never touch a device) can serve profiler state without
+initializing a backend.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Any, Hashable
+
+from ..common.perf_counters import PerfHistogram, size_latency_axes
+
+# kernel-call latencies start ~1 us (cached host dispatch) — a finer
+# floor than the daemon op histograms
+_KERNEL_AXES = dict(size_min=4096.0, lat_min=1e-6)
+
+
+class _EngineStats:
+    __slots__ = ("calls", "compile_calls", "cache_hits", "compile_time",
+                 "exec_time", "bytes", "exec_bytes", "shapes", "hist")
+
+    def __init__(self):
+        self.calls = 0
+        self.compile_calls = 0
+        self.cache_hits = 0
+        self.compile_time = 0.0
+        self.exec_time = 0.0
+        self.bytes = 0
+        self.exec_bytes = 0  # cached-call bytes only, for exec_gbps
+        self.shapes: dict[str, int] = {}
+        self.hist = PerfHistogram(size_latency_axes(**_KERNEL_AXES))
+
+
+class KernelProfiler:
+    """Process-global per-engine kernel timing (see module docstring).
+
+    An *engine* is a kernel family as the codec layer routes it
+    ("gf_encode", "ec_shards", "bitmatrix_decode", "crush_vec", ...);
+    a *key* is the jit-cache signature the caller knows (matrix
+    signature + batch shape), used to classify compile vs cached calls.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._engines: dict[str, _EngineStats] = {}
+        # compile signatures OUTLIVE reset(): jax's jit cache is not
+        # cleared by a profiler reset, so a warmed key stays a hit
+        self._seen: set[tuple[str, Hashable]] = set()
+        self._reset_at = time.time()
+
+    # -- recording -----------------------------------------------------------
+    def record(self, engine: str, key: Hashable, seconds: float,
+               nbytes: int = 0, shape: Any = None,
+               compiled: bool | None = None) -> None:
+        """``compiled`` overrides the first-sighting classification for
+        callers that know (bench.py records a chained-scan marginal as
+        steady-state even on a shape it never timed standalone)."""
+        sig = (engine, key)
+        with self._lock:
+            st = self._engines.get(engine)
+            if st is None:
+                st = self._engines[engine] = _EngineStats()
+            st.calls += 1
+            st.bytes += int(nbytes)
+            was_compile = (sig not in self._seen) if compiled is None \
+                else compiled
+            self._seen.add(sig)
+            if was_compile:
+                st.compile_calls += 1
+                st.compile_time += seconds
+            else:
+                st.cache_hits += 1
+                st.exec_time += seconds
+                st.exec_bytes += int(nbytes)
+            if shape is not None:
+                s = str(tuple(shape))
+                st.shapes[s] = st.shapes.get(s, 0) + 1
+        st.hist.sample(max(float(nbytes), 0.0), seconds)
+
+    @contextlib.contextmanager
+    def timed(self, engine: str, key: Hashable, nbytes: int = 0,
+              shape: Any = None, compiled: bool | None = None):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(engine, key, time.perf_counter() - t0,
+                        nbytes=nbytes, shape=shape, compiled=compiled)
+
+    # -- views ---------------------------------------------------------------
+    def dump(self) -> dict:
+        """JSON-able per-engine breakdown (``dump_kernel_profile``)."""
+        with self._lock:
+            engines = {}
+            for name, st in sorted(self._engines.items()):
+                engines[name] = {
+                    "calls": st.calls,
+                    "jit_cache": {
+                        "misses": st.compile_calls,
+                        "hits": st.cache_hits,
+                    },
+                    # first-call time includes the first execution (no
+                    # portable trace/compile-only hook in jax)
+                    "compile_time": round(st.compile_time, 6),
+                    "exec_time": round(st.exec_time, 6),
+                    # steady-state bytes over steady-state time: mixing
+                    # compile-call bytes in would inflate the rate by
+                    # (1 + misses/hits)
+                    "exec_gbps": round(
+                        st.exec_bytes / st.exec_time / 1e9, 3
+                    ) if st.exec_time > 0 else None,
+                    "bytes": st.bytes,
+                    "shapes": dict(st.shapes),
+                }
+            return {
+                "since": self._reset_at,
+                "engines": engines,
+            }
+
+    def dump_histograms(self) -> dict:
+        with self._lock:
+            return {
+                name: st.hist.dump()
+                for name, st in sorted(self._engines.items())
+            }
+
+    def reset(self) -> None:
+        """Clear the accumulated stats (bench phase boundaries); the
+        compile-signature set survives — see __init__."""
+        with self._lock:
+            self._engines.clear()
+            self._reset_at = time.time()
+
+
+_profiler: KernelProfiler | None = None
+_profiler_lock = threading.Lock()
+
+
+def profiler() -> KernelProfiler:
+    global _profiler
+    if _profiler is None:
+        with _profiler_lock:
+            if _profiler is None:
+                _profiler = KernelProfiler()
+    return _profiler

@@ -234,6 +234,11 @@ class OSD(Dispatcher):
         posd.add_counter("op_err", "client ops answered with an error")
         posd.add_counter("subop_w", "sub-writes applied on this shard")
         posd.add_time_avg("op_latency", "client op wall time")
+        # 2D log2 (payload bytes x latency) grid — the reference's
+        # l_osd_op_*_lat_*_hist perf histograms, served raw via
+        # dump_histograms and flattened to prometheus _bucket series
+        posd.add_histogram("op_latency_histogram",
+                           "client op payload size x wall time")
         # slow-request visibility (reference OpTracker
         # check_ops_in_flight -> the SLOW_OPS health warning): gauges
         # refreshed at each mgr report from the live tracker state
@@ -260,6 +265,10 @@ class OSD(Dispatcher):
                       "mesh-engine reconstruct GB/s (last call)")
         pec.add_time_avg("encode_time", "device encode wall time")
         pec.add_time_avg("decode_time", "device decode wall time")
+        pec.add_histogram("encode_time_histogram",
+                          "EC encode buffer size x device wall time")
+        pec.add_histogram("decode_time_histogram",
+                          "EC decode shard bytes x device wall time")
         # the mesh EC data path (osd_ec_mesh): shard rows on mesh rows,
         # ICI all-gather reconstruct; None = host/TCP-only path
         self.ec_mesh = None
@@ -865,14 +874,25 @@ class OSD(Dispatcher):
                      oid=msg.oid, ops=names)
         replied = False
         try:
-            with posd.time("op_latency"):
-                try:
-                    result, out, blobs = await self._execute_op(msg, conn)
-                except asyncio.CancelledError:
-                    raise
-                except Exception as e:
-                    logger.exception("%s: op tid=%s failed", self.name, msg.tid)
-                    result, out, blobs = -EIO, [{"error": str(e)}], []
+            t0 = time.perf_counter()
+            try:
+                result, out, blobs = await self._execute_op(msg, conn)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                logger.exception("%s: op tid=%s failed", self.name, msg.tid)
+                result, out, blobs = -EIO, [{"error": str(e)}], []
+            dt = time.perf_counter() - t0
+            posd.observe("op_latency", dt)
+            # in+out payload x latency: reads land on their returned
+            # bytes, writes on their submitted bytes, so a size-skewed
+            # latency regression shows in the right bucket row
+            posd.hist(
+                "op_latency_histogram",
+                sum(len(b) for b in msg.blobs)
+                + sum(len(b) for b in blobs),
+                dt,
+            )
             _trace.point("osd_op_reply", osd=self.osd_id, tid=msg.tid,
                          result=result)
             if result < 0:
@@ -1606,6 +1626,7 @@ class OSD(Dispatcher):
             yield
         dt = time.perf_counter() - t0
         pec.observe(f"{op}_time", dt)
+        pec.hist(f"{op}_time_histogram", nbytes, dt)
         if dt > 0:
             pec.set(f"mesh_{op}_gbps" if mesh else f"{op}_gbps",
                     nbytes / dt / 1e9)

@@ -142,8 +142,19 @@ def encode(
     if cs % 8 == 0 and _native_matrix_engine(ec_impl):
         # one C pass produces shard rows + parity (transpose and matmul
         # fused — no second read of the input)
+        from ..ops.profiler import profiler
+
         m = ec_impl.get_coding_chunk_count()
-        out_arr = native.encode_stripes(ec_impl.matrix, buf, S, cs)
+        # the OSD's CPU-host hot path bypasses the jax codec entries, so
+        # it must report into the kernel profiler here or the daemon's
+        # dump_kernel_profile is empty exactly where the stack runs;
+        # no jit cache on the C engine -> every call is steady-state
+        with profiler().timed(
+            "native_stripes_encode",
+            (ec_impl.matrix.tobytes(), S, cs),
+            nbytes=buf.size, shape=(S, k, cs), compiled=False,
+        ):
+            out_arr = native.encode_stripes(ec_impl.matrix, buf, S, cs)
         return {i: out_arr[i] for i in range(k + m)}
     encs = getattr(ec_impl, "encode_shards_u32", None)
     if (

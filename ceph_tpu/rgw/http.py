@@ -35,6 +35,7 @@ import hashlib
 import hmac
 import json
 import logging
+import time
 from urllib.parse import parse_qs, unquote, urlsplit
 
 from .store import RGWError, RGWStore
@@ -188,10 +189,16 @@ class S3Server:
     _VERBS = ("get", "put", "post", "head", "delete", "copy")
 
     def __init__(self, store: RGWStore, stats_interval: float = 1.0,
-                 name: str | None = None):
+                 name: str | None = None,
+                 admin_socket: str | None = None):
         self.store = store
         self._server: asyncio.AbstractServer | None = None
         self.addr = ""
+        # `ceph daemon rgw.<zone> <cmd>` surface (perf dump/schema/
+        # reset, dump_histograms, dump_kernel_profile); '{name}'
+        # expands like the daemon config pattern
+        self._admin_path = admin_socket
+        self._admin = None
         # mgr-report identity: must be instance-unique or two gateways
         # serving the same zone clobber each other's mgr.daemon_stats
         # entry (and their prometheus series flip-flop); the default
@@ -216,7 +223,12 @@ class S3Server:
          .add_counter("req_4xx", "requests answered 4xx")
          .add_counter("req_5xx", "requests answered 5xx")
          .add_counter("bytes_in", "request body bytes")
-         .add_counter("bytes_out", "response payload bytes"))
+         .add_counter("bytes_out", "response payload bytes")
+         # payload size x wall time across all verbs: the per-verb
+         # latency avgs above collapse a 100-byte HEAD and a 64 MiB PUT
+         # into one number; the 2D grid keeps them apart
+         .add_histogram("req_latency_histogram",
+                        "request payload size x wall time"))
         self.stats_interval = stats_interval
         self._stats_task: asyncio.Task | None = None
 
@@ -226,12 +238,32 @@ class S3Server:
         self.addr = f"{h}:{p}"
         if self.stats_interval > 0:
             self._stats_task = asyncio.ensure_future(self._stats_loop())
+        if self._admin_path:
+            from ..common import AdminSocket, register_common
+
+            # the socket path must be addr-free (sockets are created
+            # from the config pattern before clients know the port)
+            asok_name = self.name or f"rgw.{self.store.zone or 'default'}"
+            self._admin = AdminSocket(
+                self._admin_path.replace("{name}", asok_name)
+            )
+            register_common(self._admin, perf=self.perf_coll)
+            self._admin.register(
+                "status",
+                lambda req: {"name": asok_name, "addr": self.addr,
+                             "zone": self.store.zone or "default"},
+                "gateway identity",
+            )
+            await self._admin.start()
         return self.addr
 
     async def stop(self) -> None:
         if self._stats_task is not None:
             self._stats_task.cancel()
             self._stats_task = None
+        if self._admin is not None:
+            await self._admin.stop()
+            self._admin = None
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
@@ -284,10 +316,14 @@ class S3Server:
                     verb = "other"
                 self.perf.inc(f"req_{verb}")
                 self.perf.inc("bytes_in", len(body))
-                with self.perf.time(f"lat_{verb}"):
-                    status, out_headers, payload = await self._route(
-                        method.upper(), target, headers, body
-                    )
+                t0 = time.perf_counter()
+                status, out_headers, payload = await self._route(
+                    method.upper(), target, headers, body
+                )
+                dt = time.perf_counter() - t0
+                self.perf.observe(f"lat_{verb}", dt)
+                self.perf.hist("req_latency_histogram",
+                               len(body) + len(payload), dt)
                 if 400 <= status < 500:
                     self.perf.inc("req_4xx")
                 elif status >= 500:

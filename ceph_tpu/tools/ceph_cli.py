@@ -180,6 +180,9 @@ def _daemon_command(args) -> int:
                 kw.setdefault("value", positional[1])
             elif prefix == "log dump" and positional:
                 kw.setdefault("num", positional[0])
+            elif prefix == "perf reset" and positional:
+                # positional subsystem form: `perf reset osd` / `all`
+                kw.setdefault("name", positional[0])
             out = await admin_command(path, prefix, **kw)
         except (ConnectionError, OSError) as e:
             print(f"error: {path}: {e}", file=sys.stderr)

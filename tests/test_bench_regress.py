@@ -1,0 +1,121 @@
+"""Bench-regression pipeline (ISSUE 3): tools/bench_regress.py fails
+on a real throughput drop but not on a phase flip, and bench.py's
+parent survives the BENCH_r05 failure mode — the child aborting inside
+JAX backend registration (xla_bridge.backends) during device
+acquisition — still printing a final parseable JSON line with the
+per-phase record.
+"""
+
+import importlib.util
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+
+def _load_tool():
+    path = (pathlib.Path(__file__).parent.parent
+            / "tools" / "bench_regress.py")
+    spec = importlib.util.spec_from_file_location("bench_regress", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["bench_regress"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_round(tmp_path, n, phase, value, wrapped=True, parsed=True):
+    line = {"metric": "m", "value": value, "unit": "GB/s",
+            "phase": phase}
+    obj = ({"n": n, "rc": 0, "parsed": (line if parsed else None)}
+           if wrapped else line)
+    (tmp_path / f"BENCH_r{n:02d}.json").write_text(json.dumps(obj))
+
+
+class TestBenchRegress:
+    def test_2x_drop_fails(self, tmp_path):
+        br = _load_tool()
+        _write_round(tmp_path, 1, "tpu", 600.0)
+        _write_round(tmp_path, 2, "tpu", 650.0)
+        _write_round(tmp_path, 3, "tpu", 300.0)  # 2x drop vs best prior
+        rc = br.main(["--dir", str(tmp_path)])
+        assert rc == 1
+
+    def test_stable_trajectory_passes(self, tmp_path):
+        br = _load_tool()
+        _write_round(tmp_path, 1, "tpu", 600.0)
+        _write_round(tmp_path, 2, "tpu", 662.0)
+        _write_round(tmp_path, 3, "tpu", 540.0)  # jitter, not a 2x drop
+        assert br.main(["--dir", str(tmp_path)]) == 0
+
+    def test_phase_flip_is_not_a_regression(self, tmp_path):
+        """A tpu round followed by a native-only round is an
+        environment fault (dead tunnel), not a kernel regression — the
+        comparator only judges same-phase rounds."""
+        br = _load_tool()
+        _write_round(tmp_path, 1, "tpu", 662.0)
+        _write_round(tmp_path, 2, "native-only", 5.2)
+        report = br.compare(br.load_rounds(str(tmp_path)))
+        assert report["comparable"] is False
+        assert br.main(["--dir", str(tmp_path)]) == 0
+
+    def test_unparsed_rounds_skipped_and_bare_lines_accepted(
+        self, tmp_path
+    ):
+        br = _load_tool()
+        _write_round(tmp_path, 1, "tpu", 600.0, wrapped=False)
+        _write_round(tmp_path, 2, "tpu", 650.0)
+        _write_round(tmp_path, 3, "tpu", 0.0, parsed=False)  # rc=124
+        rounds = br.load_rounds(str(tmp_path))
+        assert [r["round"] for r in rounds] == [1, 2]
+        assert br.main(["--dir", str(tmp_path)]) == 0
+
+    def test_numeric_round_ordering(self, tmp_path):
+        br = _load_tool()
+        for n, v in ((9, 600.0), (10, 100.0)):  # r10 is newest, 6x drop
+            _write_round(tmp_path, n, "tpu", v)
+        assert br.main(["--dir", str(tmp_path)]) == 1
+
+    def test_no_records_exit_2(self, tmp_path):
+        br = _load_tool()
+        assert br.main(["--dir", str(tmp_path)]) == 2
+
+    def test_threshold_option(self, tmp_path):
+        br = _load_tool()
+        _write_round(tmp_path, 1, "tpu", 100.0)
+        _write_round(tmp_path, 2, "tpu", 80.0)
+        assert br.main(["--dir", str(tmp_path)]) == 0
+        assert br.main(
+            ["--dir", str(tmp_path), "--threshold", "0.9"]
+        ) == 1
+
+
+class TestChildBackendDeath:
+    def test_parent_survives_backend_registration_abort(self):
+        """Regression for BENCH_r05: every accelerator child dies with
+        a hard abort during backend registration (the crash inside
+        jax.devices() -> xla_bridge.backends); the parent must still
+        print a final parseable JSON line with phase native-only or
+        jax-cpu, carrying the per-phase record that shows WHERE the
+        trajectory emptied out."""
+        env = dict(os.environ)
+        env["CEPH_TPU_BENCH_FAULT"] = "backend-death"
+        env.pop("JAX_PLATFORMS", None)  # the parent never imports jax
+        bench = str(pathlib.Path(__file__).parent.parent / "bench.py")
+        r = subprocess.run(
+            [sys.executable, bench, "--budget", "12",
+             "--platform", "cpu"],
+            env=env, capture_output=True, text=True, timeout=120,
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+        assert lines, r.stderr[-2000:]
+        final = json.loads(lines[-1])
+        assert final["phase"] in ("native-only", "jax-cpu")
+        assert final["value"] > 0
+        # the phase record names the dead child instead of omitting it
+        phases = {p["phase"]: p for p in final["phases"]}
+        assert phases["native"]["status"] == "ok"
+        combo = phases.get("jax-cpu")
+        assert combo is not None
+        assert combo["status"].startswith("child-died"), combo

@@ -248,7 +248,17 @@ def test_memory_log_ring_and_admin_dump(tmp_path):
     with _pytest.raises(ValueError):
         ml.recent(level="not-a-level")
     assert ml.recent(n=1)[-1]["msg"] == "loud failure"
-    assert any("loud failure" in line for line in dump_recent(10))
+    crash_lines = dump_recent(10)
+    assert any("loud failure" in line for line in crash_lines)
+    # crash-dump timestamps are ISO-8601 with millisecond precision
+    # (date + subseconds, correlatable with trace events / prometheus
+    # scrapes — a bare %H:%M:%S was neither)
+    import re as _re
+
+    assert all(
+        _re.match(r"^\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}\.\d{3} ", line)
+        for line in crash_lines
+    ), crash_lines
     # capacity resize preserves entries
     ml2 = install(capacity=7)
     assert ml2 is ml and ml._ring.maxlen == 7

@@ -1,0 +1,401 @@
+"""Histogram-grade timing stack (ISSUE 3 tentpole): PerfHistogram
+bucket math, admin-socket ``dump_histograms`` / ``perf schema`` /
+``perf reset`` on OSD and rgw sockets, and the mgr prometheus module's
+``_bucket{le=...}`` exposition contract — le monotone non-decreasing,
++Inf bucket == ``_count``, ``_sum``/``_count`` coherent with the same
+daemon's ``perf dump``, deterministic 2D flattening.
+"""
+
+import asyncio
+import math
+import os
+import re
+
+from ceph_tpu.common import (
+    PerfCounters,
+    PerfCountersCollection,
+    PerfHistogram,
+    PerfHistogramAxis,
+    size_latency_axes,
+)
+from ceph_tpu.common.admin_socket import admin_command
+from ceph_tpu.mgr.modules import PrometheusModule
+from ceph_tpu.rados import MiniCluster
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+class TestAxisMath:
+    def test_log2_bucket_placement(self):
+        ax = PerfHistogramAxis("lat", min=1.0, buckets=5)
+        assert ax.bucket(0.0) == 0          # below min
+        assert ax.bucket(0.999) == 0
+        assert ax.bucket(1.0) == 1          # [1, 2)
+        assert ax.bucket(1.999) == 1
+        assert ax.bucket(2.0) == 2          # [2, 4)
+        assert ax.bucket(4.0) == 3          # [4, 8)
+        assert ax.bucket(8.0) == 4          # overflow
+        assert ax.bucket(1e9) == 4
+
+    def test_log2_uppers_double_then_inf(self):
+        ax = PerfHistogramAxis("lat", min=0.5, buckets=4)
+        assert [ax.upper(i) for i in range(4)] == [
+            0.5, 1.0, 2.0, math.inf
+        ]
+
+    def test_linear_bucket_placement(self):
+        ax = PerfHistogramAxis("x", scale="linear", min=10, quant=5,
+                               buckets=4)
+        assert ax.bucket(9) == 0
+        assert ax.bucket(10) == 1    # [10, 15)
+        assert ax.bucket(14.9) == 1
+        assert ax.bucket(15) == 2    # [15, 20)
+        assert ax.bucket(500) == 3   # overflow
+        assert ax.upper(1) == 15.0 and ax.upper(3) == math.inf
+
+
+class TestPerfHistogram:
+    def test_2d_grid_and_sums(self):
+        h = PerfHistogram(size_latency_axes(
+            size_min=256, size_buckets=4, lat_min=0.001, lat_buckets=4,
+        ))
+        h.sample(100, 0.0001)    # below both mins -> [0][0]
+        h.sample(256, 0.002)     # [1][2]
+        h.sample(1 << 20, 10.0)  # overflow both -> [3][3]
+        d = h.dump()
+        assert d["count"] == 3
+        assert d["values"][0][0] == 1
+        assert d["values"][1][2] == 1
+        assert d["values"][3][3] == 1
+        assert sum(sum(r) for r in d["values"]) == 3
+        # exposition sum = last (latency) axis
+        assert abs(d["sum"] - (0.0001 + 0.002 + 10.0)) < 1e-12
+        h.reset()
+        d = h.dump()
+        assert d["count"] == 0 and sum(sum(r) for r in d["values"]) == 0
+
+    def test_perf_counters_integration_and_reset(self):
+        pc = PerfCounters("t")
+        pc.add_counter("c").add_avg("a").add_histogram("h")
+        pc.inc("c", 5)
+        pc.observe("a", 2.0)
+        pc.observe("a", 4.0)
+        pc.hist("h", 1024, 0.01)
+        d = pc.dump()
+        assert d["c"] == 5
+        assert d["a"]["min"] == 2.0 and d["a"]["max"] == 4.0
+        assert d["h"]["histogram"]["count"] == 1
+        assert pc.dump_histograms().keys() == {"h"}
+        sch = pc.schema()
+        assert sch["c"]["type"] == "counter"
+        assert sch["h"]["type"] == "histogram"
+        assert [a["name"] for a in sch["h"]["axes"]] == [
+            "request_bytes", "latency"
+        ]
+        # perf reset: the avg min/max (previously accumulating forever)
+        # and the histogram grid clear; the counter restarts at 0
+        pc.reset()
+        d = pc.dump()
+        assert d["c"] == 0
+        assert d["a"]["avgcount"] == 0 and d["a"]["min"] is None
+        assert d["h"]["histogram"]["count"] == 0
+
+    def test_collection_reset_by_name(self):
+        coll = PerfCountersCollection()
+        a = coll.create("a")
+        b = coll.create("b")
+        a.add_counter("x")
+        b.add_counter("x")
+        a.inc("x")
+        b.inc("x")
+        assert coll.reset("a") == ["a"]
+        assert a.get("x") == 0 and b.get("x") == 1
+        assert sorted(coll.reset("all")) == ["a", "b"]
+        assert b.get("x") == 0
+        try:
+            coll.reset("nope")
+            raise AssertionError("unknown subsystem must raise")
+        except KeyError:
+            pass
+
+
+class _FakeMgr:
+    """Just enough MgrDaemon surface for PrometheusModule.metrics."""
+
+    def __init__(self, osd_stats=None, daemon_stats=None):
+        self.osdmap = None
+        self.name = "mgr.fake"
+        self.perf = PerfCountersCollection()
+        self._osd = osd_stats or {}
+        self._daemon = daemon_stats or {}
+
+    def live_osd_stats(self):
+        return self._osd
+
+    def live_daemon_stats(self):
+        return self._daemon
+
+    def pg_summary(self):
+        return {}
+
+
+def _metrics_for(perf_dump: dict) -> list[str]:
+    mgr = _FakeMgr(osd_stats={0: {"perf": perf_dump}})
+    _c, _s, out = PrometheusModule().metrics(mgr, {})
+    return out.splitlines()
+
+
+def _hist_perf_dump() -> dict:
+    pc = PerfCounters("osd")
+    pc.add_histogram("op_latency_histogram", axes=size_latency_axes(
+        size_min=256, size_buckets=4, lat_min=0.001, lat_buckets=4,
+    ))
+    pc.hist("op_latency_histogram", 100, 0.0001)
+    pc.hist("op_latency_histogram", 512, 0.004)
+    pc.hist("op_latency_histogram", 4096, 0.004)
+    pc.hist("op_latency_histogram", 1 << 22, 100.0)
+    return {"osd": pc.dump()}
+
+
+class TestPrometheusHistograms:
+    def test_bucket_series_shape(self):
+        lines = _metrics_for(_hist_perf_dump())
+        buckets = [
+            ln for ln in lines
+            if ln.startswith('ceph_osd_op_latency_histogram_bucket{')
+        ]
+        # one series per le-axis bucket, daemon + le labels
+        assert len(buckets) == 4
+        les = [
+            re.search(r'le="([^"]+)"', ln).group(1) for ln in buckets
+        ]
+        assert les == ["0.001", "0.002", "0.004", "+Inf"]
+        counts = [int(ln.rsplit(" ", 1)[1]) for ln in buckets]
+        # cumulative counts monotone non-decreasing
+        assert counts == sorted(counts)
+        # +Inf bucket equals _count
+        count_line = next(
+            ln for ln in lines
+            if ln.startswith('ceph_osd_op_latency_histogram_count{')
+        )
+        assert counts[-1] == int(count_line.rsplit(" ", 1)[1]) == 4
+
+    def test_sum_count_coherent_with_perf_dump(self):
+        dump = _hist_perf_dump()
+        lines = _metrics_for(dump)
+        h = dump["osd"]["op_latency_histogram"]["histogram"]
+        sum_line = next(
+            ln for ln in lines
+            if ln.startswith('ceph_osd_op_latency_histogram_sum{')
+        )
+        count_line = next(
+            ln for ln in lines
+            if ln.startswith('ceph_osd_op_latency_histogram_count{')
+        )
+        assert float(sum_line.rsplit(" ", 1)[1]) == h["sum"]
+        assert int(count_line.rsplit(" ", 1)[1]) == h["count"]
+        # no bare-base sample for histograms (that name is reserved for
+        # scalar samples; a histogram exports only typed series)
+        assert not any(
+            re.match(r'ceph_osd_op_latency_histogram\{', ln)
+            for ln in lines
+        )
+
+    def test_2d_flattening_deterministic(self):
+        dump = _hist_perf_dump()
+        a = _metrics_for(dump)
+        b = _metrics_for(dump)
+        assert a == b
+        # the le-axis marginal equals the column sums of the 2D grid
+        h = dump["osd"]["op_latency_histogram"]["histogram"]
+        col = [sum(r[j] for r in h["values"]) for j in range(4)]
+        buckets = [
+            int(ln.rsplit(" ", 1)[1]) for ln in a
+            if ln.startswith('ceph_osd_op_latency_histogram_bucket{')
+        ]
+        cum = 0
+        for j, c in enumerate(col):
+            cum += c
+            assert buckets[j] == cum
+
+    def test_1d_histogram_exposes_directly(self):
+        pc = PerfCounters("msgr")
+        pc.add_histogram("send_bytes_histogram", axes=[
+            PerfHistogramAxis("frame_bytes", min=64, buckets=3),
+        ])
+        pc.hist("send_bytes_histogram", 10)
+        pc.hist("send_bytes_histogram", 100)
+        lines = _metrics_for({"msgr": pc.dump()})
+        buckets = [
+            ln for ln in lines
+            if ln.startswith('ceph_msgr_send_bytes_histogram_bucket{')
+        ]
+        assert len(buckets) == 3
+        assert 'le="+Inf"} 2' in buckets[-1]
+
+
+class TestAdminSocketSurface:
+    def test_osd_histograms_schema_reset(self, tmp_path):
+        """dump_histograms / perf schema / perf reset / the kernel
+        profiler answer on a live OSD admin socket, with real op and EC
+        samples in the grids."""
+
+        async def main():
+            sock = os.path.join(str(tmp_path), "{name}.asok")
+            async with MiniCluster(
+                n_osds=4, config_overrides={"admin_socket": sock},
+            ) as cluster:
+                cl = await cluster.client()
+                await cl.create_pool("ecp", "erasure")
+                io = cl.io_ctx("ecp")
+                await io.write_full("eobj", os.urandom(8192))
+                # ask the PRIMARY's socket: only it serves the client
+                # op and runs the EC encode
+                pool = cl.osdmap.lookup_pool("ecp")
+                _pg, _a, primary = cl.osdmap.object_to_acting(
+                    "eobj", pool.id
+                )
+                path = sock.replace("{name}", f"osd.{primary}")
+                hists = await admin_command(path, "dump_histograms")
+                assert hists["osd"]["op_latency_histogram"]["count"] >= 1
+                assert hists["ec"]["encode_time_histogram"]["count"] >= 1
+                # the messenger distributions ride the same dump
+                assert hists["msgr"]["dispatch_histogram"]["count"] > 0
+                # schema names every registered key with its type
+                schema = await admin_command(path, "perf schema")
+                assert schema["osd"]["op"]["type"] == "counter"
+                assert (schema["osd"]["op_latency_histogram"]["type"]
+                        == "histogram")
+                assert schema["osd"]["op_latency_histogram"]["axes"]
+                # kernel profiler saw the EC encode kernels — on a CPU
+                # host via the native stripes engine, on an accelerator
+                # via the jax codec entries; empty means the hot path
+                # lost its tap (the gap the live drive caught)
+                prof = await admin_command(path, "dump_kernel_profile")
+                assert prof["engines"], prof
+                # perf reset clears one subsystem, leaves the rest
+                perf = await admin_command(path, "perf dump")
+                assert perf["osd"]["op"] >= 1
+                out = await admin_command(path, "perf reset", name="osd")
+                assert "success" in out
+                perf = await admin_command(path, "perf dump")
+                assert perf["osd"]["op"] == 0
+                assert (perf["osd"]["op_latency_histogram"]["histogram"]
+                        ["count"] == 0)
+                assert perf["ec"]["encode_calls"] >= 1  # untouched
+                # unknown subsystem surfaces as an error, not a crash
+                out = await admin_command(path, "perf reset", name="zz")
+                assert "error" in out
+
+        run(main())
+
+    def test_rgw_admin_socket(self, tmp_path):
+        """The gateway serves the same surface (acceptance: OSD *and*
+        rgw sockets answer dump_histograms/perf schema/
+        dump_kernel_profile)."""
+
+        async def main():
+            from ceph_tpu.rgw import RGWStore
+            from ceph_tpu.rgw.http import S3Server
+
+            from .test_rgw import _http
+
+            sock = os.path.join(str(tmp_path), "{name}.asok")
+            async with MiniCluster(n_osds=3) as cluster:
+                cl = await cluster.client()
+                store = await RGWStore.create(cl)
+                srv = S3Server(store, stats_interval=0,
+                               admin_socket=sock)
+                addr = await srv.start()
+                try:
+                    user = await store.create_user("alice")
+                    st, _h, _b = await _http(addr, "PUT", "/b",
+                                             creds=user)
+                    assert st == 200
+                    st, _h, _b = await _http(addr, "PUT", "/b/k",
+                                             body=b"x" * 2048,
+                                             creds=user)
+                    assert st == 200
+                    path = sock.replace("{name}", "rgw.default")
+                    hists = await admin_command(path, "dump_histograms")
+                    assert (hists["rgw"]["req_latency_histogram"]
+                            ["count"] >= 2)
+                    schema = await admin_command(path, "perf schema")
+                    assert (schema["rgw"]["req_latency_histogram"]
+                            ["type"] == "histogram")
+                    prof = await admin_command(
+                        path, "dump_kernel_profile"
+                    )
+                    assert "engines" in prof
+                    out = await admin_command(path, "perf reset")
+                    assert "success" in out
+                    perf = await admin_command(path, "perf dump")
+                    assert perf["rgw"]["req_put"] == 0
+                finally:
+                    await srv.stop()
+
+        run(main())
+
+
+class TestMgrBucketSeries:
+    def test_osd_op_and_ec_encode_buckets_in_metrics(self):
+        """Acceptance: the mgr prometheus output carries
+        ``_bucket{le=...}`` series for osd op latency and EC encode,
+        fed by real cluster IO through the report pipeline."""
+
+        async def main():
+            from ceph_tpu.tools.ceph_cli import _mgr_command
+
+            async with MiniCluster(
+                n_osds=4,
+                config_overrides={"osd_mgr_report_interval": 0.1},
+            ) as cluster:
+                await cluster.start_mgr()
+                await cluster.wait_for_active_mgr()
+                cl = await cluster.client()
+                await cl.create_pool("ecp", "erasure")
+                await cl.io_ctx("ecp").write_full(
+                    "eobj", os.urandom(8192)
+                )
+                want = (
+                    'ceph_osd_op_latency_histogram_bucket{',
+                    'ceph_ec_encode_time_histogram_bucket{',
+                    'ceph_msgr_dispatch_histogram_bucket{',
+                )
+                async with asyncio.timeout(20):
+                    while True:
+                        rc, metrics = await _mgr_command(
+                            cl, {"prefix": "metrics"}
+                        )
+                        assert rc == 0
+                        if all(w in metrics for w in want):
+                            break
+                        await asyncio.sleep(0.2)
+                # every bucket line is well-formed and cumulative per
+                # (daemon, series); +Inf closes each series
+                series: dict[tuple, list[tuple[float, int]]] = {}
+                pat = re.compile(
+                    r'^(ceph_\w+_bucket)\{daemon="([^"]+)",le="([^"]+)"\}'
+                    r' (\d+)$'
+                )
+                for ln in metrics.splitlines():
+                    if "_bucket{" not in ln:
+                        continue
+                    m = pat.match(ln)
+                    assert m, ln
+                    le = (math.inf if m.group(3) == "+Inf"
+                          else float(m.group(3)))
+                    series.setdefault(
+                        (m.group(1), m.group(2)), []
+                    ).append((le, int(m.group(4))))
+                assert series
+                for key, rows in series.items():
+                    les = [le for le, _c in rows]
+                    counts = [c for _le, c in rows]
+                    assert les == sorted(les), key
+                    assert les[-1] == math.inf, key
+                    assert counts == sorted(counts), key
+
+        run(main())

@@ -1,0 +1,109 @@
+"""KernelProfiler (ISSUE 3 tentpole): compile-vs-execute split,
+jit-cache hit/miss accounting keyed on call signatures, per-engine
+batch shapes and latency histograms, and the instrumentation taps in
+the matrix codec and the vectorized CRUSH mapper.
+"""
+
+import numpy as np
+
+from ceph_tpu.ops.profiler import KernelProfiler, profiler
+
+
+class TestProfilerCore:
+    def test_miss_then_hit(self):
+        p = KernelProfiler()
+        with p.timed("eng", ("m", (2, 8)), nbytes=16, shape=(2, 8)):
+            pass
+        with p.timed("eng", ("m", (2, 8)), nbytes=16, shape=(2, 8)):
+            pass
+        with p.timed("eng", ("m", (2, 16)), nbytes=32, shape=(2, 16)):
+            pass
+        d = p.dump()["engines"]["eng"]
+        assert d["calls"] == 3
+        # two distinct signatures -> two compiles, one cached repeat
+        assert d["jit_cache"] == {"misses": 2, "hits": 1}
+        assert d["bytes"] == 64
+        assert d["shapes"] == {"(2, 8)": 2, "(2, 16)": 1}
+        assert d["compile_time"] >= 0 and d["exec_time"] >= 0
+
+    def test_explicit_compiled_override(self):
+        p = KernelProfiler()
+        p.record("e", "k1", 0.5, compiled=False)  # steady-state record
+        d = p.dump()["engines"]["e"]
+        assert d["jit_cache"] == {"misses": 0, "hits": 1}
+        assert d["exec_time"] == 0.5
+
+    def test_exec_gbps_excludes_compile_call_bytes(self):
+        """A compile call's bytes must not inflate the steady-state
+        rate: 1 GB compiled in 10 s + 1 GB cached in 0.1 s is
+        10 GB/s, not 20."""
+        p = KernelProfiler()
+        p.record("e", "k", 10.0, nbytes=10 ** 9)   # miss (compile)
+        p.record("e", "k", 0.1, nbytes=10 ** 9)    # hit (exec)
+        d = p.dump()["engines"]["e"]
+        assert d["bytes"] == 2 * 10 ** 9
+        assert d["exec_gbps"] == 10.0
+
+    def test_reset_keeps_compile_signatures(self):
+        """A profiler reset (bench phase boundary) clears the stats but
+        NOT the seen-signature set: jax's jit cache is still warm, so a
+        post-reset call on an old signature must count as a hit."""
+        p = KernelProfiler()
+        p.record("e", "k", 0.1)
+        p.reset()
+        assert p.dump()["engines"] == {}
+        p.record("e", "k", 0.1)
+        assert p.dump()["engines"]["e"]["jit_cache"]["hits"] == 1
+
+    def test_histogram_rides_along(self):
+        p = KernelProfiler()
+        p.record("e", "k", 0.002, nbytes=1 << 20)
+        h = p.dump_histograms()["e"]
+        assert h["count"] == 1
+        assert [a["name"] for a in h["axes"]] == [
+            "request_bytes", "latency"
+        ]
+
+
+class TestInstrumentationTaps:
+    def test_matrix_codec_reports(self):
+        from ceph_tpu.models import registry
+
+        p = profiler()
+        p.reset()
+        codec = registry.instance().factory(
+            "isa", {"k": "2", "m": "1", "technique": "reed_sol_van"}
+        )
+        rng = np.random.default_rng(0)
+        data = rng.integers(0, 256, size=(2, 512), dtype=np.uint8)
+        parity = codec.encode_chunks(data)
+        engines = p.dump()["engines"]
+        assert "gf_encode" in engines, engines
+        assert engines["gf_encode"]["calls"] >= 1
+        assert engines["gf_encode"]["bytes"] >= data.size
+        # decode reports on its own engine (native or u32 path)
+        chunks = np.concatenate([data, parity])
+        rebuilt = codec.decode_chunks((1, 2), chunks[1:], (0,))
+        np.testing.assert_array_equal(rebuilt[0], data[0])
+        engines = p.dump()["engines"]
+        assert any(e.startswith("gf_decode") for e in engines), engines
+
+    def test_crush_mapper_reports(self):
+        from ceph_tpu.crush import mapper, mapper_jax
+        from ceph_tpu.crush.map import CrushMap
+
+        p = profiler()
+        p.reset()
+        cmap = CrushMap.flat(8)
+        rule = cmap.add_simple_rule(
+            cmap.root_id(), 0, indep=False, max_size=2
+        )
+        xs = np.arange(64, dtype=np.uint32)
+        rows = mapper_jax.vec_do_rule(cmap, rule, xs, 2)
+        assert list(rows[0]) == mapper.crush_do_rule(cmap, rule, 0, 2)
+        counts, bad = mapper_jax.vec_rule_stats(cmap, rule, xs, 2)
+        assert bad == 0 and sum(counts.values()) == 2 * 64
+        engines = p.dump()["engines"]
+        assert "crush_vec_rule" in engines, engines
+        assert "crush_vec_stats" in engines, engines
+        assert engines["crush_vec_rule"]["shapes"] == {"(64,)": 1}

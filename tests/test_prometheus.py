@@ -102,10 +102,18 @@ def test_live_daemon_counters_appear_exactly_once():
                     await asyncio.sleep(0.1)
             osd = cluster.osds[0]
             expected: list[str] = []
+            hist_buckets: dict[str, int] = {}  # base -> le-axis buckets
             for subsys, counters in osd.perf.dump().items():
                 for key, val in counters.items():
                     base = f"ceph_{subsys}_{key}"
-                    if isinstance(val, dict):
+                    if isinstance(val, dict) and "histogram" in val:
+                        # histograms export _bucket series + _sum/_count
+                        # but no bare-base sample
+                        expected += [f"{base}_sum", f"{base}_count"]
+                        hist_buckets[base] = (
+                            val["histogram"]["axes"][-1]["buckets"]
+                        )
+                    elif isinstance(val, dict):
                         expected += [f"{base}_sum", f"{base}_count", base]
                     else:
                         expected.append(base)
@@ -114,5 +122,9 @@ def test_live_daemon_counters_appear_exactly_once():
                 pat = re.escape(series) + r'\{daemon="osd\.0"\} '
                 n = sum(1 for ln in lines if re.match(pat, ln))
                 assert n == 1, (series, n)
+            for base, buckets in hist_buckets.items():
+                pat = re.escape(base) + r'_bucket\{daemon="osd\.0",le="'
+                n = sum(1 for ln in lines if re.match(pat, ln))
+                assert n == buckets, (base, n, buckets)
 
     asyncio.run(main())

@@ -1,0 +1,132 @@
+#!/usr/bin/env python3
+"""Bench-trajectory non-regression gate over the committed BENCH_*.json
+records (the per-round driver captures of bench.py's final line).
+
+Each round's driver writes ``BENCH_r<N>.json`` with the shape
+``{"n", "cmd", "rc", "tail", "parsed"}`` where ``parsed`` is bench.py's
+final JSON line (or null when the run produced none); bare final-line
+JSON files are accepted too.  This tool loads the last N rounds,
+compares the newest measurement against the best earlier one **with
+the same phase** — a "native-only" round after a "tpu" round is an
+environment fault, not a kernel regression, and must not trip the gate
+(nor silently pass a real TPU slowdown by averaging apples with
+oranges) — and exits nonzero when the newest throughput falls below
+``threshold`` x the prior best.
+
+Usage:
+  python tools/bench_regress.py [--dir D] [--last N] [--threshold R]
+                                [--metric value]
+
+Exit codes: 0 = ok / nothing comparable; 1 = regression; 2 = no usable
+bench records at all.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+
+def load_rounds(bench_dir: str) -> list[dict]:
+    """[{round, phase, metrics...}] sorted by round number (numeric:
+    lexicographic sorting puts r10 before r9)."""
+    rounds = []
+    for path in glob.glob(os.path.join(bench_dir, "BENCH_r*.json")):
+        m = re.search(r"_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                obj = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"bench_regress: skipping {path}: {e}", file=sys.stderr)
+            continue
+        line = obj.get("parsed") if "parsed" in obj else obj
+        if not isinstance(line, dict) or "value" not in line:
+            continue  # a round with no parseable result (rc=124 etc.)
+        rounds.append({
+            "round": int(m.group(1)),
+            "file": os.path.basename(path),
+            "phase": line.get("phase", "?"),
+            "line": line,
+        })
+    rounds.sort(key=lambda r: r["round"])
+    return rounds
+
+
+def compare(rounds: list[dict], metric: str = "value",
+            threshold: float = 0.5) -> dict:
+    """Newest round vs the best prior SAME-PHASE round.
+
+    Returns a report dict with ``regression`` True/False;
+    ``comparable`` False when there is no earlier same-phase round to
+    judge against (first round of a phase, or a phase flip)."""
+    if not rounds:
+        return {"comparable": False, "reason": "no bench records"}
+    newest = rounds[-1]
+    phase = newest["phase"]
+    cur = newest["line"].get(metric)
+    if not isinstance(cur, (int, float)):
+        return {
+            "comparable": False, "newest": newest["file"],
+            "reason": f"newest round has no numeric {metric!r}",
+        }
+    priors = [
+        r for r in rounds[:-1]
+        if r["phase"] == phase
+        and isinstance(r["line"].get(metric), (int, float))
+    ]
+    if not priors:
+        return {
+            "comparable": False, "newest": newest["file"],
+            "phase": phase,
+            "reason": f"no earlier round with phase {phase!r}",
+        }
+    best = max(priors, key=lambda r: r["line"][metric])
+    best_v = float(best["line"][metric])
+    ratio = (float(cur) / best_v) if best_v > 0 else 1.0
+    return {
+        "comparable": True,
+        "newest": newest["file"],
+        "phase": phase,
+        "metric": metric,
+        "current": float(cur),
+        "best_prior": best_v,
+        "best_prior_file": best["file"],
+        "ratio": round(ratio, 4),
+        "threshold": threshold,
+        "regression": ratio < threshold,
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="fail on bench throughput regression")
+    ap.add_argument("--dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    ap.add_argument("--last", type=int, default=5,
+                    help="how many newest rounds to consider")
+    ap.add_argument("--metric", default="value",
+                    help="final-line key to compare (default: value)")
+    ap.add_argument("--threshold", type=float, default=0.5,
+                    help="fail when newest < threshold x prior best "
+                         "(0.5 = a 2x drop fails)")
+    args = ap.parse_args(argv)
+
+    rounds = load_rounds(args.dir)
+    if not rounds:
+        print(json.dumps({"error": "no usable BENCH_*.json records",
+                          "dir": args.dir}))
+        return 2
+    report = compare(rounds[-args.last:], metric=args.metric,
+                     threshold=args.threshold)
+    print(json.dumps(report, indent=2))
+    return 1 if report.get("regression") else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,24 +1,37 @@
 #!/usr/bin/env python3
 """Static perf-counter consistency pass (CI gate).
 
-Every ``perf.get(...).inc/set/observe/time("key")`` call site must name
-a key some PerfCounters builder registered via
-``add_counter/add_gauge/add_avg/add_time_avg("key")`` — a typo'd key
-raises KeyError/TypeError only when that exact path runs, which for
-rarely-hit counters means production, not CI.  This pass walks the
-``ceph_tpu`` package's ASTs and fails fast on any literal key used but
-never registered.
+Two checks over the ``ceph_tpu`` package's ASTs:
+
+1. **Unregistered keys.** Every
+   ``perf.get(...).inc/set/observe/time/hist("key")`` call site must
+   name a key some PerfCounters builder registered via
+   ``add_counter/add_gauge/add_avg/add_time_avg/add_histogram("key")``
+   — a typo'd key raises KeyError/TypeError only when that exact path
+   runs, which for rarely-hit counters means production, not CI.
+
+2. **Prometheus name collisions.** The mgr prometheus module flattens
+   every registered key into exposition series
+   (``ceph_<subsys>_<key>`` plus ``_sum``/``_count`` for averages and
+   ``_bucket``/``_sum``/``_count`` for histograms) after sanitizing
+   both parts to ``[A-Za-z0-9_]``.  Two different registrations that
+   sanitize onto the same series name would silently interleave
+   samples in the scrape; this pass resolves each builder call's
+   subsystem (from ``perf.create("name")`` / ``PerfCounters("name")``
+   assignments) and fails on any such collision.
 
 Scope rules (pragmatic, zero false positives on this codebase):
-- registrations: any ``*.add_counter/add_gauge/add_avg/add_time_avg``
-  call with a literal first argument, anywhere in the package;
-- usages: ``.inc/.set/.observe/.time`` calls with a literal first
-  argument whose receiver is perf-shaped — its dotted source contains
-  ``perf`` (``self.perf.get("osd").inc``), or it is a local alias
-  assigned from such an expression (``posd = self.perf.get("osd")``);
+- registrations: any builder call with a literal first argument,
+  anywhere in the package;
+- usages: mutator calls with a literal first argument whose receiver is
+  perf-shaped — its dotted source contains ``perf``
+  (``self.perf.get("osd").inc``), or it is a local alias assigned from
+  such an expression (``posd = self.perf.get("osd")``);
 - non-literal keys (f-strings like ``f"req_{verb}"``) are skipped on
   both sides: the dynamic families register and use the same format
-  expressions, and literal typos are the failure class this gate owns.
+  expressions, and literal typos are the failure class this gate owns;
+- builder calls whose subsystem cannot be resolved statically are
+  exempt from the collision check only (still counted as registered).
 
 Usage: ``python tools/check_counters.py [package_dir]`` — exits 0 when
 clean, 1 with a per-site report otherwise.
@@ -28,10 +41,29 @@ from __future__ import annotations
 
 import ast
 import pathlib
+import re
 import sys
 
-BUILDERS = {"add_counter", "add_gauge", "add_avg", "add_time_avg"}
-MUTATORS = {"inc", "set", "observe", "time"}
+BUILDERS = {"add_counter", "add_gauge", "add_avg", "add_time_avg",
+            "add_histogram"}
+MUTATORS = {"inc", "set", "observe", "time", "hist"}
+
+# exposition suffixes per builder kind (mirrors mgr/modules.py
+# PrometheusModule flattening: avgs -> triplet, histograms -> bucket
+# series + sum/count with no bare-base sample)
+_SUFFIXES = {
+    "add_counter": ("",),
+    "add_gauge": ("",),
+    "add_avg": ("", "_sum", "_count"),
+    "add_time_avg": ("", "_sum", "_count"),
+    "add_histogram": ("_bucket", "_sum", "_count"),
+}
+
+
+def _sanitize(name: str) -> str:
+    """The exposition-name sanitization (prometheus metric names allow
+    [a-zA-Z0-9_:]; ':' is reserved for recording rules)."""
+    return re.sub(r"[^a-zA-Z0-9_]", "_", name)
 
 
 def _dotted(node: ast.AST) -> str:
@@ -55,40 +87,65 @@ def _literal_first_arg(call: ast.Call) -> str | None:
     return None
 
 
-def _perfish(expr: ast.AST, aliases: set[str]) -> bool:
-    """Is this receiver a PerfCounters? Either its dotted form names
-    perf somewhere, or it is a tracked local alias."""
-    src = _dotted(expr)
-    if "perf" in src.lower():
-        return True
-    head = src.split(".", 1)[0]
-    return head in aliases
-
-
 class _FileScan(ast.NodeVisitor):
     def __init__(self, path: str):
         self.path = path
-        self.registered: set[str] = set()
+        # (subsys | None, key, builder kind)
+        self.registered: list[tuple[str | None, str, str]] = []
         self.used: list[tuple[str, int, str]] = []  # (key, line, recv)
-        self.aliases: set[str] = set()
+        # dotted receiver -> subsystem name (None = perfish but unknown)
+        self.aliases: dict[str, str | None] = {}
+
+    def _perfish(self, expr: ast.AST) -> bool:
+        """Is this receiver a PerfCounters? Either its dotted form
+        names perf somewhere, or it is a tracked alias."""
+        src = _dotted(expr)
+        if "perf" in src.lower():
+            return True
+        return src in self.aliases or src.split(".", 1)[0] in self.aliases
+
+    def _subsys_of(self, expr: ast.AST) -> str | None:
+        """Resolve the subsystem a builder-call receiver belongs to:
+        a chained builder recurses to its base; ``.create("x")`` /
+        ``PerfCounters("x")`` answer directly; names/attributes go
+        through the alias table."""
+        if isinstance(expr, ast.Call):
+            f = expr.func
+            if isinstance(f, ast.Attribute):
+                if f.attr == "create" and "perf" in _dotted(f.value).lower():
+                    return _literal_first_arg(expr)
+                if f.attr in BUILDERS:
+                    return self._subsys_of(f.value)  # builder chain
+            elif isinstance(f, ast.Name) and f.id == "PerfCounters":
+                return _literal_first_arg(expr)
+            return None
+        src = _dotted(expr)
+        if src in self.aliases:
+            return self.aliases[src]
+        return self.aliases.get(src.split(".", 1)[0])
 
     def visit_Assign(self, node: ast.Assign) -> None:
         # X = <perfish>.create("...") / .get("...") / PerfCounters(...)
-        # / <anything>.perf  — X then receives counter mutations
+        # / <anything>.perf  — X then receives counter mutations; the
+        # subsystem rides along when the source names it literally
         value = node.value
         perfish = False
+        subsys: str | None = None
         if isinstance(value, ast.Call):
             f = value.func
             if isinstance(f, ast.Attribute) and f.attr in ("create", "get"):
                 perfish = "perf" in _dotted(f.value).lower()
+                if perfish:
+                    subsys = _literal_first_arg(value)
             elif isinstance(f, ast.Name) and f.id == "PerfCounters":
                 perfish = True
+                subsys = _literal_first_arg(value)
         elif isinstance(value, ast.Attribute):
             perfish = "perf" in _dotted(value).lower()
         if perfish:
             for t in node.targets:
-                if isinstance(t, ast.Name):
-                    self.aliases.add(t.id)
+                if isinstance(t, (ast.Name, ast.Attribute)):
+                    self.aliases[_dotted(t)] = subsys
         self.generic_visit(node)
 
     def visit_Call(self, node: ast.Call) -> None:
@@ -96,9 +153,11 @@ class _FileScan(ast.NodeVisitor):
         if isinstance(f, ast.Attribute):
             key = _literal_first_arg(node)
             if f.attr in BUILDERS and key is not None:
-                self.registered.add(key)
+                self.registered.append(
+                    (self._subsys_of(f.value), key, f.attr)
+                )
             elif f.attr in MUTATORS and key is not None \
-                    and _perfish(f.value, self.aliases):
+                    and self._perfish(f.value):
                 self.used.append((key, node.lineno, _dotted(f.value)))
         self.generic_visit(node)
 
@@ -106,7 +165,7 @@ class _FileScan(ast.NodeVisitor):
 def check(package_dir: str | pathlib.Path) -> list[str]:
     """Returns a list of violation strings (empty = clean)."""
     package_dir = pathlib.Path(package_dir)
-    registered: set[str] = set()
+    regs: list[tuple[pathlib.Path, str | None, str, str]] = []
     used: list[tuple[pathlib.Path, str, int, str]] = []
     for path in sorted(package_dir.rglob("*.py")):
         try:
@@ -115,14 +174,32 @@ def check(package_dir: str | pathlib.Path) -> list[str]:
             return [f"{path}: unparsable: {e}"]
         scan = _FileScan(str(path))
         scan.visit(tree)
-        registered |= scan.registered
+        regs.extend((path, s, k, kind) for s, k, kind in scan.registered)
         used.extend((path, k, ln, recv) for k, ln, recv in scan.used)
     problems = []
+    registered_keys = {k for _p, _s, k, _kind in regs}
     for path, key, line, recv in used:
-        if key not in registered:
+        if key not in registered_keys:
             problems.append(
                 f"{path}:{line}: {recv}.…({key!r}) uses a counter key "
                 f"no builder registers"
+            )
+    # prometheus series collisions after sanitization
+    series: dict[str, set[tuple[str, str]]] = {}
+    for _path, subsys, key, kind in regs:
+        if subsys is None:
+            continue
+        base = f"ceph_{_sanitize(subsys)}_{_sanitize(key)}"
+        for suffix in _SUFFIXES[kind]:
+            series.setdefault(base + suffix, set()).add((subsys, key))
+    for name, owners in sorted(series.items()):
+        if len(owners) > 1:
+            pretty = ", ".join(
+                f"{s}/{k}" for s, k in sorted(owners)
+            )
+            problems.append(
+                f"prometheus series {name!r} is emitted by more than "
+                f"one registration after sanitization: {pretty}"
             )
     return problems
 
@@ -136,7 +213,7 @@ def main(argv: list[str] | None = None) -> int:
     for p in problems:
         print(p, file=sys.stderr)
     if problems:
-        print(f"{len(problems)} unregistered counter key(s)",
+        print(f"{len(problems)} perf-counter problem(s)",
               file=sys.stderr)
         return 1
     print("counter keys: OK")
