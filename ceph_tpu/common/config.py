@@ -118,6 +118,21 @@ OPTIONS: dict[str, Option] = _opts(
            "(k+m shard rows on mesh rows, ICI all-gather reconstruct; "
            "the messenger keeps carrying control traffic) — "
            "ceph_tpu.parallel.engine"),
+    Option("osd_ec_dispatch", bool, True,
+           "coalesce concurrent EC encode/decode requests into one "
+           "padded device launch off the event loop "
+           "(ceph_tpu.osd.ec_dispatch; the osd_ec_mesh path bypasses)"),
+    Option("osd_ec_dispatch_window", float, 0.0005,
+           "EC dispatcher coalescing window (s): a batch flushes this "
+           "long after its first request unless the stripe threshold "
+           "fires first"),
+    Option("osd_ec_dispatch_max_stripes", int, 512,
+           "EC dispatcher flush threshold: queued stripes per "
+           "(codec, geometry) key that trigger an immediate launch"),
+    Option("osd_ec_dispatch_bucket", bool, True,
+           "pad each batched launch's stripe count to the next power "
+           "of two so the jit cache holds O(log max_S) entries per "
+           "codec instead of one per distinct object size"),
     Option("erasure_code_dir", str, "ceph_tpu.models",
            "plugin module prefix (dlopen dir analog)"),
     Option("osd_class_dir", str, "",

@@ -128,7 +128,11 @@ class MatrixErasureCode(ErasureCode):
             raise ErasureCodeValidationError(f"matrix codec supports w=8/16, got {w}")
         self.matrix = np.asarray(matrix, dtype=np.int64)
         assert self.matrix.shape == (m, k)
-        self._decode_cache: dict[tuple, tuple] = {}
+        # jit-cache key, built ONCE: the encode hot path must not
+        # re-serialize the matrix per op (it is immutable from here)
+        self._mkey = _mkey(self.matrix)
+        # (present, missing) -> (recovery matrix, its jit-cache key)
+        self._decode_cache: dict[tuple, tuple[np.ndarray, tuple]] = {}
 
     def init(self, profile: Mapping[str, str]) -> None:
         self._profile = dict(profile)
@@ -141,36 +145,40 @@ class MatrixErasureCode(ErasureCode):
             # hot path: free host-side u32 reinterpret in/out, no
             # device-side relayout (r3 Weak #4)
             return u32_to_bytes(self.encode_chunks_u32(bytes_to_u32(arr)))
-        fn = _jit_matmul(_mkey(self.matrix), self.w)
+        fn = _jit_matmul(self._mkey, self.w)
         return np.asarray(fn(arr))
 
     def encode_chunks_u32(self, d32: np.ndarray) -> np.ndarray:
         """u32-lane fast path ([k, N4] uint32 -> [m, N4] uint32): the
         OSD data path (ec_util) keeps the whole pipeline in u32 so the
         only byte movement is the stripe-layout transpose."""
-        mk = _mkey(self.matrix)
-        fn32 = _jit_matmul_u32(mk, self.w)
+        fn32 = _jit_matmul_u32(self._mkey, self.w)
         # kernel-boundary tap (ops.profiler): the (matrix, shape) key is
-        # the jit-cache signature, so compile-vs-cached splits honestly
-        with profiler().timed("gf_encode", (mk, d32.shape),
-                              nbytes=d32.size * 4, shape=d32.shape):
-            return np.asarray(fn32(d32))
+        # the jit-cache signature, so compile-vs-cached splits honestly;
+        # call_jitted AOT-times the compile separately when jax allows
+        return profiler().call_jitted(
+            "gf_encode", (self._mkey, d32.shape), fn32, (d32,),
+            nbytes=d32.size * 4, shape=d32.shape, wrap=np.asarray,
+        )
 
     def encode_shards_u32(self, d3: np.ndarray) -> np.ndarray:
         """The OSD stack's hot entry: [S, k, C4] u32 stripe view ->
         [k+m, S*C4] u32 shard rows, transpose+matmul+concat fused in
         one device call (see _jit_encode_shards_u32)."""
-        fn = _jit_encode_shards_u32(_mkey(self.matrix), self.w)
-        with profiler().timed("ec_shards", (_mkey(self.matrix), d3.shape),
-                              nbytes=d3.size * 4, shape=d3.shape):
-            return np.asarray(fn(d3))
+        fn = _jit_encode_shards_u32(self._mkey, self.w)
+        return profiler().call_jitted(
+            "ec_shards", (self._mkey, d3.shape), fn, (d3,),
+            nbytes=d3.size * 4, shape=d3.shape, wrap=np.asarray,
+        )
 
     # -- decode -------------------------------------------------------------
 
     def _recovery_matrix(
         self, present: tuple[int, ...], missing: tuple[int, ...]
-    ) -> np.ndarray:
-        """[len(missing), len(present)] GF matrix rebuilding missing rows."""
+    ) -> tuple[np.ndarray, tuple]:
+        """([len(missing), len(present)] GF matrix rebuilding missing
+        rows, its jit-cache key) — the key rides the same erasure-
+        signature cache so decode never re-serializes the matrix."""
         key = (present, missing)
         cached = self._decode_cache.get(key)
         if cached is not None:
@@ -191,8 +199,9 @@ class MatrixErasureCode(ErasureCode):
             for c, p in enumerate(use):
                 full[:, list(present).index(p)] = RM[:, c]
             RM = full
-        self._decode_cache[key] = RM
-        return RM
+        entry = (RM, _mkey(RM))
+        self._decode_cache[key] = entry
+        return entry
 
     def decode_chunks(
         self, present: Sequence[int], chunks: np.ndarray, missing: Sequence[int]
@@ -203,7 +212,7 @@ class MatrixErasureCode(ErasureCode):
             raise IOError(
                 f"cannot decode: {len(present)} chunks available, need {self.k}"
             )
-        RM = self._recovery_matrix(present, missing)
+        RM, rm_key = self._recovery_matrix(present, missing)
         arr = np.asarray(chunks, dtype=np.uint8)
         from ..utils import native as _native
 
@@ -216,18 +225,21 @@ class MatrixErasureCode(ErasureCode):
             # host<->device copies (same routing policy as the encode
             # stack; bytes identical — the GF algebra is exact)
             with profiler().timed("gf_decode_native",
-                                  (_mkey(RM), arr.shape),
+                                  (rm_key, arr.shape),
                                   nbytes=arr.size, shape=arr.shape,
                                   compiled=False):
                 return _native.encode(RM, arr)
         if arr.shape[-1] % 4 == 0:
             # decode stays on the u32 lanes too (free host views, no
             # device relayout) — same policy as encode_chunks
-            fn32 = _jit_matmul_u32(_mkey(RM), self.w)
-            with profiler().timed("gf_decode", (_mkey(RM), arr.shape),
-                                  nbytes=arr.size, shape=arr.shape):
-                return u32_to_bytes(np.asarray(fn32(bytes_to_u32(arr))))
-        fn = _jit_matmul(_mkey(RM), self.w)
+            fn32 = _jit_matmul_u32(rm_key, self.w)
+            return profiler().call_jitted(
+                "gf_decode", (rm_key, arr.shape), fn32,
+                (bytes_to_u32(arr),),
+                nbytes=arr.size, shape=arr.shape,
+                wrap=lambda o: u32_to_bytes(np.asarray(o)),
+            )
+        fn = _jit_matmul(rm_key, self.w)
         return np.asarray(fn(arr))
 
 
@@ -257,7 +269,10 @@ class BitmatrixErasureCode(ErasureCode):
         else:
             self.bitmatrix = gf(w).matrix_to_bitmatrix(self.matrix)
         assert self.bitmatrix.shape == (m * w, k * w)
-        self._decode_cache: dict[tuple, np.ndarray] = {}
+        # jit-cache key bytes, serialized once (immutable from here)
+        self._bm_key = self.bitmatrix.tobytes()
+        # (present, missing) -> (recovery bitmatrix, its key bytes)
+        self._decode_cache: dict[tuple, tuple[np.ndarray, bytes]] = {}
 
     def init(self, profile: Mapping[str, str]) -> None:
         self._profile = dict(profile)
@@ -294,24 +309,24 @@ class BitmatrixErasureCode(ErasureCode):
 
     def encode_chunks(self, data_chunks: np.ndarray) -> np.ndarray:
         pk = self._to_packets(np.asarray(data_chunks, dtype=np.uint8))
-        with profiler().timed("bitmatrix_encode",
-                              (self.bitmatrix.tobytes(), pk.shape),
-                              nbytes=pk.size, shape=pk.shape):
-            if pk.shape[-1] % 4 == 0:
-                fn32 = _jit_bitmatmul_u32(
-                    self.bitmatrix.tobytes(), *self.bitmatrix.shape
-                )
-                out = u32_to_bytes(np.asarray(fn32(bytes_to_u32(pk))))
-            else:
-                fn = _jit_bitmatmul(
-                    self.bitmatrix.tobytes(), *self.bitmatrix.shape
-                )
+        if pk.shape[-1] % 4 == 0:
+            fn32 = _jit_bitmatmul_u32(self._bm_key, *self.bitmatrix.shape)
+            out = profiler().call_jitted(
+                "bitmatrix_encode", (self._bm_key, pk.shape), fn32,
+                (bytes_to_u32(pk),), nbytes=pk.size, shape=pk.shape,
+                wrap=lambda o: u32_to_bytes(np.asarray(o)),
+            )
+        else:
+            fn = _jit_bitmatmul(self._bm_key, *self.bitmatrix.shape)
+            with profiler().timed("bitmatrix_encode",
+                                  (self._bm_key, pk.shape),
+                                  nbytes=pk.size, shape=pk.shape):
                 out = np.asarray(fn(pk))
         return self._from_packets(out, self.m)
 
     def _recovery_bitmatrix(
         self, present: tuple[int, ...], missing: tuple[int, ...]
-    ) -> np.ndarray:
+    ) -> tuple[np.ndarray, bytes]:
         key = (present, missing)
         cached = self._decode_cache.get(key)
         if cached is not None:
@@ -345,8 +360,9 @@ class BitmatrixErasureCode(ErasureCode):
                 idx = list(present).index(p)
                 full[:, idx * w : (idx + 1) * w] = RM[:, c * w : (c + 1) * w]
             RM = full
-        self._decode_cache[key] = RM
-        return RM
+        entry = (RM, RM.tobytes())
+        self._decode_cache[key] = entry
+        return entry
 
     def decode_chunks(
         self, present: Sequence[int], chunks: np.ndarray, missing: Sequence[int]
@@ -357,16 +373,19 @@ class BitmatrixErasureCode(ErasureCode):
             raise IOError(
                 f"cannot decode: {len(present)} chunks available, need {self.k}"
             )
-        RM = self._recovery_bitmatrix(present, missing)
+        RM, rm_key = self._recovery_bitmatrix(present, missing)
         pk = self._to_packets(np.asarray(chunks, dtype=np.uint8))
-        with profiler().timed("bitmatrix_decode",
-                              (RM.tobytes(), pk.shape),
-                              nbytes=pk.size, shape=pk.shape):
-            if pk.shape[-1] % 4 == 0:
-                fn32 = _jit_bitmatmul_u32(RM.tobytes(), *RM.shape)
-                out = u32_to_bytes(np.asarray(fn32(bytes_to_u32(pk))))
-            else:
-                fn = _jit_bitmatmul(RM.tobytes(), *RM.shape)
+        if pk.shape[-1] % 4 == 0:
+            fn32 = _jit_bitmatmul_u32(rm_key, *RM.shape)
+            out = profiler().call_jitted(
+                "bitmatrix_decode", (rm_key, pk.shape), fn32,
+                (bytes_to_u32(pk),), nbytes=pk.size, shape=pk.shape,
+                wrap=lambda o: u32_to_bytes(np.asarray(o)),
+            )
+        else:
+            fn = _jit_bitmatmul(rm_key, *RM.shape)
+            with profiler().timed("bitmatrix_decode", (rm_key, pk.shape),
+                                  nbytes=pk.size, shape=pk.shape):
                 out = np.asarray(fn(pk))
         return self._from_packets(out, len(missing))
 

@@ -96,6 +96,36 @@ def _native_matrix_engine(ec_impl) -> bool:
     )
 
 
+def native_encode_path(sinfo: StripeInfo, ec_impl) -> bool:
+    """Will :func:`encode` actually take the native C branch for this
+    geometry?  ONE predicate shared with the microbatch dispatcher's
+    per-op direct lane, so the routing gates cannot drift (the branch
+    below additionally needs ``cs % 8 == 0``)."""
+    return sinfo.chunk_size % 8 == 0 and _native_matrix_engine(ec_impl)
+
+
+def native_decode_path(ec_impl, shard_len: int) -> bool:
+    """Will the codec's decode take the native C branch for shard
+    buffers of ``shard_len`` bytes?  Mirrors the gate in
+    MatrixErasureCode.decode_chunks (w=8, last dim % 8, native engine);
+    shared with the dispatcher for the same no-drift reason."""
+    return shard_len % 8 == 0 and _native_matrix_engine(ec_impl)
+
+
+def account_ec_call(pec, op: str, nbytes: int, seconds: float,
+                    *, mesh: bool = False) -> None:
+    """THE definition of the ``ec.{encode,decode}`` device-wall-time
+    feed — time avg + (size x latency) histogram + per-engine GB/s
+    gauge — shared by the OSD router (mesh/inline routes), the
+    microbatch dispatcher's batch launches, and its native direct lane,
+    so the three call sites cannot drift."""
+    pec.observe(f"{op}_time", seconds)
+    pec.hist(f"{op}_time_histogram", nbytes, seconds)
+    if seconds > 0:
+        pec.set(f"mesh_{op}_gbps" if mesh else f"{op}_gbps",
+                nbytes / seconds / 1e9)
+
+
 def _check_batch_alignment(sinfo: StripeInfo, ec_impl) -> None:
     """Packetized (bitmatrix) codecs need chunk_size % (w*packetsize) == 0 or
     batched packets would span stripe boundaries and diverge from the
@@ -148,10 +178,12 @@ def encode(
         # the OSD's CPU-host hot path bypasses the jax codec entries, so
         # it must report into the kernel profiler here or the daemon's
         # dump_kernel_profile is empty exactly where the stack runs;
-        # no jit cache on the C engine -> every call is steady-state
+        # no jit cache on the C engine -> every call is steady-state.
+        # The matrix key is built once at codec construction (_mkey) —
+        # re-serializing matrix.tobytes() per op was hot-path waste.
         with profiler().timed(
             "native_stripes_encode",
-            (ec_impl.matrix.tobytes(), S, cs),
+            (ec_impl._mkey, S, cs),
             nbytes=buf.size, shape=(S, k, cs), compiled=False,
         ):
             out_arr = native.encode_stripes(ec_impl.matrix, buf, S, cs)
@@ -219,6 +251,18 @@ def decode(
     return ec_impl.decode(list(want), {i: np.asarray(chunks[i]) for i in present})
 
 
+def shards_to_logical(rows: Sequence[np.ndarray], chunk_size: int) -> bytes:
+    """[k, S*cs] data-shard rows -> the logical stripe-interleaved
+    bytes: the ONE inverse of :func:`encode`'s layout transform, shared
+    by decode_concat and the microbatch dispatcher's per-op reassembly
+    so the two decode paths cannot drift."""
+    stack = np.stack([np.asarray(r) for r in rows])
+    k = stack.shape[0]
+    S = stack.shape[1] // chunk_size
+    arr = stack.reshape(k, S, chunk_size).transpose(1, 0, 2)
+    return np.ascontiguousarray(arr).tobytes()
+
+
 def decode_concat(
     sinfo: StripeInfo,
     ec_impl: ErasureCodeInterface,
@@ -231,11 +275,9 @@ def decode_concat(
     """
     k = ec_impl.get_data_chunk_count()
     decoded = decode(sinfo, ec_impl, chunks, want=list(range(k)))
-    shard_len = decoded[0].size
-    S = shard_len // sinfo.chunk_size
-    stack = np.stack([decoded[i] for i in range(k)])  # [k, S*cs]
-    arr = stack.reshape(k, S, sinfo.chunk_size).transpose(1, 0, 2)
-    return np.ascontiguousarray(arr).tobytes()
+    return shards_to_logical(
+        [decoded[i] for i in range(k)], sinfo.chunk_size
+    )
 
 
 # -- StripeHashes ------------------------------------------------------------

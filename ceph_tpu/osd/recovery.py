@@ -1027,8 +1027,9 @@ class RecoveryManager:
             padded = (
                 sinfo.pad_to_stripe(data) if data else b"\x00" * sinfo.stripe_width
             )
-            # routes through the mesh engine when osd_ec_mesh is on
-            shard_bufs = osd._ec_encode_bufs(sinfo, codec, padded)
+            # routes through the mesh engine when osd_ec_mesh is on,
+            # else the microbatch dispatcher / host path (async router)
+            shard_bufs = await osd._ec_encode_bufs(sinfo, codec, padded)
             km = codec.get_chunk_count()
             hashes = StripeHashes(km, sinfo.chunk_size)
             hashes.set_range(0, shard_bufs)

@@ -24,9 +24,12 @@ def _load_tool():
     return mod
 
 
-def _write_round(tmp_path, n, phase, value, wrapped=True, parsed=True):
+def _write_round(tmp_path, n, phase, value, wrapped=True, parsed=True,
+                 batch_bytes=None):
     line = {"metric": "m", "value": value, "unit": "GB/s",
             "phase": phase}
+    if batch_bytes is not None:
+        line["batch_bytes"] = batch_bytes
     obj = ({"n": n, "rc": 0, "parsed": (line if parsed else None)}
            if wrapped else line)
     (tmp_path / f"BENCH_r{n:02d}.json").write_text(json.dumps(obj))
@@ -58,6 +61,39 @@ class TestBenchRegress:
         report = br.compare(br.load_rounds(str(tmp_path)))
         assert report["comparable"] is False
         assert br.main(["--dir", str(tmp_path)]) == 0
+
+    def test_batch_mismatch_is_excluded(self, tmp_path):
+        """The jax-cpu fallback's shrunken 8 MiB batch must not be
+        judged against a 64 MiB round: same phase, different
+        batch_bytes -> the prior is excluded from the comparison."""
+        br = _load_tool()
+        _write_round(tmp_path, 1, "jax-cpu", 9.0, batch_bytes=64 << 20)
+        # shrunken batch, lower GB/s than a 2x drop would allow
+        _write_round(tmp_path, 2, "jax-cpu", 3.0, batch_bytes=8 << 20)
+        report = br.compare(br.load_rounds(str(tmp_path)))
+        assert report["comparable"] is False
+        assert report["excluded_batch_mismatch"] == ["BENCH_r01.json"]
+        assert br.main(["--dir", str(tmp_path)]) == 0
+
+    def test_same_batch_still_gates(self, tmp_path):
+        br = _load_tool()
+        _write_round(tmp_path, 1, "tpu", 600.0, batch_bytes=64 << 20)
+        _write_round(tmp_path, 2, "tpu", 250.0, batch_bytes=64 << 20)
+        report = br.compare(br.load_rounds(str(tmp_path)))
+        assert report["comparable"] is True
+        assert report["regression"] is True
+        assert br.main(["--dir", str(tmp_path)]) == 1
+
+    def test_legacy_rounds_without_batch_bytes_compare(self, tmp_path):
+        """Rounds predating the batch_bytes field keep gating (the
+        wildcard rule), so the trajectory does not go blind at the
+        transition."""
+        br = _load_tool()
+        _write_round(tmp_path, 1, "tpu", 600.0)  # legacy, no field
+        _write_round(tmp_path, 2, "tpu", 250.0, batch_bytes=64 << 20)
+        report = br.compare(br.load_rounds(str(tmp_path)))
+        assert report["comparable"] is True
+        assert report["regression"] is True
 
     def test_unparsed_rounds_skipped_and_bare_lines_accepted(
         self, tmp_path

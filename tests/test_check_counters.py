@@ -53,6 +53,42 @@ def test_chained_and_aliased_receivers(tmp_path):
     assert keys == {"chained_typo", "gauge_typo"}
 
 
+def test_detects_mutator_kind_mismatch(tmp_path):
+    """inc on a gauge / hist on a counter are runtime TypeErrors — the
+    gate catches them statically (the ec.dispatch histogram class)."""
+    cc = _load_tool()
+    (tmp_path / "mod.py").write_text(
+        'pc = self.perf.create("ec")\n'
+        'pc.add_gauge("depth")\n'
+        'pc.add_counter("dispatch_batches")\n'
+        'pc.add_histogram("dispatch_batch_size_histogram")\n'
+        'pc.inc("depth")\n'                              # gauge via inc
+        'pc.hist("dispatch_batches", 1)\n'               # counter via hist
+        'pc.hist("dispatch_batch_size_histogram", 1)\n'  # correct
+        'pc.inc("dispatch_batches")\n'                   # correct
+    )
+    problems = cc.check(tmp_path)
+    assert len(problems) == 2
+    assert any("inc('depth')" in p for p in problems)
+    assert any("hist('dispatch_batches')" in p for p in problems)
+
+
+def test_kind_shared_across_subsystems_not_flagged(tmp_path):
+    """A key registered as different kinds in different subsystems is
+    fine as long as SOME registration matches the mutator (receivers
+    are not subsystem-resolved)."""
+    cc = _load_tool()
+    (tmp_path / "mod.py").write_text(
+        'a = self.perf.create("osd")\n'
+        'a.add_counter("latency")\n'
+        'b = self.perf.create("rgw")\n'
+        'b.add_time_avg("latency")\n'
+        'a.inc("latency")\n'
+        'b.observe("latency", 0.1)\n'
+    )
+    assert cc.check(tmp_path) == []
+
+
 def test_cli_exit_codes(tmp_path):
     cc = _load_tool()
     (tmp_path / "ok.py").write_text(

@@ -13,6 +13,15 @@ environment fault, not a kernel regression, and must not trip the gate
 oranges) — and exits nonzero when the newest throughput falls below
 ``threshold`` x the prior best.
 
+Same-phase is necessary but not sufficient: the jax-cpu fallback
+shrinks its batch to 8 MiB under tight budgets while TPU rounds run
+the full 64 MiB, and GB/s at 8 MiB is not GB/s at 64 MiB (less launch
+amortization).  Rounds now record ``batch_bytes`` in the final line;
+when both the newest round and a prior record it, a mismatch excludes
+that prior from the comparison (listed in the report as
+``excluded_batch_mismatch``).  Rounds predating the field are compared
+as before — the ambiguity dies out as the trajectory grows.
+
 Usage:
   python tools/bench_regress.py [--dir D] [--last N] [--threshold R]
                                 [--metric value]
@@ -80,11 +89,29 @@ def compare(rounds: list[dict], metric: str = "value",
         if r["phase"] == phase
         and isinstance(r["line"].get(metric), (int, float))
     ]
+    # per-byte comparability: drop priors measured on a DIFFERENT batch
+    # size (the 8 MiB cpu-fallback vs 64 MiB TPU trap); unrecorded
+    # batch_bytes (older rounds) stays comparable
+    cur_bb = newest["line"].get("batch_bytes")
+    excluded = []
+    if cur_bb is not None:
+        excluded = [
+            r["file"] for r in priors
+            if r["line"].get("batch_bytes") not in (None, cur_bb)
+        ]
+        priors = [
+            r for r in priors
+            if r["line"].get("batch_bytes") in (None, cur_bb)
+        ]
     if not priors:
         return {
             "comparable": False, "newest": newest["file"],
             "phase": phase,
-            "reason": f"no earlier round with phase {phase!r}",
+            **({"excluded_batch_mismatch": excluded} if excluded else {}),
+            "reason": (
+                f"no earlier round with phase {phase!r}"
+                + (" and a matching batch_bytes" if excluded else "")
+            ),
         }
     best = max(priors, key=lambda r: r["line"][metric])
     best_v = float(best["line"][metric])
@@ -93,6 +120,8 @@ def compare(rounds: list[dict], metric: str = "value",
         "comparable": True,
         "newest": newest["file"],
         "phase": phase,
+        **({"batch_bytes": cur_bb} if cur_bb is not None else {}),
+        **({"excluded_batch_mismatch": excluded} if excluded else {}),
         "metric": metric,
         "current": float(cur),
         "best_prior": best_v,

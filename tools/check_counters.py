@@ -1,7 +1,7 @@
 #!/usr/bin/env python3
 """Static perf-counter consistency pass (CI gate).
 
-Two checks over the ``ceph_tpu`` package's ASTs:
+Three checks over the ``ceph_tpu`` package's ASTs:
 
 1. **Unregistered keys.** Every
    ``perf.get(...).inc/set/observe/time/hist("key")`` call site must
@@ -19,6 +19,16 @@ Two checks over the ``ceph_tpu`` package's ASTs:
    samples in the scrape; this pass resolves each builder call's
    subsystem (from ``perf.create("name")`` / ``PerfCounters("name")``
    assignments) and fails on any such collision.
+
+3. **Mutator/builder kind mismatches.** ``inc`` only works on
+   ``add_counter`` keys, ``set`` on gauges, ``observe``/``time`` on
+   averages, ``hist`` on histograms — PerfCounters raises TypeError at
+   runtime otherwise, which (like an unregistered key) only fires when
+   that exact path runs.  A used key whose registrations are ALL
+   kind-incompatible with the mutator fails here instead (any one
+   compatible registration passes: receivers are not resolved to a
+   subsystem, so a key name shared across subsystems with different
+   kinds must not false-positive).
 
 Scope rules (pragmatic, zero false positives on this codebase):
 - registrations: any builder call with a literal first argument,
@@ -47,6 +57,16 @@ import sys
 BUILDERS = {"add_counter", "add_gauge", "add_avg", "add_time_avg",
             "add_histogram"}
 MUTATORS = {"inc", "set", "observe", "time", "hist"}
+
+# which builder kinds each mutator accepts at runtime (PerfCounters
+# raises TypeError otherwise)
+_MUTATOR_KINDS = {
+    "inc": {"add_counter"},
+    "set": {"add_gauge"},
+    "observe": {"add_avg", "add_time_avg"},
+    "time": {"add_avg", "add_time_avg"},
+    "hist": {"add_histogram"},
+}
 
 # exposition suffixes per builder kind (mirrors mgr/modules.py
 # PrometheusModule flattening: avgs -> triplet, histograms -> bucket
@@ -92,7 +112,8 @@ class _FileScan(ast.NodeVisitor):
         self.path = path
         # (subsys | None, key, builder kind)
         self.registered: list[tuple[str | None, str, str]] = []
-        self.used: list[tuple[str, int, str]] = []  # (key, line, recv)
+        # (key, line, receiver, mutator)
+        self.used: list[tuple[str, int, str, str]] = []
         # dotted receiver -> subsystem name (None = perfish but unknown)
         self.aliases: dict[str, str | None] = {}
 
@@ -158,7 +179,8 @@ class _FileScan(ast.NodeVisitor):
                 )
             elif f.attr in MUTATORS and key is not None \
                     and self._perfish(f.value):
-                self.used.append((key, node.lineno, _dotted(f.value)))
+                self.used.append((key, node.lineno, _dotted(f.value),
+                                  f.attr))
         self.generic_visit(node)
 
 
@@ -175,14 +197,25 @@ def check(package_dir: str | pathlib.Path) -> list[str]:
         scan = _FileScan(str(path))
         scan.visit(tree)
         regs.extend((path, s, k, kind) for s, k, kind in scan.registered)
-        used.extend((path, k, ln, recv) for k, ln, recv in scan.used)
+        used.extend(
+            (path, k, ln, recv, mut) for k, ln, recv, mut in scan.used
+        )
     problems = []
     registered_keys = {k for _p, _s, k, _kind in regs}
-    for path, key, line, recv in used:
+    kinds_by_key: dict[str, set[str]] = {}
+    for _p, _s, k, kind in regs:
+        kinds_by_key.setdefault(k, set()).add(kind)
+    for path, key, line, recv, mut in used:
         if key not in registered_keys:
             problems.append(
                 f"{path}:{line}: {recv}.…({key!r}) uses a counter key "
                 f"no builder registers"
+            )
+        elif not (kinds_by_key[key] & _MUTATOR_KINDS[mut]):
+            have = "/".join(sorted(kinds_by_key[key]))
+            problems.append(
+                f"{path}:{line}: {recv}.{mut}({key!r}) but every "
+                f"registration of that key is {have} — runtime TypeError"
             )
     # prometheus series collisions after sanitization
     series: dict[str, set[tuple[str, str]]] = {}
