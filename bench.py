@@ -988,6 +988,83 @@ def bench_smallops(deadline: float | None, platform: str | None) -> dict:
     }
 
 
+def bench_qos(deadline: float | None = None) -> dict:
+    """QoS starvation gate: client op wait p50/p99 through the OSD's
+    dmClock scheduler under a saturating synthetic recovery storm —
+    scheduler on (``osd_op_queue=mclock``) vs off (``fifo``), same
+    storm both times.
+
+    The harness drives ``ceph_tpu.osd.scheduler.OpScheduler`` directly
+    (pure asyncio, no device): one service slot with a fixed per-grant
+    service time models the saturated device, a 4:1 pre-queued
+    background storm models recovery, and clients arrive paced while
+    the storm drains.  ``protection`` is fifo-p99 / mclock-p99 — the
+    factor the scheduler buys on tail latency when the cluster is
+    degraded; it rides the BENCH_* trajectory and is gateable via
+    ``tools/bench_regress.py --metric qos.protection``.
+    """
+    import asyncio
+
+    from ceph_tpu.osd.scheduler import OpScheduler, QosSpec
+
+    service_s = 0.002     # per-grant device time (slots=1 -> 500/s)
+    n_client = 60
+    storm = 4 * n_client  # the 4:1 background:client storm
+    arrival_s = 0.003     # client inter-arrival (demand ~333/s > res)
+
+    async def run_policy(policy: str) -> dict:
+        sched = OpScheduler(
+            {
+                "client": QosSpec(reservation=100.0, weight=4.0),
+                "recovery": QosSpec(reservation=10.0, weight=1.0),
+            },
+            policy=policy, slots=1, cut_off=10_000,
+        )
+        waits: list[float] = []
+
+        async def one(klass: str) -> None:
+            t0 = time.perf_counter()
+            async with sched.grant(klass):
+                if klass == "client":
+                    waits.append(time.perf_counter() - t0)
+                await asyncio.sleep(service_s)
+
+        bg = [asyncio.ensure_future(one("recovery")) for _ in range(storm)]
+        await asyncio.sleep(0)  # the storm queues FIRST — worst case
+        cl = []
+        for _ in range(n_client):
+            cl.append(asyncio.ensure_future(one("client")))
+            await asyncio.sleep(arrival_s)
+        await asyncio.gather(*cl)
+        share = sched.share_attainment("client")
+        for t in bg:  # storm drained enough; stop burning wall clock
+            t.cancel()
+        await asyncio.gather(*bg, return_exceptions=True)
+        ws = sorted(waits)
+        return {
+            "p50_ms": round(ws[len(ws) // 2] * 1e3, 3),
+            "p99_ms": round(
+                ws[min(len(ws) - 1, int(len(ws) * 0.99))] * 1e3, 3
+            ),
+            "max_ms": round(ws[-1] * 1e3, 3),
+            "share_attainment": (
+                round(share, 3) if share is not None else None
+            ),
+        }
+
+    mclock = asyncio.run(run_policy("mclock"))
+    fifo = asyncio.run(run_policy("fifo"))
+    return {
+        "storm": {"background": storm, "clients": n_client,
+                  "service_ms": service_s * 1e3, "slots": 1},
+        "mclock": mclock,
+        "fifo": fifo,
+        "protection": round(
+            fifo["p99_ms"] / max(mclock["p99_ms"], 1e-3), 3
+        ),
+    }
+
+
 # -- parent orchestration ----------------------------------------------------
 
 _BEST: dict | None = None
@@ -1496,6 +1573,21 @@ def main():
         _phase_note("native-mc", f"failed: {e!r:.120}", time.time() - t0_mc)
         log(f"phase native-mc failed: {e!r}")
 
+    # the QoS starvation gate (PR 5): pure-asyncio, ~1s, no device —
+    # runs in the parent so the trajectory carries the scheduler's
+    # tail-latency protection factor every round, whatever the TPU does
+    qos_res: dict = {}
+    t0_qos = time.time()
+    try:
+        qos_res = bench_qos()
+        _phase_note("qos", "ok", time.time() - t0_qos)
+        log(f"phase qos: mclock p99 {qos_res['mclock']['p99_ms']}ms "
+            f"vs fifo p99 {qos_res['fifo']['p99_ms']}ms "
+            f"(protection {qos_res['protection']}x)")
+    except Exception as e:
+        _phase_note("qos", f"failed: {e!r:.120}", time.time() - t0_qos)
+        log(f"phase qos failed: {e!r}")
+
     # cpu codec-stack measurement (VERDICT r4 #4: stack_gbps must reach
     # the final line even when the TPU answers the first probe and the
     # jax-cpu combo never runs).  Runs SERIALLY after the accelerator
@@ -1603,6 +1695,8 @@ def main():
             else:
                 if stack_res.get("kernel_profile"):
                     final["kernel_profile"] = stack_res["kernel_profile"]
+        if qos_res:
+            final["qos"] = qos_res
         # the per-phase attempt record ALWAYS ships — on a child dying
         # inside device acquisition this is the breakdown the bench
         # trajectory was previously missing entirely

@@ -30,6 +30,10 @@ class Option:
     type: type  # int | float | bool | str
     default: Any
     desc: str = ""
+    # enumerated options reject bad values HERE, before Config.set
+    # commits — an observer raising after the commit would leave
+    # `config show` and daemon state diverged
+    choices: "tuple | None" = None
 
     def coerce(self, value: Any) -> Any:
         if self.type is bool:
@@ -42,9 +46,15 @@ class Option:
                 return False
             raise ValueError(f"{self.name}: bad bool {value!r}")
         try:
-            return self.type(value)
+            coerced = self.type(value)
         except (TypeError, ValueError) as e:
             raise ValueError(f"{self.name}: {e}") from None
+        if self.choices is not None and coerced not in self.choices:
+            raise ValueError(
+                f"{self.name}: must be one of {self.choices}, "
+                f"got {coerced!r}"
+            )
+        return coerced
 
 
 def _opts(*options: Option) -> dict[str, Option]:
@@ -112,6 +122,61 @@ OPTIONS: dict[str, Option] = _opts(
     Option("osd_recovery_reserve_timeout", float, 30.0,
            "budget for acquiring local+remote recovery reservations "
            "before the pass defers (s)"),
+    # osd: QoS op scheduling (reference: osd_op_queue selecting
+    # WeightedPriorityQueue / mClockScheduler, src/common/config_opts.h
+    # + the osd_mclock_scheduler_* profile options; dmClock from
+    # Gulati et al., OSDI 2010) — ceph_tpu.osd.scheduler
+    Option("osd_op_queue", str, "mclock",
+           "op scheduler policy: mclock (dmClock reservation/weight/"
+           "limit tags) | wpq (weight-only fair queueing) | fifo "
+           "(arrival order, scheduling off); live-switchable",
+           choices=("mclock", "wpq", "fifo")),
+    Option("osd_op_queue_slots", int, 256,
+           "concurrent grants the QoS scheduler hands out (the "
+           "capacity model); a CLIENT grant is held across the whole "
+           "op, replica round trips included, so this must cover "
+           "device concurrency TIMES latency hiding — size it like a "
+           "connection pool, not like a core count; queues form — and "
+           "the policy starts mattering — only when all slots are "
+           "busy"),
+    Option("osd_op_queue_cut_off", int, 256,
+           "total queued entries across the QoS scheduler past which "
+           "new best-effort admissions (scrub/snaptrim/ec_background) "
+           "defer (QosDeferred) instead of queueing — overload "
+           "shedding for background work when the pool is drowning "
+           "in client traffic"),
+    Option("osd_mclock_scheduler_client_res", float, 10.0,
+           "client class: reserved ops/s under contention"),
+    Option("osd_mclock_scheduler_client_wgt", float, 4.0,
+           "client class: proportional weight above the reservation"),
+    Option("osd_mclock_scheduler_client_lim", float, 0.0,
+           "client class: ops/s hard cap (0 = unlimited)"),
+    Option("osd_mclock_scheduler_recovery_res", float, 1.0,
+           "recovery class: reserved object pushes/s"),
+    Option("osd_mclock_scheduler_recovery_wgt", float, 1.0,
+           "recovery class: proportional weight"),
+    Option("osd_mclock_scheduler_recovery_lim", float, 0.0,
+           "recovery class: pushes/s hard cap (0 = unlimited)"),
+    Option("osd_mclock_scheduler_scrub_res", float, 0.5,
+           "scrub class: reserved PG scrubs/s"),
+    Option("osd_mclock_scheduler_scrub_wgt", float, 1.0,
+           "scrub class: proportional weight"),
+    Option("osd_mclock_scheduler_scrub_lim", float, 0.0,
+           "scrub class: PG scrubs/s hard cap (0 = unlimited)"),
+    Option("osd_mclock_scheduler_snaptrim_res", float, 0.5,
+           "snaptrim class: reserved PG trim passes/s"),
+    Option("osd_mclock_scheduler_snaptrim_wgt", float, 1.0,
+           "snaptrim class: proportional weight"),
+    Option("osd_mclock_scheduler_snaptrim_lim", float, 0.0,
+           "snaptrim class: trim passes/s hard cap (0 = unlimited)"),
+    Option("osd_mclock_scheduler_ec_background_res", float, 16.0,
+           "ec_background class: reserved stripes/s at the EC "
+           "dispatcher boundary (the rate background stripes fall "
+           "back to while client ops are queued)"),
+    Option("osd_mclock_scheduler_ec_background_wgt", float, 1.0,
+           "ec_background class: proportional weight"),
+    Option("osd_mclock_scheduler_ec_background_lim", float, 0.0,
+           "ec_background class: stripes/s hard cap (0 = unlimited)"),
     # erasure code
     Option("osd_ec_mesh", bool, False,
            "route EC encode/reconstruct through the device-mesh engine "

@@ -18,8 +18,11 @@ import time
 from collections import deque
 from typing import Any
 
-# the canonical state sequence (reference OpRequest flag names)
-STATES = ("queued", "dequeued", "sub_op_sent", "sub_op_applied", "replied")
+# the canonical state sequence (reference OpRequest flag names;
+# queued_for_qos brackets the wait in the QoS op scheduler — the
+# reference's queued_for_pg span in the op queue)
+STATES = ("queued", "queued_for_qos", "dequeued", "sub_op_sent",
+          "sub_op_applied", "replied")
 
 
 class TrackedOp:

@@ -104,8 +104,16 @@ class ECDispatcher:
 
     def __init__(self, perf=None, *, window: float = 5e-4,
                  max_stripes: int = 512, bucket: bool = True,
-                 max_workers: int = 2):
+                 max_workers: int = 2, scheduler=None):
         self._perf = perf
+        # the OSD's QoS scheduler (osd/scheduler.py; None standalone):
+        # BACKGROUND stripes (klass != "client") pace through it before
+        # entering a batch window, so client stripes preempt recovery
+        # stripes exactly when the device is the bottleneck.  Pacing is
+        # tag-only (no slot held) — the caller may already hold a
+        # recovery/scrub grant, and nesting slot acquisitions at this
+        # depth could deadlock the pool.
+        self._scheduler = scheduler
         self.window = float(window)
         self.max_stripes = int(max_stripes)
         self.bucket = bool(bucket)
@@ -135,11 +143,15 @@ class ECDispatcher:
     # -- public API ----------------------------------------------------------
 
     async def encode(
-        self, sinfo: ec_util.StripeInfo, codec, data
+        self, sinfo: ec_util.StripeInfo, codec, data, *,
+        klass: str = "client",
     ) -> dict[int, np.ndarray]:
         """Batched analog of :func:`ec_util.encode` — same contract,
         same bytes; may share its device launch with other in-flight
-        ops."""
+        ops.  ``klass`` is the QoS traffic class: background stripes
+        pace through the scheduler before entering a batch window, and
+        batches never mix classes (the key includes it), so a client
+        batch is never held open for — or padded by — recovery math."""
         buf = as_u8(data)
         if buf.size % sinfo.stripe_width != 0:
             raise ValueError(
@@ -151,23 +163,31 @@ class ECDispatcher:
             # empty payloads and shutdown drain skip the queue (nothing
             # to amortize / no flusher guaranteed to run again)
             return ec_util.encode(sinfo, codec, buf)
+        await self._qos_pace(klass, stripes)
+        if self._stopping:
+            # stop() may have drained the batches and shut the worker
+            # pool down while we slept in pace() — a late submit would
+            # open a batch nobody will ever flush (and the executor
+            # would refuse the launch)
+            return ec_util.encode(sinfo, codec, buf)
         if ec_util.native_encode_path(sinfo, codec):
             # no launch/compile overhead to amortize on the C engine —
             # keep per-op (cache-resident) calls, just off the loop
             return await self._run_native_direct(
                 ec_util.encode, sinfo, codec, buf, "encode", buf.size
             )
-        key = ("enc", id(codec), sinfo.stripe_width, sinfo.chunk_size)
+        key = ("enc", klass, id(codec), sinfo.stripe_width,
+               sinfo.chunk_size)
         return await self._submit(key, "enc", codec, sinfo, buf, stripes)
 
     async def decode_concat(
         self, sinfo: ec_util.StripeInfo, codec,
-        chunks: Mapping[int, np.ndarray],
+        chunks: Mapping[int, np.ndarray], *, klass: str = "client",
     ) -> bytes:
         """Batched analog of :func:`ec_util.decode_concat`.  Requests
         coalesce only with peers reading through the SAME survivor set
         (the recovery matrix — hence the jit signature — depends on
-        it)."""
+        it) and the same QoS class (see :meth:`encode`)."""
         arrs = {int(s): as_u8(v) for s, v in chunks.items()}
         sizes = {a.size for a in arrs.values()}
         if len(sizes) != 1:
@@ -181,15 +201,28 @@ class ECDispatcher:
         stripes = shard_len // sinfo.chunk_size
         if stripes == 0 or self._stopping:
             return ec_util.decode_concat(sinfo, codec, arrs)
+        await self._qos_pace(klass, stripes)
+        if self._stopping:
+            # see encode(): stop() may have won the race while pacing
+            return ec_util.decode_concat(sinfo, codec, arrs)
         if ec_util.native_decode_path(codec, shard_len):
             return await self._run_native_direct(
                 ec_util.decode_concat, sinfo, codec, arrs, "decode",
                 shard_len * len(arrs),
             )
         present = tuple(sorted(arrs))
-        key = ("dec", id(codec), sinfo.stripe_width, sinfo.chunk_size,
-               present)
+        key = ("dec", klass, id(codec), sinfo.stripe_width,
+               sinfo.chunk_size, present)
         return await self._submit(key, "dec", codec, sinfo, arrs, stripes)
+
+    async def _qos_pace(self, klass: str, stripes: int) -> None:
+        """Background stripes wait out the scheduler's pacing tags
+        before joining a batch window; client stripes pass — their op
+        was already admitted (and is holding a grant) at the OSD op
+        intake, so gating them again would double-charge the class."""
+        if self._scheduler is None or klass == "client":
+            return
+        await self._scheduler.pace(klass, cost=float(stripes))
 
     async def stop(self) -> None:
         """Flush every open batch (reason ``stop``), wait for in-flight

@@ -564,23 +564,34 @@ class RecoveryManager:
 
                 async def _one(oid: str, state: dict) -> None:
                     async with sem:
-                        self.active_pushes += 1
-                        self.max_active_pushes = max(
-                            self.max_active_pushes, self.active_pushes
-                        )
-                        try:
-                            if state["op"] == "delete":
-                                await self._propagate_delete(
-                                    pg, pool, erasure, shards, scans, oid,
-                                    state,
-                                )
-                            else:
-                                await self._repair_object(
-                                    pg, pool, erasure, shards, scans, oid,
-                                    state, acting,
-                                )
-                        finally:
-                            self.active_pushes -= 1
+                        # QoS grant per object push (the reference's
+                        # PGRecovery items in the op queue): recovery
+                        # asks the scheduler instead of free-running,
+                        # so a storm backs off behind client traffic.
+                        # No shed path: recovery's scheduler backlog is
+                        # already bounded (the semaphore above caps
+                        # waiters at osd_recovery_max_active, behind
+                        # the osd_max_backfills reservations), so it
+                        # queues instead of deferring
+                        async with osd.scheduler.grant("recovery"):
+                            self.active_pushes += 1
+                            self.max_active_pushes = max(
+                                self.max_active_pushes,
+                                self.active_pushes,
+                            )
+                            try:
+                                if state["op"] == "delete":
+                                    await self._propagate_delete(
+                                        pg, pool, erasure, shards,
+                                        scans, oid, state,
+                                    )
+                                else:
+                                    await self._repair_object(
+                                        pg, pool, erasure, shards,
+                                        scans, oid, state, acting,
+                                    )
+                            finally:
+                                self.active_pushes -= 1
 
                 results = await asyncio.gather(
                     *(_one(o, s) for o, s in work), return_exceptions=True
@@ -1016,7 +1027,12 @@ class RecoveryManager:
             # reconstruct the logical object, re-encode, push stale chunks
             # (one batched device call rebuilds every missing shard)
             codec, sinfo = osd._pool_codec(pool)
-            r, data = await osd._ec_read(pg, pool, acting, oid)
+            # the rebuild's device math is background EC traffic: it
+            # paces through the QoS scheduler at the dispatcher, so a
+            # repair storm cannot starve client stripes of the device
+            r, data = await osd._ec_read(
+                pg, pool, acting, oid, klass="ec_background"
+            )
             if r < 0:
                 logger.warning(
                     "%s: cannot recover %s/%s (read err %d)",
@@ -1029,7 +1045,9 @@ class RecoveryManager:
             )
             # routes through the mesh engine when osd_ec_mesh is on,
             # else the microbatch dispatcher / host path (async router)
-            shard_bufs = await osd._ec_encode_bufs(sinfo, codec, padded)
+            shard_bufs = await osd._ec_encode_bufs(
+                sinfo, codec, padded, klass="ec_background"
+            )
             km = codec.get_chunk_count()
             hashes = StripeHashes(km, sinfo.chunk_size)
             hashes.set_range(0, shard_bufs)

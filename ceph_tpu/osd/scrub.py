@@ -33,6 +33,7 @@ from .ec_util import StripeHashes
 from .osdmap import CRUSH_ITEM_NONE, PGid, Pool, POOL_TYPE_ERASURE
 from .pg_log import is_stash_name
 from .recovery import OI_KEY
+from .scheduler import QosDeferred
 
 logger = logging.getLogger("ceph_tpu.osd.scrub")
 
@@ -118,7 +119,17 @@ class ScrubManager:
                 if primary != osd.osd_id:
                     continue
                 led.add(str(pg))
-                reports.append(await self.scrub_pg(pg, pool, acting, repair))
+                # QoS grant per PG (scheduled scrubs only — operator
+                # `ceph pg scrub` commands call scrub_pg directly and
+                # jump the queue, like the reference's must_scrub): a
+                # shed pass is simply picked up by the next interval
+                try:
+                    async with osd.scheduler.grant("scrub"):
+                        reports.append(
+                            await self.scrub_pg(pg, pool, acting, repair)
+                        )
+                except QosDeferred:
+                    continue
         # prune gauge state for PGs this OSD no longer leads (primary
         # moved, pool deleted): a stale entry would pin OSD_SCRUB_ERRORS
         # at HEALTH_ERR forever after the NEW primary repairs the pg
@@ -294,7 +305,13 @@ class ScrubManager:
             return
 
         # rebuild the bad shards from the clean ones: one batched
-        # device decode (the recovery reconstruct path, §3.3)
+        # device decode (the recovery reconstruct path, §3.3); the
+        # device math is background EC traffic — pace it through the
+        # QoS scheduler so a repair-heavy scrub yields the device to
+        # queued client stripes
+        await osd.scheduler.pace(
+            "ec_background", cost=float(max(1, stripes))
+        )
         try:
             rebuilt = ec_util.decode(sinfo, codec, good, want=sorted(bad))
         except Exception:

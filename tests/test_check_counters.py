@@ -89,6 +89,53 @@ def test_kind_shared_across_subsystems_not_flagged(tmp_path):
     assert cc.check(tmp_path) == []
 
 
+def test_config_key_typos_detected(tmp_path):
+    """Any config option referenced by literal (get/set/observe or a
+    bare attribute read) but never registered as an Option fails — the
+    osd_op_queue*-typo class the QoS PR added the check for."""
+    cc = _load_tool()
+    (tmp_path / "mod.py").write_text(
+        'OPTIONS = [Option("osd_op_queue", str, "mclock"),\n'
+        '           Option("osd_op_queue_slots", int, 32)]\n'
+        'class D:\n'
+        '    def __init__(self, cfg):\n'
+        '        self.config = cfg\n'
+        '        a = cfg.osd_op_queue\n'                 # ok: attr read
+        '        b = self.config.get("osd_op_queue_slots")\n'  # ok
+        '        cfg.observe("osd_op_queue", print)\n'   # ok
+        '        c = cfg.osd_op_quue\n'                  # typo'd attr
+        '        d = cfg.get("osd_op_queue_cutoff")\n'   # typo'd get
+    )
+    problems = cc.check(tmp_path)
+    assert len(problems) == 2, problems
+    assert any("osd_op_quue" in p for p in problems)
+    assert any("osd_op_queue_cutoff" in p for p in problems)
+
+
+def test_config_check_skips_foreign_config_objects(tmp_path):
+    """jax.config.update / Config API calls / non-config receivers must
+    never false-positive; and with NO Option table in the tree the
+    config check stays off entirely (fixture packages)."""
+    cc = _load_tool()
+    (tmp_path / "clean.py").write_text(
+        'import jax\n'
+        'jax.config.update("jax_platforms", "cpu")\n'
+        'oi = {}\n'
+        'oi.get("not_an_option")\n'
+        'cfg = object()\n'
+        'cfg.show()\n'
+    )
+    assert cc.check(tmp_path) == []
+    # the same attribute reads FAIL once an Option table exists
+    (tmp_path / "table.py").write_text(
+        'opts = [Option("real_option", int, 1)]\n'
+        'x = cfg.real_option\n'
+        'y = cfg.fake_option\n'
+    )
+    problems = cc.check(tmp_path)
+    assert len(problems) == 1 and "fake_option" in problems[0]
+
+
 def test_cli_exit_codes(tmp_path):
     cc = _load_tool()
     (tmp_path / "ok.py").write_text(

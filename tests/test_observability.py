@@ -164,8 +164,10 @@ class TestOpTracker:
                 [op] = ops["ops"]
                 assert op["oid"] == name and op["trace"]
                 assert op["age"] > 0
-                assert [e["event"] for e in op["events"]][:2] == [
-                    "queued", "dequeued"
+                # the QoS scheduler brackets its queue wait between
+                # queued_for_qos and dequeued (PR 5)
+                assert [e["event"] for e in op["events"]][:3] == [
+                    "queued", "queued_for_qos", "dequeued"
                 ]
                 # completed: in history, with ordered stage timestamps
                 hist = await admin_command(path, "dump_historic_ops")

@@ -65,6 +65,83 @@ class TestThrottle:
 
         run(main())
 
+    def test_multi_unit_release_wakes_fifo_no_overtaking(self):
+        """One release big enough for several waiters wakes them in
+        strict arrival order — and a small LATER request never
+        overtakes a large older one even when the small one would fit
+        (the head blocks the line until it fits)."""
+
+        async def main():
+            t = Throttle("t", 10)
+            await t.acquire(10)
+            order = []
+
+            async def taker(tag, n):
+                await t.acquire(n)
+                order.append(tag)
+
+            tasks = [
+                asyncio.ensure_future(taker("big", 6)),
+                asyncio.ensure_future(taker("mid", 3)),
+                asyncio.ensure_future(taker("small", 1)),
+            ]
+            for _ in range(3):
+                await asyncio.sleep(0)
+            assert order == []
+            t.release(4)  # 'small' would fit; 'big' (head) would not
+            await asyncio.sleep(0.01)
+            assert order == []  # no overtaking: the head holds the line
+            t.release(6)  # now 10 free: big(6) + mid(3) + small(1) fit
+            await asyncio.gather(*tasks)
+            assert order == ["big", "mid", "small"]
+            assert t.get_current() == 10
+
+        run(main())
+
+    def test_dump_reports_oldest_waiter_age(self):
+        async def main():
+            t = Throttle("t", 10)
+            await t.acquire(10)
+            assert t.dump()["oldest_waiter_age"] == 0.0
+            task = asyncio.ensure_future(t.acquire(5))
+            await asyncio.sleep(0.05)
+            d = t.dump()
+            assert d["waiters"] == 1
+            assert 0.03 <= d["oldest_waiter_age"] < 30.0
+            t.release(10)
+            await task
+            assert t.dump()["oldest_waiter_age"] == 0.0
+
+        run(main())
+
+    def test_cancelled_head_wakes_the_line(self):
+        """A cancelled HEAD waiter must re-run the wake loop: the
+        waiter behind it may fit NOW, and no further release is coming
+        (the missed-wakeup wedge pinned by PR 5)."""
+
+        async def main():
+            t = Throttle("t", 10)
+            await t.acquire(9)
+            got = []
+
+            async def taker(tag, n):
+                await t.acquire(n)
+                got.append(tag)
+
+            big = asyncio.ensure_future(taker("big", 5))
+            small = asyncio.ensure_future(taker("small", 1))
+            for _ in range(3):
+                await asyncio.sleep(0)
+            assert got == []
+            big.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await big
+            async with asyncio.timeout(2):
+                await small  # woken by the cancellation, not a release
+            assert got == ["small"] and t.get_current() == 10
+
+        run(main())
+
     def test_cancelled_waiter_releases_slot(self):
         async def main():
             t = Throttle("t", 10)

@@ -22,9 +22,15 @@ that prior from the comparison (listed in the report as
 ``excluded_batch_mismatch``).  Rounds predating the field are compared
 as before — the ambiguity dies out as the trajectory grows.
 
+``--metric`` takes a dotted path into the final line, so nested phase
+records gate too: ``--metric qos.protection`` watches the QoS
+starvation-gate protection factor (fifo p99 / mclock p99 — how much
+tail latency the dmClock scheduler buys under a recovery storm, higher
+is better, same direction as every throughput metric here).
+
 Usage:
   python tools/bench_regress.py [--dir D] [--last N] [--threshold R]
-                                [--metric value]
+                                [--metric value|qos.protection|...]
 
 Exit codes: 0 = ok / nothing comparable; 1 = regression; 2 = no usable
 bench records at all.
@@ -67,6 +73,18 @@ def load_rounds(bench_dir: str) -> list[dict]:
     return rounds
 
 
+def metric_value(line: dict, path: str):
+    """Resolve a dotted metric path inside one final line
+    (``"value"`` -> line["value"], ``"qos.protection"`` ->
+    line["qos"]["protection"]); None when any hop is missing."""
+    cur = line
+    for part in path.split("."):
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(part)
+    return cur
+
+
 def compare(rounds: list[dict], metric: str = "value",
             threshold: float = 0.5) -> dict:
     """Newest round vs the best prior SAME-PHASE round.
@@ -78,7 +96,7 @@ def compare(rounds: list[dict], metric: str = "value",
         return {"comparable": False, "reason": "no bench records"}
     newest = rounds[-1]
     phase = newest["phase"]
-    cur = newest["line"].get(metric)
+    cur = metric_value(newest["line"], metric)
     if not isinstance(cur, (int, float)):
         return {
             "comparable": False, "newest": newest["file"],
@@ -87,7 +105,7 @@ def compare(rounds: list[dict], metric: str = "value",
     priors = [
         r for r in rounds[:-1]
         if r["phase"] == phase
-        and isinstance(r["line"].get(metric), (int, float))
+        and isinstance(metric_value(r["line"], metric), (int, float))
     ]
     # per-byte comparability: drop priors measured on a DIFFERENT batch
     # size (the 8 MiB cpu-fallback vs 64 MiB TPU trap); unrecorded
@@ -113,8 +131,8 @@ def compare(rounds: list[dict], metric: str = "value",
                 + (" and a matching batch_bytes" if excluded else "")
             ),
         }
-    best = max(priors, key=lambda r: r["line"][metric])
-    best_v = float(best["line"][metric])
+    best = max(priors, key=lambda r: metric_value(r["line"], metric))
+    best_v = float(metric_value(best["line"], metric))
     ratio = (float(cur) / best_v) if best_v > 0 else 1.0
     return {
         "comparable": True,
@@ -140,7 +158,9 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--last", type=int, default=5,
                     help="how many newest rounds to consider")
     ap.add_argument("--metric", default="value",
-                    help="final-line key to compare (default: value)")
+                    help="final-line key to compare; dotted paths reach "
+                         "nested records, e.g. qos.protection "
+                         "(default: value)")
     ap.add_argument("--threshold", type=float, default=0.5,
                     help="fail when newest < threshold x prior best "
                          "(0.5 = a 2x drop fails)")

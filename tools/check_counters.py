@@ -30,6 +30,21 @@ Three checks over the ``ceph_tpu`` package's ASTs:
    subsystem, so a key name shared across subsystems with different
    kinds must not false-positive).
 
+4. **Unregistered config keys.** Every literal config option the code
+   reads — ``cfg.get("osd_op_queue")``, ``config.set("name", v)``,
+   ``cfg.observe("name", cb)``, and plain attribute reads like
+   ``self.config.osd_op_complaint_time`` — must name an option the
+   table registers via ``Option("name", ...)``; Config raises
+   KeyError/AttributeError only when that exact path runs, which for a
+   typo'd ``osd_op_queue*`` knob on a rarely-hit branch means
+   production, not CI.  Receivers count as config-shaped when their
+   dotted source is ``cfg``/``config`` or ends in ``.config``;
+   Config's own method/API names are excluded so ``jax.config.update``
+   and the accessors themselves never false-positive.  The check only
+   runs when the scanned package registers at least one Option (a
+   fixture tree without a config table has nothing to validate
+   against).
+
 Scope rules (pragmatic, zero false positives on this codebase):
 - registrations: any builder call with a literal first argument,
   anywhere in the package;
@@ -67,6 +82,24 @@ _MUTATOR_KINDS = {
     "time": {"add_avg", "add_time_avg"},
     "hist": {"add_histogram"},
 }
+
+# config receivers: dotted sources that ARE a Config; attribute/method
+# names on them that are Config API (not option reads) — everything
+# else read off a config-shaped receiver must be a registered option
+_CONFIG_API = frozenset({
+    "get", "set", "observe", "unobserve", "show", "diff",
+    "load_file", "load_args", "options", "coerce", "update",
+})
+# config methods whose literal FIRST argument is an option name
+_CONFIG_ACCESSORS = frozenset({"get", "set", "observe", "unobserve"})
+
+
+def _configish(src: str) -> bool:
+    """Is this dotted receiver a daemon Config?  ``cfg``, ``config``,
+    or anything ending in ``.config`` (self.config, osd.config,
+    jax.config — the latter's uses are all API names and excluded)."""
+    return src in ("cfg", "config") or src.endswith(".config")
+
 
 # exposition suffixes per builder kind (mirrors mgr/modules.py
 # PrometheusModule flattening: avgs -> triplet, histograms -> bucket
@@ -116,6 +149,10 @@ class _FileScan(ast.NodeVisitor):
         self.used: list[tuple[str, int, str, str]] = []
         # dotted receiver -> subsystem name (None = perfish but unknown)
         self.aliases: dict[str, str | None] = {}
+        # config side: Option("name", ...) registrations and literal /
+        # attribute option reads (name, line, source-expression)
+        self.config_registered: list[str] = []
+        self.config_used: list[tuple[str, int, str]] = []
 
     def _perfish(self, expr: ast.AST) -> bool:
         """Is this receiver a PerfCounters? Either its dotted form
@@ -181,6 +218,29 @@ class _FileScan(ast.NodeVisitor):
                     and self._perfish(f.value):
                 self.used.append((key, node.lineno, _dotted(f.value),
                                   f.attr))
+            if f.attr in _CONFIG_ACCESSORS and key is not None \
+                    and _configish(_dotted(f.value)):
+                self.config_used.append((
+                    key, node.lineno, f"{_dotted(f.value)}.{f.attr}",
+                ))
+        elif isinstance(f, ast.Name) and f.id == "Option":
+            key = _literal_first_arg(node)
+            if key is not None:
+                self.config_registered.append(key)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        # cfg.osd_subop_timeout-style option reads (Config.__getattr__):
+        # the attr must be a registered option unless it is Config API
+        # (the accessor calls above land here too, as the inner
+        # Attribute of the Call's func — the API set excludes them)
+        if node.attr not in _CONFIG_API \
+                and not node.attr.startswith("_") \
+                and _configish(_dotted(node.value)):
+            self.config_used.append((
+                node.attr, node.lineno,
+                f"{_dotted(node.value)}.{node.attr}",
+            ))
         self.generic_visit(node)
 
 
@@ -189,6 +249,8 @@ def check(package_dir: str | pathlib.Path) -> list[str]:
     package_dir = pathlib.Path(package_dir)
     regs: list[tuple[pathlib.Path, str | None, str, str]] = []
     used: list[tuple[pathlib.Path, str, int, str]] = []
+    conf_regs: set[str] = set()
+    conf_used: list[tuple[pathlib.Path, str, int, str]] = []
     for path in sorted(package_dir.rglob("*.py")):
         try:
             tree = ast.parse(path.read_text(), filename=str(path))
@@ -199,6 +261,10 @@ def check(package_dir: str | pathlib.Path) -> list[str]:
         regs.extend((path, s, k, kind) for s, k, kind in scan.registered)
         used.extend(
             (path, k, ln, recv, mut) for k, ln, recv, mut in scan.used
+        )
+        conf_regs.update(scan.config_registered)
+        conf_used.extend(
+            (path, k, ln, src) for k, ln, src in scan.config_used
         )
     problems = []
     registered_keys = {k for _p, _s, k, _kind in regs}
@@ -234,6 +300,16 @@ def check(package_dir: str | pathlib.Path) -> list[str]:
                 f"prometheus series {name!r} is emitted by more than "
                 f"one registration after sanitization: {pretty}"
             )
+    # config keys referenced but never registered as an Option (the
+    # osd_op_queue*-typo class); only meaningful when the scanned tree
+    # carries a config table at all
+    if conf_regs:
+        for path, key, line, src in conf_used:
+            if key not in conf_regs:
+                problems.append(
+                    f"{path}:{line}: {src} references config option "
+                    f"{key!r} but no Option registers it"
+                )
     return problems
 
 
