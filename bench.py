@@ -840,7 +840,12 @@ def _bench_codec_stack(deadline: float | None) -> float:
     the registry-built RS(8,3) codec, whole-buffer in, shards out."""
     from ceph_tpu.models import registry
     from ceph_tpu.osd import ec_util
+    from ceph_tpu.utils import native as _native
 
+    # pick serial-vs-all-cores for the native stripe engine by
+    # measurement (memory-bound containers LOSE to parallel; real
+    # multi-core hosts multiply) — the verdict is logged by the caller
+    _native.calibrate_stripe_workers()
     codec = registry.instance().factory(
         "isa", {"plugin": "isa", "technique": "reed_sol_van",
                 "k": str(K), "m": str(M)},
@@ -859,6 +864,89 @@ def _bench_codec_stack(deadline: float | None) -> float:
         min_iters=3, min_seconds=0.5, deadline=deadline,
     )
     return buf.size / t / 1e9
+
+
+def _bench_stack_e2e(deadline: float | None) -> dict:
+    """The WHOLE-stack round trip the zero-copy PR targets, measured
+    end to end off the wire format: client write frame encode (segment
+    list, no join) -> frame decode (views) -> striper extent table
+    (vectorized) -> EC encode (one gather + device/native call) ->
+    shard reply frames.  GB/s over the client payload, plus the
+    ``data_path`` copy audit for ONE pass — the copies-per-payload
+    ratio is the PR's whole point, so the round JSON carries it."""
+    from ceph_tpu.models import registry
+    from ceph_tpu.msg import message as msgmod
+    from ceph_tpu.msg import messages as msgs
+    from ceph_tpu.osd import ec_util
+    from ceph_tpu.rados.striper import StripedLayout
+    from ceph_tpu.utils import buffers as _bufs
+
+    codec = registry.instance().factory(
+        "isa", {"plugin": "isa", "technique": "reed_sol_van",
+                "k": str(K), "m": str(M)},
+    )
+    chunk = codec.get_chunk_size(4096 * K)
+    sinfo = ec_util.StripeInfo(stripe_width=chunk * K, chunk_size=chunk)
+    layout = StripedLayout(stripe_unit=sinfo.stripe_width,
+                           stripe_count=1, object_size=1 << 26)
+    rng = np.random.default_rng(11)
+    payload = rng.integers(
+        0, 256, size=(sinfo.stripe_width * 512,), dtype=np.uint8
+    ).tobytes()
+
+    def one_pass() -> int:
+        # client: MOSDOp write frame as a segment list (vectored send)
+        op = msgs.MOSDOp(
+            tid=1, epoch=1, pool="bench", oid="obj",
+            ops=[{"op": "write", "data": 0}], blobs=[payload],
+        )
+        segs, total = msgmod.encode_frame_segments(op, 1)
+        # wire: the transport would scatter/gather these; the receiver
+        # sees one contiguous receive buffer — model that cost honestly
+        # with a single join standing in for the kernel's copy
+        frame = b"".join(segs)
+        # osd: decode hands out VIEWS of the receive buffer
+        decoded, _seq = msgmod.decode_frame(frame)
+        data = decoded.blobs[0]
+        # striper: vectorized extent table, view slices
+        obj, ooff, run, boff = layout.extent_table(0, len(data))
+        view = memoryview(data)
+        shard_msgs = []
+        for i in range(obj.size):
+            chunk_view = view[int(boff[i]): int(boff[i]) + int(run[i])]
+            # EC: one gather-into-layout + the device/native call
+            shards = ec_util.encode(sinfo, codec, chunk_view)
+            # fan-out: shard rows ride sub-write frames as views
+            for s in (0, K):  # one data + one parity shard is enough
+                sub = msgs.MOSDECSubOpWrite(
+                    pgid="1.0", tid=1, from_osd=0, shard=s, epoch=1,
+                    at_version=[1, 1], trim_to=[0, 0], log=[], txn=[],
+                    blobs=[shards[s]],
+                )
+                shard_msgs.append(
+                    msgmod.encode_frame_segments(sub, 2)[1]
+                )
+        return total + sum(shard_msgs)
+
+    one_pass()  # warm/compile
+    _bufs.reset_copies()
+    one_pass()
+    copied = _bufs.copied_bytes()
+    per_hop = {
+        h: _bufs.copied_bytes(h)
+        for h in ("msgr_encode", "msgr_decode", "striper", "ec_gather",
+                  "client_read", "flatten")
+        if _bufs.copied_bytes(h)
+    }
+    t = bench_loop(one_pass, min_iters=3, min_seconds=0.5,
+                   deadline=deadline)
+    return {
+        "stack_e2e_gbps": round(len(payload) / t / 1e9, 3),
+        "payload_bytes": len(payload),
+        "copied_bytes_per_pass": copied,
+        "copied_ratio": round(copied / len(payload), 3),
+        "copied_by_hop": per_hop,
+    }
 
 
 def bench_smallops(deadline: float | None, platform: str | None) -> dict:
@@ -1260,8 +1348,25 @@ def probe_device(platform: str | None, timeout: float) -> str | None:
     for line in reversed((out or "").splitlines()):
         try:
             obj = json.loads(line)
-            plat = obj["platform"]
-        except (json.JSONDecodeError, KeyError, TypeError):
+        except (json.JSONDecodeError, TypeError):
+            continue
+        if not isinstance(obj, dict):
+            continue
+        if obj.get("liveness") is not None:
+            # the child's pre-acquisition verdict rides the round JSON
+            # (probe_attempts -> tpu_diag) whatever happens next
+            attempt["liveness"] = obj["liveness"]
+        plat = obj.get("platform")
+        if plat is None:
+            if obj.get("ok") is False:
+                # conclusive dead-relay verdict: the child declined to
+                # touch the device at all — fall back NOW, no retry hang
+                attempt["result"] = "relay-dead (liveness probe)"
+                log(f"{name}: relay dead "
+                    f"({obj.get('liveness', {}).get('relay')}); "
+                    "falling back without device acquisition")
+                _phase_note(name, "relay-dead", t_spent)
+                return None
             continue
         attempt["result"] = f"ok: {plat}"
         log(f"{name}: ok: {plat}")
@@ -1337,6 +1442,14 @@ def run_combo(phase: str, platform: str | None, batch: int, quick: bool,
         # line's phase breakdown shows WHERE the trajectory emptied out
         _phase_note(phase, f"child-died rc={proc.returncode}",
                     time.time() - t_start)
+    elif set(results) <= {"liveness", "ready"} and "liveness" in results:
+        # the child bailed on its pre-acquisition liveness check: ZERO
+        # benchmarks ran — "ok" here would hide exactly the dead-relay
+        # class the phase breakdown exists to diagnose (ROADMAP 5b)
+        _phase_note(
+            phase, "relay-dead (liveness probe)", time.time() - t_start,
+            relay=results["liveness"].get("relay"),
+        )
     else:
         _phase_note(phase, "ok", time.time() - t_start,
                     kept=sorted(results))
@@ -1351,13 +1464,24 @@ def combo_main(args) -> None:
     -> crush, emitting one tagged JSON line per phase."""
     deadline = args._deadline or (time.time() + 600)
     skip = set(filter(None, (args._skip or "").split(",")))
+    # same hard-deadline liveness check as the probe child: the combo
+    # child re-acquires the device and the relay can die BETWEEN probe
+    # and combo (observed r04: five probes, all hung) — never walk into
+    # make_pjrt_c_api_client against a dead tunnel
+    live = _backend_liveness(args.platform)
+    if live.get("dead"):
+        log(f"combo child: relay dead before acquisition: "
+            f"{live.get('relay')}")
+        print(json.dumps({"kind": "liveness", **live}), flush=True)
+        return
     import jax
 
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
     dev = jax.devices()[0]
     log(f"combo child: device ready: {dev}")
-    print(json.dumps({"kind": "ready", "platform": str(dev)}), flush=True)
+    print(json.dumps({"kind": "ready", "platform": str(dev),
+                      "liveness": live}), flush=True)
 
     def sub_deadline(frac: float) -> float:
         return min(time.time() + frac * (deadline - time.time()), deadline)
@@ -1406,6 +1530,27 @@ def combo_main(args) -> None:
             log(f"combo child: headline retry failed: {e!r}")
 
 
+def _backend_liveness(platform: str | None) -> dict:
+    """Child-side backend liveness verdict, taken with a HARD deadline
+    BEFORE the first jax import (i.e. before make_pjrt_c_api_client can
+    hang on a dead relay tunnel — the BENCH_r05 failure that lost the
+    whole round).  Only the axon relay path is probeable: an explicit
+    ``--platform`` (cpu) or a host without the relay env pins skips.
+
+    ``dead=True`` means the child must NOT attempt device acquisition:
+    the relay either refuses or accepts-then-closes (the observed
+    signature of the r3/r4/r5 infinite hang) — record the verdict and
+    fall back instead of hanging."""
+    if platform:
+        return {"checked": False, "reason": f"explicit platform {platform!r}"}
+    if not (os.environ.get("AXON_POOL_SVC_OVERRIDE")
+            or os.environ.get("AXON_LOOPBACK_RELAY")):
+        return {"checked": False, "reason": "no axon relay env"}
+    sig = _relay_signature()  # 3s socket deadline inside
+    dead = sig.startswith("connect failed") or "tunnel dead" in sig
+    return {"checked": True, "relay": sig, "dead": dead}
+
+
 def _maybe_inject_fault() -> None:
     """Test hook for the BENCH_r05 failure mode: with
     CEPH_TPU_BENCH_FAULT=backend-death every bench CHILD dies the way
@@ -1428,6 +1573,15 @@ def child_main(args) -> None:
     if args._probe:
         import faulthandler
 
+        # liveness FIRST (hard deadline, plain TCP): a conclusively-dead
+        # relay never reaches jax.devices()/make_pjrt_c_api_client at
+        # all — the verdict rides the probe line into the round JSON and
+        # the parent falls back immediately (ROADMAP 5b: no BENCH round
+        # may be lost to a dead relay again)
+        live = _backend_liveness(args.platform)
+        if live.get("dead"):
+            print(json.dumps({"ok": False, "liveness": live}), flush=True)
+            return
         # arm an all-threads stack dump to fire just before the parent's
         # kill deadline: if jax.devices() hangs (r3/r4: forever inside
         # make_c_api_client waiting on the dead tunnel), stderr carries
@@ -1442,7 +1596,8 @@ def child_main(args) -> None:
             jax.config.update("jax_platforms", args.platform)
         dev = jax.devices()[0]
         faulthandler.cancel_dump_traceback_later()
-        print(json.dumps({"ok": True, "platform": str(dev)}), flush=True)
+        print(json.dumps({"ok": True, "platform": str(dev),
+                          "liveness": live}), flush=True)
         return
     if args._combo:
         combo_main(args)
@@ -1458,6 +1613,18 @@ def child_main(args) -> None:
         jax.config.update("jax_platforms", "cpu")
         _kprof().reset()
         res = {"stack_gbps": _bench_codec_stack(deadline)}
+        from ceph_tpu.utils import native as _nat
+
+        res["native_workers"] = {
+            "workers": _nat.stripe_workers(),
+            "cpus": os.cpu_count(),
+        }
+        try:
+            # the whole-stack zero-copy round trip + copy audit (the
+            # data_path.copied_bytes evidence rides the round JSON)
+            res["stack_e2e"] = _bench_stack_e2e(deadline)
+        except Exception as e:
+            log(f"stack child: e2e bench failed: {e!r}")
         try:
             # raw codec rate on the SAME backend for the honest ratio
             from ceph_tpu.ops.gf_jax import bytes_to_u32, make_gf_matmul_u32
@@ -1680,7 +1847,8 @@ def main():
                 )
         if "stack_gbps" not in final and stack_res.get("stack_gbps"):
             final["stack_gbps"] = round(stack_res["stack_gbps"], 3)
-            for key in ("raw_cpu_gbps", "stack_vs_raw"):
+            for key in ("raw_cpu_gbps", "stack_vs_raw", "stack_e2e",
+                        "native_workers"):
                 if key in stack_res:
                     final[key] = stack_res[key]
         if "kernel_profile" not in final:
@@ -1701,6 +1869,15 @@ def main():
         # inside device acquisition this is the breakdown the bench
         # trajectory was previously missing entirely
         final["phases"] = list(_PHASES)
+        # ...as do the children's pre-acquisition liveness verdicts
+        # (ROADMAP 5b): every round records whether the relay answered
+        # BEFORE any child risked make_pjrt_c_api_client
+        verdicts = [
+            {"platform": a.get("platform"), **a["liveness"]}
+            for a in _DIAG["probe_attempts"] if a.get("liveness")
+        ]
+        if verdicts:
+            final["liveness_probes"] = verdicts
         if not acc.get("tpu"):
             # no TPU answered this round: ship the captured evidence in
             # the machine-readable line itself (VERDICT r4 #1: "a logged

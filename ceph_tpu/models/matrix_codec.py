@@ -45,12 +45,33 @@ from .base import ErasureCode
 from .interface import ErasureCodeValidationError
 
 
-def _maybe_jit(fn):
+@functools.lru_cache(maxsize=1)
+def _donation_enabled() -> bool:
+    """Donate input device buffers on accelerator backends so XLA reuses
+    the allocation for the output across launches — the device half of
+    the zero-copy data path (SNIPPETS [2] donate_argnums idiom).  Safe
+    here because every call site passes HOST numpy arrays: the donated
+    buffer is the transient device_put staging buffer, never a caller
+    array (a donated jax.Array must not be re-read — see README
+    "Zero-copy data path").  CPU backends skip it (jax ignores donation
+    there and warns per call); CEPH_TPU_EC_DONATE=0/1 overrides."""
+    env = os.environ.get("CEPH_TPU_EC_DONATE")
+    if env is not None:
+        return env == "1"
+    try:
+        return jax.default_backend() != "cpu"
+    except Exception:
+        return False
+
+
+def _maybe_jit(fn, donate_argnums=()):
     # CEPH_TPU_NO_JIT=1 runs kernels eagerly — used by the (CPU) test suite
     # where hundreds of distinct decode matrices would each trigger a
     # compile; production/bench paths always jit.
     if os.environ.get("CEPH_TPU_NO_JIT") == "1":
         return fn
+    if donate_argnums and _donation_enabled():
+        return jax.jit(fn, donate_argnums=donate_argnums)
     return jax.jit(fn)
 
 
@@ -69,8 +90,9 @@ def _jit_matmul_u32(matrix_key: tuple, w: int):
     the host for free with bytes_to_u32/u32_to_bytes)."""
     matrix = np.array(matrix_key, dtype=np.int64)
     if matrix.shape[0] == 1 and np.all(matrix == 1):
-        return _maybe_jit(make_xor_parity_u32())
-    return _maybe_jit(make_gf_matmul_u32_routed(matrix, w))
+        return _maybe_jit(make_xor_parity_u32(), donate_argnums=(0,))
+    return _maybe_jit(make_gf_matmul_u32_routed(matrix, w),
+                      donate_argnums=(0,))
 
 
 @functools.lru_cache(maxsize=512)
@@ -95,7 +117,9 @@ def _jit_encode_shards_u32(matrix_key: tuple, w: int):
         par = inner(flat)
         return jnp.concatenate([flat, par], axis=0)
 
-    return _maybe_jit(fn)
+    # donated: the staged input buffer is dead after the transpose read,
+    # so XLA folds it into the (larger) output allocation across launches
+    return _maybe_jit(fn, donate_argnums=(0,))
 
 
 @functools.lru_cache(maxsize=512)
@@ -107,7 +131,8 @@ def _jit_bitmatmul(bm_key: bytes, rows: int, cols: int):
 @functools.lru_cache(maxsize=512)
 def _jit_bitmatmul_u32(bm_key: bytes, rows: int, cols: int):
     bm = np.frombuffer(bm_key, dtype=np.uint8).reshape(rows, cols)
-    return _maybe_jit(make_bitmatrix_matmul_u32_routed(bm))
+    return _maybe_jit(make_bitmatrix_matmul_u32_routed(bm),
+                      donate_argnums=(0,))
 
 
 def _mkey(matrix: np.ndarray) -> tuple:

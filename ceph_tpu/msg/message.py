@@ -10,6 +10,20 @@ Header = ``{"type", "seq", "fields", "blob_lens"}``; ``fields`` is the
 JSON-able message body, ``blobs`` carry bulk bytes (chunk data) untouched
 by JSON.  crc32c (same polynomial as the reference, via the native lib)
 covers header+blobs.
+
+Zero-copy contract (the bufferlist discipline, reference:src/include/
+buffer.h): blobs are **borrowed views**, never copied —
+
+- outbound, :func:`encode_frame_segments` returns the frame as a
+  segment list (header bytes + the caller's blob views + crc trailer)
+  for a vectored send; the crc chains across segments, so nothing is
+  joined.  The caller must not mutate a blob between ``send()`` and the
+  socket drain (our senders pass immutable receive views or
+  freshly-encoded shard buffers; a mutation would surface as a crc drop
+  on the peer, i.e. a reconnect, never silent corruption).
+- inbound, :func:`decode_frame` hands out ``memoryview`` slices of the
+  one receive buffer (the views keep it alive); ``bytes()`` happens
+  only where a caller truly needs an independent copy.
 """
 
 from __future__ import annotations
@@ -21,6 +35,7 @@ from typing import Any, Type
 import numpy as np
 
 from ..utils import native
+from ..utils.buffers import BufferList, note_copy
 
 MAGIC = b"CTPU"
 CRC_SEED = 0xFFFFFFFF
@@ -40,9 +55,19 @@ def register(cls: Type["Message"]) -> Type["Message"]:
     return cls
 
 
+def _blob_len(b) -> int:
+    if isinstance(b, np.ndarray):
+        return int(b.nbytes)  # raw byte count, whatever the dtype
+    if isinstance(b, memoryview):
+        return b.nbytes  # len() counts first-dim items, not bytes
+    return len(b)
+
+
 class Message:
     """Base message: subclasses set TYPE and FIELDS (json-able attribute
-    names); bulk bytes go in ``blobs`` (list of bytes).
+    names); bulk bytes go in ``blobs`` (bytes-like VIEWS — bytes,
+    bytearray, memoryview, uint8 ndarray, or BufferList — held
+    borrowed, not copied; see the module zero-copy contract).
 
     ``trace`` is the envelope-level trace id (the reference header's
     blkin trace context): not a subclass field — it rides the frame
@@ -55,7 +80,9 @@ class Message:
     FIELDS: tuple[str, ...] = ()
 
     def __init__(self, **kw: Any):
-        self.blobs: list[bytes] = [bytes(b) for b in kw.pop("blobs", [])]
+        # borrowed views, NOT bytes(b) copies — the pre-zero-copy frame
+        # path paid one full payload memcpy here per hop
+        self.blobs: list = list(kw.pop("blobs", []))
         self.trace: str | None = kw.pop("trace", None)
         for f in self.FIELDS:
             setattr(self, f, kw.pop(f, None))
@@ -66,62 +93,107 @@ class Message:
         return {f: getattr(self, f) for f in self.FIELDS}
 
     @classmethod
-    def from_fields(cls, fields: dict[str, Any], blobs: list[bytes]) -> "Message":
+    def from_fields(cls, fields: dict[str, Any], blobs: list) -> "Message":
         return cls(blobs=blobs, **fields)
 
     def __repr__(self) -> str:
         fs = ", ".join(f"{f}={getattr(self, f)!r}" for f in self.FIELDS)
-        return f"{type(self).__name__}({fs}, blobs={[len(b) for b in self.blobs]})"
+        return (f"{type(self).__name__}({fs}, "
+                f"blobs={[_blob_len(b) for b in self.blobs]})")
 
 
 class BadFrame(ValueError):
     """Corrupt or malformed frame (bad magic / crc / header)."""
 
 
-def encode_frame(msg: Message, seq: int = 0) -> bytes:
+def _segments_of(b) -> list:
+    """Wire segments for one blob (BufferList expands; scalars pass).
+    Every segment comes back as bytes or a FLAT 1-byte view — a
+    multi-dimensional memoryview would make ``len()`` count first-dim
+    items instead of bytes and corrupt the frame length prefix."""
+    if isinstance(b, BufferList):
+        segs = b.segments()
+    elif isinstance(b, np.ndarray):
+        # REINTERPRET to raw bytes (cast), never value-cast: a u32
+        # array blob must carry its 4N little-endian bytes, exactly
+        # what the old bytes(b) copy serialized — astype(uint8) here
+        # would silently truncate every lane to its low byte
+        segs = [memoryview(np.ascontiguousarray(b)).cast("B")]
+    else:
+        segs = [b]
+    return [
+        s.cast("B") if isinstance(s, memoryview)
+        and (s.ndim != 1 or s.itemsize != 1) else s
+        for s in segs
+    ]
+
+
+def encode_frame_segments(msg: Message, seq: int = 0) -> tuple[list, int]:
+    """Frame as a segment list for a vectored send: ``(segments,
+    total_bytes)``.  Segment 0 is magic+len+header, the middle segments
+    are the caller's blob views (ZERO copies), the trailer is the crc —
+    chained across segments (ceph_crc32c composes), so the frame is
+    never joined on the send side."""
     head = {
         "type": msg.TYPE,
         "seq": seq,
         "fields": msg.fields(),
-        "blob_lens": [len(b) for b in msg.blobs],
+        "blob_lens": [_blob_len(b) for b in msg.blobs],
     }
     if msg.trace is not None:
         head["trace"] = msg.trace
     header = json.dumps(head, separators=(",", ":")).encode()
-    buf = bytearray()
-    buf += MAGIC
-    buf += struct.pack(">I", len(header))
-    buf += header
+    segs: list = [MAGIC + struct.pack(">I", len(header)) + header]
+    crc = native.crc32c(CRC_SEED, header)
+    total = len(segs[0])
     for b in msg.blobs:
-        buf += b
-    crc = native.crc32c(
-        CRC_SEED, np.frombuffer(memoryview(buf)[8:], dtype=np.uint8)
-    )
-    buf += struct.pack(">I", crc)
-    return bytes(buf)
+        for s in _segments_of(b):
+            n = len(s)
+            if not n:
+                continue
+            segs.append(s)
+            total += n
+            crc = native.crc32c(crc, np.frombuffer(s, dtype=np.uint8)
+                                if not isinstance(s, np.ndarray) else s)
+    segs.append(struct.pack(">I", crc))
+    total += 4
+    return segs, total
 
 
-def decode_frame(frame: bytes) -> tuple[Message, int]:
-    """Inverse of :func:`encode_frame`: returns (message, seq)."""
-    if len(frame) < 12 or frame[:4] != MAGIC:
+def encode_frame(msg: Message, seq: int = 0) -> bytes:
+    """Flat-bytes frame (compat/tests; the messenger sends the segment
+    list from :func:`encode_frame_segments` without joining)."""
+    segs, total = encode_frame_segments(msg, seq)
+    note_copy("msgr_encode", total)
+    return b"".join(segs)  # copy-ok: compat flat-frame wrapper
+
+
+def decode_frame(frame: bytes | memoryview) -> tuple[Message, int]:
+    """Inverse of :func:`encode_frame`: returns (message, seq).
+
+    Blobs come back as ``memoryview`` slices of ``frame`` — zero copies;
+    the views hold the receive buffer alive.  Receive frames are never
+    mutated, so aliasing is safe by construction here."""
+    view = frame if isinstance(frame, memoryview) else memoryview(frame)
+    if view.nbytes < 12 or view[:4] != MAGIC:
         raise BadFrame("bad magic")
-    (hlen,) = struct.unpack(">I", frame[4:8])
-    body = frame[8:-4]
-    (crc,) = struct.unpack(">I", frame[-4:])
+    (hlen,) = struct.unpack(">I", view[4:8])
+    body = view[8:-4]
+    (crc,) = struct.unpack(">I", view[-4:])
     want = native.crc32c(CRC_SEED, np.frombuffer(body, dtype=np.uint8))
     if crc != want:
         raise BadFrame(f"crc mismatch: got {crc:#x} want {want:#x}")
-    if hlen > len(body):
+    if hlen > body.nbytes:
         raise BadFrame("truncated header")
-    header = json.loads(body[:hlen])
+    header = json.loads(bytes(body[:hlen]))  # copy-ok: header json only
     cls = _REGISTRY.get(header["type"])
     if cls is None:
         raise BadFrame(f"unknown message type {header['type']!r}")
     blobs, off = [], hlen
     for n in header["blob_lens"]:
-        blobs.append(bytes(body[off : off + n]))
+        blobs.append(body[off : off + n])
         off += n
-    if off != len(body):
+    if off != body.nbytes:
         raise BadFrame("blob length mismatch")
     msg = cls.from_fields(header["fields"], blobs)
     msg.trace = header.get("trace")

@@ -24,7 +24,9 @@ def encode_txn(txn: Transaction) -> tuple[list, list[bytes]]:
     blobs: list[bytes] = []
 
     def blob(b: bytes) -> int:
-        blobs.append(bytes(b))
+        # borrowed view, not bytes(b): shard write data is the fan-out
+        # hot path, and the frame encoder sends views without joining
+        blobs.append(b)
         return len(blobs) - 1
 
     for op in txn.ops:
@@ -84,13 +86,20 @@ def decode_txn(ops_in: list, blobs: list[bytes]) -> Transaction:
         elif name in ("zero", "truncate"):
             getattr(txn, name)(CollectionId(op[1]), oid(op[2]), *op[3:])
         elif name == "setattr":
-            txn.setattr(CollectionId(op[1]), oid(op[2]), op[3], blobs[op[4]])
+            # xattr/omap values are SMALL metadata the store retains
+            # indefinitely: materialize them here, or a 30-byte hinfo
+            # view would pin its whole multi-MB receive frame for as
+            # long as the object lives (write data stays a view — the
+            # store copies it into its own extents on apply)
+            txn.setattr(CollectionId(op[1]), oid(op[2]), op[3],
+                        bytes(blobs[op[4]]))  # copy-ok: tiny metadata, must not pin the frame
         elif name == "rmattr":
             txn.rmattr(CollectionId(op[1]), oid(op[2]), op[3])
         elif name == "omap_setkeys":
             txn.omap_setkeys(
                 CollectionId(op[1]), oid(op[2]),
-                {k: blobs[i] for k, i in op[3].items()},
+                # copy-ok: tiny metadata, must not pin the frame
+                {k: bytes(blobs[i]) for k, i in op[3].items()},
             )
         elif name == "omap_rmkeys":
             txn.omap_rmkeys(CollectionId(op[1]), oid(op[2]), op[3])

@@ -22,7 +22,7 @@ import time
 from typing import Optional
 
 from ..common.tracing import current_trace, new_trace_id
-from .message import BadFrame, Message, decode_frame, encode_frame
+from .message import BadFrame, Message, decode_frame, encode_frame_segments
 
 _LEN = struct.Struct(">I")
 logger = logging.getLogger("ceph_tpu.msg")
@@ -55,7 +55,10 @@ class Connection:
         self.authenticated = True  # False only on a mon awaiting MAuth
         self.auth_entity = ""      # ticket-verified identity (cephx)
         self._send_seq = 0
-        self._sendq: asyncio.Queue[Optional[bytes]] = asyncio.Queue()
+        # (total_len, [segments]) — frames queue as VIEW LISTS (header
+        # bytes + caller blob views + crc trailer) and are written
+        # vectored, never joined: the zero-copy send side
+        self._sendq: asyncio.Queue[Optional[tuple]] = asyncio.Queue()
         self._tasks: list[asyncio.Task] = []
         self._closed = False
 
@@ -73,19 +76,30 @@ class Connection:
             msg.trace = (current_trace.get()
                          or new_trace_id(self.messenger.name))
         self._send_seq += 1
-        frame = encode_frame(msg, self._send_seq)
+        # segment list, not a joined frame: payload blobs ride to the
+        # transport as borrowed views (msg/message.py zero-copy
+        # contract — the caller must not mutate them until drained; a
+        # violation fails the frame crc on the peer, never silently)
+        segs, total = encode_frame_segments(msg, self._send_seq)
+        if total <= 1024:
+            # control-frame fast path: heartbeats/acks/metadata are the
+            # overwhelming message COUNT, and for them the vectored
+            # bookkeeping costs more than one bounded sub-KiB join —
+            # payload frames (the byte volume) stay on the view path
+            segs = [b"".join(segs)]  # copy-ok: bounded <=1KiB control frame
         perf = self.messenger.perf
         perf.inc("msg_send")
-        perf.inc("bytes_send", len(frame))
-        perf.hist("send_bytes_histogram", len(frame))
-        self._sendq.put_nowait(frame)
+        perf.inc("bytes_send", total)
+        perf.hist("send_bytes_histogram", total)
+        self._sendq.put_nowait((total, segs))
 
     async def _writer_loop(self) -> None:
         try:
             while True:
-                buf = await self._sendq.get()
-                if buf is None:
+                item = await self._sendq.get()
+                if item is None:
                     break
+                total, segs = item
                 if self.messenger._inject_failure():
                     # fault injection (ms_inject_socket_failures analog,
                     # reference:src/common/config_opts.h:209): sever the
@@ -96,15 +110,23 @@ class Connection:
                         "%s: INJECTING socket failure to %s (mid-frame)",
                         self.messenger.name, self.peer_name,
                     )
-                    self._writer.write(_LEN.pack(len(buf)))
-                    self._writer.write(buf[: max(1, len(buf) // 2)])
+                    flat = b"".join(segs)  # copy-ok: fault-injection cold path
+                    self._writer.write(_LEN.pack(total))
+                    self._writer.write(flat[: max(1, total // 2)])
                     try:
                         await self._writer.drain()
                     finally:
                         self._writer.transport.abort()
                     break
-                self._writer.write(_LEN.pack(len(buf)))
-                self._writer.write(buf)
+                # vectored write: length prefix + every frame segment
+                # handed to the transport as-is — the payload views are
+                # coalesced (if at all) only at the socket boundary,
+                # never joined in the messenger
+                self._writer.write(_LEN.pack(total))
+                if len(segs) == 1:
+                    self._writer.write(segs[0])
+                else:
+                    self._writer.writelines(segs)
                 await self._writer.drain()
         except (ConnectionError, asyncio.CancelledError, OSError):
             pass

@@ -225,6 +225,13 @@ class OSD(Dispatcher):
         # l_osd_* registrations in src/osd/OSD.cc)
         self.perf = PerfCountersCollection()
         self.perf.attach(self.messenger.perf)  # msgr wire counters
+        # the zero-copy audit family (utils/buffers.py): every payload
+        # memcpy the data path still performs, per hop — process-global
+        # (copies happen in shared client/striper/codec code), attached
+        # so it rides perf dump -> mgr prometheus like any subsystem
+        from ..utils.buffers import data_path_perf
+
+        self.perf.attach(data_path_perf())
         posd = self.perf.create("osd")
         posd.add_counter("op", "client ops")
         posd.add_counter("op_r", "client reads")
@@ -2731,7 +2738,10 @@ class OSD(Dispatcher):
                 logical = await self._ec_decode_concat(
                     sinfo, codec, chunks, klass=klass
                 )
-                return 0, logical[off - s0 : end - s0]
+                if off == s0 and end - s0 == len(logical):
+                    return 0, logical  # aligned read: no trim slice
+                # trim as a VIEW of the reassembly buffer, not a copy
+                return 0, memoryview(logical)[off - s0 : end - s0]
             # else: a shard failed mid-read — loop retries with survivors
         return -EIO, b""
 

@@ -60,7 +60,7 @@ from typing import Any, Mapping
 
 import numpy as np
 
-from ..utils.buffers import as_u8
+from ..utils.buffers import as_u8, note_copy
 from . import ec_util
 
 
@@ -422,12 +422,21 @@ class ECDispatcher:
         total = sum(op.stripes for op in ops)
         pad = self._pad_for(codec, total)
         if b.kind == "enc":
-            parts = [op.payload for op in ops]
-            if pad:
-                parts.append(
-                    np.zeros(pad * sinfo.stripe_width, dtype=np.uint8)
+            if len(ops) == 1 and not pad:
+                cat = ops[0].payload  # single op, snug bucket: no gather
+            else:
+                # EXACTLY ONE gather into one preallocated host buffer
+                # (np.zeros: pad rows arrive already zero) — the batch's
+                # single accounted copy before the device upload
+                cat = np.zeros(
+                    (total + pad) * sinfo.stripe_width, dtype=np.uint8
                 )
-            cat = parts[0] if len(parts) == 1 else np.concatenate(parts)
+                off = 0
+                for op in ops:
+                    n = op.stripes * sinfo.stripe_width
+                    cat[off : off + n] = op.payload
+                    off += n
+                note_copy("ec_gather", off)
             t0 = time.perf_counter()
             out = ec_util.encode(sinfo, codec, cat)
             seconds = time.perf_counter() - t0
@@ -441,14 +450,22 @@ class ECDispatcher:
                 off = end
             return results, pad, seconds
         # decode: stack per-shard buffers; the recovery matrix is
-        # columnwise, so row ranges slice back exactly per op
+        # columnwise, so row ranges slice back exactly per op.  Same
+        # one-gather-per-shard assembly as the encode side.
         present = sorted(ops[0].payload)
         cat: dict[int, np.ndarray] = {}
         for s in present:
-            parts = [op.payload[s] for op in ops]
-            if pad:
-                parts.append(np.zeros(pad * cs, dtype=np.uint8))
-            cat[s] = parts[0] if len(parts) == 1 else np.concatenate(parts)
+            if len(ops) == 1 and not pad:
+                cat[s] = ops[0].payload[s]
+                continue
+            buf = np.zeros((total + pad) * cs, dtype=np.uint8)
+            off = 0
+            for op in ops:
+                n = op.stripes * cs
+                buf[off : off + n] = op.payload[s]
+                off += n
+            note_copy("ec_gather", off)
+            cat[s] = buf
         k = codec.get_data_chunk_count()
         t0 = time.perf_counter()
         decoded = ec_util.decode(sinfo, codec, cat, want=list(range(k)))

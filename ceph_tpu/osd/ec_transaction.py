@@ -163,7 +163,7 @@ def merge_extents(
     old_data: dict[int, bytes],
     offset: int,
     data: bytes,
-) -> bytes:
+) -> bytearray:
     """Build the will_write buffer: old partial stripes + new bytes.
 
     ``old_data`` maps each to_read extent's logical offset to its decoded
@@ -185,7 +185,9 @@ def merge_extents(
         rel = plan.new_size - ws
         if rel >= 0:
             buf[rel:] = b"\x00" * (len(buf) - rel)
-    return bytes(buf)
+    # the gather buffer itself: this merge IS the RMW path's one copy —
+    # the old bytes(buf) materialized the whole will_write a second time
+    return buf
 
 
 # ---------------------------------------------------------------------------

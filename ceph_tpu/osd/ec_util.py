@@ -24,7 +24,7 @@ import numpy as np
 
 from ..models.interface import ErasureCodeInterface
 from ..utils import native
-from ..utils.buffers import as_u8
+from ..utils.buffers import as_u8, note_copy
 
 CRC_SEED = 0xFFFFFFFF  # the reference seeds per-shard crcs with -1
 
@@ -73,12 +73,17 @@ class StripeInfo:
         end = self.logical_to_next_stripe_offset(offset + length)
         return start, end - start
 
-    def pad_to_stripe(self, data: bytes) -> bytes:
-        """Zero-pad to a whole number of stripes (reference pads logically)."""
+    def pad_to_stripe(self, data) -> bytes:
+        """Zero-pad to a whole number of stripes (reference pads logically).
+        Accepts any bytes-like (views included); unpadded input passes
+        through untouched, a padded result is one accounted gather."""
         _, want = self.offset_len_to_stripe_bounds(0, len(data))
         if want == len(data):
             return data
-        return data + b"\x00" * (want - len(data))
+        note_copy("ec_gather", len(data))
+        out = bytearray(want)
+        out[: len(data)] = data
+        return out
 
 
 # -- batched stripe math -----------------------------------------------------
@@ -181,6 +186,10 @@ def encode(
         # no jit cache on the C engine -> every call is steady-state.
         # The matrix key is built once at codec construction (_mkey) —
         # re-serializing matrix.tobytes() per op was hot-path waste.
+        # The C pass performs the SAME stripe->shard layout memcpy the
+        # jax paths do on host — it must hit the copy audit identically
+        # or the <=1x budget gate would depend on engine routing.
+        note_copy("ec_gather", buf.size)
         with profiler().timed(
             "native_stripes_encode",
             (ec_impl._mkey, S, cs),
@@ -204,7 +213,10 @@ def encode(
     if enc32 is not None and cs % 4 == 0 and buf.ctypes.data % 4 == 0:
         # u32-lane pipeline (r3 Weak #4): the transpose moves 4-byte
         # units (≈2x the u8 transpose) and the codec skips every
-        # uint8<->u32 relayout; shard rows come back as free u8 views
+        # uint8<->u32 relayout; shard rows come back as free u8 views.
+        # The transpose is the ONE host gather on this path (the
+        # stripe->shard layout transform) — accounted as ec_gather.
+        note_copy("ec_gather", buf.size)
         arr32 = np.ascontiguousarray(
             buf.view(np.uint32).reshape(S, k, cs // 4).transpose(1, 0, 2)
         ).reshape(k, S * (cs // 4))
@@ -213,6 +225,7 @@ def encode(
         for j in range(m):
             out[k + j] = np.ascontiguousarray(parity32[j]).view(np.uint8)
         return out
+    note_copy("ec_gather", buf.size)
     arr = np.ascontiguousarray(
         buf.reshape(S, k, cs).transpose(1, 0, 2)
     ).reshape(k, S * cs)
@@ -251,23 +264,33 @@ def decode(
     return ec_impl.decode(list(want), {i: np.asarray(chunks[i]) for i in present})
 
 
-def shards_to_logical(rows: Sequence[np.ndarray], chunk_size: int) -> bytes:
+def shards_to_logical(rows: Sequence[np.ndarray], chunk_size: int) -> bytearray:
     """[k, S*cs] data-shard rows -> the logical stripe-interleaved
     bytes: the ONE inverse of :func:`encode`'s layout transform, shared
     by decode_concat and the microbatch dispatcher's per-op reassembly
-    so the two decode paths cannot drift."""
-    stack = np.stack([np.asarray(r) for r in rows])
-    k = stack.shape[0]
-    S = stack.shape[1] // chunk_size
-    arr = stack.reshape(k, S, chunk_size).transpose(1, 0, 2)
-    return np.ascontiguousarray(arr).tobytes()
+    so the two decode paths cannot drift.
+
+    Gathers the interleave directly into one ``bytearray`` (the old
+    ``ascontiguousarray(...).tobytes()`` materialized the transpose and
+    then copied it AGAIN); returns the gather buffer itself —
+    bytes-compatible, sendable as a frame blob without conversion."""
+    k = len(rows)
+    row0 = np.asarray(rows[0])
+    S = row0.size // chunk_size
+    total = k * S * chunk_size
+    note_copy("ec_gather", total)
+    out = bytearray(total)
+    dst = np.frombuffer(out, dtype=np.uint8).reshape(S, k, chunk_size)
+    for i, r in enumerate(rows):
+        dst[:, i, :] = np.asarray(r).reshape(S, chunk_size)
+    return out
 
 
 def decode_concat(
     sinfo: StripeInfo,
     ec_impl: ErasureCodeInterface,
     chunks: Mapping[int, np.ndarray],
-) -> bytes:
+) -> bytearray:
     """Rebuild the original logical bytes (stripe-interleaved data shards).
 
     Inverse of :func:`encode`'s layout transform

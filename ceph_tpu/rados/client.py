@@ -21,6 +21,7 @@ from typing import Any
 from ..msg import AsyncMessenger, Connection, Dispatcher, messages
 from ..msg.message import Message
 from ..osd.osdmap import OSDMap
+from ..utils.buffers import note_copy
 
 logger = logging.getLogger("ceph_tpu.rados")
 
@@ -766,23 +767,27 @@ class IoCtx:
         return reply.out[0]["snapset"]
 
     # -- object I/O ----------------------------------------------------------
+    # Write payloads travel as borrowed views (zero-copy contract,
+    # msg/message.py): the caller's buffer is sliced into the frame
+    # segments directly and must stay unmutated until the op completes
+    # (resends reuse the same views).
     async def write_full(self, oid: str, data: bytes) -> None:
         reply = await self._op_w(
-            oid, [{"op": "writefull", "data": 0}], [bytes(data)]
+            oid, [{"op": "writefull", "data": 0}], [data]
         )
         if reply.result < 0:
             raise RadosError(reply.result, f"write_full {oid}")
 
     async def write(self, oid: str, data: bytes, offset: int = 0) -> None:
         reply = await self._op_w(
-            oid, [{"op": "write", "offset": offset, "data": 0}], [bytes(data)]
+            oid, [{"op": "write", "offset": offset, "data": 0}], [data]
         )
         if reply.result < 0:
             raise RadosError(reply.result, f"write {oid}")
 
     async def append(self, oid: str, data: bytes) -> None:
         reply = await self._op_w(
-            oid, [{"op": "append", "data": 0}], [bytes(data)]
+            oid, [{"op": "append", "data": 0}], [data]
         )
         if reply.result < 0:
             raise RadosError(reply.result, f"append {oid}")
@@ -799,13 +804,23 @@ class IoCtx:
         if reply.result < 0:
             raise RadosError(reply.result, f"zero {oid}")
 
-    async def read(self, oid: str, offset: int = 0, length: int = 0) -> bytes:
+    async def read(self, oid: str, offset: int = 0, length: int = 0,
+                   *, copy: bool = True) -> bytes:
+        """Read an extent.  ``copy=False`` returns the reply frame's
+        ``memoryview`` directly (zero-copy — the view pins the frame
+        buffer; the striper's gather path uses this); the default
+        materializes independent bytes for API compatibility, and that
+        copy is accounted (``data_path.copied_bytes_client_read``)."""
         reply = await self._op_r(
             oid, [{"op": "read", "offset": offset, "length": length}], []
         )
         if reply.result < 0:
             raise RadosError(reply.result, f"read {oid}")
-        return reply.blobs[reply.out[0]["data"]]
+        blob = reply.blobs[reply.out[0]["data"]]
+        if not copy:
+            return blob
+        note_copy("client_read", len(blob))
+        return bytes(blob)  # copy-ok: independent-bytes API default
 
     async def remove(self, oid: str) -> None:
         reply = await self._op_w(oid, [{"op": "delete"}], [])

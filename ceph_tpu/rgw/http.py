@@ -214,6 +214,12 @@ class S3Server:
 
         self.perf_coll = PerfCountersCollection()
         self.perf_coll.attach(store.client.messenger.perf)
+        from ..utils.buffers import data_path_perf
+
+        # the zero-copy audit family (utils/buffers.py): the gateway is
+        # the top of the data path, so its perf dump carries the
+        # process-wide copied-bytes evidence too
+        self.perf_coll.attach(data_path_perf())
         self.perf = self.perf_coll.create("rgw")
         for verb in (*self._VERBS, "other"):
             self.perf.add_counter(f"req_{verb}", f"{verb.upper()} requests")
@@ -335,9 +341,12 @@ class S3Server:
                 out_headers.setdefault("connection", "keep-alive")
                 for k, v in out_headers.items():
                     head.append(f"{k}: {v}")
-                writer.write(
-                    ("\r\n".join(head) + "\r\n\r\n").encode() + payload
-                )
+                # vectored response: header bytes and the payload view
+                # go to the transport separately — GET payloads are
+                # striper gather buffers handed down uncopied
+                writer.write(("\r\n".join(head) + "\r\n\r\n").encode())
+                if len(payload):
+                    writer.write(payload)
                 await writer.drain()
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass

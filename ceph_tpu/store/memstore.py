@@ -192,13 +192,19 @@ class MemStore(ObjectStore):
                 coll.pop(dst, None)
         elif name == "setattr":
             (_, cid, oid, key, value) = op
-            self._obj(cid, oid, create=True).xattrs[key] = value
+            # materialize at the retention boundary: the value may be a
+            # borrowed view of a receive frame (zero-copy messenger),
+            # and a tiny xattr must not pin a multi-MB frame for the
+            # object's lifetime
+            self._obj(cid, oid, create=True).xattrs[key] = bytes(value)
         elif name == "rmattr":
             (_, cid, oid, key) = op
             self._obj(cid, oid, create=False).xattrs.pop(key, None)
         elif name == "omap_setkeys":
             (_, cid, oid, kv) = op
-            self._obj(cid, oid, create=True).omap.update(kv)
+            self._obj(cid, oid, create=True).omap.update(
+                {k: bytes(v) for k, v in kv.items()}
+            )
         elif name == "omap_rmkeys":
             (_, cid, oid, keys) = op
             omap = self._obj(cid, oid, create=False).omap

@@ -173,17 +173,18 @@ void gf8_encode_flat(const int* matrix, int k, int m, const uint8_t* data,
   gf8_encode(matrix, k, m, dptr, pptr, n);
 }
 
-// Fused stripe-layout encode: one pass over the client buffer produces
-// the per-shard buffers (the OSD's deliverable) AND the parity — no
-// separate transpose pass re-reading the data (the ceph_tpu codec
-// stack's hot entry; ECUtil::encode's per-stripe loop collapsed).
-// in: [S, k, cs] stripes; shards: flat [(k+m), S*cs] output whose rows
-// are the shard buffers. cs % 8 == 0.
-void gf8_encode_stripes(const int* matrix, int k, int m, int64_t S,
-                        int64_t cs, const uint8_t* in, uint8_t* shards) {
+// Fused stripe-layout encode over the stripe range [s0, s0+nS) of a
+// LARGER [S, k, cs] batch whose shard rows are shard_len bytes apart:
+// the strided body that lets callers split one batch across worker
+// threads (each thread owns a disjoint stripe range, so the writes
+// never overlap and the bytes are identical to one serial pass).
+// in: the range's first stripe (caller pre-offsets); shards: the FULL
+// output base. cs % 8 == 0.
+void gf8_encode_stripes_block(const int* matrix, int k, int m, int64_t s0,
+                              int64_t nS, int64_t cs, int64_t shard_len,
+                              const uint8_t* in, uint8_t* shards) {
   const uint8_t* dptr[32];
   uint8_t* pptr[32];
-  const int64_t shard_len = S * cs;
 #ifdef CEPH_TPU_GFNI
   if (m <= 8) {
     // affine table built ONCE for the whole batch (r5 review: building
@@ -192,8 +193,8 @@ void gf8_encode_stripes(const int* matrix, int k, int m, int64_t S,
     for (int i = 0; i < m; ++i)
       for (int j = 0; j < k; ++j)
         aff[i * k + j] = gf8_affine_matrix((uint8_t)matrix[i * k + j]);
-    for (int64_t s = 0; s < S; ++s) {
-      const uint8_t* base = in + s * k * cs;
+    for (int64_t s = s0; s < s0 + nS; ++s) {
+      const uint8_t* base = in + (s - s0) * k * cs;
       for (int j = 0; j < k; ++j) {
         dptr[j] = base + j * cs;
         std::memcpy(shards + j * shard_len + s * cs, dptr[j], cs);
@@ -205,8 +206,8 @@ void gf8_encode_stripes(const int* matrix, int k, int m, int64_t S,
     return;
   }
 #endif
-  for (int64_t s = 0; s < S; ++s) {
-    const uint8_t* base = in + s * k * cs;
+  for (int64_t s = s0; s < s0 + nS; ++s) {
+    const uint8_t* base = in + (s - s0) * k * cs;
     for (int j = 0; j < k; ++j) {
       dptr[j] = base + j * cs;
       std::memcpy(shards + j * shard_len + s * cs, dptr[j], cs);
@@ -215,6 +216,17 @@ void gf8_encode_stripes(const int* matrix, int k, int m, int64_t S,
       pptr[i] = shards + (k + i) * shard_len + s * cs;
     gf8_encode(matrix, k, m, dptr, pptr, cs);
   }
+}
+
+// Fused stripe-layout encode: one pass over the client buffer produces
+// the per-shard buffers (the OSD's deliverable) AND the parity — no
+// separate transpose pass re-reading the data (the ceph_tpu codec
+// stack's hot entry; ECUtil::encode's per-stripe loop collapsed).
+// in: [S, k, cs] stripes; shards: flat [(k+m), S*cs] output whose rows
+// are the shard buffers. cs % 8 == 0.
+void gf8_encode_stripes(const int* matrix, int k, int m, int64_t S,
+                        int64_t cs, const uint8_t* in, uint8_t* shards) {
+  gf8_encode_stripes_block(matrix, k, m, 0, S, cs, S * cs, in, shards);
 }
 
 void gf8_mul_region(uint8_t c, const uint8_t* src, uint8_t* dst, int64_t n) {
@@ -405,6 +417,27 @@ int cauchy_original_matrix(int k, int m, int w, int32_t* out) {
 extern "C" {
 
 uint32_t crc32c_sw(uint32_t crc, const uint8_t* data, int64_t n) {
+#if defined(__SSE4_2__)
+  // hardware CRC32C (SSE4.2 crc32 instruction): the exact Castagnoli
+  // reflected polynomial with the same raw-state semantics as the
+  // table path below (no pre/post inversion), so the two compose and
+  // cross-check bit-identically (pinned by tests/test_native.py).
+  // This is the per-frame checksum on every messenger hop — at table
+  // speed (~1.5 GB/s) it dominated the zero-copy stack round trip;
+  // the instruction runs at tens of GB/s (ceph's crc32c-intel path,
+  // reference:src/common/crc32c_intel_fast.c).
+  uint64_t c64 = crc;
+  while (n >= 8) {
+    uint64_t word;
+    std::memcpy(&word, data, 8);
+    c64 = __builtin_ia32_crc32di(c64, word);
+    data += 8;
+    n -= 8;
+  }
+  crc = (uint32_t)c64;
+  while (n-- > 0) crc = __builtin_ia32_crc32qi(crc, *data++);
+  return crc;
+#else
   const uint32_t (*T)[256] = kCrcTab.t;
 #if defined(__BYTE_ORDER__) && __BYTE_ORDER__ == __ORDER_LITTLE_ENDIAN__
   while (n >= 8) {
@@ -419,6 +452,15 @@ uint32_t crc32c_sw(uint32_t crc, const uint8_t* data, int64_t n) {
     n -= 8;
   }
 #endif  // big-endian hosts take the bytewise loop for all input
+  while (n-- > 0) crc = (crc >> 8) ^ T[0][(crc ^ *data++) & 0xff];
+  return crc;
+#endif
+}
+
+// table-path reference, exported so the test suite can cross-check the
+// hardware instruction against the software tables on any input
+uint32_t crc32c_table(uint32_t crc, const uint8_t* data, int64_t n) {
+  const uint32_t (*T)[256] = kCrcTab.t;
   while (n-- > 0) crc = (crc >> 8) ^ T[0][(crc ^ *data++) & 0xff];
   return crc;
 }

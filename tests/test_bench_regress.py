@@ -125,6 +125,51 @@ class TestBenchRegress:
             ["--dir", str(tmp_path), "--threshold", "0.9"]
         ) == 1
 
+    # -- stack_gbps promotion (PR 6): phase-agnostic gating ------------------
+
+    def _write_stack_round(self, tmp_path, n, phase, value, stack):
+        line = {"metric": "m", "value": value, "unit": "GB/s",
+                "phase": phase, "stack_gbps": stack,
+                "batch_bytes": 1 << 26 if phase == "tpu" else 1 << 23}
+        (tmp_path / f"BENCH_r{n:02d}.json").write_text(
+            json.dumps({"n": n, "rc": 0, "parsed": line})
+        )
+
+    def test_stack_gbps_gates_across_phase_flips(self, tmp_path):
+        """The codec-stack number is measured on the cpu backend every
+        round, so a tpu->native-only flip must NOT hide a stack
+        regression (and batch_bytes, which qualifies only the headline
+        device batches, must not exclude priors)."""
+        br = _load_tool()
+        self._write_stack_round(tmp_path, 1, "tpu", 662.0, 5.8)
+        self._write_stack_round(tmp_path, 2, "native-only", 6.7, 2.0)
+        report_rc = br.main(
+            ["--dir", str(tmp_path), "--metric", "stack_gbps"]
+        )
+        assert report_rc == 1  # 5.8 -> 2.0 is a real stack regression
+        rep = br.compare(
+            br.load_rounds(str(tmp_path)), metric="stack_gbps"
+        )
+        assert rep["comparable"] and rep["regression"]
+        assert "excluded_batch_mismatch" not in rep
+
+    def test_stack_gbps_improvement_passes(self, tmp_path):
+        br = _load_tool()
+        self._write_stack_round(tmp_path, 1, "native-only", 6.7, 1.24)
+        self._write_stack_round(tmp_path, 2, "tpu", 662.0, 6.4)
+        assert br.main(
+            ["--dir", str(tmp_path), "--metric", "stack_gbps"]
+        ) == 0
+
+    def test_headline_metric_still_phase_gated(self, tmp_path):
+        """Promotion must not loosen the default metric: the headline
+        still refuses cross-phase comparison."""
+        br = _load_tool()
+        self._write_stack_round(tmp_path, 1, "tpu", 662.0, 5.8)
+        self._write_stack_round(tmp_path, 2, "native-only", 6.7, 5.8)
+        rep = br.compare(br.load_rounds(str(tmp_path)), metric="value")
+        assert not rep["comparable"]
+
 
 class TestChildBackendDeath:
     def test_parent_survives_backend_registration_abort(self):

@@ -28,6 +28,16 @@ starvation-gate protection factor (fifo p99 / mclock p99 — how much
 tail latency the dmClock scheduler buys under a recovery storm, higher
 is better, same direction as every throughput metric here).
 
+``--metric stack_gbps`` is first-class: the codec-stack measurement is
+taken on the cpu backend EVERY round (bench.py runs it serially,
+whatever the TPU does), so unlike the headline it is comparable across
+phase flips — a "native-only" fallback round still measured the same
+stack.  Metrics in ``PHASE_AGNOSTIC_METRICS`` therefore skip the
+same-phase filter (and the batch_bytes filter, which only qualifies
+the headline's device batches).  This is the zero-copy data path's
+monotonic gate: once the stack gap closes, a PR that re-introduces
+per-hop copies fails here.
+
 Usage:
   python tools/bench_regress.py [--dir D] [--last N] [--threshold R]
                                 [--metric value|qos.protection|...]
@@ -44,6 +54,10 @@ import json
 import os
 import re
 import sys
+
+# metrics measured on the SAME backend every round (bench.py's serial
+# cpu stack child), hence comparable across headline phase flips
+PHASE_AGNOSTIC_METRICS = {"stack_gbps", "raw_cpu_gbps", "stack_vs_raw"}
 
 
 def load_rounds(bench_dir: str) -> list[dict]:
@@ -96,6 +110,7 @@ def compare(rounds: list[dict], metric: str = "value",
         return {"comparable": False, "reason": "no bench records"}
     newest = rounds[-1]
     phase = newest["phase"]
+    phase_agnostic = metric in PHASE_AGNOSTIC_METRICS
     cur = metric_value(newest["line"], metric)
     if not isinstance(cur, (int, float)):
         return {
@@ -104,15 +119,17 @@ def compare(rounds: list[dict], metric: str = "value",
         }
     priors = [
         r for r in rounds[:-1]
-        if r["phase"] == phase
+        if (phase_agnostic or r["phase"] == phase)
         and isinstance(metric_value(r["line"], metric), (int, float))
     ]
     # per-byte comparability: drop priors measured on a DIFFERENT batch
     # size (the 8 MiB cpu-fallback vs 64 MiB TPU trap); unrecorded
-    # batch_bytes (older rounds) stays comparable
+    # batch_bytes (older rounds) stays comparable.  Phase-agnostic
+    # metrics skip this too — batch_bytes qualifies the headline's
+    # device batches, not the cpu stack child's fixed-size loop.
     cur_bb = newest["line"].get("batch_bytes")
     excluded = []
-    if cur_bb is not None:
+    if cur_bb is not None and not phase_agnostic:
         excluded = [
             r["file"] for r in priors
             if r["line"].get("batch_bytes") not in (None, cur_bb)
@@ -127,7 +144,8 @@ def compare(rounds: list[dict], metric: str = "value",
             "phase": phase,
             **({"excluded_batch_mismatch": excluded} if excluded else {}),
             "reason": (
-                f"no earlier round with phase {phase!r}"
+                (f"no earlier round with {metric!r}" if phase_agnostic
+                 else f"no earlier round with phase {phase!r}")
                 + (" and a matching batch_bytes" if excluded else "")
             ),
         }

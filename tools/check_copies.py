@@ -1,0 +1,135 @@
+#!/usr/bin/env python3
+"""Static zero-copy gate over the hot-path modules (CI).
+
+The zero-copy data path (ceph_tpu/utils/buffers.py, README "Zero-copy
+data path") died a death of a thousand ``bytes()`` calls once already:
+every hop that "just" materialized a slice cost one full payload memcpy
+and the whole stack ran ~600x below the kernels (BENCH_r04
+``stack_gbps``).  This gate keeps the copies from creeping back — the
+same role tools/check_counters.py plays for counter keys.
+
+Checked, in the hot-path modules only:
+
+- ``bytes(...)`` calls — the universal "accidentally copy a view" spell;
+- ``.tobytes()`` calls — same, for memoryview/ndarray receivers;
+- ``b"".join(...)`` (any bytes-literal ``.join``) — frame/buffer
+  assembly by concatenation.
+
+A site that is *legitimately* cold (compat wrappers, fault injection,
+admin/dump paths, header-only json) carries a ``# copy-ok: <reason>``
+annotation on the same line or the line above; annotated sites pass and
+double as documentation.  An annotation with no reason text fails — the
+allowlist must say WHY each copy is allowed.
+
+Hot-path scope (the client->striper->messenger->OSD->device pipeline):
+    ceph_tpu/msg/            ceph_tpu/rados/striper.py
+    ceph_tpu/osd/ec_util.py  ceph_tpu/osd/ec_dispatch.py
+
+Usage: ``python tools/check_copies.py [repo_root]`` — exits 0 when
+clean, 1 with a per-site report otherwise.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+
+HOT_PATHS = (
+    "ceph_tpu/msg",
+    "ceph_tpu/rados/striper.py",
+    "ceph_tpu/osd/ec_util.py",
+    "ceph_tpu/osd/ec_dispatch.py",
+)
+
+ANNOTATION = "# copy-ok:"
+
+
+def _hot_files(root: pathlib.Path) -> list[pathlib.Path]:
+    out: list[pathlib.Path] = []
+    for rel in HOT_PATHS:
+        p = root / rel
+        if p.is_dir():
+            out.extend(sorted(p.glob("*.py")))
+        elif p.exists():
+            out.append(p)
+    return out
+
+
+def _annotated(lines: list[str], lineno: int, end_lineno: int) -> str | None:
+    """The copy-ok reason covering the 1-based [lineno, end_lineno]
+    span (any line of the expression, or the line above it), or None.
+    Empty reasons do not count."""
+    for ln in range(lineno - 1, end_lineno + 1):
+        if 1 <= ln <= len(lines):
+            text = lines[ln - 1]
+            i = text.find(ANNOTATION)
+            if i >= 0:
+                reason = text[i + len(ANNOTATION):].strip()
+                return reason or None
+    return None
+
+
+class _CopyFinder(ast.NodeVisitor):
+    def __init__(self):
+        self.sites: list[tuple[int, int, str]] = []
+
+    def _note(self, node: ast.Call, what: str) -> None:
+        self.sites.append(
+            (node.lineno, node.end_lineno or node.lineno, what)
+        )
+
+    def visit_Call(self, node: ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id == "bytes" and node.args:
+            # bytes() with no args builds b"" — not a copy
+            self._note(node, "bytes(...) copy")
+        elif isinstance(fn, ast.Attribute):
+            if fn.attr == "tobytes":
+                self._note(node, ".tobytes() copy")
+            elif fn.attr == "join" and isinstance(fn.value, ast.Constant) \
+                    and isinstance(fn.value.value, bytes):
+                self._note(node, 'b"".join(...) concatenation')
+        self.generic_visit(node)
+
+
+def check(root: pathlib.Path) -> list[str]:
+    problems: list[str] = []
+    for path in _hot_files(root):
+        try:
+            src = path.read_text()
+            tree = ast.parse(src)
+        except (OSError, SyntaxError) as e:
+            problems.append(f"{path}: unparseable: {e}")
+            continue
+        lines = src.splitlines()
+        finder = _CopyFinder()
+        finder.visit(tree)
+        rel = path.relative_to(root)
+        for lineno, end_lineno, what in finder.sites:
+            if _annotated(lines, lineno, end_lineno) is None:
+                problems.append(
+                    f"{rel}:{lineno}: {what} in a hot-path module — "
+                    f"either make it a view (utils/buffers.py) or "
+                    f"annotate the line '# copy-ok: <why this path is "
+                    f"cold>'"
+                )
+    return problems
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    root = pathlib.Path(args[0]) if args else \
+        pathlib.Path(__file__).resolve().parent.parent
+    problems = check(root)
+    if problems:
+        print(f"check_copies: {len(problems)} un-annotated copy site(s):")
+        for p in problems:
+            print(f"  {p}")
+        return 1
+    print(f"check_copies: clean ({len(_hot_files(root))} hot-path files)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
