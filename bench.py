@@ -378,17 +378,38 @@ def bench_device(batch: int, quick: bool, deadline: float | None,
     # both directions; the headline takes the per-direction winner.
     engines: dict[str, dict] = {}
     t_by_dir: dict[str, dict[str, float]] = {"enc": {}, "dec": {}}
+    failovers: list[dict] = []
     for name, enc32, dec32 in live:
         if engines and deadline is not None and deadline - time.time() < 30:
             log(f"child: skipping {name} race (deadline close)")
             break
-        t_e = _measure_rate(
-            f"encode[{name}]", enc32, data, data_bytes, quick, deadline
-        )
-        t_d = _measure_rate(
-            f"reconstruct[{name}]", dec32, data, data_bytes, quick,
-            deadline,
-        )
+        try:
+            # post-acquisition fault domain (the PR-6 liveness contract
+            # extended past acquisition): a device dying MID-PHASE drops
+            # this engine with a recorded engine_failover verdict and
+            # the race continues on the fallback engine — a BENCH round
+            # must never be lost to the accelerator
+            _maybe_inject_device_death(name)
+            t_e = _measure_rate(
+                f"encode[{name}]", enc32, data, data_bytes, quick,
+                deadline,
+            )
+            t_d = _measure_rate(
+                f"reconstruct[{name}]", dec32, data, data_bytes, quick,
+                deadline,
+            )
+        except Exception as e:
+            from ceph_tpu.models.matrix_codec import classify_engine_error
+
+            if classify_engine_error(e) != "fatal":
+                raise  # a data/shape bug is a bench bug: surface it
+            failovers.append({
+                "engine": name, "error": repr(e)[:200],
+                "t": round(time.time() - T0, 1),
+            })
+            log(f"child: engine {name} DIED mid-phase ({e!r:.160}); "
+                "failing over to the next engine")
+            continue
         t_by_dir["enc"][name] = t_e
         t_by_dir["dec"][name] = t_d
         # steady-state per-iteration rate -> jit-cache-hit records (the
@@ -403,6 +424,16 @@ def bench_device(batch: int, quick: bool, deadline: float | None,
             "encode_gbps": round(data_bytes / t_e / 1e9, 3),
             "reconstruct_gbps": round(data_bytes / t_d / 1e9, 3),
         }
+    if not t_by_dir["enc"]:
+        # every device engine died mid-phase: the parent must still
+        # finish the round on the fallback phases, carrying the
+        # verdicts in the round JSON (never a lost round)
+        err = RuntimeError(
+            f"all device engines lost mid-phase "
+            f"({[f['engine'] for f in failovers]})"
+        )
+        err.engine_failovers = failovers
+        raise err
     enc_win = min(t_by_dir["enc"], key=t_by_dir["enc"].get)
     dec_win = min(t_by_dir["dec"], key=t_by_dir["dec"].get)
     t_encode = t_by_dir["enc"][enc_win]
@@ -413,6 +444,7 @@ def bench_device(batch: int, quick: bool, deadline: float | None,
         "platform": str(dev),
         "engine": engine,
         "engines": engines,
+        **({"engine_failover": failovers} if failovers else {}),
         # the measured batch, recorded so the regression gate never
         # compares a shrunken cpu-fallback batch (8 MiB) against a full
         # 64 MiB TPU round as if they were the same workload
@@ -1450,6 +1482,17 @@ def run_combo(phase: str, platform: str | None, batch: int, quick: bool,
             phase, "relay-dead (liveness probe)", time.time() - t_start,
             relay=results["liveness"].get("relay"),
         )
+    elif "engine_failover" in results and not any(
+        k not in ("liveness", "ready", "engine_failover")
+        for k in results
+    ):
+        # acquisition succeeded and then EVERY engine died mid-phase:
+        # the verdict (not "ok") is what the phase record must say
+        _phase_note(
+            phase, "device-died-mid-phase", time.time() - t_start,
+            engines=[f.get("engine")
+                     for f in results["engine_failover"]["failovers"]],
+        )
     else:
         _phase_note(phase, "ok", time.time() - t_start,
                     kept=sorted(results))
@@ -1493,6 +1536,15 @@ def combo_main(args) -> None:
             print(json.dumps({"kind": "headline", **res}), flush=True)
         except Exception as e:
             log(f"combo child: headline failed: {e!r}")
+            verdicts = getattr(e, "engine_failovers", None)
+            if verdicts:
+                # every device engine died MID-phase: the verdict must
+                # ride the round JSON even though no headline exists —
+                # the parent attaches it to the final line and falls
+                # back (the post-acquisition analog of the liveness
+                # probe verdicts)
+                print(json.dumps({"kind": "engine_failover",
+                                  "failovers": verdicts}), flush=True)
     if "smallops" not in skip and deadline - time.time() > 25:
         # the many-small-ops phase (coalesced vs per-op dispatch GB/s)
         # runs right after the headline: it is the dispatcher's gate
@@ -1549,6 +1601,30 @@ def _backend_liveness(platform: str | None) -> dict:
     sig = _relay_signature()  # 3s socket deadline inside
     dead = sig.startswith("connect failed") or "tunnel dead" in sig
     return {"checked": True, "relay": sig, "dead": dead}
+
+
+_DEVICE_DEATH_ARMED = (
+    os.environ.get("CEPH_TPU_BENCH_FAULT") == "device-death"
+)
+
+
+def _maybe_inject_device_death(engine: str) -> None:
+    """Test hook for POST-acquisition device loss (the fault class the
+    PR-6 liveness probe cannot see: acquisition succeeded, then the
+    device died mid-phase).  With CEPH_TPU_BENCH_FAULT=device-death the
+    FIRST engine measurement in each child raises a fabricated
+    device-lost error; the headline race must drop that engine, record
+    an engine_failover verdict in the round JSON, and continue on the
+    fallback engine — the round is never lost."""
+    global _DEVICE_DEATH_ARMED
+    if _DEVICE_DEATH_ARMED:
+        _DEVICE_DEATH_ARMED = False  # one-shot: the fallback must run
+        from ceph_tpu.models.matrix_codec import EngineFault
+
+        raise EngineFault(
+            f"INTERNAL: Device lost (injected CEPH_TPU_BENCH_FAULT "
+            f"mid-{engine})"
+        )
 
 
 def _maybe_inject_fault() -> None:
@@ -1679,6 +1755,10 @@ def result_line(dev: dict, cpu: dict, phase: str) -> dict:
         ),
         **({"engine": dev["engine"]} if "engine" in dev else {}),
         **({"engines": dev["engines"]} if "engines" in dev else {}),
+        **(
+            {"engine_failover": dev["engine_failover"]}
+            if "engine_failover" in dev else {}
+        ),
         **(
             {"kernel_profile": dev["kernel_profile"]}
             if "kernel_profile" in dev else {}
@@ -1863,6 +1943,20 @@ def main():
             else:
                 if stack_res.get("kernel_profile"):
                     final["kernel_profile"] = stack_res["kernel_profile"]
+        # post-acquisition device-loss verdicts (engine_failover): from
+        # a surviving headline's record, or the standalone verdict a
+        # child emitted when EVERY engine died mid-phase — either way
+        # the round JSON says WHY the phase fell back
+        if "engine_failover" not in final:
+            for backend in ("tpu", "jax-cpu", f"jax-{args.platform}"):
+                r = acc.get(backend, {})
+                verdicts = (
+                    r.get("headline", {}).get("engine_failover")
+                    or r.get("engine_failover", {}).get("failovers")
+                )
+                if verdicts:
+                    final["engine_failover"] = verdicts
+                    break
         if qos_res:
             final["qos"] = qos_res
         # the per-phase attempt record ALWAYS ships — on a child dying

@@ -198,6 +198,38 @@ OPTIONS: dict[str, Option] = _opts(
            "pad each batched launch's stripe count to the next power "
            "of two so the jit cache holds O(log max_S) entries per "
            "codec instead of one per distinct object size"),
+    # erasure code: accelerator fault domain (engine health state
+    # machine + failover, ceph_tpu.osd.ec_failover — the reference's
+    # heartbeat_map/suicide-grace discipline applied to the device)
+    Option("osd_ec_engine_failover", bool, True,
+           "supervise the EC device engine: fatal launch failures "
+           "(device-lost / XLA runtime / OOM / compile) replay the "
+           "in-flight batch on the host fallback engine and trip a "
+           "circuit breaker; data-shape errors still surface to the "
+           "caller (off = launch failures fail every waiter, the "
+           "pre-failover behavior)"),
+    Option("osd_ec_launch_deadline", float, 30.0,
+           "budget for one batched EC device launch (s): past it the "
+           "waiters replay on the fallback engine and the breaker "
+           "trips; the wedged worker thread stays on the HeartbeatMap "
+           "clock (grace -> health warn, suicide_grace -> daemon "
+           "policy), so a hung PJRT call can never silently freeze "
+           "the OSD (0 disables the deadline, not the watchdog)"),
+    Option("osd_ec_probe_interval", float, 1.0,
+           "base backoff between canary probes of a TRIPPED EC engine "
+           "(s); doubles per failed probe up to 32x.  A probe is one "
+           "one-stripe encode on the device engine checked against "
+           "the host oracle; success re-promotes the engine"),
+    Option("ec_inject_engine_failure", int, 0,
+           "fault injection: every Nth batched EC device launch "
+           "raises a fabricated device-lost XlaRuntimeError (1 = "
+           "every launch, 0 = off; the accelerator analog of "
+           "ms_inject_socket_failures — live via observer)"),
+    Option("ec_inject_launch_hang", float, 0.0,
+           "fault injection: every batched EC device launch stalls "
+           "this many seconds in the worker thread before running — "
+           "the make_pjrt_c_api_client wedge, for exercising "
+           "osd_ec_launch_deadline (0 = off; live via observer)"),
     Option("erasure_code_dir", str, "ceph_tpu.models",
            "plugin module prefix (dlopen dir analog)"),
     Option("osd_class_dir", str, "",

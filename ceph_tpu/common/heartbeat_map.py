@@ -49,6 +49,21 @@ class HeartbeatHandle:
         self.timeout = 0.0
         self.suicide_timeout = 0.0
 
+    def pin(self, start: float | None) -> None:
+        """Pin the deadlines to a work item that STARTED at ``start``
+        (monotonic); None marks idle.  reset_timeout/clear_timeout fit
+        workers that touch once per iteration; pin() fits supervisors
+        tracking the OLDEST of several in-flight items (the OSD op
+        engine, the EC launch watchdog) where fresh traffic must never
+        mask a wedged item."""
+        if start is None or self.grace <= 0:
+            self.clear_timeout()
+            return
+        self.timeout = start + self.grace
+        self.suicide_timeout = (
+            start + self.suicide_grace if self.suicide_grace > 0 else 0.0
+        )
+
 
 class HeartbeatMap:
     def __init__(self, name: str = "", on_suicide: Callable[[str], None] | None = None):
@@ -100,6 +115,10 @@ class HeartbeatMap:
                     "suicide_grace": h.suicide_grace,
                     "idle": h.timeout == 0.0,
                     "overdue": bool(h.timeout) and now > h.timeout,
+                    "suicide_overdue": (
+                        bool(h.suicide_timeout)
+                        and now > h.suicide_timeout
+                    ),
                 }
                 for h in self._handles
             ]

@@ -109,6 +109,7 @@ def _health_checks(m, mgr, *, up: int, inn: int, exists: int) -> list[dict]:
     outstanding = 0
     slow_ops = 0
     slow_oldest = 0.0
+    accel_tripped = 0
     for st in mgr.live_osd_stats().values():
         perf = st.get("perf") or {}
         scrub = perf.get("scrub") or {}
@@ -121,6 +122,13 @@ def _health_checks(m, mgr, *, up: int, inn: int, exists: int) -> list[dict]:
             slow_oldest,
             float(osd_perf.get("slow_ops_oldest_sec", 0) or 0),
         )
+        # ec.engine_state >= 2 is TRIPPED/PROBING (osd/ec_failover): the
+        # OSD serves EC from the host fallback engine — correct bytes,
+        # a fraction of device throughput; the operator must see it
+        # cluster-wide, not find it in one daemon's log
+        ec_perf = perf.get("ec") or {}
+        if int(ec_perf.get("engine_state", 0) or 0) >= 2:
+            accel_tripped += 1
     if outstanding:
         checks.append({
             "code": "OSD_SCRUB_ERRORS", "severity": "HEALTH_ERR",
@@ -135,6 +143,14 @@ def _health_checks(m, mgr, *, up: int, inn: int, exists: int) -> list[dict]:
             "summary": (
                 f"{slow_ops} slow ops, oldest one blocked for "
                 f"{slow_oldest:.0f} sec"
+            ),
+        })
+    if accel_tripped:
+        checks.append({
+            "code": "ACCEL_DEGRADED", "severity": "HEALTH_WARN",
+            "summary": (
+                f"{accel_tripped} osd(s) serving EC on the fallback "
+                "engine (accelerator circuit breaker tripped)"
             ),
         })
     return checks

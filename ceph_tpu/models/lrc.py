@@ -36,6 +36,17 @@ __erasure_code_version__ = PLUGIN_VERSION
 DEFAULT_INNER = {"plugin": "jerasure", "technique": "reed_sol_van"}
 
 
+def _inner_engine(inner, op: str, host: bool):
+    """Pick an inner codec's device or host engine for ``op``
+    (osd/ec_failover): on the host route, an inner without a
+    ``<op>_host`` oracle falls back to its device method — every
+    in-repo plugin ships one, so this only triggers for third-party
+    inners."""
+    if host:
+        return getattr(inner, f"{op}_host", getattr(inner, op))
+    return getattr(inner, op)
+
+
 class Layer:
     def __init__(self, chunks_map: str, profile: Mapping[str, str]):
         self.chunks_map = chunks_map
@@ -226,12 +237,25 @@ class LrcErasureCode(ErasureCode):
         return {i: full[i] for i in want_to_encode}
 
     def encode_chunks(self, data_chunks: np.ndarray) -> np.ndarray:
+        return self._encode_chunks_impl(data_chunks, host=False)
+
+    def encode_chunks_host(self, data_chunks: np.ndarray) -> np.ndarray:
+        """Host-engine parity (osd/ec_failover): the same layered pass
+        routed through each inner codec's host oracle, so an LRC
+        failover replay never re-enters the device it is failing away
+        from."""
+        return self._encode_chunks_impl(data_chunks, host=True)
+
+    def _encode_chunks_impl(
+        self, data_chunks: np.ndarray, *, host: bool
+    ) -> np.ndarray:
         n = self.get_chunk_count()
         C = data_chunks.shape[1]
         full = np.zeros((n, C), dtype=np.uint8)
         full[self.chunk_mapping] = np.asarray(data_chunks, dtype=np.uint8)
         for layer in self.layers:
-            full[layer.coding] = layer.erasure_code.encode_chunks(full[layer.data])
+            enc = _inner_engine(layer.erasure_code, "encode_chunks", host)
+            full[layer.coding] = np.asarray(enc(full[layer.data]))
         data_positions = set(self.chunk_mapping)
         coding_positions = [i for i in range(n) if i not in data_positions]
         return full[coding_positions]
@@ -278,7 +302,8 @@ class LrcErasureCode(ErasureCode):
         return sorted(minimum)
 
     def decode(
-        self, want_to_read: Sequence[int], chunks: Mapping[int, np.ndarray]
+        self, want_to_read: Sequence[int], chunks: Mapping[int, np.ndarray],
+        *, _host: bool = False,
     ) -> dict[int, np.ndarray]:
         want = list(want_to_read)
         have: dict[int, np.ndarray] = {
@@ -308,7 +333,7 @@ class LrcErasureCode(ErasureCode):
                     continue
                 try:
                     stacked = np.stack([have[layer.chunks[p]] for p in present_local])
-                    rebuilt = inner.decode_chunks(
+                    rebuilt = _inner_engine(inner, "decode_chunks", _host)(
                         present_local, stacked, missing_local
                     )
                 except (IOError, ValueError):
@@ -327,6 +352,18 @@ class LrcErasureCode(ErasureCode):
         got = self.decode(
             list(missing),
             {r: chunks[i] for i, r in enumerate(present)},
+        )
+        return np.stack([got[r] for r in missing])
+
+    def decode_chunks_host(
+        self, present: Sequence[int], chunks: np.ndarray, missing: Sequence[int]
+    ) -> np.ndarray:
+        """Host-engine reconstruct (osd/ec_failover): the same layered
+        fixed-point, each layer solved on its inner host oracle."""
+        got = self.decode(
+            list(missing),
+            {r: chunks[i] for i, r in enumerate(present)},
+            _host=True,
         )
         return np.stack([got[r] for r in missing])
 

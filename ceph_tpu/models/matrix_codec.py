@@ -139,6 +139,58 @@ def _mkey(matrix: np.ndarray) -> tuple:
     return tuple(tuple(int(v) for v in row) for row in np.asarray(matrix))
 
 
+# -- engine failure classification (osd/ec_failover) --------------------------
+#
+# The failover layer must split "the DEVICE is broken" (replay the batch
+# on the fallback engine, trip the breaker) from "the CALLER's data is
+# broken" (surface the error — replaying garbage on another engine would
+# only produce the same garbage slower).  The jax/XLA exception surface
+# is string-typed C++ statuses, so classification keys on exception
+# lineage, not isinstance against jaxlib internals (which move between
+# releases and must not be imported on hosts without a device).
+
+# caller/data errors: shape mismatches, bad survivor sets ("cannot
+# decode" IOErrors), bad profiles — deterministic on any engine
+_DATA_ERRORS = (
+    ValueError, TypeError, KeyError, IndexError, ZeroDivisionError,
+    OSError, ErasureCodeValidationError, AssertionError,
+)
+
+# exception TYPE NAMES (anywhere in the mro) that mark a device-side
+# fault whatever else the exception inherits from: the PJRT/XLA runtime
+# raises XlaRuntimeError (a RuntimeError subclass) for device-lost /
+# RESOURCE_EXHAUSTED / INTERNAL, and jax wraps compile failures in its
+# own Jax*Error family
+_FATAL_TYPE_NAMES = frozenset((
+    "XlaRuntimeError", "JaxRuntimeError", "InternalError",
+    "MosaicError", "EngineFault",
+))
+
+
+def classify_engine_error(exc: BaseException) -> str:
+    """``"fatal"`` (device-lost / XLA runtime / OOM / compile — trips
+    the breaker, batch replays on the fallback engine) or ``"data"``
+    (caller error — surfaces to the waiter).  The single classifier
+    shared by the EC dispatcher, the engine supervisor, and bench.py's
+    mid-phase failover handling, so the three sites cannot drift."""
+    for t in type(exc).__mro__:
+        if t.__name__ in _FATAL_TYPE_NAMES:
+            return "fatal"
+    if isinstance(exc, _DATA_ERRORS):
+        return "data"
+    # RuntimeError / MemoryError / SystemError and anything exotic: the
+    # device side of the jax stack raises these for OOM, dead clients
+    # and lowering failures — default unknown errors to fatal, because
+    # the fallback replay is SAFE (bit-identical engines) while failing
+    # a client op on a transient device fault is not
+    return "fatal"
+
+
+class EngineFault(RuntimeError):
+    """Fabricated device-lost error for the ec_inject_engine_failure
+    hook (classified fatal by name, like the real XlaRuntimeError)."""
+
+
 
 
 class MatrixErasureCode(ErasureCode):
@@ -195,6 +247,52 @@ class MatrixErasureCode(ErasureCode):
             "ec_shards", (self._mkey, d3.shape), fn, (d3,),
             nbytes=d3.size * 4, shape=d3.shape, wrap=np.asarray,
         )
+
+    # -- host fallback engine (osd/ec_failover) -----------------------------
+
+    def _host_matmul(self, matrix: np.ndarray, arr: np.ndarray) -> np.ndarray:
+        """Pure-host GF matmul — the failover replay engine.  Never
+        enters jax: native C when loadable and aligned (bit-identical
+        to the tables, pinned by tests), else the numpy oracle every
+        device engine is pinned against, so a replayed batch is byte
+        identical to what the device would have produced."""
+        from ..utils import native as _native
+
+        if self.w == 8 and arr.shape[-1] % 8 == 0:
+            try:
+                return _native.encode(matrix, arr)
+            except Exception:  # library unbuildable: numpy oracle below
+                pass
+        G = gf(self.w)
+        if self.w == 16:
+            # bytes are pairs of native-endian GF(2^16) elements on the
+            # device lanes; reinterpret (free), multiply, reinterpret back
+            out16 = G.matmul_region(matrix, arr.view(np.uint16))
+            return np.ascontiguousarray(out16).view(np.uint8)
+        return G.matmul_region(matrix, arr).astype(np.uint8)
+
+    def encode_chunks_host(self, data_chunks: np.ndarray) -> np.ndarray:
+        """Host-engine parity ([k, N] uint8 -> [m, N] uint8): same
+        bytes as :meth:`encode_chunks`, no device launch."""
+        arr = np.ascontiguousarray(np.asarray(data_chunks, dtype=np.uint8))
+        return self._host_matmul(self.matrix, arr)
+
+    def decode_chunks_host(
+        self, present: Sequence[int], chunks: np.ndarray,
+        missing: Sequence[int],
+    ) -> np.ndarray:
+        """Host-engine reconstruct: same recovery matrix (and cache) as
+        :meth:`decode_chunks`, applied without a device launch."""
+        present = tuple(present)
+        missing = tuple(missing)
+        if len(present) < self.k:
+            raise IOError(
+                f"cannot decode: {len(present)} chunks available, "
+                f"need {self.k}"
+            )
+        RM, _ = self._recovery_matrix(present, missing)
+        arr = np.ascontiguousarray(np.asarray(chunks, dtype=np.uint8))
+        return self._host_matmul(RM, arr)
 
     # -- decode -------------------------------------------------------------
 
@@ -348,6 +446,44 @@ class BitmatrixErasureCode(ErasureCode):
                                   nbytes=pk.size, shape=pk.shape):
                 out = np.asarray(fn(pk))
         return self._from_packets(out, self.m)
+
+    # -- host fallback engine (osd/ec_failover) -----------------------------
+
+    @staticmethod
+    def _host_bitmatmul(bm: np.ndarray, pk: np.ndarray) -> np.ndarray:
+        """Packet XOR selected by the bit-matrix — the numpy oracle the
+        jax bitmatrix kernels are pinned against (no device launch)."""
+        out = np.zeros((bm.shape[0],) + pk.shape[1:], dtype=np.uint8)
+        for r in range(bm.shape[0]):
+            rows = np.nonzero(bm[r])[0]
+            if rows.size:
+                out[r] = np.bitwise_xor.reduce(pk[rows], axis=0)
+        return out
+
+    def encode_chunks_host(self, data_chunks: np.ndarray) -> np.ndarray:
+        """Host-engine parity: same bytes as :meth:`encode_chunks`,
+        never enters jax (the failover replay engine)."""
+        pk = self._to_packets(np.asarray(data_chunks, dtype=np.uint8))
+        return self._from_packets(self._host_bitmatmul(self.bitmatrix, pk),
+                                  self.m)
+
+    def decode_chunks_host(
+        self, present: Sequence[int], chunks: np.ndarray,
+        missing: Sequence[int],
+    ) -> np.ndarray:
+        """Host-engine reconstruct via the same cached recovery
+        bitmatrix as :meth:`decode_chunks`."""
+        present = tuple(present)
+        missing = tuple(missing)
+        if len(present) < self.k:
+            raise IOError(
+                f"cannot decode: {len(present)} chunks available, "
+                f"need {self.k}"
+            )
+        RM, _ = self._recovery_bitmatrix(present, missing)
+        pk = self._to_packets(np.asarray(chunks, dtype=np.uint8))
+        return self._from_packets(self._host_bitmatmul(RM, pk),
+                                  len(missing))
 
     def _recovery_bitmatrix(
         self, present: tuple[int, ...], missing: tuple[int, ...]

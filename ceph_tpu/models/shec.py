@@ -154,6 +154,24 @@ class ShecErasureCode(MatrixErasureCode):
         fn = _jit_matmul(_mkey(X), self.w)
         return np.asarray(fn(data))
 
+    def decode_chunks_host(
+        self, present: Sequence[int], chunks: np.ndarray, missing: Sequence[int]
+    ) -> np.ndarray:
+        """Host-engine reconstruct (osd/ec_failover): the SAME span
+        solve as :meth:`decode_chunks`, applied without a device launch
+        — the inherited MDS recovery-matrix oracle would be wrong for
+        this non-MDS layout."""
+        present = tuple(present)
+        missing = tuple(missing)
+        ordered, X = self._solve(present, missing)
+        if X is None:
+            raise IOError(
+                f"cannot decode chunks {missing} from {sorted(present)}"
+            )
+        order_idx = [list(present).index(r) for r in ordered]
+        data = np.asarray(chunks, dtype=np.uint8)[order_idx]
+        return self._host_matmul(X, data)
+
 
 class ErasureCodePluginShec(ErasureCodePlugin):
     def factory(self, profile: Mapping[str, str]):

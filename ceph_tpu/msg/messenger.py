@@ -103,16 +103,29 @@ class Connection:
                 if self.messenger._inject_failure():
                     # fault injection (ms_inject_socket_failures analog,
                     # reference:src/common/config_opts.h:209): sever the
-                    # link MID-FRAME — the peer sees a truncated read,
-                    # we see a dead connection; both must recover via
-                    # reconnect + op resend, never by trusting the frame
+                    # link MID-VECTORED-WRITE — a strict prefix of the
+                    # frame's segment list goes out (a partial
+                    # writelines: whole leading segments plus part of
+                    # the next, never a join), then the transport dies.
+                    # The peer sees a truncated read mid-frame; both
+                    # sides must recover via reconnect + op resend,
+                    # never by trusting the half-delivered frame.
                     logger.info(
-                        "%s: INJECTING socket failure to %s (mid-frame)",
+                        "%s: INJECTING socket failure to %s "
+                        "(mid-vectored-write)",
                         self.messenger.name, self.peer_name,
                     )
-                    flat = b"".join(segs)  # copy-ok: fault-injection cold path
                     self._writer.write(_LEN.pack(total))
-                    self._writer.write(flat[: max(1, total // 2)])
+                    budget = max(1, total // 2)
+                    partial = []
+                    for seg in segs:
+                        take = min(len(seg), budget)
+                        partial.append(memoryview(seg)[:take]
+                                       if take < len(seg) else seg)
+                        budget -= take
+                        if budget <= 0:
+                            break
+                    self._writer.writelines(partial)
                     try:
                         await self._writer.drain()
                     finally:

@@ -36,6 +36,7 @@ import asyncio
 import contextlib
 import json
 import logging
+import os
 import time
 from typing import Any
 
@@ -306,6 +307,20 @@ class OSD(Dispatcher):
             "requests coalesced per device launch",
             axes=[PerfHistogramAxis("ops", min=1.0, buckets=12)],
         )
+        # accelerator fault domain (osd/ec_failover): the engine_state
+        # gauge feeds the mgr's ACCEL_DEGRADED health check
+        pec.add_gauge("engine_state",
+                      "EC device engine health: 0 healthy / 1 suspect "
+                      "/ 2 tripped / 3 probing")
+        pec.add_counter("engine_failovers",
+                        "batched launches replayed on the host fallback "
+                        "engine after a fatal device error")
+        pec.add_counter("replayed_ops",
+                        "waiter ops served bit-identically by a "
+                        "failover replay")
+        pec.add_counter("launch_deadline_timeouts",
+                        "device launches abandoned at "
+                        "osd_ec_launch_deadline (wedged device call)")
         # QoS op scheduler (reference: osd_op_queue selecting the
         # mClock/WPQ op queues; see osd/scheduler.py): per-class
         # counters are registered with LITERAL keys so the
@@ -392,18 +407,42 @@ class OSD(Dispatcher):
 
             self.ec_mesh = get_mesh_engine()
         # cross-op EC microbatch dispatcher (default on; the mesh engine
-        # path bypasses it — the mesh owns its own device schedule)
+        # path bypasses it — the mesh owns its own device schedule),
+        # plus the engine health supervisor (osd/ec_failover): fatal
+        # launch failures replay on the host fallback and trip the
+        # breaker; while tripped, the QoS scheduler treats capacity as
+        # degraded and ec_background pacing squeezes to reservation
         self.ec_dispatch = None
+        self.ec_supervisor = None
         if getattr(cfg, "osd_ec_dispatch", True):
             from .ec_dispatch import ECDispatcher
+            from .ec_failover import EngineSupervisor
 
+            # constructed even with failover configured OFF (enabled
+            # gates the state machine, not the object): `config set
+            # osd_ec_engine_failover true` on a RUNNING osd must arm
+            # the breaker, not silently no-op while config show says on
+            self.ec_supervisor = EngineSupervisor(
+                enabled=cfg.osd_ec_engine_failover,
+                perf=pec,
+                probe_interval=cfg.osd_ec_probe_interval,
+                on_degraded=lambda d: setattr(
+                    self.scheduler, "capacity_degraded", d
+                ),
+            )
             self.ec_dispatch = ECDispatcher(
                 perf=pec,
                 window=cfg.osd_ec_dispatch_window,
                 max_stripes=cfg.osd_ec_dispatch_max_stripes,
                 bucket=cfg.osd_ec_dispatch_bucket,
                 scheduler=self.scheduler,
+                supervisor=self.ec_supervisor,
+                launch_deadline=cfg.osd_ec_launch_deadline,
             )
+            self.ec_dispatch.inject_engine_failure = \
+                cfg.ec_inject_engine_failure
+            self.ec_dispatch.inject_launch_hang = \
+                cfg.ec_inject_launch_hang
         prec = self.perf.create("recovery")
         prec.add_counter("pushes", "objects/shards pushed")
         prec.add_counter("reservation_waits",
@@ -464,6 +503,29 @@ class OSD(Dispatcher):
             ("osd_ec_dispatch_bucket", lambda _n, v: (
                 self.ec_dispatch is not None
                 and setattr(self.ec_dispatch, "bucket", bool(v))
+            )),
+            # fault-domain knobs: deadline/backoff tuning and the
+            # injection hooks must flip on a RUNNING osd (the fault
+            # matrix arms and lifts them live)
+            ("osd_ec_launch_deadline", self._on_ec_launch_deadline),
+            ("osd_ec_probe_interval", lambda _n, v: (
+                self.ec_supervisor is not None
+                and setattr(self.ec_supervisor, "probe_interval",
+                            float(v))
+            )),
+            ("osd_ec_engine_failover", lambda _n, v: (
+                self.ec_supervisor is not None
+                and self.ec_supervisor.set_enabled(bool(v))
+            )),
+            ("ec_inject_engine_failure", lambda _n, v: (
+                self.ec_dispatch is not None
+                and setattr(self.ec_dispatch, "inject_engine_failure",
+                            int(v))
+            )),
+            ("ec_inject_launch_hang", lambda _n, v: (
+                self.ec_dispatch is not None
+                and setattr(self.ec_dispatch, "inject_launch_hang",
+                            float(v))
             )),
             # QoS scheduler knobs stay live: `config set osd_op_queue
             # fifo` must switch a RUNNING osd's policy (queued waiters
@@ -529,12 +591,34 @@ class OSD(Dispatcher):
         from ..common.heartbeat_map import HeartbeatMap
         from ..common.lockdep import lockdep_enable
 
+        # process wrappers (tools/daemon.py) set True: their suicide
+        # must os._exit after the stop attempt, because a wedged
+        # non-daemon executor thread blocks normal interpreter exit.
+        # In-process clusters (MiniCluster) keep the default — an
+        # os._exit there would kill the whole test process.
+        self.suicide_hard_exit = False
         self.hb_map = HeartbeatMap(self.name, on_suicide=self._hb_suicide)
         self._op_handle = self.hb_map.add_worker(
             "osd_op_worker",
             cfg.osd_op_thread_timeout,
             cfg.osd_op_thread_suicide_timeout,
         )
+        # EC device launches get their own handle (osd/ec_failover):
+        # grace = the launch deadline (health warn on a wedged device
+        # call), suicide_grace = the op-worker daemon policy — the
+        # asyncio-side wait_for fails the waiters over fast, this clock
+        # covers the thread that never came back.  Deadline 0 disables
+        # the failover deadline, NOT the watchdog: the handle falls
+        # back to the generic op-worker grace so a wedged launch still
+        # marks the daemon unhealthy and still hits suicide policy.
+        self._ec_launch_handle = None
+        if self.ec_dispatch is not None:
+            self._ec_launch_handle = self.hb_map.add_worker(
+                "ec_device_launch",
+                self._ec_watchdog_grace(cfg.osd_ec_launch_deadline),
+                cfg.osd_op_thread_suicide_timeout,
+            )
+            self.ec_dispatch.set_watchdog_handle(self._ec_launch_handle)
         if cfg.lockdep:
             lockdep_enable(True)
         self._tasks: set[asyncio.Task] = set()
@@ -565,17 +649,24 @@ class OSD(Dispatcher):
     def _refresh_op_handle(self) -> None:
         """Pin the watchdog deadlines to the OLDEST in-flight op — one
         shared handle must not let fresh traffic mask a wedged op (the
-        reference sidesteps this with per-thread handles)."""
-        h = self._op_handle
-        oldest = self.op_tracker.oldest_start()
-        if oldest is None or h.grace <= 0:
-            # grace 0 = watchdog disabled, not a zero-second deadline
-            h.clear_timeout()
-            return
-        h.timeout = oldest + h.grace
-        h.suicide_timeout = (
-            oldest + h.suicide_grace if h.suicide_grace > 0 else 0.0
-        )
+        reference sidesteps this with per-thread handles; grace 0 =
+        watchdog disabled, handled by HeartbeatHandle.pin)."""
+        self._op_handle.pin(self.op_tracker.oldest_start())
+
+    def _ec_watchdog_grace(self, deadline: float) -> float:
+        """The ec_device_launch handle's grace: the launch deadline, or
+        (deadline 0 = unbounded launches) the generic op-worker grace —
+        '0 disables the deadline, not the watchdog'."""
+        return (float(deadline) if deadline > 0
+                else self.config.osd_op_thread_timeout)
+
+    def _on_ec_launch_deadline(self, _name: str, value: float) -> None:
+        """osd_ec_launch_deadline is live: it bounds future launches
+        (dispatcher) and re-graces the watchdog handle."""
+        if self.ec_dispatch is not None:
+            self.ec_dispatch.launch_deadline = float(value)
+        if self._ec_launch_handle is not None:
+            self._ec_launch_handle.grace = self._ec_watchdog_grace(value)
 
     def _hb_suicide(self, worker: str) -> None:
         """A worker blew its suicide timeout: take the daemon down hard
@@ -592,7 +683,17 @@ class OSD(Dispatcher):
             logger.error("recent: %s", line)
         # NOT tracked in self._tasks: stop() cancels those, and the
         # shutdown task cancelling itself would leave the messenger up
-        asyncio.ensure_future(self.stop(umount=False))
+        task = asyncio.ensure_future(self.stop(umount=False))
+        if self.suicide_hard_exit:
+            # process daemons (tools/daemon.py) must not trust the
+            # interpreter to exit after stop(): a truly-wedged device
+            # call sits in a NON-daemon executor thread, and
+            # concurrent.futures' atexit hook would join it forever —
+            # the hang this suicide exists to end.  os._exit skips the
+            # join (reference abort() parity; 134 = 128+SIGABRT); the
+            # timer backstop covers stop() itself wedging.
+            task.add_done_callback(lambda _t: os._exit(134))
+            asyncio.get_running_loop().call_later(10.0, os._exit, 134)
 
     def _on_scrub_interval(self, _name: str, value: float) -> None:
         self.scrub.interval = value
@@ -732,6 +833,13 @@ class OSD(Dispatcher):
                 lambda req: self.ec_dispatch.dump(),
                 "EC microbatch dispatcher: open batches, flush reasons, "
                 "pad waste, observed bucket table",
+            )
+        if self.ec_supervisor is not None:
+            a.register(
+                "dump_engine_health",
+                lambda req: self.ec_dispatch.engine_health(),
+                "EC engine health state machine: breaker state, probe "
+                "backoff, failure history, failover totals",
             )
         a.register(
             "dump_op_pq_state",
@@ -3641,6 +3749,10 @@ class OSD(Dispatcher):
         New slow ops are clog'd once (edge-triggered) like the
         reference's '%d slow requests' cluster-log warnings."""
         self.scheduler.refresh_gauges()  # qos share-attainment gauges
+        if self.ec_supervisor is not None:
+            # engine_state must survive an admin `perf reset` — a
+            # zeroed gauge would clear ACCEL_DEGRADED while TRIPPED
+            self.ec_supervisor.refresh_gauge()
         slow = self.op_tracker.slow_ops(self.config.osd_op_complaint_time)
         posd = self.perf.get("osd")
         posd.set("slow_ops", len(slow))

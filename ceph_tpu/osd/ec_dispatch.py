@@ -44,9 +44,30 @@ a slow one.  The gates are ec_util's shared
 conditions the encode/decode stacks route on, so the lanes cannot
 drift.
 
+A fourth mechanism rides on top (the accelerator fault domain,
+osd/ec_failover):
+
+- **engine failover** — a batched device launch that fails with a
+  FATAL error (device-lost / XLA runtime / OOM / compile — see
+  ``classify_engine_error``) is replayed on the host fallback engine
+  (``ec_util.encode_fallback``/``decode_concat_fallback``, pinned
+  bit-identical), so no waiter ever observes a device error; data-shape
+  errors still surface to their caller.  Each failure advances the
+  :class:`~ceph_tpu.osd.ec_failover.EngineSupervisor` breaker; while
+  TRIPPED, requests route straight to the fallback lane and a canary
+  probe re-promotes the device.  Every launch is bounded by
+  ``osd_ec_launch_deadline``: past it the waiters fail over and the
+  wedged worker thread stays pinned on the daemon's HeartbeatMap
+  handle (grace -> health warn, suicide_grace -> daemon policy), so a
+  hung PJRT call can never silently freeze the OSD.  Fault hooks
+  ``ec_inject_engine_failure`` / ``ec_inject_launch_hang`` prove all
+  of it on a live cluster.
+
 Observability: batch/op/flush-reason/pad counters plus a
 ``dispatch_batch_size_histogram`` on the OSD's ``ec`` subsystem (flowing
 through perf dump -> mgr prometheus like every other key), the
+``engine_state`` gauge and ``engine_failovers``/``replayed_ops``/
+``launch_deadline_timeouts`` counters for the fault domain, the
 KernelProfiler sees the bucketed shapes at the codec boundary, and
 ``dump_ec_dispatch`` on the admin socket serves :meth:`ECDispatcher.dump`.
 """
@@ -54,14 +75,24 @@ KernelProfiler sees the bucketed shapes at the codec boundary, and
 from __future__ import annotations
 
 import asyncio
+import logging
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Mapping
 
 import numpy as np
 
+from ..models.matrix_codec import EngineFault
 from ..utils.buffers import as_u8, note_copy
 from . import ec_util
+
+logger = logging.getLogger("ceph_tpu.ec_dispatch")
+
+
+class LaunchDeadlineExceeded(RuntimeError):
+    """A batched device launch outlived osd_ec_launch_deadline: the
+    device call is considered wedged (classified fatal by lineage —
+    RuntimeError — so the replay path treats it like a device loss)."""
 
 
 def bucket_stripes(s: int) -> int:
@@ -104,7 +135,9 @@ class ECDispatcher:
 
     def __init__(self, perf=None, *, window: float = 5e-4,
                  max_stripes: int = 512, bucket: bool = True,
-                 max_workers: int = 2, scheduler=None):
+                 max_workers: int = 2, scheduler=None,
+                 supervisor=None, launch_deadline: float = 0.0,
+                 hb_handle=None):
         self._perf = perf
         # the OSD's QoS scheduler (osd/scheduler.py; None standalone):
         # BACKGROUND stripes (klass != "client") pace through it before
@@ -132,10 +165,31 @@ class ECDispatcher:
         # optimistic (assume concurrency) so the first burst gets the
         # full window.
         self._last_ops = 2
+        self._max_workers = max_workers
+        # accelerator fault domain (osd/ec_failover): the supervisor
+        # gates/records engine health, the deadline bounds every device
+        # launch, the HeartbeatMap handle keeps the daemon-policy clock
+        # on a wedged worker thread, the inject_* hooks fabricate
+        # device faults (config: ec_inject_engine_failure /
+        # ec_inject_launch_hang, live via observers)
+        self._supervisor = supervisor
+        self.launch_deadline = float(launch_deadline)
+        self._hb_handle = hb_handle
+        self.inject_engine_failure = 0
+        self.inject_launch_hang = 0.0
+        self._inject_n = 0
+        self._inflight_launches: dict[int, float] = {}  # id -> start
+        # the (kind, sinfo, codec) of the launch that last tripped the
+        # breaker — what the canary probe re-verifies
+        self._last_trip: tuple | None = None
+        if supervisor is not None and supervisor.probe is None:
+            supervisor.probe = self._canary_probe
         # dump()-side totals, independent of the perf wiring
         self._totals = {
             "batches": 0, "ops": 0, "stripes": 0, "cancelled": 0,
             "pad_stripes": 0, "pad_bytes": 0, "native_direct": 0,
+            "failovers": 0, "replayed_ops": 0, "fallback_direct": 0,
+            "deadline_timeouts": 0,
             "flush": {"size": 0, "window": 0, "stop": 0},
         }
         self._buckets_seen: dict[int, int] = {}  # padded S -> launches
@@ -162,19 +216,28 @@ class ECDispatcher:
         if stripes == 0 or self._stopping:
             # empty payloads and shutdown drain skip the queue (nothing
             # to amortize / no flusher guaranteed to run again)
-            return ec_util.encode(sinfo, codec, buf)
+            return self._inline_encode_fn()(sinfo, codec, buf)
         await self._qos_pace(klass, stripes)
         if self._stopping:
             # stop() may have drained the batches and shut the worker
             # pool down while we slept in pace() — a late submit would
             # open a batch nobody will ever flush (and the executor
             # would refuse the launch)
-            return ec_util.encode(sinfo, codec, buf)
+            return self._inline_encode_fn()(sinfo, codec, buf)
         if ec_util.native_encode_path(sinfo, codec):
             # no launch/compile overhead to amortize on the C engine —
             # keep per-op (cache-resident) calls, just off the loop
             return await self._run_native_direct(
                 ec_util.encode, sinfo, codec, buf, "encode", buf.size
+            )
+        if self._supervisor is not None and not self._supervisor.device_ok():
+            # breaker TRIPPED/PROBING: the device engine is out of the
+            # data path — serve from the host fallback (still off the
+            # loop; the canary is the only device traffic until the
+            # supervisor re-promotes)
+            return await self._run_fallback_direct(
+                ec_util.encode_fallback, sinfo, codec, buf,
+                "encode", buf.size,
             )
         key = ("enc", klass, id(codec), sinfo.stripe_width,
                sinfo.chunk_size)
@@ -200,20 +263,41 @@ class ECDispatcher:
             )
         stripes = shard_len // sinfo.chunk_size
         if stripes == 0 or self._stopping:
-            return ec_util.decode_concat(sinfo, codec, arrs)
+            return self._inline_decode_fn()(sinfo, codec, arrs)
         await self._qos_pace(klass, stripes)
         if self._stopping:
             # see encode(): stop() may have won the race while pacing
-            return ec_util.decode_concat(sinfo, codec, arrs)
+            return self._inline_decode_fn()(sinfo, codec, arrs)
         if ec_util.native_decode_path(codec, shard_len):
             return await self._run_native_direct(
                 ec_util.decode_concat, sinfo, codec, arrs, "decode",
                 shard_len * len(arrs),
             )
+        if self._supervisor is not None and not self._supervisor.device_ok():
+            return await self._run_fallback_direct(
+                ec_util.decode_concat_fallback, sinfo, codec, arrs,
+                "decode", shard_len * len(arrs),
+            )
         present = tuple(sorted(arrs))
         key = ("dec", klass, id(codec), sinfo.stripe_width,
                sinfo.chunk_size, present)
         return await self._submit(key, "dec", codec, sinfo, arrs, stripes)
+
+    def _inline_encode_fn(self):
+        """Engine for the inline per-op lanes (empty payload, shutdown
+        drain): a TRIPPED breaker must route these to the host fallback
+        too — an inline call runs ON the event loop, where a wedged
+        device call would have no deadline, no watchdog pin, and would
+        stall the very heartbeat tasks that enforce daemon policy."""
+        if self._supervisor is not None and not self._supervisor.device_ok():
+            return ec_util.encode_fallback
+        return ec_util.encode
+
+    def _inline_decode_fn(self):
+        """Decode twin of :meth:`_inline_encode_fn`."""
+        if self._supervisor is not None and not self._supervisor.device_ok():
+            return ec_util.decode_concat_fallback
+        return ec_util.decode_concat
 
     async def _qos_pace(self, klass: str, stripes: int) -> None:
         """Background stripes wait out the scheduler's pacing tags
@@ -226,15 +310,37 @@ class ECDispatcher:
 
     async def stop(self) -> None:
         """Flush every open batch (reason ``stop``), wait for in-flight
-        launches, shut the worker pool down.  Requests arriving after
-        stop() fall back to inline per-op calls."""
+        launches, stop the engine supervisor's probe loop, shut the
+        worker pool down.  Requests arriving after stop() fall back to
+        inline per-op calls."""
         self._stopping = True
         for key in list(self._open):
             self._flush(key, "stop")
         if self._tasks:
             await asyncio.gather(*list(self._tasks),
                                  return_exceptions=True)
+        if self._supervisor is not None:
+            await self._supervisor.stop()
         self._executor.shutdown(wait=False)
+
+    def engine_health(self) -> dict:
+        """``dump_engine_health`` admin-socket body: the supervisor's
+        state machine plus this dispatcher's failover slice — the ONE
+        accessor (dump() embeds it too), so the admin surfaces cannot
+        drift from the dispatcher's actual totals."""
+        t = self._totals
+        return {
+            **(self._supervisor.dump()
+               if self._supervisor is not None else {}),
+            "dispatcher": {
+                "inflight_launches": len(self._inflight_launches),
+                "launch_deadline_s": self.launch_deadline,
+                "failovers": t["failovers"],
+                "replayed_ops": t["replayed_ops"],
+                "fallback_direct": t["fallback_direct"],
+                "deadline_timeouts": t["deadline_timeouts"],
+            },
+        }
 
     def dump(self) -> dict:
         """Admin-socket body (``dump_ec_dispatch``)."""
@@ -243,7 +349,13 @@ class ECDispatcher:
                 "window_s": self.window,
                 "max_stripes": self.max_stripes,
                 "bucket": self.bucket,
+                "launch_deadline_s": self.launch_deadline,
+                "inject_engine_failure": self.inject_engine_failure,
+                "inject_launch_hang_s": self.inject_launch_hang,
             },
+            **({"engine_health": self._supervisor.dump()}
+               if self._supervisor is not None else {}),
+            "inflight_launches": len(self._inflight_launches),
             "open_batches": [
                 {
                     "kind": b.kind, "ops": len(b.ops),
@@ -265,23 +377,23 @@ class ECDispatcher:
 
     # -- queueing ------------------------------------------------------------
 
-    async def _run_native_direct(self, fn, sinfo, codec, payload,
-                                 op: str, nbytes: int):
-        """Per-op call in the worker pool (event-loop liberation without
-        coalescing — the native C engine path).  Sets the per-engine
-        GB/s gauge from the call's own device time (the daemon's
-        op-level timer includes executor-hop wait, so it no longer
-        feeds the gauge on the dispatch route)."""
-        self._totals["native_direct"] = (
-            self._totals.get("native_direct", 0) + 1
-        )
-        if self._perf is not None:
-            self._perf.inc("dispatch_native_direct")
+    async def _run_direct(self, fn, sinfo, codec, payload, op: str,
+                          nbytes: int, totals_key: str,
+                          perf_key: str | None = None):
+        """Per-op call in the worker pool (event-loop liberation
+        without coalescing) — shared by the native C lane and the
+        host-fallback lane (the serving path while the device engine
+        is TRIPPED).  The call is timed in-worker: pool queue wait must
+        not read as device time in the gauges/histograms under load —
+        and whichever engine serves, its time feeds the same gauges
+        (the daemon's op-level timer includes executor-hop wait, so it
+        no longer feeds them on the dispatch route)."""
+        self._totals[totals_key] = self._totals.get(totals_key, 0) + 1
+        if self._perf is not None and perf_key is not None:
+            self._perf.inc(perf_key)
         loop = asyncio.get_running_loop()
 
         def _timed_call():
-            # timed in-worker: pool queue wait must not read as device
-            # time in the gauges/histograms under load
             t0 = time.perf_counter()
             res = fn(sinfo, codec, payload)
             return res, time.perf_counter() - t0
@@ -290,9 +402,20 @@ class ECDispatcher:
         if self._perf is not None:
             try:
                 ec_util.account_ec_call(self._perf, op, nbytes, dt)
-            except Exception:  # observability is best-effort
+            except Exception:  # swallow-ok: observability is best-effort
                 pass
         return out
+
+    def _run_native_direct(self, fn, sinfo, codec, payload, op: str,
+                           nbytes: int):
+        return self._run_direct(fn, sinfo, codec, payload, op, nbytes,
+                                "native_direct",
+                                perf_key="dispatch_native_direct")
+
+    def _run_fallback_direct(self, fn, sinfo, codec, payload, op: str,
+                             nbytes: int):
+        return self._run_direct(fn, sinfo, codec, payload, op, nbytes,
+                                "fallback_direct")
 
     async def _submit(self, key: tuple, kind: str, codec, sinfo,
                       payload, stripes: int):
@@ -341,16 +464,45 @@ class ECDispatcher:
 
     async def _run_batch(self, b: _Batch, ops: list[_Op],
                          reason: str) -> None:
-        loop = asyncio.get_running_loop()
         try:
-            results, pad, seconds = await loop.run_in_executor(
-                self._executor, self._run_sync, b, ops
-            )
-        except Exception as e:  # surface to every waiter, wedge none
-            for op in ops:
-                if not op.fut.done():
-                    op.fut.set_exception(e)
-            return
+            results, pad, seconds = await self._launch(b, ops)
+            if self._supervisor is not None:
+                self._supervisor.record_success()
+        except Exception as e:
+            # the fault fork (osd/ec_failover): FATAL errors — device
+            # lost, XLA runtime, OOM, compile, a blown launch deadline
+            # — replay the whole batch on the host fallback engine
+            # (bit-identical), so no waiter ever sees a device error;
+            # data-shape errors surface to every waiter as before
+            sup = self._supervisor
+            if isinstance(e, LaunchDeadlineExceeded):
+                # record_timeout already advanced the breaker (and
+                # counted the timeout) inside _bounded_device_call —
+                # re-recording here would double-count one wedge as a
+                # timeout AND a fatal error
+                kind = "fatal"
+            else:
+                kind = sup.record_failure(e) if sup is not None else "data"
+            if kind != "fatal" or sup is None or not sup.enabled:
+                # data errors always surface; fatal errors surface too
+                # when failover is off (no supervisor, or live-disabled
+                # via osd_ec_engine_failover) — the pre-failover contract
+                for op in ops:
+                    if not op.fut.done():
+                        op.fut.set_exception(e)
+                return
+            self._last_trip = (b.kind, b.sinfo, b.codec)
+            try:
+                results, pad, seconds = await self._replay(b, ops)
+            except Exception as e2:
+                # the fallback failed too (a data error the device
+                # masked, or a host fault): surface THAT error — it is
+                # the one describing the actual state of the bytes
+                for op in ops:
+                    if not op.fut.done():
+                        op.fut.set_exception(e2)
+                return
+            self._note_failover(b, ops, e)
         # waiters resolve FIRST: accounting (a partially-registered
         # PerfCounters, say) must never wedge the data path
         for op, res in zip(ops, results):
@@ -358,8 +510,176 @@ class ECDispatcher:
                 op.fut.set_result(res)
         try:
             self._note_batch(b, ops, reason, pad, seconds)
-        except Exception:  # observability is best-effort by contract
+        except Exception:  # swallow-ok: observability is best-effort by contract
             pass
+
+    async def _launch(self, b: _Batch, ops: list[_Op]):
+        return await self._bounded_device_call(
+            f"{b.kind} launch ({b.stripes} stripes)",
+            self._run_sync, b, ops,
+        )
+
+    async def _bounded_device_call(self, label: str, fn, *args):
+        """One device call in the worker pool, bounded by
+        ``osd_ec_launch_deadline`` and pinned on the HeartbeatMap while
+        in flight — shared by batch launches and the canary probe, so a
+        wedged canary gets the exact same discipline as a wedged
+        launch.  On deadline: the caller fails over NOW
+        (LaunchDeadlineExceeded), the wedged thread is abandoned to a
+        fresh executor (it would otherwise eat a pool slot — and with
+        it, the fallback serving lane), and its HeartbeatMap pin keeps
+        counting until the thread returns — grace marks the daemon
+        unhealthy, suicide_grace invokes daemon policy (reference: a
+        wedged thread must kill the daemon rather than wedge the
+        cluster)."""
+        loop = asyncio.get_running_loop()
+        cf = self._executor.submit(fn, *args)
+        token = id(cf)
+        self._inflight_launches[token] = time.monotonic()
+        self._pin_watchdog()
+
+        def _done(_f, token=token):
+            try:
+                loop.call_soon_threadsafe(self._untrack_launch, token)
+            # swallow-ok: loop already closed at teardown — nothing left to unpin
+            except RuntimeError:
+                pass
+
+        cf.add_done_callback(_done)
+        fut = asyncio.wrap_future(cf)
+        deadline = self.launch_deadline
+        if deadline <= 0:
+            return await fut
+        try:
+            return await asyncio.wait_for(asyncio.shield(fut), deadline)
+        except asyncio.TimeoutError:
+            # the abandoned call may still complete (or raise) later:
+            # mark its exception retrieved so asyncio never logs a
+            # spurious "exception was never retrieved" for a call the
+            # waiters already failed over from
+            fut.add_done_callback(
+                lambda f: f.cancelled() or f.exception()
+            )
+            self._totals["deadline_timeouts"] += 1
+            if self._perf is not None:
+                self._perf.inc("launch_deadline_timeouts")
+            if self._supervisor is not None:
+                self._supervisor.record_timeout(deadline)
+            self._executor.shutdown(wait=False)
+            self._executor = ThreadPoolExecutor(
+                max_workers=self._max_workers,
+                thread_name_prefix="ec-dispatch",
+            )
+            raise LaunchDeadlineExceeded(
+                f"EC {label} exceeded the {deadline:g}s launch deadline"
+            ) from None
+
+    async def _replay(self, b: _Batch, ops: list[_Op]):
+        """Replay a failed batch on the host fallback engine (worker
+        pool; no injection, no deadline — the fallback cannot wedge on
+        a device)."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._executor, self._run_sync, b, ops, "fallback"
+        )
+
+    def _note_failover(self, b: _Batch, ops: list[_Op],
+                       cause: Exception) -> None:
+        logger.warning(
+            "EC %s batch (%d ops, %d stripes) failed over to the host "
+            "fallback engine: %r", b.kind, len(ops), b.stripes, cause,
+        )
+        self._totals["failovers"] += 1
+        self._totals["replayed_ops"] += len(ops)
+        if self._perf is not None:
+            try:
+                self._perf.inc("engine_failovers")
+                self._perf.inc("replayed_ops", len(ops))
+            except Exception:  # swallow-ok: observability is best-effort
+                pass
+
+    # -- launch watchdog (HeartbeatMap wiring) -------------------------------
+
+    def set_watchdog_handle(self, handle) -> None:
+        """Adopt the daemon's HeartbeatMap handle for in-flight device
+        launches (the daemon creates its HeartbeatMap after the
+        dispatcher; handles registered later attach here)."""
+        self._hb_handle = handle
+        self._pin_watchdog()
+
+    def _pin_watchdog(self) -> None:
+        """Pin the daemon's ec-launch handle to the OLDEST in-flight
+        launch: fresh launches must never mask a wedged one (the same
+        rule the OSD op handle follows)."""
+        if self._hb_handle is not None:
+            self._hb_handle.pin(
+                min(self._inflight_launches.values(), default=None)
+            )
+
+    def _untrack_launch(self, token: int) -> None:
+        self._inflight_launches.pop(token, None)
+        self._pin_watchdog()
+
+    # -- fault injection + canary --------------------------------------------
+
+    def _maybe_inject(self) -> None:
+        """Worker-thread hook on every DEVICE launch (batches and the
+        canary; never the fallback): the accelerator analog of
+        ms_inject_socket_failures."""
+        if self.inject_launch_hang > 0:
+            time.sleep(self.inject_launch_hang)
+        n = self.inject_engine_failure
+        if n > 0:
+            self._inject_n += 1
+            if self._inject_n % n == 0:
+                raise EngineFault(
+                    "INTERNAL: injected device loss "
+                    "(ec_inject_engine_failure)"
+                )
+
+    async def _canary_probe(self) -> bool:
+        """One-stripe launch of the KIND that tripped the breaker
+        (encode, or a one-erasure decode), checked byte-for-byte
+        against the host oracle — the supervisor's re-promotion
+        evidence.  Probing the tripped kind matters: a device whose
+        reconstruct program is broken but whose encode still works
+        would otherwise re-promote on an encode canary and flap
+        TRIPPED->HEALTHY->TRIPPED forever.  Runs in the worker pool
+        like every launch."""
+        key = self._last_trip
+        if key is None:
+            return True  # never tripped via a batch: nothing to disprove
+        kind, sinfo, codec = key
+
+        def _probe_sync() -> bool:
+            self._maybe_inject()
+            buf = np.arange(
+                sinfo.stripe_width, dtype=np.uint32
+            ).astype(np.uint8)  # deterministic, alignment-friendly
+            shards = ec_util.encode_fallback(sinfo, codec, buf)
+            if kind == "dec":
+                # drop one data shard: the probe must drive the device
+                # RECONSTRUCT program, the one that actually tripped
+                survivors = {s: np.asarray(v)
+                             for s, v in shards.items() if s != 0}
+                got = ec_util.decode_concat(sinfo, codec, survivors)
+                want = ec_util.decode_concat_fallback(
+                    sinfo, codec, survivors
+                )
+                # copy-ok: one-stripe canary, cold re-promotion path
+                return bytes(got) == bytes(want)
+            got = ec_util.encode(sinfo, codec, buf)
+            want = shards
+            return set(got) == set(want) and all(
+                np.array_equal(np.asarray(got[s]), np.asarray(want[s]))
+                for s in want
+            )
+
+        # rides the same bounding as a batch launch: a wedged canary
+        # respawns the executor (it must not eat the fallback lane's
+        # worker slots) and stays on the watchdog pin until it returns
+        return await self._bounded_device_call("canary probe",
+                                               _probe_sync)
 
     def _note_batch(self, b: _Batch, ops: list[_Op], reason: str,
                     pad: int, seconds: float) -> None:
@@ -410,17 +730,28 @@ class ECDispatcher:
             return 0
         return bucket_stripes(total_stripes) - total_stripes
 
-    def _run_sync(self, b: _Batch, ops: list[_Op]):
+    def _run_sync(self, b: _Batch, ops: list[_Op],
+                  engine: str = "device"):
         """Worker-thread body: concat -> pad -> one ec_util call ->
         per-op slices.  The device call is timed HERE (not around the
         executor hop) so the reported launch time never includes
         worker-pool queue wait; per-op encode slices are COPIES, so one
         stalled waiter pins only its own bytes, not the whole padded
-        batch output."""
+        batch output.
+
+        ``engine`` picks the math: "device" is the normal jax route
+        (fault-injection hooks apply); "fallback" is the host replay
+        route (ec_util.*_fallback — no injection, no bucketing: the
+        host engines have no jit cache to protect)."""
+        fallback = engine == "fallback"
+        encode_fn = ec_util.encode_fallback if fallback else ec_util.encode
+        decode_fn = ec_util.decode_fallback if fallback else ec_util.decode
         sinfo, codec = b.sinfo, b.codec
         cs = sinfo.chunk_size
         total = sum(op.stripes for op in ops)
-        pad = self._pad_for(codec, total)
+        pad = 0 if fallback else self._pad_for(codec, total)
+        if not fallback:
+            self._maybe_inject()
         if b.kind == "enc":
             if len(ops) == 1 and not pad:
                 cat = ops[0].payload  # single op, snug bucket: no gather
@@ -438,7 +769,7 @@ class ECDispatcher:
                     off += n
                 note_copy("ec_gather", off)
             t0 = time.perf_counter()
-            out = ec_util.encode(sinfo, codec, cat)
+            out = encode_fn(sinfo, codec, cat)
             seconds = time.perf_counter() - t0
             results = []
             off = 0
@@ -468,7 +799,7 @@ class ECDispatcher:
             cat[s] = buf
         k = codec.get_data_chunk_count()
         t0 = time.perf_counter()
-        decoded = ec_util.decode(sinfo, codec, cat, want=list(range(k)))
+        decoded = decode_fn(sinfo, codec, cat, want=list(range(k)))
         seconds = time.perf_counter() - t0
         rows = [np.asarray(decoded[i]) for i in range(k)]
         results = []

@@ -18,6 +18,7 @@ TPU re-expression of ``ECUtil`` (reference:src/osd/ECUtil.{h,cc}):
 
 from __future__ import annotations
 
+import logging
 from typing import Mapping, Sequence
 
 import numpy as np
@@ -25,6 +26,8 @@ import numpy as np
 from ..models.interface import ErasureCodeInterface
 from ..utils import native
 from ..utils.buffers import as_u8, note_copy
+
+logger = logging.getLogger("ceph_tpu.ec_util")
 
 CRC_SEED = 0xFFFFFFFF  # the reference seeds per-shard crcs with -1
 
@@ -144,6 +147,51 @@ def _check_batch_alignment(sinfo: StripeInfo, ec_impl) -> None:
         )
 
 
+def _encode_prologue(
+    sinfo: StripeInfo, ec_impl: ErasureCodeInterface,
+    data: bytes | np.ndarray,
+) -> tuple[np.ndarray, int]:
+    """Validate an encode batch; returns ``(buf, stripes)``.  ONE
+    prologue shared by :func:`encode` and :func:`encode_fallback`: the
+    device and fallback lanes must accept exactly the same batches, or
+    a failover replay could reject — with a spurious ValueError
+    delivered to the waiters as the "real" error — a batch the device
+    lane already admitted."""
+    buf = as_u8(data)
+    if buf.size % sinfo.stripe_width != 0:
+        raise ValueError(
+            f"data size {buf.size} not a multiple of stripe_width {sinfo.stripe_width}"
+        )
+    if ec_impl.get_data_chunk_count() != sinfo.k:
+        raise ValueError(
+            f"codec k={ec_impl.get_data_chunk_count()} != stripe "
+            f"k={sinfo.k}"
+        )
+    _check_batch_alignment(sinfo, ec_impl)
+    return buf, buf.size // sinfo.stripe_width
+
+
+def _decode_prologue(
+    sinfo: StripeInfo, ec_impl: ErasureCodeInterface,
+    chunks: Mapping[int, np.ndarray],
+) -> tuple[list[int], int]:
+    """Validate a decode shard set; returns ``(present, shard_len)`` —
+    the decode-side twin of :func:`_encode_prologue`, shared by
+    :func:`decode` and :func:`decode_fallback` for the same reason."""
+    present = sorted(chunks)
+    sizes = {np.asarray(v).size for v in chunks.values()}
+    if len(sizes) != 1:
+        raise ValueError(f"shard buffers differ in size: {sizes}")
+    shard_len = next(iter(sizes))
+    if shard_len % sinfo.chunk_size != 0:
+        raise ValueError(
+            f"shard buffer size {shard_len} not a multiple of "
+            f"chunk_size {sinfo.chunk_size}"
+        )
+    _check_batch_alignment(sinfo, ec_impl)
+    return present, shard_len
+
+
 def encode(
     sinfo: StripeInfo, ec_impl: ErasureCodeInterface, data: bytes | np.ndarray
 ) -> dict[int, np.ndarray]:
@@ -153,16 +201,8 @@ def encode(
     stripe into one codec call (reference loops per stripe,
     reference:ECUtil.cc:113-120 — same bytes, one device launch).
     """
-    buf = as_u8(data)
-    if buf.size % sinfo.stripe_width != 0:
-        raise ValueError(
-            f"data size {buf.size} not a multiple of stripe_width {sinfo.stripe_width}"
-        )
+    buf, S = _encode_prologue(sinfo, ec_impl, data)
     k, m = ec_impl.get_data_chunk_count(), ec_impl.get_coding_chunk_count()
-    if k != sinfo.k:
-        raise ValueError(f"codec k={k} != stripe k={sinfo.k}")
-    _check_batch_alignment(sinfo, ec_impl)
-    S = buf.size // sinfo.stripe_width
     cs = sinfo.chunk_size
     # [S, k, cs] -> [k, S*cs]: shard i's buffer is its chunk from each stripe
     # in order, exactly the reference's per-stripe append layout.
@@ -248,17 +288,7 @@ def decode(
     The recovery matrix is columnwise, so one batched call rebuilds every
     stripe at once (reference:ECUtil.cc:45 loops per chunk_size slice).
     """
-    present = sorted(chunks)
-    sizes = {np.asarray(v).size for v in chunks.values()}
-    if len(sizes) != 1:
-        raise ValueError(f"shard buffers differ in size: {sizes}")
-    shard_len = next(iter(sizes))
-    if shard_len % sinfo.chunk_size != 0:
-        raise ValueError(
-            f"shard buffer size {shard_len} not a multiple of "
-            f"chunk_size {sinfo.chunk_size}"
-        )
-    _check_batch_alignment(sinfo, ec_impl)
+    present, _shard_len = _decode_prologue(sinfo, ec_impl, chunks)
     if want is None:
         want = list(range(ec_impl.get_data_chunk_count()))
     return ec_impl.decode(list(want), {i: np.asarray(chunks[i]) for i in present})
@@ -298,6 +328,97 @@ def decode_concat(
     """
     k = ec_impl.get_data_chunk_count()
     decoded = decode(sinfo, ec_impl, chunks, want=list(range(k)))
+    return shards_to_logical(
+        [decoded[i] for i in range(k)], sinfo.chunk_size
+    )
+
+
+# -- host fallback engine (the failover replay path) --------------------------
+#
+# The engine supervisor (osd/ec_failover) replays a failed device batch
+# here: same contract and BYTES as encode/decode_concat (every engine is
+# pinned bit-identical to the host oracle), but the device is never
+# touched — codecs route through their encode_chunks_host /
+# decode_chunks_host oracle methods (models/matrix_codec), so a replay
+# cannot re-raise the device fault it is recovering from.
+
+_NO_HOST_ORACLE_WARNED: set[str] = set()
+
+
+def _host_oracle(ec_impl, op: str):
+    """``<op>_host`` on the codec, or (third-party plugins only —
+    every in-repo codec ships host oracles) the device method with a
+    once-per-class warning: a failover replay that silently re-enters
+    the dead device would re-raise the fault it is recovering from,
+    and the operator should know WHY failover is not protecting this
+    pool."""
+    host = getattr(ec_impl, f"{op}_host", None)
+    if host is not None:
+        return host
+    cls = type(ec_impl).__name__
+    if cls not in _NO_HOST_ORACLE_WARNED:
+        _NO_HOST_ORACLE_WARNED.add(cls)
+        logger.warning(
+            "codec %s has no %s_host oracle: the EC failover replay "
+            "falls back to its device method and cannot protect "
+            "against device loss for this pool", cls, op,
+        )
+    return getattr(ec_impl, op)
+
+
+def encode_fallback(
+    sinfo: StripeInfo, ec_impl: ErasureCodeInterface,
+    data: bytes | np.ndarray,
+) -> dict[int, np.ndarray]:
+    """Host-engine :func:`encode`: identical shard bytes, no jax."""
+    buf, S = _encode_prologue(sinfo, ec_impl, data)
+    k, m = ec_impl.get_data_chunk_count(), ec_impl.get_coding_chunk_count()
+    cs = sinfo.chunk_size
+    note_copy("ec_gather", buf.size)
+    arr = np.ascontiguousarray(
+        buf.reshape(S, k, cs).transpose(1, 0, 2)
+    ).reshape(k, S * cs)
+    host = _host_oracle(ec_impl, "encode_chunks")
+    parity = np.asarray(host(arr))
+    out = {i: arr[i] for i in range(k)}
+    for j in range(m):
+        out[k + j] = parity[j]
+    return out
+
+
+def decode_fallback(
+    sinfo: StripeInfo,
+    ec_impl: ErasureCodeInterface,
+    chunks: Mapping[int, np.ndarray],
+    want: Sequence[int] | None = None,
+) -> dict[int, np.ndarray]:
+    """Host-engine :func:`decode`: identical shard bytes, no jax."""
+    present, _shard_len = _decode_prologue(sinfo, ec_impl, chunks)
+    if want is None:
+        want = list(range(ec_impl.get_data_chunk_count()))
+    missing = sorted(set(want) - set(present))
+    out = {
+        i: np.asarray(chunks[i]) for i in want if i in chunks
+    }
+    if missing:
+        host = _host_oracle(ec_impl, "decode_chunks")
+        stacked = np.stack(
+            [np.asarray(chunks[i], dtype=np.uint8) for i in present]
+        )
+        rebuilt = np.asarray(host(present, stacked, missing))
+        for j, i in enumerate(missing):
+            out[i] = rebuilt[j]
+    return out
+
+
+def decode_concat_fallback(
+    sinfo: StripeInfo,
+    ec_impl: ErasureCodeInterface,
+    chunks: Mapping[int, np.ndarray],
+) -> bytearray:
+    """Host-engine :func:`decode_concat`: identical bytes, no jax."""
+    k = ec_impl.get_data_chunk_count()
+    decoded = decode_fallback(sinfo, ec_impl, chunks, want=list(range(k)))
     return shards_to_logical(
         [decoded[i] for i in range(k)], sinfo.chunk_size
     )

@@ -173,6 +173,13 @@ class OpScheduler:
         self._timer: asyncio.TimerHandle | None = None
         self._stopping = False
         self._win_t0 = time.monotonic()
+        # capacity-degraded signal (osd/ec_failover): while the EC
+        # device engine is TRIPPED the host fallback serves the data
+        # path at a fraction of device rate — background pacing
+        # squeezes to reservation rate even with no client queued,
+        # exactly as it does under client contention (capacity shrank;
+        # the same squeeze pace() already knows)
+        self.capacity_degraded = False
 
     # -- configuration (all live via config observers) -----------------------
 
@@ -295,7 +302,10 @@ class OpScheduler:
         st = self._state[klass]
         spec = st.spec
         rate = spec.limit
-        if self._state["client"].queue and spec.reservation > 0:
+        if (
+            (self._state["client"].queue or self.capacity_degraded)
+            and spec.reservation > 0
+        ):
             rate = (spec.reservation if rate <= 0
                     else min(rate, spec.reservation))
         if rate <= 0:
@@ -394,6 +404,7 @@ class OpScheduler:
             "inflight": self._inflight,
             "cut_off": self.cut_off,
             "queued_total": self.queued(),
+            "capacity_degraded": self.capacity_degraded,
             "classes": classes,
         }
 

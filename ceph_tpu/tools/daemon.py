@@ -81,6 +81,10 @@ async def _run_osd(args) -> None:
         # peer being dead
         heartbeat_grace=max(3.0, args.heartbeat_interval * 4),
     )
+    # a real process: suicide must end the PROCESS even when a wedged
+    # non-daemon executor thread would block normal interpreter exit
+    # (reference abort() parity; see OSD._hb_suicide)
+    osd.suicide_hard_exit = True
     await osd.start()
     print(f"osd.{args.id} up at {osd.addr}", flush=True)
     await _until_term(args.watch_parent)

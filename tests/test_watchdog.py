@@ -151,6 +151,47 @@ def lockdep():
     lockdep_enable(False)
 
 
+class TestSuicideHardExit:
+    """osd/ec_failover: a PROCESS daemon's suicide must end the process
+    even when a wedged non-daemon executor thread (the abandoned device
+    launch) would block normal interpreter exit at the atexit join —
+    in-process MiniCluster daemons must never hard-exit (it would kill
+    the test process)."""
+
+    class _FakeOSD:
+        name = "osd.9"
+        _stopping = False
+        suicide_hard_exit = True
+
+        async def stop(self, umount=True):
+            pass
+
+    def test_process_daemon_suicide_hard_exits_after_stop(
+        self, monkeypatch
+    ):
+        import asyncio
+
+        from ceph_tpu.osd import daemon as osd_daemon
+
+        exits = []
+        monkeypatch.setattr(osd_daemon.os, "_exit",
+                            lambda code: exits.append(code))
+
+        async def main():
+            fake = self._FakeOSD()
+            osd_daemon.OSD._hb_suicide(fake, "ec_device_launch")
+            await asyncio.sleep(0.05)
+            assert exits == [134]  # 128+SIGABRT, reference abort parity
+            exits.clear()
+            inproc = self._FakeOSD()
+            inproc.suicide_hard_exit = False
+            osd_daemon.OSD._hb_suicide(inproc, "ec_device_launch")
+            await asyncio.sleep(0.05)
+            assert exits == []  # MiniCluster semantics: stop() only
+
+        asyncio.run(main())
+
+
 class TestLockdep:
     def test_consistent_order_ok(self, lockdep):
         async def main():

@@ -56,8 +56,17 @@ import re
 import sys
 
 # metrics measured on the SAME backend every round (bench.py's serial
-# cpu stack child), hence comparable across headline phase flips
-PHASE_AGNOSTIC_METRICS = {"stack_gbps", "raw_cpu_gbps", "stack_vs_raw"}
+# cpu stack child), hence comparable across headline phase flips.
+# stack_e2e.stack_e2e_gbps (frames + crc + striper + EC encode, one
+# whole-stack pass) is promoted alongside stack_gbps (ROADMAP 3c): it
+# rides the same cpu stack child.  Rounds predating the field simply
+# lack the metric, so the gate reports "not comparable" (exit 0) until
+# two rounds carry it — promotion can never fail a round retroactively.
+PHASE_AGNOSTIC_METRICS = {"stack_gbps", "raw_cpu_gbps", "stack_vs_raw",
+                          "stack_e2e.stack_e2e_gbps"}
+
+# convenience spellings -> the dotted path inside the final line
+METRIC_ALIASES = {"stack_e2e_gbps": "stack_e2e.stack_e2e_gbps"}
 
 
 def load_rounds(bench_dir: str) -> list[dict]:
@@ -106,6 +115,7 @@ def compare(rounds: list[dict], metric: str = "value",
     Returns a report dict with ``regression`` True/False;
     ``comparable`` False when there is no earlier same-phase round to
     judge against (first round of a phase, or a phase flip)."""
+    metric = METRIC_ALIASES.get(metric, metric)
     if not rounds:
         return {"comparable": False, "reason": "no bench records"}
     newest = rounds[-1]
@@ -177,8 +187,9 @@ def main(argv: list[str] | None = None) -> int:
                     help="how many newest rounds to consider")
     ap.add_argument("--metric", default="value",
                     help="final-line key to compare; dotted paths reach "
-                         "nested records, e.g. qos.protection "
-                         "(default: value)")
+                         "nested records, e.g. qos.protection or "
+                         "stack_e2e.stack_e2e_gbps (alias: "
+                         "stack_e2e_gbps) (default: value)")
     ap.add_argument("--threshold", type=float, default=0.5,
                     help="fail when newest < threshold x prior best "
                          "(0.5 = a 2x drop fails)")

@@ -1,0 +1,133 @@
+#!/usr/bin/env python3
+"""Static swallowed-exception gate over the EC hot-path modules (CI).
+
+The accelerator fault domain (osd/ec_failover) depends on every device
+error reaching the failure classifier: a bare ``except Exception:
+pass`` in the dispatch path would eat a device-lost error exactly where
+the breaker needed to see it, and the engine would keep "serving" a
+dead device.  This gate keeps that class of bug out statically — the
+same role tools/check_counters.py plays for counter keys and
+tools/check_copies.py for payload copies.
+
+Checked, in the EC fault-domain modules only: every ``except`` handler
+must do at least one of
+
+- **re-raise** — a ``raise`` anywhere in the handler body (bare or
+  chained), including handlers that only narrow and re-throw;
+- **route through the failure classifier** — call something named
+  ``classify_engine_error``/``classify*`` or a supervisor transition
+  (``record_failure``/``record_timeout``), or resolve the error onto
+  waiter futures via ``set_exception`` (surfacing IS routing: the
+  caller sees the error);
+- **carry an annotation** — ``# swallow-ok: <reason>`` on the
+  ``except`` line or the line above.  An annotation with no reason
+  text fails: the allowlist must say WHY each swallow is safe.
+
+Scope (the device-error path end to end):
+    ceph_tpu/osd/ec_dispatch.py
+    ceph_tpu/osd/ec_util.py
+    ceph_tpu/osd/ec_failover.py
+
+Usage: ``python tools/check_faults.py [repo_root]`` — exits 0 when
+clean, 1 with a per-site report otherwise.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+
+HOT_PATHS = (
+    "ceph_tpu/osd/ec_dispatch.py",
+    "ceph_tpu/osd/ec_util.py",
+    "ceph_tpu/osd/ec_failover.py",
+)
+
+ANNOTATION = "# swallow-ok:"
+
+# call names that count as routing the error through the fault domain
+_CLASSIFIER_CALLS = ("classify", "record_failure", "record_timeout",
+                     "set_exception")
+
+
+def _hot_files(root: pathlib.Path) -> list[pathlib.Path]:
+    return [root / rel for rel in HOT_PATHS if (root / rel).exists()]
+
+
+def _annotated(lines: list[str], lineno: int) -> str | None:
+    """The swallow-ok reason on the ``except`` line or the line above,
+    or None.  Empty reasons do not count."""
+    for ln in (lineno - 1, lineno):
+        if 1 <= ln <= len(lines):
+            text = lines[ln - 1]
+            i = text.find(ANNOTATION)
+            if i >= 0:
+                reason = text[i + len(ANNOTATION):].strip()
+                return reason or None
+    return None
+
+
+def _routes_or_raises(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else ""
+            )
+            if any(marker in name for marker in _CLASSIFIER_CALLS):
+                return True
+    return False
+
+
+def check(root: pathlib.Path) -> list[str]:
+    problems: list[str] = []
+    for path in _hot_files(root):
+        try:
+            src = path.read_text()
+            tree = ast.parse(src)
+        except (OSError, SyntaxError) as e:
+            problems.append(f"{path}: unparseable: {e}")
+            continue
+        lines = src.splitlines()
+        rel = path.relative_to(root)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if _routes_or_raises(node):
+                continue
+            # the annotation may sit on the except line itself, or on
+            # the line directly above it
+            if _annotated(lines, node.lineno) is not None:
+                continue
+            what = (ast.unparse(node.type)
+                    if node.type is not None else "bare")
+            problems.append(
+                f"{rel}:{node.lineno}: except {what} swallows in an EC "
+                f"hot path — re-raise, route it through the failure "
+                f"classifier (classify_engine_error / record_failure / "
+                f"set_exception), or annotate the line "
+                f"'# swallow-ok: <why this swallow is safe>'"
+            )
+    return problems
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    root = pathlib.Path(args[0]) if args else \
+        pathlib.Path(__file__).resolve().parent.parent
+    problems = check(root)
+    if problems:
+        print(f"check_faults: {len(problems)} unrouted except site(s):")
+        for p in problems:
+            print(f"  {p}")
+        return 1
+    print(f"check_faults: clean ({len(_hot_files(root))} EC hot-path "
+          "files)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
