@@ -1108,6 +1108,180 @@ def bench_smallops(deadline: float | None, platform: str | None) -> dict:
     }
 
 
+def bench_mesh(deadline: float | None, platform: str | None) -> dict:
+    """Multi-chip EC scaling (ISSUE 8 / ROADMAP 1): encode and ICI
+    all-gather reconstruct GB/s vs chip count through the mesh engine,
+    reported as per-chip scaling efficiency — raw speed x scale, the
+    paper's headline multiplier.  Also proves the mesh lane's
+    anti-compile-storm gate (a 50-way size sweep through the dispatcher
+    costs at most #buckets x #mesh-slices compiles) and splits the ICI
+    gather cost out of the reconstruct number via the KernelProfiler's
+    ``mesh_gather`` engine.
+
+    On a single-device backend the phase still lands (n_devices=1,
+    scaling trivially flat) so the round JSON never loses the record;
+    cpu children force an 8-way virtual mesh (combo_main sets
+    ``--xla_force_host_platform_device_count``), which measures the
+    sharding topology and program cache, not HBM bandwidth — the
+    efficiency numbers only mean hardware on a real multi-chip slice.
+    """
+    import asyncio
+
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    devs = jax.devices()
+    from ceph_tpu.models import registry
+    from ceph_tpu.osd import ec_util
+    from ceph_tpu.osd.ec_dispatch import (
+        ECDispatcher, bucket_stripes_aligned,
+    )
+    from ceph_tpu.parallel.engine import MeshEcEngine
+
+    prof = _kprof()
+    prof.reset()
+    codec = registry.instance().factory(
+        "isa", {"plugin": "isa", "technique": "reed_sol_van",
+                "k": str(K), "m": str(M)},
+    )
+    chunk = codec.get_chunk_size(OBJECT_SIZE)  # 128 KiB
+    sinfo = ec_util.StripeInfo(stripe_width=chunk * K, chunk_size=chunk)
+    stripes = 64  # 64 MiB logical per pass, the headline batch
+    cpu_like = (platform or "") == "cpu" or "cpu" in str(devs[0]).lower()
+    if cpu_like or (deadline is not None
+                    and deadline - time.time() < 90):
+        stripes = 8  # 8 MiB: virtual-device hosts measure topology
+    rng = np.random.default_rng(11)
+    buf = rng.integers(
+        0, 256, size=(stripes * sinfo.stripe_width,), dtype=np.uint8
+    )
+    full = ec_util.encode(sinfo, codec, buf)
+    surv = {s: np.asarray(v) for s, v in full.items()
+            if s != ERASED[0]}  # single-chunk reconstruct, config 2
+    counts = []
+    c = 1
+    while c <= len(devs):
+        counts.append(c)
+        c *= 2
+    if counts[-1] != len(devs):
+        counts.append(len(devs))
+    log(f"mesh: {len(devs)} devices, sweep {counts}, "
+        f"{buf.size >> 20} MiB batch")
+    ms = 0.3
+    scaling = []
+    eng = None
+    t_rec = None
+    for n in counts:
+        if scaling and deadline is not None \
+                and deadline - time.time() < 15:
+            log(f"mesh: deadline close, kept {len(scaling)} counts")
+            break
+        eng = MeshEcEngine(devices=devs[:n])
+        pg, shard = eng.mesh_key(K)
+        t_enc = bench_loop(lambda: eng.encode(sinfo, codec, buf),
+                           min_seconds=ms, deadline=deadline)
+        t_rec = bench_loop(
+            lambda: eng.decode_concat(sinfo, codec, surv),
+            min_seconds=ms, deadline=deadline,
+        )
+        scaling.append({
+            "devices": n, "pg": pg, "shard": shard,
+            "encode_gbps": round(buf.size / t_enc / 1e9, 3),
+            "reconstruct_gbps": round(buf.size / t_rec / 1e9, 3),
+        })
+        log(f"mesh: {n} chip(s) (pg={pg} shard={shard}) encode "
+            f"{scaling[-1]['encode_gbps']:.2f} reconstruct "
+            f"{scaling[-1]['reconstruct_gbps']:.2f} GB/s")
+    base, top = scaling[0], scaling[-1]
+    n_top = top["devices"]
+    enc_eff = (
+        top["encode_gbps"] / base["encode_gbps"] / n_top
+        if base["encode_gbps"] > 0 else 0.0
+    )
+    rec_eff = (
+        top["reconstruct_gbps"] / base["reconstruct_gbps"] / n_top
+        if base["reconstruct_gbps"] > 0 else 0.0
+    )
+    # ICI-gather cost split: the reconstruct's all-gather ALONE at the
+    # top mesh's survivor geometry (profiled as mesh_gather too)
+    gather: dict = {}
+    try:
+        n_dev = len(eng.devices)
+        L = stripes * sinfo.chunk_size
+        quantum = 4 * n_dev
+        L_p = eng._bucket(max(L, quantum), quantum)
+        t_gather = bench_loop(lambda: eng.probe_gather(K, L_p),
+                              min_seconds=ms, deadline=deadline)
+        gather = {
+            "seconds": round(t_gather, 6),
+            "gbps": round(K * L_p / t_gather / 1e9, 3),
+            "share_of_reconstruct": round(t_gather / t_rec, 3)
+            if t_rec else None,
+        }
+    except Exception as e:
+        log(f"mesh: gather probe failed: {e!r}")
+    # the anti-compile-storm gate ON THE MESH LANE: 50 distinct sizes
+    # through the dispatcher cost at most #buckets x #mesh-slices
+    # compiles (one codec+geometry here -> one mesh slice)
+    storm: dict = {"skipped": True}
+    if deadline is None or deadline - time.time() > 20:
+        small = ec_util.StripeInfo(stripe_width=64 * K, chunk_size=64)
+        sizes = list(range(1, 51))
+        small_bufs = [
+            rng.integers(0, 256, size=(s * small.stripe_width,),
+                         dtype=np.uint8)
+            for s in sizes
+        ]
+
+        def _mesh_misses() -> int:
+            e = prof.dump().get("engines", {}).get("mesh_encode")
+            return e["jit_cache"]["misses"] if e else 0
+
+        before = _mesh_misses()
+        sweep_eng = eng
+
+        async def _sweep():
+            disp = ECDispatcher(window=0.0, max_stripes=1 << 20,
+                                mesh_engine=sweep_eng)
+            for b in small_bufs:
+                await disp.encode(small, codec, b)
+            st = disp.dump()
+            await disp.stop()
+            return st
+
+        st = asyncio.run(_sweep())
+        bound = len({
+            bucket_stripes_aligned(s, n_top, True) for s in sizes
+        })
+        compiles = _mesh_misses() - before
+        storm = {
+            "sizes": len(sizes), "compiles": compiles,
+            "bound": bound, "mesh_slices": 1,
+            "ok": 0 < compiles <= bound,
+            "mesh_buckets": st["mesh_buckets"],
+        }
+        log(f"mesh: compile storm {compiles} compiles for "
+            f"{len(sizes)} sizes (bound {bound})")
+    return {
+        "platform": str(devs[0]),
+        "n_devices": len(devs),
+        "batch_bytes": int(buf.size),
+        "codec": f"isa reed_sol_van k{K} m{M}",
+        "scaling": scaling,
+        "scaling_efficiency": round(enc_eff, 3),
+        "reconstruct_scaling_efficiency": round(rec_eff, 3),
+        "mesh_vs_single_chip": round(
+            top["encode_gbps"] / base["encode_gbps"], 3
+        ) if base["encode_gbps"] > 0 else None,
+        "encode_gbps": top["encode_gbps"],
+        "reconstruct_gbps": top["reconstruct_gbps"],
+        **({"gather": gather} if gather else {}),
+        "compile_storm": storm,
+        "kernel_profile": prof.dump(prefix="mesh"),
+    }
+
+
 def bench_qos(deadline: float | None = None) -> dict:
     """QoS starvation gate: client op wait p50/p99 through the OSD's
     dmClock scheduler under a saturating synthetic recovery storm —
@@ -1517,6 +1691,16 @@ def combo_main(args) -> None:
             f"{live.get('relay')}")
         print(json.dumps({"kind": "liveness", **live}), flush=True)
         return
+    if args.platform == "cpu":
+        # the mesh phase needs chips: give cpu children an 8-way
+        # virtual mesh (the flag only affects the HOST platform and
+        # must land before the first backend instantiation; real-TPU
+        # combos never reach here, their devices are real)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
     import jax
 
     if args.platform:
@@ -1555,6 +1739,15 @@ def combo_main(args) -> None:
             print(json.dumps({"kind": "smallops", **res}), flush=True)
         except Exception as e:
             log(f"combo child: smallops failed: {e!r}")
+    if "mesh" not in skip and deadline - time.time() > 25:
+        # multi-chip scaling (ISSUE 8): right after smallops — it is
+        # the scale gate metric (mesh.scaling_efficiency) and must not
+        # starve behind the grid sweep on a tight budget
+        try:
+            res = bench_mesh(sub_deadline(0.6), args.platform)
+            print(json.dumps({"kind": "mesh", **res}), flush=True)
+        except Exception as e:
+            log(f"combo child: mesh failed: {e!r}")
     if "grid" not in skip and deadline - time.time() > 30:
         try:
             res = bench_grid(args.quick, sub_deadline(0.75), args.platform)
@@ -1916,6 +2109,19 @@ def main():
                         "dispatch",
                     ) if k in r["smallops"]
                 }
+            if "mesh" not in final and r.get("mesh", {}).get("scaling"):
+                # the multi-chip scaling record (ISSUE 8): per-chip
+                # efficiency rides the round JSON so bench_regress can
+                # gate mesh.scaling_efficiency across rounds
+                final["mesh"] = {
+                    k: r["mesh"][k] for k in (
+                        "platform", "n_devices", "batch_bytes", "codec",
+                        "scaling", "scaling_efficiency",
+                        "reconstruct_scaling_efficiency",
+                        "mesh_vs_single_chip", "encode_gbps",
+                        "reconstruct_gbps", "gather", "compile_storm",
+                    ) if k in r["mesh"]
+                }
             if "stack_gbps" not in final and (
                 r.get("headline", {}).get("stack_gbps")
             ):
@@ -2042,6 +2248,7 @@ def main():
                 for v in r.get("crush", {}).values()
             )
             and "coalesced_gbps" in r.get("smallops", {})
+            and bool(r.get("mesh", {}).get("scaling"))
         )
 
     def _cpu_batch(remaining: float) -> int:
@@ -2133,6 +2340,8 @@ def main():
                     skip.add("crush")
                 if "coalesced_gbps" in tpu_r.get("smallops", {}):
                     skip.add("smallops")
+                if tpu_r.get("mesh", {}).get("scaling"):
+                    skip.add("mesh")
                 timeout = max(40.0, remaining - reserve - 10)
                 if more_headline:
                     skip.discard("headline")

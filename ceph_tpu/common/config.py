@@ -182,11 +182,21 @@ OPTIONS: dict[str, Option] = _opts(
            "route EC encode/reconstruct through the device-mesh engine "
            "(k+m shard rows on mesh rows, ICI all-gather reconstruct; "
            "the messenger keeps carrying control traffic) — "
-           "ceph_tpu.parallel.engine"),
+           "ceph_tpu.parallel.engine.  With osd_ec_dispatch on the "
+           "mesh is a dispatcher LANE: coalescing, QoS pacing, the "
+           "launch deadline, and engine failover all govern mesh "
+           "traffic; batch keys carry the mesh slice and stripe "
+           "bucketing aligns to mesh_size x bucket"),
+    Option("osd_ec_mesh_devices", int, 0,
+           "devices in the EC mesh slice (0 = every device jax "
+           "exposes); a nonzero value pins the mesh to the first N "
+           "devices — bench.py's mesh phase sweeps this dimension for "
+           "per-chip scaling efficiency"),
     Option("osd_ec_dispatch", bool, True,
            "coalesce concurrent EC encode/decode requests into one "
            "padded device launch off the event loop "
-           "(ceph_tpu.osd.ec_dispatch; the osd_ec_mesh path bypasses)"),
+           "(ceph_tpu.osd.ec_dispatch); with osd_ec_mesh on, mesh "
+           "launches ride the same dispatcher as a first-class lane"),
     Option("osd_ec_dispatch_window", float, 0.0005,
            "EC dispatcher coalescing window (s): a batch flushes this "
            "long after its first request unless the stripe threshold "

@@ -57,6 +57,14 @@ from .map import (
 _SEED = 1315423911  # CRUSH_HASH_SEED
 _S64_MIN_PY = -(1 << 63)
 
+# version-portable scoped-x64 context: new jax exposes jax.enable_x64,
+# 0.4.x ships it as jax.experimental.enable_x64 (same semantics) — the
+# same API skew the mesh engine's shard_map shim handles
+if hasattr(jax, "enable_x64"):
+    _enable_x64 = jax.enable_x64
+else:
+    from jax.experimental import enable_x64 as _enable_x64
+
 
 @functools.lru_cache(maxsize=1)
 def _ln_tables_dev():
@@ -67,7 +75,7 @@ def _ln_tables_dev():
     behavior of unrelated JAX code in the process (advisor r1 finding) —
     so x64 is scoped to the exact kernels instead, and the hot approx
     path stays 32-bit/f32 and needs no x64 at all."""
-    with jax.enable_x64():
+    with _enable_x64():
         return (
             jnp.asarray(np.array(ln_tables.RH_LH_TBL, dtype=np.int64)),
             jnp.asarray(np.array(ln_tables.LL_TBL, dtype=np.int64)),
@@ -143,7 +151,7 @@ def crush_ln(xin):
     ``xin`` int64 lanes in [0, 0xffff].  Runs under a scoped x64 context
     (signed-64 fixed point); the hot approx path never calls this.
     """
-    with jax.enable_x64():
+    with _enable_x64():
         rh_lh, ll = _ln_tables_dev()
         x = jnp.asarray(xin, jnp.int64) + 1  # 1..0x10000
         norm = (x & 0x18000) == 0
@@ -173,7 +181,7 @@ def straw2_choose(x, items, weights, r):
     """
     n = items.shape[0]
 
-    with jax.enable_x64():
+    with _enable_x64():
         s64_min = jnp.int64(_S64_MIN_PY)
 
         def draw_for(i):

@@ -185,11 +185,17 @@ class KernelProfiler:
             return out if wrap is None else wrap(out)
 
     # -- views ---------------------------------------------------------------
-    def dump(self) -> dict:
-        """JSON-able per-engine breakdown (``dump_kernel_profile``)."""
+    def dump(self, prefix: str | None = None) -> dict:
+        """JSON-able per-engine breakdown (``dump_kernel_profile``).
+        ``prefix`` filters to one engine family — bench.py's mesh phase
+        embeds ``dump(prefix="mesh")`` so the mesh shard_map programs
+        (mesh_encode / mesh_reconstruct / mesh_gather) read distinctly
+        from the single-chip kernel entries."""
         with self._lock:
             engines = {}
             for name, st in sorted(self._engines.items()):
+                if prefix is not None and not name.startswith(prefix):
+                    continue
                 engines[name] = {
                     "calls": st.calls,
                     "jit_cache": {

@@ -263,6 +263,13 @@ class OSD(Dispatcher):
                         "encodes dispatched to the device-mesh engine")
         pec.add_counter("mesh_decode_calls",
                         "reconstructs via the mesh all-gather path")
+        # the mesh dispatcher lane (ISSUE 8): launch/geometry evidence
+        # for the multi-chip route, distinct from the per-op calls
+        pec.add_counter("mesh_batches",
+                        "coalesced launches served by the mesh lane")
+        pec.add_gauge("mesh_devices",
+                      "devices in the EC mesh slice (pg x shard) as "
+                      "seen by the last mesh-lane launch")
         # per-engine codec throughput (the number bench.py and
         # TPU_EVIDENCE track): last-call GB/s gauges + wall-time avgs
         pec.add_gauge("encode_gbps", "host-path encode GB/s (last call)")
@@ -305,6 +312,41 @@ class OSD(Dispatcher):
         pec.add_histogram(
             "dispatch_batch_size_histogram",
             "requests coalesced per device launch",
+            axes=[PerfHistogramAxis("ops", min=1.0, buckets=12)],
+        )
+        # per-lane split of the dispatcher evidence (ISSUE 8
+        # satellite): pad waste / occupancy / batch sizes attributable
+        # per route (native-direct has its own counter above — no
+        # batching there, so no occupancy/pad series)
+        pec.add_counter("dispatch_batches_device",
+                        "coalesced launches on the single-device lane")
+        pec.add_counter("dispatch_batches_mesh",
+                        "coalesced launches on the mesh lane")
+        pec.add_counter("dispatch_ops_device",
+                        "requests served by single-device launches")
+        pec.add_counter("dispatch_ops_mesh",
+                        "requests served by mesh-lane launches")
+        pec.add_counter("dispatch_pad_stripes_device",
+                        "bucket pad stripes on the single-device lane")
+        pec.add_counter("dispatch_pad_stripes_mesh",
+                        "mesh-alignment + bucket pad stripes on the "
+                        "mesh lane")
+        pec.add_counter("dispatch_pad_bytes_device",
+                        "single-device-lane pad waste in bytes")
+        pec.add_counter("dispatch_pad_bytes_mesh",
+                        "mesh-lane pad waste in bytes")
+        pec.add_avg("dispatch_occupancy_device",
+                    "single-device-lane batch stripes / flush threshold")
+        pec.add_avg("dispatch_occupancy_mesh",
+                    "mesh-lane batch stripes / flush threshold")
+        pec.add_histogram(
+            "dispatch_batch_size_device_histogram",
+            "requests coalesced per single-device launch",
+            axes=[PerfHistogramAxis("ops", min=1.0, buckets=12)],
+        )
+        pec.add_histogram(
+            "dispatch_batch_size_mesh_histogram",
+            "requests coalesced per mesh-lane launch",
             axes=[PerfHistogramAxis("ops", min=1.0, buckets=12)],
         )
         # accelerator fault domain (osd/ec_failover): the engine_state
@@ -400,18 +442,24 @@ class OSD(Dispatcher):
             perf=pqos,
         )
         # the mesh EC data path (osd_ec_mesh): shard rows on mesh rows,
-        # ICI all-gather reconstruct; None = host/TCP-only path
+        # ICI all-gather reconstruct; None = host/TCP-only path.  With
+        # the dispatcher on (default) the mesh is a DISPATCHER LANE —
+        # coalescing, QoS pacing, launch deadlines, and failover all
+        # apply to mesh traffic (ISSUE 8); only the dispatcher-off
+        # config keeps the old direct per-op route
         self.ec_mesh = None
         if getattr(cfg, "osd_ec_mesh", False):
             from ..parallel.engine import get_mesh_engine
 
-            self.ec_mesh = get_mesh_engine()
-        # cross-op EC microbatch dispatcher (default on; the mesh engine
-        # path bypasses it — the mesh owns its own device schedule),
-        # plus the engine health supervisor (osd/ec_failover): fatal
-        # launch failures replay on the host fallback and trip the
-        # breaker; while tripped, the QoS scheduler treats capacity as
-        # degraded and ec_background pacing squeezes to reservation
+            self.ec_mesh = get_mesh_engine(
+                getattr(cfg, "osd_ec_mesh_devices", 0)
+            )
+        # cross-op EC microbatch dispatcher (default on), plus the
+        # engine health supervisor (osd/ec_failover): fatal launch
+        # failures — on the single-device AND mesh lanes — replay on
+        # the host fallback and trip the breaker; while tripped, the
+        # QoS scheduler treats capacity as degraded and ec_background
+        # pacing squeezes to reservation
         self.ec_dispatch = None
         self.ec_supervisor = None
         if getattr(cfg, "osd_ec_dispatch", True):
@@ -438,6 +486,7 @@ class OSD(Dispatcher):
                 scheduler=self.scheduler,
                 supervisor=self.ec_supervisor,
                 launch_deadline=cfg.osd_ec_launch_deadline,
+                mesh_engine=self.ec_mesh,
             )
             self.ec_dispatch.inject_engine_failure = \
                 cfg.ec_inject_engine_failure
@@ -1950,26 +1999,35 @@ class OSD(Dispatcher):
     async def _ec_encode_bufs(self, sinfo, codec, buf, *,
                               klass: str = "client",
                               ) -> dict[int, np.ndarray]:
-        """Encode router (VERDICT r4 #2): with ``osd_ec_mesh`` on and a
-        matrix codec, the k+m shard rows are computed BY the mesh (shard
-        rows on mesh rows, reference:src/osd/ECBackend.cc:1902-1926 as
-        device placement); otherwise the host path — through the cross-op
-        microbatch dispatcher when ``osd_ec_dispatch`` is on (coalesced
-        launches in a worker thread, so heartbeat/messenger/op-tracker
-        tasks are never frozen behind a device call), else inline
-        ec_util.  Bytes are identical on every route (pinned by
-        tests/test_mesh_datapath.py and tests/test_ec_dispatch.py)."""
-        mesh = self.ec_mesh is not None and self.ec_mesh.supports(codec)
-        dispatched = not mesh and self.ec_dispatch is not None
+        """Encode router (VERDICT r4 #2, ISSUE 8): with
+        ``osd_ec_dispatch`` on, everything goes through the cross-op
+        microbatch dispatcher (coalesced launches in a worker thread,
+        so heartbeat/messenger/op-tracker tasks are never frozen
+        behind a device call) — with ``osd_ec_mesh`` also on, matrix
+        codecs take its MESH LANE, where the k+m shard rows are
+        computed BY the mesh (shard rows on mesh rows,
+        reference:src/osd/ECBackend.cc:1902-1926 as device placement).
+        Dispatcher off keeps the old direct routes (mesh per-op, else
+        inline ec_util).  Bytes are identical on every route (pinned
+        by tests/test_mesh_datapath.py, tests/test_mesh_dispatch.py
+        and tests/test_ec_dispatch.py)."""
+        dispatched = self.ec_dispatch is not None
+        # with the dispatcher on, the mesh is one of ITS lanes (ISSUE
+        # 8): coalescing/QoS/deadline/failover apply to mesh traffic;
+        # the direct route survives only for osd_ec_dispatch=false
+        mesh = (
+            self.ec_dispatch.mesh_route(sinfo, codec) if dispatched
+            else self.ec_mesh is not None and self.ec_mesh.supports(codec)
+        )
         with self._ec_timed("encode", len(buf), mesh,
                             account=not dispatched):
-            if mesh:
-                self.perf.get("ec").inc("mesh_encode_calls")
-                return self.ec_mesh.encode(sinfo, codec, buf)
             if dispatched:
                 return await self.ec_dispatch.encode(
                     sinfo, codec, buf, klass=klass
                 )
+            if mesh:
+                self.perf.get("ec").inc("mesh_encode_calls")
+                return self.ec_mesh.encode(sinfo, codec, buf)
             return ec_util.encode(sinfo, codec, buf)
 
     async def _ec_decode_concat(self, sinfo, codec, chunks, *,
@@ -1979,22 +2037,25 @@ class OSD(Dispatcher):
         collective) when the engine applies; host decodes ride the
         microbatch dispatcher like encodes."""
         k = codec.get_data_chunk_count()
+        missing = any(r not in chunks for r in range(k))
+        dispatched = self.ec_dispatch is not None
         mesh = (
-            self.ec_mesh is not None
-            and self.ec_mesh.supports(codec)
-            and any(r not in chunks for r in range(k))
+            self.ec_dispatch.mesh_route(sinfo, codec, missing=missing)
+            if dispatched
+            else (self.ec_mesh is not None
+                  and self.ec_mesh.supports(codec)
+                  and missing)
         )
         nbytes = sum(int(c.size) for c in chunks.values())
-        dispatched = not mesh and self.ec_dispatch is not None
         with self._ec_timed("decode", nbytes, mesh,
                             account=not dispatched):
-            if mesh:
-                self.perf.get("ec").inc("mesh_decode_calls")
-                return self.ec_mesh.decode_concat(sinfo, codec, chunks)
             if dispatched:
                 return await self.ec_dispatch.decode_concat(
                     sinfo, codec, chunks, klass=klass
                 )
+            if mesh:
+                self.perf.get("ec").inc("mesh_decode_calls")
+                return self.ec_mesh.decode_concat(sinfo, codec, chunks)
             return ec_util.decode_concat(sinfo, codec, chunks)
 
     async def _ec_mutate_execute(

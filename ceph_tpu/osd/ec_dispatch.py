@@ -44,7 +44,25 @@ a slow one.  The gates are ec_util's shared
 conditions the encode/decode stacks route on, so the lanes cannot
 drift.
 
-A fourth mechanism rides on top (the accelerator fault domain,
+A fourth mechanism is the **mesh lane** (ISSUE 8 — the multi-chip
+engine as a first-class dispatcher lane, not a bypass):
+
+- with ``osd_ec_mesh`` on and a matrix codec, coalesced batches route
+  to :class:`~ceph_tpu.parallel.engine.MeshEcEngine` — stripes shard
+  over the device mesh (``NamedSharding``/``shard_map``), the k+m
+  output rows lay across the ``shard`` axis, and reconstructs enter
+  survivor-sharded and all-gather over ICI.  Batch keys grow a
+  mesh-slice dimension ``(pg, shard)``, and the stripe bucketing
+  aligns to ``mesh_size x bucket`` (:func:`bucket_stripes_aligned`),
+  so shards stay balanced and the jit cache stays
+  O(#buckets x #mesh-slices) — the anti-compile-storm gate holds on
+  the mesh lane too.  The lane inherits ALL the machinery below: QoS
+  classes never share a mesh batch, ``osd_ec_launch_deadline`` bounds
+  mesh launches, and a fatal mesh failure (a chip in the slice dying
+  included) replays bit-identically on the host fallback via the same
+  classifier and supervisor.
+
+A fifth mechanism rides on top (the accelerator fault domain,
 osd/ec_failover):
 
 - **engine failover** — a batched device launch that fails with a
@@ -100,6 +118,19 @@ def bucket_stripes(s: int) -> int:
     return 1 << max(0, (int(s) - 1).bit_length())
 
 
+def bucket_stripes_aligned(s: int, quantum: int = 1,
+                           bucket: bool = True) -> int:
+    """Mesh-lane bucketing: round ``s`` up to ``quantum * 2^j`` (the
+    mesh size times a power-of-two bucket), so every chip gets the same
+    stripe count AND the jit cache stays O(log max_S) per mesh slice.
+    With ``bucket=False`` only the mesh alignment is applied (shards
+    must stay balanced even when the operator disables bucketing)."""
+    units = max(1, -(-int(s) // int(quantum)))
+    if bucket:
+        units = bucket_stripes(units)
+    return int(quantum) * units
+
+
 class _Op:
     """One queued waiter: its payload and the future its op awaits."""
 
@@ -114,15 +145,19 @@ class _Op:
 class _Batch:
     """One still-collecting batch for a queue key."""
 
-    __slots__ = ("kind", "codec", "sinfo", "ops", "stripes", "timer")
+    __slots__ = ("kind", "codec", "sinfo", "ops", "stripes", "timer",
+                 "lane", "quantum")
 
-    def __init__(self, kind: str, codec, sinfo: ec_util.StripeInfo):
+    def __init__(self, kind: str, codec, sinfo: ec_util.StripeInfo,
+                 lane: str = "device", quantum: int = 1):
         self.kind = kind  # "enc" | "dec"
         self.codec = codec
         self.sinfo = sinfo
         self.ops: list[_Op] = []
         self.stripes = 0
         self.timer: asyncio.TimerHandle | None = None
+        self.lane = lane  # "device" | "mesh"
+        self.quantum = int(quantum)  # stripe-alignment (mesh size)
 
 
 class ECDispatcher:
@@ -137,8 +172,14 @@ class ECDispatcher:
                  max_stripes: int = 512, bucket: bool = True,
                  max_workers: int = 2, scheduler=None,
                  supervisor=None, launch_deadline: float = 0.0,
-                 hb_handle=None):
+                 hb_handle=None, mesh_engine=None):
         self._perf = perf
+        # the multi-chip mesh lane (parallel/engine.MeshEcEngine; None
+        # = single-device only).  supports()/routes() never touch the
+        # device; the first mesh-lane submit resolves jax.devices()
+        # lazily via mesh_key (the same first-touch the old bypass
+        # route paid on the event loop)
+        self._mesh = mesh_engine
         # the OSD's QoS scheduler (osd/scheduler.py; None standalone):
         # BACKGROUND stripes (klass != "client") pace through it before
         # entering a batch window, so client stripes preempt recovery
@@ -191,8 +232,19 @@ class ECDispatcher:
             "failovers": 0, "replayed_ops": 0, "fallback_direct": 0,
             "deadline_timeouts": 0,
             "flush": {"size": 0, "window": 0, "stop": 0},
+            # per-route slice of the above (satellite: pad waste and
+            # batch sizes attributable per lane)
+            "lanes": {
+                lane: {"batches": 0, "ops": 0, "stripes": 0,
+                       "pad_stripes": 0, "pad_bytes": 0}
+                for lane in ("device", "mesh")
+            },
         }
-        self._buckets_seen: dict[int, int] = {}  # padded S -> launches
+        # padded S -> launches, per lane (O(log max_S) rows per lane
+        # by construction; the mesh lane's rows are mesh_size-aligned)
+        self._buckets_seen: dict[str, dict[int, int]] = {
+            "device": {}, "mesh": {},
+        }
 
     # -- public API ----------------------------------------------------------
 
@@ -224,24 +276,37 @@ class ECDispatcher:
             # open a batch nobody will ever flush (and the executor
             # would refuse the launch)
             return self._inline_encode_fn()(sinfo, codec, buf)
-        if ec_util.native_encode_path(sinfo, codec):
+        # lane selection: the mesh (an explicit operator opt-in via
+        # osd_ec_mesh) outranks the native C engine, exactly as the old
+        # router ordered its routes; the native lane outranks the
+        # single-device jax lane on CPU hosts as before
+        lane = "mesh" if (
+            self._mesh is not None and self._mesh.routes(sinfo, codec)
+        ) else "device"
+        if lane != "mesh" and ec_util.native_encode_path(sinfo, codec):
             # no launch/compile overhead to amortize on the C engine —
             # keep per-op (cache-resident) calls, just off the loop
             return await self._run_native_direct(
                 ec_util.encode, sinfo, codec, buf, "encode", buf.size
             )
         if self._supervisor is not None and not self._supervisor.device_ok():
-            # breaker TRIPPED/PROBING: the device engine is out of the
-            # data path — serve from the host fallback (still off the
-            # loop; the canary is the only device traffic until the
-            # supervisor re-promotes)
+            # breaker TRIPPED/PROBING: the device engine — mesh slice
+            # included, it is the same accelerator fault domain — is
+            # out of the data path; serve from the host fallback (still
+            # off the loop; the canary is the only device traffic until
+            # the supervisor re-promotes)
             return await self._run_fallback_direct(
                 ec_util.encode_fallback, sinfo, codec, buf,
                 "encode", buf.size,
             )
-        key = ("enc", klass, id(codec), sinfo.stripe_width,
-               sinfo.chunk_size)
-        return await self._submit(key, "enc", codec, sinfo, buf, stripes)
+        mesh_slice = (
+            self._mesh.mesh_key(codec.get_data_chunk_count())
+            if lane == "mesh" else None
+        )
+        key = ("enc", lane, mesh_slice, klass, id(codec),
+               sinfo.stripe_width, sinfo.chunk_size)
+        return await self._submit(key, "enc", codec, sinfo, buf, stripes,
+                                  lane=lane, mesh_slice=mesh_slice)
 
     async def decode_concat(
         self, sinfo: ec_util.StripeInfo, codec,
@@ -268,7 +333,16 @@ class ECDispatcher:
         if self._stopping:
             # see encode(): stop() may have won the race while pacing
             return self._inline_decode_fn()(sinfo, codec, arrs)
-        if ec_util.native_decode_path(codec, shard_len):
+        # the mesh lane only earns its keep when rows are MISSING (the
+        # ICI all-gather reconstruct); a plain concat read stays on the
+        # device/native lanes — the same gate the old router applied
+        k = codec.get_data_chunk_count()
+        lane = "mesh" if (
+            self._mesh is not None
+            and self._mesh.routes(sinfo, codec)
+            and any(r not in arrs for r in range(k))
+        ) else "device"
+        if lane != "mesh" and ec_util.native_decode_path(codec, shard_len):
             return await self._run_native_direct(
                 ec_util.decode_concat, sinfo, codec, arrs, "decode",
                 shard_len * len(arrs),
@@ -279,9 +353,11 @@ class ECDispatcher:
                 "decode", shard_len * len(arrs),
             )
         present = tuple(sorted(arrs))
-        key = ("dec", klass, id(codec), sinfo.stripe_width,
-               sinfo.chunk_size, present)
-        return await self._submit(key, "dec", codec, sinfo, arrs, stripes)
+        mesh_slice = self._mesh.mesh_key(k) if lane == "mesh" else None
+        key = ("dec", lane, mesh_slice, klass, id(codec),
+               sinfo.stripe_width, sinfo.chunk_size, present)
+        return await self._submit(key, "dec", codec, sinfo, arrs, stripes,
+                                  lane=lane, mesh_slice=mesh_slice)
 
     def _inline_encode_fn(self):
         """Engine for the inline per-op lanes (empty payload, shutdown
@@ -298,6 +374,23 @@ class ECDispatcher:
         if self._supervisor is not None and not self._supervisor.device_ok():
             return ec_util.decode_concat_fallback
         return ec_util.decode_concat
+
+    def mesh_route(self, sinfo, codec, *, missing: bool = True) -> bool:
+        """Would a request for this (geometry, codec) take the mesh
+        lane?  The OSD router tags its trace spans with this — ONE
+        gate, so the span's engine label cannot drift from the actual
+        route.  ``missing=False`` marks a decode whose wanted rows are
+        all present (no reconstruct — the mesh does not apply).  A
+        TRIPPED/PROBING breaker answers False too: those requests are
+        served by the host fallback, and the span must say so —
+        especially during the incident the label exists for."""
+        return (
+            self._mesh is not None
+            and missing
+            and self._mesh.routes(sinfo, codec)
+            and (self._supervisor is None
+                 or self._supervisor.device_ok())
+        )
 
     async def _qos_pace(self, klass: str, stripes: int) -> None:
         """Background stripes wait out the scheduler's pacing tags
@@ -364,14 +457,21 @@ class ECDispatcher:
                 }
                 for b in self._open.values()
             ],
+            "mesh_lane": self._mesh is not None,
             "totals": {
                 **{k: v for k, v in self._totals.items() if k != "flush"},
                 "flush_reasons": dict(self._totals["flush"]),
             },
-            # the observed bucketing table: padded stripe count ->
-            # launches that used it (O(log max_S) rows by construction)
+            # the observed bucketing tables: padded stripe count ->
+            # launches that used it, per lane (O(log max_S) rows each
+            # by construction; the mesh table's rows are mesh-aligned)
             "buckets": {
-                str(k): v for k, v in sorted(self._buckets_seen.items())
+                str(k): v
+                for k, v in sorted(self._buckets_seen["device"].items())
+            },
+            "mesh_buckets": {
+                str(k): v
+                for k, v in sorted(self._buckets_seen["mesh"].items())
             },
         }
 
@@ -418,7 +518,8 @@ class ECDispatcher:
                                 "fallback_direct")
 
     async def _submit(self, key: tuple, kind: str, codec, sinfo,
-                      payload, stripes: int):
+                      payload, stripes: int, *, lane: str = "device",
+                      mesh_slice: tuple | None = None):
         loop = asyncio.get_running_loop()
         b = self._open.get(key)
         if b is not None and b.ops and (
@@ -431,7 +532,15 @@ class ECDispatcher:
             self._flush(key, "size")
             b = None
         if b is None:
-            b = self._open[key] = _Batch(kind, codec, sinfo)
+            # the mesh lane's alignment quantum is the mesh size (the
+            # k+m-independent pg x shard slice the batch shards over):
+            # encode stripes split across every chip, decode bytes
+            # split across the pg axis — both need ΣS % mesh_size == 0
+            quantum = (
+                mesh_slice[0] * mesh_slice[1] if mesh_slice else 1
+            )
+            b = self._open[key] = _Batch(kind, codec, sinfo,
+                                         lane=lane, quantum=quantum)
             delay = self.window if self._last_ops > 1 else 0.0
             b.timer = loop.call_later(delay, self._flush, key, "window")
         fut = loop.create_future()
@@ -482,7 +591,8 @@ class ECDispatcher:
                 # timeout AND a fatal error
                 kind = "fatal"
             else:
-                kind = sup.record_failure(e) if sup is not None else "data"
+                kind = (sup.record_failure(e, lane=b.lane)
+                        if sup is not None else "data")
             if kind != "fatal" or sup is None or not sup.enabled:
                 # data errors always surface; fatal errors surface too
                 # when failover is off (no supervisor, or live-disabled
@@ -491,7 +601,7 @@ class ECDispatcher:
                     if not op.fut.done():
                         op.fut.set_exception(e)
                 return
-            self._last_trip = (b.kind, b.sinfo, b.codec)
+            self._last_trip = (b.kind, b.sinfo, b.codec, b.lane)
             try:
                 results, pad, seconds = await self._replay(b, ops)
             except Exception as e2:
@@ -503,13 +613,16 @@ class ECDispatcher:
                         op.fut.set_exception(e2)
                 return
             self._note_failover(b, ops, e)
+            served = "fallback"
+        else:
+            served = b.lane
         # waiters resolve FIRST: accounting (a partially-registered
         # PerfCounters, say) must never wedge the data path
         for op, res in zip(ops, results):
             if not op.fut.done():
                 op.fut.set_result(res)
         try:
-            self._note_batch(b, ops, reason, pad, seconds)
+            self._note_batch(b, ops, reason, pad, seconds, served)
         except Exception:  # swallow-ok: observability is best-effort by contract
             pass
 
@@ -649,7 +762,7 @@ class ECDispatcher:
         key = self._last_trip
         if key is None:
             return True  # never tripped via a batch: nothing to disprove
-        kind, sinfo, codec = key
+        kind, sinfo, codec, lane = key
 
         def _probe_sync() -> bool:
             self._maybe_inject()
@@ -657,18 +770,28 @@ class ECDispatcher:
                 sinfo.stripe_width, dtype=np.uint32
             ).astype(np.uint8)  # deterministic, alignment-friendly
             shards = ec_util.encode_fallback(sinfo, codec, buf)
+            # probe the LANE that tripped too: a dead chip in the mesh
+            # slice fails shard_map programs while the single-device
+            # engine may still answer — an ec_util canary would then
+            # re-promote a mesh lane that is still broken and flap
+            if lane == "mesh":
+                enc_dev = self._mesh.encode
+                dec_dev = self._mesh.decode_concat
+            else:
+                enc_dev = ec_util.encode
+                dec_dev = ec_util.decode_concat
             if kind == "dec":
                 # drop one data shard: the probe must drive the device
                 # RECONSTRUCT program, the one that actually tripped
                 survivors = {s: np.asarray(v)
                              for s, v in shards.items() if s != 0}
-                got = ec_util.decode_concat(sinfo, codec, survivors)
+                got = dec_dev(sinfo, codec, survivors)
                 want = ec_util.decode_concat_fallback(
                     sinfo, codec, survivors
                 )
                 # copy-ok: one-stripe canary, cold re-promotion path
                 return bytes(got) == bytes(want)
-            got = ec_util.encode(sinfo, codec, buf)
+            got = enc_dev(sinfo, codec, buf)
             want = shards
             return set(got) == set(want) and all(
                 np.array_equal(np.asarray(got[s]), np.asarray(want[s]))
@@ -682,7 +805,19 @@ class ECDispatcher:
                                                _probe_sync)
 
     def _note_batch(self, b: _Batch, ops: list[_Op], reason: str,
-                    pad: int, seconds: float) -> None:
+                    pad: int, seconds: float,
+                    served: str | None = None) -> None:
+        """``served`` names the engine that actually produced the
+        bytes: the batch's lane normally, ``"fallback"`` after a
+        failover replay.  Per-route evidence (the lane split, the
+        bucket tables, the mesh_* family, the per-engine GB/s gauges)
+        follows SERVED, not routed: a mesh slice whose launches are
+        all being replayed on the host must not keep painting healthy
+        mesh throughput — that is exactly the outage those counters
+        exist to reveal (the failovers/replayed_ops counters carry the
+        replay side)."""
+        if served is None:
+            served = b.lane
         stripes = sum(op.stripes for op in ops)
         t = self._totals
         t["batches"] += 1
@@ -691,8 +826,16 @@ class ECDispatcher:
         t["pad_stripes"] += pad
         t["pad_bytes"] += pad * b.sinfo.stripe_width
         t["flush"][reason] = t["flush"].get(reason, 0) + 1
-        sp = stripes + pad
-        self._buckets_seen[sp] = self._buckets_seen.get(sp, 0) + 1
+        if served != "fallback":
+            lt = t["lanes"][served]
+            lt["batches"] += 1
+            lt["ops"] += len(ops)
+            lt["stripes"] += stripes
+            lt["pad_stripes"] += pad
+            lt["pad_bytes"] += pad * b.sinfo.stripe_width
+            sp = stripes + pad
+            lb = self._buckets_seen[served]
+            lb[sp] = lb.get(sp, 0) + 1
         pec = self._perf
         if pec is None:
             return
@@ -702,30 +845,67 @@ class ECDispatcher:
         if pad:
             pec.inc("dispatch_pad_stripes", pad)
             pec.inc("dispatch_pad_bytes", pad * b.sinfo.stripe_width)
-        pec.observe(
-            "dispatch_occupancy",
+        occupancy = (
             min(1.0, stripes / self.max_stripes) if self.max_stripes
-            else 1.0,
+            else 1.0
         )
+        pec.observe("dispatch_occupancy", occupancy)
         pec.hist("dispatch_batch_size_histogram", len(ops))
+        # per-lane occupancy/pad/batch-size split (registered with
+        # literal keys in the daemon so the check_counters gate sees
+        # the family; prometheus gets one series per route)
+        if served == "mesh":
+            pec.inc("dispatch_batches_mesh")
+            pec.inc("dispatch_ops_mesh", len(ops))
+            if pad:
+                pec.inc("dispatch_pad_stripes_mesh", pad)
+                pec.inc("dispatch_pad_bytes_mesh",
+                        pad * b.sinfo.stripe_width)
+            pec.observe("dispatch_occupancy_mesh", occupancy)
+            pec.hist("dispatch_batch_size_mesh_histogram", len(ops))
+            pec.inc("mesh_batches")
+            pec.inc("mesh_encode_calls" if b.kind == "enc"
+                    else "mesh_decode_calls", len(ops))
+            pec.set("mesh_devices", b.quantum)
+        elif served == "device":
+            pec.inc("dispatch_batches_device")
+            pec.inc("dispatch_ops_device", len(ops))
+            if pad:
+                pec.inc("dispatch_pad_stripes_device", pad)
+                pec.inc("dispatch_pad_bytes_device",
+                        pad * b.sinfo.stripe_width)
+            pec.observe("dispatch_occupancy_device", occupancy)
+            pec.hist("dispatch_batch_size_device_histogram", len(ops))
         # device-wall-time accounting from this LAUNCH's own time
         # (logical bytes, pad excluded): the daemon's op-level timer
         # includes queue wait and batch sharing, so on the dispatch
         # route the encode/decode time avg + size x latency histogram +
         # GB/s gauge are all fed here, once per launch, keeping the
-        # PR-2 "device wall time" semantics comparable across PRs
+        # PR-2 "device wall time" semantics comparable across PRs.
+        # The mesh lane feeds the mesh_* GB/s gauges (account_ec_call's
+        # mesh fork) only when the mesh actually served — a fallback
+        # replay's wall time belongs to the host-path gauges.
         op = "encode" if b.kind == "enc" else "decode"
         if b.kind == "enc":
             nbytes = stripes * b.sinfo.stripe_width
         else:
             nbytes = stripes * b.sinfo.chunk_size * len(ops[0].payload)
-        ec_util.account_ec_call(pec, op, nbytes, seconds)
+        ec_util.account_ec_call(pec, op, nbytes, seconds,
+                                mesh=served == "mesh")
 
     # -- the batched launch (executor thread) --------------------------------
 
-    def _pad_for(self, codec, total_stripes: int) -> int:
+    def _pad_for(self, b: _Batch, total_stripes: int) -> int:
         """Zero stripes to add (only jit-path codecs reach a batch —
-        the native engine took the direct lane in encode/decode)."""
+        the native engine took the direct lane in encode/decode).  The
+        mesh lane always pads to its alignment quantum (shards must
+        stay balanced across the slice even with bucketing disabled);
+        bucketing then rounds the per-chip stripe count to a power of
+        two — ``mesh_size x bucket``, the anti-compile-storm rule."""
+        if b.quantum > 1:
+            return bucket_stripes_aligned(
+                total_stripes, b.quantum, self.bucket
+            ) - total_stripes
         if not self.bucket:
             return 0
         return bucket_stripes(total_stripes) - total_stripes
@@ -739,17 +919,26 @@ class ECDispatcher:
         stalled waiter pins only its own bytes, not the whole padded
         batch output.
 
-        ``engine`` picks the math: "device" is the normal jax route
-        (fault-injection hooks apply); "fallback" is the host replay
-        route (ec_util.*_fallback — no injection, no bucketing: the
-        host engines have no jit cache to protect)."""
+        ``engine`` picks the math: "device" is the normal jax route —
+        the batch's lane selects single-device ec_util or the mesh
+        engine's shard_map programs (fault-injection hooks apply to
+        both: the mesh slice is the same accelerator fault domain);
+        "fallback" is the host replay route (ec_util.*_fallback — no
+        injection, no bucketing: the host engines have no jit cache to
+        protect)."""
         fallback = engine == "fallback"
-        encode_fn = ec_util.encode_fallback if fallback else ec_util.encode
-        decode_fn = ec_util.decode_fallback if fallback else ec_util.decode
+        if fallback:
+            encode_fn, decode_fn = (ec_util.encode_fallback,
+                                    ec_util.decode_fallback)
+        elif b.lane == "mesh":
+            encode_fn, decode_fn = (self._mesh.encode_batch,
+                                    self._mesh.decode_batch)
+        else:
+            encode_fn, decode_fn = ec_util.encode, ec_util.decode
         sinfo, codec = b.sinfo, b.codec
         cs = sinfo.chunk_size
         total = sum(op.stripes for op in ops)
-        pad = 0 if fallback else self._pad_for(codec, total)
+        pad = 0 if fallback else self._pad_for(b, total)
         if not fallback:
             self._maybe_inject()
         if b.kind == "enc":

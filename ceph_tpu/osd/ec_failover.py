@@ -97,10 +97,14 @@ class EngineSupervisor:
         self._probe_task: asyncio.Task | None = None
         self._stopping = False
         # dump()-side history, independent of the perf wiring
+        # (mesh_fatal_errors slices fatal_errors by the dispatcher's
+        # mesh lane — a slice losing one chip shows up HERE first)
         self.totals = {"fatal_errors": 0, "data_errors": 0,
+                       "mesh_fatal_errors": 0,
                        "timeouts": 0, "trips": 0, "probes": 0,
                        "promotions": 0}
         self.last_failure: str | None = None
+        self.last_failure_lane: str | None = None
         self.last_transition = time.monotonic()
         self._set_gauge()
 
@@ -138,16 +142,26 @@ class EngineSupervisor:
             self._transition(HEALTHY)
             self._notify_degraded(False)
 
-    def record_failure(self, exc: BaseException) -> str:
+    def record_failure(self, exc: BaseException,
+                       lane: str = "device") -> str:
         """Classify a launch failure; fatal errors advance the breaker
         (HEALTHY -> SUSPECT -> TRIPPED).  Returns the classification so
-        the dispatcher can decide replay-vs-surface with one call."""
+        the dispatcher can decide replay-vs-surface with one call.
+        ``lane`` names the dispatcher route that failed ("device" /
+        "mesh") — the mesh slice shares this breaker (one accelerator
+        fault domain: losing a single chip in the slice fails the
+        shard_map program exactly like losing the only chip), but the
+        dump attributes the failure so the operator can tell a sick
+        mesh from a sick chip."""
         kind = classify_engine_error(exc)
         if kind != "fatal":
             self.totals["data_errors"] += 1
             return kind
         self.totals["fatal_errors"] += 1
+        if lane == "mesh":
+            self.totals["mesh_fatal_errors"] += 1
         self.last_failure = repr(exc)[:200]
+        self.last_failure_lane = lane
         if not self.enabled:
             return kind
         now = time.monotonic()
@@ -289,5 +303,6 @@ class EngineSupervisor:
                 and not self._probe_task.done()
             ),
             "last_failure": self.last_failure,
+            "last_failure_lane": self.last_failure_lane,
             "totals": dict(self.totals),
         }

@@ -124,9 +124,10 @@ def account_ec_call(pec, op: str, nbytes: int, seconds: float,
                     *, mesh: bool = False) -> None:
     """THE definition of the ``ec.{encode,decode}`` device-wall-time
     feed — time avg + (size x latency) histogram + per-engine GB/s
-    gauge — shared by the OSD router (mesh/inline routes), the
-    microbatch dispatcher's batch launches, and its native direct lane,
-    so the three call sites cannot drift."""
+    gauge — shared by the OSD router (inline/direct-mesh routes), the
+    microbatch dispatcher's batch launches (``mesh=True`` on its mesh
+    lane, feeding the ``mesh_*_gbps`` gauges per launch), and its
+    native direct lane, so the call sites cannot drift."""
     pec.observe(f"{op}_time", seconds)
     pec.hist(f"{op}_time_histogram", nbytes, seconds)
     if seconds > 0:

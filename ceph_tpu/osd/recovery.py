@@ -1043,8 +1043,8 @@ class RecoveryManager:
             padded = (
                 sinfo.pad_to_stripe(data) if data else b"\x00" * sinfo.stripe_width
             )
-            # routes through the mesh engine when osd_ec_mesh is on,
-            # else the microbatch dispatcher / host path (async router)
+            # routes through the microbatch dispatcher (whose mesh lane
+            # serves when osd_ec_mesh is on) / host path (async router)
             shard_bufs = await osd._ec_encode_bufs(
                 sinfo, codec, padded, klass="ec_background"
             )

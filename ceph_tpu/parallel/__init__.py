@@ -16,7 +16,7 @@ analog for bulk data (SURVEY.md §5.8) — while control-plane traffic uses
 :mod:`ceph_tpu.rados`'s TCP messenger.
 """
 
-from .mesh import make_mesh
+from .mesh import ec_shard_axis, make_mesh
 from .distributed import make_ec_step
 
-__all__ = ["make_mesh", "make_ec_step"]
+__all__ = ["ec_shard_axis", "make_mesh", "make_ec_step"]

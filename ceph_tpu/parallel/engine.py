@@ -1,20 +1,29 @@
 """MeshEcEngine: the OSD's EC hot ops executed over a device mesh.
 
-VERDICT r4 Missing #2 — the mesh in the DATA PATH, not a sidecar demo.
+VERDICT r4 Missing #2 — the mesh in the DATA PATH, not a sidecar demo;
+ISSUE 8 — the mesh as a first-class DISPATCHER LANE, not a bypass.
 A pool's k+m shard rows map onto the ``shard`` axis of a
 :class:`jax.sharding.Mesh`:
 
-- **encode** runs data-parallel over the ``pg`` axis (stripes sharded —
-  the CRUSH placement-parallelism analog); the resulting k+m shard rows
-  are laid across the ``shard`` axis by sharding constraint, so the k+m
-  fan-out of reference:src/osd/ECBackend.cc:1902-1926 becomes device
-  placement instead of k+m messenger sends.
-- **reconstruct** starts from survivor rows sharded over ``shard`` (each
-  mesh row holds its own shard's bytes, as the real topology would),
-  all-gathers them over ICI inside ``shard_map``, and rebuilds the
-  missing rows with the cached recovery matrix — the MOSDECSubOpRead
+- **encode** runs data-parallel over the WHOLE mesh (stripes sharded
+  over ``(pg, shard)`` — every chip encodes its slice of the batch; the
+  CRUSH placement-parallelism analog); the resulting k+m shard rows are
+  then laid across the ``shard`` axis by sharding constraint, so the
+  k+m fan-out of reference:src/osd/ECBackend.cc:1902-1926 becomes
+  device placement instead of k+m messenger sends.
+- **reconstruct** starts from survivor rows sharded over ``shard``
+  (each mesh row holds its own shard's bytes, as the real topology
+  would) with the byte dimension sharded over ``pg``, all-gathers the
+  survivor rows over ICI inside ``shard_map``, and rebuilds the missing
+  rows with the cached recovery matrix — the MOSDECSubOpRead
   round-trips of reference:src/osd/ECBackend.cc:2187 become one
-  collective.
+  collective, and the rebuild itself stays pg-parallel.
+- **prime-k degeneracy** (ISSUE 8 satellite): when ``gcd(k, n) == 1``
+  the ``shard`` axis collapses to 1 and an all-gather over it would
+  silently serialize — reconstruct then falls back to sharding the
+  survivor ROWS over ``pg`` (zero-padded to a row multiple, with
+  matching zero recovery-matrix columns), so the gather still crosses
+  ICI instead of degenerating to replicated compute.
 
 The TCP messenger keeps carrying CONTROL traffic (pg-log entries,
 commit acks, version/crc metadata); the engine carries the bulk bytes.
@@ -24,10 +33,24 @@ Byte contract: outputs are bit-identical to the host path
 is exact and reconstruction of an MDS code is unique, so the tests pin
 mesh-path bytes == TCP-path bytes.
 
+Batching contract (the dispatcher lane): :meth:`encode_batch` /
+:meth:`decode_batch` take PRE-ALIGNED batches — the microbatch
+dispatcher pads the coalesced stripe count to ``mesh_size x bucket``
+(ec_dispatch.bucket_stripes_aligned), so shards stay balanced and the
+jit cache holds O(#buckets x #mesh-slices) programs.  The per-op
+:meth:`encode` / :meth:`decode` wrappers pad internally (the
+no-dispatcher route keeps working standalone).
+
+Every compiled program reports into the process KernelProfiler as its
+own engine family (``mesh_encode`` / ``mesh_reconstruct`` /
+``mesh_gather``), keyed on (mesh shape, codec matrix, padded batch
+shape) — ``dump_kernel_profile`` shows mesh launches distinctly from
+single-chip launches, with the compile-vs-exec split AOT-separated
+where jax allows.
+
 Engine support is matrix codecs (:class:`MatrixErasureCode`: isa +
-jerasure reed_sol families — the overwhelming production profiles);
-bitmatrix/LRC/SHEC codecs fall back to the host path at the OSD router
-(``OSD._ec_encode_bufs``).
+jerasure reed_sol families, w=8 and w=16 — the overwhelming production
+profiles); bitmatrix/LRC/SHEC codecs fall back to the host path.
 """
 
 from __future__ import annotations
@@ -40,16 +63,34 @@ import numpy as np
 from ..utils.buffers import as_u8
 
 
+# ONE mesh program in flight per process: shard_map programs carry
+# collectives (the reconstruct all-gather; encode's output-layout
+# reshard), and two collective programs interleaving their per-device
+# participants on a shared device set DEADLOCK the rendezvous (XLA's
+# cross-module collective rendezvous is keyed per run — observed live
+# on the CPU backend: "waiting for all participants to arrive", with
+# every launch then blowing its osd_ec_launch_deadline).  Every
+# dispatcher executor thread and the failover canary route through
+# this lock; the chips are one host resource, so concurrent launches
+# had nothing to win anyway.  A genuinely wedged device call holding
+# the lock starves later launches into their deadline failovers — the
+# breaker's job, exactly as for a wedged single-device call.
+_MESH_EXEC_LOCK = threading.Lock()
+
+
 class MeshEcEngine:
     """Compiled-program cache + mesh factory for the EC data path."""
 
-    def __init__(self, devices=None, max_programs: int = 64):
+    def __init__(self, devices=None, max_programs: int = 64,
+                 n_devices: int | None = None):
         # device acquisition is LAZY (first mesh_for call): jax.devices()
         # can block indefinitely when the TPU tunnel is down, and this
         # constructor runs inside OSD.__init__ on the event loop (code
         # review r5) — supports() and construction must never touch the
-        # device
+        # device.  ``n_devices`` bounds the slice (osd_ec_mesh_devices;
+        # 0/None = all visible devices), resolved at the same lazy point.
         self._devices = list(devices) if devices is not None else None
+        self._n_devices = int(n_devices) if n_devices else None
         self.max_programs = max_programs
         self._programs: dict = {}
         self._meshes: dict[int, tuple] = {}
@@ -60,7 +101,10 @@ class MeshEcEngine:
         if self._devices is None:
             import jax
 
-            self._devices = list(jax.devices())
+            devs = list(jax.devices())
+            if self._n_devices:
+                devs = devs[: self._n_devices]
+            self._devices = devs
         return self._devices
 
     # -- capability ----------------------------------------------------------
@@ -76,19 +120,28 @@ class MeshEcEngine:
             and getattr(ec_impl, "matrix", None) is not None
         )
 
+    def routes(self, sinfo, ec_impl) -> bool:
+        """May the DISPATCHER route this (geometry, codec) to the mesh
+        lane?  supports() plus the u32-lane alignment the shard_map
+        programs need — one predicate shared with the OSD router so the
+        lane gates cannot drift.  Never touches the device."""
+        return self.supports(ec_impl) and sinfo.chunk_size % 4 == 0
+
     # -- mesh factory --------------------------------------------------------
     def mesh_for(self, k: int):
-        """(mesh, pg_size, shard_size): 'shard' is the largest axis that
-        divides both k (so survivor rows shard evenly for the all-gather)
-        and the device count."""
+        """(mesh, pg_size, shard_size): 'shard' is the chunk-layout
+        axis (bounded divisor of gcd(k, n) — see mesh.ec_shard_axis);
+        'pg' takes the rest of the devices for stripe parallelism."""
         with self._lock:
             got = self._meshes.get(k)
             if got is not None:
                 return got
         from jax.sharding import Mesh
 
+        from .mesh import ec_shard_axis  # lazy: mesh.py imports jax
+
         n = len(self.devices)
-        shard = math.gcd(k, n)
+        shard = ec_shard_axis(k, n)
         pg = n // shard
         mesh = Mesh(
             np.asarray(self.devices).reshape(pg, shard), ("pg", "shard")
@@ -96,6 +149,18 @@ class MeshEcEngine:
         with self._lock:
             self._meshes[k] = (mesh, pg, shard)
         return mesh, pg, shard
+
+    def mesh_key(self, k: int) -> tuple[int, int]:
+        """(pg, shard) — the mesh-slice dimension of a dispatcher batch
+        key; pg * shard is the stripe-alignment quantum."""
+        _mesh, pg, shard = self.mesh_for(k)
+        return pg, shard
+
+    def reconstruct_axis(self, k: int) -> str:
+        """Which mesh axis the reconstruct all-gather crosses: 'shard'
+        normally, 'pg' on the prime-k degeneracy (gcd(k, n) == 1)."""
+        _mesh, pg, shard = self.mesh_for(k)
+        return "shard" if shard > 1 else "pg"
 
     def _cached(self, key, build):
         with self._lock:
@@ -122,12 +187,43 @@ class MeshEcEngine:
         units = max(1, -(-n // quantum))
         return quantum * (1 << max(0, math.ceil(math.log2(units))))
 
+    def _profiler(self):
+        from ..ops.profiler import profiler
+
+        return profiler()
+
     # -- encode --------------------------------------------------------------
     def encode(self, sinfo, ec_impl, data) -> dict[int, np.ndarray]:
-        """Same contract and bytes as :func:`ceph_tpu.osd.ec_util.encode`,
-        executed as a shard_map program over the mesh."""
-        import jax
+        """Per-op twin of :func:`ceph_tpu.osd.ec_util.encode` — same
+        contract, same bytes; pads the stripe batch to a mesh-aligned
+        bucket internally (zero stripes encode to zero parity
+        columnwise) and slices back."""
+        buf = as_u8(data)
+        if buf.size % sinfo.stripe_width != 0:
+            raise ValueError(
+                f"data size {buf.size} not a multiple of "
+                f"stripe_width {sinfo.stripe_width}"
+            )
+        k = ec_impl.get_data_chunk_count()
+        S = buf.size // sinfo.stripe_width
+        C = sinfo.chunk_size
+        _mesh, pg, shard = self.mesh_for(k)
+        S_p = self._bucket(S, pg * shard)
+        if S_p != S:
+            buf = np.concatenate(
+                [buf, np.zeros((S_p - S) * sinfo.stripe_width,
+                               dtype=np.uint8)]
+            )
+        full = self.encode_batch(sinfo, ec_impl, buf)
+        if S_p == S:
+            return full
+        return {i: v[: S * C] for i, v in full.items()}
 
+    def encode_batch(self, sinfo, ec_impl, data) -> dict[int, np.ndarray]:
+        """Mesh-aligned batch encode: same contract and bytes as
+        :func:`ceph_tpu.osd.ec_util.encode`, executed as one shard_map
+        program; the stripe count must already be a multiple of the
+        mesh size (the dispatcher lane pads to mesh_size x bucket)."""
         buf = as_u8(data)
         if buf.size % sinfo.stripe_width != 0:
             raise ValueError(
@@ -142,24 +238,26 @@ class MeshEcEngine:
         if C % 4 != 0:
             raise ValueError(f"chunk_size {C} not a multiple of 4")
         S = buf.size // sinfo.stripe_width
-        mesh, pg_sz, _shard_sz = self.mesh_for(k)
-        # pad the stripe batch to a pg-axis bucket: zero stripes encode
-        # to zero parity columnwise, and we slice back to S below
-        S_p = self._bucket(S, pg_sz)
-        d3 = buf.reshape(S, k, C)
-        if S_p != S:
-            d3 = np.concatenate(
-                [d3, np.zeros((S_p - S, k, C), dtype=np.uint8)], axis=0
+        mesh, pg, shard = self.mesh_for(k)
+        n = pg * shard
+        if S % n != 0:
+            raise ValueError(
+                f"mesh batch of {S} stripes not aligned to the "
+                f"{pg}x{shard} mesh (pad to a multiple of {n})"
             )
+        d3 = buf.reshape(S, k, C)
+        mk = self._mkey(ec_impl)
         step = self._cached(
-            ("enc", self._mkey(ec_impl), S_p, C),
+            ("enc", mk, S, C),
             lambda: self._build_encode(ec_impl, mesh, m),
         )
-        full = np.asarray(step(d3))  # [S_p, k+m, C]
+        with _MESH_EXEC_LOCK:
+            full = self._profiler().call_jitted(
+                "mesh_encode", ((pg, shard), mk, S, C), step, (d3,),
+                nbytes=buf.size, shape=(S, k, C), wrap=np.asarray,
+            )  # [S, k+m, C]
         return {
-            i: np.ascontiguousarray(
-                full[:S, i, :]
-            ).reshape(S * C)
+            i: np.ascontiguousarray(full[:, i, :]).reshape(S * C)
             for i in range(k + m)
         }
 
@@ -172,16 +270,23 @@ class MeshEcEngine:
 
         enc = make_gf_matmul(ec_impl.matrix, ec_impl.w)
 
-        def local_encode(d):  # [S_p/pg, k, C] on one pg member
+        def local_encode(d):  # [S/(pg*shard), k, C] on EVERY chip
             S, rows, C = d.shape
             flat = jnp.transpose(d, (1, 0, 2)).reshape(rows, S * C)
             par = enc(flat)
             par3 = jnp.transpose(par.reshape(m, S, C), (1, 0, 2))
             return jnp.concatenate([d, par3], axis=1)
 
-        sm = jax.shard_map(
-            local_encode, mesh=mesh,
-            in_specs=P("pg", None, None), out_specs=P("pg", None, None),
+        from .mesh import shard_map_compat
+
+        # stripes shard over BOTH axes for the compute (a shard-axis
+        # member must not re-encode its pg row's stripes replicated —
+        # that wastes every chip past pg); the constraint below then
+        # lays the k+m rows across 'shard'
+        sm = shard_map_compat(
+            local_encode, mesh,
+            in_specs=P(("pg", "shard"), None, None),
+            out_specs=P(("pg", "shard"), None, None),
         )
 
         @jax.jit
@@ -199,13 +304,13 @@ class MeshEcEngine:
     def decode(
         self, sinfo, ec_impl, chunks, want=None
     ) -> dict[int, np.ndarray]:
-        """Rebuild shard buffers from survivors: survivor rows enter
-        sharded over the 'shard' axis and are all-gathered over ICI."""
+        """Per-op twin of :func:`ceph_tpu.osd.ec_util.decode`: pads the
+        shard buffers to a mesh-aligned bucket and slices back."""
         k = ec_impl.get_data_chunk_count()
         if want is None:
             want = list(range(k))
-        present = sorted(chunks)
-        sizes = {np.asarray(v).size for v in chunks.values()}
+        arrs = {int(r): as_u8(np.asarray(v)) for r, v in chunks.items()}
+        sizes = {a.size for a in arrs.values()}
         if len(sizes) != 1:
             raise ValueError(f"shard buffers differ in size: {sizes}")
         L = next(iter(sizes))
@@ -214,10 +319,43 @@ class MeshEcEngine:
                 f"shard buffer size {L} not a multiple of "
                 f"chunk_size {sinfo.chunk_size}"
             )
-        missing = [r for r in want if r not in chunks]
-        out = {
-            r: as_u8(np.asarray(chunks[r])) for r in want if r in chunks
-        }
+        if not any(r not in arrs for r in want):
+            return {r: arrs[r] for r in want}
+        _mesh, pg, shard = self.mesh_for(k)
+        quantum = 4 * pg * shard  # u32 lanes x the byte-sharding axis
+        L_p = self._bucket(max(L, quantum), quantum)
+        if L_p != L:
+            arrs = {
+                r: np.concatenate(
+                    [a, np.zeros(L_p - L, dtype=np.uint8)]
+                )
+                for r, a in arrs.items()
+            }
+        decoded = self.decode_batch(sinfo, ec_impl, arrs, want=want)
+        if L_p == L:
+            return decoded
+        return {r: v[:L] for r, v in decoded.items()}
+
+    def decode_batch(
+        self, sinfo, ec_impl, chunks, want=None
+    ) -> dict[int, np.ndarray]:
+        """Mesh-aligned batch reconstruct: survivor rows enter sharded
+        over the gather axis ('shard', or 'pg' on the prime-k
+        degeneracy), are all-gathered over ICI, and the missing rows
+        rebuild pg-parallel over the byte dimension.  Shard buffers
+        must be mesh-slice aligned (see :meth:`routes` + the dispatcher
+        padding)."""
+        k = ec_impl.get_data_chunk_count()
+        if want is None:
+            want = list(range(k))
+        present = sorted(chunks)
+        arrs = {int(r): as_u8(np.asarray(v)) for r, v in chunks.items()}
+        sizes = {a.size for a in arrs.values()}
+        if len(sizes) != 1:
+            raise ValueError(f"shard buffers differ in size: {sizes}")
+        L = next(iter(sizes))
+        missing = [r for r in want if r not in arrs]
+        out = {r: arrs[r] for r in want if r in arrs}
         if not missing:
             return out
         if len(present) < k:
@@ -225,23 +363,43 @@ class MeshEcEngine:
                 f"cannot decode: {len(present)} survivors < k={k}"
             )
         use = present[:k]
-        mesh, _pg_sz, _shard_sz = self.mesh_for(k)
-        L_p = self._bucket(max(L, 4), 4)
-        surv = np.stack([as_u8(np.asarray(chunks[r])) for r in use])
-        if L_p != L:
-            surv = np.concatenate(
-                [surv, np.zeros((k, L_p - L), dtype=np.uint8)], axis=1
+        mesh, pg, shard = self.mesh_for(k)
+        rows_ax = "shard" if shard > 1 else "pg"
+        rows_sz = shard if shard > 1 else pg
+        cols_sz = pg if shard > 1 else shard
+        if L % (4 * cols_sz) != 0:
+            raise ValueError(
+                f"shard buffer size {L} not aligned to the mesh slice "
+                f"(need a multiple of {4 * cols_sz})"
             )
+        k_p = -(-k // rows_sz) * rows_sz
+        surv = np.stack([arrs[r] for r in use])
+        if k_p != k:
+            # prime-k fallback: zero survivor rows + zero recovery
+            # columns — GF-exact no-ops that make the pg gather even
+            surv = np.concatenate(
+                [surv, np.zeros((k_p - k, L), dtype=np.uint8)], axis=0
+            )
+        mk = self._mkey(ec_impl)
         step = self._cached(
-            ("dec", self._mkey(ec_impl), tuple(use), tuple(missing), L_p),
-            lambda: self._build_reconstruct(ec_impl, mesh, use, missing),
+            ("dec", mk, tuple(use), tuple(missing), L),
+            lambda: self._build_reconstruct(
+                ec_impl, mesh, use, missing, rows_ax, k_p
+            ),
         )
-        rebuilt = np.asarray(step(surv))  # [len(missing), L_p]
+        with _MESH_EXEC_LOCK:
+            rebuilt = self._profiler().call_jitted(
+                "mesh_reconstruct",
+                ((pg, shard), mk, tuple(use), tuple(missing), L),
+                step, (surv,), nbytes=k * L, shape=(k_p, L),
+                wrap=np.asarray,
+            )  # [len(missing), L]
         for i, r in enumerate(missing):
-            out[r] = np.ascontiguousarray(rebuilt[i, :L])
+            out[r] = np.ascontiguousarray(rebuilt[i])
         return out
 
-    def _build_reconstruct(self, ec_impl, mesh, use, missing):
+    def _build_reconstruct(self, ec_impl, mesh, use, missing,
+                           rows_ax, k_p):
         import jax
         from jax.sharding import PartitionSpec as P
 
@@ -252,18 +410,27 @@ class MeshEcEngine:
         RM = _recovery_rows(
             np.asarray(ec_impl.matrix), k, w, list(use), list(missing)
         )
+        if k_p != k:
+            RM = np.concatenate(
+                [RM, np.zeros((RM.shape[0], k_p - k), dtype=RM.dtype)],
+                axis=1,
+            )
         dec = make_gf_matmul(RM, w)
+        cols_ax = "pg" if rows_ax == "shard" else "shard"
 
-        def local_rec(surv):  # [k/shard, L] on one shard member
-            g = jax.lax.all_gather(surv, "shard", axis=0, tiled=True)
+        def local_rec(surv):  # [k_p/rows, L/cols] on one chip
+            g = jax.lax.all_gather(surv, rows_ax, axis=0, tiled=True)
             return dec(g)
 
-        # every shard member computes the same rebuilt rows after the
-        # gather (replicated output) — invisible to the static VMA check
-        sm = jax.shard_map(
-            local_rec, mesh=mesh,
-            in_specs=P("shard", None), out_specs=P(None, None),
-            check_vma=False,
+        from .mesh import shard_map_compat
+
+        # the rebuilt rows replicate over the gather axis (every member
+        # computes its byte slice of the same rows after the gather) —
+        # invisible to the static replication check
+        sm = shard_map_compat(
+            local_rec, mesh,
+            in_specs=P(rows_ax, cols_ax), out_specs=P(None, cols_ax),
+            replicated_ok=True,
         )
         return jax.jit(sm)
 
@@ -277,16 +444,68 @@ class MeshEcEngine:
         arr = stack.reshape(k, S, sinfo.chunk_size).transpose(1, 0, 2)
         return np.ascontiguousarray(arr).tobytes()
 
+    # -- the ICI-gather cost probe (bench.py mesh phase) ---------------------
+    def probe_gather(self, k: int, L: int) -> None:
+        """Run the reconstruct's all-gather ALONE (no recovery matmul)
+        at the given survivor geometry, reporting into the profiler as
+        the ``mesh_gather`` engine — bench.py's mesh phase splits the
+        ICI collective's cost out of the reconstruct number with it.
+        ``L`` must be mesh-slice aligned (a multiple of
+        4 * pg * shard covers every layout)."""
+        import jax
+        from jax.sharding import PartitionSpec as P
 
-_GLOBAL: MeshEcEngine | None = None
-_GLOBAL_LOCK = threading.Lock()
+        mesh, pg, shard = self.mesh_for(k)
+        rows_ax = "shard" if shard > 1 else "pg"
+        rows_sz = shard if shard > 1 else pg
+        cols_ax = "pg" if rows_ax == "shard" else "shard"
+        cols_sz = pg if shard > 1 else shard
+        if L % max(1, cols_sz) != 0:
+            raise ValueError(
+                f"gather probe length {L} not a multiple of {cols_sz}"
+            )
+        k_p = -(-k // rows_sz) * rows_sz
+        surv = np.zeros((k_p, L), dtype=np.uint8)
+
+        def build():
+            from .mesh import shard_map_compat
+
+            def local_gather(s):
+                return jax.lax.all_gather(s, rows_ax, axis=0, tiled=True)
+
+            sm = shard_map_compat(
+                local_gather, mesh,
+                in_specs=P(rows_ax, cols_ax),
+                out_specs=P(None, cols_ax),
+                replicated_ok=True,
+            )
+            return jax.jit(sm)
+
+        step = self._cached(("gather", k_p, L), build)
+        with _MESH_EXEC_LOCK:
+            self._profiler().call_jitted(
+                "mesh_gather", ((pg, shard), k_p, L), step, (surv,),
+                nbytes=k * L, shape=(k_p, L), wrap=np.asarray,
+            )
 
 
-def get_mesh_engine() -> MeshEcEngine:
-    """Process-global engine: one mesh + program cache shared by every
-    in-process daemon (the single set of chips is a host resource)."""
-    global _GLOBAL
-    with _GLOBAL_LOCK:
-        if _GLOBAL is None:
-            _GLOBAL = MeshEcEngine()
-        return _GLOBAL
+# process-global engines keyed by slice size (None = all devices):
+# one mesh + program cache shared by every in-process daemon on the
+# same slice — the chips are a host resource, and N daemons pinning
+# the SAME osd_ec_mesh_devices must not each pay their own XLA
+# compiles for identical programs
+_ENGINES: dict[int | None, MeshEcEngine] = {}
+_ENGINES_LOCK = threading.Lock()
+
+
+def get_mesh_engine(n_devices: int | None = None) -> MeshEcEngine:
+    """Process-global engine for a device slice: daemons pinning the
+    same ``osd_ec_mesh_devices`` share one program cache; different
+    slice sizes get their own engine (their programs are shaped for a
+    different mesh)."""
+    key = int(n_devices) if n_devices else None
+    with _ENGINES_LOCK:
+        eng = _ENGINES.get(key)
+        if eng is None:
+            eng = _ENGINES[key] = MeshEcEngine(n_devices=key)
+        return eng

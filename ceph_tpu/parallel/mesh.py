@@ -2,9 +2,55 @@
 
 from __future__ import annotations
 
+import math
+
 import jax
 import numpy as np
 from jax.sharding import Mesh
+
+
+def shard_map_compat(f, mesh, in_specs, out_specs,
+                     replicated_ok: bool = False):
+    """Version-portable ``shard_map``: new jax exposes ``jax.shard_map``
+    (replication opt-out spelled ``check_vma=False``), 0.4.x ships it
+    as ``jax.experimental.shard_map.shard_map`` (``check_rep=False``).
+    ``replicated_ok=True`` disables the static replication check — the
+    reconstruct programs produce outputs replicated over the gather
+    axis, which the checker cannot see through an all_gather."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        try:
+            if replicated_ok:
+                return sm(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=False)
+            return sm(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs)
+        # swallow-ok: kwargs-spelling probe — this jax wants the 0.4.x keywords, fall through to the experimental entry (nothing launched yet)
+        except TypeError:
+            pass
+    from jax.experimental.shard_map import shard_map as esm
+
+    kw = {"check_rep": False} if replicated_ok else {}
+    return esm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               **kw)
+
+
+def ec_shard_axis(k: int, n_devices: int) -> int:
+    """Size of the EC mesh's 'shard' (chunk-layout) axis: the largest
+    divisor of gcd(k, n) not exceeding 4, so survivor rows shard evenly
+    for the reconstruct all-gather while most of the device count stays
+    on the 'pg' axis for stripe/byte parallelism (an over-wide shard
+    axis buys layout, not compute — encode work is stripe-sharded, and
+    the reconstruct rebuild is byte-sharded over 'pg').
+
+    Returns 1 when gcd(k, n) == 1 (prime k vs the device count) — the
+    degenerate case MeshEcEngine's reconstruct handles by gathering
+    over 'pg' instead (ISSUE 8 satellite)."""
+    g = math.gcd(int(k), int(n_devices))
+    for cand in (4, 3, 2):
+        if g % cand == 0:
+            return cand
+    return 1
 
 
 def make_mesh(

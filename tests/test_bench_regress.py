@@ -225,6 +225,68 @@ class TestBenchRegress:
             ["--dir", str(tmp_path), "--metric", "stack_e2e_gbps"]
         ) == 0
 
+    # -- mesh.scaling_efficiency (ISSUE 8): 20%-drop gate --------------------
+
+    def _write_mesh_round(self, tmp_path, n, phase, value, eff=None):
+        line = {"metric": "m", "value": value, "unit": "GB/s",
+                "phase": phase}
+        if eff is not None:
+            line["mesh"] = {"scaling_efficiency": eff,
+                            "n_devices": 8, "scaling": []}
+        (tmp_path / f"BENCH_r{n:02d}.json").write_text(
+            json.dumps({"n": n, "rc": 0, "parsed": line})
+        )
+
+    def test_mesh_efficiency_20pct_drop_fails(self, tmp_path):
+        """A >20% per-chip efficiency drop between rounds carrying the
+        mesh phase fails at the metric's own 0.8 default threshold —
+        far inside the 2x budget the throughput metrics get."""
+        br = _load_tool()
+        self._write_mesh_round(tmp_path, 1, "tpu", 660.0, eff=0.9)
+        self._write_mesh_round(tmp_path, 2, "tpu", 650.0, eff=0.7)
+        # 0.7/0.9 = 0.78 < 0.8 -> regression (both metric spellings)
+        for metric in ("mesh.scaling_efficiency",
+                       "mesh_scaling_efficiency"):
+            assert br.main(
+                ["--dir", str(tmp_path), "--metric", metric]
+            ) == 1, metric
+
+    def test_mesh_efficiency_small_wobble_passes(self, tmp_path):
+        br = _load_tool()
+        self._write_mesh_round(tmp_path, 1, "tpu", 660.0, eff=0.9)
+        self._write_mesh_round(tmp_path, 2, "tpu", 650.0, eff=0.78)
+        # 0.78/0.9 = 0.87 >= 0.8 -> ok
+        assert br.main(
+            ["--dir", str(tmp_path),
+             "--metric", "mesh.scaling_efficiency"]
+        ) == 0
+
+    def test_mesh_metric_skips_rounds_without_it(self, tmp_path):
+        """Rounds predating the mesh phase lack the record: the gate
+        reports 'not comparable' and exits 0 until two rounds carry
+        it (promotion can never fail a round retroactively)."""
+        br = _load_tool()
+        self._write_mesh_round(tmp_path, 1, "tpu", 660.0)  # legacy
+        self._write_mesh_round(tmp_path, 2, "tpu", 650.0, eff=0.5)
+        rep = br.compare(br.load_rounds(str(tmp_path)),
+                         metric="mesh.scaling_efficiency")
+        assert rep["comparable"] is False
+        assert br.main(
+            ["--dir", str(tmp_path),
+             "--metric", "mesh.scaling_efficiency"]
+        ) == 0
+
+    def test_mesh_explicit_threshold_still_wins(self, tmp_path):
+        br = _load_tool()
+        self._write_mesh_round(tmp_path, 1, "tpu", 660.0, eff=0.9)
+        self._write_mesh_round(tmp_path, 2, "tpu", 650.0, eff=0.7)
+        # operator override: a 0.5 threshold tolerates the 0.78 ratio
+        assert br.main(
+            ["--dir", str(tmp_path),
+             "--metric", "mesh.scaling_efficiency",
+             "--threshold", "0.5"]
+        ) == 0
+
 
 class TestChildBackendDeath:
     def test_parent_survives_backend_registration_abort(self):

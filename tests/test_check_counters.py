@@ -24,6 +24,30 @@ def test_package_counter_keys_all_registered():
     assert problems == [], "\n".join(problems)
 
 
+def test_mesh_counter_family_is_gate_visible(tmp_path):
+    """ISSUE 8 satellite: the ec.mesh_* family (and the per-lane
+    dispatch split) is registered with literal keys in the daemon, so
+    a typo'd mesh key at a use site fails the gate like any other —
+    proven on a fixture mirroring the dispatcher's literal-branch
+    mutators."""
+    cc = _load_tool()
+    (tmp_path / "mod.py").write_text(
+        'class D:\n'
+        '    def __init__(self):\n'
+        '        pec = self.perf.create("ec")\n'
+        '        pec.add_counter("mesh_batches")\n'
+        '        pec.add_gauge("mesh_devices")\n'
+        '        pec.add_counter("dispatch_batches_mesh")\n'
+        '    def note(self):\n'
+        '        pec = self.perf.get("ec")\n'
+        '        pec.inc("mesh_batches")\n'
+        '        pec.set("mesh_devices", 8)\n'
+        '        pec.inc("dispatch_batches_mesk")\n'  # typo'd lane key
+    )
+    problems = cc.check(tmp_path)
+    assert len(problems) == 1 and "dispatch_batches_mesk" in problems[0]
+
+
 def test_detects_unregistered_key(tmp_path):
     cc = _load_tool()
     (tmp_path / "mod.py").write_text(

@@ -147,9 +147,12 @@ class TestRepoIsClean:
         problems = cf.check(root)
         assert problems == [], "\n".join(problems)
 
-    def test_repo_covers_all_three_modules(self):
+    def test_repo_covers_the_ec_hot_path_modules(self):
+        """Scope includes the mesh lane (ISSUE 8): a swallowed device
+        error inside the shard_map engine would hide a dead chip from
+        the breaker exactly like one in the dispatcher."""
         cf = _load_tool()
         root = pathlib.Path(__file__).parent.parent
         files = {p.name for p in cf._hot_files(root)}
         assert files == {"ec_dispatch.py", "ec_util.py",
-                         "ec_failover.py"}
+                         "ec_failover.py", "engine.py", "mesh.py"}

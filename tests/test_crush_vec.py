@@ -162,8 +162,14 @@ def test_hier_plain_choose_buckets_and_devices():
         _compare_hier(m, rn, nrep)
 
 
+@pytest.mark.slow
 def test_hier_exhaustion_more_reps_than_domains():
-    """numrep > #racks: firstn returns short, indep leaves holes."""
+    """numrep > #racks: firstn returns short, indep leaves holes.
+
+    Slow tier (ISSUE 8 CI budget pass): two full N_XH scalar-oracle
+    sweeps over rule shapes no other test compiles (~60s on the
+    1.5-core CI budget); the exhaustion semantics stay covered at
+    smaller numrep by the firstn/indep bit-exact tests above."""
     m = _build_racks()
     r1 = m.add_simple_rule(m.root_id(), 2)
     r2 = m.add_simple_rule(m.root_id(), 2, indep=True)
@@ -361,10 +367,16 @@ def test_chained_rule_with_weights_and_outs():
     _compare_hier(m, rule, 4, wv)
 
 
+@pytest.mark.slow
 def test_lrc_pool_rule_is_vectorized():
     """An actual LRC pool's installed rule (via the codec's
     ruleset_steps) must be on the vectorized path when the map has the
-    locality topology."""
+    locality topology.
+
+    Slow tier (ISSUE 8 CI budget pass): the LRC rule compiles its own
+    choose-program shapes and sweeps the scalar oracle (~35s on the
+    1.5-core CI budget); vectorized-path support itself is asserted by
+    test_supports_* and the hier bit-exact sweeps."""
     from ceph_tpu.osd.osdmap import OSDMap
 
     m = _build_racks()

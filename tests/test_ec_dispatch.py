@@ -383,6 +383,16 @@ def test_perf_counters_and_histogram_land(monkeypatch):
         "dispatch_batch_size_histogram",
         axes=[PerfHistogramAxis("ops", min=1.0, buckets=12)],
     )
+    # per-lane split (ISSUE 8): the device lane feeds its own series
+    pec.add_counter("dispatch_batches_device")
+    pec.add_counter("dispatch_ops_device")
+    pec.add_counter("dispatch_pad_stripes_device")
+    pec.add_counter("dispatch_pad_bytes_device")
+    pec.add_avg("dispatch_occupancy_device")
+    pec.add_histogram(
+        "dispatch_batch_size_device_histogram",
+        axes=[PerfHistogramAxis("ops", min=1.0, buckets=12)],
+    )
     sinfo, codec = _sinfo(2), _codec()
     bufs = _bufs(sinfo, [3, 5], seed=9)
 
@@ -401,6 +411,12 @@ def test_perf_counters_and_histogram_land(monkeypatch):
     assert d["dispatch_pad_stripes"] == 0  # 3+5 = 8, an exact bucket
     assert d["dispatch_occupancy"]["avgcount"] == 1
     assert d["dispatch_batch_size_histogram"]["histogram"]["count"] == 1
+    # the per-lane split attributes the launch to the device route
+    assert d["dispatch_batches_device"] == 1
+    assert d["dispatch_ops_device"] == 2
+    assert d["dispatch_occupancy_device"]["avgcount"] == 1
+    assert (d["dispatch_batch_size_device_histogram"]["histogram"]
+            ["count"] == 1)
 
 
 def test_native_direct_lane(monkeypatch):

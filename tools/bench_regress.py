@@ -66,7 +66,17 @@ PHASE_AGNOSTIC_METRICS = {"stack_gbps", "raw_cpu_gbps", "stack_vs_raw",
                           "stack_e2e.stack_e2e_gbps"}
 
 # convenience spellings -> the dotted path inside the final line
-METRIC_ALIASES = {"stack_e2e_gbps": "stack_e2e.stack_e2e_gbps"}
+METRIC_ALIASES = {"stack_e2e_gbps": "stack_e2e.stack_e2e_gbps",
+                  "mesh_scaling_efficiency": "mesh.scaling_efficiency"}
+
+# per-metric default thresholds (used when --threshold is not given):
+# mesh.scaling_efficiency is a RATIO (per-chip efficiency of the
+# multi-chip EC phase, ISSUE 8) — a >20% drop between rounds carrying
+# the mesh phase is a topology/sharding regression, far inside the 2x
+# jitter budget the throughput metrics need.  Rounds without the mesh
+# record simply lack the metric, so the gate skips cleanly (exit 0)
+# until two same-phase rounds carry it.
+METRIC_DEFAULT_THRESHOLDS = {"mesh.scaling_efficiency": 0.8}
 
 
 def load_rounds(bench_dir: str) -> list[dict]:
@@ -187,21 +197,28 @@ def main(argv: list[str] | None = None) -> int:
                     help="how many newest rounds to consider")
     ap.add_argument("--metric", default="value",
                     help="final-line key to compare; dotted paths reach "
-                         "nested records, e.g. qos.protection or "
+                         "nested records, e.g. qos.protection, "
                          "stack_e2e.stack_e2e_gbps (alias: "
-                         "stack_e2e_gbps) (default: value)")
-    ap.add_argument("--threshold", type=float, default=0.5,
+                         "stack_e2e_gbps) or mesh.scaling_efficiency "
+                         "(alias: mesh_scaling_efficiency) "
+                         "(default: value)")
+    ap.add_argument("--threshold", type=float, default=None,
                     help="fail when newest < threshold x prior best "
-                         "(0.5 = a 2x drop fails)")
+                         "(default: 0.5 = a 2x drop fails; "
+                         "mesh.scaling_efficiency defaults to 0.8 = a "
+                         ">20%% per-chip efficiency drop fails)")
     args = ap.parse_args(argv)
 
+    metric = METRIC_ALIASES.get(args.metric, args.metric)
+    threshold = (args.threshold if args.threshold is not None
+                 else METRIC_DEFAULT_THRESHOLDS.get(metric, 0.5))
     rounds = load_rounds(args.dir)
     if not rounds:
         print(json.dumps({"error": "no usable BENCH_*.json records",
                           "dir": args.dir}))
         return 2
-    report = compare(rounds[-args.last:], metric=args.metric,
-                     threshold=args.threshold)
+    report = compare(rounds[-args.last:], metric=metric,
+                     threshold=threshold)
     print(json.dumps(report, indent=2))
     return 1 if report.get("regression") else 0
 

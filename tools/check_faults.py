@@ -23,10 +23,14 @@ must do at least one of
   ``except`` line or the line above.  An annotation with no reason
   text fails: the allowlist must say WHY each swallow is safe.
 
-Scope (the device-error path end to end):
+Scope (the device-error path end to end, mesh lane included — a
+shard_map program losing one chip in the slice must reach the breaker
+exactly like a single-device loss):
     ceph_tpu/osd/ec_dispatch.py
     ceph_tpu/osd/ec_util.py
     ceph_tpu/osd/ec_failover.py
+    ceph_tpu/parallel/engine.py
+    ceph_tpu/parallel/mesh.py
 
 Usage: ``python tools/check_faults.py [repo_root]`` — exits 0 when
 clean, 1 with a per-site report otherwise.
@@ -42,6 +46,8 @@ HOT_PATHS = (
     "ceph_tpu/osd/ec_dispatch.py",
     "ceph_tpu/osd/ec_util.py",
     "ceph_tpu/osd/ec_failover.py",
+    "ceph_tpu/parallel/engine.py",
+    "ceph_tpu/parallel/mesh.py",
 )
 
 ANNOTATION = "# swallow-ok:"
