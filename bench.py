@@ -74,6 +74,62 @@ def _kprof():
     return profiler()
 
 
+def _device_trace_capture(run_fn, label: str,
+                          duration: float = 20.0) -> dict:
+    """One bounded jax.profiler trace window around ``run_fn()``
+    (ISSUE 9 / ROADMAP 5a): the phase's MEASURED fused-op / DMA /
+    ICI-collective device-time split, embedded in the round JSON.
+    TRACER failures degrade to ``{"unavailable": reason}`` — a bench
+    phase must never die on observability — but a ``run_fn`` failure
+    PROPAGATES: the burst is real device work, and an engine dying in
+    it must reach the caller's failover accounting, not hide as a
+    capture miss."""
+    try:
+        from ceph_tpu.ops.device_trace import tracer
+
+        svc = tracer()
+        st = svc.start(duration=duration, label=label,
+                       max_duration=duration)
+    except Exception as e:
+        return {"unavailable": f"device trace capture failed: {e!r}"}
+    if not st.get("success"):
+        return {"unavailable": st.get("unavailable")
+                or st.get("error") or str(st)}
+    try:
+        run_fn()
+    finally:
+        try:
+            bd = svc.stop()
+            if bd.get("no_window"):
+                # the expiry timer closed the window mid-burst (slow
+                # host): the capture was still parsed and stored —
+                # dump() serves it rather than discarding the evidence
+                bd = svc.dump()
+            bd.pop("top_ops", None)  # keep the round JSON bounded
+        except Exception as e:  # tracer-side close failure only
+            bd = {"unavailable": f"device trace capture failed: {e!r}"}
+    return bd
+
+
+def _capture_or_failover(run_fn, label: str) -> tuple[dict, str | None]:
+    """Capture wrapper for the phase bursts: tracer failures degrade
+    (see above); a FATAL engine error in the burst is reported as
+    ``(unavailable, error)`` so the phase can record the failover
+    verdict while keeping its already-measured numbers; data/shape
+    errors re-raise (a bench bug must surface)."""
+    try:
+        return _device_trace_capture(run_fn, label), None
+    except Exception as e:
+        from ceph_tpu.models.matrix_codec import classify_engine_error
+
+        if classify_engine_error(e) != "fatal":
+            raise
+        log(f"{label}: engine died during trace burst ({e!r:.160})")
+        return {
+            "unavailable": f"engine died during trace burst: {e!r:.200}"
+        }, repr(e)[:200]
+
+
 def log(msg: str) -> None:
     print(f"[bench +{time.time() - T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
 
@@ -440,6 +496,45 @@ def bench_device(batch: int, quick: bool, deadline: float | None,
     t_decode = t_by_dir["dec"][dec_win]
     engine = enc_win if enc_win == dec_win else f"{enc_win}/{dec_win}"
 
+    # ISSUE 9: one measured trace window over the winning engines —
+    # the phase's fused-op/DMA/collective device-time split, captured
+    # rather than inferred (the profiler tap attributes the events to
+    # the gf_encode/gf_decode engine families).  The guard is generous:
+    # the FIRST start_trace in a process pays ~15-20s of profiler init
+    # on this container class, so tight-budget children must skip
+    # capture entirely rather than burn their measurement budget on it
+    device_trace = {"unavailable": "skipped (deadline close)"}
+    if deadline is None or deadline - time.time() > 60:
+        fns = {nm: (e32, d32) for nm, e32, d32 in live}
+        import jax as _jax
+
+        enc_fn = _jax.jit(fns[enc_win][0])
+        dec_fn = _jax.jit(fns[dec_win][1])
+        # warm OUTSIDE the window: these are fresh jit wrappers (empty
+        # trace cache), and a compile inside the burst would both
+        # pollute the capture and book compile seconds as steady-state
+        # exec via compiled=False
+        _jax.block_until_ready(enc_fn(data))
+        _jax.block_until_ready(dec_fn(data))
+
+        def _burst():
+            with prof.timed(f"gf_encode[{enc_win}]",
+                            ("headline-enc", enc_win, data.shape),
+                            nbytes=data_bytes, compiled=False):
+                _jax.block_until_ready(enc_fn(data))
+            with prof.timed(f"gf_decode[{dec_win}]",
+                            ("headline-dec-full", dec_win, data.shape),
+                            nbytes=data_bytes, compiled=False):
+                _jax.block_until_ready(dec_fn(data))
+
+        device_trace, burst_err = _capture_or_failover(_burst,
+                                                       "headline")
+        if burst_err:
+            failovers.append({
+                "engine": engine, "error": burst_err,
+                "t": round(time.time() - T0, 1),
+            })
+
     out = {
         "platform": str(dev),
         "engine": engine,
@@ -467,6 +562,7 @@ def bench_device(batch: int, quick: bool, deadline: float | None,
             log(f"child: codec stack bench failed: {e!r}")
     # the phase's kernel evidence rides its own JSON line (the codec
     # stack above reported through the same profiler via matrix_codec)
+    out["device_trace"] = device_trace
     out["kernel_profile"] = prof.dump()
     return out
 
@@ -1085,6 +1181,31 @@ def bench_smallops(deadline: float | None, platform: str | None) -> dict:
     finally:
         _native._HOST_ACTIVE = saved_host_active
 
+    # ISSUE 9: one trace window over a short coalesced burst — the
+    # dispatcher-launch device-time split (measured, not inferred).
+    # 45s guard: a process whose headline already opened a window pays
+    # ~nothing here, but a first-window child pays ~15-20s of profiler
+    # init (see bench_device) and must not blow its budget on it
+    device_trace = {"unavailable": "skipped (deadline close)"}
+    if deadline is None or deadline - time.time() > 45:
+        sub = bufs[:32]
+
+        async def _window_pass():
+            disp = ECDispatcher(window=0.002, max_stripes=2048)
+            await asyncio.gather(
+                *[disp.encode(sinfo, codec, b) for b in sub]
+            )
+            await disp.stop()
+
+        saved = _native._HOST_ACTIVE
+        try:
+            _native._HOST_ACTIVE = False  # same engine the ratio raced
+            device_trace, _burst_err = _capture_or_failover(
+                lambda: asyncio.run(_window_pass()), "smallops"
+            )
+        finally:
+            _native._HOST_ACTIVE = saved
+
     return {
         "platform": str(dev),
         # cold_passes: the ratio below came from the WARM passes only
@@ -1092,6 +1213,7 @@ def bench_smallops(deadline: float | None, platform: str | None) -> dict:
         # where coalesced paid ~#buckets, so the ratio is compile-
         # inflated and must not be read as a steady-state number
         **({"cold_passes": True} if passes == 0 else {}),
+        "device_trace": device_trace,
         "ops": n_ops,
         "batch_bytes": total_bytes,
         "per_op_gbps": round(total_bytes / t_per / 1e9, 3),
@@ -1263,6 +1385,39 @@ def bench_mesh(deadline: float | None, platform: str | None) -> dict:
         }
         log(f"mesh: compile storm {compiles} compiles for "
             f"{len(sizes)} sizes (bound {bound})")
+    # ISSUE 9: MEASURED ICI share — a trace window over the top mesh's
+    # reconstruct, with the all-gather time read from the collective
+    # bucket instead of inferred from the probe_gather wall clock.
+    # ``ici_share`` gates via bench_regress --metric mesh.ici_share
+    # (lower is better: a reconstruct drifting gather-bound fails even
+    # when headline GB/s barely moves).
+    ici_share = None
+    ici_measured = False
+    device_trace = {"unavailable": "skipped (deadline close)"}
+    # 45s guard: first-window profiler init costs ~15-20s (see
+    # bench_device) — worth it for the measured ICI split only when
+    # the budget actually has room
+    if deadline is None or deadline - time.time() > 45:
+
+        def _mesh_burst():
+            for _ in range(3):
+                eng.decode_concat(sinfo, codec, surv)
+
+        device_trace, _burst_err = _capture_or_failover(
+            _mesh_burst, "mesh-reconstruct"
+        )
+        rec = device_trace.get("engines", {}).get("mesh_reconstruct")
+        src = rec or device_trace.get("buckets")
+        if src:
+            total = (src.get("fused_op", 0.0) + src.get("dma", 0.0)
+                     + src.get("collective", 0.0))
+            if total > 0:
+                ici_share = round(src["collective"] / total, 4)
+                ici_measured = True
+    if ici_share is None and gather.get("share_of_reconstruct"):
+        # wall-clock inference fallback (the pre-ISSUE-9 number): the
+        # metric stays on the trajectory even when tracing degrades
+        ici_share = gather["share_of_reconstruct"]
     return {
         "platform": str(devs[0]),
         "n_devices": len(devs),
@@ -1277,6 +1432,10 @@ def bench_mesh(deadline: float | None, platform: str | None) -> dict:
         "encode_gbps": top["encode_gbps"],
         "reconstruct_gbps": top["reconstruct_gbps"],
         **({"gather": gather} if gather else {}),
+        **({"ici_share": ici_share,
+            "ici_share_measured": ici_measured}
+           if ici_share is not None else {}),
+        "device_trace": device_trace,
         "compile_storm": storm,
         "kernel_profile": prof.dump(prefix="mesh"),
     }
@@ -2106,20 +2265,24 @@ def main():
                     k: r["smallops"][k] for k in (
                         "platform", "ops", "batch_bytes", "per_op_gbps",
                         "coalesced_gbps", "coalesced_vs_per_op",
-                        "dispatch",
+                        "dispatch", "device_trace",
                     ) if k in r["smallops"]
                 }
             if "mesh" not in final and r.get("mesh", {}).get("scaling"):
                 # the multi-chip scaling record (ISSUE 8): per-chip
                 # efficiency rides the round JSON so bench_regress can
-                # gate mesh.scaling_efficiency across rounds
+                # gate mesh.scaling_efficiency across rounds; ici_share
+                # (ISSUE 9, measured from the trace window's collective
+                # bucket) gates mesh.ici_share the same way
                 final["mesh"] = {
                     k: r["mesh"][k] for k in (
                         "platform", "n_devices", "batch_bytes", "codec",
                         "scaling", "scaling_efficiency",
                         "reconstruct_scaling_efficiency",
                         "mesh_vs_single_chip", "encode_gbps",
-                        "reconstruct_gbps", "gather", "compile_storm",
+                        "reconstruct_gbps", "gather", "ici_share",
+                        "ici_share_measured", "device_trace",
+                        "compile_storm",
                     ) if k in r["mesh"]
                 }
             if "stack_gbps" not in final and (

@@ -94,7 +94,8 @@ def register_common(asok: "AdminSocket", *, perf=None, config=None) -> None:
     """The observability commands every daemon serves — one wiring for
     osd/mon/mgr/rgw so the surfaces cannot drift: ``perf dump`` /
     ``perf schema`` / ``perf reset``, ``dump_histograms``,
-    ``dump_kernel_profile``, ``config show|diff|set``, ``log dump``,
+    ``dump_kernel_profile``, ``kernel trace start|stop|status|dump``
+    (ops.device_trace windows), ``config show|diff|set``, ``log dump``,
     ``dump_tracepoints`` (optionally filtered to one trace id via
     {"trace": ...})."""
     if perf is not None:
@@ -129,11 +130,84 @@ def register_common(asok: "AdminSocket", *, perf=None, config=None) -> None:
         kp = _kernel_profiler()
         if kp is None:
             return {"error": "kernel profiler unavailable"}
-        return kp.dump()
+        top = req.get("top")
+        # NB: req["prefix"] is the admin COMMAND name — the engine-
+        # family filter rides a separate key
+        return kp.dump(prefix=req.get("engine"),
+                       top=int(top) if top is not None else None)
 
     asok.register("dump_kernel_profile", _dump_kernel_profile,
                   "JAX/Pallas kernel timings: compile vs execute, "
-                  "jit-cache hits/misses, batch shapes per engine")
+                  "jit-cache hits/misses, batch shapes per engine "
+                  "(optional {'top': N, 'engine': <family prefix>})")
+
+    # -- device trace windows (ceph_tpu.ops.device_trace, ROADMAP 5a):
+    # one process-wide jax.profiler window at a time, served from every
+    # daemon's socket.  start/stop/dump run in an executor — start_trace
+    # and the capture parse take tens of milliseconds, and an admin
+    # command must never stall heartbeats or in-flight ops.
+    def _device_tracer():
+        try:
+            from ..ops.device_trace import tracer
+        except Exception:  # pragma: no cover - broken partial install
+            return None
+        return tracer()
+
+    async def _in_executor(fn, *args):
+        return await asyncio.get_running_loop().run_in_executor(
+            None, fn, *args
+        )
+
+    async def _ktrace_start(req: dict):
+        svc = _device_tracer()
+        if svc is None:
+            return {"unavailable": "device tracer unavailable"}
+        max_s = 30.0
+        if config is not None:
+            try:
+                max_s = float(config.get("kernel_trace_max_duration"))
+            except Exception:  # pragma: no cover - option table gap
+                pass
+        duration = req.get("duration")
+        label = str(req.get("label", "") or "")
+        return await _in_executor(
+            lambda: svc.start(
+                duration=float(duration) if duration else None,
+                label=label, max_duration=max_s,
+            )
+        )
+
+    async def _ktrace_stop(_req: dict):
+        svc = _device_tracer()
+        if svc is None:
+            return {"unavailable": "device tracer unavailable"}
+        return await _in_executor(svc.stop)
+
+    def _ktrace_status(_req: dict):
+        svc = _device_tracer()
+        if svc is None:
+            return {"unavailable": "device tracer unavailable"}
+        return svc.status()
+
+    async def _ktrace_dump(_req: dict):
+        svc = _device_tracer()
+        if svc is None:
+            return {"unavailable": "device tracer unavailable"}
+        return await _in_executor(svc.dump)
+
+    asok.register("kernel trace start", _ktrace_start,
+                  "open a jax.profiler device trace window "
+                  "({'duration': s, 'label': ...}; bounded by "
+                  "kernel_trace_max_duration, one window at a time)")
+    asok.register("kernel trace stop", _ktrace_stop,
+                  "close the open trace window and parse it into the "
+                  "per-engine fused-op/DMA/collective breakdown")
+    asok.register("kernel trace status", _ktrace_status,
+                  "trace window state + per-bucket device-seconds "
+                  "totals across windows")
+    asok.register("kernel trace dump", _ktrace_dump,
+                  "the last closed window's breakdown (auto-closes an "
+                  "expired window first)")
     if config is not None:
         asok.register("config show", lambda req: config.show(),
                       "every option with its current value")

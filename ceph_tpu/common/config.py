@@ -274,6 +274,18 @@ OPTIONS: dict[str, Option] = _opts(
     # admin
     Option("admin_socket", str, "",
            "unix socket path for perf dump / config commands ('' = off)"),
+    # kernel visibility (ceph_tpu.ops.device_trace): on-demand
+    # jax.profiler trace windows + the device-launch flight recorder
+    Option("kernel_trace_max_duration", float, 30.0,
+           "hard cap on one `kernel trace start` window (s): the "
+           "requested duration clamps here and an expired window "
+           "auto-closes on the next service call, so an operator "
+           "cannot leave profiler overhead armed on the device path"),
+    Option("osd_ec_launch_history", int, 64,
+           "device-launch flight-recorder depth: the last N EC "
+           "launches (lane, batch key, QoS class, queue-wait vs "
+           "device wall, slowest member op's trace id) kept for "
+           "dump_launch_history and the SLOW_OPS dump enrichment"),
     # auth (reference:src/auth; auth_supported / keyring options)
     Option("auth_supported", str, "none",
            "authentication: none | cephx (handshake tickets)"),

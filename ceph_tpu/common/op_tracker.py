@@ -80,6 +80,12 @@ class OpTracker:
         # longest-duration ring (OpHistory's duration-sorted set): kept
         # sorted descending, bounded to history_size
         self._slowest: list[TrackedOp] = []
+        # optional trace-id -> device-launch lookup (the EC flight
+        # recorder, ops.device_trace.FlightRecorder.lookup): when set,
+        # op dumps carry the launch that carried the op — a SLOW_OPS
+        # investigation names the lane/batch/QoS class directly instead
+        # of leaving the operator to correlate timestamps by hand
+        self.launch_lookup = None
 
     # -- lifecycle
     def create(self, trace: str | None = None, **desc: Any) -> TrackedOp:
@@ -141,18 +147,30 @@ class OpTracker:
         ]
 
     # -- admin-socket command bodies
+    def _dump_op(self, op: TrackedOp, now: float | None = None) -> dict:
+        out = op.dump(now)
+        lookup = self.launch_lookup
+        if lookup is not None and op.trace is not None:
+            try:
+                launch = lookup(op.trace)
+            except Exception:  # pragma: no cover - observability only
+                launch = None
+            if launch is not None:
+                out["launch"] = launch
+        return out
+
     def dump_ops_in_flight(self) -> dict:
         now = time.monotonic()
-        ops = [o.dump(now) for o in self._inflight.values()]
+        ops = [self._dump_op(o, now) for o in self._inflight.values()]
         return {"num_ops": len(ops), "ops": ops}
 
     def dump_historic_ops(self) -> dict:
         return {"num_ops": len(self._historic),
-                "ops": [o.dump() for o in self._historic]}
+                "ops": [self._dump_op(o) for o in self._historic]}
 
     def dump_historic_ops_by_duration(self) -> dict:
         return {"num_ops": len(self._slowest),
-                "ops": [o.dump() for o in self._slowest]}
+                "ops": [self._dump_op(o) for o in self._slowest]}
 
     def register_admin(self, asok) -> None:
         """The three reference dump commands, on any daemon's socket."""

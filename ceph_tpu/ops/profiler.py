@@ -10,11 +10,14 @@ process-global timing tap every host-side kernel entry reports into:
 - **trace/compile vs execute split**: jitted callables compile once per
   (program, input-shape) signature; the first call on a new signature
   pays tracing + XLA/Mosaic compilation on top of the execution.  The
-  profiler keys every call on the caller-supplied signature and counts
-  first sightings as ``compile`` calls (their wall time includes the
-  first execution — JAX gives no portable hook to separate them; the
-  steady-state ``exec`` numbers are the clean ones) and repeats as
-  jit-cache ``hits``.
+  profiler keys every call on the caller-supplied signature: first
+  sightings count as jit-cache ``misses``, repeats as ``hits``.  Where
+  jax allows AOT (``lower().compile()``, via :meth:`KernelProfiler.
+  call_jitted`) the compile is timed alone (``compile_time``,
+  ``aot_split=true``) and the first execution joins the steady-state
+  numbers; otherwise the fused first call is reported as
+  ``first_exec_s`` — in NEITHER compile nor exec time, so neither
+  stat lies for codecs that cannot AOT.
 - **per-engine batch shapes**: which [k, N] / [n_x] shapes actually hit
   each engine, so batching regressions (a shape explosion defeating the
   jit cache) are visible instead of inferred.
@@ -45,7 +48,8 @@ _KERNEL_AXES = dict(size_min=4096.0, lat_min=1e-6)
 class _EngineStats:
     __slots__ = ("calls", "compile_calls", "cache_hits", "compile_time",
                  "exec_time", "bytes", "exec_bytes", "shapes", "hist",
-                 "aot_splits")
+                 "aot_splits", "first_exec_time", "first_execs",
+                 "device")
 
     def __init__(self):
         self.calls = 0
@@ -58,6 +62,15 @@ class _EngineStats:
         self.shapes: dict[str, int] = {}
         self.hist = PerfHistogram(size_latency_axes(**_KERNEL_AXES))
         self.aot_splits = 0  # compiles timed separately via jax AOT
+        # first sightings of a signature on the NON-AOT path: tracing +
+        # compile + the first execution fused in one wall time (jax
+        # offers no portable split without lower().compile()) — kept
+        # out of BOTH compile_time and exec_time so neither stat lies
+        self.first_exec_time = 0.0
+        self.first_execs = 0
+        # per-bucket device-seconds merged from a jax.profiler trace
+        # window (ops.device_trace): fused_op / dma / collective
+        self.device: dict[str, float] = {}
 
 
 class KernelProfiler:
@@ -88,6 +101,11 @@ class KernelProfiler:
         # the jit-cache miss (compiles are rare; contention is fine)
         self._compile_lock = threading.Lock()
         self._reset_at = time.time()
+        # ops.device_trace window sink: while a trace window is open,
+        # every recorded call reports its (engine, key, wall interval)
+        # for per-engine attribution of the captured device events.
+        # One attribute read when no window exists — zero-cost default.
+        self.trace_sink: Any = None
 
     # -- recording -----------------------------------------------------------
     def record(self, engine: str, key: Hashable, seconds: float,
@@ -95,7 +113,14 @@ class KernelProfiler:
                compiled: bool | None = None) -> None:
         """``compiled`` overrides the first-sighting classification for
         callers that know (bench.py records a chained-scan marginal as
-        steady-state even on a shape it never timed standalone)."""
+        steady-state even on a shape it never timed standalone;
+        ``compiled=True`` marks a pure compile).  An un-overridden
+        first sighting lands in the ``first_exec`` bucket: its wall
+        time fuses tracing + compile + the first execution, so folding
+        it into either compile_time or exec_time would lie (ROADMAP 5a
+        caveat — the AOT path in :meth:`call_jitted` is the only place
+        a clean compile-only time exists)."""
+        t_end = time.perf_counter()
         sig = (engine, key)
         with self._lock:
             st = self._engines.get(engine)
@@ -103,12 +128,15 @@ class KernelProfiler:
                 st = self._engines[engine] = _EngineStats()
             st.calls += 1
             st.bytes += int(nbytes)
-            was_compile = (sig not in self._seen) if compiled is None \
-                else compiled
+            first = sig not in self._seen
             self._seen.add(sig)
-            if was_compile:
+            if compiled is True:
                 st.compile_calls += 1
                 st.compile_time += seconds
+            elif compiled is None and first:
+                st.compile_calls += 1  # a jit-cache miss either way
+                st.first_execs += 1
+                st.first_exec_time += seconds
             else:
                 st.cache_hits += 1
                 st.exec_time += seconds
@@ -117,6 +145,13 @@ class KernelProfiler:
                 s = str(tuple(shape))
                 st.shapes[s] = st.shapes.get(s, 0) + 1
         st.hist.sample(max(float(nbytes), 0.0), seconds)
+        sink = self.trace_sink
+        if sink is not None and sink.active:
+            try:
+                sink.note_kernel(engine, key, seconds, nbytes=nbytes,
+                                 t_end_pc=t_end)
+            except Exception:  # pragma: no cover - observability only
+                pass
 
     @contextlib.contextmanager
     def timed(self, engine: str, key: Hashable, nbytes: int = 0,
@@ -184,18 +219,55 @@ class KernelProfiler:
             out = f(*args)
             return out if wrap is None else wrap(out)
 
+    def merge_device_time(self,
+                          per_engine: dict[str, dict[str, float]]) -> None:
+        """Fold a closed trace window's per-engine device-event buckets
+        (ops.device_trace: fused_op / dma / collective seconds) into
+        the matching engine entries, so ``dump_kernel_profile`` answers
+        "where did the device time go INSIDE the program?" next to the
+        compile/exec stats.  Accumulates across windows; cleared by
+        :meth:`reset` like every other per-engine stat."""
+        with self._lock:
+            for engine, buckets in per_engine.items():
+                st = self._engines.get(engine)
+                if st is None:
+                    st = self._engines[engine] = _EngineStats()
+                for bucket, seconds in buckets.items():
+                    st.device[bucket] = (
+                        st.device.get(bucket, 0.0) + float(seconds)
+                    )
+
     # -- views ---------------------------------------------------------------
-    def dump(self, prefix: str | None = None) -> dict:
+    @staticmethod
+    def _engine_seconds(st: _EngineStats) -> float:
+        return st.compile_time + st.first_exec_time + st.exec_time
+
+    def dump(self, prefix: str | None = None,
+             top: int | None = None) -> dict:
         """JSON-able per-engine breakdown (``dump_kernel_profile``).
         ``prefix`` filters to one engine family — bench.py's mesh phase
         embeds ``dump(prefix="mesh")`` so the mesh shard_map programs
         (mesh_encode / mesh_reconstruct / mesh_gather) read distinctly
-        from the single-chip kernel entries."""
+        from the single-chip kernel entries.  ``top`` keeps only the N
+        heaviest engines by recorded seconds (a busy daemon's dump
+        stays readable without paging through every signature); each
+        entry carries ``device_share`` — its recorded seconds over the
+        window total — so the heavy hitters read at a glance."""
         with self._lock:
+            picked = [
+                (name, st)
+                for name, st in sorted(self._engines.items())
+                if prefix is None or name.startswith(prefix)
+            ]
+            total_s = sum(self._engine_seconds(st) for _n, st in picked)
+            n_matched = len(picked)
+            if top is not None and top >= 0:
+                picked = sorted(
+                    picked, key=lambda ns: -self._engine_seconds(ns[1])
+                )[:top]
+                picked.sort(key=lambda ns: ns[0])
             engines = {}
-            for name, st in sorted(self._engines.items()):
-                if prefix is not None and not name.startswith(prefix):
-                    continue
+            for name, st in picked:
                 engines[name] = {
                     "calls": st.calls,
                     "jit_cache": {
@@ -204,11 +276,16 @@ class KernelProfiler:
                     },
                     # aot_split=True: compiles were timed separately via
                     # jax AOT (lower().compile()), so compile_time holds
-                    # NO execution; otherwise first-call time includes
-                    # the first execution (no portable compile-only
-                    # hook on the plain jit path)
+                    # NO execution and first executions land in
+                    # exec_time; aot_split=False: compiles could not be
+                    # split, so each signature's first call — tracing +
+                    # compile + first execution fused — is reported as
+                    # first_exec_s, in NEITHER compile_time nor
+                    # exec_time (ROADMAP 5a: the old accounting called
+                    # it "compile" and lied)
                     "aot_split": st.aot_splits > 0,
                     "compile_time": round(st.compile_time, 6),
+                    "first_exec_s": round(st.first_exec_time, 6),
                     "exec_time": round(st.exec_time, 6),
                     # steady-state bytes over steady-state time: mixing
                     # compile-call bytes in would inflate the rate by
@@ -217,10 +294,23 @@ class KernelProfiler:
                         st.exec_bytes / st.exec_time / 1e9, 3
                     ) if st.exec_time > 0 else None,
                     "bytes": st.bytes,
+                    "device_share": round(
+                        self._engine_seconds(st) / total_s, 4
+                    ) if total_s > 0 else 0.0,
                     "shapes": dict(st.shapes),
+                    # per-bucket device-event seconds from the last
+                    # trace window(s) (ops.device_trace merge); absent
+                    # until a window captured this engine
+                    **({"device_trace": {
+                        b: round(v, 6)
+                        for b, v in sorted(st.device.items())
+                    }} if st.device else {}),
                 }
             return {
                 "since": self._reset_at,
+                "total_seconds": round(total_s, 6),
+                **({"engines_omitted": n_matched - len(engines)}
+                   if len(engines) < n_matched else {}),
                 "engines": engines,
             }
 

@@ -349,6 +349,23 @@ class OSD(Dispatcher):
             "requests coalesced per mesh-lane launch",
             axes=[PerfHistogramAxis("ops", min=1.0, buckets=12)],
         )
+        # inside-the-kernel device tracing (ops/device_trace, ROADMAP
+        # 5a): per-bucket device-seconds accumulated across closed
+        # `kernel trace` windows, pulled off the report tick; the
+        # occupancy gauge reflects the LAST window (device-busy seconds
+        # / window wall — parallel execution threads can push it >1)
+        pec.add_counter("device_time_fused_op",
+                        "traced device seconds in fused-op/compute "
+                        "HLO events (kernel trace windows)")
+        pec.add_counter("device_time_dma",
+                        "traced device seconds in DMA/infeed/outfeed/"
+                        "copy events")
+        pec.add_counter("device_time_collective",
+                        "traced device seconds in ICI collective "
+                        "events (all-gather/all-reduce/...)")
+        pec.add_gauge("device_occupancy",
+                      "device-busy share of the last trace window "
+                      "(>1 = parallel execution threads)")
         # accelerator fault domain (osd/ec_failover): the engine_state
         # gauge feeds the mgr's ACCEL_DEGRADED health check
         pec.add_gauge("engine_state",
@@ -487,6 +504,7 @@ class OSD(Dispatcher):
                 supervisor=self.ec_supervisor,
                 launch_deadline=cfg.osd_ec_launch_deadline,
                 mesh_engine=self.ec_mesh,
+                launch_history=cfg.osd_ec_launch_history,
             )
             self.ec_dispatch.inject_engine_failure = \
                 cfg.ec_inject_engine_failure
@@ -520,6 +538,11 @@ class OSD(Dispatcher):
         self.op_tracker = OpTracker(
             history_size=cfg.osd_op_history_size
         )
+        if self.ec_dispatch is not None:
+            # SLOW_OPS -> launch correlation (ROADMAP 5a): an op dump
+            # names the device launch that carried it, straight from
+            # the dispatcher's flight recorder
+            self.op_tracker.launch_lookup = self.ec_dispatch.flight.lookup
         self._slow_reported = 0  # slow ops already clog'd (edge trigger)
         self._mon_conn: Connection | None = None
         self._admin = None
@@ -882,6 +905,13 @@ class OSD(Dispatcher):
                 lambda req: self.ec_dispatch.dump(),
                 "EC microbatch dispatcher: open batches, flush reasons, "
                 "pad waste, observed bucket table",
+            )
+            a.register(
+                "dump_launch_history",
+                lambda req: self.ec_dispatch.flight.dump(),
+                "device-launch flight recorder: the last N launches "
+                "(lane, batch key, QoS class, queue-wait vs device "
+                "wall, slowest member op's trace id)",
             )
         if self.ec_supervisor is not None:
             a.register(
@@ -3814,6 +3844,7 @@ class OSD(Dispatcher):
             # engine_state must survive an admin `perf reset` — a
             # zeroed gauge would clear ACCEL_DEGRADED while TRIPPED
             self.ec_supervisor.refresh_gauge()
+        self._pull_device_trace_totals()
         slow = self.op_tracker.slow_ops(self.config.osd_op_complaint_time)
         posd = self.perf.get("osd")
         posd.set("slow_ops", len(slow))
@@ -3827,6 +3858,32 @@ class OSD(Dispatcher):
                 f"{self.config.osd_op_complaint_time:g}s)",
             )
         self._slow_reported = len(slow)
+
+    def _pull_device_trace_totals(self) -> None:
+        """Fold the process-global device tracer's per-bucket totals
+        (ops/device_trace: seconds of traced fused-op / DMA / ICI-
+        collective device events across closed `kernel trace` windows)
+        into this daemon's ``ec.device_time_*`` counters, and mirror
+        the last window's occupancy into the ``device_occupancy``
+        gauge — the mgr prometheus module then exports the breakdown
+        like every other family.  consume_totals hands each window's
+        seconds out exactly once process-wide, so with N in-process
+        daemons a sum over their series equals the true traced time
+        (each daemon independently delta-pulling totals() would
+        report N copies)."""
+        try:
+            from ..ops.device_trace import tracer
+
+            tot = tracer().consume_totals()
+        except Exception:  # tracer unavailable: observability only
+            return
+        pec = self.perf.get("ec")
+        for bucket, key in (("fused_op", "device_time_fused_op"),
+                            ("dma", "device_time_dma"),
+                            ("collective", "device_time_collective")):
+            if tot[bucket] > 0:
+                pec.inc(key, tot[bucket])
+        pec.set("device_occupancy", tot["last_occupancy"])
 
     async def _collect_pg_stats(self) -> tuple[dict, int]:
         """Per-led-PG object/byte counts from the local store (the

@@ -86,8 +86,14 @@ Observability: batch/op/flush-reason/pad counters plus a
 through perf dump -> mgr prometheus like every other key), the
 ``engine_state`` gauge and ``engine_failovers``/``replayed_ops``/
 ``launch_deadline_timeouts`` counters for the fault domain, the
-KernelProfiler sees the bucketed shapes at the codec boundary, and
-``dump_ec_dispatch`` on the admin socket serves :meth:`ECDispatcher.dump`.
+KernelProfiler sees the bucketed shapes at the codec boundary,
+``dump_ec_dispatch`` on the admin socket serves :meth:`ECDispatcher.dump`,
+and every launch (batched, native-direct, fallback-direct) lands in the
+:class:`~ceph_tpu.ops.device_trace.FlightRecorder` ring — lane, batch
+key, QoS class, queue-wait vs device wall, slowest member trace id —
+served by ``dump_launch_history`` and consulted by the SLOW_OPS dump
+path, while an open ``kernel trace`` window (ops.device_trace) captures
+the launches' device-side fused-op/DMA/collective breakdown.
 """
 
 from __future__ import annotations
@@ -100,7 +106,9 @@ from typing import Any, Mapping
 
 import numpy as np
 
+from ..common.tracing import current_trace
 from ..models.matrix_codec import EngineFault
+from ..ops.device_trace import FlightRecorder
 from ..utils.buffers import as_u8, note_copy
 from . import ec_util
 
@@ -132,24 +140,29 @@ def bucket_stripes_aligned(s: int, quantum: int = 1,
 
 
 class _Op:
-    """One queued waiter: its payload and the future its op awaits."""
+    """One queued waiter: its payload and the future its op awaits.
+    ``trace``/``t_submit`` feed the launch flight recorder — the
+    queue-wait split and the slow-op -> launch correlation."""
 
-    __slots__ = ("fut", "stripes", "payload")
+    __slots__ = ("fut", "stripes", "payload", "trace", "t_submit")
 
     def __init__(self, fut: asyncio.Future, stripes: int, payload: Any):
         self.fut = fut
         self.stripes = stripes
         self.payload = payload
+        self.trace = current_trace.get()
+        self.t_submit = time.monotonic()
 
 
 class _Batch:
     """One still-collecting batch for a queue key."""
 
     __slots__ = ("kind", "codec", "sinfo", "ops", "stripes", "timer",
-                 "lane", "quantum")
+                 "lane", "quantum", "klass")
 
     def __init__(self, kind: str, codec, sinfo: ec_util.StripeInfo,
-                 lane: str = "device", quantum: int = 1):
+                 lane: str = "device", quantum: int = 1,
+                 klass: str = "client"):
         self.kind = kind  # "enc" | "dec"
         self.codec = codec
         self.sinfo = sinfo
@@ -158,6 +171,7 @@ class _Batch:
         self.timer: asyncio.TimerHandle | None = None
         self.lane = lane  # "device" | "mesh"
         self.quantum = int(quantum)  # stripe-alignment (mesh size)
+        self.klass = klass  # QoS traffic class (classes never mix)
 
 
 class ECDispatcher:
@@ -172,7 +186,8 @@ class ECDispatcher:
                  max_stripes: int = 512, bucket: bool = True,
                  max_workers: int = 2, scheduler=None,
                  supervisor=None, launch_deadline: float = 0.0,
-                 hb_handle=None, mesh_engine=None):
+                 hb_handle=None, mesh_engine=None,
+                 launch_history: int = 64):
         self._perf = perf
         # the multi-chip mesh lane (parallel/engine.MeshEcEngine; None
         # = single-device only).  supports()/routes() never touch the
@@ -245,6 +260,12 @@ class ECDispatcher:
         self._buckets_seen: dict[str, dict[int, int]] = {
             "device": {}, "mesh": {},
         }
+        # device-launch flight recorder (ops.device_trace, ROADMAP 5a):
+        # the last N launches with lane / batch key / QoS class /
+        # queue-wait vs device wall / slowest member trace id, served
+        # by dump_launch_history and consulted by the SLOW_OPS dump
+        # path (OpTracker.launch_lookup)
+        self.flight = FlightRecorder(capacity=launch_history)
 
     # -- public API ----------------------------------------------------------
 
@@ -287,7 +308,8 @@ class ECDispatcher:
             # no launch/compile overhead to amortize on the C engine —
             # keep per-op (cache-resident) calls, just off the loop
             return await self._run_native_direct(
-                ec_util.encode, sinfo, codec, buf, "encode", buf.size
+                ec_util.encode, sinfo, codec, buf, "encode", buf.size,
+                klass=klass,
             )
         if self._supervisor is not None and not self._supervisor.device_ok():
             # breaker TRIPPED/PROBING: the device engine — mesh slice
@@ -297,7 +319,7 @@ class ECDispatcher:
             # the supervisor re-promotes)
             return await self._run_fallback_direct(
                 ec_util.encode_fallback, sinfo, codec, buf,
-                "encode", buf.size,
+                "encode", buf.size, klass=klass,
             )
         mesh_slice = (
             self._mesh.mesh_key(codec.get_data_chunk_count())
@@ -306,7 +328,8 @@ class ECDispatcher:
         key = ("enc", lane, mesh_slice, klass, id(codec),
                sinfo.stripe_width, sinfo.chunk_size)
         return await self._submit(key, "enc", codec, sinfo, buf, stripes,
-                                  lane=lane, mesh_slice=mesh_slice)
+                                  lane=lane, mesh_slice=mesh_slice,
+                                  klass=klass)
 
     async def decode_concat(
         self, sinfo: ec_util.StripeInfo, codec,
@@ -345,19 +368,20 @@ class ECDispatcher:
         if lane != "mesh" and ec_util.native_decode_path(codec, shard_len):
             return await self._run_native_direct(
                 ec_util.decode_concat, sinfo, codec, arrs, "decode",
-                shard_len * len(arrs),
+                shard_len * len(arrs), klass=klass,
             )
         if self._supervisor is not None and not self._supervisor.device_ok():
             return await self._run_fallback_direct(
                 ec_util.decode_concat_fallback, sinfo, codec, arrs,
-                "decode", shard_len * len(arrs),
+                "decode", shard_len * len(arrs), klass=klass,
             )
         present = tuple(sorted(arrs))
         mesh_slice = self._mesh.mesh_key(k) if lane == "mesh" else None
         key = ("dec", lane, mesh_slice, klass, id(codec),
                sinfo.stripe_width, sinfo.chunk_size, present)
         return await self._submit(key, "dec", codec, sinfo, arrs, stripes,
-                                  lane=lane, mesh_slice=mesh_slice)
+                                  lane=lane, mesh_slice=mesh_slice,
+                                  klass=klass)
 
     def _inline_encode_fn(self):
         """Engine for the inline per-op lanes (empty payload, shutdown
@@ -479,7 +503,8 @@ class ECDispatcher:
 
     async def _run_direct(self, fn, sinfo, codec, payload, op: str,
                           nbytes: int, totals_key: str,
-                          perf_key: str | None = None):
+                          perf_key: str | None = None,
+                          klass: str = "client"):
         """Per-op call in the worker pool (event-loop liberation
         without coalescing) — shared by the native C lane and the
         host-fallback lane (the serving path while the device engine
@@ -487,18 +512,38 @@ class ECDispatcher:
         not read as device time in the gauges/histograms under load —
         and whichever engine serves, its time feeds the same gauges
         (the daemon's op-level timer includes executor-hop wait, so it
-        no longer feeds them on the dispatch route)."""
+        no longer feeds them on the dispatch route).  Direct calls are
+        launches too: they ride the flight recorder (lane =
+        native_direct/fallback_direct, one-op "batch"), so a slow op
+        served off-device still names what carried it."""
         self._totals[totals_key] = self._totals.get(totals_key, 0) + 1
         if self._perf is not None and perf_key is not None:
             self._perf.inc(perf_key)
         loop = asyncio.get_running_loop()
+        flight = self.flight.begin(
+            lane=totals_key, kind="enc" if op == "encode" else "dec",
+            klass=klass, ops=1, stripes=None,
+            stripe_width=sinfo.stripe_width,
+            chunk_size=sinfo.chunk_size, queue_wait_s=0.0,
+            slowest_trace=current_trace.get(),
+            traces=[current_trace.get()],
+        )
 
         def _timed_call():
             t0 = time.perf_counter()
             res = fn(sinfo, codec, payload)
             return res, time.perf_counter() - t0
 
-        out, dt = await loop.run_in_executor(self._executor, _timed_call)
+        try:
+            out, dt = await loop.run_in_executor(self._executor,
+                                                 _timed_call)
+        except BaseException as e:
+            # BaseException: a cancelled waiter (CancelledError) must
+            # close its flight record too, or _inflight leaks phantom
+            # launches forever
+            self.flight.end(flight, served="error", error=repr(e))
+            raise
+        self.flight.end(flight, device_wall_s=dt, served=totals_key)
         if self._perf is not None:
             try:
                 ec_util.account_ec_call(self._perf, op, nbytes, dt)
@@ -507,19 +552,21 @@ class ECDispatcher:
         return out
 
     def _run_native_direct(self, fn, sinfo, codec, payload, op: str,
-                           nbytes: int):
+                           nbytes: int, klass: str = "client"):
         return self._run_direct(fn, sinfo, codec, payload, op, nbytes,
                                 "native_direct",
-                                perf_key="dispatch_native_direct")
+                                perf_key="dispatch_native_direct",
+                                klass=klass)
 
     def _run_fallback_direct(self, fn, sinfo, codec, payload, op: str,
-                             nbytes: int):
+                             nbytes: int, klass: str = "client"):
         return self._run_direct(fn, sinfo, codec, payload, op, nbytes,
-                                "fallback_direct")
+                                "fallback_direct", klass=klass)
 
     async def _submit(self, key: tuple, kind: str, codec, sinfo,
                       payload, stripes: int, *, lane: str = "device",
-                      mesh_slice: tuple | None = None):
+                      mesh_slice: tuple | None = None,
+                      klass: str = "client"):
         loop = asyncio.get_running_loop()
         b = self._open.get(key)
         if b is not None and b.ops and (
@@ -540,7 +587,8 @@ class ECDispatcher:
                 mesh_slice[0] * mesh_slice[1] if mesh_slice else 1
             )
             b = self._open[key] = _Batch(kind, codec, sinfo,
-                                         lane=lane, quantum=quantum)
+                                         lane=lane, quantum=quantum,
+                                         klass=klass)
             delay = self.window if self._last_ops > 1 else 0.0
             b.timer = loop.call_later(delay, self._flush, key, "window")
         fut = loop.create_future()
@@ -571,8 +619,39 @@ class ECDispatcher:
         self._tasks.add(task)
         task.add_done_callback(self._tasks.discard)
 
+    def _flight_begin(self, b: _Batch, ops: list[_Op],
+                      reason: str) -> int:
+        """Open the launch's flight-recorder record BEFORE the device
+        call: a wedged launch must be findable while it is in flight
+        (the slow ops it is carrying are in flight too).  The slowest
+        member is the op that queued earliest — its wait IS the
+        batch's queue-wait number."""
+        now = time.monotonic()
+        oldest = min(ops, key=lambda op: op.t_submit)
+        return self.flight.begin(
+            lane=b.lane, kind=b.kind, klass=b.klass, reason=reason,
+            ops=len(ops), stripes=b.stripes,
+            stripe_width=b.sinfo.stripe_width,
+            chunk_size=b.sinfo.chunk_size,
+            queue_wait_s=round(now - oldest.t_submit, 6),
+            slowest_trace=oldest.trace,
+            traces=[op.trace for op in ops],
+        )
+
     async def _run_batch(self, b: _Batch, ops: list[_Op],
                          reason: str) -> None:
+        flight = self._flight_begin(b, ops, reason)
+        try:
+            await self._run_batch_inner(b, ops, reason, flight)
+        finally:
+            # safety net: every exit path above ends the record; a
+            # CANCELLED task (loop teardown mid-launch) reaches only
+            # this finally — end() is a no-op when already ended
+            self.flight.end(flight, served="cancelled",
+                            error="launch task cancelled")
+
+    async def _run_batch_inner(self, b: _Batch, ops: list[_Op],
+                               reason: str, flight: int) -> None:
         try:
             results, pad, seconds = await self._launch(b, ops)
             if self._supervisor is not None:
@@ -600,6 +679,7 @@ class ECDispatcher:
                 for op in ops:
                     if not op.fut.done():
                         op.fut.set_exception(e)
+                self.flight.end(flight, served="error", error=repr(e))
                 return
             self._last_trip = (b.kind, b.sinfo, b.codec, b.lane)
             try:
@@ -611,16 +691,21 @@ class ECDispatcher:
                 for op in ops:
                     if not op.fut.done():
                         op.fut.set_exception(e2)
+                self.flight.end(flight, served="error", error=repr(e2))
                 return
             self._note_failover(b, ops, e)
             served = "fallback"
+            flight_error = repr(e)
         else:
             served = b.lane
+            flight_error = None
         # waiters resolve FIRST: accounting (a partially-registered
         # PerfCounters, say) must never wedge the data path
         for op, res in zip(ops, results):
             if not op.fut.done():
                 op.fut.set_result(res)
+        self.flight.end(flight, device_wall_s=seconds, served=served,
+                        error=flight_error)
         try:
             self._note_batch(b, ops, reason, pad, seconds, served)
         except Exception:  # swallow-ok: observability is best-effort by contract

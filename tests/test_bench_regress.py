@@ -287,6 +287,80 @@ class TestBenchRegress:
              "--threshold", "0.5"]
         ) == 0
 
+    # -- mesh.ici_share (ISSUE 9): lower-is-better gate ----------------------
+
+    def _write_ici_round(self, tmp_path, n, phase, value, ici=None):
+        line = {"metric": "m", "value": value, "unit": "GB/s",
+                "phase": phase}
+        if ici is not None:
+            line["mesh"] = {"ici_share": ici, "ici_share_measured": True,
+                            "scaling_efficiency": 0.9}
+        (tmp_path / f"BENCH_r{n:02d}.json").write_text(
+            json.dumps({"n": n, "rc": 0, "parsed": line})
+        )
+
+    def test_ici_share_growth_is_the_regression(self, tmp_path):
+        """mesh.ici_share is lower-is-better: a reconstruct drifting
+        from compute-bound to gather-bound fails the gate even when
+        headline GB/s barely moves.  (0.2+0.1)/(0.6+0.1) = 0.43 <
+        0.8 -> regression, via both metric spellings."""
+        br = _load_tool()
+        self._write_ici_round(tmp_path, 1, "tpu", 660.0, ici=0.2)
+        self._write_ici_round(tmp_path, 2, "tpu", 658.0, ici=0.6)
+        rep = br.compare(br.load_rounds(str(tmp_path)),
+                         metric="mesh.ici_share")
+        assert rep["comparable"] and rep["lower_is_better"]
+        assert rep["regression"] is True
+        for metric in ("mesh.ici_share", "mesh_ici_share"):
+            assert br.main(
+                ["--dir", str(tmp_path), "--metric", metric]
+            ) == 1, metric
+
+    def test_ici_share_wobble_and_shrink_pass(self, tmp_path):
+        br = _load_tool()
+        self._write_ici_round(tmp_path, 1, "tpu", 660.0, ici=0.3)
+        # small wobble: (0.3+0.1)/(0.35+0.1) = 0.89 >= 0.8
+        self._write_ici_round(tmp_path, 2, "tpu", 658.0, ici=0.35)
+        assert br.main(
+            ["--dir", str(tmp_path), "--metric", "mesh.ici_share"]
+        ) == 0
+        # improvement (share SHRINKS): ratio > 1, never a regression
+        self._write_ici_round(tmp_path, 3, "tpu", 661.0, ici=0.1)
+        rep = br.compare(br.load_rounds(str(tmp_path)),
+                         metric="mesh.ici_share")
+        assert rep["ratio"] > 1 and not rep["regression"]
+
+    def test_ici_share_skips_until_two_rounds_carry_it(self, tmp_path):
+        """ISSUE 9 acceptance: the metric skips cleanly (exit 0) until
+        two rounds carry it — promotion can never fail a round
+        retroactively."""
+        br = _load_tool()
+        self._write_ici_round(tmp_path, 1, "tpu", 660.0)  # legacy
+        self._write_ici_round(tmp_path, 2, "tpu", 650.0, ici=0.4)
+        rep = br.compare(br.load_rounds(str(tmp_path)),
+                         metric="mesh.ici_share")
+        assert rep["comparable"] is False
+        assert br.main(
+            ["--dir", str(tmp_path), "--metric", "mesh.ici_share"]
+        ) == 0
+
+    def test_ici_share_zero_prior_tolerates_small_absolute_growth(
+        self, tmp_path
+    ):
+        """The additive slack keeps a near-zero best prior from making
+        percentage-point noise fatal: 0.0 -> 0.02 passes, 0.0 -> 0.3
+        fails."""
+        br = _load_tool()
+        self._write_ici_round(tmp_path, 1, "tpu", 660.0, ici=0.0)
+        self._write_ici_round(tmp_path, 2, "tpu", 659.0, ici=0.02)
+        assert br.main(
+            ["--dir", str(tmp_path), "--metric", "mesh.ici_share"]
+        ) == 0
+        self._write_ici_round(tmp_path, 3, "tpu", 659.0, ici=0.3)
+        assert br.main(
+            ["--dir", str(tmp_path), "--metric", "mesh.ici_share"]
+        ) == 1
+
 
 class TestChildBackendDeath:
     def test_parent_survives_backend_registration_abort(self):

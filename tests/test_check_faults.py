@@ -148,11 +148,14 @@ class TestRepoIsClean:
         assert problems == [], "\n".join(problems)
 
     def test_repo_covers_the_ec_hot_path_modules(self):
-        """Scope includes the mesh lane (ISSUE 8): a swallowed device
-        error inside the shard_map engine would hide a dead chip from
-        the breaker exactly like one in the dispatcher."""
+        """Scope includes the mesh lane (ISSUE 8) and the trace-window
+        service (ISSUE 9): a swallowed device error inside the
+        shard_map engine — or inside a trace capture racing an engine
+        trip — would hide a dead chip from the breaker exactly like
+        one in the dispatcher."""
         cf = _load_tool()
         root = pathlib.Path(__file__).parent.parent
         files = {p.name for p in cf._hot_files(root)}
         assert files == {"ec_dispatch.py", "ec_util.py",
-                         "ec_failover.py", "engine.py", "mesh.py"}
+                         "ec_failover.py", "engine.py", "mesh.py",
+                         "device_trace.py"}

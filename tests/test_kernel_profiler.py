@@ -5,6 +5,7 @@ the matrix codec and the vectorized CRUSH mapper.
 """
 
 import numpy as np
+import pytest
 
 from ceph_tpu.ops.profiler import KernelProfiler, profiler
 
@@ -63,6 +64,58 @@ class TestProfilerCore:
         assert [a["name"] for a in h["axes"]] == [
             "request_bytes", "latency"
         ]
+
+    def test_non_aot_first_call_is_first_exec_not_compile(self):
+        """ISSUE 9 satellite (ROADMAP 5a caveat): a non-AOT callable's
+        first call fuses tracing + compile + the first execution — it
+        must land in ``first_exec_s`` with ``aot_split`` false, in
+        NEITHER compile_time nor exec_time, so neither stat lies."""
+        p = KernelProfiler()
+        p.record("e", "k", 3.0, nbytes=10 ** 9)   # first sighting
+        p.record("e", "k", 0.1, nbytes=10 ** 9)   # steady state
+        d = p.dump()["engines"]["e"]
+        assert d["aot_split"] is False
+        assert d["first_exec_s"] == 3.0
+        assert d["compile_time"] == 0.0
+        assert d["exec_time"] == 0.1
+        # the fused first call still counts as the jit-cache miss
+        assert d["jit_cache"] == {"misses": 1, "hits": 1}
+        # ...and never pollutes the steady-state rate
+        assert d["exec_gbps"] == 10.0
+
+    def test_dump_top_n_and_device_share(self):
+        """ISSUE 9 satellite: ``dump(top=N)`` keeps the N heaviest
+        engines (readable on a busy daemon) and every entry carries
+        its share of the window's recorded device-seconds."""
+        p = KernelProfiler()
+        p.record("heavy", "k", 8.0, compiled=False)
+        p.record("light", "k", 1.0, compiled=False)
+        p.record("mid", "k", 3.0, compiled=False)
+        full = p.dump()
+        assert full["total_seconds"] == pytest.approx(12.0)
+        assert full["engines"]["heavy"]["device_share"] \
+            == pytest.approx(8 / 12, abs=1e-3)
+        assert "engines_omitted" not in full
+        top = p.dump(top=2)
+        assert set(top["engines"]) == {"heavy", "mid"}
+        assert top["engines_omitted"] == 1
+        # shares stay relative to the FULL window, not the page
+        assert top["engines"]["mid"]["device_share"] \
+            == pytest.approx(3 / 12, abs=1e-3)
+        assert p.dump(top=0)["engines"] == {}
+
+    def test_merge_device_time(self):
+        """A closed trace window's per-engine buckets fold into the
+        matching entries (ops.device_trace merge) and reset clears
+        them with everything else."""
+        p = KernelProfiler()
+        p.record("e", "k", 0.1, compiled=False)
+        p.merge_device_time({"e": {"collective": 0.04, "fused_op": 0.01}})
+        p.merge_device_time({"e": {"collective": 0.02}})
+        d = p.dump()["engines"]["e"]["device_trace"]
+        assert d == {"collective": 0.06, "fused_op": 0.01}
+        p.reset()
+        assert p.dump()["engines"] == {}
 
 
 class TestInstrumentationTaps:

@@ -67,7 +67,8 @@ PHASE_AGNOSTIC_METRICS = {"stack_gbps", "raw_cpu_gbps", "stack_vs_raw",
 
 # convenience spellings -> the dotted path inside the final line
 METRIC_ALIASES = {"stack_e2e_gbps": "stack_e2e.stack_e2e_gbps",
-                  "mesh_scaling_efficiency": "mesh.scaling_efficiency"}
+                  "mesh_scaling_efficiency": "mesh.scaling_efficiency",
+                  "mesh_ici_share": "mesh.ici_share"}
 
 # per-metric default thresholds (used when --threshold is not given):
 # mesh.scaling_efficiency is a RATIO (per-chip efficiency of the
@@ -76,7 +77,19 @@ METRIC_ALIASES = {"stack_e2e_gbps": "stack_e2e.stack_e2e_gbps",
 # jitter budget the throughput metrics need.  Rounds without the mesh
 # record simply lack the metric, so the gate skips cleanly (exit 0)
 # until two same-phase rounds carry it.
-METRIC_DEFAULT_THRESHOLDS = {"mesh.scaling_efficiency": 0.8}
+METRIC_DEFAULT_THRESHOLDS = {"mesh.scaling_efficiency": 0.8,
+                             "mesh.ici_share": 0.8}
+
+# metrics where GROWTH is the regression: mesh.ici_share (ISSUE 9) is
+# the ICI all-gather's share of the mesh reconstruct's device time,
+# measured by a jax.profiler trace window — a change that shifts the
+# reconstruct from compute-bound to gather-bound must fail the gate
+# even when headline GB/s barely moves.  Compared with an additive
+# 0.1-share slack (shares are small ratios: best-prior 0.0 must not
+# make a 2-percentage-point wobble fatal): ratio =
+# (best + 0.1) / (current + 0.1), regression when ratio < threshold.
+LOWER_IS_BETTER = {"mesh.ici_share"}
+_SHARE_SLACK = 0.1
 
 
 def load_rounds(bench_dir: str) -> list[dict]:
@@ -169,9 +182,15 @@ def compare(rounds: list[dict], metric: str = "value",
                 + (" and a matching batch_bytes" if excluded else "")
             ),
         }
-    best = max(priors, key=lambda r: metric_value(r["line"], metric))
-    best_v = float(metric_value(best["line"], metric))
-    ratio = (float(cur) / best_v) if best_v > 0 else 1.0
+    lower = metric in LOWER_IS_BETTER
+    if lower:
+        best = min(priors, key=lambda r: metric_value(r["line"], metric))
+        best_v = float(metric_value(best["line"], metric))
+        ratio = (best_v + _SHARE_SLACK) / (float(cur) + _SHARE_SLACK)
+    else:
+        best = max(priors, key=lambda r: metric_value(r["line"], metric))
+        best_v = float(metric_value(best["line"], metric))
+        ratio = (float(cur) / best_v) if best_v > 0 else 1.0
     return {
         "comparable": True,
         "newest": newest["file"],
@@ -179,6 +198,7 @@ def compare(rounds: list[dict], metric: str = "value",
         **({"batch_bytes": cur_bb} if cur_bb is not None else {}),
         **({"excluded_batch_mismatch": excluded} if excluded else {}),
         "metric": metric,
+        **({"lower_is_better": True} if lower else {}),
         "current": float(cur),
         "best_prior": best_v,
         "best_prior_file": best["file"],
@@ -199,8 +219,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="final-line key to compare; dotted paths reach "
                          "nested records, e.g. qos.protection, "
                          "stack_e2e.stack_e2e_gbps (alias: "
-                         "stack_e2e_gbps) or mesh.scaling_efficiency "
-                         "(alias: mesh_scaling_efficiency) "
+                         "stack_e2e_gbps), mesh.scaling_efficiency "
+                         "(alias: mesh_scaling_efficiency) or "
+                         "mesh.ici_share (alias: mesh_ici_share; "
+                         "lower is better — growth is the regression) "
                          "(default: value)")
     ap.add_argument("--threshold", type=float, default=None,
                     help="fail when newest < threshold x prior best "

@@ -25,12 +25,15 @@ must do at least one of
 
 Scope (the device-error path end to end, mesh lane included — a
 shard_map program losing one chip in the slice must reach the breaker
-exactly like a single-device loss):
+exactly like a single-device loss; the trace-window service rides the
+same device path, so a capture racing an engine trip must degrade to
+"unavailable", never swallow the device error the classifier needed):
     ceph_tpu/osd/ec_dispatch.py
     ceph_tpu/osd/ec_util.py
     ceph_tpu/osd/ec_failover.py
     ceph_tpu/parallel/engine.py
     ceph_tpu/parallel/mesh.py
+    ceph_tpu/ops/device_trace.py
 
 Usage: ``python tools/check_faults.py [repo_root]`` — exits 0 when
 clean, 1 with a per-site report otherwise.
@@ -48,6 +51,7 @@ HOT_PATHS = (
     "ceph_tpu/osd/ec_failover.py",
     "ceph_tpu/parallel/engine.py",
     "ceph_tpu/parallel/mesh.py",
+    "ceph_tpu/ops/device_trace.py",
 )
 
 ANNOTATION = "# swallow-ok:"
