@@ -1,0 +1,24 @@
+"""Shared EC accelerator service (ISSUE 10 / ROADMAP item 2).
+
+A standalone device daemon (:class:`AccelDaemon`) that owns the
+JAX/XLA device + mesh EC lanes and serves batched encode/decode to
+many OSDs over the messenger; the OSD-side remote lane is
+:class:`~ceph_tpu.accel.client.AccelClient`, wired into the EC
+dispatcher via ``osd_ec_accel_addr`` / ``osd_ec_accel_mode``.
+"""
+
+from .client import (
+    AccelClient,
+    AccelDataError,
+    AccelServiceError,
+    AccelUnavailable,
+)
+from .daemon import AccelDaemon
+
+__all__ = [
+    "AccelClient",
+    "AccelDaemon",
+    "AccelDataError",
+    "AccelServiceError",
+    "AccelUnavailable",
+]

@@ -1,0 +1,616 @@
+"""The shared EC accelerator daemon (ISSUE 10 / ROADMAP item 2).
+
+The paper's design centers on a *persistent JAX/XLA process* that keeps
+compiled GF(2^8) programs resident and amortizes device cost across the
+whole storage plane.  Before this daemon, every OSD owned its own
+device lane, so device count scaled with daemon count; the
+:class:`AccelDaemon` inverts that — ONE standalone process owns the
+device (and the mesh slice, when configured) and serves batched
+encode/decode to many OSDs over the messenger, so device count scales
+with *traffic*.
+
+The engine room is exactly the OSD's (one code path, two processes):
+
+- an :class:`~ceph_tpu.osd.ec_dispatch.ECDispatcher` coalesces
+  requests into padded launches — but here the requests arrive from
+  *different OSD daemons*, so batches coalesce **across clients** (the
+  shared-occupancy win; the flight recorder records which OSDs shared
+  each launch, and a stripe stays traceable
+  client -> OSD -> accelerator -> device via the trace id the
+  messenger restores on dispatch);
+- its own dmClock :class:`~ceph_tpu.osd.scheduler.OpScheduler`
+  instance paces background classes (requests carry the QoS class in
+  the RPC), so client-vs-background isolation holds end to end;
+- the full PR-7 fault domain: the shared failure classifier, the
+  launch deadline with the HeartbeatMap watchdog pin, bit-identical
+  host-fallback replay, the breaker + canary re-promotion — a shared
+  device serving dozens of OSDs must fail over, not fail everyone;
+- the process-global KernelProfiler and DeviceTracer run HERE (the
+  device lives here), served over the admin socket like on any daemon
+  (``dump_kernel_profile``, ``kernel trace start|stop|...``,
+  ``dump_launch_history``).
+
+Health flows two ways: every reply and a periodic
+:class:`~ceph_tpu.msg.messages.MAccelBeacon` piggyback the breaker
+state + queue depth (OSDs route around a TRIPPED or saturated
+accelerator without a timeout chain), and — when a monitor is
+configured — the daemon subscribes to maps and reports its perf
+counters to the active mgr (``MDaemonStats``), so prometheus exports an
+``accel.N`` daemon series.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+from typing import Any
+
+import numpy as np
+
+from ..msg import AsyncMessenger, Connection, Dispatcher, messages
+from ..msg.message import Message
+from ..msg.messenger import send_daemon_stats
+from ..osd import ec_util
+from ..utils.buffers import as_u8
+
+logger = logging.getLogger("ceph_tpu.accel")
+
+EINVAL = 22
+EIO = 5
+
+# a client entity counts toward the ``accel.clients`` gauge for this
+# long after its last request
+_CLIENT_FRESH_S = 30.0
+
+
+class AccelDaemon(Dispatcher):
+    """One shared accelerator process (see module doc).
+
+    ``mon_addr`` is optional: without it the daemon serves requests and
+    beacons but skips map subscription and mgr reporting (the
+    standalone bench/test topology).
+    """
+
+    def __init__(self, name: str = "accel.0",
+                 mon_addr: "str | list[str] | None" = None,
+                 config=None):
+        from ..common import Config, PerfCountersCollection
+        from ..common.log import install as _install_memlog
+
+        self.config = config or Config()
+        cfg = self.config
+        _install_memlog()
+        self.name = name
+        self.mon_addr = mon_addr
+        self.messenger = AsyncMessenger(name, self)
+        self.messenger.apply_config(cfg)
+        from ..auth import daemon_auth_context
+
+        self.messenger.auth = daemon_auth_context(cfg, name)
+        self.addr = ""
+        self.osdmap = None
+        # -- observability: the SAME ec family the OSD registers (one
+        # definition, osd/ec_perf.py — the engine room mutates the
+        # same keys in both processes) plus the accel-service half
+        from ..osd.ec_perf import create_accel_service_perf, create_ec_perf
+        from ..utils.buffers import data_path_perf
+
+        self.perf = PerfCountersCollection()
+        self.perf.attach(self.messenger.perf)
+        self.perf.attach(data_path_perf())
+        pec = create_ec_perf(self.perf)
+        self._pacc = create_accel_service_perf(self.perf)
+        # -- QoS: this daemon's OWN dmClock instance (requests carry
+        # the class in the RPC, so client-vs-background pacing holds
+        # end to end across the wire); perf=None — the per-class wait
+        # histograms live on the OSDs, where admission happens
+        from ..osd.scheduler import CLASSES as QOS_CLASSES
+        from ..osd.scheduler import OpScheduler, QosSpec
+
+        self.scheduler = OpScheduler(
+            {
+                k: QosSpec(
+                    reservation=cfg.get(f"osd_mclock_scheduler_{k}_res"),
+                    weight=cfg.get(f"osd_mclock_scheduler_{k}_wgt"),
+                    limit=cfg.get(f"osd_mclock_scheduler_{k}_lim"),
+                )
+                for k in QOS_CLASSES
+            },
+            policy=cfg.osd_op_queue,
+            slots=cfg.osd_op_queue_slots,
+            cut_off=cfg.osd_op_queue_cut_off,
+        )
+        # -- the engine room: mesh lane (optional), breaker, dispatcher
+        # — the full PR-7 discipline, verbatim from the OSD
+        self.ec_mesh = None
+        if getattr(cfg, "osd_ec_mesh", False):
+            from ..parallel.engine import get_mesh_engine
+
+            self.ec_mesh = get_mesh_engine(
+                getattr(cfg, "osd_ec_mesh_devices", 0)
+            )
+        from ..osd.ec_dispatch import ECDispatcher
+        from ..osd.ec_failover import EngineSupervisor
+
+        self.supervisor = EngineSupervisor(
+            enabled=cfg.osd_ec_engine_failover,
+            perf=pec,
+            probe_interval=cfg.osd_ec_probe_interval,
+            on_degraded=lambda d: setattr(
+                self.scheduler, "capacity_degraded", d
+            ),
+        )
+        self.dispatch = ECDispatcher(
+            perf=pec,
+            window=cfg.osd_ec_dispatch_window,
+            max_stripes=cfg.osd_ec_dispatch_max_stripes,
+            bucket=cfg.osd_ec_dispatch_bucket,
+            scheduler=self.scheduler,
+            supervisor=self.supervisor,
+            launch_deadline=cfg.osd_ec_launch_deadline,
+            mesh_engine=self.ec_mesh,
+            launch_history=cfg.osd_ec_launch_history,
+        )
+        self.dispatch.inject_engine_failure = cfg.ec_inject_engine_failure
+        self.dispatch.inject_launch_hang = cfg.ec_inject_launch_hang
+        # -- watchdog: a wedged device call must mark THIS daemon
+        # unhealthy and eventually kill it (tools/daemon.py sets
+        # suicide_hard_exit), exactly like the OSD's launch handle
+        from ..common.heartbeat_map import HeartbeatMap
+
+        self.suicide_hard_exit = False
+        self.hb_map = HeartbeatMap(self.name, on_suicide=self._hb_suicide)
+        self._launch_handle = self.hb_map.add_worker(
+            "ec_device_launch",
+            (cfg.osd_ec_launch_deadline
+             if cfg.osd_ec_launch_deadline > 0
+             else cfg.osd_op_thread_timeout),
+            cfg.osd_op_thread_suicide_timeout,
+        )
+        self.dispatch.set_watchdog_handle(self._launch_handle)
+        # (profile-tuple, stripe_width, chunk_size) -> (codec, sinfo):
+        # the accelerator's analog of the OSD's per-pool codec cache —
+        # a persistent process keeps codecs (and their jit caches)
+        # resident across every client's traffic
+        self._codecs: dict[tuple, tuple[Any, ec_util.StripeInfo]] = {}
+        self._clients: dict[str, dict] = {}  # peer -> {"ops","bytes","t"}
+        self._inflight = 0
+        self._cross_client_reported = 0  # -> accel.cross_client_batches
+        self._tasks: set[asyncio.Task] = set()
+        self._beacon_task: asyncio.Task | None = None
+        self._report_task: asyncio.Task | None = None
+        self._mon_conn: Connection | None = None
+        self._admin = None
+        self._stopping = False
+        # live knobs (tracked so stop() unregisters; a shared Config
+        # must not keep firing actions on dead daemons)
+        self._observers = [
+            ("osd_ec_dispatch_window", lambda _n, v: setattr(
+                self.dispatch, "window", float(v))),
+            ("osd_ec_dispatch_max_stripes", lambda _n, v: setattr(
+                self.dispatch, "max_stripes", int(v))),
+            ("osd_ec_dispatch_bucket", lambda _n, v: setattr(
+                self.dispatch, "bucket", bool(v))),
+            ("osd_ec_launch_deadline", self._on_launch_deadline),
+            ("osd_ec_probe_interval", lambda _n, v: setattr(
+                self.supervisor, "probe_interval", float(v))),
+            ("osd_ec_engine_failover", lambda _n, v:
+                self.supervisor.set_enabled(bool(v))),
+            ("ec_inject_engine_failure", lambda _n, v: setattr(
+                self.dispatch, "inject_engine_failure", int(v))),
+            ("ec_inject_launch_hang", lambda _n, v: setattr(
+                self.dispatch, "inject_launch_hang", float(v))),
+        ]
+        for opt, cb in self._observers:
+            cfg.observe(opt, cb)
+
+    def _on_launch_deadline(self, _name: str, value: float) -> None:
+        self.dispatch.launch_deadline = float(value)
+        self._launch_handle.grace = (
+            float(value) if value > 0
+            else self.config.osd_op_thread_timeout
+        )
+
+    def _hb_suicide(self, worker: str) -> None:
+        if self._stopping:
+            return
+        self._stopping = True
+        logger.error("%s: %s suicide timeout — aborting daemon",
+                     self.name, worker)
+        task = asyncio.ensure_future(self.stop())
+        if self.suicide_hard_exit:
+            task.add_done_callback(lambda _t: os._exit(134))
+            asyncio.get_running_loop().call_later(10.0, os._exit, 134)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        self.addr = await self.messenger.bind(host, port)
+        if self.mon_addr:
+            # best-effort: the accelerator serves fine without a mon
+            # (standalone bench topology); with one, it learns the map
+            # (mgr address) and reports like rgw/mon do
+            try:
+                await self._connect_mon()
+            # swallow-ok: mgr reporting is optional — the report loop keeps retrying
+            except (ConnectionError, OSError) as e:
+                logger.warning("%s: no mon reachable at start (%r); "
+                               "mgr reporting deferred", self.name, e)
+        self._beacon_task = asyncio.ensure_future(self._beacon_loop())
+        self._report_task = asyncio.ensure_future(self._report_loop())
+        await self._start_admin_socket()
+        logger.info("%s: serving EC batches at %s", self.name, self.addr)
+        return self.addr
+
+    @property
+    def _mon_addrs(self) -> list[str]:
+        if isinstance(self.mon_addr, str):
+            return [self.mon_addr]
+        return list(self.mon_addr or [])
+
+    async def _connect_mon(self) -> Connection:
+        last: Exception | None = None
+        for addr in self._mon_addrs:
+            try:
+                conn = await self.messenger.connect(addr, "mon")
+                conn.send(messages.MMonGetMap(have=0))
+                self._mon_conn = conn
+                return conn
+            # swallow-ok: tries the next mon; the loop raises when all fail
+            except (ConnectionError, OSError) as e:
+                last = e
+        raise ConnectionError(f"no mon reachable: {last}")
+
+    async def _start_admin_socket(self) -> None:
+        path = self.config.admin_socket
+        if not path:
+            return
+        from ..common import AdminSocket, register_common
+
+        self._admin = AdminSocket(path.replace("{name}", self.name))
+        a = self._admin
+        register_common(a, perf=self.perf, config=self.config)
+        a.register(
+            "dump_ec_dispatch",
+            lambda req: self.dispatch.dump(),
+            "EC microbatch dispatcher: open batches, flush reasons, "
+            "pad waste, observed bucket table (cross-client totals)",
+        )
+        a.register(
+            "dump_launch_history",
+            lambda req: self.dispatch.flight.dump(),
+            "device-launch flight recorder: the last N launches (lane, "
+            "QoS class, client OSDs that shared the launch, queue-wait "
+            "vs device wall, slowest member trace id)",
+        )
+        a.register(
+            "dump_engine_health",
+            lambda req: self.dispatch.engine_health(),
+            "EC engine health state machine: breaker state, probe "
+            "backoff, failure history, failover totals",
+        )
+        a.register(
+            "dump_op_pq_state",
+            lambda req: self.scheduler.dump(),
+            "this accelerator's dmClock instance: per-class specs, "
+            "queues, pacing state",
+        )
+        a.register(
+            "dump_watchdog",
+            lambda req: self.hb_map.dump(),
+            "HeartbeatMap worker deadlines",
+        )
+        a.register(
+            "status",
+            lambda req: {
+                "name": self.name,
+                "addr": self.addr,
+                "clients": self.client_table(),
+                "queue_depth": self.queue_depth(),
+                "engine_state": self.supervisor.state,
+            },
+            "daemon identity, connected clients, queue depth",
+        )
+        await a.start()
+
+    async def stop(self, crash: bool = False) -> None:
+        """``crash=True`` models SIGKILL: connections die NOW, mid-
+        batch — in-flight replies are never sent, and every client OSD
+        must recover by replaying locally (the acceptance criterion:
+        zero failed client ops)."""
+        self._stopping = True
+        for opt, cb in self._observers:
+            self.config.unobserve(opt, cb)
+        self.scheduler.stop()
+        for t in (self._beacon_task, self._report_task):
+            if t is not None:
+                t.cancel()
+        for t in list(self._tasks):
+            t.cancel()
+        if crash:
+            await self.messenger.shutdown()
+        # let the serve-task cancellations land before the dispatcher
+        # flushes, so doomed waiters drop instead of launching
+        await asyncio.sleep(0)
+        await self.dispatch.stop()
+        if self._admin is not None:
+            await self._admin.stop()
+            self._admin = None
+        if not crash:
+            await self.messenger.shutdown()
+
+    # -- dispatch ------------------------------------------------------------
+
+    async def ms_dispatch(self, conn: Connection, msg: Message) -> None:
+        if isinstance(msg, (messages.MAccelEncode, messages.MAccelDecode)):
+            # run as a task: serving blocks on the device (and on
+            # coalescing windows), and the connection reader must keep
+            # pulling CONCURRENT requests — that concurrency IS the
+            # cross-client coalescing win
+            t = asyncio.ensure_future(self._serve(conn, msg))
+            self._tasks.add(t)
+            t.add_done_callback(self._tasks.discard)
+        elif isinstance(msg, messages.MOSDMapMsg):
+            from ..osd.osdmap import advance_map
+
+            if self.osdmap is None or msg.epoch > self.osdmap.epoch:
+                m = advance_map(
+                    self.osdmap, msg.epoch, msg.osdmap, msg.incrementals
+                )
+                if m is None:
+                    conn.send(messages.MMonGetMap(have=None))
+                    return
+                self.osdmap = m
+
+    def ms_handle_reset(self, conn: Connection) -> None:
+        if conn is self._mon_conn:
+            self._mon_conn = None
+
+    # -- the service ---------------------------------------------------------
+
+    def _codec_for(self, profile: dict, stripe_width: int,
+                   chunk_size: int):
+        """Rebuild (and cache) the codec named by the wire profile.
+        The geometry is TRUSTED from the wire and validated by the
+        shared encode/decode prologue (ec_util), exactly as a local
+        batch would be — the accelerator and the OSD must accept the
+        same batches."""
+        from ..models import registry
+
+        prof = {str(k): str(v) for k, v in (profile or {}).items()}
+        key = (tuple(sorted(prof.items())), int(stripe_width),
+               int(chunk_size))
+        cached = self._codecs.get(key)
+        if cached is not None:
+            return cached
+        plugin = prof.get("plugin", "jerasure")
+        codec = registry.instance().factory(plugin, prof)
+        sinfo = ec_util.StripeInfo(
+            stripe_width=int(stripe_width), chunk_size=int(chunk_size)
+        )
+        self._codecs[key] = (codec, sinfo)
+        return codec, sinfo
+
+    def _note_client(self, peer: str, nbytes: int) -> None:
+        c = self._clients.setdefault(peer, {"ops": 0, "bytes": 0})
+        c["ops"] += 1
+        c["bytes"] += nbytes
+        c["t"] = time.monotonic()
+
+    def client_table(self) -> dict:
+        now = time.monotonic()
+        return {
+            peer: {"ops": c["ops"], "bytes": c["bytes"],
+                   "age_s": round(now - c["t"], 3)}
+            for peer, c in sorted(self._clients.items())
+        }
+
+    def queue_depth(self) -> int:
+        """Requests currently in service (queued + launching): the
+        saturation signal the beacon carries."""
+        return self._inflight
+
+    def _health_fields(self) -> dict:
+        return {
+            "engine_state": self.supervisor.state,
+            "queue_depth": self.queue_depth(),
+            "capacity": max(1, int(self.config.osd_op_queue_slots)),
+        }
+
+    async def _serve(self, conn: Connection, msg: Message) -> None:
+        t0 = time.perf_counter()
+        decode = isinstance(msg, messages.MAccelDecode)
+        pacc = self._pacc
+        pacc.inc("rpc_decode" if decode else "rpc_encode")
+        klass = msg.klass or "client"
+        reply_extra: dict = {}
+        self._inflight += 1
+        pacc.set("queue_depth", self._inflight)
+        try:
+            codec, sinfo = self._codec_for(
+                msg.profile, msg.stripe_width, msg.chunk_size
+            )
+            if decode:
+                result_blobs, nbytes_in = await self._serve_decode(
+                    conn, msg, codec, sinfo, klass
+                )
+                reply_extra["shards"] = None
+            else:
+                result_blobs, nbytes_in, shards = await self._serve_encode(
+                    conn, msg, codec, sinfo, klass
+                )
+                reply_extra["shards"] = shards
+            self._note_client(conn.peer_name, nbytes_in)
+            pacc.inc("rpc_bytes_in", nbytes_in)
+            out_bytes = sum(
+                v.nbytes if isinstance(v, np.ndarray) else len(v)
+                for v in result_blobs
+            )
+            pacc.inc("rpc_bytes_out", out_bytes)
+            # served-engine + device-wall attribution: the launch that
+            # carried this request is findable by its trace id in the
+            # flight recorder (the record ended before the dispatcher
+            # resolved our waiter), so the client OSD's own flight
+            # record can show the TRUE device time — not the RTT —
+            # and which engine here produced the bytes
+            from ..common.tracing import current_trace
+
+            launch = self.dispatch.flight.lookup(
+                current_trace.get()) or {}
+            reply = messages.MAccelReply(
+                tid=msg.tid, result=0, blobs=result_blobs,
+                served=launch.get("served"),
+                device_wall_s=launch.get("device_wall_s"),
+                **reply_extra, **self._health_fields(),
+            )
+        except Exception as e:
+            # fork by the SHARED classifier (models/matrix_codec): a
+            # data-class error (malformed batch, >m erasures — the
+            # validation prologue and codec IOErrors) answers EINVAL
+            # and the client OSD surfaces it to its waiters untouched;
+            # anything else (device AND host fallback both failed here,
+            # or shutdown raced the batch) answers EIO and the client
+            # replays the batch on its LOCAL fallback engine — either
+            # way no error is swallowed and no client op fails
+            from ..models.matrix_codec import classify_engine_error
+
+            kind = classify_engine_error(e)
+            if kind != "data":
+                logger.warning("%s: batch tid=%s failed: %r",
+                               self.name, msg.tid, e)
+            pacc.inc("rpc_errors")
+            reply = messages.MAccelReply(
+                tid=msg.tid,
+                result=(-EINVAL if kind == "data" else -EIO),
+                error=repr(e)[:300],
+                **self._health_fields(),
+            )
+        finally:
+            self._inflight -= 1
+            pacc.set("queue_depth", self._inflight)
+        conn.send(reply)
+        pacc.observe("service_time", time.perf_counter() - t0)
+
+    async def _serve_encode(self, conn, msg, codec, sinfo, klass):
+        """Each MEMBER op of the client's coalesced batch submits
+        individually into the dispatcher (the payloads already arrived
+        as separate borrowed frame views — re-gathering them here
+        would pay a full extra copy before the dispatcher's own
+        ec_gather, and would make N member ops count as ONE dispatcher
+        op, undercounting coalesce/occupancy/flight attribution).  The
+        members land in the same tick, so they coalesce into one
+        launch — together with other clients' members."""
+        bufs = [as_u8(bl) for bl in msg.blobs]
+        total = sum(b.size for b in bufs)
+        outs = await asyncio.gather(*[
+            self.dispatch.encode(sinfo, codec, b, klass=klass,
+                                 client=conn.peer_name)
+            for b in bufs
+        ])
+        self._sync_cross_client()
+        shards = sorted(outs[0]) if outs else []
+        # member-major reply blobs: the per-member shard buffers ARE
+        # the dispatcher's result slices — sent as views, no join
+        return [o[s] for o in outs for s in shards], total, shards
+
+    async def _serve_decode(self, conn, msg, codec, sinfo, klass):
+        present = [int(s) for s in msg.present]
+        nsh = len(present)
+        n_ops = len(msg.stripes or [1])
+        blobs = msg.blobs
+        if len(blobs) != nsh * n_ops:
+            raise ValueError(
+                f"decode batch carries {len(blobs)} blobs for "
+                f"{n_ops} ops x {nsh} shards"
+            )
+        payloads = [
+            {present[j]: as_u8(blobs[i * nsh + j]) for j in range(nsh)}
+            for i in range(n_ops)
+        ]
+        total = sum(
+            v.size for p in payloads for v in p.values()
+        )
+        outs = await asyncio.gather(*[
+            self.dispatch.decode_concat(sinfo, codec, p, klass=klass,
+                                        client=conn.peer_name)
+            for p in payloads
+        ])
+        self._sync_cross_client()
+        return list(outs), total
+
+    def _sync_cross_client(self) -> None:
+        """Mirror the dispatcher's cross-client-batch total into the
+        ``accel.cross_client_batches`` counter (the dispatcher's perf
+        handle is the ``ec`` family; the service-side key lives in
+        ``accel``)."""
+        total = self.dispatch._totals.get("cross_client_batches", 0)
+        delta = total - self._cross_client_reported
+        if delta > 0:
+            self._cross_client_reported = total
+            self._pacc.inc("cross_client_batches", delta)
+
+    # -- beacon + mgr reporting ----------------------------------------------
+
+    async def _beacon_loop(self) -> None:
+        """Engine-state/queue-depth beacon to every connected peer: a
+        TRIPPED breaker or a saturating queue re-routes OSD traffic to
+        their local lanes on the NEXT request — no timeout chain — and
+        a healthy beacon routes it back."""
+        try:
+            while not self._stopping:
+                interval = self.config.accel_beacon_interval
+                await asyncio.sleep(interval if interval > 0 else 1.0)
+                if interval <= 0 or self._stopping:
+                    continue
+                fields = self._health_fields()
+                sent = False
+                for conn in list(self.messenger._all):
+                    if conn is self._mon_conn:
+                        continue  # the mon is not an EC client
+                    conn.send(messages.MAccelBeacon(
+                        name=self.name, **fields,
+                    ))
+                    sent = True
+                if sent:
+                    self._pacc.inc("beacons")
+                now = time.monotonic()
+                self._pacc.set("clients", sum(
+                    1 for c in self._clients.values()
+                    if now - c["t"] <= _CLIENT_FRESH_S
+                ))
+        # swallow-ok: beacon loop cancelled at daemon stop (teardown)
+        except asyncio.CancelledError:
+            pass
+
+    async def _report_loop(self) -> None:
+        """Perf-counter reports to the active mgr (the rgw/mon
+        MDaemonStats path) — the ``accel.N`` daemon series in
+        prometheus; also re-asserts the engine_state gauge so a perf
+        reset cannot hide a TRIPPED breaker, and POLLS the HeartbeatMap
+        (it is passive — suicide only fires from is_healthy(); the OSD
+        polls on its heartbeat tick, this daemon polls here), so a
+        wedged device launch past suicide_grace actually kills the
+        process like the watchdog contract promises."""
+        try:
+            while not self._stopping:
+                interval = self.config.accel_mgr_report_interval
+                await asyncio.sleep(interval if interval > 0 else 1.0)
+                self.hb_map.is_healthy()
+                self.supervisor.refresh_gauge()
+                if interval <= 0 or not self.mon_addr:
+                    continue
+                if self._mon_conn is None:
+                    try:
+                        await self._connect_mon()
+                    # swallow-ok: mon bouncing — retry next tick
+                    except (ConnectionError, OSError):
+                        continue
+                await send_daemon_stats(
+                    self.messenger, self.osdmap, self.name,
+                    self.perf.dump(),
+                )
+        # swallow-ok: report loop cancelled at daemon stop (teardown)
+        except asyncio.CancelledError:
+            pass

@@ -240,6 +240,36 @@ OPTIONS: dict[str, Option] = _opts(
            "this many seconds in the worker thread before running — "
            "the make_pjrt_c_api_client wedge, for exercising "
            "osd_ec_launch_deadline (0 = off; live via observer)"),
+    # erasure code: shared accelerator service (ceph_tpu.accel — one
+    # standalone device daemon serving many OSDs over the messenger;
+    # ISSUE 10 / ROADMAP item 2)
+    Option("osd_ec_accel_addr", str, "",
+           "address (host:port) of the shared EC accelerator daemon "
+           "this OSD ships coalesced encode/decode batches to ('' = "
+           "no remote; live — retargeting resets the connection)"),
+    Option("osd_ec_accel_mode", str, "off",
+           "remote EC lane policy: off = local lanes only; prefer = "
+           "route to the accelerator while its beacon reads healthy "
+           "and unsaturated, fall back to the local lanes otherwise; "
+           "require = always route remote (no local device expected "
+           "on this host) — accelerator faults still replay on the "
+           "local host fallback engine, so no client op ever fails",
+           choices=("off", "prefer", "require")),
+    Option("osd_ec_accel_deadline", float, 10.0,
+           "round-trip budget for one remote EC batch (s): past it "
+           "the waiters replay on the local fallback engine and the "
+           "remote is marked unreachable (0 = unbounded)"),
+    Option("osd_ec_accel_retry_interval", float, 1.0,
+           "base backoff before re-trying an unreachable accelerator "
+           "(s); doubles per failed attempt up to 16x.  A beacon or "
+           "successful reply clears the backoff immediately"),
+    Option("accel_beacon_interval", float, 0.5,
+           "accelerator daemon: engine-state/queue-depth beacon "
+           "period to every connected OSD (s); 0 disables (replies "
+           "still piggyback the same fields)"),
+    Option("accel_mgr_report_interval", float, 1.0,
+           "accelerator daemon -> mgr perf-counter report period (s); "
+           "0 disables"),
     Option("erasure_code_dir", str, "ceph_tpu.models",
            "plugin module prefix (dlopen dir analog)"),
     Option("osd_class_dir", str, "",

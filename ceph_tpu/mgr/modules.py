@@ -110,6 +110,7 @@ def _health_checks(m, mgr, *, up: int, inn: int, exists: int) -> list[dict]:
     slow_ops = 0
     slow_oldest = 0.0
     accel_tripped = 0
+    accel_unreachable = 0
     for st in mgr.live_osd_stats().values():
         perf = st.get("perf") or {}
         scrub = perf.get("scrub") or {}
@@ -129,6 +130,14 @@ def _health_checks(m, mgr, *, up: int, inn: int, exists: int) -> list[dict]:
         ec_perf = perf.get("ec") or {}
         if int(ec_perf.get("engine_state", 0) or 0) >= 2:
             accel_tripped += 1
+        # accel.remote_unreachable (osd/ec_perf.py client half): the
+        # OSD's shared-accelerator lane is configured but the daemon
+        # cannot be reached — EC serves on the local lanes, correct
+        # bytes, none of the shared-device amortization the operator
+        # deployed the accelerator FOR (ceph_tpu.accel, ISSUE 10)
+        accel_perf = perf.get("accel") or {}
+        if int(accel_perf.get("remote_unreachable", 0) or 0) >= 1:
+            accel_unreachable += 1
     if outstanding:
         checks.append({
             "code": "OSD_SCRUB_ERRORS", "severity": "HEALTH_ERR",
@@ -151,6 +160,14 @@ def _health_checks(m, mgr, *, up: int, inn: int, exists: int) -> list[dict]:
             "summary": (
                 f"{accel_tripped} osd(s) serving EC on the fallback "
                 "engine (accelerator circuit breaker tripped)"
+            ),
+        })
+    if accel_unreachable:
+        checks.append({
+            "code": "ACCEL_UNREACHABLE", "severity": "HEALTH_WARN",
+            "summary": (
+                f"{accel_unreachable} osd(s) cannot reach their shared "
+                "EC accelerator (serving EC on local lanes)"
             ),
         })
     return checks

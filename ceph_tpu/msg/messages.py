@@ -459,6 +459,73 @@ class MWatchNotifyAck(Message):
     FIELDS = ("notify_id", "cookie")
 
 
+# -- shared EC accelerator service (ceph_tpu.accel) --------------------------
+
+
+@register
+class MAccelEncode(Message):
+    """OSD -> accelerator daemon: one coalesced EC encode batch (the
+    remote dispatcher lane, ISSUE 10).  ``profile`` is the erasure-code
+    profile dict the accelerator rebuilds the codec from (plugin, k, m,
+    technique, ...); ``stripe_width``/``chunk_size`` the stripe
+    geometry; ``stripes`` the per-member stripe counts (one entry per
+    coalesced op — the accelerator's flight recorder attributes
+    occupancy per client batch); ``klass`` the QoS traffic class the
+    accelerator's own dmClock instance paces by.  Payloads ride in
+    blobs, ONE BORROWED VIEW PER MEMBER OP (no gather on the OSD side
+    — the frame encoder sends views vectored); the trace id rides the
+    frame header like every message."""
+
+    TYPE = "accel_encode"
+    FIELDS = ("tid", "profile", "stripe_width", "chunk_size", "stripes",
+              "klass")
+
+
+@register
+class MAccelDecode(Message):
+    """OSD -> accelerator daemon: one coalesced EC decode batch.
+    ``present`` is the shared survivor set (batch keys include it, so
+    every member reads through the same recovery matrix); blobs are
+    per-member per-shard views in ``present`` order, member-major
+    (op0's shards, then op1's, ...)."""
+
+    TYPE = "accel_decode"
+    FIELDS = ("tid", "profile", "stripe_width", "chunk_size", "stripes",
+              "present", "klass")
+
+
+@register
+class MAccelReply(Message):
+    """Accelerator -> OSD: the batch result, member-major.  Encode
+    replies carry ``len(members) x len(shards)`` blobs — each member's
+    per-shard result buffers in ``shards`` order (the accelerator's
+    dispatcher already sliced them per member; sending them as views
+    avoids any re-join); decode replies carry one reassembled logical
+    blob PER member.
+    ``engine_state``/``queue_depth``/``capacity`` piggyback the
+    accelerator's health on EVERY reply (the beacon's fields), so a
+    busy OSD learns about a TRIPPED or saturating remote from its own
+    traffic, without waiting for the next beacon.  ``served`` names the
+    engine that produced the bytes (device/mesh/fallback) and
+    ``device_wall_s`` its launch time — accelerator-side evidence for
+    the OSD's flight recorder."""
+
+    TYPE = "accel_reply"
+    FIELDS = ("tid", "result", "error", "shards", "engine_state",
+              "queue_depth", "capacity", "served", "device_wall_s")
+
+
+@register
+class MAccelBeacon(Message):
+    """Accelerator -> every connected OSD, periodic: engine breaker
+    state + queue depth + stripe capacity.  OSDs route around a TRIPPED
+    or saturated remote on the NEXT request — no timeout chain — and
+    route back when a healthy beacon arrives."""
+
+    TYPE = "accel_beacon"
+    FIELDS = ("name", "engine_state", "queue_depth", "capacity")
+
+
 # -- recovery ----------------------------------------------------------------
 
 

@@ -637,7 +637,19 @@ class FlightRecorder:
             return token
 
     def end(self, token: int, *, device_wall_s: float | None = None,
-            served: str | None = None, error: str | None = None) -> None:
+            served: str | None = None, error: str | None = None,
+            origin: str | None = None,
+            remote_served: str | None = None) -> None:
+        """``origin`` names the lane whose FAULT caused a
+        fallback-served batch ("remote" = accelerator/network trip,
+        "device"/"mesh" = local device trip) — without it an operator
+        reading ``dump_launch_history`` cannot tell which fault domain
+        the replay answered for (ISSUE 10 satellite).
+        ``remote_served`` names the engine the ACCELERATOR served a
+        remote-lane batch from (device/mesh/native_direct/fallback —
+        the reply piggybacks it), so the client-side record shows
+        whether the shared device, or its host fallback, actually
+        produced the bytes."""
         with self._lock:
             rec = self._inflight.pop(token, None)
             if rec is None:
@@ -648,6 +660,10 @@ class FlightRecorder:
                 rec["served"] = served
             if error is not None:
                 rec["error"] = error
+            if origin is not None:
+                rec["origin"] = origin
+            if remote_served is not None:
+                rec["remote_served"] = remote_served
             self._ring.append(rec)
 
     @staticmethod

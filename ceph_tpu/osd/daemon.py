@@ -254,132 +254,16 @@ class OSD(Dispatcher):
                        "in-flight ops older than osd_op_complaint_time")
         posd.add_gauge("slow_ops_oldest_sec",
                        "age of the oldest slow op (seconds)")
-        pec = self.perf.create("ec")
-        pec.add_counter("encode_calls", "batched device encodes")
-        pec.add_counter("encode_bytes", "logical bytes encoded")
-        pec.add_counter("decode_calls", "batched device decodes")
-        pec.add_counter("decode_bytes", "shard bytes decoded")
-        pec.add_counter("mesh_encode_calls",
-                        "encodes dispatched to the device-mesh engine")
-        pec.add_counter("mesh_decode_calls",
-                        "reconstructs via the mesh all-gather path")
-        # the mesh dispatcher lane (ISSUE 8): launch/geometry evidence
-        # for the multi-chip route, distinct from the per-op calls
-        pec.add_counter("mesh_batches",
-                        "coalesced launches served by the mesh lane")
-        pec.add_gauge("mesh_devices",
-                      "devices in the EC mesh slice (pg x shard) as "
-                      "seen by the last mesh-lane launch")
-        # per-engine codec throughput (the number bench.py and
-        # TPU_EVIDENCE track): last-call GB/s gauges + wall-time avgs
-        pec.add_gauge("encode_gbps", "host-path encode GB/s (last call)")
-        pec.add_gauge("decode_gbps", "host-path decode GB/s (last call)")
-        pec.add_gauge("mesh_encode_gbps",
-                      "mesh-engine encode GB/s (last call)")
-        pec.add_gauge("mesh_decode_gbps",
-                      "mesh-engine reconstruct GB/s (last call)")
-        pec.add_time_avg("encode_time", "device encode wall time")
-        pec.add_time_avg("decode_time", "device decode wall time")
-        pec.add_histogram("encode_time_histogram",
-                          "EC encode buffer size x device wall time")
-        pec.add_histogram("decode_time_histogram",
-                          "EC decode shard bytes x device wall time")
-        # cross-op microbatch dispatcher (osd_ec_dispatch; see
-        # osd/ec_dispatch.py): coalesced-launch + bucketing evidence
-        from ..common.perf_counters import PerfHistogramAxis
+        # the shared EC family (osd/ec_perf.py): ONE registration used
+        # by this OSD and the accelerator daemon — the engine room
+        # (dispatcher/supervisor/trace) mutates the same keys in both
+        # processes, so the families must be defined once
+        from .ec_perf import create_accel_client_perf, create_ec_perf
 
-        pec.add_counter("dispatch_batches", "coalesced device launches")
-        pec.add_counter("dispatch_ops",
-                        "encode/decode requests served by coalesced launches")
-        pec.add_counter("dispatch_cancelled",
-                        "queued waiters dropped by op abort")
-        pec.add_counter("dispatch_flush_size",
-                        "batches flushed on the stripe threshold")
-        pec.add_counter("dispatch_flush_window",
-                        "batches flushed on the coalescing window")
-        pec.add_counter("dispatch_flush_stop",
-                        "batches flushed at daemon shutdown")
-        pec.add_counter("dispatch_pad_stripes",
-                        "zero stripes added by shape bucketing")
-        pec.add_counter("dispatch_pad_bytes",
-                        "bucket pad waste in bytes")
-        pec.add_counter("dispatch_native_direct",
-                        "per-op calls routed straight to the native C "
-                        "engine in the worker pool (no coalescing win "
-                        "there — see ec_dispatch)")
-        pec.add_avg("dispatch_occupancy",
-                    "batch stripes / flush threshold at launch")
-        pec.add_histogram(
-            "dispatch_batch_size_histogram",
-            "requests coalesced per device launch",
-            axes=[PerfHistogramAxis("ops", min=1.0, buckets=12)],
-        )
-        # per-lane split of the dispatcher evidence (ISSUE 8
-        # satellite): pad waste / occupancy / batch sizes attributable
-        # per route (native-direct has its own counter above — no
-        # batching there, so no occupancy/pad series)
-        pec.add_counter("dispatch_batches_device",
-                        "coalesced launches on the single-device lane")
-        pec.add_counter("dispatch_batches_mesh",
-                        "coalesced launches on the mesh lane")
-        pec.add_counter("dispatch_ops_device",
-                        "requests served by single-device launches")
-        pec.add_counter("dispatch_ops_mesh",
-                        "requests served by mesh-lane launches")
-        pec.add_counter("dispatch_pad_stripes_device",
-                        "bucket pad stripes on the single-device lane")
-        pec.add_counter("dispatch_pad_stripes_mesh",
-                        "mesh-alignment + bucket pad stripes on the "
-                        "mesh lane")
-        pec.add_counter("dispatch_pad_bytes_device",
-                        "single-device-lane pad waste in bytes")
-        pec.add_counter("dispatch_pad_bytes_mesh",
-                        "mesh-lane pad waste in bytes")
-        pec.add_avg("dispatch_occupancy_device",
-                    "single-device-lane batch stripes / flush threshold")
-        pec.add_avg("dispatch_occupancy_mesh",
-                    "mesh-lane batch stripes / flush threshold")
-        pec.add_histogram(
-            "dispatch_batch_size_device_histogram",
-            "requests coalesced per single-device launch",
-            axes=[PerfHistogramAxis("ops", min=1.0, buckets=12)],
-        )
-        pec.add_histogram(
-            "dispatch_batch_size_mesh_histogram",
-            "requests coalesced per mesh-lane launch",
-            axes=[PerfHistogramAxis("ops", min=1.0, buckets=12)],
-        )
-        # inside-the-kernel device tracing (ops/device_trace, ROADMAP
-        # 5a): per-bucket device-seconds accumulated across closed
-        # `kernel trace` windows, pulled off the report tick; the
-        # occupancy gauge reflects the LAST window (device-busy seconds
-        # / window wall — parallel execution threads can push it >1)
-        pec.add_counter("device_time_fused_op",
-                        "traced device seconds in fused-op/compute "
-                        "HLO events (kernel trace windows)")
-        pec.add_counter("device_time_dma",
-                        "traced device seconds in DMA/infeed/outfeed/"
-                        "copy events")
-        pec.add_counter("device_time_collective",
-                        "traced device seconds in ICI collective "
-                        "events (all-gather/all-reduce/...)")
-        pec.add_gauge("device_occupancy",
-                      "device-busy share of the last trace window "
-                      "(>1 = parallel execution threads)")
-        # accelerator fault domain (osd/ec_failover): the engine_state
-        # gauge feeds the mgr's ACCEL_DEGRADED health check
-        pec.add_gauge("engine_state",
-                      "EC device engine health: 0 healthy / 1 suspect "
-                      "/ 2 tripped / 3 probing")
-        pec.add_counter("engine_failovers",
-                        "batched launches replayed on the host fallback "
-                        "engine after a fatal device error")
-        pec.add_counter("replayed_ops",
-                        "waiter ops served bit-identically by a "
-                        "failover replay")
-        pec.add_counter("launch_deadline_timeouts",
-                        "device launches abandoned at "
-                        "osd_ec_launch_deadline (wedged device call)")
+        pec = create_ec_perf(self.perf)
+        # the OSD-side half of the accel family: this daemon's view of
+        # its remote accelerator lane (ISSUE 10; AccelClient mutates)
+        pacc = create_accel_client_perf(self.perf)
         # QoS op scheduler (reference: osd_op_queue selecting the
         # mClock/WPQ op queues; see osd/scheduler.py): per-class
         # counters are registered with LITERAL keys so the
@@ -479,7 +363,9 @@ class OSD(Dispatcher):
         # pacing squeezes to reservation
         self.ec_dispatch = None
         self.ec_supervisor = None
+        self.accel_client = None
         if getattr(cfg, "osd_ec_dispatch", True):
+            from ..accel.client import AccelClient
             from .ec_dispatch import ECDispatcher
             from .ec_failover import EngineSupervisor
 
@@ -495,6 +381,19 @@ class OSD(Dispatcher):
                     self.scheduler, "capacity_degraded", d
                 ),
             )
+            # the remote dispatcher lane (ISSUE 10): coalesced batches
+            # ship to a shared accelerator daemon over the messenger.
+            # Constructed even with osd_ec_accel_mode=off (the default)
+            # — `config set osd_ec_accel_addr/mode` on a RUNNING osd
+            # must arm the lane live, exactly like the breaker above
+            self.accel_client = AccelClient(
+                self.messenger,
+                addr=cfg.osd_ec_accel_addr,
+                mode=cfg.osd_ec_accel_mode,
+                deadline=cfg.osd_ec_accel_deadline,
+                retry_interval=cfg.osd_ec_accel_retry_interval,
+                perf=pacc,
+            )
             self.ec_dispatch = ECDispatcher(
                 perf=pec,
                 window=cfg.osd_ec_dispatch_window,
@@ -505,6 +404,7 @@ class OSD(Dispatcher):
                 launch_deadline=cfg.osd_ec_launch_deadline,
                 mesh_engine=self.ec_mesh,
                 launch_history=cfg.osd_ec_launch_history,
+                remote=self.accel_client,
             )
             self.ec_dispatch.inject_engine_failure = \
                 cfg.ec_inject_engine_failure
@@ -597,6 +497,26 @@ class OSD(Dispatcher):
             ("ec_inject_launch_hang", lambda _n, v: (
                 self.ec_dispatch is not None
                 and setattr(self.ec_dispatch, "inject_launch_hang",
+                            float(v))
+            )),
+            # remote accelerator lane knobs (ISSUE 10): routing must
+            # re-target / re-mode on a RUNNING osd — the fault matrix
+            # and MiniCluster wiring both flip them live
+            ("osd_ec_accel_addr", lambda _n, v: (
+                self.accel_client is not None
+                and self.accel_client.set_addr(str(v))
+            )),
+            ("osd_ec_accel_mode", lambda _n, v: (
+                self.accel_client is not None
+                and self.accel_client.set_mode(str(v))
+            )),
+            ("osd_ec_accel_deadline", lambda _n, v: (
+                self.accel_client is not None
+                and setattr(self.accel_client, "deadline", float(v))
+            )),
+            ("osd_ec_accel_retry_interval", lambda _n, v: (
+                self.accel_client is not None
+                and setattr(self.accel_client, "retry_interval",
                             float(v))
             )),
             # QoS scheduler knobs stay live: `config set osd_op_queue
@@ -1027,6 +947,13 @@ class OSD(Dispatcher):
             nw = self._notify_waiters.get(msg.notify_id)
             if nw:
                 nw.ack(msg.cookie, msg.blobs[0] if msg.blobs else b"")
+        elif isinstance(msg, (messages.MAccelReply, messages.MAccelBeacon)):
+            # shared-accelerator traffic (ISSUE 10): replies resolve the
+            # remote lane's in-flight batches, beacons update the
+            # routing health (TRIPPED/saturated -> local lanes, no
+            # timeout chain)
+            if self.accel_client is not None:
+                self.accel_client.handle(msg, conn)
         elif isinstance(msg, messages.MOSDRepOp):
             self._handle_rep_op(conn, msg)
         elif isinstance(msg, messages.MOSDRepOpReply):
@@ -1058,6 +985,12 @@ class OSD(Dispatcher):
             return
         if conn is self._mgr_conn:
             self._mgr_conn = None
+        if self.accel_client is not None:
+            # the accelerator link died: fail the remote lane's
+            # in-flight batches NOW (they replay on the local fallback
+            # without waiting out the RPC deadline) and mark the remote
+            # unreachable so new batches route local immediately
+            self.accel_client.on_reset(conn)
         # a dead client's watches die with its connection (reference:
         # Watch.cc handle_watch_timeout; lingers re-register on reconnect)
         for key, table in list(self._watchers.items()):
@@ -3844,6 +3777,10 @@ class OSD(Dispatcher):
             # engine_state must survive an admin `perf reset` — a
             # zeroed gauge would clear ACCEL_DEGRADED while TRIPPED
             self.ec_supervisor.refresh_gauge()
+        if self.accel_client is not None:
+            # same rule for remote_unreachable: a perf reset must not
+            # silently clear ACCEL_UNREACHABLE while the remote is down
+            self.accel_client.refresh_gauges()
         self._pull_device_trace_totals()
         slow = self.op_tracker.slow_ops(self.config.osd_op_complaint_time)
         posd = self.perf.get("osd")

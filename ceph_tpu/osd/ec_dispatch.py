@@ -62,7 +62,24 @@ engine as a first-class dispatcher lane, not a bypass):
   included) replays bit-identically on the host fallback via the same
   classifier and supervisor.
 
-A fifth mechanism rides on top (the accelerator fault domain,
+A fifth mechanism is the **remote lane** (ISSUE 10 — the shared
+accelerator service, ``ceph_tpu.accel``):
+
+- with ``osd_ec_accel_mode`` = prefer|require and an
+  ``osd_ec_accel_addr`` configured, coalesced batches ship to a
+  standalone accelerator daemon over the messenger
+  (:class:`~ceph_tpu.accel.client.AccelClient`) instead of launching
+  on this process's device — payloads as borrowed frame views, QoS
+  class + geometry in the fields, trace id on the frame header.  The
+  accelerator re-coalesces across CLIENT OSDs (the shared-occupancy
+  win) through its own dispatcher instance.  The remote is its own
+  fault domain: its beacons gate routing (a TRIPPED or saturated
+  remote sheds to the local lanes with no timeout chain), its faults
+  never advance the LOCAL breaker, and a remote fatal — accelerator
+  death mid-batch included — replays the batch on the local host
+  fallback, bit-identically (flight record ``origin=remote``).
+
+A sixth mechanism rides on top (the accelerator fault domain,
 osd/ec_failover):
 
 - **engine failover** — a batched device launch that fails with a
@@ -142,16 +159,23 @@ def bucket_stripes_aligned(s: int, quantum: int = 1,
 class _Op:
     """One queued waiter: its payload and the future its op awaits.
     ``trace``/``t_submit`` feed the launch flight recorder — the
-    queue-wait split and the slow-op -> launch correlation."""
+    queue-wait split and the slow-op -> launch correlation.
+    ``client`` names the requesting entity when this dispatcher serves
+    REMOTE callers (the accelerator daemon, ISSUE 10: cross-client
+    coalescing is the occupancy win, and the flight recorder must say
+    which OSDs shared a launch)."""
 
-    __slots__ = ("fut", "stripes", "payload", "trace", "t_submit")
+    __slots__ = ("fut", "stripes", "payload", "trace", "t_submit",
+                 "client")
 
-    def __init__(self, fut: asyncio.Future, stripes: int, payload: Any):
+    def __init__(self, fut: asyncio.Future, stripes: int, payload: Any,
+                 client: str | None = None):
         self.fut = fut
         self.stripes = stripes
         self.payload = payload
         self.trace = current_trace.get()
         self.t_submit = time.monotonic()
+        self.client = client
 
 
 class _Batch:
@@ -187,8 +211,16 @@ class ECDispatcher:
                  max_workers: int = 2, scheduler=None,
                  supervisor=None, launch_deadline: float = 0.0,
                  hb_handle=None, mesh_engine=None,
-                 launch_history: int = 64):
+                 launch_history: int = 64, remote=None):
         self._perf = perf
+        # the remote accelerator lane (accel/client.AccelClient; None =
+        # local lanes only, ISSUE 10): coalesced batches ship to a
+        # shared accelerator daemon over the messenger instead of
+        # launching on this process's device.  The remote has ITS OWN
+        # fault domain — its faults never touch the local supervisor's
+        # breaker (a network trip must not bench the local device), and
+        # a failed remote batch replays on the LOCAL fallback engine
+        self._remote = remote
         # the multi-chip mesh lane (parallel/engine.MeshEcEngine; None
         # = single-device only).  supports()/routes() never touch the
         # device; the first mesh-lane submit resolves jax.devices()
@@ -252,11 +284,18 @@ class ECDispatcher:
             "lanes": {
                 lane: {"batches": 0, "ops": 0, "stripes": 0,
                        "pad_stripes": 0, "pad_bytes": 0}
-                for lane in ("device", "mesh")
+                for lane in ("device", "mesh", "remote")
             },
+            # launches whose member ops came from >1 client entity
+            # (only a remote-serving dispatcher — the accelerator
+            # daemon — ever sees clients; cross-client coalescing is
+            # the shared-device occupancy win, ISSUE 10)
+            "cross_client_batches": 0,
         }
         # padded S -> launches, per lane (O(log max_S) rows per lane
-        # by construction; the mesh lane's rows are mesh_size-aligned)
+        # by construction; the mesh lane's rows are mesh_size-aligned;
+        # the remote lane ships unpadded — the accelerator owns the
+        # bucketing for its own jit cache — so it has no table)
         self._buckets_seen: dict[str, dict[int, int]] = {
             "device": {}, "mesh": {},
         }
@@ -271,14 +310,18 @@ class ECDispatcher:
 
     async def encode(
         self, sinfo: ec_util.StripeInfo, codec, data, *,
-        klass: str = "client",
+        klass: str = "client", client: str | None = None,
     ) -> dict[int, np.ndarray]:
         """Batched analog of :func:`ec_util.encode` — same contract,
         same bytes; may share its device launch with other in-flight
         ops.  ``klass`` is the QoS traffic class: background stripes
         pace through the scheduler before entering a batch window, and
         batches never mix classes (the key includes it), so a client
-        batch is never held open for — or padded by — recovery math."""
+        batch is never held open for — or padded by — recovery math.
+        ``client`` names the requesting entity on a remote-serving
+        dispatcher (the accelerator daemon tags each request with its
+        OSD peer, so the flight recorder can show which clients shared
+        a launch)."""
         buf = as_u8(data)
         if buf.size % sinfo.stripe_width != 0:
             raise ValueError(
@@ -297,10 +340,20 @@ class ECDispatcher:
             # open a batch nobody will ever flush (and the executor
             # would refuse the launch)
             return self._inline_encode_fn()(sinfo, codec, buf)
-        # lane selection: the mesh (an explicit operator opt-in via
-        # osd_ec_mesh) outranks the native C engine, exactly as the old
-        # router ordered its routes; the native lane outranks the
-        # single-device jax lane on CPU hosts as before
+        # lane selection: the remote accelerator (an explicit operator
+        # opt-in via osd_ec_accel_mode, ISSUE 10) outranks every local
+        # lane — its whole point is taking the device math off this
+        # host; its OWN breaker beacon gates it, not the local
+        # supervisor.  Below it, the mesh (osd_ec_mesh) outranks the
+        # native C engine, exactly as the old router ordered its
+        # routes; the native lane outranks the single-device jax lane
+        # on CPU hosts as before
+        if self._remote is not None and self._remote.routes(codec):
+            key = ("enc", "remote", None, klass, id(codec),
+                   sinfo.stripe_width, sinfo.chunk_size)
+            return await self._submit(key, "enc", codec, sinfo, buf,
+                                      stripes, lane="remote",
+                                      klass=klass, client=client)
         lane = "mesh" if (
             self._mesh is not None and self._mesh.routes(sinfo, codec)
         ) else "device"
@@ -309,7 +362,7 @@ class ECDispatcher:
             # keep per-op (cache-resident) calls, just off the loop
             return await self._run_native_direct(
                 ec_util.encode, sinfo, codec, buf, "encode", buf.size,
-                klass=klass,
+                klass=klass, client=client,
             )
         if self._supervisor is not None and not self._supervisor.device_ok():
             # breaker TRIPPED/PROBING: the device engine — mesh slice
@@ -319,7 +372,7 @@ class ECDispatcher:
             # the supervisor re-promotes)
             return await self._run_fallback_direct(
                 ec_util.encode_fallback, sinfo, codec, buf,
-                "encode", buf.size, klass=klass,
+                "encode", buf.size, klass=klass, client=client,
             )
         mesh_slice = (
             self._mesh.mesh_key(codec.get_data_chunk_count())
@@ -329,11 +382,12 @@ class ECDispatcher:
                sinfo.stripe_width, sinfo.chunk_size)
         return await self._submit(key, "enc", codec, sinfo, buf, stripes,
                                   lane=lane, mesh_slice=mesh_slice,
-                                  klass=klass)
+                                  klass=klass, client=client)
 
     async def decode_concat(
         self, sinfo: ec_util.StripeInfo, codec,
         chunks: Mapping[int, np.ndarray], *, klass: str = "client",
+        client: str | None = None,
     ) -> bytes:
         """Batched analog of :func:`ec_util.decode_concat`.  Requests
         coalesce only with peers reading through the SAME survivor set
@@ -356,24 +410,38 @@ class ECDispatcher:
         if self._stopping:
             # see encode(): stop() may have won the race while pacing
             return self._inline_decode_fn()(sinfo, codec, arrs)
+        k = codec.get_data_chunk_count()
+        missing = any(r not in arrs for r in range(k))
+        # remote lane first (see encode()) — but only when rows are
+        # MISSING: an all-rows-present concat does no device math, and
+        # shipping its payload across the wire to do a host transform
+        # there would be pure network waste
+        if (missing and self._remote is not None
+                and self._remote.routes(codec)):
+            present = tuple(sorted(arrs))
+            key = ("dec", "remote", None, klass, id(codec),
+                   sinfo.stripe_width, sinfo.chunk_size, present)
+            return await self._submit(key, "dec", codec, sinfo, arrs,
+                                      stripes, lane="remote",
+                                      klass=klass, client=client)
         # the mesh lane only earns its keep when rows are MISSING (the
         # ICI all-gather reconstruct); a plain concat read stays on the
         # device/native lanes — the same gate the old router applied
-        k = codec.get_data_chunk_count()
         lane = "mesh" if (
             self._mesh is not None
             and self._mesh.routes(sinfo, codec)
-            and any(r not in arrs for r in range(k))
+            and missing
         ) else "device"
         if lane != "mesh" and ec_util.native_decode_path(codec, shard_len):
             return await self._run_native_direct(
                 ec_util.decode_concat, sinfo, codec, arrs, "decode",
-                shard_len * len(arrs), klass=klass,
+                shard_len * len(arrs), klass=klass, client=client,
             )
         if self._supervisor is not None and not self._supervisor.device_ok():
             return await self._run_fallback_direct(
                 ec_util.decode_concat_fallback, sinfo, codec, arrs,
                 "decode", shard_len * len(arrs), klass=klass,
+                client=client,
             )
         present = tuple(sorted(arrs))
         mesh_slice = self._mesh.mesh_key(k) if lane == "mesh" else None
@@ -381,7 +449,7 @@ class ECDispatcher:
                sinfo.stripe_width, sinfo.chunk_size, present)
         return await self._submit(key, "dec", codec, sinfo, arrs, stripes,
                                   lane=lane, mesh_slice=mesh_slice,
-                                  klass=klass)
+                                  klass=klass, client=client)
 
     def _inline_encode_fn(self):
         """Engine for the inline per-op lanes (empty payload, shutdown
@@ -482,6 +550,8 @@ class ECDispatcher:
                 for b in self._open.values()
             ],
             "mesh_lane": self._mesh is not None,
+            **({"remote": self._remote.dump()}
+               if self._remote is not None else {}),
             "totals": {
                 **{k: v for k, v in self._totals.items() if k != "flush"},
                 "flush_reasons": dict(self._totals["flush"]),
@@ -504,7 +574,8 @@ class ECDispatcher:
     async def _run_direct(self, fn, sinfo, codec, payload, op: str,
                           nbytes: int, totals_key: str,
                           perf_key: str | None = None,
-                          klass: str = "client"):
+                          klass: str = "client",
+                          client: str | None = None):
         """Per-op call in the worker pool (event-loop liberation
         without coalescing) — shared by the native C lane and the
         host-fallback lane (the serving path while the device engine
@@ -527,6 +598,7 @@ class ECDispatcher:
             chunk_size=sinfo.chunk_size, queue_wait_s=0.0,
             slowest_trace=current_trace.get(),
             traces=[current_trace.get()],
+            **({"clients": [client]} if client else {}),
         )
 
         def _timed_call():
@@ -552,21 +624,25 @@ class ECDispatcher:
         return out
 
     def _run_native_direct(self, fn, sinfo, codec, payload, op: str,
-                           nbytes: int, klass: str = "client"):
+                           nbytes: int, klass: str = "client",
+                           client: str | None = None):
         return self._run_direct(fn, sinfo, codec, payload, op, nbytes,
                                 "native_direct",
                                 perf_key="dispatch_native_direct",
-                                klass=klass)
+                                klass=klass, client=client)
 
     def _run_fallback_direct(self, fn, sinfo, codec, payload, op: str,
-                             nbytes: int, klass: str = "client"):
+                             nbytes: int, klass: str = "client",
+                             client: str | None = None):
         return self._run_direct(fn, sinfo, codec, payload, op, nbytes,
-                                "fallback_direct", klass=klass)
+                                "fallback_direct", klass=klass,
+                                client=client)
 
     async def _submit(self, key: tuple, kind: str, codec, sinfo,
                       payload, stripes: int, *, lane: str = "device",
                       mesh_slice: tuple | None = None,
-                      klass: str = "client"):
+                      klass: str = "client",
+                      client: str | None = None):
         loop = asyncio.get_running_loop()
         b = self._open.get(key)
         if b is not None and b.ops and (
@@ -592,7 +668,7 @@ class ECDispatcher:
             delay = self.window if self._last_ops > 1 else 0.0
             b.timer = loop.call_later(delay, self._flush, key, "window")
         fut = loop.create_future()
-        b.ops.append(_Op(fut, stripes, payload))
+        b.ops.append(_Op(fut, stripes, payload, client=client))
         b.stripes += stripes
         if b.stripes >= self.max_stripes:
             self._flush(key, "size")
@@ -628,6 +704,7 @@ class ECDispatcher:
         batch's queue-wait number."""
         now = time.monotonic()
         oldest = min(ops, key=lambda op: op.t_submit)
+        clients = sorted({op.client for op in ops if op.client})
         return self.flight.begin(
             lane=b.lane, kind=b.kind, klass=b.klass, reason=reason,
             ops=len(ops), stripes=b.stripes,
@@ -636,6 +713,10 @@ class ECDispatcher:
             queue_wait_s=round(now - oldest.t_submit, 6),
             slowest_trace=oldest.trace,
             traces=[op.trace for op in ops],
+            # which OSDs shared this launch (only a remote-serving
+            # dispatcher — the accelerator daemon — tags clients): the
+            # stripe stays traceable client->OSD->accelerator->device
+            **({"clients": clients} if clients else {}),
         )
 
     async def _run_batch(self, b: _Batch, ops: list[_Op],
@@ -652,27 +733,48 @@ class ECDispatcher:
 
     async def _run_batch_inner(self, b: _Batch, ops: list[_Op],
                                reason: str, flight: int) -> None:
+        origin = None
+        extra: dict = {}
         try:
-            results, pad, seconds = await self._launch(b, ops)
-            if self._supervisor is not None:
+            results, pad, seconds, extra = await self._launch(b, ops)
+            if b.lane != "remote" and self._supervisor is not None:
+                # a remote success says nothing about the LOCAL device
+                # — only local launches close the local breaker
                 self._supervisor.record_success()
         except Exception as e:
             # the fault fork (osd/ec_failover): FATAL errors — device
             # lost, XLA runtime, OOM, compile, a blown launch deadline
             # — replay the whole batch on the host fallback engine
             # (bit-identical), so no waiter ever sees a device error;
-            # data-shape errors surface to every waiter as before
+            # data-shape errors surface to every waiter as before.
+            # REMOTE batches fork the same way, but against their own
+            # fault domain: the accelerator's failure never advances
+            # the local supervisor's breaker (a network trip must not
+            # bench a healthy local device), and a remote fatal always
+            # replays locally — accelerator death mid-batch is
+            # classified like device death (ISSUE 10)
             sup = self._supervisor
-            if isinstance(e, LaunchDeadlineExceeded):
+            if b.lane == "remote":
+                from ..accel.client import AccelDataError
+
+                kind = ("data" if isinstance(e, AccelDataError)
+                        else "fatal")
+                replayable = kind == "fatal"
+                if replayable:
+                    self._remote.note_failure(e)
+            elif isinstance(e, LaunchDeadlineExceeded):
                 # record_timeout already advanced the breaker (and
                 # counted the timeout) inside _bounded_device_call —
                 # re-recording here would double-count one wedge as a
                 # timeout AND a fatal error
                 kind = "fatal"
+                replayable = sup is not None and sup.enabled
             else:
                 kind = (sup.record_failure(e, lane=b.lane)
                         if sup is not None else "data")
-            if kind != "fatal" or sup is None or not sup.enabled:
+                replayable = (kind == "fatal" and sup is not None
+                              and sup.enabled)
+            if not replayable:
                 # data errors always surface; fatal errors surface too
                 # when failover is off (no supervisor, or live-disabled
                 # via osd_ec_engine_failover) — the pre-failover contract
@@ -681,9 +783,11 @@ class ECDispatcher:
                         op.fut.set_exception(e)
                 self.flight.end(flight, served="error", error=repr(e))
                 return
-            self._last_trip = (b.kind, b.sinfo, b.codec, b.lane)
+            if b.lane != "remote":
+                self._last_trip = (b.kind, b.sinfo, b.codec, b.lane)
             try:
                 results, pad, seconds = await self._replay(b, ops)
+                extra = {}
             except Exception as e2:
                 # the fallback failed too (a data error the device
                 # masked, or a host fault): surface THAT error — it is
@@ -696,6 +800,10 @@ class ECDispatcher:
             self._note_failover(b, ops, e)
             served = "fallback"
             flight_error = repr(e)
+            # the satellite fix (ISSUE 10): a fallback-served record
+            # must say WHERE the fault was — "remote" is a network/
+            # accelerator trip, "device"/"mesh" a local device trip
+            origin = b.lane
         else:
             served = b.lane
             flight_error = None
@@ -705,17 +813,33 @@ class ECDispatcher:
             if not op.fut.done():
                 op.fut.set_result(res)
         self.flight.end(flight, device_wall_s=seconds, served=served,
-                        error=flight_error)
+                        error=flight_error, origin=origin, **extra)
         try:
             self._note_batch(b, ops, reason, pad, seconds, served)
         except Exception:  # swallow-ok: observability is best-effort by contract
             pass
 
     async def _launch(self, b: _Batch, ops: list[_Op]):
-        return await self._bounded_device_call(
+        """Returns ``(results, pad, seconds, extra)`` — ``extra`` is
+        flight-record enrichment (the remote lane reports which engine
+        the ACCELERATOR served from; local lanes have nothing to
+        add)."""
+        if b.lane == "remote":
+            # the remote lane is messenger I/O, not a worker-pool
+            # device call: the AccelClient bounds it with its own RPC
+            # deadline (osd_ec_accel_deadline) and raises
+            # AccelUnavailable/AccelServiceError for the fork above —
+            # no watchdog pin (nothing can wedge a thread here)
+            results, pad, seconds, served_by = \
+                await self._remote.run_batch(b, ops)
+            return results, pad, seconds, (
+                {"remote_served": served_by} if served_by else {}
+            )
+        results, pad, seconds = await self._bounded_device_call(
             f"{b.kind} launch ({b.stripes} stripes)",
             self._run_sync, b, ops,
         )
+        return results, pad, seconds, {}
 
     async def _bounded_device_call(self, label: str, fn, *args):
         """One device call in the worker pool, bounded by
@@ -918,9 +1042,18 @@ class ECDispatcher:
             lt["stripes"] += stripes
             lt["pad_stripes"] += pad
             lt["pad_bytes"] += pad * b.sinfo.stripe_width
-            sp = stripes + pad
-            lb = self._buckets_seen[served]
-            lb[sp] = lb.get(sp, 0) + 1
+            if served in self._buckets_seen:
+                # the remote lane ships unpadded (the accelerator owns
+                # the bucketing), so only local lanes keep a table
+                sp = stripes + pad
+                lb = self._buckets_seen[served]
+                lb[sp] = lb.get(sp, 0) + 1
+        if len({op.client for op in ops if op.client}) > 1:
+            # ops from more than one client OSD shared this launch —
+            # the accelerator's cross-client coalescing win (ISSUE 10;
+            # the accel daemon mirrors this total into its
+            # accel.cross_client_batches counter off its beacon tick)
+            t["cross_client_batches"] += 1
         pec = self._perf
         if pec is None:
             return
@@ -961,6 +1094,18 @@ class ECDispatcher:
                         pad * b.sinfo.stripe_width)
             pec.observe("dispatch_occupancy_device", occupancy)
             pec.hist("dispatch_batch_size_device_histogram", len(ops))
+        elif served == "remote":
+            pec.inc("dispatch_batches_remote")
+            pec.inc("dispatch_ops_remote", len(ops))
+            pec.observe("dispatch_occupancy_remote", occupancy)
+            pec.hist("dispatch_batch_size_remote_histogram", len(ops))
+            # device wall time belongs to the ACCELERATOR's ec family
+            # (it reports to the mgr itself); this OSD's client-side
+            # view — batches/bytes/rtt — is accounted by the
+            # AccelClient.  Feeding the remote's seconds into the
+            # local encode/decode gauges would paint phantom local
+            # device throughput.
+            return
         # device-wall-time accounting from this LAUNCH's own time
         # (logical bytes, pad excluded): the daemon's op-level timer
         # includes queue wait and batch sharing, so on the dispatch
@@ -1024,8 +1169,6 @@ class ECDispatcher:
         cs = sinfo.chunk_size
         total = sum(op.stripes for op in ops)
         pad = 0 if fallback else self._pad_for(b, total)
-        if not fallback:
-            self._maybe_inject()
         if b.kind == "enc":
             if len(ops) == 1 and not pad:
                 cat = ops[0].payload  # single op, snug bucket: no gather
@@ -1043,6 +1186,13 @@ class ECDispatcher:
                     off += n
                 note_copy("ec_gather", off)
             t0 = time.perf_counter()
+            if not fallback:
+                # inside the timed window: the hang variant SIMULATES a
+                # wedged device call, and a wedged call is slow DEVICE
+                # WALL — timing it out of the window made the injected
+                # slow launch invisible to the flight recorder, exactly
+                # the record dump_launch_history exists to show
+                self._maybe_inject()
             out = encode_fn(sinfo, codec, cat)
             seconds = time.perf_counter() - t0
             results = []
@@ -1073,6 +1223,8 @@ class ECDispatcher:
             cat[s] = buf
         k = codec.get_data_chunk_count()
         t0 = time.perf_counter()
+        if not fallback:
+            self._maybe_inject()  # see the encode side: device wall
         decoded = decode_fn(sinfo, codec, cat, want=list(range(k)))
         seconds = time.perf_counter() - t0
         rows = [np.asarray(decoded[i]) for i in range(k)]

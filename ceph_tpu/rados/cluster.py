@@ -89,6 +89,8 @@ class MiniCluster:
         self._mgr_seq = 0  # monotonic: killed mgrs' names never recycle
         self.mdss: dict[str, "object"] = {}  # name -> MDSDaemon
         self._mds_seq = 0
+        self.accels: dict[str, "object"] = {}  # name -> AccelDaemon
+        self._accel_seq = 0
         self._clients: list[RadosClient] = []
 
     def _daemon_config(self):
@@ -271,6 +273,33 @@ class MiniCluster:
                     return active
                 await asyncio.sleep(0.01)
 
+    # -- shared EC accelerator (ceph_tpu.accel, ISSUE 10) -------------------
+    async def start_accel(self, name: str | None = None, config=None):
+        """One shared accelerator daemon on loopback; wire the OSDs at
+        it with :meth:`route_osds_to_accel` (the options are live)."""
+        from ..accel import AccelDaemon
+
+        self._accel_seq += 1
+        name = name or f"accel.{self._accel_seq}"
+        acc = AccelDaemon(name, mon_addr=self.monmap or self.mon.addr,
+                          config=config or self._daemon_config())
+        await acc.start()
+        self.accels[name] = acc
+        return acc
+
+    async def kill_accel(self, name: str, crash: bool = False) -> None:
+        """``crash=True`` models SIGKILL mid-batch: connections die
+        without replies, and the OSDs must replay in-flight batches on
+        their local fallback engines (zero failed client ops)."""
+        await self.accels.pop(name).stop(crash=crash)
+
+    def route_osds_to_accel(self, addr: str, mode: str = "prefer") -> None:
+        """Point every running OSD's remote EC lane at ``addr`` (live
+        config — takes effect on the next batch)."""
+        for osd in self.osds.values():
+            osd.config.set("osd_ec_accel_addr", addr)
+            osd.config.set("osd_ec_accel_mode", mode)
+
     # -- mds (reference:src/mds; vstart's MDS_COUNT) ------------------------
     async def start_mds(self, name: str | None = None, config=None, **kw):
         from ..mds import MDSDaemon
@@ -302,6 +331,8 @@ class MiniCluster:
             await self.kill_mds(name)
         for name in list(self.mgrs):
             await self.kill_mgr(name)
+        for name in list(self.accels):
+            await self.kill_accel(name)
         for osd_id in list(self.osds):
             await self.kill_osd(osd_id)
         for rank in list(self.mons):

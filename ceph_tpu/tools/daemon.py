@@ -23,18 +23,22 @@ import os
 import signal
 import sys
 
-# Daemons never touch the accelerator — and on hosts where a
-# sitecustomize pins an experimental jax platform (the axon TPU tunnel),
-# merely importing the framework would make every daemon process fight
-# over the single device, stalling heartbeats into false failures.
-# jax.config is the only override that works once sitecustomize has run
-# (the JAX_PLATFORMS env var is a no-op by then).
-try:
-    import jax
+def _pin_cpu_platform() -> None:
+    """mon/osd daemons never touch the accelerator — and on hosts where
+    a sitecustomize pins an experimental jax platform (the axon TPU
+    tunnel), merely importing the framework would make every daemon
+    process fight over the single device, stalling heartbeats into
+    false failures.  jax.config is the only override that works once
+    sitecustomize has run (the JAX_PLATFORMS env var is a no-op by
+    then).  The ``accel`` role is the ONE exception: the accelerator
+    daemon exists to own the device, so it keeps whatever platform the
+    host pinned (ceph_tpu.accel)."""
+    try:
+        import jax
 
-    jax.config.update("jax_platforms", "cpu")
-except Exception:  # pragma: no cover - jax is a hard dep in practice
-    pass
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # pragma: no cover - jax is a hard dep in practice
+        pass
 
 
 def _make_store(path: str, kind: str):
@@ -66,6 +70,24 @@ async def _run_mon(args) -> None:
     print(f"mon.{args.rank} up at {mon.addr}", flush=True)
     await _until_term(args.watch_parent)
     await mon.stop()
+
+
+async def _run_accel(args) -> None:
+    from ..accel import AccelDaemon
+
+    acc = AccelDaemon(
+        f"accel.{args.id}",
+        mon_addr=(args.monmap.split(",") if args.monmap else None),
+    )
+    # a real process: suicide must end the PROCESS even when a wedged
+    # device call sits in a non-daemon executor thread (same contract
+    # as the OSD's launch watchdog)
+    acc.suicide_hard_exit = True
+    host, port = args.addr.rsplit(":", 1)
+    await acc.start(host, int(port))
+    print(f"accel.{args.id} up at {acc.addr}", flush=True)
+    await _until_term(args.watch_parent)
+    await acc.stop()
 
 
 async def _run_osd(args) -> None:
@@ -155,7 +177,13 @@ def main(argv=None) -> int:
     po.add_argument("--store", required=True)
     po.add_argument("--store-kind", default="wal", choices=["wal", "blue"])
     po.add_argument("--heartbeat-interval", type=float, default=1.0)
-    for sp in (pm, po):
+    pa = sub.add_parser("accel")
+    pa.add_argument("--id", type=int, required=True)
+    pa.add_argument("--addr", required=True, help="host:port to bind")
+    pa.add_argument("--monmap", default=None,
+                    help="comma-sep mon addrs (optional: enables map "
+                         "subscription + mgr reporting)")
+    for sp in (pm, po, pa):
         sp.add_argument("--verbose", action="store_true")
         sp.add_argument(
             "--watch-parent", type=int, default=None, metavar="PID",
@@ -163,6 +191,8 @@ def main(argv=None) -> int:
         )
     args = p.parse_args(argv)
     _arm_parent_death(args.watch_parent)
+    if args.role != "accel":
+        _pin_cpu_platform()
     if args.verbose:
         import logging
 
@@ -170,7 +200,8 @@ def main(argv=None) -> int:
             level=logging.INFO,
             format="%(asctime)s %(name)s %(message)s",
         )
-    coro = _run_mon(args) if args.role == "mon" else _run_osd(args)
+    coro = {"mon": _run_mon, "osd": _run_osd,
+            "accel": _run_accel}[args.role](args)
     asyncio.run(coro)
     return 0
 

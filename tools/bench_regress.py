@@ -68,7 +68,8 @@ PHASE_AGNOSTIC_METRICS = {"stack_gbps", "raw_cpu_gbps", "stack_vs_raw",
 # convenience spellings -> the dotted path inside the final line
 METRIC_ALIASES = {"stack_e2e_gbps": "stack_e2e.stack_e2e_gbps",
                   "mesh_scaling_efficiency": "mesh.scaling_efficiency",
-                  "mesh_ici_share": "mesh.ici_share"}
+                  "mesh_ici_share": "mesh.ici_share",
+                  "accel_occupancy": "accel.occupancy"}
 
 # per-metric default thresholds (used when --threshold is not given):
 # mesh.scaling_efficiency is a RATIO (per-chip efficiency of the
@@ -77,8 +78,14 @@ METRIC_ALIASES = {"stack_e2e_gbps": "stack_e2e.stack_e2e_gbps",
 # jitter budget the throughput metrics need.  Rounds without the mesh
 # record simply lack the metric, so the gate skips cleanly (exit 0)
 # until two same-phase rounds carry it.
+# accel.occupancy (ISSUE 10) is the shared accelerator's device
+# occupancy under an N-feeder storm — a RATIO like the mesh
+# efficiency, same 20% budget; rounds predating the accel phase
+# simply lack the metric, so the gate skips cleanly (exit 0) until
+# two rounds carry it.
 METRIC_DEFAULT_THRESHOLDS = {"mesh.scaling_efficiency": 0.8,
-                             "mesh.ici_share": 0.8}
+                             "mesh.ici_share": 0.8,
+                             "accel.occupancy": 0.8}
 
 # metrics where GROWTH is the regression: mesh.ici_share (ISSUE 9) is
 # the ICI all-gather's share of the mesh reconstruct's device time,

@@ -21,9 +21,13 @@ annotation on the same line or the line above; annotated sites pass and
 double as documentation.  An annotation with no reason text fails — the
 allowlist must say WHY each copy is allowed.
 
-Hot-path scope (the client->striper->messenger->OSD->device pipeline):
+Hot-path scope (the client->striper->messenger->OSD->device pipeline,
+plus the shared-accelerator RPC assembly path — batch payloads crossing
+the messenger to ceph_tpu.accel must stay view-based, or every remote
+batch pays a silent re-materialization on the hot path):
     ceph_tpu/msg/            ceph_tpu/rados/striper.py
     ceph_tpu/osd/ec_util.py  ceph_tpu/osd/ec_dispatch.py
+    ceph_tpu/accel/
 
 Usage: ``python tools/check_copies.py [repo_root]`` — exits 0 when
 clean, 1 with a per-site report otherwise.
@@ -40,6 +44,7 @@ HOT_PATHS = (
     "ceph_tpu/rados/striper.py",
     "ceph_tpu/osd/ec_util.py",
     "ceph_tpu/osd/ec_dispatch.py",
+    "ceph_tpu/accel",
 )
 
 ANNOTATION = "# copy-ok:"

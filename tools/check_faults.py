@@ -34,6 +34,13 @@ same device path, so a capture racing an engine trip must degrade to
     ceph_tpu/parallel/engine.py
     ceph_tpu/parallel/mesh.py
     ceph_tpu/ops/device_trace.py
+    ceph_tpu/accel/client.py
+    ceph_tpu/accel/daemon.py
+
+(the shared accelerator service, ISSUE 10, extends the same fault
+domain across the messenger: a swallowed error on either side would
+eat exactly the device-loss signal the OSD's local-replay fork and the
+accelerator's own breaker both depend on)
 
 Usage: ``python tools/check_faults.py [repo_root]`` — exits 0 when
 clean, 1 with a per-site report otherwise.
@@ -52,6 +59,8 @@ HOT_PATHS = (
     "ceph_tpu/parallel/engine.py",
     "ceph_tpu/parallel/mesh.py",
     "ceph_tpu/ops/device_trace.py",
+    "ceph_tpu/accel/client.py",
+    "ceph_tpu/accel/daemon.py",
 )
 
 ANNOTATION = "# swallow-ok:"
