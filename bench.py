@@ -1573,15 +1573,151 @@ def bench_accel(deadline: float | None, platform: str | None) -> dict:
             await d.stop()
         return dt, stats
 
+    async def fleet_pass():
+        """Multi-accel phase (ISSUE 11 / ROADMAP 3): the same trickling
+        feeders, SKEWED 4:1:1:1, over a TWO-accel fleet routed by the
+        AccelRouter (a synthetic AccelMap — no mon in the bench
+        topology) — and one accelerator is crash-killed mid-run.  The
+        claims measured: aggregate fleet occupancy holds under feeder
+        skew (the router's least-loaded balancing spreads the hot
+        feeder), and accel death REBALANCES to the survivor with zero
+        failed ops and zero local-fallback replays (inter-accel
+        failover, gated via ``bench_regress --metric
+        accel.fleet_occupancy``)."""
+        from ceph_tpu.accel import AccelMap, AccelRouter
+        from ceph_tpu.common import Config
+
+        accs = []
+        for i in range(2):
+            a = AccelDaemon(f"accel.f{i}", config=Config(overrides={
+                "osd_ec_dispatch_window": accel_window,
+                "osd_ec_dispatch_max_stripes": max_stripes,
+                # a tight capacity so the reply-piggybacked load signal
+                # actually moves: with the 256-slot default the hot
+                # accel's load ratio stays under the hysteresis margin
+                # and the skew never spreads
+                "osd_op_queue_slots": 8,
+            }))
+            await a.start()
+            accs.append(a)
+        amap = AccelMap()
+        for i, a in enumerate(accs):
+            amap.note_boot(a.name, a.addr, "", capacity=8)
+
+        class _FleetFeeder(Dispatcher):
+            def __init__(self, name: str):
+                self.messenger = AsyncMessenger(name, self)
+                self.router = AccelRouter(self.messenger, mode="prefer",
+                                          deadline=60.0,
+                                          retry_interval=0.05)
+                self.router.apply_map(amap)
+                self.dispatch = ECDispatcher(window=window,
+                                             max_stripes=max_stripes,
+                                             remote=self.router)
+
+            async def ms_dispatch(self, conn, msg):
+                self.router.handle(msg, conn)
+
+            def ms_handle_reset(self, conn):
+                self.router.on_reset(conn)
+
+            async def stop(self):
+                await self.dispatch.stop()
+                await self.messenger.shutdown()
+
+        # 4:1:1:1 feeder skew — feeder 0 is the hot client the router
+        # must spread across the fleet
+        skew_bufs = [[b for _ in range(4) for b in bufs[0]], *bufs[1:]]
+        fleet_bytes = int(sum(b.size for fb in skew_bufs for b in fb))
+        feeders = [_FleetFeeder(f"osd.{i}") for i in range(n_feeders)]
+        total_ops = sum(len(fb) for fb in skew_bufs)
+        done_ops = 0
+        killed = asyncio.Event()
+        victim: list[int] = []
+        errors = 0
+
+        async def _drive_counted(f, fb):
+            nonlocal done_ops, errors
+            for i in range(0, len(fb), group):
+                outs = await asyncio.gather(*[
+                    f.dispatch.encode(sinfo, codec, b)
+                    for b in fb[i:i + group]
+                ], return_exceptions=True)
+                errors += sum(1 for o in outs if isinstance(o, Exception))
+                done_ops += len(outs)
+                if done_ops >= total_ops // 2 and not killed.is_set():
+                    killed.set()
+                    # SIGKILL the BUSIER accel mid-run: its in-flight
+                    # batches must hop to the survivor (the rebalance
+                    # claim), not just quietly lose an idle standby
+                    busy = max(
+                        range(len(accs)),
+                        key=lambda i: accs[i].dispatch._totals["batches"],
+                    )
+                    victim.append(busy)
+                    await accs[busy].stop(crash=True)
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*[
+            _drive_counted(f, fb) for f, fb in zip(feeders, skew_bufs)
+        ])
+        dt = time.perf_counter() - t0
+        stats = [a.dispatch.dump() for a in accs]
+        failover_next = sum(
+            f.router.totals["failover_next"] for f in feeders
+        )
+        local_replays = sum(
+            f.dispatch.dump()["totals"]["failovers"] for f in feeders
+        )
+        for f in feeders:
+            await f.stop()
+        for i, a in enumerate(accs):
+            if i not in victim:
+                await a.stop()
+        batches = sum(s["totals"]["batches"] for s in stats)
+        stripes = sum(s["totals"]["stripes"] for s in stats)
+        return {
+            "accels": len(accs),
+            "feeder_skew": "4:1:1:1",
+            "ops": total_ops,
+            "batch_bytes": fleet_bytes,
+            "gbps": round(fleet_bytes / dt / 1e9, 3),
+            # aggregate device occupancy across the FLEET: stripes per
+            # launch / threshold, summed over every accel's dispatcher
+            "fleet_occupancy": round(
+                stripes / (batches * max_stripes), 4
+            ) if batches else 0.0,
+            "per_accel_batches": [s["totals"]["batches"] for s in stats],
+            # rebalance-on-accel-death evidence: the mid-run SIGKILL's
+            # in-flight batches hopped to the survivor (no client op
+            # failed, no local-fallback replay)
+            "killed_mid_run": killed.is_set(),
+            "rebalanced_batches": failover_next,
+            "local_fallback_replays": local_replays,
+            "failed_ops": errors,
+        }
+
     # the JAX batch path is the engine being shared (the native C lane
     # routes per-op by design and has nothing to amortize) — same
     # override discipline as bench_smallops, try/finally scoped
     _native.host_engine_active()
     saved_host_active = _native._HOST_ACTIVE
+    fleet = None
     try:
         _native._HOST_ACTIVE = False
         t_shared, acc_stats = asyncio.run(shared_pass())
         t_local, local_stats = asyncio.run(local_pass())
+        if deadline is None or deadline - time.time() > 25:
+            # the multi-accel phase (ISSUE 11): skipped only under a
+            # tight deadline — the single-accel occupancy above is the
+            # PR-10 gate and must always land
+            fleet = asyncio.run(fleet_pass())
+            log(f"accel fleet: occupancy {fleet['fleet_occupancy']} "
+                f"over {fleet['accels']} accels, "
+                f"{fleet['rebalanced_batches']} batches rebalanced on "
+                f"death, {fleet['failed_ops']} failed ops")
+        else:
+            log("accel: skipping the fleet phase (deadline close)")
     finally:
         _native._HOST_ACTIVE = saved_host_active
     occupancy = round(_occ(acc_stats), 4)
@@ -1606,6 +1742,14 @@ def bench_accel(deadline: float | None, platform: str | None) -> dict:
         "cross_client_rate": round(
             t.get("cross_client_batches", 0) / batches, 4),
         "coalesce_ops_per_batch": round(t["ops"] / batches, 3),
+        # the multi-accel fleet phase (ISSUE 11): aggregate occupancy
+        # under 4:1:1:1 feeder skew + rebalance-on-accel-death; the
+        # top-level key feeds bench_regress --metric
+        # accel.fleet_occupancy (absent under a tight deadline — the
+        # gate skips cleanly until two rounds carry it)
+        **({"fleet": fleet,
+            "fleet_occupancy": fleet["fleet_occupancy"]}
+           if fleet is not None else {}),
         "dispatch": {
             "batches": t["batches"], "ops": t["ops"],
             "stripes": t["stripes"],
@@ -2461,7 +2605,7 @@ def main():
                         "gbps_shared", "gbps_local", "occupancy",
                         "occupancy_local_best", "shared_vs_best_local",
                         "cross_client_rate", "coalesce_ops_per_batch",
-                        "dispatch",
+                        "dispatch", "fleet", "fleet_occupancy",
                     ) if k in r["accel"]
                 }
             if "mesh" not in final and r.get("mesh", {}).get("scaling"):

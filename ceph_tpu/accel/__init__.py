@@ -7,6 +7,7 @@ many OSDs over the messenger; the OSD-side remote lane is
 dispatcher via ``osd_ec_accel_addr`` / ``osd_ec_accel_mode``.
 """
 
+from .accelmap import AccelEntry, AccelMap
 from .client import (
     AccelClient,
     AccelDataError,
@@ -14,11 +15,15 @@ from .client import (
     AccelUnavailable,
 )
 from .daemon import AccelDaemon
+from .router import AccelRouter
 
 __all__ = [
     "AccelClient",
     "AccelDaemon",
     "AccelDataError",
+    "AccelEntry",
+    "AccelMap",
+    "AccelRouter",
     "AccelServiceError",
     "AccelUnavailable",
 ]

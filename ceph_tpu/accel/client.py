@@ -49,10 +49,14 @@ logger = logging.getLogger("ceph_tpu.accel.client")
 # accelerator's EngineSupervisor.state)
 _TRIPPED = 2
 
-# a beacon/reply health snapshot older than this is stale: routing must
-# not pin "TRIPPED" forever off one last message before a quiet period —
-# traffic re-probes instead (the accelerator may long since have
-# re-promoted while no connection carried the news)
+# default for ``stale_interval`` (the ``osd_ec_accel_stale_interval``
+# Option): a beacon/reply health snapshot aged >= it is stale and no
+# longer gates routing — traffic re-probes instead of pinning
+# "TRIPPED"/saturated forever off one last message before a quiet
+# period (the accelerator may long since have re-promoted while no
+# connection carried the news).  Live via observer since ISSUE 11; the
+# boundary is pinned by tests/test_accel_fleet.py (age == T is stale,
+# age == T - ε still gates).
 _STATE_STALE_S = 10.0
 
 _BACKOFF_MAX_FACTOR = 16
@@ -87,12 +91,19 @@ class AccelClient:
 
     def __init__(self, messenger, *, addr: str = "", mode: str = "off",
                  deadline: float = 10.0, retry_interval: float = 1.0,
-                 perf=None):
+                 stale_interval: float = _STATE_STALE_S, perf=None,
+                 aid: int | None = None, locality: str = ""):
         self.messenger = messenger
         self.addr = addr
         self.mode = mode
         self.deadline = float(deadline)
         self.retry_interval = float(retry_interval)
+        self.stale_interval = float(stale_interval)
+        # fleet identity (AccelRouter, ISSUE 11): the mon-assigned
+        # accel id and locality label of the map entry this client
+        # targets (None/"" for the osd_ec_accel_addr static shim)
+        self.aid = aid
+        self.locality = locality
         self._perf = perf
         self._conn = None
         self._tid = 0
@@ -148,17 +159,37 @@ class AccelClient:
         """Reachable (or due a retry probe) and — per the last fresh
         beacon/reply — not TRIPPED and not saturated.  A down remote
         whose backoff expired reads available so TRAFFIC re-probes it;
-        :attr:`unreachable` stays True until the probe succeeds."""
+        :attr:`unreachable` stays True until the probe succeeds.  A
+        snapshot aged exactly ``stale_interval`` is already stale (the
+        boundary the fleet tests pin): it stops gating and traffic
+        re-probes."""
         now = time.monotonic()
         if self._down and now < self._down_until:
             return False
-        if now - self._state_at <= _STATE_STALE_S:
+        if self.state_fresh(now):
             if self.remote_state >= _TRIPPED:
                 return False
             if (self.remote_capacity
                     and self.remote_queue > self.remote_capacity):
                 return False
         return True
+
+    def state_fresh(self, now: float | None = None) -> bool:
+        """Whether the last piggybacked health snapshot still gates
+        routing (age strictly under ``stale_interval``)."""
+        if now is None:
+            now = time.monotonic()
+        return now - self._state_at < self.stale_interval
+
+    def load(self) -> float:
+        """Queue depth / capacity from the last fresh snapshot — the
+        router's balancing signal (ISSUE 11: the beacon piggyback is a
+        balancing input now, not just an avoidance input).  A stale or
+        never-heard snapshot reads 0.0: an idle-looking unknown is
+        exactly what a re-probe should target."""
+        if not self.state_fresh() or not self.remote_capacity:
+            return 0.0
+        return self.remote_queue / self.remote_capacity
 
     @property
     def unreachable(self) -> bool:
@@ -501,6 +532,9 @@ class AccelClient:
         now = time.monotonic()
         return {
             "addr": self.addr,
+            **({"aid": self.aid} if self.aid is not None else {}),
+            **({"locality": self.locality} if self.locality else {}),
+            "load": round(self.load(), 4),
             "mode": self.mode,
             "deadline_s": self.deadline,
             "unreachable": self.unreachable,

@@ -231,9 +231,12 @@ class AccelDaemon(Dispatcher):
         if self.mon_addr:
             # best-effort: the accelerator serves fine without a mon
             # (standalone bench topology); with one, it learns the map
-            # (mgr address) and reports like rgw/mon do
+            # (mgr address), REGISTERS into the mon-published AccelMap
+            # (ISSUE 11 — OSD routers learn this daemon from the next
+            # map push) and reports like rgw/mon do
             try:
                 await self._connect_mon()
+                self._register_mon()
             # swallow-ok: mgr reporting is optional — the report loop keeps retrying
             except (ConnectionError, OSError) as e:
                 logger.warning("%s: no mon reachable at start (%r); "
@@ -315,11 +318,38 @@ class AccelDaemon(Dispatcher):
         )
         await a.start()
 
+    def _register_mon(self) -> None:
+        """One AccelMap registration beacon to the mon (best-effort —
+        a dead mon conn is the report loop's problem): name, serving
+        address, locality label, stripe capacity."""
+        conn = self._mon_conn
+        if conn is None or not self.addr or self._stopping:
+            return
+        conn.send(messages.MAccelBoot(
+            name=self.name, addr=self.addr,
+            locality=self.config.accel_locality,
+            capacity=max(1, int(self.config.osd_op_queue_slots)),
+            down=False,
+        ))
+
     async def stop(self, crash: bool = False) -> None:
         """``crash=True`` models SIGKILL: connections die NOW, mid-
         batch — in-flight replies are never sent, and every client OSD
         must recover by replaying locally (the acceptance criterion:
         zero failed client ops)."""
+        if not crash and not self._stopping and self._mon_conn is not None:
+            # graceful deregistration: the mon marks us down on this
+            # word instead of waiting out the beacon grace (a crash
+            # stop deliberately skips it — the connection reset and
+            # the grace ARE the crash signal being tested)
+            try:
+                self._mon_conn.send(messages.MAccelBoot(
+                    name=self.name, addr=self.addr, locality="",
+                    capacity=0, down=True,
+                ))
+            # swallow-ok: best-effort dereg on a dying conn — the mon's reset path covers it
+            except Exception:
+                pass
         self._stopping = True
         for opt, cb in self._observers:
             self.config.unobserve(opt, cb)
@@ -562,7 +592,17 @@ class AccelDaemon(Dispatcher):
             while not self._stopping:
                 interval = self.config.accel_beacon_interval
                 await asyncio.sleep(interval if interval > 0 else 1.0)
-                if interval <= 0 or self._stopping:
+                if self._stopping:
+                    continue
+                # the mon gets the REGISTRATION beacon (MAccelBoot)
+                # regardless of the client-beacon knob: interval=0
+                # disables only the OSD-facing health beacons — a
+                # live daemon must keep proving liveness to the mon,
+                # or the beacon-grace check would mark a healthy
+                # accelerator down.  True silence (this loop wedged or
+                # dead) is exactly what mon_accel_beacon_grace catches
+                self._register_mon()
+                if interval <= 0:
                     continue
                 fields = self._health_fields()
                 sent = False

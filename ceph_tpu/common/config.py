@@ -263,6 +263,12 @@ OPTIONS: dict[str, Option] = _opts(
            "base backoff before re-trying an unreachable accelerator "
            "(s); doubles per failed attempt up to 16x.  A beacon or "
            "successful reply clears the backoff immediately"),
+    Option("osd_ec_accel_stale_interval", float, 10.0,
+           "age past which an accelerator's last beacon/reply health "
+           "snapshot no longer gates routing (s): a snapshot aged >= "
+           "this is stale and traffic re-probes the remote instead of "
+           "pinning TRIPPED/saturated forever off one old message "
+           "(live via observer)"),
     Option("accel_beacon_interval", float, 0.5,
            "accelerator daemon: engine-state/queue-depth beacon "
            "period to every connected OSD (s); 0 disables (replies "
@@ -270,6 +276,18 @@ OPTIONS: dict[str, Option] = _opts(
     Option("accel_mgr_report_interval", float, 1.0,
            "accelerator daemon -> mgr perf-counter report period (s); "
            "0 disables"),
+    Option("accel_locality", str, "",
+           "accelerator daemon: locality label advertised in its "
+           "AccelMap registration (match the crush host names of the "
+           "OSDs it is co-located with); decode batches prefer the "
+           "accelerator matching their surviving shards' majority "
+           "label, so reads stop shipping survivor bytes across the "
+           "fabric"),
+    Option("mon_accel_beacon_grace", float, 5.0,
+           "mon: a registered accelerator silent (no MAccelBoot "
+           "beacon) for this long is marked down in the AccelMap and "
+           "the epoch bump is published — routers stop targeting it "
+           "within one map push"),
     Option("erasure_code_dir", str, "ceph_tpu.models",
            "plugin module prefix (dlopen dir analog)"),
     Option("osd_class_dir", str, "",

@@ -111,6 +111,7 @@ def _health_checks(m, mgr, *, up: int, inn: int, exists: int) -> list[dict]:
     slow_oldest = 0.0
     accel_tripped = 0
     accel_unreachable = 0
+    accel_fleet_degraded = 0
     for st in mgr.live_osd_stats().values():
         perf = st.get("perf") or {}
         scrub = perf.get("scrub") or {}
@@ -138,6 +139,13 @@ def _health_checks(m, mgr, *, up: int, inn: int, exists: int) -> list[dict]:
         accel_perf = perf.get("accel") or {}
         if int(accel_perf.get("remote_unreachable", 0) or 0) >= 1:
             accel_unreachable += 1
+        # fleet summary (accel/router.py, ISSUE 11): some — but not
+        # all — of this OSD's accelerator fleet is sticky-down.  EC
+        # still rides the surviving accels (inter-accel failover), so
+        # this is a capacity warning, not the ACCEL_UNREACHABLE outage
+        elif (int(accel_perf.get("fleet_down", 0) or 0) >= 1
+                and int(accel_perf.get("fleet_up", 0) or 0) >= 1):
+            accel_fleet_degraded += 1
     if outstanding:
         checks.append({
             "code": "OSD_SCRUB_ERRORS", "severity": "HEALTH_ERR",
@@ -168,6 +176,15 @@ def _health_checks(m, mgr, *, up: int, inn: int, exists: int) -> list[dict]:
             "summary": (
                 f"{accel_unreachable} osd(s) cannot reach their shared "
                 "EC accelerator (serving EC on local lanes)"
+            ),
+        })
+    if accel_fleet_degraded:
+        checks.append({
+            "code": "ACCEL_FLEET_DEGRADED", "severity": "HEALTH_WARN",
+            "summary": (
+                f"{accel_fleet_degraded} osd(s) report part of their "
+                "accelerator fleet down (EC riding the surviving "
+                "accels)"
             ),
         })
     return checks
@@ -436,7 +453,7 @@ class PrometheusModule(MgrModule):
     COMMANDS = {"metrics": "metrics"}
 
     @staticmethod
-    def _emit_histogram(lines: list[str], base: str, daemon_esc: str,
+    def _emit_histogram(lines: list[str], base: str, labels: str,
                         hist: dict) -> None:
         """One PerfHistogram dump -> prometheus histogram series:
         ``<base>_bucket{le=...}`` cumulative counts plus ``_sum`` /
@@ -469,28 +486,38 @@ class PrometheusModule(MgrModule):
             else:
                 le = format(amin + i * quant, "g")
             lines.append(
-                f'{base}_bucket{{daemon="{daemon_esc}",le="{le}"}} {cum}'
+                f'{base}_bucket{{{labels},le="{le}"}} {cum}'
             )
         lines.append(
-            f'{base}_sum{{daemon="{daemon_esc}"}} '
+            f'{base}_sum{{{labels}}} '
             f'{float(hist.get("sum") or 0.0)}'
         )
         lines.append(
-            f'{base}_count{{daemon="{daemon_esc}"}} '
+            f'{base}_count{{{labels}}} '
             f'{int(hist.get("count") or 0)}'
         )
 
     @classmethod
     def _emit_daemon(cls, lines: list[str], daemon: str, perf: dict) -> None:
         """One daemon's full counter dump -> exposition lines; every
-        registered counter appears exactly once per daemon."""
+        registered counter appears exactly once per daemon.  A
+        subsystem named ``<base>@<label>`` (the per-accel families,
+        osd/ec_perf.py create_accel_target_perf) emits onto the BASE
+        subsystem's series names with an extra identifying label —
+        ``ceph_accel_remote_batches{daemon=...,accel="3"}`` — so a
+        fleet's per-target skew is one labelled query, not N series
+        name variants."""
         esc = _prom_escape(daemon)
-        lab = f'{{daemon="{esc}"}}'
         for subsys, counters in sorted((perf or {}).items()):
+            labels = f'daemon="{esc}"'
+            if "@" in subsys:
+                subsys, instance = subsys.split("@", 1)
+                labels += f',{subsys}="{_prom_escape(instance)}"'
+            lab = f"{{{labels}}}"
             for key, val in sorted(counters.items()):
                 base = f"ceph_{subsys}_{key}"
                 if isinstance(val, dict) and "histogram" in val:
-                    cls._emit_histogram(lines, base, esc,
+                    cls._emit_histogram(lines, base, labels,
                                         val["histogram"])
                     continue
                 if isinstance(val, dict):

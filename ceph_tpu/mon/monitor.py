@@ -128,7 +128,13 @@ class Monitor(Dispatcher):
          .add_counter("clog_entries", "cluster-log entries appended")
          .add_gauge("map_epoch", "current osdmap epoch")
          .add_gauge("subscribers", "map subscription connections")
-         .add_gauge("is_leader", "1 when this mon leads the quorum"))
+         .add_gauge("is_leader", "1 when this mon leads the quorum")
+         # the accelerator fleet map (ISSUE 11): registration volume +
+         # the published fleet state, next to the osdmap numbers
+         .add_counter("accel_boots",
+                      "AccelMap registrations/refresh beacons handled")
+         .add_gauge("accelmap_epoch", "current accelmap epoch")
+         .add_gauge("accels_up", "registered accelerators currently up"))
         self._admin = None
         self._mgr_report_last = 0.0
         self.failure_min_reporters = (
@@ -158,6 +164,12 @@ class Monitor(Dispatcher):
         self._sub_epochs: dict[Connection, int] = {}  # last epoch sent
         self._boot_conns: dict[int, Connection] = {}  # osd id -> its conn
         self._failure_reports: dict[int, set[int]] = {}  # target -> reporters
+        # accelerator fleet liveness (ISSUE 11): registration conn +
+        # last-beacon clock per accel name; the pending set stops a
+        # slow markdown commit from queueing duplicates off the tick
+        self._accel_conns: dict[str, Connection] = {}
+        self._accel_beacons: dict[str, float] = {}
+        self._accel_down_pending: set[str] = set()
         self.addr = ""
         # -- quorum state
         self.rank = rank
@@ -318,6 +330,7 @@ class Monitor(Dispatcher):
                         self.check_svc_beacons(
                             svc, grace=self.config.mon_lease_interval * 3
                         )
+                    self._check_accel_beacons()
                 await self._report_to_mgr()
         except asyncio.CancelledError:
             pass
@@ -433,6 +446,8 @@ class Monitor(Dispatcher):
             return
         if isinstance(msg, messages.MOSDBoot):
             _bg(self._handle_boot(conn, msg))
+        elif isinstance(msg, messages.MAccelBoot):
+            _bg(self._handle_accel_boot(conn, msg))
         elif isinstance(msg, messages.MOSDFailure):
             _bg(self._handle_failure(msg))
         elif isinstance(msg, messages.MLog):
@@ -529,6 +544,18 @@ class Monitor(Dispatcher):
                 if self.osdmap.is_up(osd):
                     logger.info("%s: osd.%d connection reset -> down", self.name, osd)
                     _bg(self._report_down(osd, MON_REPORTER_BASE + self.rank))
+        for name, c in list(self._accel_conns.items()):
+            if c is conn:
+                # the accelerator's registration link died: the TCP FIN
+                # is the fastest death signal on loopback (the same rule
+                # the OSD boot conns follow) — mark it down in the
+                # AccelMap and publish, so routers shed it immediately
+                del self._accel_conns[name]
+                entry = self.osdmap.accelmap.by_name(name)
+                if entry is not None and entry.up:
+                    logger.info("%s: accel %s connection reset -> down",
+                                self.name, name)
+                    _bg(self._accel_mark_down(name))
 
     async def _report_down(self, osd: int, reporter: int) -> None:
         """Route a locally-observed OSD death like any failure report:
@@ -538,6 +565,104 @@ class Monitor(Dispatcher):
                 target_osd=osd, reporter=reporter, epoch=self.osdmap.epoch
             )
         )
+
+    # -- accelerator fleet (AccelMap, ISSUE 11) ------------------------------
+
+    def _accel_gauges(self) -> None:
+        pmon = self.perf.get("mon")
+        pmon.set("accelmap_epoch", self.osdmap.accelmap.epoch)
+        pmon.set("accels_up", len(self.osdmap.accelmap.up_entries()))
+
+    async def _handle_accel_boot(self, conn: Connection,
+                                 msg: messages.MAccelBoot) -> None:
+        """Register/refresh (or, with ``down=True``, deregister) one
+        accelerator in the AccelMap — the MOSDBoot analog: handled at
+        the leader, forwarded from peons (the accel's map subscription
+        keeps being served locally), published on actual change only
+        (steady-state registration beacons cost no epoch churn)."""
+        name = str(msg.name or "")
+        if not name:
+            return
+        self.perf.get("mon").inc("accel_boots")
+        if not msg.down:
+            # any registration word — forwarded ones included — feeds
+            # the staleness clock: an accel homed at a peon beacons
+            # through forwarding, and the leader must not grace it out
+            self._accel_beacons[name] = time.monotonic()
+        if not msg.down and not conn.peer_name.startswith("mon."):
+            # only the accelerator's OWN connection is its liveness
+            # conn (the _handle_boot rule: a forwarded registration
+            # rides the peon's mon-peer link)
+            self._accel_conns[name] = conn
+            self._subs.add(conn)
+        if not self.is_leader:
+            if self.leader_rank is not None:
+                await self._send_peer(self.leader_rank, msg)
+            return
+        if msg.down:
+            await self._accel_mark_down(name)
+            return
+        async with self._commit_lock:
+            changed = self.osdmap.accelmap.note_boot(
+                name, str(msg.addr or ""), str(msg.locality or ""),
+                int(msg.capacity or 0),
+            )
+            self._accel_gauges()
+            if changed:
+                logger.info(
+                    "%s: accel %s registered at %s (locality=%r, "
+                    "accelmap e%d)", self.name, name, msg.addr,
+                    msg.locality, self.osdmap.accelmap.epoch,
+                )
+                self.clog_append(
+                    self.name, "info",
+                    f"accel {name} registered ({msg.addr})",
+                )
+                await self._publish()
+
+    async def _accel_mark_down(self, name: str) -> None:
+        """Mark one accelerator down and publish (leader), or forward
+        the markdown to the leader (peon) — beacon loss and connection
+        resets both land here."""
+        if not self.is_leader:
+            if self.leader_rank is not None:
+                await self._send_peer(self.leader_rank, messages.MAccelBoot(
+                    name=name, addr="", locality="", capacity=0, down=True,
+                ))
+            return
+        self._accel_down_pending.add(name)
+        try:
+            async with self._commit_lock:
+                if self.osdmap.accelmap.mark_down(name):
+                    self._accel_gauges()
+                    self.clog_append(self.name, "warn",
+                                     f"accel {name} marked down")
+                    await self._publish()
+        finally:
+            self._accel_down_pending.discard(name)
+
+    def _check_accel_beacons(self) -> None:
+        """Leader tick: a registered, up accelerator silent past
+        ``mon_accel_beacon_grace`` is marked down (the beacon-loss
+        path; a freshly-elected leader starts every clock on its first
+        tick, like the mgr/mds beacon checks)."""
+        grace = self.config.mon_accel_beacon_grace
+        now = time.monotonic()
+        for e in self.osdmap.accelmap.up_entries():
+            last = self._accel_beacons.get(e.name)
+            if last is None:
+                self._accel_beacons[e.name] = now
+                continue
+            if now - last > grace and e.name not in self._accel_down_pending:
+                logger.warning(
+                    "%s: accel %s beacon silent for %.1fs -> down",
+                    self.name, e.name, now - last,
+                )
+                _bg(self._accel_mark_down(e.name))
+
+    def _cmd_accel_ls(self, cmd: dict) -> tuple[int, str, Any]:
+        """``ceph accel ls``: the published fleet map."""
+        return 0, "", self.osdmap.accelmap.to_dict()
 
     # -- election (reference:src/mon/Elector.cc, lowest rank wins) -----------
 
@@ -1441,6 +1566,7 @@ class Monitor(Dispatcher):
                 "fs set max_mds": self._cmd_fs_set_max_mds,
                 "mds prune-standbys": lambda c: self._cmd_svc_prune("mds", c),
                 "log last": self._cmd_log_last,
+                "accel ls": self._cmd_accel_ls,
                 "quorum_status": self._cmd_quorum_status,
                 "mon stat": self._cmd_quorum_status,
                 "osd tree": self._cmd_osd_tree,

@@ -526,6 +526,20 @@ class MAccelBeacon(Message):
     FIELDS = ("name", "engine_state", "queue_depth", "capacity")
 
 
+@register
+class MAccelBoot(Message):
+    """Accelerator -> mon: register into the mon-published AccelMap
+    (ISSUE 11; the MOSDBoot analog).  Re-sent periodically as the
+    registration beacon — the mon marks the accelerator down on beacon
+    loss or connection reset and publishes the epoch bump, so every
+    subscribed OSD's router learns within one map push.  ``down=True``
+    is the graceful-deregistration form (clean daemon stop); a peon
+    forwards either form to the leader like every map mutation."""
+
+    TYPE = "accel_boot"
+    FIELDS = ("name", "addr", "locality", "capacity", "down")
+
+
 # -- recovery ----------------------------------------------------------------
 
 

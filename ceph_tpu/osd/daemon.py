@@ -365,7 +365,7 @@ class OSD(Dispatcher):
         self.ec_supervisor = None
         self.accel_client = None
         if getattr(cfg, "osd_ec_dispatch", True):
-            from ..accel.client import AccelClient
+            from ..accel.router import AccelRouter
             from .ec_dispatch import ECDispatcher
             from .ec_failover import EngineSupervisor
 
@@ -381,18 +381,24 @@ class OSD(Dispatcher):
                     self.scheduler, "capacity_degraded", d
                 ),
             )
-            # the remote dispatcher lane (ISSUE 10): coalesced batches
-            # ship to a shared accelerator daemon over the messenger.
-            # Constructed even with osd_ec_accel_mode=off (the default)
-            # — `config set osd_ec_accel_addr/mode` on a RUNNING osd
-            # must arm the lane live, exactly like the breaker above
-            self.accel_client = AccelClient(
+            # the remote dispatcher lane (ISSUE 10 -> 11): coalesced
+            # batches ship to the accelerator FLEET over the
+            # messenger — the AccelRouter holds one client per
+            # mon-published AccelMap entry (fed from every map push in
+            # _handle_map) and keeps osd_ec_accel_addr as the
+            # single-entry static-fleet compat shim.  Constructed even
+            # with osd_ec_accel_mode=off (the default) — `config set
+            # osd_ec_accel_addr/mode` on a RUNNING osd must arm the
+            # lane live, exactly like the breaker above
+            self.accel_client = AccelRouter(
                 self.messenger,
                 addr=cfg.osd_ec_accel_addr,
                 mode=cfg.osd_ec_accel_mode,
                 deadline=cfg.osd_ec_accel_deadline,
                 retry_interval=cfg.osd_ec_accel_retry_interval,
+                stale_interval=cfg.osd_ec_accel_stale_interval,
                 perf=pacc,
+                perf_collection=self.perf,
             )
             self.ec_dispatch = ECDispatcher(
                 perf=pec,
@@ -517,6 +523,11 @@ class OSD(Dispatcher):
             ("osd_ec_accel_retry_interval", lambda _n, v: (
                 self.accel_client is not None
                 and setattr(self.accel_client, "retry_interval",
+                            float(v))
+            )),
+            ("osd_ec_accel_stale_interval", lambda _n, v: (
+                self.accel_client is not None
+                and setattr(self.accel_client, "stale_interval",
                             float(v))
             )),
             # QoS scheduler knobs stay live: `config set osd_op_queue
@@ -1047,6 +1058,11 @@ class OSD(Dispatcher):
         old = self.osdmap
         self.osdmap = m
         self._codecs.clear()  # pools/profiles may have changed
+        if self.accel_client is not None:
+            # the accelerator fleet rides the map (ISSUE 11): a mon
+            # markdown reaches this router on the same push that
+            # carries any other map change — one push, no side channel
+            self.accel_client.apply_map(m.accelmap)
         try:
             self._note_intervals(old, m)
         except Exception:
@@ -1994,11 +2010,16 @@ class OSD(Dispatcher):
             return ec_util.encode(sinfo, codec, buf)
 
     async def _ec_decode_concat(self, sinfo, codec, chunks, *,
-                                klass: str = "client") -> bytes:
+                                klass: str = "client",
+                                locality: "list[str] | None" = None,
+                                ) -> bytes:
         """Reconstruct router: missing rows rebuilt via the mesh's ICI
         all-gather (reference:src/osd/ECBackend.cc:2187 as one
         collective) when the engine applies; host decodes ride the
-        microbatch dispatcher like encodes."""
+        microbatch dispatcher like encodes.  ``locality`` carries the
+        surviving shards' OSD locality labels (crush host names) so
+        the accel router can prefer the accelerator co-located with
+        the survivor bytes (ISSUE 11 shard-locality decode)."""
         k = codec.get_data_chunk_count()
         missing = any(r not in chunks for r in range(k))
         dispatched = self.ec_dispatch is not None
@@ -2014,7 +2035,8 @@ class OSD(Dispatcher):
                             account=not dispatched):
             if dispatched:
                 return await self.ec_dispatch.decode_concat(
-                    sinfo, codec, chunks, klass=klass
+                    sinfo, codec, chunks, klass=klass,
+                    locality=locality,
                 )
             if mesh:
                 self.perf.get("ec").inc("mesh_decode_calls")
@@ -2867,8 +2889,20 @@ class OSD(Dispatcher):
                 pec = self.perf.get("ec")
                 pec.inc("decode_calls")
                 pec.inc("decode_bytes", sum(c.size for c in chunks.values()))
+                # the surviving shards' locality labels (the OSDs the
+                # chunks were actually read from -> their crush hosts):
+                # the accel router prefers the accelerator matching
+                # the majority label, so reconstruct reads stop
+                # shipping survivor bytes across the fabric
+                locality = [
+                    lbl for lbl in (
+                        self.osdmap.locality_of(available[s])
+                        for s in chunks if s in available
+                    ) if lbl
+                ]
                 logical = await self._ec_decode_concat(
-                    sinfo, codec, chunks, klass=klass
+                    sinfo, codec, chunks, klass=klass,
+                    locality=locality or None,
                 )
                 if off == s0 and end - s0 == len(logical):
                     return 0, logical  # aligned read: no trim slice

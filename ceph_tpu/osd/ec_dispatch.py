@@ -63,20 +63,25 @@ engine as a first-class dispatcher lane, not a bypass):
   classifier and supervisor.
 
 A fifth mechanism is the **remote lane** (ISSUE 10 — the shared
-accelerator service, ``ceph_tpu.accel``):
+accelerator service, ``ceph_tpu.accel``; fleet-scoped since ISSUE 11):
 
-- with ``osd_ec_accel_mode`` = prefer|require and an
-  ``osd_ec_accel_addr`` configured, coalesced batches ship to a
-  standalone accelerator daemon over the messenger
-  (:class:`~ceph_tpu.accel.client.AccelClient`) instead of launching
-  on this process's device — payloads as borrowed frame views, QoS
-  class + geometry in the fields, trace id on the frame header.  The
-  accelerator re-coalesces across CLIENT OSDs (the shared-occupancy
-  win) through its own dispatcher instance.  The remote is its own
-  fault domain: its beacons gate routing (a TRIPPED or saturated
-  remote sheds to the local lanes with no timeout chain), its faults
-  never advance the LOCAL breaker, and a remote fatal — accelerator
-  death mid-batch included — replays the batch on the local host
+- with ``osd_ec_accel_mode`` = prefer|require, coalesced batches ship
+  to the accelerator FLEET over the messenger — the
+  :class:`~ceph_tpu.accel.router.AccelRouter` holds one
+  :class:`~ceph_tpu.accel.client.AccelClient` per mon-published
+  AccelMap entry (``osd_ec_accel_addr`` survives as the single-entry
+  static shim) and picks a target per batch by load (least-loaded
+  with hysteresis off the beacon-piggybacked queue/capacity), with
+  decode batches preferring the accelerator matching their surviving
+  shards' majority locality label — payloads as borrowed frame
+  views, QoS class + geometry in the fields, trace id on the frame
+  header.  The accelerator re-coalesces across CLIENT OSDs (the
+  shared-occupancy win) through its own dispatcher instance.  The
+  remote is its own fault domain: beacons gate routing (a TRIPPED or
+  saturated remote sheds with no timeout chain), its faults never
+  advance the LOCAL breaker, and a remote fatal — accelerator death
+  mid-batch included — fails over to the NEXT accelerator first;
+  only a whole-fleet outage replays the batch on the local host
   fallback, bit-identically (flight record ``origin=remote``).
 
 A sixth mechanism rides on top (the accelerator fault domain,
@@ -166,16 +171,21 @@ class _Op:
     which OSDs shared a launch)."""
 
     __slots__ = ("fut", "stripes", "payload", "trace", "t_submit",
-                 "client")
+                 "client", "locality")
 
     def __init__(self, fut: asyncio.Future, stripes: int, payload: Any,
-                 client: str | None = None):
+                 client: str | None = None,
+                 locality: "list[str] | None" = None):
         self.fut = fut
         self.stripes = stripes
         self.payload = payload
         self.trace = current_trace.get()
         self.t_submit = time.monotonic()
         self.client = client
+        # surviving shards' OSD locality labels (decode only; ISSUE
+        # 11): the accel router prefers the fleet member matching the
+        # batch's majority label
+        self.locality = locality
 
 
 class _Batch:
@@ -388,11 +398,15 @@ class ECDispatcher:
         self, sinfo: ec_util.StripeInfo, codec,
         chunks: Mapping[int, np.ndarray], *, klass: str = "client",
         client: str | None = None,
+        locality: "list[str] | None" = None,
     ) -> bytes:
         """Batched analog of :func:`ec_util.decode_concat`.  Requests
         coalesce only with peers reading through the SAME survivor set
         (the recovery matrix — hence the jit signature — depends on
-        it) and the same QoS class (see :meth:`encode`)."""
+        it) and the same QoS class (see :meth:`encode`).  ``locality``
+        names the surviving shards' OSD locality labels; the remote
+        lane's router prefers the accelerator matching the batch's
+        majority label (ISSUE 11)."""
         arrs = {int(s): as_u8(v) for s, v in chunks.items()}
         sizes = {a.size for a in arrs.values()}
         if len(sizes) != 1:
@@ -423,7 +437,8 @@ class ECDispatcher:
                    sinfo.stripe_width, sinfo.chunk_size, present)
             return await self._submit(key, "dec", codec, sinfo, arrs,
                                       stripes, lane="remote",
-                                      klass=klass, client=client)
+                                      klass=klass, client=client,
+                                      locality=locality)
         # the mesh lane only earns its keep when rows are MISSING (the
         # ICI all-gather reconstruct); a plain concat read stays on the
         # device/native lanes — the same gate the old router applied
@@ -642,7 +657,8 @@ class ECDispatcher:
                       payload, stripes: int, *, lane: str = "device",
                       mesh_slice: tuple | None = None,
                       klass: str = "client",
-                      client: str | None = None):
+                      client: str | None = None,
+                      locality: "list[str] | None" = None):
         loop = asyncio.get_running_loop()
         b = self._open.get(key)
         if b is not None and b.ops and (
@@ -668,7 +684,8 @@ class ECDispatcher:
             delay = self.window if self._last_ops > 1 else 0.0
             b.timer = loop.call_later(delay, self._flush, key, "window")
         fut = loop.create_future()
-        b.ops.append(_Op(fut, stripes, payload, client=client))
+        b.ops.append(_Op(fut, stripes, payload, client=client,
+                         locality=locality))
         b.stripes += stripes
         if b.stripes >= self.max_stripes:
             self._flush(key, "size")

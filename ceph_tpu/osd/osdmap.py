@@ -232,6 +232,17 @@ class OSDMap:
         # standby); mds_name/mds_addr mirror rank 0 for older callers
         self.mds_ranks: list[list[str]] = []
         self.mds_max = 1
+        # the accelerator fleet map (ceph_tpu/accel/accelmap.py, ISSUE
+        # 11): owned by the mon alongside this map and carried inside
+        # its wire dict, so Paxos replication, persistence, incremental
+        # diffs and subscriber pushes all reuse the OSDMap machinery.
+        # Lazy import: accelmap is dependency-free, but going through
+        # the accel package __init__ would pull the daemon stack into
+        # every map consumer's import graph
+        from ..accel.accelmap import AccelMap
+
+        self.accelmap = AccelMap()
+        self._locality_cache: dict[int, str] | None = None
 
     # -- device lifecycle ----------------------------------------------------
 
@@ -572,6 +583,31 @@ class OSDMap:
         inc.apply_to_dict(d)
         return OSDMap.from_dict(d)
 
+    def locality_of(self, osd: int) -> str:
+        """The locality label of ``osd``: the name of the crush HOST
+        bucket holding it ("" when the topology is flat or the osd is
+        unplaced).  This is the label decode batches carry so the
+        accel router can prefer the accelerator co-located with the
+        surviving shards (ISSUE 11 shard-locality decode); accel
+        daemons advertise the matching label via ``accel_locality``."""
+        table = self._locality_cache
+        if table is None:
+            host_types = {
+                t for t, n in self.crush.type_names.items() if n == "host"
+            }
+            table = {}
+            for bid, b in self.crush.buckets.items():
+                if b.type not in host_types:
+                    continue
+                if bid in getattr(self.crush, "_shadow_owner", {}):
+                    continue  # device-class shadow copies alias the host
+                name = self.crush.item_names.get(bid, str(bid))
+                for child in b.items:
+                    if child >= 0:
+                        table[child] = name
+            self._locality_cache = table
+        return table.get(osd, "")
+
     # -- wire form (reference: OSDMap::encode/decode) ------------------------
 
     def to_dict(self) -> dict:
@@ -612,6 +648,7 @@ class OSDMap:
             "mds_ranks": [list(r) for r in self.mds_ranks],
             "mds_max": self.mds_max,
             "cluster_flags": sorted(self.cluster_flags),
+            "accelmap": self.accelmap.to_dict(),
         }
 
     @classmethod
@@ -650,6 +687,9 @@ class OSDMap:
         m.mds_ranks = [list(x) for x in d.get("mds_ranks", [])]
         m.mds_max = int(d.get("mds_max", 1))
         m.cluster_flags = set(d.get("cluster_flags", []))
+        from ..accel.accelmap import AccelMap
+
+        m.accelmap = AccelMap.from_dict(d.get("accelmap"))
         return m
 
 

@@ -273,19 +273,50 @@ class MiniCluster:
                     return active
                 await asyncio.sleep(0.01)
 
-    # -- shared EC accelerator (ceph_tpu.accel, ISSUE 10) -------------------
-    async def start_accel(self, name: str | None = None, config=None):
-        """One shared accelerator daemon on loopback; wire the OSDs at
-        it with :meth:`route_osds_to_accel` (the options are live)."""
+    # -- shared EC accelerator fleet (ceph_tpu.accel, ISSUE 10/11) ----------
+    async def start_accel(self, name: str | None = None, config=None,
+                          locality: str = "", register: bool = True):
+        """One shared accelerator daemon on loopback.  With
+        ``register`` (default) it registers into the mon-published
+        AccelMap and every OSD's router picks it up from the next map
+        push — :meth:`route_osds_to_accel` only needs to set the mode.
+        ``register=False`` keeps the PR-10 static topology (no mon:
+        wire OSDs via ``osd_ec_accel_addr``).  ``locality`` is the
+        AccelMap locality label (match a crush host name so decode
+        batches prefer this accelerator for shards homed there)."""
         from ..accel import AccelDaemon
 
         self._accel_seq += 1
         name = name or f"accel.{self._accel_seq}"
-        acc = AccelDaemon(name, mon_addr=self.monmap or self.mon.addr,
-                          config=config or self._daemon_config())
+        if locality and config is not None:
+            # setting it on the caller's object would cross-contaminate
+            # accels sharing one Config (the registration beacon
+            # re-reads accel_locality live)
+            raise ValueError(
+                "pass accel_locality inside config= OR use locality=, "
+                "not both"
+            )
+        cfg = config or self._daemon_config()
+        if locality:
+            if cfg is None:
+                from ..common import Config
+
+                cfg = Config()
+            cfg.set("accel_locality", locality)
+        acc = AccelDaemon(
+            name,
+            mon_addr=(self.monmap or self.mon.addr) if register else None,
+            config=cfg,
+        )
         await acc.start()
         self.accels[name] = acc
         return acc
+
+    def set_accel_mode(self, mode: str = "prefer") -> None:
+        """Arm every running OSD's remote EC lane for the mon-published
+        fleet (the addr comes from the AccelMap, not static config)."""
+        for osd in self.osds.values():
+            osd.config.set("osd_ec_accel_mode", mode)
 
     async def kill_accel(self, name: str, crash: bool = False) -> None:
         """``crash=True`` models SIGKILL mid-batch: connections die

@@ -75,9 +75,15 @@ async def _run_mon(args) -> None:
 async def _run_accel(args) -> None:
     from ..accel import AccelDaemon
 
+    config = None
+    if getattr(args, "locality", ""):
+        from ..common import Config
+
+        config = Config(overrides={"accel_locality": args.locality})
     acc = AccelDaemon(
         f"accel.{args.id}",
         mon_addr=(args.monmap.split(",") if args.monmap else None),
+        config=config,
     )
     # a real process: suicide must end the PROCESS even when a wedged
     # device call sits in a non-daemon executor thread (same contract
@@ -182,7 +188,12 @@ def main(argv=None) -> int:
     pa.add_argument("--addr", required=True, help="host:port to bind")
     pa.add_argument("--monmap", default=None,
                     help="comma-sep mon addrs (optional: enables map "
-                         "subscription + mgr reporting)")
+                         "subscription, AccelMap registration + mgr "
+                         "reporting)")
+    pa.add_argument("--locality", default="",
+                    help="AccelMap locality label (match the crush "
+                         "host of co-located OSDs; decode batches "
+                         "prefer the matching accelerator)")
     for sp in (pm, po, pa):
         sp.add_argument("--verbose", action="store_true")
         sp.add_argument(

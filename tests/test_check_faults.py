@@ -160,5 +160,8 @@ class TestRepoIsClean:
                          "ec_failover.py", "engine.py", "mesh.py",
                          "device_trace.py",
                          # the shared accelerator service (ISSUE 10)
-                         # extends the fault domain across the wire
-                         "client.py", "daemon.py"}
+                         # extends the fault domain across the wire,
+                         # and the fleet subsystem (ISSUE 11) extends
+                         # it across accelerators
+                         "client.py", "daemon.py",
+                         "accelmap.py", "router.py"}

@@ -69,7 +69,8 @@ PHASE_AGNOSTIC_METRICS = {"stack_gbps", "raw_cpu_gbps", "stack_vs_raw",
 METRIC_ALIASES = {"stack_e2e_gbps": "stack_e2e.stack_e2e_gbps",
                   "mesh_scaling_efficiency": "mesh.scaling_efficiency",
                   "mesh_ici_share": "mesh.ici_share",
-                  "accel_occupancy": "accel.occupancy"}
+                  "accel_occupancy": "accel.occupancy",
+                  "accel_fleet_occupancy": "accel.fleet_occupancy"}
 
 # per-metric default thresholds (used when --threshold is not given):
 # mesh.scaling_efficiency is a RATIO (per-chip efficiency of the
@@ -83,9 +84,15 @@ METRIC_ALIASES = {"stack_e2e_gbps": "stack_e2e.stack_e2e_gbps",
 # efficiency, same 20% budget; rounds predating the accel phase
 # simply lack the metric, so the gate skips cleanly (exit 0) until
 # two rounds carry it.
+# accel.fleet_occupancy (ISSUE 11) is the MULTI-accel phase's
+# aggregate occupancy under 4:1:1:1 feeder skew with a mid-run accel
+# kill — the fleet-balancing analog of accel.occupancy, same ratio
+# semantics, same 20% budget, same clean skip until two rounds carry
+# the fleet record.
 METRIC_DEFAULT_THRESHOLDS = {"mesh.scaling_efficiency": 0.8,
                              "mesh.ici_share": 0.8,
-                             "accel.occupancy": 0.8}
+                             "accel.occupancy": 0.8,
+                             "accel.fleet_occupancy": 0.8}
 
 # metrics where GROWTH is the regression: mesh.ici_share (ISSUE 9) is
 # the ICI all-gather's share of the mesh reconstruct's device time,

@@ -61,6 +61,8 @@ HOT_PATHS = (
     "ceph_tpu/ops/device_trace.py",
     "ceph_tpu/accel/client.py",
     "ceph_tpu/accel/daemon.py",
+    "ceph_tpu/accel/accelmap.py",
+    "ceph_tpu/accel/router.py",
 )
 
 ANNOTATION = "# swallow-ok:"
