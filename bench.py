@@ -1077,6 +1077,111 @@ def _bench_stack_e2e(deadline: float | None) -> dict:
     }
 
 
+def _smallops_waterfall(deadline: float | None, n_ops: int = 96) -> dict:
+    """Small-op hop waterfall + header cost ledger (ISSUE 12): a real
+    1-OSD loopback MiniCluster serves ``n_ops`` 4 KiB writes with
+    ``osd_op_trace_sample_every=1``, and every op's cross-hop spans
+    are read back from the client-side waterfall
+    (common/tracing.op_waterfall — the same merge `dump_op_waterfall`
+    serves).  Reports per-hop p50/p99 and ``header_share``: the
+    measured JSON frame-header encode+decode seconds
+    (stack.header_encode_s/header_decode_s, timed at the messenger
+    boundary) over total op wall time.  At 4 KiB the
+    payload-proportional work is negligible, so this approximates the
+    non-payload share directly — the acceptance baseline ROADMAP item
+    1's binary header must beat, gated across rounds via
+    ``bench_regress --metric smallops.header_share`` (lower is
+    better)."""
+    import asyncio
+
+    from ceph_tpu.common import stack_ledger
+    from ceph_tpu.common.tracing import op_waterfall
+    from ceph_tpu.rados.cluster import MiniCluster
+
+    payload = np.random.default_rng(11).integers(
+        0, 256, size=4096, dtype=np.uint8
+    ).tobytes()
+
+    async def drive() -> dict:
+        async with MiniCluster(
+            n_osds=1,
+            config_overrides={"osd_op_trace_sample_every": 1},
+        ) as c:
+            cl = await c.client()
+            await cl.create_pool("wf", "replicated", size=1)
+            # warm-up: first op pays connect + clock-probe seeding;
+            # its hops would misreport the steady state
+            for i in range(4):
+                await cl.operate(
+                    "wf", f"warm{i}",
+                    [{"op": "writefull", "data": 0}], [payload],
+                )
+            stack_ledger.reset_stack()
+            traces = []
+            walls = []
+            t_all0 = time.perf_counter()
+            for i in range(n_ops):
+                if deadline is not None and deadline - time.time() < 10:
+                    # a slow/contended host must not blow the bench's
+                    # budget here: keep the partial capture (the
+                    # percentiles just get fewer samples)
+                    log(f"smallops: waterfall stopping at {i} ops "
+                        "(deadline close)")
+                    break
+                t0 = time.perf_counter()
+                reply = await cl.operate(
+                    "wf", f"o{i}",
+                    [{"op": "writefull", "data": 0}], [payload],
+                )
+                walls.append(time.perf_counter() - t0)
+                traces.append(reply.trace)
+            wall_s = time.perf_counter() - t_all0
+            n_ops = len(traces)
+            if not traces:
+                return {"unavailable": "deadline before any sampled op"}
+            enc_s, dec_s = stack_ledger.header_seconds()
+            per_hop: dict[str, list] = {}
+            covered = 0
+            for tr in traces:
+                wf = op_waterfall(tr)
+                if wf["hops"]:
+                    covered += 1
+                for h in wf["hops"]:
+                    per_hop.setdefault(h["hop"], []).append(h["dur_s"])
+            hops = {
+                hop: {
+                    "p50_ms": round(float(np.percentile(v, 50)) * 1e3, 4),
+                    "p99_ms": round(float(np.percentile(v, 99)) * 1e3, 4),
+                    "n": len(v),
+                }
+                for hop, v in sorted(per_hop.items())
+            }
+            total_op_s = float(sum(walls))
+            return {
+                "ops": n_ops,
+                "payload_bytes": len(payload),
+                "ops_per_sec": round(n_ops / wall_s, 1),
+                "op_p50_ms": round(
+                    float(np.percentile(walls, 50)) * 1e3, 4),
+                "op_p99_ms": round(
+                    float(np.percentile(walls, 99)) * 1e3, 4),
+                "hops": hops,
+                "sampled_ops_with_spans": covered,
+                "header_encode_s": round(enc_s, 6),
+                "header_decode_s": round(dec_s, 6),
+                "frame_allocs": int(
+                    stack_ledger.stack_perf().get("frame_allocs")),
+                # the ledger counts EVERY frame in the window (map
+                # subs and mon chatter included) — honest: those
+                # headers are part of what the stack pays per op
+                "header_share": round(
+                    (enc_s + dec_s) / total_op_s, 4
+                ) if total_op_s > 0 else 0.0,
+            }
+
+    return asyncio.run(drive())
+
+
 def bench_smallops(deadline: float | None, platform: str | None) -> dict:
     """Many-small-ops EC throughput: coalesced microbatch dispatch vs
     per-op dispatch over a mixed size distribution — the OSD's real
@@ -1206,7 +1311,25 @@ def bench_smallops(deadline: float | None, platform: str | None) -> dict:
         finally:
             _native._HOST_ACTIVE = saved
 
+    # ISSUE 12: the op waterfall capture + header cost ledger — a real
+    # loopback cluster round so the per-hop p50/p99 and header_share
+    # land in the round JSON (bench_regress gates the share)
+    waterfall: dict = {"unavailable": "skipped (deadline close)"}
+    header_share = None
+    if deadline is None or deadline - time.time() > 25:
+        try:
+            waterfall = _smallops_waterfall(deadline)
+            header_share = waterfall.get("header_share")
+            log(f"smallops: waterfall header_share="
+                f"{header_share} over {waterfall.get('ops')} ops")
+        except Exception as e:
+            log(f"smallops: waterfall capture failed: {e!r}")
+            waterfall = {"unavailable": repr(e)[:200]}
+
     return {
+        **({"header_share": header_share}
+           if header_share is not None else {}),
+        "waterfall": waterfall,
         "platform": str(dev),
         # cold_passes: the ratio below came from the WARM passes only
         # (deadline closed in) — per-op paid ~#distinct-size compiles
@@ -2592,7 +2715,8 @@ def main():
                     k: r["smallops"][k] for k in (
                         "platform", "ops", "batch_bytes", "per_op_gbps",
                         "coalesced_gbps", "coalesced_vs_per_op",
-                        "dispatch", "device_trace",
+                        "dispatch", "device_trace", "waterfall",
+                        "header_share",
                     ) if k in r["smallops"]
                 }
             if "accel" not in final and "occupancy" in r.get("accel", {}):

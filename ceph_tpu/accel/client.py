@@ -201,11 +201,14 @@ class AccelClient:
 
     async def run_batch(self, b, ops):
         """Ship one coalesced batch; returns ``(results, pad=0,
-        seconds, served_by)`` — the first three shaped exactly like
-        the local ``_run_sync`` so the dispatcher's completion path is
-        lane-agnostic, plus the engine the ACCELERATOR served from
-        (device/mesh/native_direct/fallback; rides the flight record
-        as ``remote_served``).  ``seconds`` is the accelerator's
+        seconds, info)`` — the first three shaped exactly like the
+        local ``_run_sync`` so the dispatcher's completion path is
+        lane-agnostic, plus an ``info`` dict with the reply's
+        accelerator-side evidence: ``served`` (the engine that
+        produced the bytes — device/mesh/native_direct/fallback; rides
+        the flight record as ``remote_served``) and ``queue_wait_s``
+        (the accel-side coalesce wait — the op waterfall's
+        accel_queue_wait hop).  ``seconds`` is the accelerator's
         device wall time when the reply carries it (the RTT lives in
         ``accel.remote_rtt``).  Raises AccelDataError /
         AccelUnavailable / AccelServiceError (see module doc for the
@@ -287,7 +290,10 @@ class AccelClient:
         self._note_success(b, ops, rtt)
         seconds = (float(reply.device_wall_s)
                    if reply.device_wall_s else rtt)
-        return results, 0, seconds, reply.served
+        return results, 0, seconds, {
+            "served": reply.served,
+            "queue_wait_s": reply.queue_wait_s,
+        }
 
     def _slice_results(self, b, ops, reply):
         """Member-major reply blobs -> per-member results.  Encode

@@ -100,6 +100,12 @@ class AccelDaemon(Dispatcher):
         self.perf = PerfCountersCollection()
         self.perf.attach(self.messenger.perf)
         self.perf.attach(data_path_perf())
+        # the small-op cost ledger (ISSUE 12): this daemon's RPC
+        # frames pay header encode/decode too — same process-global
+        # family the OSD attaches, riding perf dump -> mgr
+        from ..common.stack_ledger import stack_perf
+
+        self.perf.attach(stack_perf())
         pec = create_ec_perf(self.perf)
         self._pacc = create_accel_service_perf(self.perf)
         # -- QoS: this daemon's OWN dmClock instance (requests carry
@@ -493,6 +499,10 @@ class AccelDaemon(Dispatcher):
                 tid=msg.tid, result=0, blobs=result_blobs,
                 served=launch.get("served"),
                 device_wall_s=launch.get("device_wall_s"),
+                # the accel-side coalesce wait: the client OSD's
+                # flight record and op waterfall split the remote RTT
+                # into wait-here vs device wall (ISSUE 12)
+                queue_wait_s=launch.get("queue_wait_s"),
                 **reply_extra, **self._health_fields(),
             )
         except Exception as e:

@@ -238,7 +238,35 @@ def register_common(asok: "AdminSocket", *, perf=None, config=None) -> None:
         return dump_all(trace=req.get("trace"))
 
     asok.register("dump_tracepoints", _dump_tracepoints,
-                  "ring-buffer tracepoint events (optional trace filter)")
+                  "ring-buffer tracepoint events (optional trace "
+                  "filter; each ring reports dropped / "
+                  "dropped_since_dump so a truncated timeline is "
+                  "visibly truncated)")
+
+    def _dump_op_waterfall(req: dict) -> dict:
+        from .tracing import op_waterfall
+
+        trace = req.get("trace") or req.get("trace_id")
+        if not trace:
+            return {"error": "pass the op's trace id as "
+                             "{'trace': 'client.N:tX'}"}
+        return op_waterfall(str(trace))
+
+    asok.register("dump_op_waterfall", _dump_op_waterfall,
+                  "one op's cross-daemon hop waterfall "
+                  "({'trace': <id>}): ordered clock-aligned hops with "
+                  "durations, nesting, alignment uncertainty, "
+                  "path_sum_s and the dominant hop")
+
+    def _dump_clock_sync(_req: dict) -> dict:
+        from .clocksync import clock_table
+
+        return clock_table().dump()
+
+    asok.register("dump_clock_sync", _dump_clock_sync,
+                  "per-peer monotonic clock-offset estimates "
+                  "(offset/uncertainty/rtt/age/samples) feeding the "
+                  "op waterfall's cross-process alignment")
 
 
 async def admin_command(path: str, prefix: str, **kw) -> Any:

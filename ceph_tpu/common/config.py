@@ -82,6 +82,30 @@ OPTIONS: dict[str, Option] = _opts(
            "operations, mid-frame when sending (0 = off; the "
            "reference's ms_inject_socket_failures, "
            "config_opts.h:209)"),
+    Option("ms_clock_sync_interval", float, 5.0,
+           "per-peer monotonic clock-offset re-estimation period (s): "
+           "the messenger runs an NTP-style MClockSync exchange at "
+           "connection start and whenever the peer's estimate ages "
+           "past this, so span timestamps from different processes "
+           "merge into one op waterfall (0 disables the probes; "
+           "common/clocksync.py records the uncertainty of every "
+           "estimate)"),
+    # observability: op waterfall (common/tracing.py spans + the
+    # stack.* ledger, ISSUE 12)
+    Option("osd_op_trace_sample_every", int, 64,
+           "record full waterfall spans for 1-in-N client ops (per "
+           "OSD): sampled ops get per-hop spans (client serialize / "
+           "wire / dispatch / qos wait / execute / EC coalesce+device "
+           "/ reply) recorded locally, piggybacked on the reply, and "
+           "fed into the stack.lat_* histograms -> mgr prometheus — "
+           "per-hop p99 as a continuously exported series (1 = every "
+           "op, 0 disables; live via observer)"),
+    Option("trace_ring_capacity", int, 4096,
+           "events kept per tracepoint-provider ring "
+           "(common/tracing.py; process-global — one set of rings per "
+           "process).  Live via observer; shrinking evicts oldest "
+           "events and the eviction is COUNTED (dump_tracepoints "
+           "reports dropped / dropped_since_dump)"),
     # osd: liveness
     Option("osd_heartbeat_interval", float, 0.0,
            "peer ping period (s); 0 disables (reference default 6)"),

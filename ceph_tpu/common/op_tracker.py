@@ -52,6 +52,37 @@ class TrackedOp:
         return (now if now is not None else time.monotonic()) \
             - self.initiated_at
 
+    def state_durations(self, now: float | None = None) -> dict[str, float]:
+        """Seconds spent in each typed state: consecutive transition
+        deltas, with the current state charged up to ``now`` (in-flight)
+        or to the recorded duration (historic).  The waterfall's coarse
+        shape for UNSAMPLED ops — queued_for_qos -> dequeued is the QoS
+        wait, dequeued -> replied the execute wall — readable straight
+        off dump_ops_in_flight / dump_historic_ops."""
+        if now is None:
+            now = time.monotonic()
+        end = (self.initiated_at + self.duration
+               if self.duration is not None else now)
+        durs: dict[str, float] = {}
+        for i, (state, ts) in enumerate(self.events):
+            nxt = (self.events[i + 1][1] if i + 1 < len(self.events)
+                   else end)
+            durs[state] = durs.get(state, 0.0) + max(0.0, nxt - ts)
+        return durs
+
+    def dominant_state(self, now: float | None = None,
+                       durs: "dict[str, float] | None" = None
+                       ) -> str | None:
+        """The state this op spent longest in — a slow op's coarse
+        'dominant hop' (the SLOW_OPS dump names it).  ``durs`` lets a
+        caller that already computed :meth:`state_durations` reuse it
+        (dump() does) so the dominance rule lives in ONE place."""
+        if durs is None:
+            durs = self.state_durations(now)
+        if not durs:
+            return None
+        return max(durs.items(), key=lambda kv: kv[1])[0]
+
     def dump(self, now: float | None = None) -> dict:
         out = dict(self.desc)
         out["trace"] = self.trace
@@ -61,6 +92,12 @@ class TrackedOp:
         out["events"] = [
             {"event": ev, "at": round(ts - t0, 6)} for ev, ts in self.events
         ]
+        durs = self.state_durations(now)
+        out["state_durations"] = {
+            st: round(d, 6) for st, d in durs.items()
+        }
+        if durs:
+            out["dominant_state"] = self.dominant_state(durs=durs)
         if self.duration is not None:
             out["duration"] = self.duration
         else:

@@ -30,10 +30,12 @@ from __future__ import annotations
 
 import json
 import struct
+import time
 from typing import Any, Type
 
 import numpy as np
 
+from ..common.stack_ledger import note_header_decode, note_header_encode
 from ..utils import native
 from ..utils.buffers import BufferList, note_copy
 
@@ -84,6 +86,14 @@ class Message:
         # path paid one full payload memcpy here per hop
         self.blobs: list = list(kw.pop("blobs", []))
         self.trace: str | None = kw.pop("trace", None)
+        # transport stamps (op waterfall, common/tracing.py): ``sent``
+        # is the SENDER's monotonic clock at frame encode (rides the
+        # header next to the trace id, only on traced messages);
+        # ``recv_ts`` is the receiver's monotonic clock at frame read
+        # (set by the messenger reader loop, never on the wire) — the
+        # wire hop is recv_ts - align(sent)
+        self.sent: float | None = None
+        self.recv_ts: float | None = None
         for f in self.FIELDS:
             setattr(self, f, kw.pop(f, None))
         if kw:
@@ -134,6 +144,11 @@ def encode_frame_segments(msg: Message, seq: int = 0) -> tuple[list, int]:
     are the caller's blob views (ZERO copies), the trailer is the crc —
     chained across segments (ceph_crc32c composes), so the frame is
     never joined on the send side."""
+    # the header cost ledger (common/stack_ledger): time the HEADER
+    # work only — dict build + json.dumps + length prefix — never the
+    # payload-proportional crc below.  This is the number ROADMAP item
+    # 1's binary-header PR must beat, measured where it is paid.
+    _t0 = time.perf_counter()
     head = {
         "type": msg.TYPE,
         "seq": seq,
@@ -142,8 +157,19 @@ def encode_frame_segments(msg: Message, seq: int = 0) -> tuple[list, int]:
     }
     if msg.trace is not None:
         head["trace"] = msg.trace
+        # send stamp for the waterfall's wire hop (sender's monotonic
+        # clock; the receiver aligns it via clocksync).  It rides
+        # wherever the trace id rides — i.e. EVERY frame the messenger
+        # sends (Connection.send mints a trace when none is set); the
+        # guard matters for direct encode_frame users (tests, compat),
+        # whose untraced frames stay byte-deterministic across encodes
+        msg.sent = time.monotonic()
+        head["sent"] = round(msg.sent, 9)
     header = json.dumps(head, separators=(",", ":")).encode()
     segs: list = [MAGIC + struct.pack(">I", len(header)) + header]
+    # two allocations on this path: the header bytes and (below) the
+    # crc trailer pack
+    note_header_encode(time.perf_counter() - _t0, allocs=2)
     crc = native.crc32c(CRC_SEED, header)
     total = len(segs[0])
     for b in msg.blobs:
@@ -185,8 +211,12 @@ def decode_frame(frame: bytes | memoryview) -> tuple[Message, int]:
         raise BadFrame(f"crc mismatch: got {crc:#x} want {want:#x}")
     if hlen > body.nbytes:
         raise BadFrame("truncated header")
+    # header ledger (see encode_frame_segments): the parse + type
+    # routing cost, crc and blob views excluded
+    _t0 = time.perf_counter()
     header = json.loads(bytes(body[:hlen]))  # copy-ok: header json only
     cls = _REGISTRY.get(header["type"])
+    note_header_decode(time.perf_counter() - _t0, allocs=1)
     if cls is None:
         raise BadFrame(f"unknown message type {header['type']!r}")
     blobs, off = [], hlen
@@ -197,4 +227,5 @@ def decode_frame(frame: bytes | memoryview) -> tuple[Message, int]:
         raise BadFrame("blob length mismatch")
     msg = cls.from_fields(header["fields"], blobs)
     msg.trace = header.get("trace")
+    msg.sent = header.get("sent")
     return msg, header["seq"]

@@ -156,6 +156,20 @@ class MPingReply(Message):
     FIELDS = ("stamp", "epoch")
 
 
+@register
+class MClockSync(Message):
+    """NTP-style clock probe (common/clocksync.py; the reference mon's
+    ``timecheck`` exchange, applied per messenger connection so span
+    timestamps from different processes merge into one timeline).
+    Handled INSIDE the messenger — no dispatcher ever sees one.
+    Request: ``t0`` = requester's monotonic at send, ``t_rx``/``t_tx``
+    None.  Pong: ``t0`` echoed, ``t_rx`` = responder's monotonic at
+    receive, ``t_tx`` at pong send."""
+
+    TYPE = "clock_sync"
+    FIELDS = ("t0", "t_rx", "t_tx")
+
+
 # -- mon control plane -------------------------------------------------------
 
 
@@ -273,19 +287,33 @@ class MOSDOp(Message):
 
     ``snapc`` ({"seq", "snaps"}) rides with writes, ``snapid`` with reads
     — the reference's MOSDOp snap_seq/snaps/snapid header fields.
+
+    ``stamps`` ({"submit": <client monotonic>}) feeds the op waterfall
+    (common/tracing.py): together with the frame header's send stamp
+    the OSD computes the client_serialize hop without shipping any
+    span, and aligns it through the clock table.
     """
 
     TYPE = "osd_op"
-    FIELDS = ("tid", "epoch", "pool", "oid", "ops", "snapc", "snapid")
+    FIELDS = ("tid", "epoch", "pool", "oid", "ops", "snapc", "snapid",
+              "stamps")
 
 
 @register
 class MOSDOpReply(Message):
     """reference:src/messages/MOSDOpReply.h. Per-op outputs in ``out``
-    (json-able); read payloads in blobs (blob index in out entry)."""
+    (json-able); read payloads in blobs (blob index in out entry).
+
+    ``spans`` piggybacks the OSD's waterfall hops for a SAMPLED op
+    (1-in-osd_op_trace_sample_every; None otherwise): each entry is
+    {"hop", "t0", "dur", "entity", "parent"?, "uncertainty"?} with
+    ``t0`` in the OSD's monotonic clock — the client aligns them
+    through its clock table and records them locally, so the full
+    cross-daemon waterfall is readable at the client without any
+    collector."""
 
     TYPE = "osd_op_reply"
-    FIELDS = ("tid", "result", "epoch", "out")
+    FIELDS = ("tid", "result", "epoch", "out", "spans")
 
 
 # -- EC shard sub-ops --------------------------------------------------------
@@ -506,13 +534,15 @@ class MAccelReply(Message):
     accelerator's health on EVERY reply (the beacon's fields), so a
     busy OSD learns about a TRIPPED or saturating remote from its own
     traffic, without waiting for the next beacon.  ``served`` names the
-    engine that produced the bytes (device/mesh/fallback) and
-    ``device_wall_s`` its launch time — accelerator-side evidence for
-    the OSD's flight recorder."""
+    engine that produced the bytes (device/mesh/fallback),
+    ``device_wall_s`` its launch time and ``queue_wait_s`` the
+    accelerator-side coalesce wait — accelerator-side evidence for the
+    OSD's flight recorder and the op waterfall's accel hops."""
 
     TYPE = "accel_reply"
     FIELDS = ("tid", "result", "error", "shards", "engine_state",
-              "queue_depth", "capacity", "served", "device_wall_s")
+              "queue_depth", "capacity", "served", "device_wall_s",
+              "queue_wait_s")
 
 
 @register

@@ -21,6 +21,8 @@ import struct
 import time
 from typing import Optional
 
+from ..common.clocksync import ClockTable, clock_table
+from ..common.stack_ledger import note_frame_alloc
 from ..common.tracing import current_trace, new_trace_id
 from .message import BadFrame, Message, decode_frame, encode_frame_segments
 
@@ -61,6 +63,32 @@ class Connection:
         self._sendq: asyncio.Queue[Optional[tuple]] = asyncio.Queue()
         self._tasks: list[asyncio.Task] = []
         self._closed = False
+        # last MClockSync probe sent on this connection (per-conn
+        # throttle; the estimate's freshness check is the real gate),
+        # the bounded fast-convergence budget for loose estimates, and
+        # a lock-free "nothing to do before this" stamp so the
+        # per-frame hot path pays one float compare, not table locks
+        self._clock_probe_at = 0.0
+        self._clock_fast_left = AsyncMessenger.CLOCK_FAST_PROBES
+        self._clock_next_due = 0.0
+        # THIS connection's clock-offset estimate for its peer
+        # (common/clocksync; a single-entry ClockTable so the
+        # keep/age-out policy is shared).  Per-connection on purpose:
+        # peer entity names are not unique across processes
+        # (client.1 exists in every client process), so alignment must
+        # never read a name-keyed global — clock_table() is only the
+        # dump_clock_sync mirror
+        self._clock = ClockTable()
+
+    def clock_align(self, remote_ts: float):
+        """Translate a peer timestamp into our monotonic timeline:
+        ``(local_ts, uncertainty_s)`` or None when this connection's
+        peer clock was never estimated."""
+        return self._clock.align(self.peer_name, remote_ts)
+
+    def clock_estimate(self):
+        """This connection's current offset estimate dict (or None)."""
+        return self._clock.offset(self.peer_name)
 
     def send(self, msg: Message) -> None:
         """Queue a message; delivery is in send order (never blocks).
@@ -87,6 +115,7 @@ class Connection:
             # bookkeeping costs more than one bounded sub-KiB join —
             # payload frames (the byte volume) stay on the view path
             segs = [b"".join(segs)]  # copy-ok: bounded <=1KiB control frame
+            note_frame_alloc()  # the join is a frame-path allocation
         perf = self.messenger.perf
         perf.inc("msg_send")
         perf.inc("bytes_send", total)
@@ -141,6 +170,7 @@ class Connection:
                 else:
                     self._writer.writelines(segs)
                 await self._writer.drain()
+        # swallow-ok: writer teardown — the reader loop owns reset reporting
         except (ConnectionError, asyncio.CancelledError, OSError):
             pass
 
@@ -167,9 +197,15 @@ class Connection:
                 perf.set("dispatch_queue_bytes", throttle.current)
                 try:
                     frame = await self._reader.readexactly(n)
+                    t_rx = time.monotonic()
                     msg, _seq = decode_frame(frame)
+                    # receive stamp (op waterfall): taken at frame
+                    # read, local clock — with the header's send stamp
+                    # and the peer clock offset this IS the wire hop
+                    msg.recv_ts = t_rx
                     perf.inc("msg_recv")
                     perf.inc("bytes_recv", n)
+                    self.messenger._maybe_clock_probe(self)
                     # restore the sender's trace context for this
                     # dispatch (and every task it spawns): the id minted
                     # at the client follows the op across daemons
@@ -182,8 +218,8 @@ class Connection:
                             dt = time.perf_counter() - t0
                             perf.observe("dispatch_latency", dt)
                             perf.hist("dispatch_histogram", n, dt)
+                    # swallow-ok: logged handler bug must not tear down the peer link
                     except Exception:
-                        # a handler bug must not tear down the peer link
                         logger.exception(
                             "%s: dispatcher failed on %s from %s",
                             self.messenger.name, msg.TYPE, self.peer_name,
@@ -193,10 +229,11 @@ class Connection:
                 finally:
                     throttle.release(n)
                     perf.set("dispatch_queue_bytes", throttle.current)
+        # swallow-ok: peer went away — _handle_reset below reports it
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
-        except BadFrame:
-            pass  # corrupt peer: drop the connection (reference fault path)
+        except BadFrame:  # swallow-ok: corrupt peer — dropping the conn IS the fault path
+            pass
         except asyncio.CancelledError:
             raise
         finally:
@@ -211,6 +248,7 @@ class Connection:
         try:
             self._writer.close()
             await self._writer.wait_closed()
+        # swallow-ok: already-dead transport on close — nothing to report
         except (ConnectionError, OSError):
             pass
 
@@ -238,6 +276,11 @@ class AsyncMessenger:
         self.reconnect_attempts = reconnect_attempts
         self.reconnect_backoff = reconnect_backoff
         self.connect_timeout = connect_timeout
+        # peer clock-offset re-estimation period (common/clocksync:
+        # the op waterfall's cross-process alignment; 0 disables the
+        # probes).  The ms_clock_sync_interval option overrides via
+        # apply_config; bare messengers (clients) keep this default.
+        self.clock_sync_interval = 5.0
         self._server: asyncio.AbstractServer | None = None
         self._conns: dict[str, Connection] = {}  # outbound, keyed by peer addr
         self._pending: dict[str, asyncio.Future] = {}  # in-flight connects
@@ -299,6 +342,7 @@ class AsyncMessenger:
         self.connect_timeout = cfg.ms_connect_timeout
         self.dispatch_throttle.limit = cfg.ms_dispatch_throttle_bytes
         self.inject_socket_failures = cfg.ms_inject_socket_failures
+        self.clock_sync_interval = cfg.ms_clock_sync_interval
 
     def _inject_failure(self) -> bool:
         n = self.inject_socket_failures
@@ -328,6 +372,7 @@ class AsyncMessenger:
                     continue
                 try:
                     await t
+                # swallow-ok: shutdown drain — cancelled conn tasks die here by design
                 except (asyncio.CancelledError, Exception):
                     pass
         if self._server is not None:
@@ -393,6 +438,7 @@ class AsyncMessenger:
                 json.dumps({"entity": self.name, "addr": self.addr}).encode() + b"\n"
             )
             await writer.drain()
+        # swallow-ok: malformed/failed handshake — closing the conn is the reply
         except (ValueError, KeyError, TypeError, ConnectionError, OSError):
             writer.close()
             return
@@ -439,6 +485,7 @@ class AsyncMessenger:
                 return await self._dial(addr, peer_name)
             except PermissionError:
                 raise  # deterministic auth rejection: do not retry
+            # swallow-ok: retry loop — the terminal raise below chains `last`
             except (ConnectionError, OSError, TimeoutError) as e:
                 last = e
         raise ConnectionError(
@@ -527,9 +574,95 @@ class AsyncMessenger:
             asyncio.ensure_future(conn._reader_loop()),
             asyncio.ensure_future(conn._writer_loop()),
         ]
+        # seed the peer clock offset right away (both sides of every
+        # connection do this, so the acceptor learns the dialer's clock
+        # too — the handshake banner alone cannot separate offset from
+        # one-way delay)
+        self._maybe_clock_probe(conn)
+
+    # -- peer clock sync (common/clocksync; the op waterfall's
+    # cross-process alignment) ----------------------------------------------
+
+    # an estimate tighter than this stops the fast re-probe cadence: a
+    # ±2ms placement error is far below any hop the waterfall renders
+    # across real processes, and chasing lower costs probe traffic
+    CLOCK_TIGHT_S = 0.002
+    # fast probes (loose-estimate convergence) allowed per connection:
+    # a boot-congested first exchange converges within a few quiet
+    # round trips; on a link whose floor RTT simply IS large (tight is
+    # unreachable), the budget caps the extra traffic instead of
+    # probing at ~1/s forever
+    CLOCK_FAST_PROBES = 8
+
+    def _maybe_clock_probe(self, conn: Connection) -> None:
+        """Send an MClockSync probe when this peer's offset estimate is
+        missing, stale, or LOOSE.  Driven by traffic (the reader loop)
+        plus one shot at connection start: only peers we exchange
+        frames with ever need alignment, and re-estimation rides for
+        free.  A loose estimate (a probe that straddled a busy loop
+        tick inflates rtt, and uncertainty = rtt/2) re-probes at up to
+        ~1/s — bounded by a per-connection budget — until a tight
+        exchange lands; the table keeps the minimum-uncertainty
+        estimate, so one quiet round trip beats any number of
+        congested ones, and a confirming pong refreshes freshness
+        (checked_at) so the steady-state cadence stays 1-in-interval."""
+        interval = self.clock_sync_interval
+        if interval <= 0 or conn._closed or conn.peer_name in ("", "?"):
+            return
+        now = time.monotonic()
+        # hot-path fast exit: one float compare per frame — the table
+        # locks below are only taken when a decision is actually due
+        if now < conn._clock_next_due:
+            return
+        fresh = conn._clock.fresh(conn.peer_name, interval)
+        if fresh:
+            est = conn.clock_estimate()
+            if est["uncertainty_s"] <= self.CLOCK_TIGHT_S:
+                conn._clock_next_due = est["checked_at"] + interval
+                return
+            if conn._clock_fast_left <= 0:
+                # loose but this link can't do better: settle at the
+                # normal cadence
+                conn._clock_next_due = est["checked_at"] + interval
+                return
+        gap = min(1.0, interval)
+        if now - conn._clock_probe_at < gap:
+            conn._clock_next_due = conn._clock_probe_at + gap
+            return
+        if fresh:
+            conn._clock_fast_left -= 1
+        conn._clock_probe_at = now
+        conn._clock_next_due = now + gap
+        from . import messages
+
+        conn.send(messages.MClockSync(t0=time.monotonic()))
 
     # -- dispatch plumbing
     async def _dispatch(self, conn: Connection, msg: Message) -> None:
+        from . import messages
+
+        if isinstance(msg, messages.MClockSync):
+            # handled at the messenger layer on every daemon AND
+            # client: no dispatcher ever needs to know clocks exist
+            if msg.t_rx is None:
+                rx = (msg.recv_ts if msg.recv_ts is not None
+                      else time.monotonic())
+                conn.send(messages.MClockSync(
+                    t0=msg.t0, t_rx=round(rx, 9),
+                    t_tx=round(time.monotonic(), 9),
+                ))
+            else:
+                t3 = float(msg.recv_ts if msg.recv_ts is not None
+                           else time.monotonic())
+                conn._clock.observe(conn.peer_name, float(msg.t0),
+                                    float(msg.t_rx), float(msg.t_tx), t3)
+                # mirror into the name-keyed process table: the
+                # dump_clock_sync observability view only — alignment
+                # reads the per-connection estimate
+                clock_table().observe(conn.peer_name, float(msg.t0),
+                                      float(msg.t_rx), float(msg.t_tx),
+                                      t3)
+            return
         await self.dispatcher.ms_dispatch(conn, msg)
 
     def _handle_reset(self, conn: Connection) -> None:
@@ -555,5 +688,6 @@ async def send_daemon_stats(messenger: "AsyncMessenger", osdmap,
         conn = await messenger.connect(osdmap.mgr_addr, osdmap.mgr_name)
         conn.send(messages.MDaemonStats(name=name, perf=perf))
         return True
+    # swallow-ok: best-effort stats push — a dead mgr must cost the reporter nothing
     except (ConnectionError, OSError):
         return False

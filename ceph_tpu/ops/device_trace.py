@@ -639,7 +639,8 @@ class FlightRecorder:
     def end(self, token: int, *, device_wall_s: float | None = None,
             served: str | None = None, error: str | None = None,
             origin: str | None = None,
-            remote_served: str | None = None) -> None:
+            remote_served: str | None = None,
+            remote_queue_wait_s: float | None = None) -> None:
         """``origin`` names the lane whose FAULT caused a
         fallback-served batch ("remote" = accelerator/network trip,
         "device"/"mesh" = local device trip) — without it an operator
@@ -664,6 +665,11 @@ class FlightRecorder:
                 rec["origin"] = origin
             if remote_served is not None:
                 rec["remote_served"] = remote_served
+            if remote_queue_wait_s is not None:
+                # accel-side coalesce wait (reply piggyback): keeps
+                # the queue-wait-vs-device split honest for remote
+                # launches, and feeds the waterfall's accel hop
+                rec["remote_queue_wait_s"] = round(remote_queue_wait_s, 6)
             self._ring.append(rec)
 
     @staticmethod

@@ -233,6 +233,15 @@ class OSD(Dispatcher):
         from ..utils.buffers import data_path_perf
 
         self.perf.attach(data_path_perf())
+        # the small-op cost ledger + per-hop latency family
+        # (common/stack_ledger.py, ISSUE 12): header encode/decode
+        # seconds + frame allocs fed at the messenger boundary, and
+        # the stack.lat_<hop> histograms this OSD feeds for sampled
+        # ops — process-global like data_path, attached so the family
+        # rides perf dump -> mgr prometheus
+        from ..common.stack_ledger import stack_perf
+
+        self.perf.attach(stack_perf())
         posd = self.perf.create("osd")
         posd.add_counter("op", "client ops")
         posd.add_counter("op_r", "client reads")
@@ -450,6 +459,13 @@ class OSD(Dispatcher):
             # the dispatcher's flight recorder
             self.op_tracker.launch_lookup = self.ec_dispatch.flight.lookup
         self._slow_reported = 0  # slow ops already clog'd (edge trigger)
+        # op waterfall sampling (ISSUE 12): 1-in-N client ops get full
+        # hop spans (recorded + reply-piggybacked + stack.lat_* fed)
+        self._trace_sample_every = int(cfg.osd_op_trace_sample_every)
+        self._trace_sampled_n = 0
+        from ..common.tracing import set_ring_capacity
+
+        set_ring_capacity(cfg.trace_ring_capacity)
         self._mon_conn: Connection | None = None
         self._admin = None
         # live knobs: without observers, admin-socket `config set` would
@@ -538,6 +554,12 @@ class OSD(Dispatcher):
              lambda _n, v: self.scheduler.set_slots(v)),
             ("osd_op_queue_cut_off", lambda _n, v: setattr(
                 self.scheduler, "cut_off", max(1, int(v)))),
+            # op waterfall knobs (ISSUE 12): sampling rate and ring
+            # capacity flip on a RUNNING osd (the live tests and a
+            # debug session both crank sampling to 1 temporarily)
+            ("osd_op_trace_sample_every", lambda _n, v: setattr(
+                self, "_trace_sample_every", int(v))),
+            ("trace_ring_capacity", self._on_trace_ring_capacity),
         ]
         for _qk in QOS_CLASSES:
             for _qf, _qa in (("res", "reservation"), ("wgt", "weight"),
@@ -662,6 +684,14 @@ class OSD(Dispatcher):
         '0 disables the deadline, not the watchdog'."""
         return (float(deadline) if deadline > 0
                 else self.config.osd_op_thread_timeout)
+
+    def _on_trace_ring_capacity(self, _name: str, value: int) -> None:
+        """trace_ring_capacity is live (process-global: one set of
+        rings per process, so the last setter wins — the same sharing
+        the data_path family documents)."""
+        from ..common.tracing import set_ring_capacity
+
+        set_ring_capacity(int(value))
 
     def _on_ec_launch_deadline(self, _name: str, value: float) -> None:
         """osd_ec_launch_deadline is live: it bounds future launches
@@ -1177,6 +1207,160 @@ class OSD(Dispatcher):
                         - frozenset(("delete", "rmxattr", "omap_rmkeys",
                                      "omap_clear", "call")))
 
+    def _op_sampled(self, msg: messages.MOSDOp, internal: bool) -> bool:
+        """1-in-``osd_op_trace_sample_every`` client ops get full
+        waterfall spans (ISSUE 12).  Internal peer-daemon ops never
+        sample: their originator's op owns the trace."""
+        n = self._trace_sample_every
+        if internal or n <= 0 or msg.trace is None:
+            return False
+        self._trace_sampled_n += 1
+        return self._trace_sampled_n % n == 0
+
+    def _waterfall_spans(self, conn: Connection, msg: messages.MOSDOp,
+                         op) -> list[dict]:
+        """Build one sampled op's hop spans (this OSD's view of the
+        waterfall), record them into the local ``stack`` provider
+        ring, feed the ``stack.lat_<hop>`` histograms, and return the
+        JSON-able list the reply piggybacks (``t0`` in THIS daemon's
+        monotonic clock; the client re-aligns).
+
+        Hops, all in this process's timeline:
+
+        - ``client_serialize``: the client's submit->frame-queued span
+          — its DURATION is exact (both stamps are the client's own
+          clock: ``msg.stamps["submit"]`` and the frame header's send
+          stamp).
+        - ``wire``: send stamp (aligned) -> receive stamp.  Skipped
+          when the peer's clock was never estimated (first frames can
+          beat the probe round trip).
+
+        Placement is **causally anchored**: the wire hop ends exactly
+        at our receive stamp and client_serialize ends exactly where
+        wire starts, so every span this daemon emits sits on ONE rigid
+        local timeline and the merged waterfall is monotonic by
+        construction — clock alignment determines the wire DURATION
+        (and carries its uncertainty), never the ordering.  Without
+        the clamp, an offset error of rtt/2 (the estimator's honest
+        bound) can exceed a loopback hop gap and fake a reordering.
+        - ``dispatch``: receive stamp -> op-tracker creation.
+        - ``qos_wait`` / ``execute``: straight off the typed OpTracker
+          transitions.
+        - children of execute, from the flight record of the launch
+          that carried this trace: ``coalesce_wait`` (batch queue
+          wait), ``accel_queue_wait`` (remote lane only) and
+          ``device_wall``.  Their DURATIONS are measured; their
+          placement is back-to-back ending at execute end (the launch
+          record does not keep absolute stamps) — documented
+          approximation, excluded from path_sum by the parent link.
+        """
+        from ..common import stack_ledger
+        from ..common.tracing import record_span, span_id_for
+
+        trace = msg.trace
+        now = time.monotonic()
+        ev: dict[str, float] = {}
+        for state, ts in op.events:
+            ev.setdefault(state, ts)
+        peer = conn.peer_name
+        # per-CONNECTION estimate: peer names are not unique across
+        # processes, so alignment never reads a name-keyed global
+        align = conn.clock_align
+        sent = msg.sent
+        submit = (msg.stamps or {}).get("submit")
+        recv = msg.recv_ts
+        spans: list[dict] = []
+        wire_start = recv  # where client spans anchor (causal clamp)
+        if sent is not None and recv is not None:
+            loc = align(float(sent))
+            if loc is not None:
+                aligned_t0, unc = loc
+                dur = max(0.0, recv - aligned_t0)
+                wire_start = recv - dur
+                spans.append({"hop": "wire", "t0": wire_start,
+                              "dur": dur, "entity": self.name,
+                              "uncertainty": unc})
+        if sent is not None and submit is not None:
+            dur = max(0.0, float(sent) - float(submit))
+            anchor = wire_start if wire_start is not None else now
+            loc = align(float(submit))
+            unc = loc[1] if loc is not None else None
+            spans.append({"hop": "client_serialize",
+                          "t0": anchor - dur, "dur": dur,
+                          "entity": peer,
+                          **({"uncertainty": unc}
+                             if unc is not None else {})})
+        tq, td = ev.get("queued_for_qos"), ev.get("dequeued")
+        if recv is not None:
+            # dispatch runs to the qos mark (not just op creation):
+            # the tracker bookkeeping between the two is dispatch-side
+            # work, and leaving it uncovered opens a gap the hop-sum
+            # honesty check would charge to nobody
+            d_end = tq if tq is not None else op.initiated_at
+            spans.append({"hop": "dispatch", "t0": recv,
+                          "dur": max(0.0, d_end - recv),
+                          "entity": self.name})
+        if tq is not None and td is not None:
+            spans.append({"hop": "qos_wait", "t0": tq,
+                          "dur": max(0.0, td - tq),
+                          "entity": self.name})
+        if td is not None:
+            spans.append({"hop": "execute", "t0": td,
+                          "dur": max(0.0, now - td),
+                          "entity": self.name})
+            rec = None
+            if self.ec_dispatch is not None:
+                try:
+                    rec = self.ec_dispatch.flight.lookup(trace)
+                except Exception:  # pragma: no cover - observability only
+                    rec = None
+            if rec:
+                parent = span_id_for(trace, self.name, "execute")
+                cursor = now
+                # laid out backwards from execute end: the device wall
+                # is last, the accel-side wait before it, the local
+                # coalesce wait first.  Clamped at the execute span's
+                # own start: the flight record carries BATCH-level
+                # durations (the oldest member's queue wait, the
+                # shared launch wall), and a child rendered before its
+                # parent — before this op even reached the OSD — would
+                # read as time travel, not as the documented
+                # approximation
+                for hop, key in (("device_wall", "device_wall_s"),
+                                 ("accel_queue_wait",
+                                  "remote_queue_wait_s"),
+                                 ("coalesce_wait", "queue_wait_s")):
+                    dur = rec.get(key)
+                    if not dur:
+                        continue
+                    cursor = max(td, cursor - float(dur))
+                    spans.append({"hop": hop, "t0": cursor,
+                                  "dur": float(dur),
+                                  "entity": self.name,
+                                  "parent": parent})
+        for s in spans:
+            record_span(s["hop"], s["t0"], s["dur"], trace=trace,
+                        entity=s["entity"], parent=s.get("parent"),
+                        uncertainty=s.get("uncertainty"))
+            stack_ledger.feed_hop(s["hop"], s["dur"])
+        # lat_total = client submit -> reply queued: the OSD-visible
+        # extent, fed HERE because this daemon's family is the one the
+        # mgr exports continuously (the reply wire/delivery tail rides
+        # lat_reply_* from the client) — the registration text says so
+        base = None
+        if submit is not None:
+            loc = align(float(submit))
+            base = loc[0] if loc is not None else None
+        if base is None:
+            base = recv if recv is not None else op.initiated_at
+        stack_ledger.feed_hop("total", max(0.0, now - base))
+        stack_ledger.stack_perf().inc("sampled_ops")
+        return [
+            {k: (round(v, 9) if isinstance(v, float) else v)
+             for k, v in s.items()}
+            for s in spans
+        ]
+
     async def _handle_client_op(self, conn: Connection, msg: messages.MOSDOp) -> None:
         posd = self.perf.get("osd")
         posd.inc("op")
@@ -1199,6 +1383,7 @@ class OSD(Dispatcher):
         # (tier promotion/flush internal ops), and re-admitting them
         # could deadlock the slot pool against their originator
         internal = conn.peer_name.startswith("osd.")
+        sampled = self._op_sampled(msg, internal)
         replied = False
         granted = False
         try:
@@ -1237,10 +1422,21 @@ class OSD(Dispatcher):
                     "op_out_bytes", sum(len(b) for b in blobs)
                 )
             op.mark("replied")
+            spans_payload = None
+            if sampled:
+                # best-effort by contract: a waterfall bug must never
+                # fail an op that executed fine
+                try:
+                    spans_payload = self._waterfall_spans(conn, msg, op)
+                except Exception:  # pragma: no cover - observability only
+                    logger.exception(
+                        "%s: waterfall span build failed for tid=%s",
+                        self.name, msg.tid,
+                    )
             conn.send(
                 messages.MOSDOpReply(
                     tid=msg.tid, result=result, epoch=self._epoch(), out=out,
-                    blobs=blobs,
+                    blobs=blobs, spans=spans_payload,
                 )
             )
             replied = True
@@ -3819,13 +4015,19 @@ class OSD(Dispatcher):
         slow = self.op_tracker.slow_ops(self.config.osd_op_complaint_time)
         posd = self.perf.get("osd")
         posd.set("slow_ops", len(slow))
-        oldest = max((o.age() for o in slow), default=0.0)
+        oldest_op = max(slow, key=lambda o: o.age(), default=None)
+        oldest = oldest_op.age() if oldest_op is not None else 0.0
         posd.set("slow_ops_oldest_sec", round(oldest, 3))
         if len(slow) > self._slow_reported:
+            # name WHERE the oldest op's time went (its typed-state
+            # durations — the waterfall's coarse shape for unsampled
+            # ops), so the warning points at a hop, not just an age
+            dom = oldest_op.dominant_state() if oldest_op else None
             self.clog(
                 "warn",
                 f"{len(slow)} slow requests, oldest blocked for "
-                f"{oldest:.1f}s (complaint time "
+                f"{oldest:.1f}s in state {dom or 'unknown'} "
+                f"(complaint time "
                 f"{self.config.osd_op_complaint_time:g}s)",
             )
         self._slow_reported = len(slow)

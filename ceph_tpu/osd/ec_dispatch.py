@@ -847,11 +847,17 @@ class ECDispatcher:
             # deadline (osd_ec_accel_deadline) and raises
             # AccelUnavailable/AccelServiceError for the fork above —
             # no watchdog pin (nothing can wedge a thread here)
-            results, pad, seconds, served_by = \
+            results, pad, seconds, info = \
                 await self._remote.run_batch(b, ops)
-            return results, pad, seconds, (
-                {"remote_served": served_by} if served_by else {}
-            )
+            extra = {}
+            if info.get("served"):
+                extra["remote_served"] = info["served"]
+            if info.get("queue_wait_s"):
+                # the accel-side coalesce wait (reply piggyback): the
+                # waterfall's accel_queue_wait hop, and the honest
+                # queue-wait-vs-device split for a REMOTE launch
+                extra["remote_queue_wait_s"] = float(info["queue_wait_s"])
+            return results, pad, seconds, extra
         results, pad, seconds = await self._bounded_device_call(
             f"{b.kind} launch ({b.stripes} stripes)",
             self._run_sync, b, ops,

@@ -16,6 +16,7 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
+import time
 from typing import Any
 
 from ..msg import AsyncMessenger, Connection, Dispatcher, messages
@@ -478,6 +479,11 @@ class RadosClient(Dispatcher):
             except (ConnectionError, OSError):
                 pass  # mon hunting happens below anyway
         for attempt in range(self.max_retries):
+            # waterfall submit stamp (ISSUE 12): taken at ATTEMPT
+            # start, so the client_serialize hop covers the real
+            # client-side cost of this submission — pool lookup, pg
+            # mapping, (cached) connect — not just the frame encode
+            t_submit = time.monotonic()
             epoch = self.osdmap.epoch
             pool = self.osdmap.lookup_pool(pool_name)
             if pool is None:
@@ -501,12 +507,16 @@ class RadosClient(Dispatcher):
             try:
                 conn = await self.messenger.connect(addr, f"osd.{primary}")
                 self._fut_conns[tid] = conn
-                conn.send(
-                    messages.MOSDOp(
-                        tid=tid, epoch=epoch, pool=pool.id, oid=oid,
-                        ops=ops, blobs=blobs, snapc=snapc, snapid=snapid,
-                    )
+                m = messages.MOSDOp(
+                    tid=tid, epoch=epoch, pool=pool.id, oid=oid,
+                    ops=ops, blobs=blobs, snapc=snapc, snapid=snapid,
+                    # the submit stamp plus the frame header's send
+                    # stamp give the OSD the client_serialize hop
+                    # with no span shipping — both are OUR clock, so
+                    # the duration is exact wherever it is read
+                    stamps={"submit": round(t_submit, 9)},
                 )
+                conn.send(m)
                 async with asyncio.timeout(op_timeout):
                     reply = await fut
             except PermissionError as e:
@@ -529,9 +539,178 @@ class RadosClient(Dispatcher):
                 # wrong primary (map race) — wait for a newer map and retry
                 await self._wait_for_map_change(epoch, self.op_timeout)
                 continue
+            if getattr(reply, "spans", None):
+                # a SAMPLED op: the OSD piggybacked its hop spans —
+                # align + record them here, so the full cross-daemon
+                # waterfall is readable in this process
+                try:
+                    self._note_waterfall(conn, m, reply)
+                except Exception:  # pragma: no cover - observability only
+                    logger.exception(
+                        "%s: waterfall record failed", self.name
+                    )
             return reply
         raise RadosError(-EAGAIN, f"op to {pool_name}/{oid} exhausted retries"
                          ) from last_err
+
+    def _note_waterfall(self, conn: Connection, msg, reply) -> None:
+        """Record a sampled op's piggybacked hop spans (the OSD's
+        monotonic clock) into THIS process's ``stack`` provider ring,
+        aligned through the messenger clock table, plus the
+        client-side hops — common/tracing.op_waterfall then merges
+        everything into one timeline (stable span ids dedupe against
+        the OSD's own copies when both daemons share a process).
+
+        The network hops are **offset-free in sum**: total network
+        time = (our send stamp -> our reply receive) minus the OSD's
+        busy extent — every term a same-clock difference, so the hop
+        sum honesty check does not inherit clock-offset error.  The
+        clock alignment only SPLITS that total between ``wire`` and
+        ``reply_wire`` (midpoint split when no estimate exists —
+        exactly the RTT/2 assumption, with the uncertainty saying so).
+        Placement is causally chained (serialize -> wire -> the OSD
+        extent re-anchored as one rigid block at sent+wire ->
+        reply_wire ends at our receive), so the merged ordering cannot
+        be faked by alignment error.  OSD spans that cannot be aligned
+        are skipped: mis-placing them would fake an ordering the
+        uncertainty field exists to prevent."""
+        from ..common import stack_ledger
+        from ..common.tracing import has_spans, record_span
+
+        trace = reply.trace
+        if not trace:
+            return
+        # per-CONNECTION estimate (peer names are not unique across
+        # processes — clocksync module docstring)
+        align = conn.clock_align
+        peer = conn.peer_name
+        # SAME-PROCESS fast path: the OSD already recorded every span
+        # it measured into this process's ring with TRUE timestamps —
+        # re-recording aligned reconstructions next to them would mix
+        # two rigid timelines in one waterfall (per-span dedupe could
+        # then pick copies from different frames, a reordering no real
+        # clock produced).  We only add the reply-side hops, and the
+        # piggybacked stamps are same-clock, so no alignment at all.
+        local = has_spans(trace)
+        # 1. parse the OSD's spans; the client-pair hops (wire /
+        # client_serialize) are recomputed below from our own stamps
+        parsed: list[tuple[str, float, float, dict]] = []
+        osd_extent: list[tuple[float, float]] = []
+        for s in reply.spans:
+            try:
+                t0, dur = float(s["t0"]), float(s["dur"])
+                hop = str(s["hop"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            if hop in ("client_serialize", "wire"):
+                continue
+            if local:
+                if s.get("entity") == peer and not s.get("parent"):
+                    osd_extent.append((t0, dur))  # same clock: raw
+                continue
+            loc = align(t0)
+            if loc is None:
+                continue
+            t0_local, align_unc = loc
+            if s.get("entity") == peer and not s.get("parent"):
+                osd_extent.append((t0_local, dur))
+            parsed.append((hop, t0_local, dur, {
+                "entity": str(s.get("entity") or peer),
+                "parent": s.get("parent"),
+                "uncertainty": (float(s.get("uncertainty") or 0.0)
+                                + align_unc),
+            }))
+        sent_cl = msg.sent
+        recv_cl = reply.recv_ts
+        if sent_cl is None or recv_cl is None:
+            return
+        submit = (getattr(msg, "stamps", None) or {}).get("submit")
+        if not osd_extent:
+            # nothing usable (cross-process with no clock estimate
+            # yet): without the OSD busy extent the "network total"
+            # would be the whole round trip, execute included —
+            # recording a wire split from that (or feeding the
+            # histograms with it) would be exported fiction.  Keep
+            # only what our own clock proves.
+            if not local and submit is not None:
+                dur = max(0.0, float(sent_cl) - float(submit))
+                record_span("client_serialize", float(submit), dur,
+                            trace=trace, entity=self.name)
+            dur = max(0.0, time.monotonic() - recv_cl)
+            record_span("reply_dispatch", recv_cl, dur, trace=trace,
+                        entity=self.name)
+            stack_ledger.feed_hop("reply_dispatch", dur)
+            return
+        # 2. offset-free network total: (our turnaround) - (the
+        # OSD's busy extent)
+        ext_t0 = min(t0 for t0, _d in osd_extent)
+        ext_end = max(t0 + d for t0, d in osd_extent)
+        osd_busy = ext_end - ext_t0
+        net_total = max(0.0, (recv_cl - float(sent_cl)) - osd_busy)
+        if local:
+            # same process, same clock: EVERY client-pair hop is
+            # exactly measurable, no offset estimate involved — the
+            # reply path is the gap between the OSD extent's end and
+            # our receive stamp, and the wire hop is the gap between
+            # our send stamp and the extent's start (ext_t0 IS the
+            # OSD's receive stamp, already in our clock).  These exact
+            # copies carry no uncertainty, so they win the span dedupe
+            # over the OSD's alignment-based versions — under load the
+            # OSD's estimate error would otherwise eat a visible slice
+            # of the hop sum.
+            rw = max(0.0, recv_cl - ext_end)
+            record_span("reply_wire", recv_cl - rw, rw, trace=trace,
+                        entity=self.name)
+            stack_ledger.feed_hop("reply_wire", rw)
+            w = max(0.0, ext_t0 - float(sent_cl))
+            record_span("wire", float(sent_cl), w, trace=trace,
+                        entity=peer)
+            if submit is not None:
+                record_span("client_serialize", float(submit),
+                            max(0.0, float(sent_cl) - float(submit)),
+                            trace=trace, entity=self.name)
+        else:
+            # 3. cross-process: split the total by alignment, then
+            # RE-ANCHOR the whole rigid OSD frame at sent + wire so
+            # the chain is contiguous BY CONSTRUCTION — serialize ->
+            # wire -> [OSD extent, shifted as one block] -> reply_wire
+            # ends at our receive.  Alignment error moves only the
+            # split (reported as uncertainty); raw aligned positions
+            # could land the OSD frame outside our [send, recv] window
+            # whenever the offset error exceeds the one-way delay,
+            # faking a reordering (the loopback flake this replaces).
+            rw = None
+            split_unc = net_total / 2.0
+            if reply.sent is not None:
+                loc = align(float(reply.sent))
+                if loc is not None:
+                    rw = min(max(0.0, recv_cl - loc[0]), net_total)
+                    split_unc = min(loc[1], net_total / 2.0)
+            if rw is None:
+                rw = net_total / 2.0  # no estimate: RTT/2 midpoint
+            wire = net_total - rw
+            shift = (float(sent_cl) + wire) - ext_t0
+            for hop, t0_local, dur, extra in parsed:
+                record_span(hop, t0_local + shift, dur, trace=trace,
+                            entity=extra["entity"],
+                            parent=extra["parent"],
+                            uncertainty=extra["uncertainty"])
+            record_span("wire", float(sent_cl), wire, trace=trace,
+                        entity=peer, uncertainty=split_unc)
+            if submit is not None:
+                dur = max(0.0, float(sent_cl) - float(submit))
+                record_span("client_serialize", float(submit), dur,
+                            trace=trace, entity=self.name)
+            record_span("reply_wire", recv_cl - rw, rw, trace=trace,
+                        entity=self.name, uncertainty=split_unc)
+            stack_ledger.feed_hop("reply_wire", rw)
+        # 4. reply delivery: frame read -> this op's task resumed
+        # (future resolution + loop scheduling — real small-op latency
+        # a busy client loop pays; our own clock, no alignment)
+        dur = max(0.0, time.monotonic() - recv_cl)
+        record_span("reply_dispatch", recv_cl, dur, trace=trace,
+                    entity=self.name)
+        stack_ledger.feed_hop("reply_dispatch", dur)
 
     async def _pg_roundtrip(
         self, pg, build_msg, timeout: float, resend_on_timeout: bool = True

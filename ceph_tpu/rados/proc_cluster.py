@@ -63,13 +63,17 @@ atexit.register(_reap_all)
 class ProcCluster:
     def __init__(self, store_dir: str, n_osds: int = 3, n_mons: int = 1,
                  store_kind: str = "wal", heartbeat_interval: float = 2.0,
-                 log_dir: str | None = None):
+                 log_dir: str | None = None,
+                 osd_config: "dict | None" = None):
         self.store_dir = store_dir
         self.n_osds = n_osds
         self.n_mons = n_mons
         self.store_kind = store_kind
         self.heartbeat_interval = heartbeat_interval
         self.log_dir = log_dir  # per-daemon log files (None = discard)
+        # per-OSD config overrides forwarded as --config key=val (the
+        # MiniCluster config_overrides analog for real processes)
+        self.osd_config = dict(osd_config or {})
         self.monmap = [f"127.0.0.1:{_free_port()}" for _ in range(n_mons)]
         self.mon_procs: dict[int, subprocess.Popen] = {}
         self.osd_procs: dict[int, subprocess.Popen] = {}
@@ -118,12 +122,16 @@ class ProcCluster:
         ])
 
     def spawn_osd(self, osd_id: int) -> None:
+        cfg_args = []
+        for k, v in self.osd_config.items():
+            cfg_args += ["--config", f"{k}={v}"]
         self.osd_procs[osd_id] = self._spawn([
             "osd", "--id", str(osd_id),
             "--monmap", ",".join(self.monmap),
             "--store", os.path.join(self.store_dir, f"osd.{osd_id}"),
             "--store-kind", self.store_kind,
             "--heartbeat-interval", str(self.heartbeat_interval),
+            *cfg_args,
         ])
 
     async def start(self) -> None:

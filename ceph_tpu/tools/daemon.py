@@ -101,6 +101,24 @@ async def _run_osd(args) -> None:
 
     store = _make_store(args.store, args.store_kind)
     monmap = args.monmap.split(",")
+    config = None
+    if getattr(args, "config", None):
+        # generic option overrides (--config key=val, repeatable): the
+        # multiprocess harness needs per-daemon knobs (waterfall
+        # sampling, injection hooks) exactly like MiniCluster's
+        # config_overrides — Config coerces through the option table,
+        # so a typo'd key or bad value fails loudly at boot
+        from ..common import Config
+
+        overrides = {}
+        for kv in args.config:
+            if "=" not in kv:
+                raise SystemExit(
+                    f"--config expects KEY=VAL, got {kv!r}"
+                )
+            k, v = kv.split("=", 1)
+            overrides[k] = v
+        config = Config(overrides=overrides)
     osd = OSD(
         args.id, monmap if len(monmap) > 1 else monmap[0],
         store=store, heartbeat_interval=args.heartbeat_interval,
@@ -108,6 +126,7 @@ async def _run_osd(args) -> None:
         # interpreters can delay a ping by a full interval without the
         # peer being dead
         heartbeat_grace=max(3.0, args.heartbeat_interval * 4),
+        config=config,
     )
     # a real process: suicide must end the PROCESS even when a wedged
     # non-daemon executor thread would block normal interpreter exit
@@ -183,6 +202,11 @@ def main(argv=None) -> int:
     po.add_argument("--store", required=True)
     po.add_argument("--store-kind", default="wal", choices=["wal", "blue"])
     po.add_argument("--heartbeat-interval", type=float, default=1.0)
+    po.add_argument("--config", action="append", default=[],
+                    metavar="KEY=VAL",
+                    help="daemon config override (repeatable; coerced "
+                         "through the option table, bad keys fail at "
+                         "boot)")
     pa = sub.add_parser("accel")
     pa.add_argument("--id", type=int, required=True)
     pa.add_argument("--addr", required=True, help="host:port to bind")

@@ -164,4 +164,10 @@ class TestRepoIsClean:
                          # and the fleet subsystem (ISSUE 11) extends
                          # it across accelerators
                          "client.py", "daemon.py",
-                         "accelmap.py", "router.py"}
+                         "accelmap.py", "router.py",
+                         # the op-waterfall paths (ISSUE 12): the
+                         # messenger boundary carries the span/clock
+                         # machinery — a swallow there eats the
+                         # reset/decode signal resend depends on
+                         "message.py", "messenger.py", "tracing.py",
+                         "clocksync.py", "stack_ledger.py"}

@@ -70,7 +70,8 @@ METRIC_ALIASES = {"stack_e2e_gbps": "stack_e2e.stack_e2e_gbps",
                   "mesh_scaling_efficiency": "mesh.scaling_efficiency",
                   "mesh_ici_share": "mesh.ici_share",
                   "accel_occupancy": "accel.occupancy",
-                  "accel_fleet_occupancy": "accel.fleet_occupancy"}
+                  "accel_fleet_occupancy": "accel.fleet_occupancy",
+                  "smallops_header_share": "smallops.header_share"}
 
 # per-metric default thresholds (used when --threshold is not given):
 # mesh.scaling_efficiency is a RATIO (per-chip efficiency of the
@@ -89,10 +90,19 @@ METRIC_ALIASES = {"stack_e2e_gbps": "stack_e2e.stack_e2e_gbps",
 # kill — the fleet-balancing analog of accel.occupancy, same ratio
 # semantics, same 20% budget, same clean skip until two rounds carry
 # the fleet record.
+# smallops.header_share (ISSUE 12) is the measured JSON-header
+# encode/decode share of small-op wall time (the cost ledger riding
+# the smallops waterfall capture) — LOWER_IS_BETTER with the additive
+# share slack, same shape as mesh.ici_share: a change that grows the
+# header tax must fail even when GB/s barely moves, and the round that
+# lands ROADMAP item 1's binary header should show up as a step DOWN.
+# Rounds predating the capture lack the metric -> clean skip until two
+# rounds carry it.
 METRIC_DEFAULT_THRESHOLDS = {"mesh.scaling_efficiency": 0.8,
                              "mesh.ici_share": 0.8,
                              "accel.occupancy": 0.8,
-                             "accel.fleet_occupancy": 0.8}
+                             "accel.fleet_occupancy": 0.8,
+                             "smallops.header_share": 0.8}
 
 # metrics where GROWTH is the regression: mesh.ici_share (ISSUE 9) is
 # the ICI all-gather's share of the mesh reconstruct's device time,
@@ -102,7 +112,7 @@ METRIC_DEFAULT_THRESHOLDS = {"mesh.scaling_efficiency": 0.8,
 # 0.1-share slack (shares are small ratios: best-prior 0.0 must not
 # make a 2-percentage-point wobble fatal): ratio =
 # (best + 0.1) / (current + 0.1), regression when ratio < threshold.
-LOWER_IS_BETTER = {"mesh.ici_share"}
+LOWER_IS_BETTER = {"mesh.ici_share", "smallops.header_share"}
 _SHARE_SLACK = 0.1
 
 

@@ -63,6 +63,16 @@ HOT_PATHS = (
     "ceph_tpu/accel/daemon.py",
     "ceph_tpu/accel/accelmap.py",
     "ceph_tpu/accel/router.py",
+    # the op-waterfall paths (ISSUE 12): the messenger boundary now
+    # carries the span/clock machinery, and a swallowed error there
+    # would eat exactly the reset/decode signal the client's
+    # retarget-and-resend path depends on — every remaining swallow
+    # is annotated with why it is safe
+    "ceph_tpu/msg/message.py",
+    "ceph_tpu/msg/messenger.py",
+    "ceph_tpu/common/tracing.py",
+    "ceph_tpu/common/clocksync.py",
+    "ceph_tpu/common/stack_ledger.py",
 )
 
 ANNOTATION = "# swallow-ok:"
