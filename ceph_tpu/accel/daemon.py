@@ -208,6 +208,13 @@ class AccelDaemon(Dispatcher):
                 self.dispatch, "inject_engine_failure", int(v))),
             ("ec_inject_launch_hang", lambda _n, v: setattr(
                 self.dispatch, "inject_launch_hang", float(v))),
+            # binary wire protocol PR: the accel serves MANY client
+            # OSDs over one messenger — the ack-batch bound must tune
+            # live here exactly like on the OSD (its encode replies
+            # carry blobs and stay vectored; beacons and piggybacked
+            # health acks are the coalescible traffic)
+            ("ms_reply_coalesce_max", lambda _n, v: setattr(
+                self.messenger, "reply_coalesce_max", int(v))),
         ]
         for opt, cb in self._observers:
             cfg.observe(opt, cb)

@@ -141,6 +141,18 @@ def register_common(asok: "AdminSocket", *, perf=None, config=None) -> None:
                   "jit-cache hits/misses, batch shapes per engine "
                   "(optional {'top': N, 'engine': <family prefix>})")
 
+    def _dump_frame_slab(req: dict) -> dict:
+        # the frame scratch pool (common/slab.py, binary wire
+        # protocol): hit/miss totals + per-class free-list occupancy —
+        # the operator view behind stack.slab_hits/misses/bytes_held
+        from .slab import frame_slab
+
+        return frame_slab().stats()
+
+    asok.register("dump_frame_slab", _dump_frame_slab,
+                  "frame scratch slab pool: hits/misses, bytes held, "
+                  "per-size-class free-list occupancy")
+
     # -- device trace windows (ceph_tpu.ops.device_trace, ROADMAP 5a):
     # one process-wide jax.profiler window at a time, served from every
     # daemon's socket.  start/stop/dump run in an executor — start_trace

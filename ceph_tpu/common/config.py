@@ -82,6 +82,16 @@ OPTIONS: dict[str, Option] = _opts(
            "operations, mid-frame when sending (0 = off; the "
            "reference's ms_inject_socket_failures, "
            "config_opts.h:209)"),
+    Option("ms_reply_coalesce_max", int, 16,
+           "coalesced-ack bound: the messenger writer loop packs up "
+           "to this many consecutive READY blob-free acks (COALESCE "
+           "message classes: op/sub-op/rep-op replies) to one peer "
+           "into a single batch frame — one binary header + crc + "
+           "syscall amortized over N acks.  Flush-on-idle: an empty "
+           "send queue ships immediately, so coalescing amortizes "
+           "bursts without ever delaying a lone ack (the EC "
+           "dispatcher's adaptive-window discipline applied to "
+           "replies).  <=1 disables (live via observer)"),
     Option("ms_clock_sync_interval", float, 5.0,
            "per-peer monotonic clock-offset re-estimation period (s): "
            "the messenger runs an NTP-style MClockSync exchange at "

@@ -214,6 +214,19 @@ class PerfCounters:
                 raise TypeError(f"{key} is not a counter")
             self._vals[key] += by
 
+    def inc_pair(self, key_a: str, by_a, key_b: str, by_b) -> None:
+        """Two counter incs under ONE lock round trip — the per-frame
+        ledger feed (stack_ledger) pays this on every message, and two
+        separate acquisitions measurably widen the small-op path on
+        slow hosts."""
+        with self._lock:
+            types = self._types
+            if types[key_a] != COUNTER or types[key_b] != COUNTER:
+                raise TypeError(f"{key_a}/{key_b}: not counters")
+            vals = self._vals
+            vals[key_a] += by_a
+            vals[key_b] += by_b
+
     def set(self, key: str, value) -> None:
         with self._lock:
             if self._types[key] != GAUGE:

@@ -6,13 +6,27 @@ can prove (or refute, or later *gate*) that claim:
 
 - **ledger counters**, fed by the messenger boundary on every frame:
   ``header_encode_s`` / ``header_decode_s`` (seconds spent purely on
-  the header: json.dumps/loads + type routing, never the
-  payload-proportional crc), ``frames_encoded`` / ``frames_decoded``,
-  and ``frame_allocs`` — discrete allocation events on the frame path
-  (header bytes, crc trailer, the sub-KiB control-frame join, the
-  decode-side header copy).  ``header_share`` in bench.py's smallops
-  waterfall is ``(header_encode_s + header_decode_s) / Σ op wall`` —
-  the acceptance baseline for the binary-header PR.
+  the header: struct pack/unpack + field-tail codec + type routing,
+  never the payload-proportional crc), ``frames_encoded`` /
+  ``frames_decoded``, and ``frame_allocs`` — discrete frame-BUFFER
+  allocation events on the frame path.  Re-baselined by the
+  binary-header PR: the JSON era counted header bytes + crc pack +
+  control-frame join + the decode header copy (~3 per frame); all
+  four are gone — headers now pack into slab-recycled scratch
+  (common/slab.py) and decode as struct slices of the receive view —
+  so the only remaining alloc events are slab-pool **misses**
+  (cold pool / oversize tails).  Steady state is allocation-free:
+  ``frame_allocs`` goes FLAT while ``slab_hits`` grows (pinned by
+  tests/test_wire_protocol.py on a live cluster).  ``header_share``
+  in bench.py's smallops waterfall is ``(header_encode_s +
+  header_decode_s) / Σ op wall`` — ~6.6% measured at PR 12 with the
+  JSON envelope, the baseline the binary header is gated against.
+
+- **slab pool counters** (``slab_hits`` / ``slab_misses`` /
+  ``slab_bytes_held``), fed by common/slab.py: recycling proof for
+  the frame scratch pool — hits are allocation-free frame encodes,
+  misses are real allocations (also counted in ``frame_allocs``),
+  the gauge is bytes parked in the bounded free lists.
 
 - **per-hop latency histograms** ``lat_<hop>``, fed by the OSD for
   1-in-``osd_op_trace_sample_every`` client ops (the sampled
@@ -66,20 +80,31 @@ def stack_perf():
                 (pc
                  .add_counter("header_encode_s",
                               "seconds spent encoding frame headers "
-                              "(json.dumps + assembly; crc excluded)")
+                              "(struct pack + field tail; crc "
+                              "excluded)")
                  .add_counter("header_decode_s",
                               "seconds spent decoding frame headers "
-                              "(json.loads + type routing; crc "
-                              "excluded)")
+                              "(struct unpack + field tail + type "
+                              "routing; crc excluded)")
                  .add_counter("frames_encoded",
                               "frames whose header encode was timed")
                  .add_counter("frames_decoded",
                               "frames whose header decode was timed")
                  .add_counter("frame_allocs",
-                              "discrete allocation events on the "
-                              "frame path (header bytes, crc "
-                              "trailer, control-frame join, decode "
-                              "header copy)")
+                              "frame-buffer allocation events on the "
+                              "frame path — slab-pool misses and "
+                              "oversize scratch; flat in steady "
+                              "state (the JSON-era header/crc/join/"
+                              "decode-copy allocs are retired)")
+                 .add_counter("slab_hits",
+                              "frame scratch served from the slab "
+                              "free lists (allocation-free encodes)")
+                 .add_counter("slab_misses",
+                              "slab checkouts that had to allocate "
+                              "(cold pool or oversize request)")
+                 .add_gauge("slab_bytes_held",
+                            "bytes parked in the slab pool's bounded "
+                            "free lists")
                  .add_counter("sampled_ops",
                               "client ops that got full waterfall "
                               "spans (1-in-osd_op_trace_sample_every)"))
@@ -143,8 +168,7 @@ def stack_perf():
 def note_header_encode(seconds: float, allocs: int = 0) -> None:
     """One frame header encoded (msg/message.py boundary)."""
     pc = stack_perf()
-    pc.inc("header_encode_s", seconds)
-    pc.inc("frames_encoded")
+    pc.inc_pair("header_encode_s", seconds, "frames_encoded", 1)
     if allocs:
         pc.inc("frame_allocs", allocs)
 
@@ -152,16 +176,38 @@ def note_header_encode(seconds: float, allocs: int = 0) -> None:
 def note_header_decode(seconds: float, allocs: int = 0) -> None:
     """One frame header decoded (msg/message.py boundary)."""
     pc = stack_perf()
-    pc.inc("header_decode_s", seconds)
-    pc.inc("frames_decoded")
+    pc.inc_pair("header_decode_s", seconds, "frames_decoded", 1)
     if allocs:
         pc.inc("frame_allocs", allocs)
 
 
 def note_frame_alloc(n: int = 1) -> None:
-    """A frame-path allocation outside the header timers (the
-    messenger's control-frame join)."""
+    """A frame-buffer allocation outside the slab accounting (rare:
+    paths that bypass the pool entirely)."""
     stack_perf().inc("frame_allocs", n)
+
+
+def note_slab_hit(n: int = 1) -> None:
+    """Pooled slab checkouts (allocation-free frame encodes), flushed
+    in batches from the pool's plain-int tally — the checkout hot path
+    sits inside the timed header-encode window and pays no perf-
+    counter lock; releases/misses/stats flush the delta."""
+    stack_perf().inc("slab_hits", n)
+
+
+def note_slab_miss(held_bytes: int) -> None:
+    """One slab checkout that had to allocate — a real frame-path
+    allocation, ALSO counted into ``frame_allocs`` (the
+    flat-in-steady-state pin)."""
+    pc = stack_perf()
+    pc.inc("slab_misses")
+    pc.inc("frame_allocs")
+    pc.set("slab_bytes_held", held_bytes)
+
+
+def note_slab_held(held_bytes: int) -> None:
+    """Free-list byte gauge refresh on a slab release."""
+    stack_perf().set("slab_bytes_held", held_bytes)
 
 
 def feed_hop(hop: str, seconds: float) -> None:

@@ -9,7 +9,14 @@ the host NIC for control traffic, while bulk shard math rides the device
 mesh (ICI collectives, see ceph_tpu.parallel.distributed).
 """
 
-from .message import Message, decode_frame, encode_frame, register
+from .message import (
+    Message,
+    decode_frame,
+    decode_frame_msgs,
+    encode_frame,
+    encode_frame_segments,
+    register,
+)
 from . import messages
 from .messenger import AsyncMessenger, Connection, Dispatcher
 
@@ -17,7 +24,9 @@ __all__ = [
     "Message",
     "messages",
     "encode_frame",
+    "encode_frame_segments",
     "decode_frame",
+    "decode_frame_msgs",
     "register",
     "AsyncMessenger",
     "Connection",

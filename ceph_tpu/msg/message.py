@@ -1,59 +1,147 @@
-"""Message envelope + wire framing.
+"""Message envelope + binary wire framing.
 
-The reference gives every message a typed header, a JSON-able midsection and
-raw data segments, each crc32c-protected (reference:src/msg/Message.h,
-crc flags reference:src/msg/Messenger.cc:51-64).  The frame here:
+The reference gives every message a **fixed-layout** typed header whose
+decode is a pointer cast, not a parse (``ceph_msg_header``,
+reference:src/include/msgr.h), a midsection and raw data segments, each
+crc32c-protected (reference:src/msg/Message.h).  The frame here (all
+integers little-endian; the writer prepends a 4-byte big-endian length
+like before):
 
-    [4B magic "CTPU"] [4B header_len BE] [header JSON] [blobs...] [4B crc BE]
+    offset  size  field
+    0       4     magic  b"CTPB"
+    4       2     type_id   (stable integer id, msg/wire_manifest.json)
+    6       2     flags     (1 TRACED | 2 TAIL_BIN | 4 TAIL_JSON | 8 BATCH)
+    8       8     seq       (per-connection send sequence)
+    16      8     sent      (sender monotonic clock, f64; 0.0 untraced)
+    24      2     blob_count  (sub-message count for BATCH frames)
+    26      2     trace_len
+    28      4     tail_len
+    32      ...   blob lengths   (blob_count x u32)
+    ...           trace id bytes (utf-8, trace_len)
+    ...           field tail     (tail_len; see below)
+    ...           blobs          (borrowed views, never joined)
+    last 4        crc32c         (chained over everything above)
 
-Header = ``{"type", "seq", "fields", "blob_lens"}``; ``fields`` is the
-JSON-able message body, ``blobs`` carry bulk bytes (chunk data) untouched
-by JSON.  crc32c (same polynomial as the reference, via the native lib)
-covers header+blobs.
+``fields`` ride the **tail**: ``marshal`` (C-speed, version-2 format —
+frozen since CPython 2.4; both ends of every connection run the same
+interpreter, and frames are crc-checked + cephx-authenticated like the
+reference's peer-encoded structs) for data-path types, or JSON for the
+few admin/auth types that opt in via ``WIRE_TAIL = "json"`` (operator
+payloads stay greppable in a pcap; cold path — the check_wire gate
+bans JSON from everything else).  ``None`` fields are omitted; a
+message with no non-None fields has no tail at all.
+
+Header + blob-length array + trace + tail + crc all pack into ONE
+slab-recycled scratch block (common/slab.py) with ``pack_into``/slice
+assignment — steady-state frame encode allocates nothing
+(``stack.frame_allocs`` flat, ``stack.slab_hits`` growing).  Frames
+<= :data:`SMALL_FRAME_MAX` additionally gather their blobs into the
+same block (the old messenger control-frame join, now pool-backed):
+heartbeats/acks cost one segment, one write, zero allocations.
+
+**Batch frames** (flags BATCH) carry N blob-free sub-messages under one
+header+crc — the coalesced-ack path: the OSD writer loop packs
+consecutive ready ``MOSDOpReply``-class acks (``COALESCE`` subclasses)
+into one frame, one syscall.  Each sub-entry is
+``[u16 type_id][u16 flags][u16 trace_len][u32 tail_len][trace][tail]``;
+``blob_count`` holds the sub-message count.
 
 Zero-copy contract (the bufferlist discipline, reference:src/include/
 buffer.h): blobs are **borrowed views**, never copied —
 
 - outbound, :func:`encode_frame_segments` returns the frame as a
-  segment list (header bytes + the caller's blob views + crc trailer)
-  for a vectored send; the crc chains across segments, so nothing is
-  joined.  The caller must not mutate a blob between ``send()`` and the
-  socket drain (our senders pass immutable receive views or
-  freshly-encoded shard buffers; a mutation would surface as a crc drop
-  on the peer, i.e. a reconnect, never silent corruption).
+  segment list (slab header block + the caller's blob views + the crc
+  tail of the same slab block) for a vectored send; the crc chains
+  across segments, so nothing is joined.  The caller must not mutate a
+  blob between ``send()`` and the socket drain (a violation surfaces
+  as a crc drop on the peer — a reconnect, never silent corruption).
 - inbound, :func:`decode_frame` hands out ``memoryview`` slices of the
-  one receive buffer (the views keep it alive); ``bytes()`` happens
-  only where a caller truly needs an independent copy.
+  one receive buffer (the views keep it alive) and parses the header
+  as struct slices of that view — no byte of the frame is copied
+  anywhere on the decode path (the JSON era's header copy is retired;
+  tools/check_copies.py enforces it).
 """
 
 from __future__ import annotations
 
 import json
+import marshal
 import struct
 import time
 from typing import Any, Type
 
 import numpy as np
 
+from ..common.slab import frame_slab
 from ..common.stack_ledger import note_header_decode, note_header_encode
 from ..utils import native
 from ..utils.buffers import BufferList, note_copy
 
-MAGIC = b"CTPU"
+MAGIC = b"CTPB"
 CRC_SEED = 0xFFFFFFFF
 
-_REGISTRY: dict[str, Type["Message"]] = {}
+# frames at or under this total gather into one slab block and ship as
+# a single segment: acks/heartbeats are the message COUNT, and for
+# them vectored bookkeeping costs more than one bounded sub-KiB copy
+# into pooled memory (payload frames stay on the view path)
+SMALL_FRAME_MAX = 1024
+
+FLAG_TRACED = 0x1
+FLAG_TAIL_BIN = 0x2
+FLAG_TAIL_JSON = 0x4
+FLAG_BATCH = 0x8
+
+# magic, type_id, flags, seq, sent, blob_count, trace_len, tail_len
+_FIXED = struct.Struct("<4sHHQdHHI")
+# batch sub-entry: type_id, flags, trace_len, tail_len
+_SUB = struct.Struct("<HHHI")
+_CRC = struct.Struct("<I")
+# the marshal wire format version (2 = the portable, frozen layout)
+_MARSHAL_VER = 2
+
+# the reserved pseudo-type of coalesced multi-message frames; never a
+# Message subclass id (check_wire refuses it in the manifest)
+TYPE_ID_BATCH = 1
+
+_REGISTRY: dict[int, Type["Message"]] = {}
+_BY_NAME: dict[str, Type["Message"]] = {}
+
+# per-blob-count length-array structs, built once (an f-string format
+# per frame would re-parse in struct's cache path)
+_LENS: dict[int, struct.Struct] = {}
+
+
+def _lens_struct(n: int) -> struct.Struct:
+    s = _LENS.get(n)
+    if s is None:
+        s = _LENS[n] = struct.Struct(f"<{n}I")
+    return s
 
 
 def register(cls: Type["Message"]) -> Type["Message"]:
-    """Class decorator: route frames of ``cls.TYPE`` to ``cls`` on decode
-    (the role of the reference's decode_message type switch,
-    reference:src/msg/Message.cc)."""
+    """Class decorator: route frames of ``cls.TYPE_ID`` to ``cls`` on
+    decode (the role of the reference's decode_message type switch,
+    reference:src/msg/Message.cc).  Ids are STABLE wire protocol —
+    tools/check_wire.py pins them against msg/wire_manifest.json."""
     if not cls.TYPE:
         raise ValueError(f"{cls.__name__} has no TYPE")
-    if cls.TYPE in _REGISTRY:
+    tid = cls.TYPE_ID
+    if not isinstance(tid, int) or not (0 < tid < 0x10000):
+        raise ValueError(f"{cls.__name__} has no valid TYPE_ID ({tid!r})")
+    if tid == TYPE_ID_BATCH:
+        raise ValueError(f"{cls.__name__}: TYPE_ID {tid} is reserved "
+                         f"for batch frames")
+    if tid in _REGISTRY:
+        raise ValueError(
+            f"duplicate TYPE_ID {tid} ({cls.__name__} vs "
+            f"{_REGISTRY[tid].__name__})"
+        )
+    if cls.TYPE in _BY_NAME:
         raise ValueError(f"duplicate message type {cls.TYPE!r}")
-    _REGISTRY[cls.TYPE] = cls
+    if cls.WIRE_TAIL not in ("bin", "json"):
+        raise ValueError(f"{cls.__name__}: bad WIRE_TAIL {cls.WIRE_TAIL!r}")
+    _REGISTRY[tid] = cls
+    _BY_NAME[cls.TYPE] = cls
     return cls
 
 
@@ -66,9 +154,10 @@ def _blob_len(b) -> int:
 
 
 class Message:
-    """Base message: subclasses set TYPE and FIELDS (json-able attribute
-    names); bulk bytes go in ``blobs`` (bytes-like VIEWS — bytes,
-    bytearray, memoryview, uint8 ndarray, or BufferList — held
+    """Base message: subclasses set TYPE (readable name), TYPE_ID (the
+    stable wire id) and FIELDS (attribute names; values must be
+    marshal/json-able); bulk bytes go in ``blobs`` (bytes-like VIEWS —
+    bytes, bytearray, memoryview, uint8 ndarray, or BufferList — held
     borrowed, not copied; see the module zero-copy contract).
 
     ``trace`` is the envelope-level trace id (the reference header's
@@ -76,10 +165,45 @@ class Message:
     header on every message type, stamped by the sending connection
     when unset and restored on decode, so one client op's id follows
     its sub-ops and replies across daemons (common/tracing.py).
+
+    ``COALESCE = True`` marks blob-free ack types the messenger writer
+    loop may pack into one batch frame (ms_reply_coalesce_max).
     """
 
     TYPE = ""
+    TYPE_ID = 0
     FIELDS: tuple[str, ...] = ()
+    # field-tail encoding: "bin" (marshal, the data path) or "json"
+    # (admin/auth types only — the check_wire gate allowlists them)
+    WIRE_TAIL = "bin"
+    _TAIL_JSON = False  # derived below; hot-path flag
+    _FIELDS_GET = None  # compiled positional-field accessor
+    _FIELDS_SINGLE = False
+    _PLAIN_BUILD = True
+    COALESCE = False
+
+    def __init_subclass__(cls, **kw: Any):
+        super().__init_subclass__(**kw)
+        cls._TAIL_JSON = cls.WIRE_TAIL == "json"  # flag, not str cmp
+        # compiled field access: one C attrgetter call pulls the whole
+        # positional tail (the bin tail is the FIELDS tuple in
+        # declaration order — no key strings on the wire, no per-field
+        # getattr)
+        if cls.FIELDS:
+            import operator
+
+            cls._FIELDS_GET = operator.attrgetter(*cls.FIELDS)
+            cls._FIELDS_SINGLE = len(cls.FIELDS) == 1
+        else:
+            cls._FIELDS_GET = None
+            cls._FIELDS_SINGLE = False
+        # decode fast path allowed only for classes that keep the
+        # stock construction hooks (overridden __init__/from_fields
+        # get the validated slow path)
+        cls._PLAIN_BUILD = (
+            cls.__init__ is Message.__init__
+            and cls.from_fields.__func__ is Message.from_fields.__func__
+        )
 
     def __init__(self, **kw: Any):
         # borrowed views, NOT bytes(b) copies — the pre-zero-copy frame
@@ -138,94 +262,372 @@ def _segments_of(b) -> list:
     ]
 
 
-def encode_frame_segments(msg: Message, seq: int = 0) -> tuple[list, int]:
+def _pack_tail(msg: Message) -> tuple[bytes, int]:
+    """(tail bytes, tail flag) for one message's fields.
+
+    Bin tail = ``marshal`` of the FIELDS VALUES as a positional tuple
+    (declaration order — no key strings on the wire; both ends share
+    the class schema, and a length mismatch decodes as BadFrame).
+    JSON tail (``WIRE_TAIL="json"`` admin/auth types) keeps the named
+    non-None dict, greppable in a pcap.  No fields -> no tail."""
+    if msg._TAIL_JSON:
+        fields = {f: v for f in msg.FIELDS
+                  if (v := getattr(msg, f)) is not None}
+        if not fields:
+            return b"", 0
+        # admin/auth tail only — the data path rides marshal;
+        # tools/check_wire.py enforces the split
+        # wire-ok: JSON tail is the admin/auth opt-in, never the data path
+        return json.dumps(fields, separators=(",", ":")).encode(), \
+            FLAG_TAIL_JSON
+    get = msg._FIELDS_GET
+    if get is None:
+        return b"", 0
+    vals = get(msg)
+    if msg._FIELDS_SINGLE:
+        vals = (vals,)
+    return marshal.dumps(vals, _MARSHAL_VER), FLAG_TAIL_BIN
+
+
+def _build(cls: Type[Message], view: memoryview, flags: int,
+           blobs: list) -> Message:
+    """Construct one message from its tail bytes — every failure mode
+    (undecodable tail, schema mismatch, hostile content) is a
+    :class:`BadFrame`, never a reader-loop crash."""
+    if not view.nbytes:
+        fields: dict = {}
+        vals: tuple = ()
+        if cls.FIELDS:
+            vals = (None,) * len(cls.FIELDS)
+    elif flags & FLAG_TAIL_JSON:
+        try:
+            # wire-ok: admin-tail decode, cold path
+            fields = json.loads(bytes(view))  # copy-ok: admin json tail
+        except ValueError as e:
+            raise BadFrame(f"bad json tail: {e!r}") from e
+        if not isinstance(fields, dict):
+            raise BadFrame(f"json tail is {type(fields).__name__}")
+        try:
+            return cls.from_fields(fields, blobs)
+        except Exception as e:
+            raise BadFrame(f"{cls.__name__}: field mismatch: {e!r}") from e
+    else:
+        try:
+            vals = marshal.loads(view)
+        except (ValueError, EOFError, TypeError) as e:
+            raise BadFrame(f"bad field tail: {e!r}") from e
+        if type(vals) is not tuple or len(vals) != len(cls.FIELDS):
+            raise BadFrame(
+                f"{cls.__name__}: tail arity "
+                f"{len(vals) if type(vals) is tuple else type(vals).__name__}"
+                f" != {len(cls.FIELDS)}"
+            )
+    if cls._PLAIN_BUILD:
+        # stock construction hooks: set the positional fields straight
+        # onto a bare instance (the __init__ kw loop re-validates what
+        # the schema already guarantees)
+        m = cls.__new__(cls)
+        m.blobs = blobs
+        m.trace = None
+        m.sent = None
+        m.recv_ts = None
+        d = m.__dict__
+        for f, v in zip(cls.FIELDS, vals):
+            d[f] = v
+        return m
+    fields = {f: v for f, v in zip(cls.FIELDS, vals) if v is not None}
+    try:
+        return cls.from_fields(fields, blobs)
+    except Exception as e:
+        raise BadFrame(f"{cls.__name__}: field mismatch: {e!r}") from e
+
+
+def encode_frame_segments(msg: Message, seq: int = 0) -> tuple[list, int,
+                                                               Any]:
     """Frame as a segment list for a vectored send: ``(segments,
-    total_bytes)``.  Segment 0 is magic+len+header, the middle segments
-    are the caller's blob views (ZERO copies), the trailer is the crc —
-    chained across segments (ceph_crc32c composes), so the frame is
-    never joined on the send side."""
+    total_bytes, release)``.  Segment 0 is the slab-packed binary
+    header (fixed struct + blob lens + trace + field tail), the middle
+    segments are the caller's blob views (ZERO copies), the trailer is
+    the crc — a 4-byte view of the SAME slab block, chained across
+    segments (ceph_crc32c composes), so the frame is never joined on
+    the send side.  Frames <= SMALL_FRAME_MAX come back as ONE slab
+    segment instead (blobs gathered into the block).
+
+    ``release`` returns the scratch block to the pool — call it once
+    the transport has drained the segments (the messenger writer loop
+    does); dropping it instead just costs the pool a later miss."""
     # the header cost ledger (common/stack_ledger): time the HEADER
-    # work only — dict build + json.dumps + length prefix — never the
+    # work only — tail codec + struct packing — never the
     # payload-proportional crc below.  This is the number ROADMAP item
-    # 1's binary-header PR must beat, measured where it is paid.
+    # 1 gates via bench_regress --metric smallops.header_share.
     _t0 = time.perf_counter()
-    head = {
-        "type": msg.TYPE,
-        "seq": seq,
-        "fields": msg.fields(),
-        "blob_lens": [_blob_len(b) for b in msg.blobs],
-    }
+    flags = 0
+    trace_b = b""
+    sent = 0.0
     if msg.trace is not None:
-        head["trace"] = msg.trace
+        flags |= FLAG_TRACED
+        trace_b = msg.trace.encode()
         # send stamp for the waterfall's wire hop (sender's monotonic
         # clock; the receiver aligns it via clocksync).  It rides
-        # wherever the trace id rides — i.e. EVERY frame the messenger
-        # sends (Connection.send mints a trace when none is set); the
-        # guard matters for direct encode_frame users (tests, compat),
-        # whose untraced frames stay byte-deterministic across encodes
+        # wherever the trace id rides; untraced frames keep sent=0.0
+        # and stay byte-deterministic across encodes
         msg.sent = time.monotonic()
-        head["sent"] = round(msg.sent, 9)
-    header = json.dumps(head, separators=(",", ":")).encode()
-    segs: list = [MAGIC + struct.pack(">I", len(header)) + header]
-    # two allocations on this path: the header bytes and (below) the
-    # crc trailer pack
-    note_header_encode(time.perf_counter() - _t0, allocs=2)
-    crc = native.crc32c(CRC_SEED, header)
-    total = len(segs[0])
+        sent = msg.sent
+    tail, tflag = _pack_tail(msg)
+    flags |= tflag
+    lens: list[int] = []
+    blob_segs: list = []
+    blob_total = 0
     for b in msg.blobs:
-        for s in _segments_of(b):
-            n = len(s)
-            if not n:
-                continue
+        if type(b) is bytes:  # the dominant blob shape: no cast walk
+            n = len(b)
+            lens.append(n)
+            blob_total += n
+            if n:
+                blob_segs.append((b,))
+            else:
+                blob_segs.append(())
+            continue
+        segs_b = [s for s in _segments_of(b) if len(s)]
+        n = sum(len(s) for s in segs_b)
+        lens.append(n)
+        blob_total += n
+        blob_segs.append(segs_b)
+    nblob = len(lens)
+    n_trace = len(trace_b)
+    n_tail = len(tail)
+    head_len = _FIXED.size + 4 * nblob + n_trace + n_tail
+    total = head_len + blob_total + 4
+    small = total <= SMALL_FRAME_MAX
+    slab = frame_slab().checkout(total if small else head_len + 4)
+    buf = slab.data
+    _FIXED.pack_into(buf, 0, MAGIC, msg.TYPE_ID, flags, seq, sent,
+                     nblob, n_trace, n_tail)
+    off = _FIXED.size
+    if nblob:
+        _lens_struct(nblob).pack_into(buf, off, *lens)
+        off += 4 * nblob
+    if n_trace:
+        buf[off:off + n_trace] = trace_b
+        off += n_trace
+    if n_tail:
+        buf[off:off + n_tail] = tail
+        off += n_tail
+    note_header_encode(time.perf_counter() - _t0)
+    if small:
+        # control-frame fast path: gather the (bounded, sub-KiB) blobs
+        # into the same pooled block — one segment, one crc pass, no
+        # allocation (the old messenger-side b"".join, slab-backed)
+        for segs_b in blob_segs:
+            for s in segs_b:
+                n = len(s)
+                buf[off:off + n] = s
+                off += n
+        crc = native.crc32c_view(CRC_SEED, memoryview(buf), off)
+        _CRC.pack_into(buf, off, crc)
+        return [slab.view(total)], total, slab.release
+    crc = native.crc32c_view(CRC_SEED, memoryview(buf), head_len)
+    head_view = slab.view(head_len)
+    segs: list = [head_view]
+    for segs_b in blob_segs:
+        for s in segs_b:
             segs.append(s)
-            total += n
-            crc = native.crc32c(crc, np.frombuffer(s, dtype=np.uint8)
-                                if not isinstance(s, np.ndarray) else s)
-    segs.append(struct.pack(">I", crc))
-    total += 4
-    return segs, total
+            crc = native.crc32c_view(crc, s)
+    _CRC.pack_into(buf, head_len, crc)
+    segs.append(slab.view(4, start=head_len))
+    return segs, total, slab.release
+
+
+def encode_batch_frame(msgs: list[Message], seq: int = 0) -> tuple[
+        list, int, Any]:
+    """N blob-free messages under ONE header+crc (the coalesced-ack
+    frame): ``(segments, total, release)`` — always a single slab
+    segment.  ``seq`` is the first member's sequence number; members
+    occupy seq..seq+N-1 in order.  Callers guarantee every message is
+    blob-free (the writer loop checks COALESCE + not blobs)."""
+    _t0 = time.perf_counter()
+    sent = 0.0
+    parts: list[tuple[int, int, bytes, bytes]] = []
+    entries_len = 0
+    any_traced = False
+    for m in msgs:
+        if m.blobs:
+            raise ValueError(
+                f"{type(m).__name__}: blob-carrying messages cannot "
+                f"ride a batch frame")
+        sflags = 0
+        trace_b = b""
+        if m.trace is not None:
+            sflags |= FLAG_TRACED
+            trace_b = m.trace.encode()
+            any_traced = True
+        tail, tflag = _pack_tail(m)
+        sflags |= tflag
+        parts.append((m.TYPE_ID, sflags, trace_b, tail))
+        entries_len += _SUB.size + len(trace_b) + len(tail)
+    flags = FLAG_BATCH
+    if any_traced:
+        flags |= FLAG_TRACED
+        # one shared send stamp: the members leave the socket together
+        sent = time.monotonic()
+        for m in msgs:
+            if m.trace is not None:
+                m.sent = sent
+    total = _FIXED.size + entries_len + 4
+    slab = frame_slab().checkout(total)
+    buf = slab.data
+    _FIXED.pack_into(buf, 0, MAGIC, TYPE_ID_BATCH, flags, seq, sent,
+                     len(msgs), 0, entries_len)
+    off = _FIXED.size
+    for tid, sflags, trace_b, tail in parts:
+        _SUB.pack_into(buf, off, tid, sflags, len(trace_b), len(tail))
+        off += _SUB.size
+        buf[off:off + len(trace_b)] = trace_b
+        off += len(trace_b)
+        buf[off:off + len(tail)] = tail
+        off += len(tail)
+    note_header_encode(time.perf_counter() - _t0)
+    crc = native.crc32c_view(CRC_SEED, memoryview(buf), off)
+    _CRC.pack_into(buf, off, crc)
+    return [slab.view(total)], total, slab.release
 
 
 def encode_frame(msg: Message, seq: int = 0) -> bytes:
     """Flat-bytes frame (compat/tests; the messenger sends the segment
     list from :func:`encode_frame_segments` without joining)."""
-    segs, total = encode_frame_segments(msg, seq)
+    segs, total, release = encode_frame_segments(msg, seq)
     note_copy("msgr_encode", total)
-    return b"".join(segs)  # copy-ok: compat flat-frame wrapper
+    buf = bytearray(total)
+    off = 0
+    for s in segs:
+        n = len(s)
+        buf[off:off + n] = s
+        off += n
+    release()
+    return bytes(buf)  # copy-ok: compat flat-frame wrapper
 
 
-def decode_frame(frame: bytes | memoryview) -> tuple[Message, int]:
-    """Inverse of :func:`encode_frame`: returns (message, seq).
+def decode_frame_msgs(frame: bytes | bytearray | memoryview) -> tuple[
+        list, int]:
+    """Decode one wire frame into its messages: ``([messages], seq)``
+    — one element for a plain frame, N for a coalesced batch frame
+    (``seq`` is the first member's).
 
-    Blobs come back as ``memoryview`` slices of ``frame`` — zero copies;
-    the views hold the receive buffer alive.  Receive frames are never
-    mutated, so aliasing is safe by construction here."""
-    view = frame if isinstance(frame, memoryview) else memoryview(frame)
-    if view.nbytes < 12 or view[:4] != MAGIC:
-        raise BadFrame("bad magic")
-    (hlen,) = struct.unpack(">I", view[4:8])
-    body = view[8:-4]
-    (crc,) = struct.unpack(">I", view[-4:])
-    want = native.crc32c(CRC_SEED, np.frombuffer(body, dtype=np.uint8))
+    Blobs come back as ``memoryview`` slices of ``frame`` — zero
+    copies; the views hold the receive buffer alive, and the header
+    itself parses as struct slices of the same view (no header copy).
+    Receive frames are never mutated, so aliasing is safe by
+    construction here.  EVERY malformed input — bad magic, bad crc,
+    truncation, unknown type id, lying lengths, undecodable tail —
+    raises :class:`BadFrame`; nothing in here blocks."""
+    if type(frame) is bytes:
+        # the receive path hands bytes: crc the body prefix without
+        # slicing anything (pointer + length, msg/message zero-copy)
+        nbytes = len(frame)
+        if nbytes < _FIXED.size + 4 or frame[:4] != MAGIC:
+            raise BadFrame("bad magic")
+        view = memoryview(frame)
+        want = native.crc32c_view(CRC_SEED, frame, nbytes - 4)
+    else:
+        view = frame if isinstance(frame, memoryview) else memoryview(frame)
+        nbytes = view.nbytes
+        if nbytes < _FIXED.size + 4 or view[:4] != MAGIC:
+            raise BadFrame("bad magic")
+        want = native.crc32c_view(CRC_SEED, view, nbytes - 4)
+    body = view[:-4]
+    (crc,) = _CRC.unpack_from(view, nbytes - 4)
     if crc != want:
         raise BadFrame(f"crc mismatch: got {crc:#x} want {want:#x}")
-    if hlen > body.nbytes:
-        raise BadFrame("truncated header")
-    # header ledger (see encode_frame_segments): the parse + type
-    # routing cost, crc and blob views excluded
+    # header ledger (see encode_frame_segments): struct unpack + tail
+    # codec + type routing, crc and blob views excluded
     _t0 = time.perf_counter()
-    header = json.loads(bytes(body[:hlen]))  # copy-ok: header json only
-    cls = _REGISTRY.get(header["type"])
-    note_header_decode(time.perf_counter() - _t0, allocs=1)
+    try:
+        (_magic, type_id, flags, seq, sent, nblob, trace_len,
+         tail_len) = _FIXED.unpack_from(body, 0)
+    except struct.error as e:
+        raise BadFrame(f"truncated header: {e}") from e
+    if flags & FLAG_BATCH:
+        if type_id != TYPE_ID_BATCH:
+            raise BadFrame(f"batch flag on type id {type_id}")
+        if trace_len or _FIXED.size + tail_len != body.nbytes:
+            raise BadFrame("batch frame length mismatch")
+        msgs: list[Message] = []
+        off = _FIXED.size
+        for _i in range(nblob):  # blob_count = sub-message count
+            try:
+                stid, sflags, strace_len, stail_len = _SUB.unpack_from(
+                    body, off)
+            except struct.error as e:
+                raise BadFrame(f"truncated batch entry: {e}") from e
+            off += _SUB.size
+            if off + strace_len + stail_len > body.nbytes:
+                raise BadFrame("batch entry overruns frame")
+            cls = _REGISTRY.get(stid)
+            if cls is None:
+                raise BadFrame(f"unknown message type id {stid}")
+            trace = None
+            if sflags & FLAG_TRACED:
+                try:
+                    trace = str(body[off:off + strace_len], "utf-8")
+                except UnicodeDecodeError as e:
+                    raise BadFrame(f"bad trace id: {e}") from e
+            off += strace_len
+            m = _build(cls, body[off:off + stail_len], sflags, [])
+            off += stail_len
+            m.trace = trace
+            m.sent = sent if (sflags & FLAG_TRACED) else None
+            msgs.append(m)
+        if off != body.nbytes:
+            raise BadFrame("batch entries do not fill the frame")
+        if not msgs:
+            raise BadFrame("empty batch frame")
+        note_header_decode(time.perf_counter() - _t0)
+        return msgs, seq
+    cls = _REGISTRY.get(type_id)
     if cls is None:
-        raise BadFrame(f"unknown message type {header['type']!r}")
-    blobs, off = [], hlen
-    for n in header["blob_lens"]:
-        blobs.append(body[off : off + n])
+        raise BadFrame(f"unknown message type id {type_id}")
+    off = _FIXED.size
+    lens: tuple[int, ...] = ()
+    if nblob:
+        try:
+            lens = struct.unpack_from(f"<{nblob}I", body, off)
+        except struct.error as e:
+            raise BadFrame(f"truncated blob lens: {e}") from e
+        off += 4 * nblob
+    if off + trace_len + tail_len > body.nbytes:
+        raise BadFrame("truncated header")
+    trace = None
+    if flags & FLAG_TRACED:
+        try:
+            trace = str(body[off:off + trace_len], "utf-8")
+        except UnicodeDecodeError as e:
+            raise BadFrame(f"bad trace id: {e}") from e
+    off += trace_len
+    tail_view = body[off:off + tail_len]
+    off += tail_len
+    blobs = []
+    for n in lens:
+        if off + n > body.nbytes:
+            raise BadFrame("blob length mismatch")
+        blobs.append(body[off:off + n])
         off += n
     if off != body.nbytes:
         raise BadFrame("blob length mismatch")
-    msg = cls.from_fields(header["fields"], blobs)
-    msg.trace = header.get("trace")
-    msg.sent = header.get("sent")
-    return msg, header["seq"]
+    msg = _build(cls, tail_view, flags, blobs)
+    note_header_decode(time.perf_counter() - _t0)
+    msg.trace = trace
+    msg.sent = sent if (flags & FLAG_TRACED) else None
+    return [msg], seq
+
+
+def decode_frame(frame: bytes | bytearray | memoryview) -> tuple[
+        Message, int]:
+    """Single-message inverse of :func:`encode_frame`: ``(message,
+    seq)``.  Batch frames (N coalesced acks) must go through
+    :func:`decode_frame_msgs` — the messenger reader does; this compat
+    form rejects them rather than silently dropping N-1 messages."""
+    msgs, seq = decode_frame_msgs(frame)
+    if len(msgs) != 1:
+        raise BadFrame(f"batch frame ({len(msgs)} messages): use "
+                       f"decode_frame_msgs")
+    return msgs[0], seq

@@ -1,9 +1,15 @@
 """Typed cluster messages (reference:src/messages/ — the ~150 M*.h set,
 narrowed to what the mini-RADOS data/control path uses).
 
-Bulk chunk payloads ride in frame blobs; metadata rides in JSON fields.
-``encode_txn``/``decode_txn`` put a whole shard-local ObjectStore
-Transaction on the wire — the exact role of ``ECSubWrite::transaction``
+Bulk chunk payloads ride in frame blobs; metadata rides in the frame's
+field tail (msg/message.py — marshal for the data path, JSON for the
+``WIRE_TAIL = "json"`` admin/auth types).  Every class declares a
+stable integer ``TYPE_ID`` — WIRE PROTOCOL, pinned against
+msg/wire_manifest.json by tools/check_wire.py: never renumber or reuse
+one (retire ids into the manifest's ``retired`` list instead), append
+new ids to both this file and the manifest.  ``encode_txn``/
+``decode_txn`` put a whole shard-local ObjectStore Transaction on the
+wire — the exact role of ``ECSubWrite::transaction``
 (reference:src/messages/MOSDECSubOpWrite.h, reference:src/osd/ECMsgTypes.h).
 """
 
@@ -123,6 +129,7 @@ class MLog(Message):
     """
 
     TYPE = "log"
+    TYPE_ID = 10
     FIELDS = ("entries",)
 
 
@@ -134,6 +141,7 @@ class MLogSub(Message):
     as MLog messages on the same connection."""
 
     TYPE = "log_sub"
+    TYPE_ID = 11
     FIELDS = ("sub",)
 
 
@@ -145,6 +153,7 @@ class MPing(Message):
     """reference:src/messages/MOSDPing.h (PING)."""
 
     TYPE = "ping"
+    TYPE_ID = 20
     FIELDS = ("stamp", "epoch")
 
 
@@ -153,6 +162,7 @@ class MPingReply(Message):
     """reference:src/messages/MOSDPing.h (PING_REPLY)."""
 
     TYPE = "ping_reply"
+    TYPE_ID = 21
     FIELDS = ("stamp", "epoch")
 
 
@@ -167,6 +177,7 @@ class MClockSync(Message):
     receive, ``t_tx`` at pong send."""
 
     TYPE = "clock_sync"
+    TYPE_ID = 22
     FIELDS = ("t0", "t_rx", "t_tx")
 
 
@@ -179,12 +190,16 @@ class MMonCommand(Message):
     ``cmd`` is a dict like {"prefix": "osd pool create", ...}."""
 
     TYPE = "mon_command"
+    TYPE_ID = 30
+    WIRE_TAIL = "json"  # admin payloads stay pcap-greppable
     FIELDS = ("tid", "cmd")
 
 
 @register
 class MMonCommandReply(Message):
     TYPE = "mon_command_reply"
+    TYPE_ID = 31
+    WIRE_TAIL = "json"  # admin payloads stay pcap-greppable
     FIELDS = ("tid", "code", "status", "out")
 
 
@@ -194,6 +209,7 @@ class MMonGetMap(Message):
     (reference:src/messages/MMonGetOSDMap.h + MMonSubscribe.h)."""
 
     TYPE = "mon_get_map"
+    TYPE_ID = 32
     FIELDS = ("have",)
 
 
@@ -208,6 +224,7 @@ class MOSDMapMsg(Message):
     re-request with MMonGetMap(have=None)."""
 
     TYPE = "osd_map"
+    TYPE_ID = 33
     # committed_epoch: election epoch the map was committed in (set on
     # mon->mon catch-up pushes; recovery orders maps by (epoch, version))
     FIELDS = ("epoch", "osdmap", "committed_epoch", "incrementals")
@@ -218,6 +235,7 @@ class MOSDBoot(Message):
     """OSD announces itself up (reference:src/messages/MOSDBoot.h)."""
 
     TYPE = "osd_boot"
+    TYPE_ID = 34
     FIELDS = ("osd_id", "addr")
 
 
@@ -226,6 +244,7 @@ class MOSDFailure(Message):
     """Failure report to the mon (reference:src/messages/MOSDFailure.h)."""
 
     TYPE = "osd_failure"
+    TYPE_ID = 35
     FIELDS = ("target_osd", "reporter", "epoch")
 
 
@@ -241,6 +260,7 @@ class MMonElection(Message):
     adopted map."""
 
     TYPE = "mon_election"
+    TYPE_ID = 40
     # accepted: the responder's highest ACCEPTED-but-uncommitted proposal
     # {"epoch", "version", "value"} (the Paxos collect/last phase's
     # uncommitted-value carry — reference:src/mon/Paxos.cc handle_last);
@@ -262,6 +282,7 @@ class MMonPaxos(Message):
     still accepted.)"""
 
     TYPE = "mon_paxos"
+    TYPE_ID = 41
     FIELDS = ("op", "epoch", "rank", "version", "value")
 
 
@@ -272,6 +293,7 @@ class MMonLease(Message):
     election."""
 
     TYPE = "mon_lease"
+    TYPE_ID = 42
     FIELDS = ("epoch", "rank", "map_epoch")
 
 
@@ -295,6 +317,7 @@ class MOSDOp(Message):
     """
 
     TYPE = "osd_op"
+    TYPE_ID = 50
     FIELDS = ("tid", "epoch", "pool", "oid", "ops", "snapc", "snapid",
               "stamps")
 
@@ -313,6 +336,8 @@ class MOSDOpReply(Message):
     collector."""
 
     TYPE = "osd_op_reply"
+    TYPE_ID = 51
+    COALESCE = True  # blob-free acks may ride coalesced batch frames
     FIELDS = ("tid", "result", "epoch", "out", "spans")
 
 
@@ -328,6 +353,7 @@ class MOSDECSubOpWrite(Message):
     ``trim_to`` version pairs."""
 
     TYPE = "ec_sub_op_write"
+    TYPE_ID = 60
     FIELDS = ("pgid", "tid", "from_osd", "shard", "txn", "log", "at_version",
               "trim_to", "epoch")
 
@@ -335,6 +361,8 @@ class MOSDECSubOpWrite(Message):
 @register
 class MOSDECSubOpWriteReply(Message):
     TYPE = "ec_sub_op_write_reply"
+    TYPE_ID = 61
+    COALESCE = True  # blob-free acks may ride coalesced batch frames
     FIELDS = ("pgid", "tid", "shard", "result")
 
 
@@ -345,6 +373,7 @@ class MOSDECSubOpRead(Message):
     ``attrs``: also return xattrs."""
 
     TYPE = "ec_sub_op_read"
+    TYPE_ID = 62
     FIELDS = ("pgid", "tid", "shard", "reads", "attrs")
 
 
@@ -354,6 +383,7 @@ class MOSDECSubOpReadReply(Message):
     errors inline (reference:src/messages/MOSDECSubOpReadReply.h)."""
 
     TYPE = "ec_sub_op_read_reply"
+    TYPE_ID = 63
     FIELDS = ("pgid", "tid", "shard", "reads", "attrs", "errors")
 
 
@@ -366,12 +396,15 @@ class MOSDRepOp(Message):
     (reference:src/messages/MOSDRepOp.h)."""
 
     TYPE = "rep_op"
+    TYPE_ID = 70
     FIELDS = ("pgid", "tid", "from_osd", "txn", "log", "at_version", "epoch")
 
 
 @register
 class MOSDRepOpReply(Message):
     TYPE = "rep_op_reply"
+    TYPE_ID = 71
+    COALESCE = True  # blob-free acks may ride coalesced batch frames
     FIELDS = ("pgid", "tid", "from_osd", "result")
 
 
@@ -385,6 +418,7 @@ class MOSDScrub(Message):
     MOSDScrub.h; engine analog reference:src/osd/ECBackend.cc:2313)."""
 
     TYPE = "osd_scrub"
+    TYPE_ID = 80
     FIELDS = ("tid", "pgid", "repair")
 
 
@@ -393,6 +427,7 @@ class MOSDScrubReply(Message):
     """``report`` = {"pg", "objects", "errors": [...], "repaired", "clean"}."""
 
     TYPE = "osd_scrub_reply"
+    TYPE_ID = 81
     FIELDS = ("tid", "result", "report")
 
 
@@ -402,12 +437,14 @@ class MPGLs(Message):
     `rados ls`, reference:src/osd/PrimaryLogPG.cc do_pg_op PGLS)."""
 
     TYPE = "pg_ls"
+    TYPE_ID = 82
     FIELDS = ("tid", "pgid")
 
 
 @register
 class MPGLsReply(Message):
     TYPE = "pg_ls_reply"
+    TYPE_ID = 83
     FIELDS = ("tid", "result", "names")
 
 
@@ -418,6 +455,7 @@ class MPGStats(Message):
     ``perf`` = the daemon's counter dump, ``store`` = usage totals."""
 
     TYPE = "pg_stats"
+    TYPE_ID = 84
     FIELDS = ("osd", "epoch", "pgs", "perf", "store")
 
 
@@ -430,6 +468,7 @@ class MDaemonStats(Message):
     every series with a daemon label."""
 
     TYPE = "daemon_stats"
+    TYPE_ID = 85
     FIELDS = ("name", "perf")
 
 
@@ -439,6 +478,8 @@ class MAuth(Message):
     op = "get_nonce" | "authenticate" (with entity + proof)."""
 
     TYPE = "auth"
+    TYPE_ID = 90
+    WIRE_TAIL = "json"  # admin payloads stay pcap-greppable
     FIELDS = ("tid", "op", "entity", "proof")
 
 
@@ -449,6 +490,8 @@ class MAuthReply(Message):
     secret (CephxServiceTicket secret analog — see auth.seal_skey)."""
 
     TYPE = "auth_reply"
+    TYPE_ID = 91
+    WIRE_TAIL = "json"  # admin payloads stay pcap-greppable
     FIELDS = ("tid", "result", "nonce", "ticket", "skey")
 
 
@@ -458,6 +501,7 @@ class MClientRequest(Message):
     MClientRequest.h).  ``op`` names the call, ``args`` its parameters."""
 
     TYPE = "client_request"
+    TYPE_ID = 100
     FIELDS = ("tid", "op", "args")
 
 
@@ -466,6 +510,7 @@ class MClientReply(Message):
     """reference:src/messages/MClientReply.h."""
 
     TYPE = "client_reply"
+    TYPE_ID = 101
     FIELDS = ("tid", "result", "out")
 
 
@@ -475,6 +520,7 @@ class MWatchNotify(Message):
     (reference:src/messages/MWatchNotify.h).  Payload in blobs[0]."""
 
     TYPE = "watch_notify"
+    TYPE_ID = 110
     FIELDS = ("notify_id", "cookie", "oid", "notifier")
 
 
@@ -484,6 +530,8 @@ class MWatchNotifyAck(Message):
     in blobs[0] (reference ack path via CEPH_OSD_OP_NOTIFY_ACK)."""
 
     TYPE = "watch_notify_ack"
+    TYPE_ID = 111
+    COALESCE = True  # blob-free acks may ride coalesced batch frames
     FIELDS = ("notify_id", "cookie")
 
 
@@ -505,6 +553,7 @@ class MAccelEncode(Message):
     frame header like every message."""
 
     TYPE = "accel_encode"
+    TYPE_ID = 120
     FIELDS = ("tid", "profile", "stripe_width", "chunk_size", "stripes",
               "klass")
 
@@ -518,6 +567,7 @@ class MAccelDecode(Message):
     (op0's shards, then op1's, ...)."""
 
     TYPE = "accel_decode"
+    TYPE_ID = 121
     FIELDS = ("tid", "profile", "stripe_width", "chunk_size", "stripes",
               "present", "klass")
 
@@ -540,6 +590,7 @@ class MAccelReply(Message):
     OSD's flight recorder and the op waterfall's accel hops."""
 
     TYPE = "accel_reply"
+    TYPE_ID = 122
     FIELDS = ("tid", "result", "error", "shards", "engine_state",
               "queue_depth", "capacity", "served", "device_wall_s",
               "queue_wait_s")
@@ -553,6 +604,7 @@ class MAccelBeacon(Message):
     route back when a healthy beacon arrives."""
 
     TYPE = "accel_beacon"
+    TYPE_ID = 123
     FIELDS = ("name", "engine_state", "queue_depth", "capacity")
 
 
@@ -567,6 +619,7 @@ class MAccelBoot(Message):
     forwards either form to the leader like every map mutation."""
 
     TYPE = "accel_boot"
+    TYPE_ID = 124
     FIELDS = ("name", "addr", "locality", "capacity", "down")
 
 
@@ -583,6 +636,7 @@ class MOSDPGScan(Message):
     collection to scan (-1 = replicated whole-PG collection)."""
 
     TYPE = "pg_scan"
+    TYPE_ID = 130
     FIELDS = ("pgid", "tid", "shard", "store_shard", "from_osd")
 
 
@@ -595,6 +649,7 @@ class MOSDPGScanReply(Message):
     recorded past acting-set intervals (PastIntervals.to_json lists)."""
 
     TYPE = "pg_scan_reply"
+    TYPE_ID = 131
     FIELDS = ("pgid", "tid", "shard", "objects", "log", "info", "intervals")
 
 
@@ -605,12 +660,14 @@ class MOSDPGPush(Message):
     {k: blobidx}, "version": v}]."""
 
     TYPE = "pg_push"
+    TYPE_ID = 132
     FIELDS = ("pgid", "tid", "from_osd", "pushes")
 
 
 @register
 class MOSDPGPushReply(Message):
     TYPE = "pg_push_reply"
+    TYPE_ID = 133
     FIELDS = ("pgid", "tid", "from_osd", "results")
 
 
@@ -623,4 +680,5 @@ class MRecoveryReserve(Message):
     remote slots (reference:src/osd/OSD.h remote_reserver)."""
 
     TYPE = "recovery_reserve"
+    TYPE_ID = 134
     FIELDS = ("pgid", "tid", "from_osd", "op", "prio")

@@ -22,9 +22,14 @@ import time
 from typing import Optional
 
 from ..common.clocksync import ClockTable, clock_table
-from ..common.stack_ledger import note_frame_alloc
 from ..common.tracing import current_trace, new_trace_id
-from .message import BadFrame, Message, decode_frame, encode_frame_segments
+from .message import (
+    BadFrame,
+    Message,
+    decode_frame_msgs,
+    encode_batch_frame,
+    encode_frame_segments,
+)
 
 _LEN = struct.Struct(">I")
 logger = logging.getLogger("ceph_tpu.msg")
@@ -57,10 +62,13 @@ class Connection:
         self.authenticated = True  # False only on a mon awaiting MAuth
         self.auth_entity = ""      # ticket-verified identity (cephx)
         self._send_seq = 0
-        # (total_len, [segments]) — frames queue as VIEW LISTS (header
-        # bytes + caller blob views + crc trailer) and are written
-        # vectored, never joined: the zero-copy send side
-        self._sendq: asyncio.Queue[Optional[tuple]] = asyncio.Queue()
+        # MESSAGES queue here (None = shutdown sentinel); the writer
+        # loop encodes at write time — frames become slab-backed
+        # segment lists (binary header block + caller blob views + crc
+        # trailer), written vectored, never joined, and consecutive
+        # ready COALESCE acks pack into one batch frame
+        # (ms_reply_coalesce_max)
+        self._sendq: asyncio.Queue[Optional[Message]] = asyncio.Queue()
         self._tasks: list[asyncio.Task] = []
         self._closed = False
         # last MClockSync probe sent on this connection (per-conn
@@ -97,38 +105,90 @@ class Connection:
         message crosses): a message without a trace id inherits the
         active context's (so sub-ops and replies carry their client
         op's id), or is minted a fresh origin-stamped one (so a client
-        op starts a trace) — common/tracing.py."""
+        op starts a trace) — common/tracing.py.  Encoding happens in
+        the WRITER loop (so consecutive ready acks can share one batch
+        frame and the slab scratch lives exactly send->drain); the
+        payload blobs ride to the transport as borrowed views
+        (msg/message.py zero-copy contract — the caller must not
+        mutate them until drained; a violation fails the frame crc on
+        the peer, never silently)."""
         if self._closed:
             return
         if msg.trace is None:
             msg.trace = (current_trace.get()
                          or new_trace_id(self.messenger.name))
-        self._send_seq += 1
-        # segment list, not a joined frame: payload blobs ride to the
-        # transport as borrowed views (msg/message.py zero-copy
-        # contract — the caller must not mutate them until drained; a
-        # violation fails the frame crc on the peer, never silently)
-        segs, total = encode_frame_segments(msg, self._send_seq)
-        if total <= 1024:
-            # control-frame fast path: heartbeats/acks/metadata are the
-            # overwhelming message COUNT, and for them the vectored
-            # bookkeeping costs more than one bounded sub-KiB join —
-            # payload frames (the byte volume) stay on the view path
-            segs = [b"".join(segs)]  # copy-ok: bounded <=1KiB control frame
-            note_frame_alloc()  # the join is a frame-path allocation
-        perf = self.messenger.perf
-        perf.inc("msg_send")
-        perf.inc("bytes_send", total)
-        perf.hist("send_bytes_histogram", total)
-        self._sendq.put_nowait((total, segs))
+        self.messenger.perf.inc("msg_send")
+        self._sendq.put_nowait(msg)
+
+    def _coalescible(self, msg: Message) -> bool:
+        """Batch-frame eligible: a COALESCE ack class with no blobs
+        (read replies carry payload views and stay on the vectored
+        path)."""
+        return type(msg).COALESCE and not msg.blobs
 
     async def _writer_loop(self) -> None:
+        # slab release discipline: a frame's scratch block recycles
+        # only once the transport has DRAINED it — drain() returns at
+        # the low-water mark, not empty, so releases whose bytes might
+        # still sit in the transport buffer defer until it empties
+        # (releasing early would let the next frame overwrite bytes
+        # the socket has not sent: silent wire corruption)
+        pending_release: list = []
+        _nothing = object()
+        carry = _nothing
         try:
             while True:
-                item = await self._sendq.get()
+                if carry is not _nothing:
+                    item, carry = carry, _nothing
+                else:
+                    item = await self._sendq.get()
                 if item is None:
                     break
-                total, segs = item
+                perf = self.messenger.perf
+                # coalesced acks (the EC dispatcher's adaptive-window
+                # idea applied to replies): consecutive ALREADY-READY
+                # eligible acks — and only those — pack into one batch
+                # frame, one header+crc+syscall over N.  An empty queue
+                # flushes immediately (zero added latency); a
+                # non-eligible message flushes the run and carries over
+                # (send order is never reordered).
+                batch = None
+                cmax = self.messenger.reply_coalesce_max
+                if cmax > 1 and self._coalescible(item):
+                    batch = [item]
+                    while len(batch) < cmax:
+                        try:
+                            nxt = self._sendq.get_nowait()
+                        # swallow-ok: empty queue IS the flush-on-idle signal
+                        except asyncio.QueueEmpty:
+                            break
+                        if nxt is None or not self._coalescible(nxt):
+                            carry = nxt
+                            break
+                        batch.append(nxt)
+                try:
+                    if batch is not None and len(batch) > 1:
+                        seq0 = self._send_seq + 1
+                        self._send_seq += len(batch)
+                        segs, total, release = encode_batch_frame(
+                            batch, seq0)
+                        perf.inc("send_coalesced", len(batch))
+                        perf.inc("coalesced_frames")
+                    else:
+                        self._send_seq += 1
+                        segs, total, release = encode_frame_segments(
+                            item, self._send_seq)
+                # swallow-ok: logged encode bug aborts THIS conn; peers resend via reset
+                except Exception:
+                    logger.exception(
+                        "%s: frame encode failed for %s to %s",
+                        self.messenger.name, type(item).__name__,
+                        self.peer_name,
+                    )
+                    self._writer.transport.abort()
+                    break
+                perf.inc("bytes_send", total)
+                perf.hist("send_bytes_histogram", total)
                 if self.messenger._inject_failure():
                     # fault injection (ms_inject_socket_failures analog,
                     # reference:src/common/config_opts.h:209): sever the
@@ -170,9 +230,41 @@ class Connection:
                 else:
                     self._writer.writelines(segs)
                 await self._writer.drain()
+                pending_release.append(release)
+                if self._transport_empty():
+                    for rel in pending_release:
+                        rel()
+                    pending_release.clear()
+                elif len(pending_release) > 64:
+                    # sustained backpressure: the buffer sits between
+                    # the watermarks so it never reads empty — DROP
+                    # the deferred blocks to the GC (bounded memory;
+                    # the pool takes misses) instead of letting the
+                    # list grow for the connection's lifetime.
+                    # Recycling them would corrupt in-flight bytes;
+                    # dropping never can.
+                    pending_release.clear()
         # swallow-ok: writer teardown — the reader loop owns reset reporting
         except (ConnectionError, asyncio.CancelledError, OSError):
             pass
+        finally:
+            # recycle only what the (now dead or drained) transport
+            # provably no longer references; anything ambiguous is
+            # DROPPED to the GC instead — a later pool miss is cheap,
+            # recycled-while-buffered bytes on the wire are not
+            if self._transport_empty():
+                for rel in pending_release:
+                    rel()
+            pending_release.clear()
+
+    def _transport_empty(self) -> bool:
+        """True iff the transport holds no un-sent bytes (slab blocks
+        are safe to recycle)."""
+        try:
+            return self._writer.transport.get_write_buffer_size() == 0
+        # swallow-ok: closed/foreign transport — treat as NOT drained, drop the slabs
+        except Exception:
+            return False
 
     async def _reader_loop(self) -> None:
         throttle = self.messenger.dispatch_throttle
@@ -198,34 +290,46 @@ class Connection:
                 try:
                     frame = await self._reader.readexactly(n)
                     t_rx = time.monotonic()
-                    msg, _seq = decode_frame(frame)
-                    # receive stamp (op waterfall): taken at frame
-                    # read, local clock — with the header's send stamp
-                    # and the peer clock offset this IS the wire hop
-                    msg.recv_ts = t_rx
-                    perf.inc("msg_recv")
+                    # one frame may carry N coalesced acks (batch
+                    # frames); ordered delivery = frame order, then
+                    # member order within the frame
+                    msgs, _seq = decode_frame_msgs(frame)
+                    perf.inc("msg_recv", len(msgs))
                     perf.inc("bytes_recv", n)
                     self.messenger._maybe_clock_probe(self)
-                    # restore the sender's trace context for this
-                    # dispatch (and every task it spawns): the id minted
-                    # at the client follows the op across daemons
-                    current_trace.set(msg.trace)
-                    try:
-                        t0 = time.perf_counter()
+                    frame_dt = 0.0
+                    for msg in msgs:
+                        # receive stamp (op waterfall): taken at frame
+                        # read, local clock — with the header's send
+                        # stamp and the peer clock offset this IS the
+                        # wire hop
+                        msg.recv_ts = t_rx
+                        # restore the sender's trace context for this
+                        # dispatch (and every task it spawns): the id
+                        # minted at the client follows the op across
+                        # daemons
+                        current_trace.set(msg.trace)
                         try:
-                            await self.messenger._dispatch(self, msg)
+                            t0 = time.perf_counter()
+                            try:
+                                await self.messenger._dispatch(self, msg)
+                            finally:
+                                dt = time.perf_counter() - t0
+                                frame_dt += dt
+                                perf.observe("dispatch_latency", dt)
+                        # swallow-ok: logged handler bug must not tear down the peer link
+                        except Exception:
+                            logger.exception(
+                                "%s: dispatcher failed on %s from %s",
+                                self.messenger.name, msg.TYPE,
+                                self.peer_name,
+                            )
                         finally:
-                            dt = time.perf_counter() - t0
-                            perf.observe("dispatch_latency", dt)
-                            perf.hist("dispatch_histogram", n, dt)
-                    # swallow-ok: logged handler bug must not tear down the peer link
-                    except Exception:
-                        logger.exception(
-                            "%s: dispatcher failed on %s from %s",
-                            self.messenger.name, msg.TYPE, self.peer_name,
-                        )
-                    finally:
-                        current_trace.set(None)
+                            current_trace.set(None)
+                    # byte-bucketed ONCE per frame (a 16-ack batch
+                    # must not book its bytes 16x); the per-message
+                    # handler wall rides dispatch_latency above
+                    perf.hist("dispatch_histogram", n, frame_dt)
                 finally:
                     throttle.release(n)
                     perf.set("dispatch_queue_bytes", throttle.current)
@@ -281,6 +385,12 @@ class AsyncMessenger:
         # probes).  The ms_clock_sync_interval option overrides via
         # apply_config; bare messengers (clients) keep this default.
         self.clock_sync_interval = 5.0
+        # coalesced-ack bound: the writer loop packs up to this many
+        # consecutive READY blob-free COALESCE acks into one batch
+        # frame (flush-on-idle: an empty queue ships immediately, so
+        # coalescing only ever amortizes, never delays).  <=1 disables.
+        # The ms_reply_coalesce_max option overrides via apply_config.
+        self.reply_coalesce_max = 16
         self._server: asyncio.AbstractServer | None = None
         self._conns: dict[str, Connection] = {}  # outbound, keyed by peer addr
         self._pending: dict[str, asyncio.Future] = {}  # in-flight connects
@@ -320,6 +430,11 @@ class AsyncMessenger:
          .add_counter("conns_opened", "outbound connections established")
          .add_counter("conns_accepted", "inbound connections accepted")
          .add_counter("resets", "connections lost (either side)")
+         .add_counter("send_coalesced",
+                      "acks that rode a shared batch frame")
+         .add_counter("coalesced_frames",
+                      "batch frames written (one header+crc+syscall "
+                      "amortized over send_coalesced members)")
          .add_gauge("dispatch_queue_bytes",
                     "inbound bytes held by the dispatch throttle")
          .add_time_avg("dispatch_latency",
@@ -343,6 +458,7 @@ class AsyncMessenger:
         self.dispatch_throttle.limit = cfg.ms_dispatch_throttle_bytes
         self.inject_socket_failures = cfg.ms_inject_socket_failures
         self.clock_sync_interval = cfg.ms_clock_sync_interval
+        self.reply_coalesce_max = cfg.ms_reply_coalesce_max
 
     def _inject_failure(self) -> bool:
         n = self.inject_socket_failures
@@ -391,7 +507,8 @@ class AsyncMessenger:
             return
         conn = Connection(self, reader, writer)
         try:
-            banner = json.loads((await reader.readline()).decode())
+            banner = json.loads(  # wire-ok: banner handshake, line-based
+                (await reader.readline()).decode())
             conn.peer_name = banner["entity"]
             conn.peer_addr = banner.get("addr", "")
             if self.auth is not None and self.auth.require:
@@ -407,11 +524,12 @@ class AsyncMessenger:
                     from ..auth import new_secret
 
                     nonce = new_secret()
-                    writer.write(
+                    writer.write(  # wire-ok: auth challenge, handshake line
                         json.dumps({"challenge": nonce}).encode() + b"\n"
                     )
                     await writer.drain()
-                    answer = json.loads((await reader.readline()).decode())
+                    answer = json.loads(  # wire-ok: auth proof, handshake line
+                        (await reader.readline()).decode())
                     if not isinstance(answer, dict):
                         answer = {}
                     entity = self.auth.verify(
@@ -427,14 +545,14 @@ class AsyncMessenger:
                         # gates everything else on conn.authenticated
                         conn.authenticated = False
                     else:
-                        writer.write(
+                        writer.write(  # wire-ok: auth rejection, handshake line
                             json.dumps({"error": "auth failed"}).encode()
                             + b"\n"
                         )
                         await writer.drain()
                         writer.close()
                         return
-            writer.write(
+            writer.write(  # wire-ok: banner handshake, line-based
                 json.dumps({"entity": self.name, "addr": self.addr}).encode() + b"\n"
             )
             await writer.drain()
@@ -507,6 +625,7 @@ class AsyncMessenger:
                     authz = self.auth.authorizer()
                     if authz is not None:
                         out_banner["authorizer"] = authz
+                # wire-ok: banner handshake, line-based
                 writer.write(json.dumps(out_banner).encode() + b"\n")
                 await writer.drain()
                 line = await reader.readline()
@@ -517,7 +636,8 @@ class AsyncMessenger:
                         f"{addr}: peer closed during handshake"
                     )
                 try:
-                    probe = json.loads(line.decode()) if line.strip() else {}
+                    probe = (json.loads(line.decode())  # wire-ok: banner line
+                             if line.strip() else {})
                 except ValueError as e:
                     raise ConnectionResetError(
                         f"{addr}: bad handshake banner: {e!r}"
@@ -528,7 +648,7 @@ class AsyncMessenger:
                         self.auth.prove(probe["challenge"])
                         if self.auth is not None else None
                     )
-                    writer.write(
+                    writer.write(  # wire-ok: auth proof, handshake line
                         json.dumps({"proof": proof}).encode() + b"\n"
                     )
                     await writer.drain()
@@ -538,7 +658,7 @@ class AsyncMessenger:
                             f"{addr}: peer closed during auth challenge"
                         )
                 try:
-                    banner = json.loads(line.decode())
+                    banner = json.loads(line.decode())  # wire-ok: banner line
                     if isinstance(banner, dict) and "error" in banner:
                         # a deliberate rejection (auth): retrying is
                         # pointless and the caller must see WHY

@@ -560,6 +560,11 @@ class OSD(Dispatcher):
             ("osd_op_trace_sample_every", lambda _n, v: setattr(
                 self, "_trace_sample_every", int(v))),
             ("trace_ring_capacity", self._on_trace_ring_capacity),
+            # reply coalescing (binary wire protocol PR): the ack-batch
+            # bound must tune on a RUNNING osd — it is the knob the
+            # small-op latency tests sweep live
+            ("ms_reply_coalesce_max", lambda _n, v: setattr(
+                self.messenger, "reply_coalesce_max", int(v))),
         ]
         for _qk in QOS_CLASSES:
             for _qf, _qa in (("res", "reservation"), ("wgt", "weight"),

@@ -681,6 +681,13 @@ class RadosClient(Dispatcher):
             # faking a reordering (the loopback flake this replaces).
             rw = None
             split_unc = net_total / 2.0
+            # NB (binary wire protocol): a reply that rode a coalesced
+            # batch frame carries the BATCH's shared send stamp — the
+            # moment the writer loop shipped the run.  Flush-on-idle
+            # keeps that within one writer wakeup of the per-reply
+            # stamp, so reply_wire stays an honest wire measure; any
+            # residual batch wait shows up here, where it is in fact
+            # spent.
             if reply.sent is not None:
                 loc = align(float(reply.sent))
                 if loc is not None:

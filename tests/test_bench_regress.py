@@ -362,6 +362,89 @@ class TestBenchRegress:
         ) == 1
 
 
+class TestSmallopsIopsGates:
+    """The promoted IOPS metrics (binary wire protocol PR):
+    smallops.ops_per_sec (ratio, higher is better) and
+    smallops.op_p99 -> op_p99_ms (lower is better, 0.5ms additive
+    slack) gate next to the already-armed smallops.header_share."""
+
+    def _round(self, tmp_path, n, phase, value, ops=None, p99=None,
+               share=None):
+        line = {"metric": "m", "value": value, "unit": "GB/s",
+                "phase": phase}
+        so = {}
+        if ops is not None:
+            so["ops_per_sec"] = ops
+        if p99 is not None:
+            so["op_p99_ms"] = p99
+        if share is not None:
+            so["header_share"] = share
+        if so:
+            line["smallops"] = so
+        (tmp_path / f"BENCH_r{n:02d}.json").write_text(
+            json.dumps({"n": n, "rc": 0, "parsed": line})
+        )
+
+    def test_ops_per_sec_2x_drop_fails(self, tmp_path):
+        br = _load_tool()
+        self._round(tmp_path, 1, "tpu", 660.0, ops=200.0)
+        self._round(tmp_path, 2, "tpu", 661.0, ops=90.0)
+        rep = br.compare(br.load_rounds(str(tmp_path)),
+                         metric="smallops.ops_per_sec", threshold=0.5)
+        assert rep["comparable"] and rep["regression"] is True
+        for metric in ("smallops.ops_per_sec", "smallops_ops_per_sec"):
+            assert br.main(
+                ["--dir", str(tmp_path), "--metric", metric]
+            ) == 1, metric
+
+    def test_ops_per_sec_improvement_and_wobble_pass(self, tmp_path):
+        br = _load_tool()
+        self._round(tmp_path, 1, "tpu", 660.0, ops=140.0)
+        self._round(tmp_path, 2, "tpu", 661.0, ops=190.0)
+        assert br.main(
+            ["--dir", str(tmp_path), "--metric", "smallops.ops_per_sec"]
+        ) == 0
+
+    def test_op_p99_growth_is_the_regression(self, tmp_path):
+        """Lower is better with the 0.5ms slack: 5ms -> 30ms fails,
+        5ms -> 7ms passes (jitter inside the budget)."""
+        br = _load_tool()
+        self._round(tmp_path, 1, "tpu", 660.0, p99=5.0)
+        self._round(tmp_path, 2, "tpu", 661.0, p99=30.0)
+        rep = br.compare(br.load_rounds(str(tmp_path)),
+                         metric="smallops.op_p99")
+        assert rep["lower_is_better"] and rep["regression"] is True
+        for metric in ("smallops.op_p99", "smallops_op_p99",
+                       "smallops.op_p99_ms"):
+            assert br.main(
+                ["--dir", str(tmp_path), "--metric", metric]
+            ) == 1, metric
+        self._round(tmp_path, 3, "tpu", 661.0, p99=7.0)
+        rep = br.compare(br.load_rounds(str(tmp_path)),
+                         metric="smallops.op_p99")
+        # best prior is still 5ms: (5+0.5)/(7+0.5) = 0.73 >= 0.5
+        assert not rep["regression"]
+
+    def test_iops_gates_clean_skip_until_two_rounds_carry_them(
+        self, tmp_path
+    ):
+        """ISSUE acceptance: armed now, harmless until the capture has
+        landed in two rounds — promotion can never fail a round
+        retroactively."""
+        br = _load_tool()
+        self._round(tmp_path, 1, "tpu", 660.0)  # legacy round
+        self._round(tmp_path, 2, "tpu", 650.0, ops=190.0, p99=6.0,
+                    share=0.03)
+        for metric in ("smallops.ops_per_sec", "smallops.op_p99",
+                       "smallops.header_share"):
+            rep = br.compare(br.load_rounds(str(tmp_path)),
+                             metric=metric)
+            assert rep["comparable"] is False, metric
+            assert br.main(
+                ["--dir", str(tmp_path), "--metric", metric]
+            ) == 0, metric
+
+
 class TestChildBackendDeath:
     def test_parent_survives_backend_registration_abort(self):
         """Regression for BENCH_r05: every accelerator child dies with

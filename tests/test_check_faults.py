@@ -170,4 +170,8 @@ class TestRepoIsClean:
                          # machinery — a swallow there eats the
                          # reset/decode signal resend depends on
                          "message.py", "messenger.py", "tracing.py",
-                         "clocksync.py", "stack_ledger.py"}
+                         "clocksync.py", "stack_ledger.py",
+                         # the frame scratch pool (binary wire
+                         # protocol PR): a swallowed double-release
+                         # would corrupt bytes on the wire
+                         "slab.py"}

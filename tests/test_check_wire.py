@@ -1,0 +1,193 @@
+"""tools/check_wire.py — the static wire-protocol gate.
+
+The gate must: demand a literal int TYPE_ID on every @register-ed
+class, catch id/name collisions and the reserved batch id, pin ids
+against the committed manifest (renumbering, missing entries, deleted
+entries, retired-id reuse all fail), flag json.dumps/loads on the
+frame hot path unless wire-ok-annotated with a reason, and pass the
+real repo (whose manifest and hot path are clean by construction —
+that is this PR's deliverable).
+"""
+
+import importlib.util
+import json
+import pathlib
+import sys
+import textwrap
+
+
+def _load_tool():
+    path = (pathlib.Path(__file__).parent.parent
+            / "tools" / "check_wire.py")
+    spec = importlib.util.spec_from_file_location("check_wire", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["check_wire"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _repo(tmp_path, messages_src: str, manifest: dict | None,
+          messenger_src: str = "") -> pathlib.Path:
+    root = tmp_path / "repo"
+    (root / "ceph_tpu" / "msg").mkdir(parents=True)
+    (root / "ceph_tpu" / "msg" / "messages.py").write_text(
+        textwrap.dedent(messages_src))
+    if messenger_src:
+        (root / "ceph_tpu" / "msg" / "messenger.py").write_text(
+            textwrap.dedent(messenger_src))
+    if manifest is not None:
+        (root / "ceph_tpu" / "msg" / "wire_manifest.json").write_text(
+            json.dumps(manifest))
+    return root
+
+
+_OK_SRC = """
+    @register
+    class MPing(Message):
+        TYPE = "ping"
+        TYPE_ID = 20
+        FIELDS = ("stamp",)
+
+    @register
+    class MPong(Message):
+        TYPE = "pong"
+        TYPE_ID = 21
+"""
+
+
+class TestRegistryRules:
+    def test_clean_fixture_passes(self, tmp_path):
+        cw = _load_tool()
+        root = _repo(tmp_path, _OK_SRC,
+                     {"types": {"ping": 20, "pong": 21}, "retired": []})
+        assert cw.check(root) == []
+
+    def test_missing_type_id_fails(self, tmp_path):
+        cw = _load_tool()
+        root = _repo(tmp_path, """
+            @register
+            class MPing(Message):
+                TYPE = "ping"
+        """, {"types": {}, "retired": []})
+        assert any("TYPE_ID" in p for p in cw.check(root))
+
+    def test_id_collision_fails(self, tmp_path):
+        cw = _load_tool()
+        root = _repo(tmp_path, """
+            @register
+            class MA(Message):
+                TYPE = "a"
+                TYPE_ID = 9
+            @register
+            class MB(Message):
+                TYPE = "b"
+                TYPE_ID = 9
+        """, {"types": {"a": 9, "b": 9}, "retired": []})
+        assert any("collides" in p for p in cw.check(root))
+
+    def test_reserved_batch_id_fails(self, tmp_path):
+        cw = _load_tool()
+        root = _repo(tmp_path, """
+            @register
+            class MA(Message):
+                TYPE = "a"
+                TYPE_ID = 1
+        """, {"types": {"a": 1}, "retired": []})
+        assert any("reserved" in p for p in cw.check(root))
+
+    def test_unregistered_class_is_ignored(self, tmp_path):
+        cw = _load_tool()
+        root = _repo(tmp_path, _OK_SRC + """
+    class NotWire(Message):
+        TYPE = "x"
+""", {"types": {"ping": 20, "pong": 21}, "retired": []})
+        assert cw.check(root) == []
+
+
+class TestManifestPinning:
+    def test_renumbering_fails(self, tmp_path):
+        cw = _load_tool()
+        root = _repo(tmp_path, _OK_SRC,
+                     {"types": {"ping": 99, "pong": 21}, "retired": []})
+        assert any("renumbered" in p for p in cw.check(root))
+
+    def test_new_type_must_be_appended(self, tmp_path):
+        cw = _load_tool()
+        root = _repo(tmp_path, _OK_SRC,
+                     {"types": {"ping": 20}, "retired": []})
+        assert any("not in the manifest" in p for p in cw.check(root))
+
+    def test_deleted_type_must_be_retired_not_dropped(self, tmp_path):
+        cw = _load_tool()
+        root = _repo(tmp_path, _OK_SRC,
+                     {"types": {"ping": 20, "pong": 21, "gone": 30},
+                      "retired": []})
+        assert any("retired" in p for p in cw.check(root))
+
+    def test_retired_id_reuse_fails(self, tmp_path):
+        cw = _load_tool()
+        root = _repo(tmp_path, _OK_SRC,
+                     {"types": {"ping": 20, "pong": 21},
+                      "retired": [20]})
+        assert any("RETIRED" in p for p in cw.check(root))
+
+    def test_missing_manifest_reports(self, tmp_path):
+        cw = _load_tool()
+        root = _repo(tmp_path, _OK_SRC, None)
+        assert any("unreadable" in p for p in cw.check(root))
+
+
+class TestJsonBan:
+    def test_unannotated_json_on_hot_path_fails(self, tmp_path):
+        cw = _load_tool()
+        root = _repo(tmp_path, _OK_SRC,
+                     {"types": {"ping": 20, "pong": 21}, "retired": []},
+                     messenger_src="""
+            import json
+            def encode(head):
+                return json.dumps(head).encode()
+        """)
+        probs = cw.check(root)
+        assert any("json.dumps" in p for p in probs)
+
+    def test_wire_ok_annotation_allows(self, tmp_path):
+        cw = _load_tool()
+        root = _repo(tmp_path, _OK_SRC,
+                     {"types": {"ping": 20, "pong": 21}, "retired": []},
+                     messenger_src="""
+            import json
+            def banner(line):
+                # wire-ok: banner handshake, line-based
+                return json.loads(line)
+        """)
+        assert cw.check(root) == []
+
+    def test_empty_reason_fails(self, tmp_path):
+        cw = _load_tool()
+        root = _repo(tmp_path, _OK_SRC,
+                     {"types": {"ping": 20, "pong": 21}, "retired": []},
+                     messenger_src="""
+            import json
+            def banner(line):
+                return json.loads(line)  # wire-ok:
+        """)
+        assert any("json.loads" in p for p in cw.check(root))
+
+
+class TestRealRepo:
+    def test_real_repo_is_clean(self):
+        cw = _load_tool()
+        root = pathlib.Path(__file__).parent.parent
+        assert cw.check(root) == []
+
+    def test_manifest_matches_live_registry(self):
+        """The committed manifest and the IMPORTED registry agree —
+        the static extraction cannot silently miss a class the
+        interpreter registers (e.g. a dynamically-built type)."""
+        from ceph_tpu.msg.message import _REGISTRY
+
+        root = pathlib.Path(__file__).parent.parent
+        manifest = json.loads(
+            (root / "ceph_tpu" / "msg" / "wire_manifest.json").read_text())
+        live = {cls.TYPE: tid for tid, cls in _REGISTRY.items()}
+        assert live == manifest["types"]

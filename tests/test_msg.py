@@ -64,6 +64,7 @@ def test_frame_bad_magic():
 def test_unknown_type_rejected():
     class MUnknown(Message):
         TYPE = "nope_not_registered"
+        TYPE_ID = 0x7EEF  # encodes fine; never in the decode registry
         FIELDS = ("x",)
 
     with pytest.raises(BadFrame):

@@ -440,18 +440,30 @@ class TestStackLedger:
         from ceph_tpu.msg.message import decode_frame, encode_frame
         from ceph_tpu.msg.messages import MOSDOp
 
+        def mk():
+            m = MOSDOp(tid=1, epoch=1, pool=1, oid="o",
+                       ops=[{"op": "writefull", "data": 0}],
+                       blobs=[b"x" * 512])
+            m.trace = "wf-ledger-1"
+            return m
+        # warm the slab pool: the first encode of a size class is the
+        # one legitimate frame_allocs event (a slab miss)
+        decode_frame(encode_frame(mk(), 1))
         enc0, dec0 = stack_ledger.header_seconds()
         allocs0 = int(stack_ledger.stack_perf().get("frame_allocs"))
         frames0 = int(stack_ledger.stack_perf().get("frames_encoded"))
-        m = MOSDOp(tid=1, epoch=1, pool=1, oid="o",
-                   ops=[{"op": "writefull", "data": 0}],
-                   blobs=[b"x" * 512])
-        m.trace = "wf-ledger-1"
+        hits0 = int(stack_ledger.stack_perf().get("slab_hits"))
+        m = mk()
         out, _ = decode_frame(encode_frame(m, 1))
         enc1, dec1 = stack_ledger.header_seconds()
         assert enc1 > enc0 and dec1 > dec0
+        # binary-header re-baseline: a warm-pool encode+decode is
+        # ALLOCATION-FREE — the JSON era's +3 (header bytes, crc pack,
+        # decode header copy) is retired; the scratch comes back from
+        # the slab free list instead
         assert int(stack_ledger.stack_perf().get("frame_allocs")) \
-            >= allocs0 + 3
+            == allocs0
+        assert int(stack_ledger.stack_perf().get("slab_hits")) > hits0
         assert int(stack_ledger.stack_perf().get("frames_encoded")) \
             == frames0 + 1
         # the send stamp rode the header and decoded back

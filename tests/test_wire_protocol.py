@@ -1,0 +1,586 @@
+"""Binary wire protocol property/fuzz suite (the small-op latency PR).
+
+What must hold, per the frame contract in ceph_tpu/msg/message.py:
+
+- every registered message type round-trips bit-exactly (traced and
+  untraced; re-encode of a decode is byte-identical under a frozen
+  clock);
+- EVERY malformed input — truncation at any boundary, random
+  corruption, unknown type id, lying length fields, wrong tail arity —
+  raises BadFrame, never hangs, never escapes as another exception;
+- the crc chains across slab-backed segment views (mutating any blob
+  byte after encode fails the peer's check);
+- the slab pool is bounded, recycling, and exact under concurrent
+  checkout;
+- coalesced reply batches deliver byte-identical acks in order, and a
+  PR-7 mid-vectored-write sever eats a batch whole (never a prefix of
+  its members);
+- a live MiniCluster holds ``stack.frame_allocs`` FLAT across a
+  1k-small-op steady-state window — the allocation-free claim, pinned.
+"""
+
+import asyncio
+import random
+import struct
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.common import stack_ledger
+from ceph_tpu.common.slab import SlabPool, frame_slab
+from ceph_tpu.msg import AsyncMessenger, Dispatcher, messages
+from ceph_tpu.msg import message as msgmod
+from ceph_tpu.msg.message import (
+    BadFrame,
+    Message,
+    decode_frame,
+    decode_frame_msgs,
+    encode_batch_frame,
+    encode_frame,
+    encode_frame_segments,
+)
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+# -- sample construction ------------------------------------------------------
+
+# field-name driven sample values: every registered type gets a
+# realistic-ish instance; anything unlisted falls back by position
+_BY_NAME = {
+    "ops": [{"op": "writefull", "data": 0}],
+    "snapc": {"seq": 3, "snaps": [3, 2]},
+    "stamps": {"submit": 12345.123456789},
+    "spans": [{"hop": "wire", "t0": 1.5, "dur": 0.002, "entity": "osd.0"}],
+    "entries": [{"stamp": 1.0, "name": "osd.0", "level": "warn",
+                 "msg": "x"}],
+    "perf": {"osd": {"op": 7}},
+    "cmd": {"prefix": "status"},
+    "osdmap": {"epoch": 4, "pools": {}},
+    "incrementals": [{"epoch": 4}],
+    "out": [{"version": [1, 2]}],
+    "reads": [{"oid": ["o", 0], "offset": 0, "length": 8, "data": 0}],
+    "pushes": [{"oid": ["o", 0], "data": 0, "attrs": {}, "version": 1}],
+    "txn": [["touch", "1.0", ["o", 0]]],
+    "log": [],
+    "at_version": [1, 4],
+    "trim_to": [0, 0],
+    "pgs": {"1.0": {"objects": 1, "bytes": 4096, "primary": 0}},
+    "store": {"used": 1},
+    "profile": {"plugin": "isa", "k": "4", "m": "2"},
+    "stripes": [2, 1],
+    "present": [0, 1, 2],
+    "shards": [0, 4],
+    "accepted": {"epoch": 1, "version": 2, "value": {}},
+    "intervals": [[1, 2, [0, 1]]],
+    "objects": {"o": {"version": [1, 1], "size": 9}},
+    "names": ["a", "b"],
+    "report": {"pg": "1.0", "objects": 0, "errors": [], "repaired": 0,
+               "clean": True},
+    "sub": True,
+    "down": False,
+    "repair": False,
+    "attrs": {},
+    "errors": [],
+}
+_FALLBACK = [7, "s", 2.5, [1, 2], {"k": 1}, 3, "t"]
+
+
+def _sample(cls) -> Message:
+    kw = {}
+    for i, f in enumerate(cls.FIELDS):
+        kw[f] = _BY_NAME.get(f, _FALLBACK[i % len(_FALLBACK)])
+    return cls(**kw)
+
+
+def _flat(segs) -> bytes:
+    return b"".join(bytes(s) for s in segs)
+
+
+def _rebuild_crc(frame: bytearray) -> bytes:
+    """Recompute the trailer crc of a hand-mutated frame (forged
+    frames must fail on STRUCTURE, not on the crc shortcut)."""
+    from ceph_tpu.utils import native
+
+    crc = native.crc32c_view(msgmod.CRC_SEED, bytes(frame), len(frame) - 4)
+    struct.pack_into("<I", frame, len(frame) - 4, crc)
+    return bytes(frame)
+
+
+class TestRoundTrip:
+    def test_every_registered_type_roundtrips(self):
+        blobs = [b"", b"payload" * 37]
+        for tid, cls in sorted(msgmod._REGISTRY.items()):
+            m = _sample(cls)
+            m.blobs = list(blobs)
+            out, seq = decode_frame(encode_frame(m, 11))
+            assert seq == 11, cls.__name__
+            assert type(out) is cls
+            assert out.fields() == m.fields(), cls.__name__
+            assert [bytes(b) for b in out.blobs] == blobs, cls.__name__
+            assert out.trace is None and out.sent is None
+
+    def test_every_registered_type_reencodes_byte_identical(self,
+                                                            monkeypatch):
+        # frozen clock: a traced re-encode would otherwise take a new
+        # send stamp and could never be byte-compared
+        monkeypatch.setattr(time, "monotonic", lambda: 12345.675309)
+        for traced in (False, True):
+            for tid, cls in sorted(msgmod._REGISTRY.items()):
+                m = _sample(cls)
+                m.blobs = [b"xy" * 100]
+                if traced:
+                    m.trace = f"client.9:t{tid}"
+                f1 = encode_frame(m, 5)
+                out, _ = decode_frame(f1)
+                assert out.trace == m.trace
+                if traced:
+                    assert out.sent == 12345.675309
+                f2 = encode_frame(out, 5)
+                assert f2 == f1, (cls.__name__, traced)
+
+    def test_tail_modes_on_the_wire(self):
+        """Admin/auth types really ride the JSON tail; data types ride
+        marshal — the flag is readable in the raw frame."""
+        f = encode_frame(messages.MMonCommand(tid=1,
+                                              cmd={"prefix": "status"}), 1)
+        (_, _tid, flags, *_rest) = msgmod._FIXED.unpack_from(f, 0)
+        assert flags & msgmod.FLAG_TAIL_JSON
+        assert b'"prefix"' in f  # greppable in a pcap: the point
+        f2 = encode_frame(_sample(messages.MOSDOp), 1)
+        (_, _tid, flags2, *_rest) = msgmod._FIXED.unpack_from(f2, 0)
+        assert flags2 & msgmod.FLAG_TAIL_BIN
+        assert b'"tid"' not in f2  # positional tail: no key strings
+
+    def test_small_frame_is_one_segment_large_is_vectored(self):
+        small, n, rel = encode_frame_segments(
+            messages.MPing(stamp=1.0, epoch=1), 1)
+        assert len(small) == 1 and n <= msgmod.SMALL_FRAME_MAX
+        rel()
+        segs, total, rel2 = encode_frame_segments(
+            _sample(messages.MOSDOp), 1)
+        assert len(segs) == 1  # no blobs set by _sample -> tail only
+        rel2()
+        m = _sample(messages.MOSDOp)
+        m.blobs = [b"z" * 4096]
+        segs, total, rel3 = encode_frame_segments(m, 1)
+        assert len(segs) == 3  # header block, borrowed blob, crc view
+        assert segs[1] is m.blobs[0]  # the blob rides BORROWED
+        rel3()
+
+
+class TestBadFrames:
+    def _frame(self) -> bytes:
+        m = _sample(messages.MOSDOp)
+        m.blobs = [b"D" * 64, b"E" * 32]
+        m.trace = "c:t1"
+        return encode_frame(m, 9)
+
+    def test_truncation_at_every_boundary_is_badframe(self):
+        f = self._frame()
+        for k in range(len(f)):
+            with pytest.raises(BadFrame):
+                decode_frame(f[:k])
+
+    def test_random_corruption_never_escapes_badframe(self):
+        f = self._frame()
+        rng = random.Random(1312)
+        for _ in range(400):
+            ba = bytearray(f)
+            for _flip in range(rng.randrange(1, 4)):
+                ba[rng.randrange(len(ba))] ^= 1 << rng.randrange(8)
+            try:
+                decode_frame_msgs(bytes(ba))
+            except BadFrame:
+                pass  # the only acceptable failure mode
+
+    def test_unknown_type_id_with_valid_crc(self):
+        ba = bytearray(self._frame())
+        struct.pack_into("<H", ba, 4, 0x7EEF)  # type_id field
+        with pytest.raises(BadFrame, match="unknown message type id"):
+            decode_frame(_rebuild_crc(ba))
+
+    def test_lying_blob_count_is_badframe(self):
+        ba = bytearray(self._frame())
+        struct.pack_into("<H", ba, 24, 40)  # blob_count field
+        with pytest.raises(BadFrame):
+            decode_frame(_rebuild_crc(ba))
+
+    def test_lying_tail_len_is_badframe(self):
+        ba = bytearray(self._frame())
+        struct.pack_into("<I", ba, 28, 1 << 24)  # tail_len field
+        with pytest.raises(BadFrame, match="truncated header"):
+            decode_frame(_rebuild_crc(ba))
+
+    def test_wrong_tail_arity_is_badframe(self):
+        """A crc-valid frame whose positional tail does not match the
+        class schema (version skew) must be a decode error, not a
+        reader-loop crash."""
+        import marshal
+
+        tail = marshal.dumps((1, 2, 3), 2)  # MPing has 2 fields
+        trace = b""
+        head = msgmod._FIXED.pack(
+            msgmod.MAGIC, messages.MPing.TYPE_ID, msgmod.FLAG_TAIL_BIN,
+            1, 0.0, 0, len(trace), len(tail))
+        ba = bytearray(head + trace + tail + b"\0\0\0\0")
+        with pytest.raises(BadFrame, match="arity"):
+            decode_frame(_rebuild_crc(ba))
+
+    def test_batch_entry_overrun_is_badframe(self):
+        acks = [messages.MOSDOpReply(tid=i, result=0, epoch=1)
+                for i in range(3)]
+        segs, total, rel = encode_batch_frame(acks, 1)
+        ba = bytearray(_flat(segs))
+        rel()
+        # first sub-entry's tail_len overruns the frame
+        struct.pack_into("<I", ba, msgmod._FIXED.size + 4, 1 << 20)
+        with pytest.raises(BadFrame):
+            decode_frame_msgs(_rebuild_crc(ba))
+
+    def test_batch_bad_utf8_trace_is_badframe(self):
+        """Review finding: the batch path must wrap a corrupt trace id
+        into BadFrame exactly like the single-frame path — an escaped
+        UnicodeDecodeError would kill the reader loop as an unhandled
+        task exception instead of the controlled corrupt-peer drop."""
+        a = messages.MOSDOpReply(tid=1, result=0, epoch=1)
+        a.trace = "c:t1"
+        segs, _t, rel = encode_batch_frame([a, a], 1)
+        ba = bytearray(_flat(segs))
+        rel()
+        # the trace bytes sit right after the first sub-entry header
+        off = msgmod._FIXED.size + msgmod._SUB.size
+        assert bytes(ba[off:off + 4]) == b"c:t1"
+        ba[off] = 0xFF  # invalid UTF-8 lead byte
+        with pytest.raises(BadFrame, match="bad trace id"):
+            decode_frame_msgs(_rebuild_crc(ba))
+
+    def test_batch_frames_reject_single_decode_api(self):
+        acks = [messages.MOSDOpReply(tid=i, result=0, epoch=1)
+                for i in range(2)]
+        segs, _t, rel = encode_batch_frame(acks, 1)
+        frame = _flat(segs)
+        rel()
+        with pytest.raises(BadFrame, match="decode_frame_msgs"):
+            decode_frame(frame)
+        outs, _ = decode_frame_msgs(frame)
+        assert [o.tid for o in outs] == [0, 1]
+
+    def test_empty_and_garbage_input(self):
+        for junk in (b"", b"CTPB", b"XXXX" + b"\0" * 64,
+                     b"\0" * 36, self._frame()[4:]):
+            with pytest.raises(BadFrame):
+                decode_frame(junk)
+
+
+class TestCrcChain:
+    def test_crc_chains_across_slab_backed_segments(self):
+        """The vectored frame's trailer crc — computed over the slab
+        header block then CHAINED across borrowed blob views — equals
+        the crc of the joined bytes, and any post-encode blob mutation
+        fails decode."""
+        m = _sample(messages.MOSDECSubOpWrite)
+        blob = bytearray(b"Q" * 5000)  # mutable on purpose
+        m.blobs = [blob, b"R" * 3000]
+        segs, total, rel = encode_frame_segments(m, 3)
+        assert len(segs) > 2  # really vectored: slab header + views
+        flat = _flat(segs)
+        assert len(flat) == total
+        out, _ = decode_frame_msgs(flat)  # chained crc verifies
+        # the caller-mutation contract: flip one payload byte between
+        # encode and drain -> the peer's crc check MUST catch it
+        blob[100] ^= 0xFF
+        with pytest.raises(BadFrame, match="crc mismatch"):
+            decode_frame_msgs(_flat(segs))
+        rel()
+
+
+class TestSlabPool:
+    def test_reuse_returns_the_same_block(self):
+        pool = SlabPool()
+        a = pool.checkout(100)
+        backing = a.data
+        a.release()
+        b = pool.checkout(200)  # same 256B class
+        assert b.data is backing
+        assert pool.hits == 1 and pool.misses == 1
+
+    def test_free_lists_are_bounded(self):
+        pool = SlabPool(per_class=2)
+        bufs = [pool.checkout(64) for _ in range(5)]
+        for b in bufs:
+            b.release()
+        st = pool.stats()
+        assert st["free"][256] == 2  # 3 dropped to the GC
+        assert st["bytes_held"] == 512
+
+    def test_byte_cap_bounds_large_classes(self):
+        pool = SlabPool(per_class=64, class_bytes=1 << 20)
+        st = pool.stats()
+        assert st["caps"][262144] == 4  # 1MiB / 256KiB
+        assert st["caps"][256] == 64
+
+    def test_oversize_checkout_never_pools(self):
+        pool = SlabPool()
+        big = pool.checkout(1 << 21)
+        assert len(big.data) == 1 << 21
+        big.release()
+        assert pool.stats()["bytes_held"] == 0
+        assert pool.misses == 1
+
+    def test_double_release_is_idempotent(self):
+        pool = SlabPool()
+        a = pool.checkout(10)
+        a.release()
+        a.release()
+        assert pool.stats()["free"][256] == 1
+
+    def test_concurrent_checkout_hands_distinct_blocks(self):
+        pool = SlabPool()
+        a = pool.checkout(100)
+        b = pool.checkout(100)
+        assert a.data is not b.data
+        a.release()
+        b.release()
+
+    def test_threaded_churn_stays_consistent(self):
+        pool = SlabPool()
+        errors: list = []
+
+        def churn(seed):
+            rng = random.Random(seed)
+            try:
+                for _ in range(400):
+                    buf = pool.checkout(rng.choice((64, 900, 4000)))
+                    buf.data[0] = seed  # we own it exclusively
+                    if buf.data[0] != seed:
+                        errors.append("clobbered")
+                    buf.release()
+            except Exception as e:  # pragma: no cover - the assertion
+                errors.append(repr(e))
+
+        ts = [threading.Thread(target=churn, args=(i,)) for i in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errors
+        st = pool.stats()
+        assert st["hits"] + st["misses"] == 4 * 400
+
+    def test_checkouts_feed_the_stack_ledger(self):
+        pc = stack_ledger.stack_perf()
+        pool = frame_slab()
+        pool.stats()  # flush any pending hit tally first
+        h0 = int(pc.get("slab_hits"))
+        m0 = int(pc.get("slab_misses"))
+        a0 = int(pc.get("frame_allocs"))
+        buf = pool.checkout(32)
+        buf.release()
+        buf = pool.checkout(32)
+        buf.release()
+        pool.stats()
+        assert int(pc.get("slab_hits")) >= h0 + 1
+        # a miss (if the class was cold) counts into frame_allocs too
+        assert int(pc.get("slab_misses")) - m0 == \
+            int(pc.get("frame_allocs")) - a0
+
+
+class _Sink(Dispatcher):
+    def __init__(self):
+        self.got = []
+        self.resets = 0
+        self.event = asyncio.Event()
+
+    async def ms_dispatch(self, conn, msg):
+        self.got.append(msg)
+        self.event.set()
+
+    def ms_handle_reset(self, conn):
+        self.resets += 1
+
+
+class _AckBurst(Dispatcher):
+    """On any inbound message, answer with a burst of coalescible acks
+    (queued in one tick, so the writer loop can batch them) plus an
+    optional trailing blob-carrying reply (never coalescible)."""
+
+    def __init__(self, n: int, with_blob_tail: bool = False):
+        self.n = n
+        self.with_blob_tail = with_blob_tail
+
+    async def ms_dispatch(self, conn, msg):
+        for i in range(self.n):
+            conn.send(messages.MOSDOpReply(
+                tid=i, result=0, epoch=7, out=[{"v": i}]))
+        if self.with_blob_tail:
+            conn.send(messages.MOSDOpReply(
+                tid=self.n, result=0, epoch=7, out=[{"data": 0}],
+                blobs=[b"READ" * 64]))
+
+    def ms_handle_reset(self, conn):
+        pass
+
+
+async def _wait(pred, timeout=5.0):
+    async with asyncio.timeout(timeout):
+        while not pred():
+            await asyncio.sleep(0.005)
+
+
+class TestReplyCoalescing:
+    def test_burst_coalesces_in_order_byte_identical(self):
+        async def main():
+            srv = AsyncMessenger("osd.0", _AckBurst(12))
+            await srv.bind()
+            sink = _Sink()
+            cli = AsyncMessenger("client.1", sink)
+            conn = await cli.connect(srv.addr, "osd.0")
+            conn.send(messages.MPing(stamp=1.0, epoch=1))
+            await _wait(lambda: len(sink.got) >= 12)
+            acks = [m for m in sink.got
+                    if isinstance(m, messages.MOSDOpReply)]
+            assert [a.tid for a in acks] == list(range(12))  # ordered
+            assert [a.out for a in acks] == [[{"v": i}] for i in range(12)]
+            assert all(a.trace for a in acks)  # trace ids survived
+            # the burst actually shared frames: fewer frames than acks
+            assert srv.perf.get("coalesced_frames") >= 1
+            assert srv.perf.get("send_coalesced") >= 2
+            await cli.shutdown()
+            await srv.shutdown()
+
+        run(main())
+
+    def test_blob_reply_flushes_the_run_and_keeps_order(self):
+        async def main():
+            srv = AsyncMessenger("osd.0", _AckBurst(5, with_blob_tail=True))
+            await srv.bind()
+            sink = _Sink()
+            cli = AsyncMessenger("client.1", sink)
+            conn = await cli.connect(srv.addr, "osd.0")
+            conn.send(messages.MPing(stamp=1.0, epoch=1))
+            await _wait(lambda: len(sink.got) >= 6)
+            acks = [m for m in sink.got
+                    if isinstance(m, messages.MOSDOpReply)]
+            assert [a.tid for a in acks] == list(range(6))
+            assert bytes(acks[5].blobs[0]) == b"READ" * 64
+            await cli.shutdown()
+            await srv.shutdown()
+
+        run(main())
+
+    def test_coalesce_max_1_disables_batching(self):
+        async def main():
+            srv = AsyncMessenger("osd.0", _AckBurst(8))
+            srv.reply_coalesce_max = 1
+            await srv.bind()
+            sink = _Sink()
+            cli = AsyncMessenger("client.1", sink)
+            conn = await cli.connect(srv.addr, "osd.0")
+            conn.send(messages.MPing(stamp=1.0, epoch=1))
+            await _wait(lambda: len(sink.got) >= 8)
+            assert srv.perf.get("coalesced_frames") == 0
+            acks = [m for m in sink.got
+                    if isinstance(m, messages.MOSDOpReply)]
+            assert [a.tid for a in acks] == list(range(8))
+            await cli.shutdown()
+            await srv.shutdown()
+
+        run(main())
+
+    def test_sever_mid_batch_eats_the_whole_batch(self):
+        """PR-7 discipline on the coalesced path: an injected
+        mid-vectored-write sever on a batch frame delivers NO member
+        (length framing + crc — a prefix of acks can never leak), the
+        peer sees a clean reset, and a resent burst arrives whole."""
+
+        async def main():
+            sink = _Sink()
+            cli = AsyncMessenger("client.1", sink)
+            srv = AsyncMessenger("osd.0", _AckBurst(10))
+            await srv.bind()
+            fired = {"n": 0}
+
+            def inject_once():
+                fired["n"] += 1
+                return fired["n"] == 1
+
+            srv._inject_failure = inject_once
+            conn = await cli.connect(srv.addr, "osd.0")
+            conn.send(messages.MPing(stamp=1.0, epoch=1))
+            await asyncio.sleep(0.3)
+            # the server's first write was the (severed) burst: either
+            # nothing arrived, or — if the writer flushed a lone ack
+            # before batching — a strict PREFIX arrived intact; no
+            # torn/partial member ever decodes
+            acks = [m for m in sink.got
+                    if isinstance(m, messages.MOSDOpReply)]
+            assert len(acks) < 10
+            assert [a.tid for a in acks] == list(range(len(acks)))
+            assert sink.resets >= 1
+            # resend on a fresh connection delivers the full burst
+            conn2 = await cli.connect(srv.addr, "osd.0")
+            assert conn2 is not conn
+            sink.got.clear()
+            conn2.send(messages.MPing(stamp=2.0, epoch=1))
+            await _wait(lambda: len([
+                m for m in sink.got
+                if isinstance(m, messages.MOSDOpReply)]) >= 10)
+            acks = [m for m in sink.got
+                    if isinstance(m, messages.MOSDOpReply)]
+            assert [a.tid for a in acks] == list(range(10))
+            assert [a.out for a in acks] == [[{"v": i}] for i in range(10)]
+            await cli.shutdown()
+            await srv.shutdown()
+
+        run(main())
+
+
+class TestLiveClusterAllocsFlat:
+    def test_frame_allocs_flat_over_1k_op_steady_state(self):
+        """The acceptance pin: a live 1-OSD cluster serving 1000 4KiB
+        writes in steady state adds ZERO frame_allocs — every frame's
+        scratch comes back from the slab pool — while slab_hits grows
+        by at least one per frame."""
+        from ceph_tpu.rados.cluster import MiniCluster
+
+        async def main():
+            async with MiniCluster(
+                n_osds=1,
+                config_overrides={
+                    # keep the window steady-state: no mgr report tick
+                    # mid-window (its one-off jumbo perf tail is
+                    # legitimate warmup, not steady state)
+                    "osd_mgr_report_interval": 3600.0,
+                },
+            ) as c:
+                cl = await c.client()
+                await cl.create_pool("flat", "replicated", size=1)
+                payload = bytes(range(256)) * 16  # 4 KiB
+                # warmup: connects, clock probes, slab classes, stats
+                for i in range(32):
+                    await cl.operate("flat", f"w{i}",
+                                     [{"op": "writefull", "data": 0}],
+                                     [payload])
+                pc = stack_ledger.stack_perf()
+                frame_slab().stats()  # flush pending hit tallies
+                a0 = int(pc.get("frame_allocs"))
+                h0 = int(pc.get("slab_hits"))
+                ok = 0
+                for i in range(1000):
+                    r = await cl.operate("flat", f"o{i}",
+                                         [{"op": "writefull", "data": 0}],
+                                         [payload])
+                    ok += 1 if r.result == 0 else 0
+                frame_slab().stats()
+                assert ok == 1000
+                grew = int(pc.get("frame_allocs")) - a0
+                assert grew == 0, f"frame_allocs grew by {grew}"
+                # every op is >=2 frames each way; all slab-served
+                assert int(pc.get("slab_hits")) - h0 >= 2000
+                assert int(pc.get("slab_bytes_held")) >= 0
+
+        run(main())

@@ -156,7 +156,7 @@ class TestFrames:
             [b"", payload.tobytes(), b"x"],
         ):
             msg = self._mk(blobs)
-            segs, total = msgmod.encode_frame_segments(msg, 5)
+            segs, total, _rel = msgmod.encode_frame_segments(msg, 5)
             flat = b"".join(bytes(s) for s in segs)
             assert len(flat) == total
             assert flat == msgmod.encode_frame(self._mk(blobs), 5)
@@ -179,7 +179,7 @@ class TestFrames:
         arr2d = np.arange(24, dtype=np.uint8).reshape(2, 12)
         for blob in (arr2d, memoryview(arr2d)):
             msg = self._mk([blob])
-            segs, total = msgmod.encode_frame_segments(msg, 3)
+            segs, total, _rel = msgmod.encode_frame_segments(msg, 3)
             flat = b"".join(bytes(s) for s in segs)
             assert len(flat) == total
             out, _ = msgmod.decode_frame(flat)
@@ -192,7 +192,7 @@ class TestFrames:
         PR 6)."""
         arr = np.array([0x01020304, 0xAABBCCDD], dtype=np.uint32)
         msg = self._mk([arr])
-        segs, total = msgmod.encode_frame_segments(msg, 4)
+        segs, total, _rel = msgmod.encode_frame_segments(msg, 4)
         flat = b"".join(bytes(s) for s in segs)
         assert len(flat) == total
         out, _ = msgmod.decode_frame(flat)

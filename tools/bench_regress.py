@@ -71,7 +71,12 @@ METRIC_ALIASES = {"stack_e2e_gbps": "stack_e2e.stack_e2e_gbps",
                   "mesh_ici_share": "mesh.ici_share",
                   "accel_occupancy": "accel.occupancy",
                   "accel_fleet_occupancy": "accel.fleet_occupancy",
-                  "smallops_header_share": "smallops.header_share"}
+                  "smallops_header_share": "smallops.header_share",
+                  "smallops_ops_per_sec": "smallops.ops_per_sec",
+                  # the p99 rides the final line as op_p99_ms; both
+                  # spellings of the promoted IOPS tail metric resolve
+                  "smallops_op_p99": "smallops.op_p99_ms",
+                  "smallops.op_p99": "smallops.op_p99_ms"}
 
 # per-metric default thresholds (used when --threshold is not given):
 # mesh.scaling_efficiency is a RATIO (per-chip efficiency of the
@@ -98,22 +103,37 @@ METRIC_ALIASES = {"stack_e2e_gbps": "stack_e2e.stack_e2e_gbps",
 # lands ROADMAP item 1's binary header should show up as a step DOWN.
 # Rounds predating the capture lack the metric -> clean skip until two
 # rounds carry it.
+# smallops.ops_per_sec / smallops.op_p99 (the binary-wire-protocol
+# PR): IOPS and op tail latency promoted to gated metrics now that the
+# waterfall capture measures them every round — millions of users
+# means IOPS, not just GB/s.  ops_per_sec is a throughput (higher is
+# better, the standard 2x jitter budget on a noisy loopback capture);
+# op_p99 is LOWER_IS_BETTER in milliseconds with a 0.5ms additive
+# slack (a sub-ms absolute wobble on a contended CI host must not read
+# as a 2x relative regression).  Both clean-skip (exit 0) until two
+# rounds carry the capture.
 METRIC_DEFAULT_THRESHOLDS = {"mesh.scaling_efficiency": 0.8,
                              "mesh.ici_share": 0.8,
                              "accel.occupancy": 0.8,
                              "accel.fleet_occupancy": 0.8,
-                             "smallops.header_share": 0.8}
+                             "smallops.header_share": 0.8,
+                             "smallops.ops_per_sec": 0.5,
+                             "smallops.op_p99_ms": 0.5}
 
 # metrics where GROWTH is the regression: mesh.ici_share (ISSUE 9) is
 # the ICI all-gather's share of the mesh reconstruct's device time,
 # measured by a jax.profiler trace window — a change that shifts the
 # reconstruct from compute-bound to gather-bound must fail the gate
 # even when headline GB/s barely moves.  Compared with an additive
-# 0.1-share slack (shares are small ratios: best-prior 0.0 must not
-# make a 2-percentage-point wobble fatal): ratio =
-# (best + 0.1) / (current + 0.1), regression when ratio < threshold.
-LOWER_IS_BETTER = {"mesh.ici_share", "smallops.header_share"}
-_SHARE_SLACK = 0.1
+# per-metric slack (shares are small ratios: best-prior 0.0 must not
+# make a 2-percentage-point wobble fatal; p99 is absolute ms): ratio =
+# (best + slack) / (current + slack), regression when ratio <
+# threshold.
+LOWER_IS_BETTER = {"mesh.ici_share", "smallops.header_share",
+                   "smallops.op_p99_ms"}
+_SLACKS = {"mesh.ici_share": 0.1, "smallops.header_share": 0.1,
+           "smallops.op_p99_ms": 0.5}
+_SHARE_SLACK = 0.1  # fallback for LOWER_IS_BETTER metrics not in _SLACKS
 
 
 def load_rounds(bench_dir: str) -> list[dict]:
@@ -208,9 +228,10 @@ def compare(rounds: list[dict], metric: str = "value",
         }
     lower = metric in LOWER_IS_BETTER
     if lower:
+        slack = _SLACKS.get(metric, _SHARE_SLACK)
         best = min(priors, key=lambda r: metric_value(r["line"], metric))
         best_v = float(metric_value(best["line"], metric))
-        ratio = (best_v + _SHARE_SLACK) / (float(cur) + _SHARE_SLACK)
+        ratio = (best_v + slack) / (float(cur) + slack)
     else:
         best = max(priors, key=lambda r: metric_value(r["line"], metric))
         best_v = float(metric_value(best["line"], metric))

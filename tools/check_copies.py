@@ -45,6 +45,10 @@ HOT_PATHS = (
     "ceph_tpu/osd/ec_util.py",
     "ceph_tpu/osd/ec_dispatch.py",
     "ceph_tpu/accel",
+    # the frame scratch pool (binary wire protocol PR): slab blocks
+    # fill via pack_into/slice assignment — a bytes()/join creeping in
+    # would re-materialize exactly what the pool exists to recycle
+    "ceph_tpu/common/slab.py",
 )
 
 ANNOTATION = "# copy-ok:"

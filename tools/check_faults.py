@@ -73,6 +73,10 @@ HOT_PATHS = (
     "ceph_tpu/common/tracing.py",
     "ceph_tpu/common/clocksync.py",
     "ceph_tpu/common/stack_ledger.py",
+    # the frame scratch pool (binary wire protocol PR): a swallowed
+    # error here would hide exactly the double-release/recycle bug
+    # that corrupts bytes on the wire
+    "ceph_tpu/common/slab.py",
 )
 
 ANNOTATION = "# swallow-ok:"

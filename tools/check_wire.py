@@ -1,0 +1,217 @@
+#!/usr/bin/env python3
+"""Static wire-protocol gate (CI) — stable message-type ids + the
+JSON-off-the-hot-path rule.
+
+The binary frame header (ceph_tpu/msg/message.py) routes decode by an
+integer ``TYPE_ID`` that is WIRE PROTOCOL: renumbering one silently
+breaks every peer, and reusing a retired id resurrects it as the wrong
+type.  This gate (check_counters style: pure AST, no imports) pins the
+registry against the committed manifest ``ceph_tpu/msg/wire_manifest
+.json``:
+
+- every ``@register``-ed Message class declares a literal int
+  ``TYPE_ID`` (0 < id < 65536, never 1 — reserved for batch frames);
+- no two classes share an id or a TYPE name;
+- a class whose manifest entry carries a DIFFERENT id fails
+  (renumbering); a class absent from the manifest fails (append it —
+  the manifest diff is the reviewable wire-protocol change); a
+  manifest entry with no class fails (move its id to ``retired``,
+  never delete); a ``retired`` id reused by any class fails.
+
+And the reason the binary header exists at all: JSON must not creep
+back onto the frame hot path.  ``json.dumps``/``json.loads`` calls in
+the frame modules (ceph_tpu/msg/) fail unless annotated
+``# wire-ok: <reason>`` on the call's line span or the line above —
+the allowlisted sites are the banner/auth handshake (line-based, not
+frames) and the ``WIRE_TAIL="json"`` admin-tail codec.  An annotation
+with no reason text fails.
+
+Usage: ``python tools/check_wire.py [repo_root]`` — exits 0 when
+clean, 1 with a per-problem report otherwise.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import pathlib
+import sys
+
+MANIFEST = "ceph_tpu/msg/wire_manifest.json"
+# where Message subclasses live (registration sites)
+CLASS_FILES = ("ceph_tpu/msg/messages.py", "ceph_tpu/msg/message.py")
+# the frame hot path: JSON here needs a wire-ok annotation
+JSON_BAN_FILES = (
+    "ceph_tpu/msg/message.py",
+    "ceph_tpu/msg/messenger.py",
+    "ceph_tpu/msg/messages.py",
+)
+TYPE_ID_BATCH = 1
+ANNOTATION = "# wire-ok:"
+
+
+def _registered_classes(tree: ast.Module) -> list[ast.ClassDef]:
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Name) and dec.id == "register":
+                    out.append(node)
+    return out
+
+
+def _class_consts(cls: ast.ClassDef) -> dict:
+    vals: dict = {}
+    for stmt in cls.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            name = stmt.targets[0].id
+            if isinstance(stmt.value, ast.Constant):
+                vals[name] = stmt.value.value
+    return vals
+
+
+def _annotated(lines: list[str], lineno: int, end_lineno: int) -> str | None:
+    for ln in range(lineno - 1, end_lineno + 1):
+        if 1 <= ln <= len(lines):
+            text = lines[ln - 1]
+            i = text.find(ANNOTATION)
+            if i >= 0:
+                reason = text[i + len(ANNOTATION):].strip()
+                return reason or None
+    return None
+
+
+def check(root: pathlib.Path) -> list[str]:
+    problems: list[str] = []
+
+    # -- 1. registry extraction (static)
+    seen_ids: dict[int, str] = {}
+    seen_names: dict[str, str] = {}
+    code_types: dict[str, int] = {}
+    for rel in CLASS_FILES:
+        path = root / rel
+        if not path.exists():
+            continue
+        try:
+            src = path.read_text()
+            tree = ast.parse(src)
+        except (OSError, SyntaxError) as e:
+            problems.append(f"{rel}: unparseable: {e}")
+            continue
+        for cls in _registered_classes(tree):
+            consts = _class_consts(cls)
+            tname = consts.get("TYPE")
+            tid = consts.get("TYPE_ID")
+            where = f"{rel}:{cls.lineno}"
+            if not isinstance(tname, str) or not tname:
+                problems.append(
+                    f"{where}: {cls.name} has no literal TYPE")
+                continue
+            if not isinstance(tid, int) or isinstance(tid, bool) \
+                    or not (0 < tid < 0x10000):
+                problems.append(
+                    f"{where}: {cls.name} has no literal int TYPE_ID "
+                    f"in (0, 65536) — ids are wire protocol")
+                continue
+            if tid == TYPE_ID_BATCH:
+                problems.append(
+                    f"{where}: {cls.name} uses TYPE_ID {TYPE_ID_BATCH} "
+                    f"(reserved for batch frames)")
+                continue
+            if tid in seen_ids:
+                problems.append(
+                    f"{where}: TYPE_ID {tid} collides: {cls.name} vs "
+                    f"{seen_ids[tid]}")
+                continue
+            if tname in seen_names:
+                problems.append(
+                    f"{where}: TYPE {tname!r} collides: {cls.name} vs "
+                    f"{seen_names[tname]}")
+                continue
+            seen_ids[tid] = cls.name
+            seen_names[tname] = cls.name
+            code_types[tname] = tid
+
+    # -- 2. manifest comparison
+    mpath = root / MANIFEST
+    try:
+        manifest = json.loads(mpath.read_text())
+        mtypes = dict(manifest.get("types", {}))
+        retired = list(manifest.get("retired", []))
+    except (OSError, ValueError) as e:
+        problems.append(f"{MANIFEST}: unreadable: {e}")
+        mtypes, retired = {}, []
+    if code_types:  # skip cross-checks if extraction already failed hard
+        for tname, tid in sorted(code_types.items()):
+            want = mtypes.get(tname)
+            if want is None:
+                problems.append(
+                    f"{MANIFEST}: {tname!r} (id {tid}) is not in the "
+                    f"manifest — append it (the manifest diff IS the "
+                    f"reviewable wire change)")
+            elif int(want) != tid:
+                problems.append(
+                    f"{MANIFEST}: {tname!r} renumbered {want} -> {tid} "
+                    f"— ids are wire protocol, never renumber")
+            if tid in retired:
+                problems.append(
+                    f"{MANIFEST}: {tname!r} reuses RETIRED id {tid}")
+        for tname, tid in sorted(mtypes.items()):
+            if tname not in code_types:
+                problems.append(
+                    f"{MANIFEST}: {tname!r} (id {tid}) has no "
+                    f"registered class — move its id to 'retired', "
+                    f"never delete a manifest entry")
+        if TYPE_ID_BATCH in {int(v) for v in mtypes.values()}:
+            problems.append(
+                f"{MANIFEST}: id {TYPE_ID_BATCH} is reserved for "
+                f"batch frames")
+
+    # -- 3. JSON off the frame hot path
+    for rel in JSON_BAN_FILES:
+        path = root / rel
+        if not path.exists():
+            continue
+        try:
+            src = path.read_text()
+            tree = ast.parse(src)
+        except (OSError, SyntaxError) as e:
+            problems.append(f"{rel}: unparseable: {e}")
+            continue
+        lines = src.splitlines()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if isinstance(fn, ast.Attribute) \
+                    and isinstance(fn.value, ast.Name) \
+                    and fn.value.id == "json" \
+                    and fn.attr in ("dumps", "loads"):
+                end = node.end_lineno or node.lineno
+                if _annotated(lines, node.lineno, end) is None:
+                    problems.append(
+                        f"{rel}:{node.lineno}: json.{fn.attr} on the "
+                        f"frame hot path — the binary header exists to "
+                        f"kill this; annotate '# wire-ok: <why>' only "
+                        f"for banner/auth/admin sites")
+    return problems
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    root = pathlib.Path(args[0]) if args else \
+        pathlib.Path(__file__).resolve().parent.parent
+    problems = check(root)
+    if problems:
+        print(f"check_wire: {len(problems)} problem(s):")
+        for p in problems:
+            print(f"  {p}")
+        return 1
+    print("check_wire: clean (ids pinned to the manifest; frame hot "
+          "path JSON-free)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
