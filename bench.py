@@ -1975,6 +1975,151 @@ def bench_qos(deadline: float | None = None) -> dict:
     }
 
 
+def bench_churn(deadline: float | None = None) -> dict:
+    """Live churn storm (ISSUE 15 layer 3): a REAL MiniCluster EC pool
+    rides one OSD kill/rejoin cycle under sustained client load, once
+    per scheduler policy.  Reports, per policy, the client p99 during
+    the storm vs quiescent; the headline ``protection`` is
+    fifo-storm-p99 / mclock-storm-p99 — how much client tail latency
+    the dmClock classes buy while REAL recovery (peering scans, EC
+    rebuild decodes/encodes under klass=recovery, pushes) competes for
+    the same OSDs — and ``recovery_gbps``, the bytes the primaries
+    re-pushed over the recovery wall.  Both gate the trajectory via
+    ``bench_regress --metric churn.protection`` /
+    ``--metric churn.recovery_gbps`` (clean-skip until two rounds
+    carry them).  Unlike bench_qos (a synthetic scheduler harness),
+    this is the whole storm path end to end; the invariants (zero
+    failed client ops, zero lost acked writes) are asserted, not just
+    measured."""
+    import asyncio
+
+    from ceph_tpu.rados.cluster import MiniCluster
+    from ceph_tpu.rados.storm import ClientLoad, StormDriver
+
+    seed_objects = 64
+    seed_bytes = 64 * 1024
+    payload = np.random.default_rng(23).integers(
+        0, 256, size=seed_bytes, dtype=np.uint8
+    ).tobytes()
+
+    async def run_policy(policy: str) -> dict:
+        async with MiniCluster(
+            n_osds=4,
+            # a small grant pool makes ADMISSION the contended resource
+            # (the accel fleet phase's trick): recovery pushes and
+            # client ops compete for the same slots, so the measured
+            # difference is the scheduler's policy, not loopback noise
+            config_overrides={"osd_op_queue": policy,
+                              "osd_op_queue_slots": 4},
+        ) as c:
+            cl = await c.client()
+            await cl.create_pool("churn", "erasure", pg_num=8)
+            io = cl.io_ctx("churn")
+            for i in range(seed_objects):  # the dataset recovery moves
+                await io.write_full(f"seed{i}", payload)
+
+            # quiescent client p99 (same load shape as the storm; a
+            # p99 needs hundreds of samples or it degenerates to the
+            # max of a handful)
+            quiet = ClientLoad(io, prefix="q", objects=8, size=4096,
+                               pause=0.002)
+            quiet.start(writers=4)
+            await asyncio.sleep(2.0)
+            await quiet.stop()
+            if quiet.failed:
+                raise RuntimeError(f"quiescent ops failed: {quiet.failed[:3]}")
+
+            load = ClientLoad(io, prefix="s", objects=8, size=4096,
+                              pause=0.002)
+            load.start(writers=4)
+            driver = StormDriver(c, cl, ["churn"])
+
+            def pushed() -> int:
+                return sum(
+                    o.perf.get("recovery").get("bytes_pushed")
+                    for o in c.osds.values()
+                )
+
+            victim = sorted(c.osds)[-1]
+            bytes0 = pushed()
+            t0 = time.perf_counter()
+            await c.kill_osd(victim)
+            await c.wait_for_osd_down(victim)
+            await asyncio.sleep(0.5)  # degraded-window writes pile up
+            # disk replacement: the victim rejoins EMPTY, so recovery
+            # backfills its whole shard set — real recovery volume,
+            # not just the degraded-window delta
+            from ceph_tpu.store import MemStore
+
+            c.stores[victim] = MemStore()
+            await c.restart_osd(victim)
+            await c.wait_for_osd_up(victim)
+            await driver.settle(timeout=45.0)
+            recovery_wall = time.perf_counter() - t0
+            moved = pushed() - bytes0
+            await load.stop()
+            if load.failed:
+                raise RuntimeError(f"storm ops failed: {load.failed[:3]}")
+            lost = await load.verify()
+            if lost:
+                raise RuntimeError(f"lost acked writes: {lost[:3]}")
+            return {
+                "storm_p99_ms": load.p99_ms(),
+                "quiet_p99_ms": quiet.p99_ms(),
+                "ops": len(load.latencies),
+                "recovery_bytes": moved,
+                "recovery_wall_s": round(recovery_wall, 3),
+            }
+
+    def _degradation(r: dict) -> float:
+        # each policy's own storm-vs-quiescent tail blowup: normalizing
+        # inside one cluster run cancels process-warmup drift between
+        # the two runs (the first run pays every jit compile)
+        return r["storm_p99_ms"] / max(r["quiet_p99_ms"], 1e-3)
+
+    # best-of-2 policy pairs (the headline's best-of discipline): a
+    # loopback p99 on a contended host is noisy, and a one-shot
+    # protection factor would flap the bench_regress gate
+    attempts = []
+    mclock = fifo = None
+    for _try in range(2):
+        m = asyncio.run(run_policy("mclock"))
+        if deadline is not None and deadline - time.time() < 30:
+            if mclock is None:
+                mclock, fifo = m, {"skipped": "deadline close"}
+            break
+        f = asyncio.run(run_policy("fifo"))
+        prot = round(_degradation(f) / max(_degradation(m), 1e-3), 3)
+        attempts.append(prot)
+        if mclock is None or prot >= max(attempts[:-1], default=0.0):
+            mclock, fifo = m, f
+        if deadline is not None and deadline - time.time() < 30:
+            break
+    out = {
+        "seed_objects": seed_objects,
+        "seed_bytes": seed_bytes,
+        "mclock": mclock,
+        "fifo": fifo,
+        # recovery throughput from the FIFO run when it exists:
+        # under mclock the whole point is that recovery gets SQUEEZED
+        # behind the client reservation, so its wall measures the
+        # squeeze, not the recovery path's capability
+        "recovery_gbps": round(
+            (fifo if "recovery_bytes" in fifo else mclock)
+            ["recovery_bytes"]
+            / max((fifo if "recovery_wall_s" in fifo else mclock)
+                  ["recovery_wall_s"], 1e-6) / 1e9, 6,
+        ),
+        "degradation": round(_degradation(mclock), 3),
+    }
+    if attempts:
+        # >= 1.0 means the dmClock classes held client p99 through the
+        # storm at least as well as fifo did (the ISSUE acceptance)
+        out["protection"] = max(attempts)
+        out["protection_attempts"] = attempts
+    return out
+
+
 # -- parent orchestration ----------------------------------------------------
 
 _BEST: dict | None = None
@@ -2652,6 +2797,31 @@ def main():
         _phase_note("qos", f"failed: {e!r:.120}", time.time() - t0_qos)
         log(f"phase qos failed: {e!r}")
 
+    # the live churn storm (ISSUE 15): a real MiniCluster kill/rejoin
+    # cycle per policy — client protection factor + recovery GB/s ride
+    # the trajectory every round (cpu-only, no device).  A full
+    # best-of-2 pass costs ~60s of wall: tight-budget runs (the
+    # child-death regression tests drive 12-45s budgets) skip it
+    # cleanly rather than blow the round's alarm
+    churn_res: dict = {}
+    t0_churn = time.time()
+    if t_end - time.time() < 90:
+        _phase_note("churn", "skipped (budget)", 0.0)
+        log("phase churn: skipped (budget too tight for a live storm)")
+    else:
+        try:
+            churn_res = bench_churn(deadline=t_end)
+            _phase_note("churn", "ok", time.time() - t0_churn)
+            log(f"phase churn: storm p99 "
+                f"{churn_res['mclock']['storm_p99_ms']}ms "
+                f"(quiet {churn_res['mclock']['quiet_p99_ms']}ms), "
+                f"protection {churn_res.get('protection')}x, "
+                f"recovery {churn_res['recovery_gbps']} GB/s")
+        except Exception as e:
+            _phase_note("churn", f"failed: {e!r:.120}",
+                        time.time() - t0_churn)
+            log(f"phase churn failed: {e!r}")
+
     # cpu codec-stack measurement (VERDICT r4 #4: stack_gbps must reach
     # the final line even when the TPU answers the first probe and the
     # jax-cpu combo never runs).  Runs SERIALLY after the accelerator
@@ -2811,6 +2981,8 @@ def main():
                     break
         if qos_res:
             final["qos"] = qos_res
+        if churn_res:
+            final["churn"] = churn_res
         # the per-phase attempt record ALWAYS ships — on a child dying
         # inside device acquisition this is the breakdown the bench
         # trajectory was previously missing entirely

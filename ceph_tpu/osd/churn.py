@@ -1,0 +1,483 @@
+"""ChurnPlanner: device-planned cluster churn at thousands-of-OSDs scale.
+
+ROADMAP item 4 / ISSUE 15 layer 1.  The TPU-vectorized CRUSH mapper
+(crush/mapper_jax — 350x+ over the scalar x-loop) stops being a
+benchmark here and becomes the engine of churn *planning*: generate a
+large synthetic cluster map (1k-10k OSDs under a multi-host crush
+tree), compute the FULL pre- and post-churn PG->OSD mapping in one
+batched device program per pool (the ``pg_to_up_acting_osds`` pipeline
+of osd/osdmap.py with every PG as one vector lane), and diff the two
+mappings into a :class:`ChurnPlan`:
+
+- which PGs remap (the peering work the storm will trigger),
+- expected shard/replica movement and bytes (the recovery work),
+- peering-wave fan-in per surviving OSD (how many MOSDPGScan requests
+  each member will serve when the new primaries peer),
+- peering waves per new primary (how many PGs each must re-peer).
+
+Bit-exactness contract: the device mapping equals the scalar
+``OSDMap.pg_to_up_acting_osds`` for every PG of every supported pool
+(:meth:`ChurnPlanner.verify_oracle` pins sampled PGs against the
+scalar path; tests/test_churn.py holds it at >=1k OSDs), so a plan is
+*exactly* what the live daemons will compute from the same map — the
+storm driver (rados/storm.py) verifies the predicted remapped-PG set
+against what a live cluster actually peers.
+
+Supported maps (the device fast path): no primary-affinity table and
+rules the vectorized mapper handles (``mapper_jax.supports``) — every
+map this module generates qualifies.  ``pg_temp``/``primary_temp``
+overlays are applied on the host afterwards (they are O(churn) dicts,
+never O(PGs)).  Unsupported pools fall back to the scalar pipeline
+per PG (``device=False`` in the result), so live MiniCluster maps can
+always be planned.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Mapping
+
+import numpy as np
+
+from ..crush.hashes import crush_hash32_2
+from ..crush.map import CrushMap
+from .osdmap import (
+    CEPH_OSD_EXISTS,
+    CEPH_OSD_UP,
+    CRUSH_ITEM_NONE,
+    FLAG_HASHPSPOOL,
+    OSDMap,
+    PGid,
+    Pool,
+)
+
+NONE = CRUSH_ITEM_NONE
+
+
+# -- synthetic cluster maps ---------------------------------------------------
+
+
+def synthetic_map(
+    n_osds: int,
+    osds_per_host: int = 16,
+    *,
+    replicated: "tuple[int, int] | None" = (3, 256),
+    ec: "tuple[dict, int] | None" = None,
+    seed_epoch: int = 1,
+) -> OSDMap:
+    """A large dev cluster: ``n_osds`` devices spread over
+    ``ceil(n/osds_per_host)`` crush host buckets under one straw2 root,
+    every OSD existing+up+in.
+
+    ``replicated`` = (size, pg_num) adds a host-fault-domain replicated
+    pool; ``ec`` = (profile dict, pg_num) adds an EC pool whose profile
+    is validated through the plugin registry exactly like the mon does.
+    Either may be None to skip that pool."""
+    hosts: list[list[int]] = [
+        list(range(i, min(i + osds_per_host, n_osds)))
+        for i in range(0, n_osds, osds_per_host)
+    ]
+    m = OSDMap(CrushMap.hierarchical(hosts))
+    m.epoch = seed_epoch
+    m.set_max_osd(n_osds)
+    for osd in range(n_osds):
+        m.mark_up(osd)
+        m.mark_in(osd)
+    if replicated is not None:
+        size, pg_num = replicated
+        m.create_replicated_pool(
+            "churn-rep", size=size, pg_num=pg_num, fault_domain_type=1
+        )
+    if ec is not None:
+        profile, pg_num = ec
+        m.set_erasure_code_profile("churn-ec-profile", profile)
+        m.create_erasure_pool(
+            "churn-ec", "churn-ec-profile", pg_num=pg_num,
+            fault_domain_type=1,
+        )
+    return m
+
+
+def apply_churn(
+    m: OSDMap,
+    *,
+    kill: Iterable[int] = (),
+    out: Iterable[int] = (),
+    add: int = 0,
+    rejoin: Iterable[int] = (),
+) -> OSDMap:
+    """The successor map one churn event produces: a COPY of ``m`` (the
+    wire round trip, so nothing aliases) with ``kill`` marked down,
+    ``out`` weighted out, ``rejoin`` marked up+in again, ``add`` fresh
+    OSDs appended to the last (or a new) host bucket, and the epoch
+    bumped — the same mutation order the mon's markdown/boot paths
+    apply."""
+    post = OSDMap.from_dict(m.to_dict())
+    post.epoch = m.epoch + 1
+    for osd in kill:
+        post.mark_down(osd)
+    for osd in out:
+        post.mark_out(osd)
+    for osd in rejoin:
+        post.mark_up(osd)
+        post.mark_in(osd)
+    if add:
+        first_new = post.max_osd
+        new_ids = list(range(first_new, first_new + add))
+        # new devices get their own host bucket (the common expansion
+        # shape: a new chassis, not hot-plugged disks)
+        root = post.crush.buckets[post.crush.root_id()]
+        hid = post.crush.make_bucket(
+            root.alg, 1, new_ids,
+            [0x10000] * add, name=f"host-add{post.epoch}",
+        )
+        w = post.crush.buckets[hid].weight
+        root.items.append(hid)
+        root.item_weights.append(w)
+        root.weight += w
+        for osd in new_ids:
+            post.mark_up(osd)
+            post.mark_in(osd)
+    return post
+
+
+# -- the device mapping pipeline ----------------------------------------------
+
+
+def _stable_mod_vec(x: np.ndarray, b: int, bmask: int) -> np.ndarray:
+    """Vectorized ceph_stable_mod (reference:include/rados.h:84)."""
+    masked = x & np.uint32(bmask)
+    return np.where(masked < b, masked, x & np.uint32(bmask >> 1))
+
+
+def _pps_vec(pool: Pool, seeds: np.ndarray) -> np.ndarray:
+    """Vectorized ``raw_pg_to_pps`` (reference:osd_types.cc:1357): the
+    crush placement seed for every PG of the pool in one pass."""
+    ps = _stable_mod_vec(
+        seeds.astype(np.uint32), pool.pgp_num, pool.pgp_num_mask
+    )
+    if pool.flags & FLAG_HASHPSPOOL:
+        return crush_hash32_2(
+            ps.astype(np.uint32), np.uint32(pool.id)
+        ).astype(np.uint32)
+    return (ps + np.uint32(pool.id)).astype(np.uint32)
+
+
+@dataclasses.dataclass
+class PoolMapping:
+    """One pool's full PG->OSD mapping: ``acting`` is [pg_num, width]
+    int32 (CRUSH_ITEM_NONE holes; replicated rows compacted left),
+    ``primary`` [pg_num] int32 (-1 = no primary).  ``device`` says the
+    batched mapper produced it (False = scalar fallback)."""
+
+    pool_id: int
+    acting: np.ndarray
+    primary: np.ndarray
+    device: bool
+
+    def acting_of(self, seed: int) -> list[int]:
+        return [int(o) for o in self.acting[seed]]
+
+
+class ChurnPlanner:
+    """Plan churn scenarios for one cluster map on device.
+
+    The planner never mutates its map; :func:`apply_churn` produces the
+    post-churn successor and :meth:`plan` diffs the two device
+    mappings into a :class:`ChurnPlan`."""
+
+    def __init__(self, osdmap: OSDMap):
+        self.osdmap = osdmap
+
+    # -- full-map computation ------------------------------------------------
+
+    def map_pool(self, m: OSDMap, pool: Pool) -> PoolMapping:
+        """The full (acting, primary) table for one pool — one batched
+        device program over every PG when the map/rule shape is
+        supported, the scalar per-PG pipeline otherwise."""
+        if self._device_ok(m, pool):
+            return self._map_pool_device(m, pool)
+        return self._map_pool_scalar(m, pool)
+
+    def map_all(self, m: OSDMap | None = None) -> dict[int, PoolMapping]:
+        m = m if m is not None else self.osdmap
+        return {pid: self.map_pool(m, pool) for pid, pool in m.pools.items()}
+
+    @staticmethod
+    def _device_ok(m: OSDMap, pool: Pool) -> bool:
+        from ..crush import mapper_jax
+
+        if m.osd_primary_affinity is not None and any(
+            a != 0x10000 for a in m.osd_primary_affinity
+        ):
+            # the affinity re-draw is a per-PG scalar walk; none of the
+            # maps this module generates set it
+            return False
+        ruleno = m.crush.find_rule(pool.crush_ruleset, pool.type, pool.size)
+        if ruleno < 0:
+            return False
+        try:
+            return mapper_jax.supports(m.crush, ruleno)
+        except Exception:
+            return False
+
+    def _map_pool_device(self, m: OSDMap, pool: Pool) -> PoolMapping:
+        from ..crush import mapper_jax
+
+        ruleno = m.crush.find_rule(pool.crush_ruleset, pool.type, pool.size)
+        seeds = np.arange(pool.pg_num, dtype=np.uint32)
+        pps = _pps_vec(pool, seeds)
+        # the OSDMap's in/out weights are the rejection vector, exactly
+        # like the scalar path (OSDMap.cc:1567); crush item ids can
+        # exceed max_osd only on maps with gaps, which set_max_osd rules
+        # out here
+        weights = list(m.osd_weight)
+        raw = np.asarray(
+            mapper_jax.vec_do_rule(m.crush, ruleno, pps, pool.size, weights),
+            dtype=np.int32,
+        )
+        acting, primary = self._raw_to_up_vec(m, pool, raw)
+        self._apply_temp_overlays(m, pool, acting, primary)
+        return PoolMapping(pool.id, acting, primary, device=True)
+
+    def _map_pool_scalar(self, m: OSDMap, pool: Pool) -> PoolMapping:
+        width = pool.size
+        acting = np.full((pool.pg_num, width), NONE, dtype=np.int32)
+        primary = np.full((pool.pg_num,), -1, dtype=np.int32)
+        for seed in range(pool.pg_num):
+            _u, _up, act, prim = m.pg_to_up_acting_osds(PGid(pool.id, seed))
+            for i, o in enumerate(act[:width]):
+                acting[seed, i] = o
+            primary[seed] = prim
+        return PoolMapping(pool.id, acting, primary, device=False)
+
+    @staticmethod
+    def _raw_to_up_vec(
+        m: OSDMap, pool: Pool, raw: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Vectorized ``_raw_to_up_osds``: down/dne filtering over the
+        whole [pg_num, width] table (EC keeps positional holes,
+        replicated compacts left), plus first-up primary selection."""
+        n = max(1, m.max_osd)
+        state = np.zeros(n, dtype=np.int32)
+        state[: len(m.osd_state)] = np.asarray(m.osd_state, dtype=np.int32)
+        up_bits = CEPH_OSD_UP | CEPH_OSD_EXISTS
+        up_lut = (state & up_bits) == up_bits
+        valid = (raw != NONE) & (raw >= 0) & (raw < n)
+        safe = np.where(valid, raw, 0)
+        keep = valid & up_lut[safe]
+        if pool.can_shift_osds():
+            # compact each row left (stable): the reference's firstn
+            # result drops down members and closes the gaps
+            order = np.argsort(~keep, axis=1, kind="stable")
+            acting = np.take_along_axis(
+                np.where(keep, raw, NONE), order, axis=1
+            )
+        else:
+            acting = np.where(keep, raw, NONE).astype(np.int32)
+        filled = acting != NONE
+        first = np.argmax(filled, axis=1)
+        rows = np.arange(acting.shape[0])
+        primary = np.where(
+            filled.any(axis=1), acting[rows, first], -1
+        ).astype(np.int32)
+        return acting.astype(np.int32), primary
+
+    @staticmethod
+    def _apply_temp_overlays(
+        m: OSDMap, pool: Pool, acting: np.ndarray, primary: np.ndarray
+    ) -> None:
+        """pg_temp / primary_temp host overlay (O(overrides), not
+        O(PGs)) — applied through the scalar path so the semantics can
+        never drift from osdmap.py."""
+        if not m.pg_temp and not m.primary_temp:
+            return
+        width = acting.shape[1]
+        touched = {
+            pg.seed for pg in list(m.pg_temp) + list(m.primary_temp)
+            if pg.pool == pool.id and 0 <= pg.seed < acting.shape[0]
+        }
+        for seed in touched:
+            _u, _up, act, prim = m.pg_to_up_acting_osds(PGid(pool.id, seed))
+            acting[seed, :] = NONE
+            for i, o in enumerate(act[:width]):
+                acting[seed, i] = o
+            primary[seed] = prim
+
+    # -- the oracle pin ------------------------------------------------------
+
+    def verify_oracle(
+        self, m: OSDMap | None = None, samples: int = 64,
+        rng: "np.random.Generator | None" = None,
+    ) -> int:
+        """Bit-match sampled PGs of every pool against the scalar
+        ``pg_to_up_acting_osds`` oracle.  Returns the number of PGs
+        checked; raises AssertionError with the first divergence —
+        a plan from a mapping that disagrees with what live daemons
+        compute would 'predict' storms that never happen."""
+        m = m if m is not None else self.osdmap
+        rng = rng or np.random.default_rng(0)
+        checked = 0
+        for pool in m.pools.values():
+            mapping = self.map_pool(m, pool)
+            take = min(samples, pool.pg_num)
+            seeds = rng.choice(pool.pg_num, size=take, replace=False)
+            for seed in seeds:
+                seed = int(seed)
+                _u, _up, act, prim = m.pg_to_up_acting_osds(
+                    PGid(pool.id, seed)
+                )
+                width = mapping.acting.shape[1]
+                want = (list(act[:width]) + [NONE] * width)[:width]
+                got = [int(o) for o in mapping.acting[seed]]
+                assert got == want, (
+                    f"pool {pool.id} pg {seed}: device {got} != "
+                    f"oracle {want}"
+                )
+                assert int(mapping.primary[seed]) == prim, (
+                    f"pool {pool.id} pg {seed}: device primary "
+                    f"{int(mapping.primary[seed])} != oracle {prim}"
+                )
+                checked += 1
+        return checked
+
+    # -- the plan ------------------------------------------------------------
+
+    def plan(
+        self,
+        post: OSDMap,
+        *,
+        bytes_per_pg: "Mapping[int, int] | int" = 0,
+    ) -> "ChurnPlan":
+        """Diff this planner's map against its churned successor.
+
+        ``bytes_per_pg`` scales the movement estimate: bytes of logical
+        data per PG (int for all pools, or {pool_id: bytes}).  EC pools
+        move ``bytes/k`` per remapped shard slot; replicated pools move
+        the full PG bytes per new member."""
+        pre_maps = self.map_all(self.osdmap)
+        post_maps = self.map_all(post)
+        remapped: dict[int, list[dict]] = {}
+        moved_shards = 0
+        movement_bytes = 0
+        fan_in: dict[int, int] = {}
+        waves: dict[int, int] = {}
+        device = True
+        for pid, pre in pre_maps.items():
+            pool = self.osdmap.pools[pid]
+            postm = post_maps.get(pid)
+            if postm is None:
+                continue
+            device = device and pre.device and postm.device
+            k = self._pool_k(pool)
+            per_pg = (
+                bytes_per_pg.get(pid, 0)
+                if isinstance(bytes_per_pg, Mapping) else int(bytes_per_pg)
+            )
+            changed = np.nonzero(
+                (pre.acting != postm.acting).any(axis=1)
+                | (pre.primary != postm.primary)
+            )[0]
+            entries = []
+            for seed in changed:
+                seed = int(seed)
+                pre_row = [int(o) for o in pre.acting[seed]]
+                post_row = [int(o) for o in postm.acting[seed]]
+                if pool.can_shift_osds():
+                    moved = [
+                        o for o in post_row
+                        if o != NONE and o not in pre_row
+                    ]
+                    shard_bytes = per_pg
+                else:
+                    # positional: a slot whose holder changed must be
+                    # rebuilt on the new holder
+                    moved = [
+                        post_row[i] for i in range(len(post_row))
+                        if post_row[i] != NONE and post_row[i] != pre_row[i]
+                    ]
+                    shard_bytes = per_pg // max(1, k)
+                moved_shards += len(moved)
+                movement_bytes += shard_bytes * len(moved)
+                prim = int(postm.primary[seed])
+                if prim >= 0:
+                    waves[prim] = waves.get(prim, 0) + 1
+                    # the new primary scans every post-acting member
+                    # (MOSDPGScan fan-in; its own shard scans locally)
+                    for o in post_row:
+                        if o != NONE and o != prim:
+                            fan_in[o] = fan_in.get(o, 0) + 1
+                entries.append({
+                    "seed": seed,
+                    "pre": pre_row,
+                    "post": post_row,
+                    "pre_primary": int(pre.primary[seed]),
+                    "post_primary": prim,
+                    "moved": moved,
+                })
+            if entries:
+                remapped[pid] = entries
+        return ChurnPlan(
+            pre_epoch=self.osdmap.epoch,
+            post_epoch=post.epoch,
+            remapped=remapped,
+            moved_shards=moved_shards,
+            movement_bytes=movement_bytes,
+            fan_in=fan_in,
+            waves=waves,
+            device=device,
+        )
+
+    def _pool_k(self, pool: Pool) -> int:
+        if not pool.is_erasure():
+            return 1
+        # k from the stored profile (no codec instantiation per plan);
+        # size-1 (m=1) when the profile went missing
+        profile = self.osdmap.get_erasure_code_profile(
+            pool.erasure_code_profile
+        )
+        try:
+            return max(1, int(profile.get("k", pool.size - 1)))
+        except (TypeError, ValueError):
+            return max(1, pool.size - 1)
+
+
+@dataclasses.dataclass
+class ChurnPlan:
+    """The device-planned churn outcome (see module docstring)."""
+
+    pre_epoch: int
+    post_epoch: int
+    # pool id -> [{"seed", "pre", "post", "pre_primary", "post_primary",
+    #              "moved"}]
+    remapped: dict[int, list[dict]]
+    moved_shards: int
+    movement_bytes: int
+    fan_in: dict[int, int]   # osd -> expected MOSDPGScan requests
+    waves: dict[int, int]    # new primary -> PGs it must re-peer
+    device: bool
+
+    def remapped_pgs(self, pool_id: int | None = None) -> set[str]:
+        """The predicted remap set as ``"pool.seedhex"`` PG names —
+        comparable to what a live cluster's maps/peering produce."""
+        out: set[str] = set()
+        for pid, entries in self.remapped.items():
+            if pool_id is not None and pid != pool_id:
+                continue
+            for e in entries:
+                out.add(str(PGid(pid, e["seed"])))
+        return out
+
+    def summary(self) -> dict:
+        n_remapped = sum(len(v) for v in self.remapped.values())
+        return {
+            "pre_epoch": self.pre_epoch,
+            "post_epoch": self.post_epoch,
+            "pgs_remapped": n_remapped,
+            "moved_shards": self.moved_shards,
+            "movement_bytes": self.movement_bytes,
+            "peering_waves": dict(sorted(self.waves.items())),
+            "scan_fan_in": dict(sorted(self.fan_in.items())),
+            "max_fan_in": max(self.fan_in.values(), default=0),
+            "device": self.device,
+        }

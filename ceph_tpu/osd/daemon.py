@@ -78,6 +78,12 @@ EAGAIN = 11
 EDQUOT = 122  # pool quota full (reference: -EDQUOT on FLAG_FULL_QUOTA)
 EINVAL = 22
 ESTALE = 116
+# a sub-op's peer connection died while the map still lists the peer
+# as up (SIGKILL-before-markdown window): CONNECTION failure, not a
+# store error — the op folds to -EAGAIN so the client retries on the
+# post-markdown map instead of surfacing EIO (ISSUE 15 zero-failed-ops
+# invariant; the reference requeues the op through peering instead)
+ENOTCONN = 107
 EOPNOTSUPP = 95
 
 OI_KEY = "_"  # object-info xattr (reference OI_ATTR)
@@ -152,7 +158,8 @@ class _Waiter(WaiterBase):
             self.results[shard] = result
 
     def fail_key(self, key: int) -> None:
-        self.complete(key, -EIO)
+        # a reset IS a connection failure: fold like the connect path
+        self.complete(key, -ENOTCONN)
 
 
 class _ReadWaiter(WaiterBase):
@@ -429,6 +436,40 @@ class OSD(Dispatcher):
         prec.add_counter("pushes", "objects/shards pushed")
         prec.add_counter("reservation_waits",
                          "recovery passes that queued for a reservation")
+        # churn/peering observability (ISSUE 15): the storm matrix pins
+        # its invariants on these — kicks vs passes proves back-to-back
+        # epoch bumps COALESCE instead of stacking concurrent passes
+        prec.add_counter("kicks", "recovery wakeups requested (map epochs)")
+        prec.add_counter("passes", "recovery passes actually run")
+        prec.add_counter("coalesced_kicks",
+                         "kicks absorbed into an already-pending pass")
+        prec.add_counter("interrupted_passes",
+                         "passes that saw a newer map land mid-pass")
+        prec.add_counter("scans_served",
+                         "MOSDPGScan requests answered (GetInfo/GetLog)")
+        prec.add_counter("bytes_pushed",
+                         "recovery/backfill payload bytes pushed")
+        prec.add_counter("divergent_rollbacks",
+                         "divergent log entries rolled back from stashes")
+        prec.add_counter("reservations_revoked",
+                         "held reservations preempted by a higher-"
+                         "priority PG (revoke received)")
+        # map-churn accounting, fed off _handle_map/_note_intervals —
+        # the live-cluster side of the ChurnPlanner's predictions
+        # (osd/churn.py): pgs_remapped here is what the plan's
+        # remapped set must match
+        pchurn = self.perf.create("churn")
+        pchurn.add_counter("maps_applied", "osdmap epochs applied")
+        pchurn.add_counter(
+            "pgs_remapped",
+            "locally-hosted PGs whose acting set changed on a map advance",
+        )
+        pchurn.add_counter("intervals_recorded",
+                           "past-interval records appended")
+        pchurn.add_counter(
+            "map_gap_refetches",
+            "full-map refetches after an incremental epoch gap",
+        )
         # admission control (reference:src/osd/OSD.h local_reserver /
         # remote_reserver; config_opts.h:621 osd_max_backfills): two
         # independent slot pools so primaries reserving toward each
@@ -1088,10 +1129,14 @@ class OSD(Dispatcher):
             # delta chain does not bridge to our epoch: fetch a full map
             # (reference:src/osd/OSD.cc handle_osd_map request_full path)
             if conn is not None:
+                # count only refetches actually SENT — a conn-less
+                # delivery observing a gap resolves via the next push
+                self.perf.get("churn").inc("map_gap_refetches")
                 conn.send(messages.MMonGetMap(have=None))
             return
         old = self.osdmap
         self.osdmap = m
+        self.perf.get("churn").inc("maps_applied")
         self._codecs.clear()  # pools/profiles may have changed
         if self.accel_client is not None:
             # the accelerator fleet rides the map (ISSUE 11): a mon
@@ -1139,6 +1184,7 @@ class OSD(Dispatcher):
                 continue  # pool vanished / unparsable: nothing to record
             if old_acting == new_acting and old_primary == new_primary:
                 continue
+            self.perf.get("churn").inc("pgs_remapped")
             start = self._interval_start.get(pgid_s, old.epoch)
             self._interval_start[pgid_s] = new.epoch
             for cid, shard in locs:
@@ -1155,6 +1201,7 @@ class OSD(Dispatcher):
                     {PAST_INTERVALS_KEY: past.to_json()},
                 )
                 self.store.apply(txn)
+                self.perf.get("churn").inc("intervals_recorded")
 
     def _kick_snap_trim(self) -> None:
         """Schedule clone trimming for pools whose removed_snaps grew
@@ -2419,7 +2466,8 @@ class OSD(Dispatcher):
                 pass
             retry = sorted(
                 set(waiter.pending)
-                | {k for k, r in waiter.results.items() if r == -EIO}
+                | {k for k, r in waiter.results.items()
+                   if r in (-EIO, -ENOTCONN)}
             )
             if not retry or attempt == attempts - 1:
                 return
@@ -2474,6 +2522,10 @@ class OSD(Dispatcher):
         if any(r != 0 for r in waiter.results.values()):
             if any(r == -ESTALE for r in waiter.results.values()):
                 return -EAGAIN  # demoted primary; client re-targets
+            if any(r == -ENOTCONN for r in waiter.results.values()):
+                # a member died faster than the map: the client waits
+                # out the markdown and retries degraded — never EIO
+                return -EAGAIN
             return -EIO
         self._mark_committed(pg, version, present)
         return 0
@@ -2877,10 +2929,12 @@ class OSD(Dispatcher):
         try:
             conn = await self.messenger.connect(addr, f"osd.{osd}")
         except (ConnectionError, OSError):
-            # peer died before the map said so: fail this shard, not the op
+            # peer died before the map said so: fail this shard as a
+            # CONNECTION loss (the gather folds it to -EAGAIN, the
+            # client retries on the post-markdown map), not the op
             w = self._write_waiters.get(tid)
             if w:
-                w.complete(shard, -EIO)
+                w.complete(shard, -ENOTCONN)
             return
         conn.send(
             messages.MOSDECSubOpWrite(
@@ -3861,7 +3915,7 @@ class OSD(Dispatcher):
                         self.osdmap.get_addr(osd), f"osd.{osd}"
                     )
                 except (ConnectionError, OSError):
-                    waiter.complete(osd, -EIO)
+                    waiter.complete(osd, -ENOTCONN)
                     continue
                 self.op_tracker.mark_by_trace(
                     current_trace.get(), "sub_op_sent"
@@ -3884,6 +3938,9 @@ class OSD(Dispatcher):
         if waiter.pending:
             return -EIO
         if any(r != 0 for r in waiter.results.values()):
+            if any(r == -ENOTCONN for r in waiter.results.values()):
+                return -EAGAIN  # dead replica pre-markdown: retry on
+                # the next map, the write lands degraded
             return -EIO
         return 0
 

@@ -49,6 +49,7 @@ import asyncio
 import json
 import logging
 
+from ..common.tracing import current_trace, new_trace_id, record_span
 from ..msg import messages
 from ..store import CollectionId, ObjectId, Transaction
 from .ec_util import StripeHashes
@@ -86,6 +87,20 @@ class RecoveryManager:
         # pushes this primary has in flight, with high-water mark
         self.active_pushes = 0
         self.max_active_pushes = 0
+        # peering re-entrancy (ISSUE 15): one pass runs at a time; map
+        # epochs arriving faster than passes complete COALESCE into the
+        # one pending wakeup (counted), never stack concurrent passes.
+        # _pass_map is the epoch SNAPSHOT the running pass computes
+        # against — acting sets, stray reachability and the activation
+        # les all come from one map, so a mid-pass push can never mix
+        # two epochs' views (the newer epoch re-kicks a whole pass)
+        self._pass_running = False
+        self._pass_map = None
+        # PGs whose remote reservation was revoked mid-pass: the push
+        # loop stops STARTING new pushes for them (in-flight ones
+        # finish — single bounded sub-writes) so preemption actually
+        # frees the target's osd_max_backfills slot
+        self._revoked: set[str] = set()
 
     def start(self) -> None:
         if self._task is None:
@@ -106,7 +121,14 @@ class RecoveryManager:
         return self.osd.perf.get("recovery").get("pushes")
 
     def kick(self) -> None:
-        """Called on every new map epoch."""
+        """Called on every new map epoch.  Kicks while a pass is
+        running (or one is already pending) coalesce — the set event
+        absorbs them into exactly one follow-up pass on the NEWEST
+        map, the re-entrancy contract the storm matrix pins."""
+        prec = self.osd.perf.get("recovery")
+        prec.inc("kicks")
+        if self._pass_running or self._wakeup.is_set():
+            prec.inc("coalesced_kicks")
         self._wakeup.set()
 
     def fail_member(self, osd_id: int) -> None:
@@ -123,6 +145,7 @@ class RecoveryManager:
     def handle_scan(self, conn, msg: messages.MOSDPGScan) -> None:
         """Shard side: report objects + log + info + past intervals for
         one PG shard (GetInfo + GetLog in one round trip)."""
+        self.osd.perf.get("recovery").inc("scans_served")
         objects, log, info, intervals = self._local_scan(
             msg.pgid, msg.store_shard
         )
@@ -149,7 +172,28 @@ class RecoveryManager:
         a slot frees; as PRIMARY we resolve the waiting future."""
         if msg.op == "request":
             key = (msg.from_osd, msg.pgid)
-            fut = self.osd.remote_reserver.request(key, msg.prio or 0)
+
+            def _on_preempt(key=key, conn=conn, pgid=msg.pgid):
+                # a strictly-higher-priority PG evicted this grant
+                # (reference AsyncReserver preempt_by_prio + the
+                # MBackfillReserve REVOKE flow): tell the primary its
+                # slot is gone so it stops pushing and re-reserves
+                try:
+                    conn.send(messages.MRecoveryReserve(
+                        pgid=pgid, tid=0, from_osd=self.osd.osd_id,
+                        op="revoke", prio=0,
+                    ))
+                # swallow-ok: primary already gone; its reset frees everything
+                except (ConnectionError, OSError):
+                    pass
+
+            # the grant is REVOCABLE (on_preempt): under backfill-vs-
+            # recovery contention a more-degraded PG's request preempts
+            # a less-degraded one's held slot instead of queueing
+            # behind it (the storm matrix exercises this at scale)
+            fut = self.osd.remote_reserver.request(
+                key, msg.prio or 0, on_preempt=_on_preempt
+            )
             if not fut.done():
                 # contention is visible on the OSD whose slots are full
                 self.osd.perf.get("recovery").inc("reservation_waits")
@@ -157,6 +201,7 @@ class RecoveryManager:
             async def _grant():
                 try:
                     await fut
+                # swallow-ok: daemon stopping: the grant task dies with its reserver
                 except asyncio.CancelledError:
                     return
                 try:
@@ -166,6 +211,7 @@ class RecoveryManager:
                             from_osd=self.osd.osd_id, op="grant", prio=0,
                         )
                     )
+                # swallow-ok: primary vanished pre-grant; the slot is cancelled back
                 except (ConnectionError, OSError):
                     # primary vanished before the grant: free the slot
                     self.osd.remote_reserver.cancel(key)
@@ -179,26 +225,44 @@ class RecoveryManager:
                 entry[0].set_result(True)
         elif msg.op == "release":
             self.osd.remote_reserver.cancel((msg.from_osd, msg.pgid))
+        elif msg.op == "revoke":
+            # primary side of a preemption: a push target took our slot
+            # away for a higher-priority PG.  The in-flight pushes to it
+            # finish (they are single bounded sub-writes), the pass is
+            # flagged for retry and re-reserves at its own priority
+            self.osd.perf.get("recovery").inc("reservations_revoked")
+            logger.info(
+                "%s: recovery reservation for pg %s revoked by osd.%d",
+                self.osd.name, msg.pgid, msg.from_osd,
+            )
+            self._revoked.add(msg.pgid)
+            self._retry_needed = True
+            self._wakeup.set()
 
     async def _acquire_reservations(
-        self, pg: PGid, members: set[int]
+        self, pg: PGid, members: set[int], prio: int = 0
     ) -> list[int] | None:
         """Local slot first, then one remote slot per distinct push
         target (reference PG states WaitLocalRecoveryReserved ->
         WaitRemoteRecoveryReserved).  Returns the remote members to
         release later, or None when the budget ran out — the caller
         defers the pass, releasing everything, so a queued cluster
-        cannot deadlock on criss-cross reservations."""
+        cannot deadlock on criss-cross reservations.  ``prio`` is the
+        PG's recovery priority (more degraded = higher, the reference's
+        get_recovery_priority shape): it orders reserver queues and may
+        PREEMPT a held lower-priority revocable grant on a full
+        target."""
         osd = self.osd
         perf = osd.perf.get("recovery")
         timeout = osd.config.get("osd_recovery_reserve_timeout")
         lkey = ("local", str(pg))
-        lfut = osd.local_reserver.request(lkey)
+        lfut = osd.local_reserver.request(lkey, prio)
         if not lfut.done():
             perf.inc("reservation_waits")
         try:
             async with asyncio.timeout(timeout):
                 await lfut
+        # swallow-ok: reservation timeout = deferred pass (slot cancelled, caller retries)
         except TimeoutError:
             osd.local_reserver.cancel(lkey)
             return None
@@ -208,7 +272,7 @@ class RecoveryManager:
         held: list[int] = []
         try:
             for member in sorted(m for m in members if m != osd.osd_id):
-                ok = await self._reserve_remote(pg, member, timeout)
+                ok = await self._reserve_remote(pg, member, timeout, prio)
                 if not ok:
                     self._release_reservations(pg, held)
                     return None
@@ -216,12 +280,15 @@ class RecoveryManager:
             # self-pushes take our own remote slot directly (local fast
             # path)
             if osd.osd_id in members:
-                sfut = osd.remote_reserver.request((osd.osd_id, str(pg)))
+                sfut = osd.remote_reserver.request(
+                    (osd.osd_id, str(pg)), prio
+                )
                 if not sfut.done():
                     perf.inc("reservation_waits")
                 try:
                     async with asyncio.timeout(timeout):
                         await sfut
+                # swallow-ok: self-slot timeout = deferred pass (slots released, caller retries)
                 except TimeoutError:
                     osd.remote_reserver.cancel((osd.osd_id, str(pg)))
                     self._release_reservations(pg, held)
@@ -235,10 +302,11 @@ class RecoveryManager:
         return held
 
     async def _reserve_remote(
-        self, pg: PGid, member: int, timeout: float
+        self, pg: PGid, member: int, timeout: float, prio: int = 0
     ) -> bool:
         osd = self.osd
-        addr = osd.osdmap.get_addr(member) if osd.osdmap else None
+        m = self._map()
+        addr = m.get_addr(member) if m else None
         if not addr:
             return False
         tid = osd._new_tid()
@@ -249,12 +317,13 @@ class RecoveryManager:
             conn.send(
                 messages.MRecoveryReserve(
                     pgid=str(pg), tid=tid, from_osd=osd.osd_id,
-                    op="request", prio=0,
+                    op="request", prio=prio,
                 )
             )
             async with asyncio.timeout(timeout):
                 await fut
             return True
+        # swallow-ok: reserve failed/timed out: slot withdrawn, pass defers
         except (TimeoutError, ConnectionError, OSError):
             self._withdraw_remote(pg, addr, member)
             return False
@@ -281,6 +350,7 @@ class RecoveryManager:
                         op="release", prio=0,
                     )
                 )
+            # swallow-ok: peer death frees the slot via ms_handle_reset
             except (ConnectionError, OSError):
                 pass  # peer death frees the slot via ms_handle_reset
 
@@ -295,7 +365,8 @@ class RecoveryManager:
             if member == osd.osd_id:
                 osd.remote_reserver.cancel((osd.osd_id, str(pg)))
                 continue
-            addr = osd.osdmap.get_addr(member) if osd.osdmap else None
+            m = self._map()
+            addr = m.get_addr(member) if m else None
             if not addr:
                 continue
 
@@ -308,6 +379,7 @@ class RecoveryManager:
                             op="release", prio=0,
                         )
                     )
+                # swallow-ok: peer death already freed the slot (ms_handle_reset)
                 except (ConnectionError, OSError):
                     pass  # peer death already freed the slot (ms_handle_reset)
 
@@ -323,6 +395,7 @@ class RecoveryManager:
         objects: dict[str, dict] = {}
         try:
             oids = store.list_objects(cid)
+        # swallow-ok: collection absent = empty shard scan (nothing stored yet)
         except KeyError:
             return {}, [], peering.PGShardInfo().to_dict(), []
         log_entries = read_log(store, cid, shard)
@@ -337,6 +410,7 @@ class RecoveryManager:
                 continue
             try:
                 oi = json.loads(store.getattr(cid, oid, OI_KEY))
+            # swallow-ok: no object-info xattr yet: version comes from the log
             except KeyError:
                 oi = {}
             version = max(
@@ -356,6 +430,7 @@ class RecoveryManager:
             raw = omap.get(peering.INFO_KEY)
             stored_info = json.loads(raw) if raw else None
             intervals_raw = omap.get(peering.PAST_INTERVALS_KEY)
+        # swallow-ok: no pgmeta omap yet: fresh shard, default info
         except KeyError:
             pass
         info = peering.derive_info(stored_info, log_entries).to_dict()
@@ -373,17 +448,23 @@ class RecoveryManager:
                 await self._wakeup.wait()
                 self._wakeup.clear()
                 self._retry_needed = False
+                self._pass_running = True
+                self.osd.perf.get("recovery").inc("passes")
                 try:
                     await self._recover_all()
                 except asyncio.CancelledError:
                     raise
+                # swallow-ok: pass flagged for retry below (and logged)
                 except Exception:
                     logger.exception("%s: recovery pass failed", self.osd.name)
                     self._retry_needed = True
+                finally:
+                    self._pass_running = False
                 if self._retry_needed and not self._wakeup.is_set():
                     # partial pass (peer raced away): back off and retry
                     await asyncio.sleep(0.5)
                     self._wakeup.set()
+        # swallow-ok: daemon stop: the recovery loop ends
         except asyncio.CancelledError:
             pass
 
@@ -391,29 +472,66 @@ class RecoveryManager:
         osd = self.osd
         if osd.osdmap is None:
             return
-        flags = osd.osdmap.cluster_flags
-        if "norecover" in flags or "nobackfill" in flags:
-            # `ceph osd set norecover|nobackfill` parks the pass; the
-            # unset's map epoch re-kicks it (recovery and backfill are
-            # one unified push path here, so either flag parks it)
-            self._retry_needed = False
-            return
-        for pool in list(osd.osdmap.pools.values()):
-            for pg in osd.osdmap.pgs_of_pool(pool.id):
-                _up, _upp, acting, primary = osd.osdmap.pg_to_up_acting_osds(pg)
-                if primary != osd.osd_id:
-                    continue
-                try:
-                    await self._recover_pg(pg, pool, acting)
-                except asyncio.CancelledError:
-                    raise
-                except Exception:
-                    logger.exception(
-                        "%s: recovery of pg %s failed", osd.name, pg
-                    )
-                    self._retry_needed = True
+        # one epoch snapshot for the WHOLE pass: a map push landing
+        # mid-pass must not mix two epochs' acting sets inside one PG's
+        # peering (the re-entrancy invariant) — the push's kick() is
+        # already pending, so the newer map gets its own full pass
+        m = self._pass_map = osd.osdmap
+        try:
+            flags = m.cluster_flags
+            if "norecover" in flags or "nobackfill" in flags:
+                # `ceph osd set norecover|nobackfill` parks the pass; the
+                # unset's map epoch re-kicks it (recovery and backfill are
+                # one unified push path here, so either flag parks it)
+                self._retry_needed = False
+                return
+            for pool in list(m.pools.values()):
+                for pg in m.pgs_of_pool(pool.id):
+                    _up, _upp, acting, primary = m.pg_to_up_acting_osds(pg)
+                    if primary != osd.osd_id:
+                        continue
+                    try:
+                        await self._recover_pg(pg, pool, acting)
+                    except asyncio.CancelledError:
+                        raise
+                    # swallow-ok: pg pass flagged for retry (and logged)
+                    except Exception:
+                        logger.exception(
+                            "%s: recovery of pg %s failed", osd.name, pg
+                        )
+                        self._retry_needed = True
+            if osd.osdmap is not m:
+                # a newer epoch landed mid-pass; its kick is pending,
+                # so the whole pass re-runs against the new map
+                osd.perf.get("recovery").inc("interrupted_passes")
+        finally:
+            self._pass_map = None
+
+    def _map(self):
+        """The running pass's epoch snapshot (the live map outside a
+        pass) — every map read on the peering/push path goes through
+        here so one pass sees one epoch."""
+        return self._pass_map if self._pass_map is not None \
+            else self.osd.osdmap
 
     async def _recover_pg(self, pg: PGid, pool: Pool, acting: list[int]) -> None:
+        osd = self.osd
+        # every recovery pass of a PG is one traced operation (ISSUE 15
+        # satellite): the id rides the frame header of each MOSDPGScan
+        # round trip and each push sub-write the pass sends, the EC
+        # dispatcher's _Op.trace picks it up (so dump_launch_history
+        # finds a slow recovery decode by this id), and the peering/push
+        # spans below land in the op waterfall ring
+        trace = new_trace_id(f"osd.{osd.osd_id}-rec-{pg}")
+        tok = current_trace.set(trace)
+        try:
+            await self._recover_pg_traced(pg, pool, acting, trace)
+        finally:
+            current_trace.reset(tok)
+
+    async def _recover_pg_traced(
+        self, pg: PGid, pool: Pool, acting: list[int], trace: str
+    ) -> None:
         osd = self.osd
         erasure = pool.type == POOL_TYPE_ERASURE
         if erasure:
@@ -427,7 +545,13 @@ class RecoveryManager:
             return
 
         # -- GetInfo + GetLog: one scan round trip per acting member
+        t0 = asyncio.get_event_loop().time()
         scans = await self._scan_shards(pg, shards, erasure)
+        record_span(
+            "peering_scan", t0, asyncio.get_event_loop().time() - t0,
+            trace=trace, entity=f"osd.{osd.osd_id}", pg=str(pg),
+            members=len(shards),
+        )
         if scans is None:
             return
         infos = {
@@ -552,7 +676,16 @@ class RecoveryManager:
             elif self._scan_stale(scans, shards, oid, state):
                 work.append((oid, state))
         if work:
-            held = await self._acquire_reservations(pg, set(shards.values()))
+            # recovery priority: more outstanding repair work = more
+            # degraded = higher priority (the coarse shape of the
+            # reference's get_recovery_priority) — under a full
+            # reserver a badly-degraded PG preempts a nearly-clean
+            # one's revocable grant instead of queueing behind it
+            prio = min(250, len(work))
+            self._revoked.discard(str(pg))  # fresh reservation round
+            held = await self._acquire_reservations(
+                pg, set(shards.values()), prio
+            )
             if held is None:
                 self._retry_needed = True
                 return
@@ -563,6 +696,11 @@ class RecoveryManager:
                 sem = asyncio.Semaphore(max_active)
 
                 async def _one(oid: str, state: dict) -> None:
+                    if str(pg) in self._revoked:
+                        # the target took our slot away mid-pass: stop
+                        # STARTING pushes; the retry pass re-reserves
+                        self._retry_needed = True
+                        return
                     async with sem:
                         # QoS grant per object push (the reference's
                         # PGRecovery items in the op queue): recovery
@@ -588,7 +726,7 @@ class RecoveryManager:
                                 else:
                                     await self._repair_object(
                                         pg, pool, erasure, shards,
-                                        scans, oid, state, acting,
+                                        scans, oid, state, acting, past,
                                     )
                             finally:
                                 self.active_pushes -= 1
@@ -675,8 +813,8 @@ class RecoveryManager:
                 if not (0 <= member != CRUSH_ITEM_NONE) \
                         or member in acting_members:
                     continue
-                if not osd.osdmap or not osd.osdmap.is_up(member) \
-                        or not osd.osdmap.get_addr(member):
+                m = self._map()
+                if not m or not m.is_up(member) or not m.get_addr(member):
                     continue  # down: unreachable (see _repair_object defer)
                 s = idx if erasure else -1
                 if (member, s) in claimed:
@@ -709,12 +847,13 @@ class RecoveryManager:
                     )
                     waiter.complete(key, objects, log, info, ivs)
                     continue
-                addr = osd.osdmap.get_addr(member)
+                addr = self._map().get_addr(member)
                 if not addr:
                     waiter.complete(key, {}, [])
                     continue
                 try:
                     conn = await osd.messenger.connect(addr, f"osd.{member}")
+                # swallow-ok: scan-era read raced a delete: next pass re-evaluates
                 except (ConnectionError, OSError):
                     # stale map: member already dead.  Mark the PASS
                     # failed — an unreachable member completed as an
@@ -735,6 +874,7 @@ class RecoveryManager:
             try:
                 async with asyncio.timeout(10.0):
                     await waiter.event.wait()
+            # swallow-ok: scan timeout flags the pass for retry (logged)
             except TimeoutError:
                 logger.warning("%s: scan of %s timed out", osd.name, pg)
                 self._retry_needed = True
@@ -841,6 +981,8 @@ class RecoveryManager:
             )
             if not await self._push_txn(pg, store_shard, member, txn, None):
                 self._retry_needed = True
+            else:
+                osd.perf.get("recovery").inc("divergent_rollbacks")
 
     async def _activate(
         self, pg: PGid, erasure: bool, shards: dict[int, int],
@@ -852,7 +994,10 @@ class RecoveryManager:
         managed to land loses find_best_info on les, whatever its
         version numbers say."""
         osd = self.osd
-        les = osd._epoch()
+        # the SNAPSHOT epoch, not the live one: the les we persist must
+        # name the interval this pass actually peered — a map landing
+        # mid-pass would otherwise stamp an interval nobody scanned
+        les = self._map().epoch if self._map() is not None else osd._epoch()
         for key, member in shards.items():
             if infos.get(key) and infos[key].last_epoch_started >= les:
                 continue  # already at (or past) this interval
@@ -925,6 +1070,7 @@ class RecoveryManager:
         self, pg: PGid, pool: Pool, erasure: bool,
         shards: dict[int, int], scans: dict[int, tuple[dict, list]],
         oid: str, state: dict, acting: list[int],
+        past: "peering.PastIntervals | None" = None,
     ) -> None:
         # cheap pre-filter on scan-era data; the real decision re-reads
         # fresh state under the pg lock (a client op may have raced)
@@ -948,6 +1094,7 @@ class RecoveryManager:
                     try:
                         codec.minimum_to_decode(list(range(k_data)), holders)
                         decodable = True
+                    # swallow-ok: undecodable set detected below; the rollback path owns it
                     except Exception:
                         decodable = False
                     if not decodable and any(
@@ -956,6 +1103,25 @@ class RecoveryManager:
                         # some member is unreachable — the version may be
                         # fully committed on shards we cannot see; rolling
                         # back now could undo an acked write. Defer.
+                        self._retry_needed = True
+                        return
+                    if not decodable and not self._proven_unacked(
+                        pg, want_version, vers, acting, past
+                    ):
+                        # the down/incomplete rule (reference
+                        # PG::choose_acting; ISSUE 15 rolling-churn
+                        # finding): every REACHABLE member of the
+                        # version-epoch's acting set holds the version
+                        # — it may be a fully-ACKED degraded-interval
+                        # write whose other chunks sit on a member
+                        # that is currently down.  Rolling back now
+                        # would destroy acked data; wait for the
+                        # holder (or an operator decision) instead.
+                        logger.warning(
+                            "%s: %s/%s v%s undecodable but possibly "
+                            "acked (holders down): deferring",
+                            osd.name, pg, oid, want_version,
+                        )
                         self._retry_needed = True
                         return
                     if not decodable:
@@ -990,6 +1156,49 @@ class RecoveryManager:
                     acting, vers,
                 )
                 return
+
+    def _proven_unacked(
+        self, pg: PGid, want_version: tuple, vers: dict[int, tuple],
+        acting: list[int], past: "peering.PastIntervals | None",
+    ) -> bool:
+        """Whether an undecodable newest EC version is PROVABLY never
+        acked — the license to roll it back.
+
+        A write acks only after every present member of its interval's
+        acting set commits, so finding one UP, successfully-read member
+        of the version-epoch's acting set that does NOT hold the
+        version proves the ack never happened (the torn-RMW shape).
+        When every reachable member of that interval holds it, the
+        missing chunks may sit on down members of a DEGRADED interval
+        — i.e. the write may be acked — and the caller must defer, not
+        destroy (the rolling-churn scenario: write acked 2-of-3 while
+        A was down, then B dies before A backfills)."""
+        epoch = int(want_version[0])
+        acting_e: list[int] | None = None
+        for iv in (past.intervals if past is not None else []):
+            if iv.first <= epoch <= iv.last:
+                acting_e = list(iv.acting)
+                break
+        if acting_e is None:
+            # no record covers the epoch: it belongs to the current
+            # interval
+            acting_e = list(acting)
+        m = self._map()
+        for s, member in enumerate(acting_e):
+            if member == CRUSH_ITEM_NONE or member < 0:
+                continue  # a degraded hole was never asked to commit
+            if m is None or not m.is_up(member):
+                continue  # down: unknowable, no proof either way
+            if s >= len(acting) or acting[s] != member:
+                # the slot re-homed since that interval: vers[s] holds
+                # the CURRENT member's answer, not this one's
+                continue
+            v = vers.get(s)
+            if v is None:
+                continue  # not readable this pass (stray/moved slot)
+            if v != tuple(want_version):
+                return True  # an up member of the interval lacks it
+        return False
 
     async def _rollback(
         self, pg: PGid, oid: str, version: tuple, holders: list[int],
@@ -1027,11 +1236,14 @@ class RecoveryManager:
             # reconstruct the logical object, re-encode, push stale chunks
             # (one batched device call rebuilds every missing shard)
             codec, sinfo = osd._pool_codec(pool)
-            # the rebuild's device math is background EC traffic: it
-            # paces through the QoS scheduler at the dispatcher, so a
-            # repair storm cannot starve client stripes of the device
+            # the rebuild's device math runs under the RECOVERY dmClock
+            # class end to end (ISSUE 15): it paces through the QoS
+            # scheduler at the dispatcher — and when the remote accel
+            # lane carries the batch, the class rides MAccelEncode/
+            # MAccelDecode into the accelerator's own scheduler — so a
+            # repair storm cannot starve client stripes of any device
             r, data = await osd._ec_read(
-                pg, pool, acting, oid, klass="ec_background"
+                pg, pool, acting, oid, klass="recovery"
             )
             if r < 0:
                 logger.warning(
@@ -1046,7 +1258,7 @@ class RecoveryManager:
             # routes through the microbatch dispatcher (whose mesh lane
             # serves when osd_ec_mesh is on) / host path (async router)
             shard_bufs = await osd._ec_encode_bufs(
-                sinfo, codec, padded, klass="ec_background"
+                sinfo, codec, padded, klass="recovery"
             )
             km = codec.get_chunk_count()
             hashes = StripeHashes(km, sinfo.chunk_size)
@@ -1072,7 +1284,9 @@ class RecoveryManager:
                     osd.name, soid, key, member, version,
                 )
                 if await self._push_txn(pg, key, member, txn, entry):
-                    self.osd.perf.get("recovery").inc("pushes")
+                    prec = self.osd.perf.get("recovery")
+                    prec.inc("pushes")
+                    prec.inc("bytes_pushed", len(chunk))
         else:
             # replicated: push the whole object from a healthy member
             cid = CollectionId(str(pg))
@@ -1084,6 +1298,7 @@ class RecoveryManager:
                     try:
                         data = osd.store.read(cid, soid)
                         attrs = osd.store.getattrs(cid, soid)
+                    # swallow-ok: local copy raced away: try the next healthy member
                     except KeyError:
                         continue
                     break
@@ -1114,7 +1329,9 @@ class RecoveryManager:
                 if await self.push_replica_object(
                     pg, member, oid, data, attrs or {}, entry
                 ):
-                    self.osd.perf.get("recovery").inc("pushes")
+                    prec = self.osd.perf.get("recovery")
+                    prec.inc("pushes")
+                    prec.inc("bytes_pushed", len(data))
 
     async def push_replica_object(
         self, pg: PGid, member: int, oid: str, data: bytes,
@@ -1169,12 +1386,24 @@ class RecoveryManager:
 
         waiter = _Waiter({shard}, {shard: member})
         osd._write_waiters[tid] = waiter
+        t0 = asyncio.get_event_loop().time()
         try:
             await osd._send_sub_write(
                 tid, pg, shard, member, txn, [entry] if entry else []
             )
             async with asyncio.timeout(10.0):
                 await waiter.event.wait()
+            # the push round trip as a waterfall hop (same ring the
+            # sampled client ops feed): a recovery trace reads as
+            # peering_scan -> N recovery_push spans in dump_op_waterfall
+            trace = current_trace.get()
+            if trace is not None:
+                record_span(
+                    "recovery_push", t0,
+                    asyncio.get_event_loop().time() - t0, trace=trace,
+                    entity=f"osd.{osd.osd_id}", member=member, shard=shard,
+                )
+        # swallow-ok: push timeout flags the pass for retry (logged)
         except TimeoutError:
             logger.warning(
                 "%s: recovery push to osd.%d timed out", osd.name, member

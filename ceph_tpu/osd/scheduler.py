@@ -124,7 +124,7 @@ class _Waiter:
 class _ClassState:
     __slots__ = ("spec", "queue", "r_tag", "p_tag", "l_tag", "pace_tag",
                  "admitted", "deferred", "preempted", "paced",
-                 "win_served", "wait_sum", "wait_max")
+                 "pace_calls", "win_served", "wait_sum", "wait_max")
 
     def __init__(self, spec: QosSpec):
         self.spec = spec
@@ -139,7 +139,11 @@ class _ClassState:
         self.admitted = 0
         self.deferred = 0
         self.preempted = 0
-        self.paced = 0
+        self.paced = 0       # pace() calls that actually slept
+        self.pace_calls = 0  # every pace() admission of this class —
+        # the end-to-end proof a background class (e.g. recovery math
+        # shipped to the accelerator, ISSUE 15) reached THIS scheduler,
+        # independent of whether its rate forced a delay
         self.win_served = 0.0  # cost granted in the current share window
         self.wait_sum = 0.0
         self.wait_max = 0.0
@@ -300,6 +304,7 @@ class OpScheduler:
         if self._stopping or self.policy == "fifo":
             return 0.0
         st = self._state[klass]
+        st.pace_calls += 1
         spec = st.spec
         rate = spec.limit
         if (
@@ -392,6 +397,7 @@ class OpScheduler:
                 "deferred": st.deferred,
                 "preempted": st.preempted,
                 "paced": st.paced,
+                "pace_calls": st.pace_calls,
                 "wait_avg_s": round(
                     st.wait_sum / st.admitted, 6
                 ) if st.admitted else 0.0,

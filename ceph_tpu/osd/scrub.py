@@ -97,10 +97,12 @@ class ScrubManager:
                     )
                 except asyncio.CancelledError:
                     raise
+                # swallow-ok: logged; the next interval re-runs the pass
                 except Exception:
                     logger.exception(
                         "%s: background scrub failed", self.osd.name
                     )
+        # swallow-ok: daemon stop: the scrub loop ends
         except asyncio.CancelledError:
             pass
         finally:
@@ -128,6 +130,7 @@ class ScrubManager:
                         reports.append(
                             await self.scrub_pg(pg, pool, acting, repair)
                         )
+                # swallow-ok: QoS shed: the next interval re-scrubs this pg
                 except QosDeferred:
                     continue
         # prune gauge state for PGs this OSD no longer leads (primary
@@ -244,12 +247,14 @@ class ScrubManager:
                 try:
                     ois[s] = json.loads(raw)
                     newest = max(newest, tuple(ois[s].get("version", [0, 0])))
+                # swallow-ok: unreadable OI classifies the shard as attr-bad below
                 except ValueError:
                     pass
             hraw = a.get(StripeHashes.XATTR_KEY)
             if hraw is not None:
                 try:
                     tables[s] = StripeHashes.from_dict(json.loads(hraw))
+                # swallow-ok: unreadable crc table classifies the shard as attr-bad below
                 except Exception:
                     pass
 
@@ -314,6 +319,7 @@ class ScrubManager:
         )
         try:
             rebuilt = ec_util.decode(sinfo, codec, good, want=sorted(bad))
+        # swallow-ok: logged; errors stay in the report, next scrub retries
         except Exception:
             logger.exception(
                 "%s: scrub decode failed for %s/%s", osd.name, pg, oid
@@ -385,6 +391,7 @@ class ScrubManager:
             if raw:
                 try:
                     vers[m] = tuple(json.loads(raw).get("version", [0, 0]))
+                # swallow-ok: unreadable OI reads as version (0,0): shard classifies stale
                 except ValueError:
                     vers[m] = (0, 0)
             else:

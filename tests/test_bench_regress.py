@@ -445,6 +445,82 @@ class TestSmallopsIopsGates:
             ) == 0, metric
 
 
+class TestChurnGates:
+    """ISSUE 15: churn.protection (live-storm client protection factor,
+    ratio, 20% budget) and churn.recovery_gbps (storm recovery
+    throughput, 2x budget) — registered with aliases and clean-skip
+    semantics exactly like the accel/mesh metrics."""
+
+    def _round(self, tmp_path, n, phase, value, protection=None,
+               gbps=None):
+        line = {"metric": "m", "value": value, "unit": "GB/s",
+                "phase": phase}
+        ch = {}
+        if protection is not None:
+            ch["protection"] = protection
+        if gbps is not None:
+            ch["recovery_gbps"] = gbps
+        if ch:
+            line["churn"] = ch
+        (tmp_path / f"BENCH_r{n:02d}.json").write_text(
+            json.dumps({"n": n, "rc": 0, "parsed": line})
+        )
+
+    def test_protection_collapse_fails(self, tmp_path):
+        """The 2.5x budget (0.4): a protection factor collapsing from
+        a healthy ~2x to well under 1.0 is the regression."""
+        br = _load_tool()
+        self._round(tmp_path, 1, "tpu", 660.0, protection=2.0)
+        self._round(tmp_path, 2, "tpu", 661.0, protection=0.7)
+        rep = br.compare(br.load_rounds(str(tmp_path)),
+                         metric="churn.protection", threshold=0.4)
+        assert rep["comparable"] and rep["regression"] is True
+        for metric in ("churn.protection", "churn_protection"):
+            assert br.main(
+                ["--dir", str(tmp_path), "--metric", metric]
+            ) == 1, metric
+
+    def test_protection_wobble_and_improvement_pass(self, tmp_path):
+        """The measured best-of-2 spread (1.3..2.7 on an idle host)
+        stays inside the budget."""
+        br = _load_tool()
+        self._round(tmp_path, 1, "tpu", 660.0, protection=2.7)
+        self._round(tmp_path, 2, "tpu", 661.0, protection=1.3)
+        assert br.main(
+            ["--dir", str(tmp_path), "--metric", "churn.protection"]
+        ) == 0
+        self._round(tmp_path, 3, "tpu", 661.0, protection=3.0)
+        assert br.main(
+            ["--dir", str(tmp_path), "--metric", "churn.protection"]
+        ) == 0
+
+    def test_recovery_gbps_2x_drop_fails(self, tmp_path):
+        br = _load_tool()
+        self._round(tmp_path, 1, "tpu", 660.0, gbps=0.4)
+        self._round(tmp_path, 2, "tpu", 661.0, gbps=0.1)
+        for metric in ("churn.recovery_gbps", "churn_recovery_gbps"):
+            assert br.main(
+                ["--dir", str(tmp_path), "--metric", metric]
+            ) == 1, metric
+
+    def test_churn_gates_clean_skip_until_two_rounds_carry_them(
+        self, tmp_path
+    ):
+        """Armed now, harmless until the churn phase has landed in two
+        rounds — promotion can never fail a round retroactively."""
+        br = _load_tool()
+        self._round(tmp_path, 1, "tpu", 660.0)  # legacy round
+        self._round(tmp_path, 2, "tpu", 650.0, protection=1.8,
+                    gbps=0.3)
+        for metric in ("churn.protection", "churn.recovery_gbps"):
+            rep = br.compare(br.load_rounds(str(tmp_path)),
+                             metric=metric)
+            assert rep["comparable"] is False, metric
+            assert br.main(
+                ["--dir", str(tmp_path), "--metric", metric]
+            ) == 0, metric
+
+
 class TestChildBackendDeath:
     def test_parent_survives_backend_registration_abort(self):
         """Regression for BENCH_r05: every accelerator child dies with

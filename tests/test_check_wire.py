@@ -191,3 +191,104 @@ class TestRealRepo:
             (root / "ceph_tpu" / "msg" / "wire_manifest.json").read_text())
         live = {cls.TYPE: tid for tid, cls in _REGISTRY.items()}
         assert live == manifest["types"]
+
+
+class TestTailModePin:
+    """ISSUE 15 wire audit: the manifest's json_tails list is the only
+    license for a JSON field tail — the peering/recovery data path can
+    never silently regress off positional marshal."""
+
+    def test_unlisted_json_tail_fails(self, tmp_path):
+        cw = _load_tool()
+        root = _repo(tmp_path, """
+            @register
+            class MScan(Message):
+                TYPE = "pg_scan"
+                TYPE_ID = 130
+                WIRE_TAIL = "json"
+                FIELDS = ("pgid",)
+        """, {"types": {"pg_scan": 130}, "retired": [],
+              "json_tails": []})
+        assert any("json_tails" in p and "pg_scan" in p
+                   for p in cw.check(root))
+
+    def test_listed_json_tail_passes(self, tmp_path):
+        cw = _load_tool()
+        root = _repo(tmp_path, """
+            @register
+            class MCmd(Message):
+                TYPE = "mon_command"
+                TYPE_ID = 30
+                WIRE_TAIL = "json"
+                FIELDS = ("cmd",)
+        """, {"types": {"mon_command": 30}, "retired": [],
+              "json_tails": ["mon_command"]})
+        assert cw.check(root) == []
+
+    def test_listed_type_gone_binary_fails(self, tmp_path):
+        """Delisting is part of the same reviewable diff: a type still
+        in json_tails but binary in code is drift, both ways pin."""
+        cw = _load_tool()
+        root = _repo(tmp_path, """
+            @register
+            class MCmd(Message):
+                TYPE = "mon_command"
+                TYPE_ID = 30
+                FIELDS = ("cmd",)
+        """, {"types": {"mon_command": 30}, "retired": [],
+              "json_tails": ["mon_command"]})
+        assert any("binary tail" in p for p in cw.check(root))
+
+    def test_json_tails_entry_without_class_fails(self, tmp_path):
+        cw = _load_tool()
+        root = _repo(tmp_path, _OK_SRC,
+                     {"types": {"ping": 20, "pong": 21}, "retired": [],
+                      "json_tails": ["ghost"]})
+        assert any("ghost" in p for p in cw.check(root))
+
+    def test_recovery_wire_is_marshal_tailed(self):
+        """The committed registry: every peering/recovery type decodes
+        as a positional-marshal tail, none is a JSON leftover."""
+        from ceph_tpu.msg.message import _REGISTRY
+
+        recovery_types = {"pg_scan", "pg_scan_reply", "pg_push",
+                          "pg_push_reply", "recovery_reserve",
+                          "osd_scrub", "osd_scrub_reply"}
+        by_name = {cls.TYPE: cls for cls in _REGISTRY.values()}
+        for t in recovery_types:
+            assert by_name[t].WIRE_TAIL == "bin", t
+
+    def test_laundered_wire_tail_fails(self, tmp_path):
+        """A WIRE_TAIL assigned through a name must not silently read
+        as the 'bin' default — the pin cannot be bypassed by
+        indirection."""
+        cw = _load_tool()
+        root = _repo(tmp_path, """
+            _J = "json"
+
+            @register
+            class MScan(Message):
+                TYPE = "pg_scan"
+                TYPE_ID = 130
+                WIRE_TAIL = _J
+                FIELDS = ("pgid",)
+        """, {"types": {"pg_scan": 130}, "retired": [],
+              "json_tails": []})
+        assert any("WIRE_TAIL" in p for p in cw.check(root))
+
+    def test_annotated_wire_tail_is_visible(self, tmp_path):
+        """`WIRE_TAIL: str = "json"` (AnnAssign) binds the attribute
+        at runtime exactly like a plain assign — the pin must see it,
+        not default it to 'bin'."""
+        cw = _load_tool()
+        root = _repo(tmp_path, """
+            @register
+            class MScan(Message):
+                TYPE = "pg_scan"
+                TYPE_ID = 130
+                WIRE_TAIL: str = "json"
+                FIELDS = ("pgid",)
+        """, {"types": {"pg_scan": 130}, "retired": [],
+              "json_tails": []})
+        assert any("json_tails" in p and "pg_scan" in p
+                   for p in cw.check(root))

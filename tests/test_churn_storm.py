@@ -1,0 +1,550 @@
+"""The live storm fault matrix (ISSUE 15 layers 2+3): map churn under
+sustained client load on real MiniClusters, with the hard invariants —
+zero failed client ops, zero lost acked writes, every PG reaches clean
+— plus peering re-entrancy coalescing, reservation preemption, recovery
+trace/flight visibility, the recovery QoS class riding into the
+accelerator's scheduler, and divergent rollback under a double primary
+flip."""
+
+import asyncio
+import json
+import types
+
+import pytest
+
+from ceph_tpu.common import tracing
+from ceph_tpu.msg import messages
+from ceph_tpu.osd import peering
+from ceph_tpu.osd.pg_log import Eversion
+from ceph_tpu.rados import MiniCluster
+from ceph_tpu.rados.storm import ClientLoad, StormDriver
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _ec_cluster(n_osds=4, pg_num=8, **kw):
+    cluster = MiniCluster(n_osds=n_osds, **kw)
+    await cluster.start()
+    cl = await cluster.client()
+    await cl.create_pool("ec", "erasure", pg_num=pg_num)  # isa k2m1
+    return cluster, cl
+
+
+class TestStormMatrix:
+    def test_single_kill_storm(self):
+        """Scenario 1: one OSD dies under load and rejoins.  Invariants
+        hold, the device churn plan predicts EXACTLY the PGs the live
+        cluster remapped, and the recovery work is visible as traced
+        spans + klass=recovery flight records."""
+
+        async def main():
+            cluster, cl = await _ec_cluster()
+            try:
+                load = ClientLoad(cl.io_ctx("ec"), prefix="sk")
+                load.start(writers=2)
+                driver = StormDriver(cluster, cl, ["ec"])
+                result = await driver.scenario_single_kill(load)
+                assert result["ops_acked"] > 0
+
+                # the tentpole acceptance: device plan == live reality
+                churn = result["churn"]
+                assert churn["predicted"] == churn["actual"]
+                assert churn["predicted"]  # a kill must remap something
+                assert churn["plan"]["pgs_remapped"] == len(
+                    churn["predicted"]
+                )
+                # ...and the daemons observed remaps on the same push
+                remaps = sum(
+                    o.perf.get("churn").get("pgs_remapped")
+                    for o in cluster.osds.values()
+                )
+                assert remaps > 0
+
+                # recovery is traced end to end (satellite): the pass's
+                # trace id shows peering_scan + recovery_push hops in
+                # the op waterfall...
+                prov = tracing._providers.get(tracing.STACK_PROVIDER)
+                rec_traces = {
+                    e["trace"] for e in prov.events()
+                    if e.get("event") == "span" and "-rec-" in str(
+                        e.get("trace"))
+                }
+                assert rec_traces, "no traced recovery passes"
+                pushed = [
+                    t for t in rec_traces
+                    if any(h["hop"] == "recovery_push"
+                           for h in tracing.op_waterfall(t)["hops"])
+                ]
+                assert pushed, "no recovery_push spans in the waterfall"
+                scans = [
+                    t for t in rec_traces
+                    if any(h["hop"] == "peering_scan"
+                           for h in tracing.op_waterfall(t)["hops"])
+                ]
+                assert scans, "no peering_scan spans in the waterfall"
+
+                # ...and the rebuild decode/encode launches carry
+                # klass=recovery in the flight recorder, findable by
+                # the recovery trace id (dump_launch_history contract)
+                rec_launches = []
+                for osd in cluster.osds.values():
+                    d = osd.ec_dispatch.flight.dump()
+                    rec_launches += [
+                        r for r in d["launches"]
+                        if r.get("klass") == "recovery"
+                    ]
+                assert rec_launches, "no recovery-class device launches"
+                found = False
+                for osd in cluster.osds.values():
+                    for t in rec_traces:
+                        rec = osd.ec_dispatch.flight.lookup(t)
+                        if rec is not None:
+                            assert rec["klass"] == "recovery"
+                            found = True
+                assert found, "recovery trace not findable in flight"
+            finally:
+                await cluster.stop()
+
+        run(main())
+
+    def test_rolling_churn(self):
+        """Scenario 2: rolling multi-OSD kill/rejoin — epochs land
+        back to back while recovery runs; invariants hold and kicks
+        were delivered for every epoch."""
+
+        async def main():
+            cluster, cl = await _ec_cluster(n_osds=5)
+            try:
+                load = ClientLoad(cl.io_ctx("ec"), prefix="roll")
+                load.start(writers=2)
+                driver = StormDriver(cluster, cl, ["ec"])
+                result = await driver.scenario_rolling(load)
+                assert result["ops_acked"] > 0
+                assert result["kicks"] > 0
+            finally:
+                await cluster.stop()
+
+        run(main())
+
+    def test_backfill_vs_recovery_contention(self):
+        """Scenario 3: osd_max_backfills=1 and a rejoining member that
+        owes many PGs recovery — the AsyncReservers must actually
+        queue (reservation_waits) while every invariant holds."""
+
+        async def main():
+            cluster, cl = await _ec_cluster(n_osds=4, pg_num=16)
+            try:
+                load = ClientLoad(
+                    cl.io_ctx("ec"), prefix="bf", objects=24,
+                    pause=0.005,
+                )
+                load.start(writers=3)
+                driver = StormDriver(cluster, cl, ["ec"])
+                result = await driver.scenario_backfill_contention(load)
+                assert result["ops_acked"] > 0
+                assert result["reservation_waits"] > 0, \
+                    "osd_max_backfills=1 never queued a reservation"
+            finally:
+                await cluster.stop()
+
+        run(main())
+
+    def test_scrub_storm_collides_with_recovery(self):
+        """Scenario 4: an operator deep-scrub wave over every PG races
+        live recovery; nothing tears, everything reaches clean."""
+
+        async def main():
+            cluster, cl = await _ec_cluster()
+            try:
+                load = ClientLoad(cl.io_ctx("ec"), prefix="ss")
+                load.start(writers=2)
+                driver = StormDriver(cluster, cl, ["ec"])
+                result = await driver.scenario_scrub_storm(load)
+                assert result["ops_acked"] > 0
+                assert result["storm_scrubs"] > 0
+            finally:
+                await cluster.stop()
+
+        run(main())
+
+    def test_accel_death_mid_recovery(self):
+        """Scenario 5: recovery decode/encode batches route through the
+        accelerator fleet and the serving accelerator SIGKILLs
+        mid-recovery — batches fail over (surviving accel, else local
+        fallback) with zero failed ops, and the accelerator's own
+        scheduler/flight saw the RECOVERY class (the end-to-end QoS
+        class carry this PR must verify)."""
+
+        async def main():
+            cluster = MiniCluster(
+                n_osds=4,
+                config_overrides={
+                    "accel_beacon_interval": 0.05,
+                    "osd_ec_accel_retry_interval": 0.1,
+                },
+            )
+            await cluster.start()
+            try:
+                accs = [await cluster.start_accel() for _ in range(2)]
+                cluster.set_accel_mode("prefer")
+                async with asyncio.timeout(10):
+                    while not all(
+                        len(o.accel_client._map_clients) == 2
+                        for o in cluster.osds.values()
+                    ):
+                        await asyncio.sleep(0.02)
+                cl = await cluster.client()
+                await cl.create_pool("ec", "erasure", pg_num=8)
+                load = ClientLoad(
+                    cl.io_ctx("ec"), prefix="ad", objects=16,
+                    size=8192, pause=0.005,
+                )
+                load.start(writers=3)
+                driver = StormDriver(cluster, cl, ["ec"])
+                result = await driver.scenario_accel_death(load)
+                assert result["ops_acked"] > 0
+                # the surviving accelerator carried recovery-class
+                # batches: its dispatcher's flight records AND its own
+                # dmClock scheduler both saw klass=recovery
+                survivor = accs[1]
+                launches = survivor.dispatch.flight.dump()["launches"]
+                rec = [r for r in launches
+                       if r.get("klass") == "recovery"]
+                assert rec, "accel never served a recovery-class batch"
+                # ...and its dmClock actually admitted the class:
+                # pace_calls counts EVERY recovery-class admission
+                # (paced/pace_tag only move when the rate forces a
+                # sleep)
+                st = survivor.scheduler._state["recovery"]
+                assert st.pace_calls > 0, \
+                    "accel scheduler never saw the recovery class"
+                assert survivor.scheduler.dump()["classes"][
+                    "recovery"]["pace_calls"] > 0
+            finally:
+                await cluster.stop()
+
+        run(main())
+
+
+@pytest.mark.slow
+class TestProcClusterStorm:
+    def test_proc_cluster_sigkill_storm(self, tmp_path):
+        """The matrix's single-kill shape on a REAL multi-process
+        cluster: SIGKILL of a separate OSD process under client load,
+        restart through WalStore journal replay, same invariants —
+        zero failed ops, zero lost acked writes, every PG clean (over
+        the wire; no in-process state to poke)."""
+        from ceph_tpu.rados.proc_cluster import ProcCluster
+
+        async def main():
+            async with ProcCluster(
+                str(tmp_path / "c"), n_osds=3,
+                heartbeat_interval=0.5,
+            ) as pc:
+                cl = await pc.client()
+                await cl.create_pool("rep", "replicated", size=3)
+                load = ClientLoad(
+                    cl.io_ctx("rep"), prefix="pk", objects=8,
+                    size=2048, pause=0.01,
+                )
+                load.start(writers=2)
+                # generous clean budget: this runs in the slow tier,
+                # often right after a many-minute XLA compile has
+                # loaded the host
+                driver = StormDriver(pc, cl, ["rep"], clean_timeout=150)
+                await asyncio.sleep(0.5)
+                pc.kill9_osd(2)
+                await pc.wait_osd_state(cl, 2, up=False)
+                await asyncio.sleep(0.5)  # degraded-window writes
+                await pc.restart_osd(2)
+                await pc.wait_osd_state(cl, 2, up=True)
+                result = await driver.check_invariants(load)
+                assert result["ops_acked"] > 0
+                assert result["pgs_scrubbed"] > 0
+                await cl.shutdown()
+
+        run(main())
+
+
+class TestPeeringReentrancy:
+    def test_back_to_back_kicks_coalesce_not_stack(self):
+        """Map epochs delivered faster than passes complete must
+        COALESCE into one pending pass, never run concurrently — the
+        re-entrancy contract, pinned deterministically by slowing one
+        OSD's pass and hammering kick()."""
+
+        async def main():
+            async with MiniCluster(n_osds=1) as cluster:
+                osd = next(iter(cluster.osds.values()))
+                await asyncio.sleep(0.1)  # boot-time kicks drain
+                concurrency = {"now": 0, "max": 0, "runs": 0}
+
+                async def slow_pass(self):
+                    concurrency["now"] += 1
+                    concurrency["runs"] += 1
+                    concurrency["max"] = max(
+                        concurrency["max"], concurrency["now"]
+                    )
+                    try:
+                        await asyncio.sleep(0.15)
+                    finally:
+                        concurrency["now"] -= 1
+
+                osd.recovery._recover_all = types.MethodType(
+                    slow_pass, osd.recovery
+                )
+                prec = osd.perf.get("recovery")
+                kicks0 = prec.get("kicks")
+                co0 = prec.get("coalesced_kicks")
+                for _ in range(6):
+                    osd.recovery.kick()
+                    await asyncio.sleep(0.03)  # mid-pass kicks
+                async with asyncio.timeout(5):
+                    while osd.recovery._pass_running or \
+                            osd.recovery._wakeup.is_set():
+                        await asyncio.sleep(0.02)
+                await asyncio.sleep(0.2)
+                assert prec.get("kicks") - kicks0 == 6
+                # at least 4 of the 6 landed mid-pass/pending
+                assert prec.get("coalesced_kicks") - co0 >= 4
+                assert concurrency["max"] == 1, "passes overlapped"
+                assert concurrency["runs"] <= 3  # 6 kicks, <=3 passes
+
+        run(main())
+
+    def test_mid_pass_epoch_is_interrupted_and_rerun(self):
+        """A map landing mid-pass is counted and the pass re-runs on
+        the new epoch (the snapshot rule)."""
+
+        async def main():
+            async with MiniCluster(n_osds=2) as cluster:
+                cl = await cluster.client()
+                await cl.create_pool("rep", "replicated", size=2)
+                pool = cl.osdmap.lookup_pool("rep")
+                # pick an OSD that actually leads a PG (its pass then
+                # spends real time inside the slow stub)
+                lead = next(
+                    cl.osdmap.pg_to_up_acting_osds(pg)[3]
+                    for pg in cl.osdmap.pgs_of_pool(pool.id)
+                    if cl.osdmap.pg_to_up_acting_osds(pg)[3] >= 0
+                )
+                osd0 = cluster.osds[lead]
+                prec = osd0.perf.get("recovery")
+                before = prec.get("interrupted_passes")
+
+                async def slow_pg(pg, pool, acting):
+                    await asyncio.sleep(0.2)
+
+                osd0.recovery._recover_pg = slow_pg
+                task = asyncio.ensure_future(
+                    osd0.recovery._recover_all()
+                )
+                await asyncio.sleep(0.05)  # snapshot taken, pass busy
+                from ceph_tpu.osd.osdmap import OSDMap
+
+                newer = OSDMap.from_dict(osd0.osdmap.to_dict())
+                newer.epoch += 1
+                osd0.osdmap = newer  # the mid-pass push
+                await task
+                # >=: the daemon's own loop may have had a pass in
+                # flight across the swap too — both count
+                assert prec.get("interrupted_passes") >= before + 1
+                # the pass computed against its snapshot, not the swap
+                assert osd0.recovery._pass_map is None
+
+        run(main())
+
+
+class TestReservationPreemption:
+    def test_higher_priority_pg_preempts_revocable_grant(self):
+        """AsyncReserver preemption through the live wire protocol
+        surface: with one remote slot, a held low-priority grant is
+        revoked when a strictly-higher-priority PG requests — the
+        primary is told (op=revoke), counted, and re-queued."""
+
+        async def main():
+            async with MiniCluster(n_osds=2) as cluster:
+                target = cluster.osds[0]
+                target.config.set("osd_max_backfills", 1)
+                sent: list = []
+
+                class _Conn:
+                    def send(self, msg):
+                        sent.append(msg)
+
+                conn = _Conn()
+                target.recovery.handle_reserve(
+                    conn, messages.MRecoveryReserve(
+                        pgid="9.0", tid=1, from_osd=1,
+                        op="request", prio=1,
+                    )
+                )
+                await asyncio.sleep(0.05)
+                assert [m.op for m in sent] == ["grant"]
+                # a more degraded PG outranks the held grant
+                target.recovery.handle_reserve(
+                    conn, messages.MRecoveryReserve(
+                        pgid="9.1", tid=2, from_osd=1,
+                        op="request", prio=9,
+                    )
+                )
+                await asyncio.sleep(0.05)
+                ops = [m.op for m in sent]
+                assert "revoke" in ops and ops.count("grant") == 2
+                assert target.remote_reserver.preemptions == 1
+
+                # primary side: a revoke flags the pass for retry and
+                # counts
+                primary = cluster.osds[1]
+                prec = primary.perf.get("recovery")
+                before = prec.get("reservations_revoked")
+                primary.recovery.handle_reserve(
+                    conn, messages.MRecoveryReserve(
+                        pgid="9.0", tid=0, from_osd=0,
+                        op="revoke", prio=0,
+                    )
+                )
+                assert prec.get("reservations_revoked") == before + 1
+                assert primary.recovery._retry_needed
+                assert primary.recovery._wakeup.is_set()
+
+        run(main())
+
+
+class TestDoubleFlipDivergence:
+    def test_find_best_info_double_flip_interval_ordering(self):
+        """Unit pin (satellite): across TWO primary flips the les
+        interval order dominates totally — an interval-1 shard with the
+        numerically newest update loses to interval-2, which loses to
+        interval-3, whatever the versions say."""
+        infos = {
+            0: peering.PGShardInfo(2, Eversion(9, 99), 40),  # flip-1 era
+            1: peering.PGShardInfo(5, Eversion(9, 98), 39),  # flip-2 era
+            2: peering.PGShardInfo(7, Eversion(3, 1), 1),    # current
+            3: peering.PGShardInfo(7, Eversion(3, 2), 2),    # current
+        }
+        assert peering.find_best_info(infos) == 3
+        # drop the current-interval members: flip-2 must now win over
+        # the numerically-newest flip-1 shard
+        del infos[2], infos[3]
+        assert peering.find_best_info(infos) == 1
+
+    def test_divergent_rollback_survives_double_primary_flip(self):
+        """Live: partition -> stale-interval writes (decodable!) ->
+        heal -> SECOND flip before the PG is clean.  The interval-3
+        primary must still fence the stale pair on les and roll their
+        entries back — acked v1 bytes survive, the never-acked write
+        dies, and the rollback is counted."""
+        from tests.test_peering import (
+            _ec_pool, _inject_partial_write, _newest_entry,
+        )
+        from ceph_tpu.osd.daemon import CollectionId, ObjectId
+        from ceph_tpu.osd.pg_log import meta_oid
+
+        PAYLOAD = bytes(range(256)) * 32
+
+        async def main():
+            async with MiniCluster(n_osds=6) as cluster:
+                cl = await cluster.client()
+                io = await _ec_pool(
+                    cl, profile={"plugin": "isa",
+                                 "technique": "reed_sol_van",
+                                 "k": "2", "m": "2"},
+                )
+                await io.write_full("obj", PAYLOAD)  # v1 ACKED
+                pool = cl.osdmap.lookup_pool("ecpool")
+                pg, acting, prim = cl.osdmap.object_to_acting(
+                    "obj", pool.id
+                )
+
+                def les_of(osd_id, shard):
+                    st = cluster.stores[osd_id]
+                    try:
+                        omap = st.omap_get(
+                            CollectionId(f"{pg}s{shard}"), meta_oid(shard)
+                        )
+                    except KeyError:
+                        return 0
+                    raw = omap.get(peering.INFO_KEY)
+                    return json.loads(raw).get("les", 0) if raw else 0
+
+                async with asyncio.timeout(15):
+                    while any(
+                        les_of(o, s) == 0 for s, o in enumerate(acting)
+                    ):
+                        cluster.osds[prim].recovery.kick()
+                        await asyncio.sleep(0.1)
+
+                # FLIP 1: partition shards 0+1 (decodable stale pair)
+                zombies = [(0, acting[0]), (1, acting[1])]
+                for _s, o in zombies:
+                    await cluster.kill_osd(o, crash=True)
+                    await cluster.wait_for_osd_down(o)
+                async with asyncio.timeout(20):
+                    while await io.read("obj") != PAYLOAD:
+                        await asyncio.sleep(0.1)
+                # the new interval must have ACTIVATED (les fence) on
+                # the survivors before the stale pair returns
+                async with asyncio.timeout(20):
+                    while True:
+                        els = [
+                            les_of(o, s) for s, o in enumerate(acting)
+                            if o not in (z[1] for z in zombies)
+                            and o in cluster.osds
+                        ]
+                        if els and all(
+                            v > cl.osdmap.epoch - 10 and v >= 2
+                            for v in els
+                        ) and len(set(els)) == 1:
+                            break
+                        await asyncio.sleep(0.1)
+
+                # the partitioned pair lands a never-acked v2 from the
+                # OLD interval (numerically newest, k=2 holders =>
+                # decodable — version logic alone would adopt it)
+                v2s = []
+                for s, o in zombies:
+                    st = cluster.stores[o]
+                    prior = _newest_entry(st, pg, s, "obj").version
+                    chunk_len = len(
+                        st.read(CollectionId(f"{pg}s{s}"),
+                                ObjectId("obj", s))
+                    )
+                    v2s.append(_inject_partial_write(
+                        st, pg, s, "obj", prior, b"\xbb" * chunk_len
+                    ))
+
+                # HEAL, and immediately FLIP 2: kill the CURRENT
+                # primary before the PG can possibly be clean
+                for _s, o in zombies:
+                    await cluster.restart_osd(o)
+                    await cluster.wait_for_osd_up(o)
+                _pg2, acting2, prim2 = cl.osdmap.object_to_acting(
+                    "obj", pool.id
+                )
+                if prim2 in cluster.osds and prim2 not in (
+                    z[1] for z in zombies
+                ):
+                    await cluster.kill_osd(prim2, crash=True)
+                    await cluster.wait_for_osd_down(prim2)
+
+                # the stale pair's injected entries must roll back
+                async with asyncio.timeout(30):
+                    while not all(
+                        (e := _newest_entry(cluster.stores[o], pg, s,
+                                            "obj"))
+                        is not None and e.version < v2s[0]
+                        for s, o in zombies
+                    ):
+                        await asyncio.sleep(0.1)
+                # acked data survived the double flip
+                assert await io.read("obj") == PAYLOAD
+                rollbacks = sum(
+                    o.perf.get("recovery").get("divergent_rollbacks")
+                    for o in cluster.osds.values()
+                )
+                assert rollbacks > 0
+
+        run(main())

@@ -76,7 +76,9 @@ METRIC_ALIASES = {"stack_e2e_gbps": "stack_e2e.stack_e2e_gbps",
                   # the p99 rides the final line as op_p99_ms; both
                   # spellings of the promoted IOPS tail metric resolve
                   "smallops_op_p99": "smallops.op_p99_ms",
-                  "smallops.op_p99": "smallops.op_p99_ms"}
+                  "smallops.op_p99": "smallops.op_p99_ms",
+                  "churn_protection": "churn.protection",
+                  "churn_recovery_gbps": "churn.recovery_gbps"}
 
 # per-metric default thresholds (used when --threshold is not given):
 # mesh.scaling_efficiency is a RATIO (per-chip efficiency of the
@@ -112,13 +114,29 @@ METRIC_ALIASES = {"stack_e2e_gbps": "stack_e2e.stack_e2e_gbps",
 # slack (a sub-ms absolute wobble on a contended CI host must not read
 # as a 2x relative regression).  Both clean-skip (exit 0) until two
 # rounds carry the capture.
+# churn.protection (ISSUE 15) is the live-storm client protection
+# factor — fifo's storm-vs-quiescent p99 blowup over mclock's under
+# the SAME OSD-kill/recovery storm (a real MiniCluster cycle per
+# policy, not the synthetic scheduler harness behind qos.protection).
+# It is a ratio of FOUR live loopback p99s, so its round-over-round
+# noise is multiplicative (measured best-of-2 spread ~1.3-2.7x on an
+# idle host): the budget is 2.5x (0.4), not the occupancy metrics'
+# 20% — a real regression (protection collapsing toward/under 1.0
+# from a healthy ~2x) still fails.  Rounds predating the churn phase
+# lack the metric, so the gate skips cleanly (exit 0) until two
+# rounds carry it.  churn.recovery_gbps is the storm's measured
+# recovery throughput (bytes the primaries re-pushed over the
+# fifo run's recovery wall) — a throughput with the standard 2x
+# jitter budget, same clean-skip semantics.
 METRIC_DEFAULT_THRESHOLDS = {"mesh.scaling_efficiency": 0.8,
                              "mesh.ici_share": 0.8,
                              "accel.occupancy": 0.8,
                              "accel.fleet_occupancy": 0.8,
                              "smallops.header_share": 0.8,
                              "smallops.ops_per_sec": 0.5,
-                             "smallops.op_p99_ms": 0.5}
+                             "smallops.op_p99_ms": 0.5,
+                             "churn.protection": 0.4,
+                             "churn.recovery_gbps": 0.5}
 
 # metrics where GROWTH is the regression: mesh.ici_share (ISSUE 9) is
 # the ICI all-gather's share of the mesh reconstruct's device time,

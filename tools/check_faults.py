@@ -77,6 +77,13 @@ HOT_PATHS = (
     # error here would hide exactly the double-release/recycle bug
     # that corrupts bytes on the wire
     "ceph_tpu/common/slab.py",
+    # the peering/recovery/scrub storm path (ISSUE 15): a swallowed
+    # error in a peering pass or a push is exactly how a PG silently
+    # never reaches clean — every remaining swallow is annotated with
+    # why it is safe (deferred-pass retries, peer-death slot releases)
+    "ceph_tpu/osd/peering.py",
+    "ceph_tpu/osd/recovery.py",
+    "ceph_tpu/osd/scrub.py",
 )
 
 ANNOTATION = "# swallow-ok:"

@@ -16,7 +16,13 @@ registry against the committed manifest ``ceph_tpu/msg/wire_manifest
   (renumbering); a class absent from the manifest fails (append it —
   the manifest diff is the reviewable wire-protocol change); a
   manifest entry with no class fails (move its id to ``retired``,
-  never delete); a ``retired`` id reused by any class fails.
+  never delete); a ``retired`` id reused by any class fails;
+- TAIL MODES are pinned too (ISSUE 15 wire audit): only the types the
+  manifest's ``json_tails`` list names may declare ``WIRE_TAIL =
+  "json"`` — a data-path type (the peering/recovery wire,
+  MOSDPGScan and friends, included) silently regressing to a JSON
+  field tail fails, and so does a listed type silently going binary
+  (delist it in the same diff — the manifest diff is the review).
 
 And the reason the binary header exists at all: JSON must not creep
 back onto the frame hot path.  ``json.dumps``/``json.loads`` calls in
@@ -60,14 +66,30 @@ def _registered_classes(tree: ast.Module) -> list[ast.ClassDef]:
     return out
 
 
+# sentinel for class attributes assigned a NON-constant expression —
+# callers must not silently default these (a WIRE_TAIL laundered
+# through a name would otherwise read as the default "bin")
+NON_LITERAL = object()
+
+
 def _class_consts(cls: ast.ClassDef) -> dict:
     vals: dict = {}
     for stmt in cls.body:
+        # plain and ANNOTATED assignments both bind class attributes
+        # at runtime — `WIRE_TAIL: str = "json"` must not be invisible
         if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
                 and isinstance(stmt.targets[0], ast.Name):
-            name = stmt.targets[0].id
-            if isinstance(stmt.value, ast.Constant):
-                vals[name] = stmt.value.value
+            name, value = stmt.targets[0].id, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) \
+                and isinstance(stmt.target, ast.Name) \
+                and stmt.value is not None:
+            name, value = stmt.target.id, stmt.value
+        else:
+            continue
+        if isinstance(value, ast.Constant):
+            vals[name] = value.value
+        else:
+            vals[name] = NON_LITERAL
     return vals
 
 
@@ -89,6 +111,7 @@ def check(root: pathlib.Path) -> list[str]:
     seen_ids: dict[int, str] = {}
     seen_names: dict[str, str] = {}
     code_types: dict[str, int] = {}
+    code_tails: dict[str, str] = {}  # TYPE -> "bin" | "json"
     for rel in CLASS_FILES:
         path = root / rel
         if not path.exists():
@@ -129,9 +152,17 @@ def check(root: pathlib.Path) -> list[str]:
                     f"{where}: TYPE {tname!r} collides: {cls.name} vs "
                     f"{seen_names[tname]}")
                 continue
+            tail = consts.get("WIRE_TAIL", "bin")
+            if tail not in ("bin", "json"):
+                problems.append(
+                    f"{where}: {cls.name} has a non-literal or invalid "
+                    f"WIRE_TAIL ({tail!r}) — tail modes are wire "
+                    f"protocol")
+                continue
             seen_ids[tid] = cls.name
             seen_names[tname] = cls.name
             code_types[tname] = tid
+            code_tails[tname] = tail
 
     # -- 2. manifest comparison
     mpath = root / MANIFEST
@@ -139,9 +170,10 @@ def check(root: pathlib.Path) -> list[str]:
         manifest = json.loads(mpath.read_text())
         mtypes = dict(manifest.get("types", {}))
         retired = list(manifest.get("retired", []))
+        json_tails = set(manifest.get("json_tails", []))
     except (OSError, ValueError) as e:
         problems.append(f"{MANIFEST}: unreadable: {e}")
-        mtypes, retired = {}, []
+        mtypes, retired, json_tails = {}, [], set()
     if code_types:  # skip cross-checks if extraction already failed hard
         for tname, tid in sorted(code_types.items()):
             want = mtypes.get(tname)
@@ -167,6 +199,26 @@ def check(root: pathlib.Path) -> list[str]:
             problems.append(
                 f"{MANIFEST}: id {TYPE_ID_BATCH} is reserved for "
                 f"batch frames")
+        # tail-mode pin: the json_tails list is the ONLY license for a
+        # JSON field tail — both directions of drift fail
+        for tname, tail in sorted(code_tails.items()):
+            if tail == "json" and tname not in json_tails:
+                problems.append(
+                    f"{MANIFEST}: {tname!r} declares WIRE_TAIL='json' "
+                    f"but is not in 'json_tails' — data-path types "
+                    f"(the peering/recovery wire included) must stay "
+                    f"positional-marshal; admin/auth opt-ins go in "
+                    f"the manifest list (the reviewable wire change)")
+            elif tail == "bin" and tname in json_tails:
+                problems.append(
+                    f"{MANIFEST}: {tname!r} is listed in 'json_tails' "
+                    f"but declares a binary tail — delist it in the "
+                    f"same diff (tail modes are wire protocol)")
+        for tname in sorted(json_tails):
+            if tname not in code_types:
+                problems.append(
+                    f"{MANIFEST}: 'json_tails' entry {tname!r} has no "
+                    f"registered class")
 
     # -- 3. JSON off the frame hot path
     for rel in JSON_BAN_FILES:
