@@ -1915,12 +1915,17 @@ def bench_qos(deadline: float | None = None) -> dict:
     """
     import asyncio
 
+    from ceph_tpu.osd.client_ledger import ClientLedger
     from ceph_tpu.osd.scheduler import OpScheduler, QosSpec
 
     service_s = 0.002     # per-grant device time (slots=1 -> 500/s)
     n_client = 60
     storm = 4 * n_client  # the 4:1 background:client storm
     arrival_s = 0.003     # client inter-arrival (demand ~333/s > res)
+    # synthetic tenants with a 2:1:1 skew — the per-tenant breakdown
+    # below comes from the REAL ledger aggregator (ISSUE 16), so the
+    # bench exercises the same top-K/p99 path the OSD op path feeds
+    tenant_cycle = (101, 101, 202, 303)
 
     async def run_policy(policy: str) -> dict:
         sched = OpScheduler(
@@ -1931,19 +1936,24 @@ def bench_qos(deadline: float | None = None) -> dict:
             policy=policy, slots=1, cut_off=10_000,
         )
         waits: list[float] = []
+        ledger = ClientLedger(topk=8, window=60.0)
 
-        async def one(klass: str) -> None:
+        async def one(klass: str, tenant: int = 0) -> None:
             t0 = time.perf_counter()
             async with sched.grant(klass):
                 if klass == "client":
-                    waits.append(time.perf_counter() - t0)
+                    wait = time.perf_counter() - t0
+                    waits.append(wait)
+                    ledger.account(tenant, 0, "client", lat=wait)
                 await asyncio.sleep(service_s)
 
         bg = [asyncio.ensure_future(one("recovery")) for _ in range(storm)]
         await asyncio.sleep(0)  # the storm queues FIRST — worst case
         cl = []
-        for _ in range(n_client):
-            cl.append(asyncio.ensure_future(one("client")))
+        for i in range(n_client):
+            cl.append(asyncio.ensure_future(
+                one("client", tenant_cycle[i % len(tenant_cycle)])
+            ))
             await asyncio.sleep(arrival_s)
         await asyncio.gather(*cl)
         share = sched.share_attainment("client")
@@ -1951,6 +1961,7 @@ def bench_qos(deadline: float | None = None) -> dict:
             t.cancel()
         await asyncio.gather(*bg, return_exceptions=True)
         ws = sorted(waits)
+        total = sum(r["ops"] for r in ledger.series())
         return {
             "p50_ms": round(ws[len(ws) // 2] * 1e3, 3),
             "p99_ms": round(
@@ -1960,6 +1971,14 @@ def bench_qos(deadline: float | None = None) -> dict:
             "share_attainment": (
                 round(share, 3) if share is not None else None
             ),
+            "tenants": {
+                str(r["client"]): {
+                    "ops": r["ops"],
+                    "share": round(r["ops"] / total, 3) if total else 0.0,
+                    "wait_p99_ms": round(r["p99_s"] * 1e3, 3),
+                }
+                for r in ledger.series() if r["class"] != "other"
+            },
         }
 
     mclock = asyncio.run(run_policy("mclock"))
@@ -2012,9 +2031,14 @@ def bench_churn(deadline: float | None = None) -> dict:
             config_overrides={"osd_op_queue": policy,
                               "osd_op_queue_slots": 4},
         ) as c:
-            cl = await c.client()
+            # two NAMED tenants (stable blake2b session ids): the storm
+            # load splits across them so the OSD ledgers have a real
+            # multi-tenant breakdown to report (ISSUE 16)
+            cl = await c.client(name="bench.tenant_a")
+            cl2 = await c.client(name="bench.tenant_b")
             await cl.create_pool("churn", "erasure", pg_num=8)
             io = cl.io_ctx("churn")
+            io2 = cl2.io_ctx("churn")
             for i in range(seed_objects):  # the dataset recovery moves
                 await io.write_full(f"seed{i}", payload)
 
@@ -2029,9 +2053,14 @@ def bench_churn(deadline: float | None = None) -> dict:
             if quiet.failed:
                 raise RuntimeError(f"quiescent ops failed: {quiet.failed[:3]}")
 
+            # same 4 concurrent writers as before (comparable p99
+            # series), split 2+2 across the two tenants
             load = ClientLoad(io, prefix="s", objects=8, size=4096,
                               pause=0.002)
-            load.start(writers=4)
+            load.start(writers=2)
+            load2 = ClientLoad(io2, prefix="t", objects=8, size=4096,
+                               pause=0.002)
+            load2.start(writers=2)
             driver = StormDriver(c, cl, ["churn"])
 
             def pushed() -> int:
@@ -2057,18 +2086,47 @@ def bench_churn(deadline: float | None = None) -> dict:
             await driver.settle(timeout=45.0)
             recovery_wall = time.perf_counter() - t0
             moved = pushed() - bytes0
+            # tenant breakdown BEFORE the loads stop: the ledger is a
+            # sliding window, so read it while the storm is in-window
+            tenants: dict[str, dict] = {}
+            tenant_total = 0
+            for o in c.osds.values():
+                for row in o.client_ledger.series():
+                    tenant_total += row["ops"]
+                    if row["class"] == "other":
+                        continue
+                    t = tenants.setdefault(str(row["client"]), {
+                        "ops": 0, "errs": 0, "p99_ms": 0.0,
+                    })
+                    t["ops"] += row["ops"]
+                    t["errs"] += row["errs"]
+                    t["p99_ms"] = max(
+                        t["p99_ms"], round(row["p99_s"] * 1e3, 3)
+                    )
+            for t in tenants.values():
+                t["share"] = round(t["ops"] / tenant_total, 3) \
+                    if tenant_total else 0.0
             await load.stop()
-            if load.failed:
-                raise RuntimeError(f"storm ops failed: {load.failed[:3]}")
-            lost = await load.verify()
+            await load2.stop()
+            failed = load.failed + load2.failed
+            if failed:
+                raise RuntimeError(f"storm ops failed: {failed[:3]}")
+            lost = (await load.verify()) + (await load2.verify())
             if lost:
                 raise RuntimeError(f"lost acked writes: {lost[:3]}")
+            lat = sorted(load.latencies + load2.latencies)
+            storm_p99 = round(
+                lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1e3, 3
+            ) if lat else 0.0
             return {
-                "storm_p99_ms": load.p99_ms(),
+                "storm_p99_ms": storm_p99,
                 "quiet_p99_ms": quiet.p99_ms(),
-                "ops": len(load.latencies),
+                "ops": len(lat),
                 "recovery_bytes": moved,
                 "recovery_wall_s": round(recovery_wall, 3),
+                "tenants": dict(sorted(
+                    tenants.items(), key=lambda kv: -kv[1]["ops"]
+                )),
             }
 
     def _degradation(r: dict) -> float:

@@ -237,6 +237,11 @@ class AccelClient:
         sinfo = b.sinfo
         profile = dict(b.codec._profile)
         stripes = [op.stripes for op in ops]
+        # per-member tenant ids (ISSUE 16): the accelerator's dmClock
+        # and flight records attribute device time to the SAME u64 the
+        # OSD ledger keys on (0 = unattributed)
+        tenants = [op.client if isinstance(op.client, int) else 0
+                   for op in ops]
         try:
             if b.kind == "enc":
                 # one borrowed view per member op — no gather on this
@@ -246,6 +251,7 @@ class AccelClient:
                     stripe_width=sinfo.stripe_width,
                     chunk_size=sinfo.chunk_size,
                     stripes=stripes, klass=b.klass,
+                    tenants=tenants,
                     blobs=[op.payload for op in ops],
                 ))
             else:
@@ -255,6 +261,7 @@ class AccelClient:
                     stripe_width=sinfo.stripe_width,
                     chunk_size=sinfo.chunk_size,
                     stripes=stripes, present=present, klass=b.klass,
+                    tenants=tenants,
                     blobs=[op.payload[s] for op in ops
                            for s in present],
                 ))

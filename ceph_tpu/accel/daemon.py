@@ -551,10 +551,17 @@ class AccelDaemon(Dispatcher):
         launch — together with other clients' members."""
         bufs = [as_u8(bl) for bl in msg.blobs]
         total = sum(b.size for b in bufs)
+        tenants = msg.tenants or []
         outs = await asyncio.gather(*[
+            # per-member tenant attribution (ISSUE 16): the flight
+            # recorder shows the SAME u64 ids the OSD ledger keys on;
+            # unattributed members fall back to the sending OSD's name
             self.dispatch.encode(sinfo, codec, b, klass=klass,
-                                 client=conn.peer_name)
-            for b in bufs
+                                 client=(tenants[i]
+                                         if i < len(tenants)
+                                         and tenants[i]
+                                         else conn.peer_name))
+            for i, b in enumerate(bufs)
         ])
         self._sync_cross_client()
         shards = sorted(outs[0]) if outs else []
@@ -579,10 +586,15 @@ class AccelDaemon(Dispatcher):
         total = sum(
             v.size for p in payloads for v in p.values()
         )
+        tenants = msg.tenants or []
         outs = await asyncio.gather(*[
+            # see _serve_encode: per-member tenant attribution
             self.dispatch.decode_concat(sinfo, codec, p, klass=klass,
-                                        client=conn.peer_name)
-            for p in payloads
+                                        client=(tenants[i]
+                                                if i < len(tenants)
+                                                and tenants[i]
+                                                else conn.peer_name))
+            for i, p in enumerate(payloads)
         ])
         self._sync_cross_client()
         return list(outs), total

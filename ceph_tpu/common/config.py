@@ -379,6 +379,51 @@ OPTIONS: dict[str, Option] = _opts(
            "op worker heartbeat grace before the daemon is unhealthy"),
     Option("osd_op_thread_suicide_timeout", float, 150.0,
            "op worker stall that aborts the daemon (0 disables)"),
+    # tenant ledger / tsdb / SLO (ISSUE 16)
+    Option("osd_client_ledger_topk", int, 128,
+           "per-OSD tenant ledger capacity: the space-saving sketch "
+           "tracks the K heaviest (client, pool, class) keys exactly "
+           "and folds the tail into one 'other' bucket — memory is "
+           "O(K) no matter how many tenants exist"),
+    Option("osd_client_ledger_window", float, 10.0,
+           "tenant-ledger sliding window (s): dumps and the mgr's "
+           "ceph_client_* series reflect the last 0.5-1x this span, "
+           "so idle tenants age out of the top-K"),
+    Option("osd_inject_op_delay", float, 0.0,
+           "DEBUG: sleep this long (s) inside every client op before "
+           "execution — the latency-storm injector the SLO burn-rate "
+           "tests flip on and off live (0 = off)"),
+    Option("mgr_tsdb_step", float, 1.0,
+           "mgr time-series store bucket width (s): daemon reports "
+           "land in fixed-step buckets; rates derive from cumulative "
+           "deltas across them"),
+    Option("mgr_tsdb_retention", int, 600,
+           "mgr time-series ring depth (buckets per series): memory "
+           "per series is this many points, full stop — history "
+           "beyond step*retention falls off the ring"),
+    Option("mgr_tsdb_max_series", int, 4096,
+           "hard cap on distinct series the mgr store tracks; "
+           "overflow increments tsdb.dropped_series instead of "
+           "growing without bound"),
+    Option("mgr_slo_op_p99_target", float, 0.5,
+           "SLO: client op latency threshold (s) — ops slower than "
+           "this burn the latency error budget (budget: "
+           "mgr_slo_slow_frac_budget of ops may exceed it)"),
+    Option("mgr_slo_slow_frac_budget", float, 0.01,
+           "SLO: allowed fraction of ops over the p99 target (the "
+           "error budget the burn rate is measured against)"),
+    Option("mgr_slo_failure_rate_target", float, 0.01,
+           "SLO: allowed client op failure rate (op_err/op)"),
+    Option("mgr_slo_fast_window", float, 5.0,
+           "SLO fast burn window (s) — the 5m analog scaled to test "
+           "time; both windows must burn to raise SLO_BURN, and the "
+           "fast one decaying clears it"),
+    Option("mgr_slo_slow_window", float, 60.0,
+           "SLO slow burn window (s) — the 1h analog scaled to test "
+           "time"),
+    Option("mgr_slo_burn_threshold", float, 2.0,
+           "burn-rate multiple (consumption / budget) that raises "
+           "SLO_BURN when BOTH windows exceed it"),
 )
 
 

@@ -52,6 +52,13 @@ current_trace: contextvars.ContextVar[str | None] = contextvars.ContextVar(
 current_span: contextvars.ContextVar[str | None] = contextvars.ContextVar(
     "ceph_tpu_span", default=None
 )
+# the originating client id for this task tree (ISSUE 16): set once at
+# op dispatch, read wherever attribution is needed (EC dispatch _Op
+# capture, flight records) — the same zero-threading pattern as
+# current_trace, so deep call chains never grow a client= parameter
+current_client: contextvars.ContextVar[int | None] = contextvars.ContextVar(
+    "ceph_tpu_client", default=None
+)
 _trace_seq = itertools.count(1)
 _span_seq = itertools.count(1)
 
@@ -256,7 +263,11 @@ def op_waterfall(trace: str) -> dict:
     copy), time-ordered, with nesting resolved.  ``path_sum_s`` sums
     only top-level (parentless) hops — the honesty number the
     acceptance test holds against the client-observed wall time;
-    ``dominant_hop`` names where the op's microseconds went."""
+    ``dominant_hop`` names where the op's microseconds went.
+
+    Any span carrying a ``client`` field (the OSD stamps its hops with
+    the originating tenant id) surfaces it as a top-level ``client``
+    key, so "whose op was this" reads straight off the waterfall."""
     spans: dict[str, dict] = {}
     for name, p in _providers.items():
         for e in p.events():
@@ -271,14 +282,18 @@ def op_waterfall(trace: str) -> dict:
             ):
                 spans[sid] = dict(e)
     if not spans:
-        return {"trace": trace, "hops": [], "path_sum_s": 0.0,
-                "span_s": 0.0, "dominant_hop": None,
+        return {"trace": trace, "client": None, "hops": [],
+                "path_sum_s": 0.0, "span_s": 0.0, "dominant_hop": None,
                 "max_uncertainty_s": 0.0}
     # start-time order; at an exact tie the SHORTER span sorts first
     # (a zero-duration hop ends where its same-start neighbor begins —
     # a clamped-to-zero wire must still render before dispatch)
     ordered = sorted(spans.values(), key=lambda e: (e["ts"], e["dur"]))
     t_base = ordered[0]["ts"]
+    client = next(
+        (e["client"] for e in ordered if e.get("client") is not None),
+        None,
+    )
     hops = []
     path_sum = 0.0
     dominant = (None, -1.0)
@@ -304,6 +319,7 @@ def op_waterfall(trace: str) -> dict:
     ) - t_base
     return {
         "trace": trace,
+        "client": client,
         "hops": hops,
         "path_sum_s": round(path_sum, 9),
         "span_s": round(span_s, 9),

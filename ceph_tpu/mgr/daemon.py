@@ -67,8 +67,38 @@ class MgrDaemon(Dispatcher):
         pm.add_counter("daemon_stats_received",
                        "non-OSD daemon reports ingested")
         pm.add_counter("commands", "module commands served")
+        # time-series store (ISSUE 16): every daemon report folds into
+        # bounded ring-buffer history; its own health is a perf family
+        # so series-cap pressure shows in prometheus like anything else
+        ptsdb = self.perf.create("tsdb")
+        ptsdb.add_counter("samples", "series points ingested")
+        ptsdb.add_counter("dropped_series",
+                          "new series refused past mgr_tsdb_max_series")
+        ptsdb.add_gauge("series", "distinct series tracked")
+        ptsdb.add_gauge("points", "ring points held across all series")
+        from .tsdb import TimeSeriesStore
+
+        self.tsdb = TimeSeriesStore(
+            step=self.config.mgr_tsdb_step,
+            retention=self.config.mgr_tsdb_retention,
+            max_series=self.config.mgr_tsdb_max_series,
+            perf=ptsdb,
+        )
+        self.tsdb.slow_threshold = self.config.mgr_slo_op_p99_target
+        # SLO burn-rate state (ISSUE 16): gauges survive scrapes; the
+        # health check itself is computed on demand in _health_checks
+        pslo = self.perf.create("slo")
+        pslo.add_gauge("latency_burn_fast",
+                       "latency error-budget burn rate, fast window")
+        pslo.add_gauge("latency_burn_slow",
+                       "latency error-budget burn rate, slow window")
+        pslo.add_gauge("failure_burn_fast",
+                       "failure-rate budget burn, fast window")
+        pslo.add_gauge("failure_burn_slow",
+                       "failure-rate budget burn, slow window")
         from .modules import (
             DfModule,
+            MetricsModule,
             OsdDfModule,
             PGDumpModule,
             PgQueryModule,
@@ -78,7 +108,7 @@ class MgrDaemon(Dispatcher):
 
         self.modules: list[MgrModule] = modules or [
             StatusModule(), DfModule(), OsdDfModule(), PgQueryModule(),
-            PGDumpModule(), PrometheusModule(),
+            PGDumpModule(), PrometheusModule(), MetricsModule(),
         ]
         self._routes: dict[str, MgrModule] = {}
         for mod in self.modules:
@@ -161,6 +191,10 @@ class MgrDaemon(Dispatcher):
                         tid = self._check_pool_quotas(conn, tid)
                 except (ConnectionError, OSError):
                     self._mon_conn = None
+                # the mgr's OWN counters ride the same history as any
+                # reporting daemon (ISSUE 16) — msgr clock-sync
+                # uncertainty included
+                self.tsdb.ingest(self.name, self.perf.dump())
                 await asyncio.sleep(interval)
         except asyncio.CancelledError:
             pass
@@ -229,6 +263,7 @@ class MgrDaemon(Dispatcher):
             self.daemon_stats[msg.name] = {
                 "perf": dict(msg.perf or {}), "ts": time.monotonic(),
             }
+            self.tsdb.ingest(msg.name, msg.perf or {})
         elif isinstance(msg, messages.MMonCommand):
             code, status, out = self.handle_command(msg.cmd)
             conn.send(messages.MMonCommandReply(
@@ -247,9 +282,19 @@ class MgrDaemon(Dispatcher):
             "pgs": dict(msg.pgs or {}),
             "perf": dict(msg.perf or {}),
             "store": dict(msg.store or {}),
+            "ledger": list(msg.ledger or []),
             "epoch": msg.epoch,
             "ts": now,
         }
+        # fold the report into history (ISSUE 16): rates/quantiles
+        # derive at insert; the slow threshold tracks the SLO target
+        # so slow_frac and the burn rate measure the same thing
+        self.tsdb.slow_threshold = self.config.mgr_slo_op_p99_target
+        self.tsdb.ingest(f"osd.{msg.osd}", msg.perf or {})
+        st = self.tsdb.stats()
+        ptsdb = self.perf.get("tsdb")
+        ptsdb.set("series", st["series"])
+        ptsdb.set("points", st["points"])
         # client io rates from op-counter deltas
         prev = self._prev_perf.get(msg.osd)
         osd_perf = (msg.perf or {}).get("osd", {})

@@ -187,7 +187,119 @@ def _health_checks(m, mgr, *, up: int, inn: int, exists: int) -> list[dict]:
                 "accels)"
             ),
         })
+    slo = _slo_burn_check(mgr)
+    if slo is not None:
+        checks.append(slo)
     return checks
+
+
+def _dominant_tenant(mgr) -> tuple[object, float] | None:
+    """(client id, share-of-window) of the heaviest attributed tenant
+    across every OSD's ledger rows — the tail bucket counts in the
+    denominator so a diffuse load can't crown a minor client."""
+    totals: dict[object, int] = {}
+    all_ops = 0
+    for st in mgr.live_osd_stats().values():
+        for row in st.get("ledger") or []:
+            ops = int(row.get("ops", 0) or 0)
+            all_ops += ops
+            if row.get("class") == "other":
+                continue
+            c = row.get("client")
+            totals[c] = totals.get(c, 0) + ops
+    if not totals or all_ops <= 0:
+        return None
+    top = max(totals, key=lambda c: totals[c])
+    return top, totals[top] / all_ops
+
+
+def _worst_hop(mgr, window: float) -> tuple[str | None, float]:
+    """(hop name, windowed slow fraction) of the worst pipeline hop
+    from the stack.lat_* histogram-derived counter series — names the
+    stage burning the latency budget, not just that it burns."""
+    best, best_frac = None, 0.0
+    for ent in mgr.tsdb.ls("stack.lat_*.slow_total"):
+        m = ent["metric"]
+        base = m[: -len(".slow_total")]
+        tot = mgr.tsdb.query(f"{base}.total", window=window)["value"]
+        if tot <= 0:
+            continue
+        frac = mgr.tsdb.query(m, window=window)["value"] / tot
+        if frac > best_frac:
+            best, best_frac = base[len("stack.lat_"):], frac
+    return best, best_frac
+
+
+def _slo_burn_check(mgr) -> dict | None:
+    """Multi-window SLO burn-rate evaluation (the SRE-workbook fast/
+    slow pattern): both the fast AND slow window must burn budget
+    faster than ``mgr_slo_burn_threshold``x before SLO_BURN raises —
+    the fast window alone is too noisy, the slow window alone pages
+    long after the storm.  Burns also land in the ``slo.*`` gauges so
+    prometheus can graph the approach to the threshold."""
+    cfg = getattr(mgr, "config", None)
+    if cfg is None or getattr(mgr, "tsdb", None) is None:
+        # partial mgr (health evaluated against a map-only view, as
+        # some callers/fixtures do): no history, no SLO verdict
+        return None
+    fast = float(cfg.mgr_slo_fast_window)
+    slow = float(cfg.mgr_slo_slow_window)
+    lat_budget = max(1e-9, float(cfg.mgr_slo_slow_frac_budget))
+    fail_budget = max(1e-9, float(cfg.mgr_slo_failure_rate_target))
+
+    def lat_burn(window: float) -> float:
+        tot = mgr.tsdb.query("osd.op_latency_histogram.total",
+                             window=window)["value"]
+        if tot <= 0:
+            return 0.0
+        sl = mgr.tsdb.query("osd.op_latency_histogram.slow_total",
+                            window=window)["value"]
+        return (sl / tot) / lat_budget
+
+    def fail_burn(window: float) -> float:
+        ops = mgr.tsdb.query("osd.op", window=window)["value"]
+        if ops <= 0:
+            return 0.0
+        errs = mgr.tsdb.query("osd.op_err", window=window)["value"]
+        return (errs / ops) / fail_budget
+
+    lf, ls = lat_burn(fast), lat_burn(slow)
+    ff, fs = fail_burn(fast), fail_burn(slow)
+    pslo = mgr.perf.get("slo")
+    if pslo is not None:
+        pslo.set("latency_burn_fast", round(lf, 6))
+        pslo.set("latency_burn_slow", round(ls, 6))
+        pslo.set("failure_burn_fast", round(ff, 6))
+        pslo.set("failure_burn_slow", round(fs, 6))
+    thr = float(cfg.mgr_slo_burn_threshold)
+    lat_hot = lf > thr and ls > thr
+    fail_hot = ff > thr and fs > thr
+    if not lat_hot and not fail_hot:
+        return None
+    parts = []
+    if lat_hot:
+        parts.append(
+            f"latency budget burning {lf:.1f}x (fast) / {ls:.1f}x "
+            "(slow)"
+        )
+    if fail_hot:
+        parts.append(
+            f"failure budget burning {ff:.1f}x (fast) / {fs:.1f}x "
+            "(slow)"
+        )
+    detail = "; ".join(parts)
+    dom = _dominant_tenant(mgr)
+    if dom is not None:
+        detail += (
+            f"; dominant client {dom[0]} ({dom[1]:.0%} of ops)"
+        )
+    hop, frac = _worst_hop(mgr, fast)
+    if hop is not None and frac > 0:
+        detail += f"; worst hop {hop} ({frac:.0%} slow)"
+    return {
+        "code": "SLO_BURN", "severity": "HEALTH_WARN",
+        "summary": detail,
+    }
 
 
 class StatusModule(MgrModule):
@@ -429,6 +541,116 @@ class PGDumpModule(MgrModule):
         }
 
 
+class MetricsModule(MgrModule):
+    """Query surface over the mgr's time-series store (tsdb.py):
+    ``metrics ls`` lists series names, ``metrics query`` answers one
+    windowed number (rate/value/avg), ``metrics range`` returns the
+    per-bucket samples ``ceph_top`` renders.  Command routing is exact
+    prefix match, so these coexist with the prometheus module's bare
+    ``metrics`` scrape."""
+
+    NAME = "metrics_store"
+    COMMANDS = {
+        "metrics query": "query",
+        "metrics ls": "ls",
+        "metrics range": "range_",
+        "metrics stats": "stats",
+        "client ledger": "client_ledger",
+    }
+
+    def query(self, mgr: MgrDaemon, cmd: dict) -> tuple[int, str, Any]:
+        metric = cmd.get("metric")
+        if not metric:
+            return -22, "need metric", None
+        derive = str(cmd.get("derive", "rate"))
+        if derive not in ("rate", "value", "avg"):
+            return -22, f"bad derive {derive!r}", None
+        return 0, "", mgr.tsdb.query(
+            str(metric),
+            window=float(cmd.get("window", 10.0)),
+            daemon=cmd.get("daemon"),
+            derive=derive,
+        )
+
+    def ls(self, mgr: MgrDaemon, cmd: dict) -> tuple[int, str, Any]:
+        # stats nests: its "series" key (a count) must not clobber
+        # the series list
+        return 0, "", {
+            "series": mgr.tsdb.ls(cmd.get("pattern")),
+            "stats": mgr.tsdb.stats(),
+        }
+
+    def range_(self, mgr: MgrDaemon, cmd: dict) -> tuple[int, str, Any]:
+        metric = cmd.get("metric")
+        if not metric:
+            return -22, "need metric", None
+        derive = str(cmd.get("derive", "rate"))
+        if derive not in ("rate", "value"):
+            return -22, f"bad derive {derive!r}", None
+        return 0, "", mgr.tsdb.range(
+            str(metric),
+            window=float(cmd.get("window", 60.0)),
+            daemon=cmd.get("daemon"),
+            derive=derive,
+        )
+
+    def stats(self, mgr: MgrDaemon, cmd: dict) -> tuple[int, str, Any]:
+        return 0, "", mgr.tsdb.stats()
+
+    def client_ledger(self, mgr: MgrDaemon, cmd: dict
+                      ) -> tuple[int, str, Any]:
+        """Cluster-wide tenant view: every OSD's top-K ledger rows
+        merged by (client, pool, class).  Share is over ALL in-window
+        ops including the evicted tail, so a heavy hitter's share is
+        honest even when small tenants fell off the sketch.  p99 is
+        the max across OSDs (per-OSD sketches cannot be re-merged
+        into one quantile)."""
+        merged: dict[tuple, dict] = {}
+        other = {"ops": 0, "errs": 0, "ops_per_sec": 0.0,
+                 "bytes_per_sec": 0.0}
+        total_ops = 0
+        for st in mgr.live_osd_stats().values():
+            for row in st.get("ledger") or []:
+                ops = int(row.get("ops", 0) or 0)
+                total_ops += ops
+                if row.get("class") == "other":
+                    other["ops"] += ops
+                    other["errs"] += int(row.get("errs", 0) or 0)
+                    other["ops_per_sec"] += float(
+                        row.get("ops_per_sec", 0) or 0)
+                    other["bytes_per_sec"] += float(
+                        row.get("bytes_per_sec", 0) or 0)
+                    continue
+                key = (row.get("client"), row.get("pool"),
+                       row.get("class"))
+                e = merged.setdefault(key, {
+                    "client": row.get("client"),
+                    "pool": row.get("pool"),
+                    "class": row.get("class"),
+                    "ops": 0, "errs": 0, "bytes_in": 0,
+                    "bytes_out": 0, "ops_per_sec": 0.0,
+                    "bytes_per_sec": 0.0, "p99_s": 0.0,
+                })
+                e["ops"] += ops
+                e["errs"] += int(row.get("errs", 0) or 0)
+                e["bytes_in"] += int(row.get("bytes_in", 0) or 0)
+                e["bytes_out"] += int(row.get("bytes_out", 0) or 0)
+                e["ops_per_sec"] += float(row.get("ops_per_sec", 0) or 0)
+                e["bytes_per_sec"] += float(
+                    row.get("bytes_per_sec", 0) or 0)
+                e["p99_s"] = max(e["p99_s"],
+                                 float(row.get("p99_s", 0) or 0))
+        rows = sorted(merged.values(), key=lambda r: -r["ops"])
+        for r in rows:
+            r["share"] = round(r["ops"] / total_ops, 4) \
+                if total_ops else 0.0
+        return 0, "", {
+            "total_ops": total_ops,
+            "clients": rows,
+            "other": other,
+        }
+
+
 def _prom_escape(value) -> str:
     """Prometheus label-value escaping (exposition format: backslash,
     double-quote and newline must be escaped inside label values)."""
@@ -486,6 +708,7 @@ class PrometheusModule(MgrModule):
             else:
                 le = format(amin + i * quant, "g")
             lines.append(
+                # cardinality-ok: le edges are the fixed axis schema
                 f'{base}_bucket{{{labels},le="{le}"}} {cum}'
             )
         lines.append(
@@ -509,9 +732,11 @@ class PrometheusModule(MgrModule):
         name variants."""
         esc = _prom_escape(daemon)
         for subsys, counters in sorted((perf or {}).items()):
+            # cardinality-ok: one value per reporting daemon
             labels = f'daemon="{esc}"'
             if "@" in subsys:
                 subsys, instance = subsys.split("@", 1)
+                # cardinality-ok: one value per configured accel target
                 labels += f',{subsys}="{_prom_escape(instance)}"'
             lab = f"{{{labels}}}"
             for key, val in sorted(counters.items()):
@@ -550,6 +775,29 @@ class PrometheusModule(MgrModule):
             )
         for osd, st in sorted(mgr.live_osd_stats().items()):
             self._emit_daemon(lines, f"osd.{osd}", st["perf"])
+            # tenant ledger rows (ISSUE 16): cardinality is bounded at
+            # the SOURCE — each OSD ships at most osd_client_ledger_topk
+            # rows + one "other" tail row, so the series count here is
+            # O(osds * topk) no matter how many tenants exist
+            for row in st.get("ledger") or []:
+                labels = (
+                    f'daemon="osd.{osd}",'
+                    # cardinality-ok: top-K ledger rows, <= topk+other
+                    f'client="{_prom_escape(row.get("client"))}",'
+                    # cardinality-ok: pools are operator-created, few
+                    f'pool="{_prom_escape(row.get("pool"))}",'
+                    # cardinality-ok: fixed op-class enum + "other"
+                    f'class="{_prom_escape(row.get("class"))}"'
+                )
+                for col, series in (
+                    ("ops_per_sec", "ceph_client_ops_per_sec"),
+                    ("bytes_per_sec", "ceph_client_bytes_per_sec"),
+                    ("p99_s", "ceph_client_p99_seconds"),
+                    ("errs", "ceph_client_errors"),
+                ):
+                    lines.append(
+                        f"{series}{{{labels}}} {row.get(col, 0) or 0}"
+                    )
         # non-OSD daemons (mon elections/map publishes, rgw verbs) ride
         # MDaemonStats reports; the mgr exports its own counters too
         for name, st in sorted(mgr.live_daemon_stats().items()):
@@ -557,6 +805,7 @@ class PrometheusModule(MgrModule):
         self._emit_daemon(lines, mgr.name, mgr.perf.dump())
         for pgid, pst in sorted(mgr.pg_summary().items()):
             lines.append(
+                # cardinality-ok: pg count is fixed by pool pg_num
                 f'ceph_pg_objects{{pgid="{_prom_escape(pgid)}"}} '
                 f'{pst.get("objects", 0)}'
             )

@@ -314,12 +314,19 @@ class MOSDOp(Message):
     (common/tracing.py): together with the frame header's send stamp
     the OSD computes the client_serialize hop without shipping any
     span, and aligns it through the clock table.
+
+    ``client`` (ISSUE 16) is the originator's stable session id — a
+    63-bit blake2b of the entity name, one marshalled u64 riding the
+    positional tail.  It keys the OSD's per-tenant ledger and flows
+    through EC dispatch to the accelerator's flight records, so every
+    layer attributes work to the same tenant.  None from peers that
+    predate the field or from internal sub-ops.
     """
 
     TYPE = "osd_op"
     TYPE_ID = 50
     FIELDS = ("tid", "epoch", "pool", "oid", "ops", "snapc", "snapid",
-              "stamps")
+              "stamps", "client")
 
 
 @register
@@ -452,11 +459,18 @@ class MPGLsReply(Message):
 class MPGStats(Message):
     """OSD -> mgr: periodic stats report (reference:src/messages/
     MPGStats.h).  ``pgs`` = {pgid: {"objects", "bytes", "primary"}},
-    ``perf`` = the daemon's counter dump, ``store`` = usage totals."""
+    ``perf`` = the daemon's counter dump, ``store`` = usage totals.
+
+    ``ledger`` (ISSUE 16) is the OSD's per-tenant heavy-hitter dump
+    (client_ledger.series(): bounded top-K list of {"client", "pool",
+    "class", rates...} rows plus the evicted-other bucket) — shipped
+    as its own field rather than folded into ``perf`` so the mgr's
+    prometheus module keeps full label control and the cardinality
+    bound is enforced at the source."""
 
     TYPE = "pg_stats"
     TYPE_ID = 84
-    FIELDS = ("osd", "epoch", "pgs", "perf", "store")
+    FIELDS = ("osd", "epoch", "pgs", "perf", "store", "ledger")
 
 
 @register
@@ -550,12 +564,18 @@ class MAccelEncode(Message):
     accelerator's own dmClock instance paces by.  Payloads ride in
     blobs, ONE BORROWED VIEW PER MEMBER OP (no gather on the OSD side
     — the frame encoder sends views vectored); the trace id rides the
-    frame header like every message."""
+    frame header like every message.
+
+    ``tenants`` (ISSUE 16) is the per-member originating-client id
+    list (one entry per coalesced op, 0 for unattributed) — the
+    accelerator's dmClock and flight records attribute device time to
+    the SAME tenant ids the OSD ledger uses, not just to the sending
+    OSD."""
 
     TYPE = "accel_encode"
     TYPE_ID = 120
     FIELDS = ("tid", "profile", "stripe_width", "chunk_size", "stripes",
-              "klass")
+              "klass", "tenants")
 
 
 @register
@@ -564,12 +584,13 @@ class MAccelDecode(Message):
     ``present`` is the shared survivor set (batch keys include it, so
     every member reads through the same recovery matrix); blobs are
     per-member per-shard views in ``present`` order, member-major
-    (op0's shards, then op1's, ...)."""
+    (op0's shards, then op1's, ...).  ``tenants`` as in MAccelEncode:
+    per-member originating-client ids."""
 
     TYPE = "accel_decode"
     TYPE_ID = 121
     FIELDS = ("tid", "profile", "stripe_width", "chunk_size", "stripes",
-              "present", "klass")
+              "present", "klass", "tenants")
 
 
 @register

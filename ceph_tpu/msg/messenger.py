@@ -437,6 +437,12 @@ class AsyncMessenger:
                       "amortized over send_coalesced members)")
          .add_gauge("dispatch_queue_bytes",
                     "inbound bytes held by the dispatch throttle")
+         .add_gauge("clock_sync_uncertainty",
+                    "worst per-connection clock-offset uncertainty "
+                    "(s) across live peers — loose alignment here "
+                    "means the waterfall's cross-daemon placement is "
+                    "loose too (ISSUE 16: was only visible inside "
+                    "dump_clock_sync)")
          .add_time_avg("dispatch_latency",
                        "handler wall time per inbound message")
          # log2 frame-size / dispatch-time distributions: the averages
@@ -782,6 +788,19 @@ class AsyncMessenger:
                 clock_table().observe(conn.peer_name, float(msg.t0),
                                       float(msg.t_rx), float(msg.t_tx),
                                       t3)
+                # worst live-connection uncertainty as a gauge (ISSUE
+                # 16): refreshed on every completed exchange, so the
+                # tsdb/top view flags hosts whose waterfall alignment
+                # went loose without an admin-socket round trip
+                worst = 0.0
+                for c in self._all:
+                    if c._closed:
+                        continue
+                    est = c.clock_estimate()
+                    if est is not None:
+                        worst = max(worst, est["uncertainty_s"])
+                self.perf.set("clock_sync_uncertainty",
+                              round(worst, 9))
             return
         await self.dispatcher.ms_dispatch(conn, msg)
 

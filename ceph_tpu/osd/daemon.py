@@ -487,6 +487,29 @@ class OSD(Dispatcher):
             "unrepaired",
             "CURRENT unrepaired inconsistencies (latest pass per pg)",
         )
+        # per-tenant op ledger (ISSUE 16): space-saving top-K over
+        # (client, pool, class) — the sketch's own health counters are
+        # a perf family so eviction pressure is visible in prometheus
+        from .client_ledger import ClientLedger
+
+        pcli = self.perf.create("client")
+        pcli.add_counter("accounted_ops",
+                         "client ops accounted into the tenant ledger")
+        pcli.add_counter("ledger_evictions",
+                         "top-K evictions (tail mass folded into the "
+                         "'other' bucket; high churn = raise "
+                         "osd_client_ledger_topk)")
+        pcli.add_gauge("ledger_entries",
+                       "live (client, pool, class) keys tracked — "
+                       "bounded by 2x osd_client_ledger_topk")
+        self.client_ledger = ClientLedger(
+            topk=cfg.osd_client_ledger_topk,
+            window=cfg.osd_client_ledger_window,
+            perf=pcli,
+        )
+        # the SLO latency-storm injector (ISSUE 16): cached so the op
+        # hot path reads an attribute, not the config dict
+        self._inject_op_delay = float(cfg.osd_inject_op_delay)
         # op tracking (reference:src/common/TrackedOp.h OpTracker):
         # typed state transitions, bounded history, slow-op detection
         from ..common.op_tracker import OpTracker
@@ -606,6 +629,15 @@ class OSD(Dispatcher):
             # small-op latency tests sweep live
             ("ms_reply_coalesce_max", lambda _n, v: setattr(
                 self.messenger, "reply_coalesce_max", int(v))),
+            # tenant ledger + SLO storm injector (ISSUE 16): the
+            # cardinality bound must shrink live, and the burn-rate
+            # tests flip the delay on a RUNNING osd
+            ("osd_client_ledger_topk",
+             lambda _n, v: self.client_ledger.set_topk(int(v))),
+            ("osd_client_ledger_window", lambda _n, v: setattr(
+                self.client_ledger, "window", max(0.1, float(v)))),
+            ("osd_inject_op_delay", lambda _n, v: setattr(
+                self, "_inject_op_delay", float(v))),
         ]
         for _qk in QOS_CLASSES:
             for _qf, _qa in (("res", "reservation"), ("wgt", "weight"),
@@ -932,6 +964,13 @@ class OSD(Dispatcher):
             lambda req: self.scheduler.dump(),
             "QoS op scheduler: policy, per-class specs, queues, "
             "dmClock tags, admission totals",
+        )
+        a.register(
+            "dump_client_ledger",
+            lambda req: self.client_ledger.dump(),
+            "per-tenant op ledger: top-K (client, pool, class) rows "
+            "with IOPS/bytes/p99/share over the sliding window, the "
+            "evicted-other bucket, and sketch health",
         )
         a.register(
             "dump_reservations",
@@ -1391,9 +1430,13 @@ class OSD(Dispatcher):
                                   "entity": self.name,
                                   "parent": parent})
         for s in spans:
+            # the tenant id rides every span event so op_waterfall can
+            # answer "whose op" without a tracker lookup (ISSUE 16)
             record_span(s["hop"], s["t0"], s["dur"], trace=trace,
                         entity=s["entity"], parent=s.get("parent"),
-                        uncertainty=s.get("uncertainty"))
+                        uncertainty=s.get("uncertainty"),
+                        **({"client": msg.client}
+                           if msg.client is not None else {}))
             stack_ledger.feed_hop(s["hop"], s["dur"])
         # lat_total = client submit -> reply queued: the OSD-visible
         # extent, fed HERE because this daemon's family is the one the
@@ -1423,10 +1466,16 @@ class OSD(Dispatcher):
         if any(n == "read" for n in names):
             posd.inc("op_r")
         # the tracked op carries the client's trace id so sub-op replies
-        # (arriving on other dispatch contexts) can mark its progress
+        # (arriving on other dispatch contexts) can mark its progress;
+        # the tenant id rides the desc into dump_ops_in_flight and the
+        # contextvar so EC dispatch/flight records attribute to it with
+        # no signature threading (ISSUE 16)
+        from ..common.tracing import current_client
+
+        current_client.set(msg.client)
         op = self.op_tracker.create(
             trace=msg.trace, tid=msg.tid, oid=msg.oid, pool=msg.pool,
-            ops=names,
+            ops=names, client=msg.client,
         )
         self._refresh_op_handle()
         # QoS admission (reference: enqueue_op -> the osd_op_queue ->
@@ -1447,6 +1496,12 @@ class OSD(Dispatcher):
             _trace.point("osd_dequeue_op", osd=self.osd_id, tid=msg.tid,
                          oid=msg.oid, ops=names)
             t0 = time.perf_counter()
+            if self._inject_op_delay > 0 and not internal:
+                # SLO storm injector: burns the latency budget without
+                # touching execution — inside the measured window so
+                # op_latency and the ledger p99 both see it; raises
+                # SLO_BURN live, clears when the knob resets (ISSUE 16)
+                await asyncio.sleep(self._inject_op_delay)
             try:
                 result, out, blobs = await self._execute_op(msg, conn)
             except asyncio.CancelledError:
@@ -1472,6 +1527,15 @@ class OSD(Dispatcher):
             else:
                 posd.inc(
                     "op_out_bytes", sum(len(b) for b in blobs)
+                )
+            if msg.client is not None and not internal:
+                # tenant attribution (ISSUE 16): O(K) however many
+                # clients exist — unattributed peers never reach here
+                self.client_ledger.account(
+                    msg.client, msg.pool, "client",
+                    bytes_in=sum(len(b) for b in msg.blobs),
+                    bytes_out=sum(len(b) for b in blobs),
+                    lat=dt, err=result < 0,
                 )
             op.mark("replied")
             spans_payload = None
@@ -4048,10 +4112,18 @@ class OSD(Dispatcher):
                         self._mgr_conn = conn
                         self._mgr_addr_used = addr
                     pgs, used = await self._collect_pg_stats()
+                    # ledger gauge + rows ride the same report: the
+                    # mgr's ceph_client_* series and the SLO module
+                    # see tenants at report cadence (ISSUE 16)
+                    self.perf.get("client").set(
+                        "ledger_entries",
+                        self.client_ledger.entry_count(),
+                    )
                     conn.send(messages.MPGStats(
                         osd=self.osd_id, epoch=self._epoch(), pgs=pgs,
                         perf=self.perf.dump(),
                         store={"bytes_used": used},
+                        ledger=self.client_ledger.series(),
                     ))
                 except (ConnectionError, OSError):
                     self._mgr_conn = None  # mgr bouncing; retry next tick
@@ -4085,12 +4157,27 @@ class OSD(Dispatcher):
             # durations — the waterfall's coarse shape for unsampled
             # ops), so the warning points at a hop, not just an age
             dom = oldest_op.dominant_state() if oldest_op else None
+            # ... and WHOSE ops they are: when one tenant owns the
+            # majority of the slow set, say so — "the cluster is slow"
+            # becomes "client X is slow" (ISSUE 16)
+            owners: dict = {}
+            for o in slow:
+                c = o.desc.get("client")
+                if c is not None:
+                    owners[c] = owners.get(c, 0) + 1
+            culprit = ""
+            if owners:
+                top = max(owners, key=lambda c: owners[c])
+                if owners[top] * 2 > len(slow):
+                    culprit = (f"; dominant client {top} owns "
+                               f"{owners[top]}/{len(slow)}")
             self.clog(
                 "warn",
                 f"{len(slow)} slow requests, oldest blocked for "
                 f"{oldest:.1f}s in state {dom or 'unknown'} "
                 f"(complaint time "
-                f"{self.config.osd_op_complaint_time:g}s)",
+                f"{self.config.osd_op_complaint_time:g}s)"
+                f"{culprit}",
             )
         self._slow_reported = len(slow)
 

@@ -128,7 +128,7 @@ from typing import Any, Mapping
 
 import numpy as np
 
-from ..common.tracing import current_trace
+from ..common.tracing import current_client, current_trace
 from ..models.matrix_codec import EngineFault
 from ..ops.device_trace import FlightRecorder
 from ..utils.buffers import as_u8, note_copy
@@ -168,20 +168,24 @@ class _Op:
     ``client`` names the requesting entity when this dispatcher serves
     REMOTE callers (the accelerator daemon, ISSUE 10: cross-client
     coalescing is the occupancy win, and the flight recorder must say
-    which OSDs shared a launch)."""
+    which OSDs shared a launch).  When no explicit client is passed it
+    captures ``current_client`` — the tenant id the OSD op path set at
+    dispatch (ISSUE 16) — so flight records attribute device time per
+    tenant with no signature threading through the EC call chain."""
 
     __slots__ = ("fut", "stripes", "payload", "trace", "t_submit",
                  "client", "locality")
 
     def __init__(self, fut: asyncio.Future, stripes: int, payload: Any,
-                 client: str | None = None,
+                 client=None,
                  locality: "list[str] | None" = None):
         self.fut = fut
         self.stripes = stripes
         self.payload = payload
         self.trace = current_trace.get()
         self.t_submit = time.monotonic()
-        self.client = client
+        self.client = client if client is not None \
+            else current_client.get()
         # surviving shards' OSD locality labels (decode only; ISSUE
         # 11): the accel router prefers the fleet member matching the
         # batch's majority label
@@ -332,6 +336,10 @@ class ECDispatcher:
         dispatcher (the accelerator daemon tags each request with its
         OSD peer, so the flight recorder can show which clients shared
         a launch)."""
+        if client is None:
+            # tenant attribution (ISSUE 16): the direct lanes bypass
+            # _Op, so capture the contextvar here too
+            client = current_client.get()
         buf = as_u8(data)
         if buf.size % sinfo.stripe_width != 0:
             raise ValueError(
@@ -407,6 +415,8 @@ class ECDispatcher:
         names the surviving shards' OSD locality labels; the remote
         lane's router prefers the accelerator matching the batch's
         majority label (ISSUE 11)."""
+        if client is None:
+            client = current_client.get()  # see encode()
         arrs = {int(s): as_u8(v) for s, v in chunks.items()}
         sizes = {a.size for a in arrs.values()}
         if len(sizes) != 1:
@@ -721,7 +731,10 @@ class ECDispatcher:
         batch's queue-wait number."""
         now = time.monotonic()
         oldest = min(ops, key=lambda op: op.t_submit)
-        clients = sorted({op.client for op in ops if op.client})
+        # key=str: tenant ids (ints, ISSUE 16) and peer names (strs,
+        # the accel daemon's fallback) can share one launch
+        clients = sorted({op.client for op in ops if op.client},
+                         key=str)
         return self.flight.begin(
             lane=b.lane, kind=b.kind, klass=b.klass, reason=reason,
             ops=len(ops), stripes=b.stripes,

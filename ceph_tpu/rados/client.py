@@ -14,6 +14,7 @@ resend on handle_osd_map).
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import itertools
 import logging
 import time
@@ -27,6 +28,16 @@ from ..utils.buffers import note_copy
 logger = logging.getLogger("ceph_tpu.rados")
 
 _client_counter = itertools.count(1)
+
+
+def client_session_id(name: str) -> int:
+    """Stable 63-bit tenant id for an entity name (ISSUE 16) — the u64
+    every MOSDOp carries and every ledger/flight record keys on.  A
+    content hash, not a counter: the same named client maps to the same
+    id across reconnects and processes, so attribution survives
+    restarts.  Masked to 63 bits to stay positive in every marshal."""
+    digest = hashlib.blake2b(name.encode(), digest_size=8).digest()
+    return int.from_bytes(digest, "little") & 0x7FFF_FFFF_FFFF_FFFF
 
 ENOENT = 2
 EAGAIN = 11
@@ -67,6 +78,8 @@ class RadosClient(Dispatcher):
                  auth_entity: str | None = None,
                  auth_secret: str | None = None):
         self.name = name or f"client.{next(_client_counter)}"
+        # the per-tenant attribution id every op of ours carries
+        self.client_id = client_session_id(self.name)
         # cephx: entity + secret prove key possession to the mon, which
         # returns the ticket every later handshake presents
         self.auth_entity = auth_entity
@@ -515,6 +528,7 @@ class RadosClient(Dispatcher):
                     # with no span shipping — both are OUR clock, so
                     # the duration is exact wherever it is read
                     stamps={"submit": round(t_submit, 9)},
+                    client=self.client_id,
                 )
                 conn.send(m)
                 async with asyncio.timeout(op_timeout):

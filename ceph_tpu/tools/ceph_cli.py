@@ -30,7 +30,9 @@ from ..msg import messages
 from ..rados.client import RadosClient, RadosError
 
 MGR_COMMANDS = {"status", "health", "df", "osd df", "pg dump",
-                "pg query", "pg ls", "metrics", "mgr module ls"}
+                "pg query", "pg ls", "metrics", "mgr module ls",
+                "metrics query", "metrics ls", "metrics range",
+                "metrics stats", "client ledger"}
 
 
 async def _mgr_command(client: RadosClient, cmd: dict):
@@ -253,6 +255,17 @@ def main(argv=None) -> int:
         extra["pool"] = words.pop()
     if words[:3] == ["osd", "pool", "get-quota"] and len(words) == 4:
         extra["pool"] = words.pop()
+    # `ceph metrics query metric=osd.op window=10 [derive=rate]` and
+    # friends (ISSUE 16): trailing key=value words become params
+    if words[:2] in (["metrics", "query"], ["metrics", "ls"],
+                     ["metrics", "range"]):
+        while len(words) > 2 and "=" in words[-1]:
+            k, _, v = words.pop().partition("=")
+            extra[k] = v
+        # bare third word: the metric (query/range) or glob (ls)
+        if len(words) == 3:
+            extra["metric" if words[1] != "ls" else "pattern"] = \
+                words.pop()
     # `ceph log last [n] [level]` (reference CLI shape)
     if words[:2] == ["log", "last"]:
         for w in words[2:]:

@@ -170,3 +170,35 @@ def test_cli_exit_codes(tmp_path):
         'self.perf.get("a").inc("zzz_missing")\n'
     )
     assert cc.main([str(tmp_path)]) == 1
+
+
+def test_cardinality_lint_flags_unannotated_labels(tmp_path):
+    """ISSUE 16 satellite: an f-string prometheus label with a dynamic
+    value inside an mgr module fails unless annotated
+    `# cardinality-ok: <reason>` — and the same code outside mgr/
+    is ignored (label syntax elsewhere is not exposition)."""
+    cc = _load_tool()
+    mgr = tmp_path / "mgr"
+    mgr.mkdir()
+    (mgr / "mod.py").write_text(
+        'def emit(lines, oid):\n'
+        '    lines.append(f\'ceph_thing{{oid="{oid}"}} 1\')\n'
+    )
+    problems = cc.check(tmp_path)
+    assert len(problems) == 1
+    assert "oid" in problems[0] and "cardinality" in problems[0]
+
+    # annotated on the line above: passes
+    (mgr / "mod.py").write_text(
+        'def emit(lines, oid):\n'
+        '    # cardinality-ok: oids here are bounded by topk\n'
+        '    lines.append(f\'ceph_thing{{oid="{oid}"}} 1\')\n'
+    )
+    assert cc.check(tmp_path) == []
+
+    # identical code outside an mgr/ path: not exposition, no lint
+    (tmp_path / "other.py").write_text(
+        'def emit(lines, oid):\n'
+        '    lines.append(f\'ceph_thing{{oid="{oid}"}} 1\')\n'
+    )
+    assert cc.check(tmp_path) == []

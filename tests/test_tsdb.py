@@ -1,0 +1,227 @@
+"""TimeSeriesStore unit tests (ISSUE 16): rate derivation pinned to
+hand-computed counter deltas, reset survival, bounded memory (ring +
+series cap), avg/histogram derivation at insert, and range queries."""
+
+import math
+
+from ceph_tpu.mgr.tsdb import TimeSeriesStore
+
+
+class _Clock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _mk(step=1.0, retention=600, max_series=4096, clock=None):
+    return TimeSeriesStore(step=step, retention=retention,
+                           max_series=max_series,
+                           clock=clock or _Clock())
+
+
+def _hist(counts, *, lat_min=1e-4):
+    """A 1D latency PerfHistogram dump with the given bucket counts."""
+    return {"histogram": {
+        "axes": [{"name": "latency", "scale": "log2", "min": lat_min,
+                  "buckets": len(counts), "quant": 1.0,
+                  "unit": "seconds"}],
+        "values": list(counts),
+        "count": sum(counts), "sum": 0.0, "sums": [0.0],
+    }}
+
+
+class TestRates:
+    def test_rate_matches_hand_computed_delta(self):
+        """The ISSUE acceptance pin: `metrics query` rate == counter
+        delta / elapsed, exactly."""
+        clk = _Clock(100.0)
+        ts = _mk(clock=clk)
+        ts.ingest("osd.0", {"osd": {"op": 100}})
+        clk.t = 110.0
+        ts.ingest("osd.0", {"osd": {"op": 160}})
+        clk.t = 110.5
+        q = ts.query("osd.op", window=30.0)
+        assert q["value"] == (160 - 100) / (110.0 - 100.0)
+        assert q["daemons"] == {"osd.0": 6.0}
+
+    def test_first_sight_contributes_no_rate(self):
+        """A counter's entire pre-observation value must not read as a
+        burst at first ingest."""
+        clk = _Clock()
+        ts = _mk(clock=clk)
+        ts.ingest("osd.0", {"osd": {"op": 1_000_000}})
+        clk.t += 5.0
+        ts.ingest("osd.0", {"osd": {"op": 1_000_010}})
+        q = ts.query("osd.op", window=30.0)
+        assert q["value"] == 10 / 5.0
+
+    def test_survives_perf_reset(self):
+        """A mid-window reset (counter drops) re-bases instead of
+        producing a negative rate; post-reset accumulation counts."""
+        clk = _Clock()
+        ts = _mk(clock=clk)
+        ts.ingest("osd.0", {"osd": {"op": 100}})
+        clk.t += 10.0
+        ts.ingest("osd.0", {"osd": {"op": 160}})   # +60
+        clk.t += 10.0
+        ts.ingest("osd.0", {"osd": {"op": 40}})    # reset: +40
+        clk.t += 10.0
+        ts.ingest("osd.0", {"osd": {"op": 70}})    # +30
+        q = ts.query("osd.op", window=60.0)
+        assert math.isclose(q["value"], (60 + 40 + 30) / 30.0,
+                            rel_tol=1e-6)
+        assert q["value"] > 0
+
+    def test_aggregates_across_daemons(self):
+        clk = _Clock()
+        ts = _mk(clock=clk)
+        for d in ("osd.0", "osd.1"):
+            ts.ingest(d, {"osd": {"op": 0}})
+        clk.t += 10.0
+        ts.ingest("osd.0", {"osd": {"op": 100}})
+        ts.ingest("osd.1", {"osd": {"op": 50}})
+        q = ts.query("osd.op", window=30.0)
+        assert q["value"] == 15.0
+        assert ts.query("osd.op", window=30.0,
+                        daemon="osd.1")["value"] == 5.0
+
+    def test_avg_derivation(self):
+        """Avg pairs split at insert; derive=avg recombines the
+        windowed deltas: Δsum/Δcount, not the lifetime average."""
+        clk = _Clock()
+        ts = _mk(clock=clk)
+        ts.ingest("osd.0", {"osd": {"op_latency": {
+            "avgcount": 100, "sum": 10.0, "avg": 0.1}}})
+        clk.t += 10.0
+        ts.ingest("osd.0", {"osd": {"op_latency": {
+            "avgcount": 150, "sum": 60.0, "avg": 0.4}}})
+        q = ts.query("osd.op_latency", window=30.0, derive="avg")
+        # windowed: Δsum=50 over Δcount=50 -> 1.0s (lifetime avg 0.4)
+        assert q["value"] == 1.0
+
+    def test_value_derive_reads_latest_raw(self):
+        clk = _Clock()
+        ts = _mk(clock=clk)
+        ts.ingest("osd.0", {"osd": {"numpg": 8}})
+        clk.t += 2.0
+        ts.ingest("osd.0", {"osd": {"numpg": 6}})
+        q = ts.query("osd.numpg", window=30.0, derive="value")
+        assert q["value"] == 6
+
+
+class TestHistograms:
+    def test_p99_and_slow_frac_derived_at_insert(self):
+        clk = _Clock()
+        ts = _mk(clock=clk)
+        ts.slow_threshold = 0.05
+        # first sight: counts ARE the window
+        counts = [0] * 16
+        counts[2] = 98   # fast bucket (upper 4e-4)
+        counts[12] = 2   # slow bucket (upper 1e-4 * 2^12 = 0.4096)
+        ts.ingest("osd.0", {"osd": {"op_latency_histogram":
+                                    _hist(counts)}})
+        q = ts.query("osd.op_latency_histogram.slow_frac",
+                     window=30.0, derive="value")
+        assert math.isclose(q["value"], 2 / 100)
+        p99 = ts.query("osd.op_latency_histogram.p99",
+                       window=30.0, derive="value")
+        assert math.isclose(p99["value"], 1e-4 * 2 ** 12)
+
+    def test_cumulative_totals_feed_burn_rates(self):
+        """.total/.slow_total are counter series over the lifetime
+        bucket sums — the burn-rate substrate."""
+        clk = _Clock()
+        ts = _mk(clock=clk)
+        ts.slow_threshold = 0.05
+        c1 = [0] * 16
+        c1[2] = 100
+        ts.ingest("osd.0", {"osd": {"op_latency_histogram": _hist(c1)}})
+        clk.t += 10.0
+        c2 = list(c1)
+        c2[2] = 150
+        c2[12] = 10   # 10 new slow ops
+        ts.ingest("osd.0", {"osd": {"op_latency_histogram": _hist(c2)}})
+        tot = ts.query("osd.op_latency_histogram.total", window=30.0)
+        slow = ts.query("osd.op_latency_histogram.slow_total",
+                        window=30.0)
+        assert tot["value"] == 60 / 10.0
+        assert slow["value"] == 10 / 10.0
+
+    def test_2d_grid_flattens_to_last_axis(self):
+        clk = _Clock()
+        ts = _mk(clock=clk)
+        ts.slow_threshold = 0.05
+        hist = {"histogram": {
+            "axes": [
+                {"name": "request_bytes", "scale": "log2", "min": 256.0,
+                 "buckets": 2, "quant": 1.0, "unit": "bytes"},
+                {"name": "latency", "scale": "log2", "min": 1e-4,
+                 "buckets": 16, "quant": 1.0, "unit": "seconds"},
+            ],
+            "values": [[0] * 16, [0] * 16],
+            "count": 4, "sum": 0.0, "sums": [0.0, 0.0],
+        }}
+        hist["histogram"]["values"][0][2] = 3
+        hist["histogram"]["values"][1][12] = 1
+        ts.ingest("osd.0", {"osd": {"op_latency_histogram": hist}})
+        q = ts.query("osd.op_latency_histogram.slow_frac",
+                     window=30.0, derive="value")
+        assert math.isclose(q["value"], 1 / 4)
+
+
+class TestBounds:
+    def test_ring_bounded_by_retention(self):
+        clk = _Clock()
+        ts = _mk(step=1.0, retention=5, clock=clk)
+        for i in range(50):
+            ts.ingest("osd.0", {"osd": {"op": i}})
+            clk.t += 1.0
+        s = ts.stats()
+        assert s["points"] <= 5
+
+    def test_series_cap_counts_drops(self):
+        ts = _mk(max_series=3)
+        ts.ingest("osd.0", {"osd": {"a": 1, "b": 2, "c": 3, "d": 4,
+                                    "e": 5}})
+        s = ts.stats()
+        assert s["series"] == 3
+        assert s["dropped_series"] == 2
+
+    def test_same_bucket_overwrites(self):
+        """Reports landing inside one step bucket must not grow the
+        ring — a fast reporter cannot inflate history."""
+        clk = _Clock()
+        ts = _mk(step=1.0, clock=clk)
+        for _ in range(100):
+            ts.ingest("osd.0", {"osd": {"op": 1}})
+            clk.t += 0.001
+        assert ts.stats()["points"] == 1
+
+
+class TestQueriesMisc:
+    def test_ls_globs(self):
+        ts = _mk()
+        ts.ingest("osd.0", {"osd": {"op": 1, "op_err": 0},
+                            "scrub": {"passes": 2}})
+        names = {e["metric"] for e in ts.ls("osd.*")}
+        assert names == {"osd.op", "osd.op_err"}
+
+    def test_range_buckets(self):
+        clk = _Clock()
+        ts = _mk(step=1.0, clock=clk)
+        for i in range(5):
+            ts.ingest("osd.0", {"osd": {"op": i * 10}})
+            clk.t += 1.0
+        r = ts.range("osd.op", window=60.0)
+        assert r["series"] == 1
+        # consecutive-bucket rates: 10 ops per 1s step
+        assert [v for _t, v in r["points"]] == [10.0] * 4
+
+    def test_non_numeric_and_bool_skipped(self):
+        ts = _mk()
+        ts.ingest("osd.0", {"osd": {"state": "active", "flag": True,
+                                    "op": 1}})
+        names = {e["metric"] for e in ts.ls()}
+        assert names == {"osd.op"}

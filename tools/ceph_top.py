@@ -1,0 +1,232 @@
+#!/usr/bin/env python3
+"""ceph_top: an iostat/top-style live cluster view over the mgr's
+time-series store (ISSUE 16).
+
+Each frame is built from ``metrics query``/``metrics ls`` range
+queries plus the cluster-merged tenant ledger (``client ledger``), so
+everything shown is windowed history the mgr already holds — the tool
+adds zero load to the OSD data path.
+
+Panes:
+
+- **io** — cluster op rate, byte rates, windowed p99 and the slow-op
+  fraction (the same series the SLO burn-rate health check reads).
+- **clients** — top tenants by in-window ops, with share-of-window,
+  rates, and worst per-OSD p99 (the OSD ledgers' top-K rows merged;
+  the evicted tail shows as ``other``).
+- **hops** — the op pipeline's stack.lat_* stages ranked by windowed
+  p99, naming where latency is spent (ISSUE 12's waterfall, served
+  continuously).
+- **accel** — per-accelerator occupancy: queue depth, rpc rate, and
+  service time.
+
+Usage:
+  python tools/ceph_top.py -m MON               # live, 2s refresh
+  python tools/ceph_top.py -m MON --interval 5 --window 30
+  python tools/ceph_top.py -m MON --once --json # one frame, JSON out
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from ceph_tpu.rados.client import (  # noqa: E402
+    RadosClient,
+    RadosError,
+    resolve_mon_arg,
+)
+
+
+async def _mgr_cmd(client: RadosClient, cmd: dict):
+    """One mgr command via the map-discovered active mgr (the ceph
+    CLI's direct-to-mgr path); None on any error — a frame with a
+    missing pane beats a dead top."""
+    m = client.osdmap
+    if m is None or not m.mgr_addr:
+        return None
+    try:
+        conn = await client.messenger.connect(m.mgr_addr, m.mgr_name)
+        reply = await client.command_on(conn, cmd)
+    except (ConnectionError, OSError, TimeoutError):
+        return None
+    return reply.out if reply.code == 0 else None
+
+
+def _worst(q: dict | None) -> float:
+    """Worst per-daemon value of a query result — the right read for
+    fractions/quantiles, where the cross-daemon SUM is meaningless."""
+    if not q or not q.get("daemons"):
+        return float(q["value"]) if q else 0.0
+    return max(q["daemons"].values())
+
+
+async def collect_frame(client: RadosClient, window: float) -> dict:
+    """One full frame of panes as plain data (render-free, so tests
+    and the JSON mode share the exact pipeline the live view shows)."""
+
+    async def q(metric: str, derive: str = "rate"):
+        return await _mgr_cmd(client, {
+            "prefix": "metrics query", "metric": metric,
+            "window": window, "derive": derive,
+        })
+
+    frame: dict = {"window_s": window}
+    ops = await q("osd.op")
+    frame["io"] = {
+        "op_per_sec": ops["value"] if ops else 0.0,
+        "rd_bytes_sec": (await q("osd.op_out_bytes") or {}).get(
+            "value", 0.0),
+        "wr_bytes_sec": (await q("osd.op_in_bytes") or {}).get(
+            "value", 0.0),
+        "err_per_sec": (await q("osd.op_err") or {}).get("value", 0.0),
+        "p99_s": _worst(await q(
+            "osd.op_latency_histogram.p99", "value")),
+        "slow_frac": _worst(await q(
+            "osd.op_latency_histogram.slow_frac", "value")),
+    }
+    ledger = await _mgr_cmd(client, {"prefix": "client ledger"})
+    frame["clients"] = ledger or {"total_ops": 0, "clients": [],
+                                  "other": {}}
+    hops = []
+    ls = await _mgr_cmd(client, {
+        "prefix": "metrics ls", "pattern": "stack.lat_*.p99",
+    })
+    for ent in (ls or {}).get("series", []):
+        base = ent["metric"][: -len(".p99")]
+        hop = base[len("stack.lat_"):]
+        p99 = _worst(await q(ent["metric"], "value"))
+        slow = _worst(await q(f"{base}.slow_frac", "value"))
+        rate = (await q(f"{base}.total") or {}).get("value", 0.0)
+        hops.append({"hop": hop, "p99_s": p99, "slow_frac": slow,
+                     "ops_per_sec": rate})
+    hops.sort(key=lambda h: -h["p99_s"])
+    frame["hops"] = hops
+    accels = {}
+    depth = await q("accel.queue_depth", "value")
+    for d, v in ((depth or {}).get("daemons") or {}).items():
+        accels[d] = {"queue_depth": v}
+    for metric, col in (("accel.rpc_encode", "enc_per_sec"),
+                        ("accel.rpc_decode", "dec_per_sec")):
+        res = await q(metric)
+        for d, v in ((res or {}).get("daemons") or {}).items():
+            accels.setdefault(d, {})[col] = v
+    svc = await q("accel.service_time", "avg")
+    frame["accels"] = accels
+    frame["accel_service_time_s"] = (svc or {}).get("value", 0.0)
+    return frame
+
+
+def render_frame(frame: dict) -> str:
+    """One frame -> the fixed-width text block the live loop paints."""
+    w = frame.get("window_s", 0)
+    io = frame.get("io", {})
+    lines = [
+        f"ceph_top — window {w:g}s",
+        "",
+        f"io:     {io.get('op_per_sec', 0):8.1f} op/s   "
+        f"rd {io.get('rd_bytes_sec', 0):10.0f} B/s   "
+        f"wr {io.get('wr_bytes_sec', 0):10.0f} B/s   "
+        f"err {io.get('err_per_sec', 0):.1f}/s",
+        f"lat:    p99 {io.get('p99_s', 0) * 1000:8.2f} ms   "
+        f"slow {io.get('slow_frac', 0):6.1%}",
+        "",
+        f"{'CLIENT':>20} {'POOL':>5} {'CLASS':>8} {'OPS':>8} "
+        f"{'SHARE':>6} {'OP/S':>8} {'B/S':>10} {'P99MS':>8}",
+    ]
+    led = frame.get("clients", {})
+    for r in led.get("clients", [])[:10]:
+        lines.append(
+            f"{str(r.get('client')):>20} {str(r.get('pool')):>5} "
+            f"{str(r.get('class')):>8} {r.get('ops', 0):>8} "
+            f"{r.get('share', 0):>6.1%} "
+            f"{r.get('ops_per_sec', 0):>8.1f} "
+            f"{r.get('bytes_per_sec', 0):>10.0f} "
+            f"{r.get('p99_s', 0) * 1000:>8.2f}"
+        )
+    other = led.get("other") or {}
+    if other.get("ops"):
+        lines.append(
+            f"{'(other)':>20} {'-':>5} {'other':>8} "
+            f"{other.get('ops', 0):>8} {'':>6} "
+            f"{other.get('ops_per_sec', 0):>8.1f} "
+            f"{other.get('bytes_per_sec', 0):>10.0f} {'':>8}"
+        )
+    hops = frame.get("hops", [])
+    if hops:
+        lines += ["", f"{'HOP':>20} {'P99MS':>8} {'SLOW':>6} "
+                      f"{'OP/S':>8}"]
+        for h in hops[:10]:
+            lines.append(
+                f"{h['hop']:>20} {h['p99_s'] * 1000:>8.2f} "
+                f"{h['slow_frac']:>6.1%} {h['ops_per_sec']:>8.1f}"
+            )
+    accels = frame.get("accels", {})
+    if accels:
+        lines += ["", f"{'ACCEL':>20} {'QDEPTH':>7} {'ENC/S':>8} "
+                      f"{'DEC/S':>8}"]
+        for name in sorted(accels):
+            a = accels[name]
+            lines.append(
+                f"{name:>20} {a.get('queue_depth', 0):>7.0f} "
+                f"{a.get('enc_per_sec', 0):>8.1f} "
+                f"{a.get('dec_per_sec', 0):>8.1f}"
+            )
+        lines.append(
+            f"{'service_time':>20} "
+            f"{frame.get('accel_service_time_s', 0) * 1000:.2f} ms"
+        )
+    return "\n".join(lines)
+
+
+async def _run(args) -> int:
+    mon = resolve_mon_arg(args.mon)
+    client = await RadosClient(mon).connect()
+    try:
+        while True:
+            frame = await collect_frame(client, args.window)
+            if args.json:
+                print(json.dumps(frame, sort_keys=True))
+            else:
+                if not args.once:
+                    # clear + home, like top/watch
+                    sys.stdout.write("\x1b[2J\x1b[H")
+                print(render_frame(frame), flush=True)
+            if args.once:
+                return 0
+            await asyncio.sleep(args.interval)
+    except (RadosError, ConnectionError, TimeoutError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    finally:
+        await client.shutdown()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ceph_top", description=__doc__)
+    p.add_argument("-m", "--mon", required=True)
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="refresh period, seconds")
+    p.add_argument("--window", type=float, default=10.0,
+                   help="query window, seconds")
+    p.add_argument("--once", action="store_true",
+                   help="print one frame and exit")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable frames (implies no screen "
+                        "clearing)")
+    args = p.parse_args(argv)
+    try:
+        return asyncio.run(_run(args))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,7 +30,18 @@ Three checks over the ``ceph_tpu`` package's ASTs:
    subsystem, so a key name shared across subsystems with different
    kinds must not false-positive).
 
-4. **Unregistered config keys.** Every literal config option the code
+4. **Unbounded prometheus label cardinality.**  Every dynamic label
+   value interpolated into exposition text (an f-string constant part
+   ending ``label="`` followed by an interpolation, in ``mgr/``
+   modules) is a cardinality decision: an unbounded value set (client
+   ids, object names) melts the scrape.  Each such site must carry a
+   ``# cardinality-ok: <reason>`` annotation — on the line above or
+   inside the f-string's span — stating WHY the value set is bounded
+   (top-K sketch, operator-created pools, fixed enum...).  A new
+   label without the annotation fails here, which is the point: the
+   bound must be argued, not assumed.
+
+5. **Unregistered config keys.** Every literal config option the code
    reads — ``cfg.get("osd_op_queue")``, ``config.set("name", v)``,
    ``cfg.observe("name", cb)``, and plain attribute reads like
    ``self.config.osd_op_complaint_time`` — must name an option the
@@ -153,6 +164,9 @@ class _FileScan(ast.NodeVisitor):
         # attribute option reads (name, line, source-expression)
         self.config_registered: list[str] = []
         self.config_used: list[tuple[str, int, str]] = []
+        # prometheus label sites: (label, lineno, end_lineno) per
+        # f-string part ending `label="` right before an interpolation
+        self.label_sites: list[tuple[str, int, int]] = []
 
     def _perfish(self, expr: ast.AST) -> bool:
         """Is this receiver a PerfCounters? Either its dotted form
@@ -229,6 +243,23 @@ class _FileScan(ast.NodeVisitor):
                 self.config_registered.append(key)
         self.generic_visit(node)
 
+    def visit_JoinedStr(self, node: ast.JoinedStr) -> None:
+        # label="{value}" inside an f-string: a dynamic prometheus
+        # label value (the `le="` / `daemon="` / `client="` shape) —
+        # recorded with the full f-string span so the annotation can
+        # sit on the line above or between concatenated parts
+        for part, nxt in zip(node.values, node.values[1:]):
+            if isinstance(part, ast.Constant) \
+                    and isinstance(part.value, str) \
+                    and isinstance(nxt, ast.FormattedValue):
+                m = re.search(r'(\w*)="$', part.value)
+                if m:
+                    self.label_sites.append((
+                        m.group(1) or "<dynamic>", node.lineno,
+                        node.end_lineno or node.lineno,
+                    ))
+        self.generic_visit(node)
+
     def visit_Attribute(self, node: ast.Attribute) -> None:
         # cfg.osd_subop_timeout-style option reads (Config.__getattr__):
         # the attr must be a registered option unless it is Config API
@@ -251,9 +282,11 @@ def check(package_dir: str | pathlib.Path) -> list[str]:
     used: list[tuple[pathlib.Path, str, int, str]] = []
     conf_regs: set[str] = set()
     conf_used: list[tuple[pathlib.Path, str, int, str]] = []
+    label_problems: list[str] = []
     for path in sorted(package_dir.rglob("*.py")):
         try:
-            tree = ast.parse(path.read_text(), filename=str(path))
+            src_text = path.read_text()
+            tree = ast.parse(src_text, filename=str(path))
         except SyntaxError as e:
             return [f"{path}: unparsable: {e}"]
         scan = _FileScan(str(path))
@@ -266,6 +299,22 @@ def check(package_dir: str | pathlib.Path) -> list[str]:
         conf_used.extend(
             (path, k, ln, src) for k, ln, src in scan.config_used
         )
+        # cardinality lint: exposition text is built in the mgr tree
+        if scan.label_sites and "mgr" in path.parts:
+            lines = src_text.splitlines()
+            for label, lineno, end in scan.label_sites:
+                window = lines[max(0, lineno - 2):end]
+                if not any(
+                    re.search(r"#\s*cardinality-ok:\s*\S", ln)
+                    for ln in window
+                ):
+                    label_problems.append(
+                        f"{path}:{lineno}: prometheus label "
+                        f"{label}=\"...\" interpolates a dynamic value "
+                        f"with no `# cardinality-ok: <reason>` "
+                        f"annotation — argue the bound or drop the "
+                        f"label"
+                    )
     problems = []
     registered_keys = {k for _p, _s, k, _kind in regs}
     kinds_by_key: dict[str, set[str]] = {}
@@ -310,6 +359,7 @@ def check(package_dir: str | pathlib.Path) -> list[str]:
                     f"{path}:{line}: {src} references config option "
                     f"{key!r} but no Option registers it"
                 )
+    problems.extend(label_problems)
     return problems
 
 

@@ -22,7 +22,13 @@ registry against the committed manifest ``ceph_tpu/msg/wire_manifest
   "json"`` — a data-path type (the peering/recovery wire,
   MOSDPGScan and friends, included) silently regressing to a JSON
   field tail fails, and so does a listed type silently going binary
-  (delist it in the same diff — the manifest diff is the review).
+  (delist it in the same diff — the manifest diff is the review);
+- FIELD TAILS are pinned for the data-path types the manifest's
+  ``field_tails`` map names (ISSUE 16): the positional marshal means
+  FIELDS order IS the wire format — reordering, renaming, or removing
+  an entry breaks every peer, and appending one must show up in the
+  manifest diff.  A pinned class whose FIELDS tuple diverges from the
+  manifest fails in either direction; update both in the same diff.
 
 And the reason the binary header exists at all: JSON must not creep
 back onto the frame hot path.  ``json.dumps``/``json.loads`` calls in
@@ -93,6 +99,35 @@ def _class_consts(cls: ast.ClassDef) -> dict:
     return vals
 
 
+def _class_fields(cls: ast.ClassDef) -> list[str] | None:
+    """Extract a class's literal ``FIELDS`` tuple (a tuple/list of str
+    constants), or None when absent / non-literal — positional-marshal
+    order is wire protocol, so a FIELDS laundered through a name or
+    comprehension must not silently pass the pin."""
+    for stmt in cls.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            name, value = stmt.targets[0].id, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) \
+                and isinstance(stmt.target, ast.Name) \
+                and stmt.value is not None:
+            name, value = stmt.target.id, stmt.value
+        else:
+            continue
+        if name != "FIELDS":
+            continue
+        if not isinstance(value, (ast.Tuple, ast.List)):
+            return None
+        out: list[str] = []
+        for elt in value.elts:
+            if not (isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, str)):
+                return None
+            out.append(elt.value)
+        return out
+    return None
+
+
 def _annotated(lines: list[str], lineno: int, end_lineno: int) -> str | None:
     for ln in range(lineno - 1, end_lineno + 1):
         if 1 <= ln <= len(lines):
@@ -112,6 +147,7 @@ def check(root: pathlib.Path) -> list[str]:
     seen_names: dict[str, str] = {}
     code_types: dict[str, int] = {}
     code_tails: dict[str, str] = {}  # TYPE -> "bin" | "json"
+    code_fields: dict[str, list[str] | None] = {}  # TYPE -> FIELDS
     for rel in CLASS_FILES:
         path = root / rel
         if not path.exists():
@@ -163,6 +199,7 @@ def check(root: pathlib.Path) -> list[str]:
             seen_names[tname] = cls.name
             code_types[tname] = tid
             code_tails[tname] = tail
+            code_fields[tname] = _class_fields(cls)
 
     # -- 2. manifest comparison
     mpath = root / MANIFEST
@@ -171,9 +208,10 @@ def check(root: pathlib.Path) -> list[str]:
         mtypes = dict(manifest.get("types", {}))
         retired = list(manifest.get("retired", []))
         json_tails = set(manifest.get("json_tails", []))
+        field_tails = dict(manifest.get("field_tails", {}))
     except (OSError, ValueError) as e:
         problems.append(f"{MANIFEST}: unreadable: {e}")
-        mtypes, retired, json_tails = {}, [], set()
+        mtypes, retired, json_tails, field_tails = {}, [], set(), {}
     if code_types:  # skip cross-checks if extraction already failed hard
         for tname, tid in sorted(code_types.items()):
             want = mtypes.get(tname)
@@ -219,6 +257,28 @@ def check(root: pathlib.Path) -> list[str]:
                 problems.append(
                     f"{MANIFEST}: 'json_tails' entry {tname!r} has no "
                     f"registered class")
+        # field-tail pin: the positional marshal makes FIELDS order the
+        # wire format for these data-path types — any divergence (the
+        # class's tuple vs the manifest's list, either direction) fails
+        for tname, want_fields in sorted(field_tails.items()):
+            if tname not in code_types:
+                problems.append(
+                    f"{MANIFEST}: 'field_tails' entry {tname!r} has no "
+                    f"registered class")
+                continue
+            got = code_fields.get(tname)
+            if got is None:
+                problems.append(
+                    f"{MANIFEST}: {tname!r} is field-tail pinned but "
+                    f"its class has no literal FIELDS tuple of strings "
+                    f"— positional-marshal order is wire protocol")
+            elif got != list(want_fields):
+                problems.append(
+                    f"{MANIFEST}: {tname!r} FIELDS diverge from the "
+                    f"pinned tail: manifest {list(want_fields)} vs "
+                    f"code {got} — reorder/rename/remove breaks every "
+                    f"peer; update both in the same diff (appending a "
+                    f"trailing field is the only compatible change)")
 
     # -- 3. JSON off the frame hot path
     for rel in JSON_BAN_FILES:
