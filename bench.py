@@ -1164,8 +1164,45 @@ def _smallops_waterfall(deadline: float | None, n_ops: int = 96) -> dict:
                 }
                 for hop, v in sorted(per_hop.items())
             }
+            # tail-sampling overhead (ISSUE 18): ops/sec with the keep
+            # policy ARMED at production settings (provisional spans on
+            # every op, 1-in-N baseline keeps) vs tracing OFF entirely
+            # (keep policy disarmed AND head sampling zeroed), on the
+            # SAME cluster via live config flips — the share gates the
+            # always-on decide-late tracing against the PR-13 IOPS win
+            async def _rate_arm(keep: bool, every: int, tag: str
+                                ) -> float | None:
+                for osd in c.osds.values():
+                    osd.config.set("osd_trace_keep", keep)
+                    osd.config.set("osd_op_trace_sample_every", every)
+                if deadline is not None and deadline - time.time() < 8:
+                    return None
+                n = 0
+                t0 = time.perf_counter()
+                for i in range(n_ops):
+                    if deadline is not None \
+                            and deadline - time.time() < 5:
+                        break
+                    await cl.operate(
+                        "wf", f"{tag}{i}",
+                        [{"op": "writefull", "data": 0}], [payload],
+                    )
+                    n += 1
+                dt = time.perf_counter() - t0
+                return n / dt if n and dt > 0 else None
+
+            armed_rate = await _rate_arm(True, 64, "arm")
+            off_rate = await _rate_arm(False, 0, "off")
+            overhead = None
+            if armed_rate and off_rate:
+                overhead = round(max(0.0, 1.0 - armed_rate / off_rate), 4)
+
             total_op_s = float(sum(walls))
             return {
+                **({"trace_overhead_share": overhead,
+                    "ops_per_sec_keep_armed": round(armed_rate, 1),
+                    "ops_per_sec_tracing_off": round(off_rate, 1)}
+                   if overhead is not None else {}),
                 "ops": n_done,
                 "payload_bytes": len(payload),
                 "ops_per_sec": round(n_done / wall_s, 1),
@@ -1337,6 +1374,11 @@ def bench_smallops(deadline: float | None, platform: str | None) -> dict:
     return {
         **({"header_share": header_share}
            if header_share is not None else {}),
+        # tail-sampling overhead gate (ISSUE 18): armed-vs-off ops/sec
+        # share from the same waterfall cluster, promoted so the
+        # bench_regress smallops.trace_overhead_share gate can see it
+        **({"trace_overhead_share": waterfall["trace_overhead_share"]}
+           if waterfall.get("trace_overhead_share") is not None else {}),
         # IOPS promotion (this PR): ops/sec + op p99 from the same
         # capture ride the record top level so the bench_regress
         # smallops.ops_per_sec / smallops.op_p99 gates can see them
@@ -2964,6 +3006,10 @@ def main():
                         # higher is better) and op_p99_ms (lower is
                         # better) next to header_share
                         "header_share", "ops_per_sec", "op_p99_ms",
+                        # tail-sampling overhead (ISSUE 18): armed vs
+                        # tracing-off ops/sec share, gated lower-is-
+                        # better so decide-late tracing stays ~free
+                        "trace_overhead_share",
                     ) if k in r["smallops"]
                 }
             if "accel" not in final and "occupancy" in r.get("accel", {}):

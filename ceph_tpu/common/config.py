@@ -110,6 +110,25 @@ OPTIONS: dict[str, Option] = _opts(
            "fed into the stack.lat_* histograms -> mgr prometheus — "
            "per-hop p99 as a continuously exported series (1 = every "
            "op, 0 disables; live via observer)"),
+    # observability: tail-sampled tracing (ISSUE 18) — every client op
+    # provisionally traces (the binary frame header already carries
+    # trace id + send stamp); the OSD decides keep/drop at COMPLETION,
+    # so the slow tail, the errors and the failover replays always
+    # carry waterfalls while the median op costs nothing but the
+    # per-op keep check
+    Option("osd_trace_keep", bool, True,
+           "tail-based trace keep policy: at op completion, keep the "
+           "waterfall when the op ran slow (osd_trace_keep_slow_"
+           "threshold), failed, or its launch record shows a "
+           "failover/fallback replay or accel re-route — plus the "
+           "1-in-osd_op_trace_sample_every baseline.  False reverts "
+           "to pure head sampling (the tracing-off arm of the bench "
+           "overhead capture pairs False with sample_every=0; live "
+           "via observer)"),
+    Option("osd_trace_keep_slow_threshold", float, 0.0,
+           "op wall time (s) past which the keep policy retains the "
+           "trace; 0 = osd_op_complaint_time/4 (live via observer, "
+           "as is a complaint-time change)"),
     Option("trace_ring_capacity", int, 4096,
            "events kept per tracepoint-provider ring "
            "(common/tracing.py; process-global — one set of rings per "
@@ -393,6 +412,11 @@ OPTIONS: dict[str, Option] = _opts(
            "DEBUG: sleep this long (s) inside every client op before "
            "execution — the latency-storm injector the SLO burn-rate "
            "tests flip on and off live (0 = off)"),
+    Option("osd_inject_op_delay_every", int, 1,
+           "DEBUG: apply osd_inject_op_delay to only 1-in-N client "
+           "ops (<=1 = every op) — the tail-sampling acceptance run "
+           "pins ~1% injected-slow ops against the keep policy "
+           "(live via observer)"),
     Option("mgr_tsdb_step", float, 1.0,
            "mgr time-series store bucket width (s): daemon reports "
            "land in fixed-step buckets; rates derive from cumulative "
@@ -424,6 +448,11 @@ OPTIONS: dict[str, Option] = _opts(
     Option("mgr_slo_burn_threshold", float, 2.0,
            "burn-rate multiple (consumption / budget) that raises "
            "SLO_BURN when BOTH windows exceed it"),
+    Option("mgr_trace_store_capacity", int, 512,
+           "kept waterfalls the mgr trace store rings (mgr/trace_"
+           "store.py): overflow evicts oldest and counts "
+           "trace.store_evictions — memory is O(capacity * hops), "
+           "full stop"),
 )
 
 

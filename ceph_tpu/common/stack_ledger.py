@@ -48,7 +48,11 @@ from __future__ import annotations
 import threading
 
 # the canonical small-op hops (the waterfall's vocabulary); feed_hop()
-# lazily registers anything else, same policy as note_copy's hops
+# lazily registers anything else, same policy as note_copy's hops.
+# Every hop here — and every literal record_span/feed_hop hop anywhere
+# — must also appear in common/hop_manifest.json: the manifest bounds
+# the ceph_stack_lat_* prometheus series set by construction, and
+# tools/check_counters.py fails CI on drift in either direction
 STACK_HOPS = (
     "client_serialize",  # client: operate() submit -> frame queued
     "wire",              # frame queued -> peer receive (clock-aligned)

@@ -37,12 +37,33 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import itertools
+import json
+import os
 import time
 from collections import deque
 from typing import Any, Iterator
 
 _providers: dict[str, "TraceProvider"] = {}
 _default_capacity = 4096
+
+# the committed span hop-name vocabulary (ISSUE 18): every hop name
+# record_span/feed_hop sees must appear here — each one becomes a
+# stack.lat_<hop> histogram and a ceph_stack_lat_<hop>_bucket
+# prometheus family, so the manifest is the cardinality bound.
+# tools/check_counters.py lints every literal call site against it.
+HOP_MANIFEST_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "hop_manifest.json"
+)
+_hop_manifest: frozenset[str] | None = None
+
+
+def hop_manifest() -> frozenset[str]:
+    """The committed hop-name set (loaded once per process)."""
+    global _hop_manifest
+    if _hop_manifest is None:
+        with open(HOP_MANIFEST_PATH) as f:
+            _hop_manifest = frozenset(json.load(f)["hops"])
+    return _hop_manifest
 
 # the active trace id for this task tree (None = untraced work)
 current_trace: contextvars.ContextVar[str | None] = contextvars.ContextVar(

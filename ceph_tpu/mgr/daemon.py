@@ -96,6 +96,18 @@ class MgrDaemon(Dispatcher):
                        "failure-rate budget burn, fast window")
         pslo.add_gauge("failure_burn_slow",
                        "failure-rate budget burn, slow window")
+        # tail-sampled trace collector (ISSUE 18): kept waterfalls ride
+        # MPGStats into a bounded ring; eviction pressure is a counter
+        # so an undersized store shows up in prometheus, not in silence
+        ptrace = self.perf.create("trace")
+        ptrace.add_counter("store_evictions",
+                           "kept traces evicted oldest-first at capacity")
+        ptrace.add_gauge("store_size", "kept traces currently held")
+        from .trace_store import TraceStore
+
+        self.trace_store = TraceStore(
+            capacity=self.config.mgr_trace_store_capacity, perf=ptrace,
+        )
         from .modules import (
             DfModule,
             MetricsModule,
@@ -104,11 +116,13 @@ class MgrDaemon(Dispatcher):
             PgQueryModule,
             PrometheusModule,
             StatusModule,
+            TraceModule,
         )
 
         self.modules: list[MgrModule] = modules or [
             StatusModule(), DfModule(), OsdDfModule(), PgQueryModule(),
             PGDumpModule(), PrometheusModule(), MetricsModule(),
+            TraceModule(),
         ]
         self._routes: dict[str, MgrModule] = {}
         for mod in self.modules:
@@ -286,6 +300,11 @@ class MgrDaemon(Dispatcher):
             "epoch": msg.epoch,
             "ts": now,
         }
+        # tail-sampled keeps (ISSUE 18): already decided at the source,
+        # so ingest is unconditional — stamp the reporter for `trace ls`
+        for wf in msg.traces or []:
+            if isinstance(wf, dict):
+                self.trace_store.ingest({**wf, "osd": msg.osd})
         # fold the report into history (ISSUE 16): rates/quantiles
         # derive at insert; the slow threshold tracks the SLO target
         # so slow_frac and the burn rate measure the same thing

@@ -296,6 +296,15 @@ def _slo_burn_check(mgr) -> dict | None:
     hop, frac = _worst_hop(mgr, fast)
     if hop is not None and frac > 0:
         detail += f"; worst hop {hop} ({frac:.0%} slow)"
+    ts = getattr(mgr, "trace_store", None)
+    if ts is not None:
+        # exemplar linkage (ISSUE 18): name concrete ops from the
+        # burning window — anomaly-kept traces first, slowest first —
+        # so the operator's next command is `ceph trace show <id>`,
+        # not a fishing expedition
+        ids = ts.exemplars(3, window=fast)
+        if ids:
+            detail += f"; exemplar traces {', '.join(map(str, ids))}"
     return {
         "code": "SLO_BURN", "severity": "HEALTH_WARN",
         "summary": detail,
@@ -651,6 +660,66 @@ class MetricsModule(MgrModule):
         }
 
 
+class TraceModule(MgrModule):
+    """Query surface over the mgr's kept-trace store (trace_store.py,
+    ISSUE 18): ``trace ls`` filters one-line summaries by client /
+    pool / dominant hop, ``trace show <id>`` returns one full
+    cross-daemon waterfall, ``trace top`` the slowest keeps in a
+    window, ``trace summary`` the dominant-hop histogram — the
+    multi-host hop re-rank table (ROADMAP item 1c) read straight off
+    kept outliers instead of sampled medians."""
+
+    NAME = "trace"
+    COMMANDS = {
+        "trace ls": "ls",
+        "trace show": "show",
+        "trace top": "top",
+        "trace summary": "summary",
+    }
+
+    @staticmethod
+    def _as_id(value):
+        """CLI params arrive as strings; stored client/pool ids are
+        ints — coerce digit-strings so ``trace ls client=123`` matches."""
+        if isinstance(value, str) and value.lstrip("-").isdigit():
+            return int(value)
+        return value
+
+    def ls(self, mgr: MgrDaemon, cmd: dict) -> tuple[int, str, Any]:
+        return 0, "", {
+            "traces": mgr.trace_store.ls(
+                client=self._as_id(cmd.get("client")),
+                pool=self._as_id(cmd.get("pool")),
+                hop=cmd.get("hop"),
+                limit=int(cmd.get("limit", 64)),
+            ),
+            "stats": mgr.trace_store.stats(),
+        }
+
+    def show(self, mgr: MgrDaemon, cmd: dict) -> tuple[int, str, Any]:
+        trace = cmd.get("trace")
+        if not trace:
+            return -22, "need trace id", None
+        rec = mgr.trace_store.get(str(trace))
+        if rec is None:
+            return -2, f"no kept trace {trace!r} (evicted or dropped)", None
+        rec.pop("_ts", None)  # store-internal window clock
+        return 0, "", rec
+
+    def top(self, mgr: MgrDaemon, cmd: dict) -> tuple[int, str, Any]:
+        return 0, "", {
+            "traces": mgr.trace_store.top(
+                n=int(cmd.get("n", 10)),
+                window=float(cmd.get("window", 0) or 0) or None,
+            ),
+        }
+
+    def summary(self, mgr: MgrDaemon, cmd: dict) -> tuple[int, str, Any]:
+        return 0, "", mgr.trace_store.summary(
+            window=float(cmd.get("window", 0) or 0) or None,
+        )
+
+
 def _prom_escape(value) -> str:
     """Prometheus label-value escaping (exposition format: backslash,
     double-quote and newline must be escaped inside label values)."""
@@ -676,13 +745,18 @@ class PrometheusModule(MgrModule):
 
     @staticmethod
     def _emit_histogram(lines: list[str], base: str, labels: str,
-                        hist: dict) -> None:
+                        hist: dict, exemplar=None) -> None:
         """One PerfHistogram dump -> prometheus histogram series:
         ``<base>_bucket{le=...}`` cumulative counts plus ``_sum`` /
         ``_count``.  The LAST axis is the ``le`` axis; a 2D (size x
         latency) grid is flattened by summing the size axis away —
         a pure column sum, so the flattening is deterministic and the
-        +Inf bucket always equals ``_count``."""
+        +Inf bucket always equals ``_count``.
+
+        ``exemplar`` (ISSUE 18): an optional ``(lo, hi) -> (trace_id,
+        value) | None`` lookup; a hit appends an OpenMetrics exemplar
+        annotation to that bucket line, linking the histogram's shape
+        to one concrete kept trace."""
         axes = hist.get("axes") or []
         values = hist.get("values") or []
         if not axes:
@@ -702,15 +776,29 @@ class PrometheusModule(MgrModule):
         for i, c in enumerate(counts):
             cum += c
             if i >= len(counts) - 1:
-                le = "+Inf"
+                le, hi = "+Inf", float("inf")
             elif log2:
                 le = format(amin * (2 ** i), "g")
+                hi = amin * (2 ** i)
             else:
                 le = format(amin + i * quant, "g")
-            lines.append(
+                hi = amin + i * quant
+            line = (
                 # cardinality-ok: le edges are the fixed axis schema
                 f'{base}_bucket{{{labels},le="{le}"}} {cum}'
             )
+            if exemplar is not None and c > 0:
+                if log2:
+                    lo = 0.0 if i == 0 else amin * (2 ** (i - 1))
+                else:
+                    lo = 0.0 if i == 0 else amin + (i - 1) * quant
+                ex = exemplar(lo, hi)
+                if ex is not None:
+                    # OpenMetrics exemplar: `# {trace_id="..."} value`
+                    # cardinality-ok: exemplar annotation, not a label
+                    line += f' # {{trace_id="{_prom_escape(ex[0])}"}} ' \
+                            f'{ex[1]}'
+            lines.append(line)
         lines.append(
             f'{base}_sum{{{labels}}} '
             f'{float(hist.get("sum") or 0.0)}'
@@ -721,7 +809,8 @@ class PrometheusModule(MgrModule):
         )
 
     @classmethod
-    def _emit_daemon(cls, lines: list[str], daemon: str, perf: dict) -> None:
+    def _emit_daemon(cls, lines: list[str], daemon: str, perf: dict,
+                     trace_store=None) -> None:
         """One daemon's full counter dump -> exposition lines; every
         registered counter appears exactly once per daemon.  A
         subsystem named ``<base>@<label>`` (the per-accel families,
@@ -729,7 +818,11 @@ class PrometheusModule(MgrModule):
         subsystem's series names with an extra identifying label —
         ``ceph_accel_remote_batches{daemon=...,accel="3"}`` — so a
         fleet's per-target skew is one labelled query, not N series
-        name variants."""
+        name variants.
+
+        ``trace_store`` (ISSUE 18): when given, ``stack.lat_<hop>``
+        histogram buckets that hold a kept trace get an exemplar
+        annotation keyed by its trace id."""
         esc = _prom_escape(daemon)
         for subsys, counters in sorted((perf or {}).items()):
             # cardinality-ok: one value per reporting daemon
@@ -742,8 +835,16 @@ class PrometheusModule(MgrModule):
             for key, val in sorted(counters.items()):
                 base = f"ceph_{subsys}_{key}"
                 if isinstance(val, dict) and "histogram" in val:
+                    exemplar = None
+                    if (trace_store is not None and subsys == "stack"
+                            and key.startswith("lat_")):
+                        hop = key[len("lat_"):]
+                        exemplar = (
+                            lambda lo, hi, _h=hop:
+                            trace_store.exemplar_for(_h, lo, hi)
+                        )
                     cls._emit_histogram(lines, base, labels,
-                                        val["histogram"])
+                                        val["histogram"], exemplar)
                     continue
                 if isinstance(val, dict):
                     # PerfCounters avg dump: {avgcount, sum, avg, ...}
@@ -774,7 +875,9 @@ class PrometheusModule(MgrModule):
                 f"ceph_health_status {_SEVERITIES.index(worst)}"
             )
         for osd, st in sorted(mgr.live_osd_stats().items()):
-            self._emit_daemon(lines, f"osd.{osd}", st["perf"])
+            self._emit_daemon(lines, f"osd.{osd}", st["perf"],
+                              trace_store=getattr(mgr, "trace_store",
+                                                  None))
             # tenant ledger rows (ISSUE 16): cardinality is bounded at
             # the SOURCE — each OSD ships at most osd_client_ledger_topk
             # rows + one "other" tail row, so the series count here is

@@ -466,11 +466,17 @@ class MPGStats(Message):
     "class", rates...} rows plus the evicted-other bucket) — shipped
     as its own field rather than folded into ``perf`` so the mgr's
     prometheus module keeps full label control and the cardinality
-    bound is enforced at the source."""
+    bound is enforced at the source.
+
+    ``traces`` (ISSUE 18) is the tail-sampling drain: the keep-policy
+    survivors since the last report, each a merged op waterfall dict
+    (hops, client, pool, keep reason, wall time, launch linkage) bound
+    for the mgr trace store.  Bounded at the source — the OSD's
+    pending ring holds at most 256 kept traces per interval."""
 
     TYPE = "pg_stats"
     TYPE_ID = 84
-    FIELDS = ("osd", "epoch", "pgs", "perf", "store", "ledger")
+    FIELDS = ("osd", "epoch", "pgs", "perf", "store", "ledger", "traces")
 
 
 @register

@@ -38,6 +38,7 @@ import json
 import logging
 import os
 import time
+from collections import deque
 from typing import Any
 
 import numpy as np
@@ -508,8 +509,39 @@ class OSD(Dispatcher):
             perf=pcli,
         )
         # the SLO latency-storm injector (ISSUE 16): cached so the op
-        # hot path reads an attribute, not the config dict
+        # hot path reads an attribute, not the config dict; _every
+        # (ISSUE 18) scopes the delay to 1-in-N ops so the tail-
+        # sampling acceptance run can make ~1% of ops slow
         self._inject_op_delay = float(cfg.osd_inject_op_delay)
+        self._inject_op_delay_every = int(cfg.osd_inject_op_delay_every)
+        self._inject_op_delay_n = 0
+        # tail-sampled tracing (ISSUE 18): every client op provisionally
+        # traces (the frame header already carries trace id + stamp);
+        # the keep policy fires at op COMPLETION, when wall time,
+        # result and the launch record are all known — kept waterfalls
+        # queue here and ride the next MPGStats report to the mgr store
+        ptr = self.perf.create("trace")
+        ptr.add_counter("kept", "client ops whose trace the keep "
+                                "policy retained (any reason)")
+        ptr.add_counter("kept_slow",
+                        "traces kept for wall time past "
+                        "osd_trace_keep_slow_threshold")
+        ptr.add_counter("kept_error",
+                        "traces kept for a failed/EAGAIN-folded op")
+        ptr.add_counter("kept_replay",
+                        "traces kept for a failover/fallback replay "
+                        "or accel re-route in the launch record")
+        ptr.add_counter("kept_baseline",
+                        "traces kept by the 1-in-N baseline draw")
+        ptr.add_counter("dropped",
+                        "traced client ops the keep policy discarded "
+                        "(the healthy median — no spans built)")
+        ptr.add_counter("shipped",
+                        "kept waterfalls assembled and sent to the "
+                        "mgr trace store via MPGStats")
+        self._trace_keep = bool(cfg.osd_trace_keep)
+        self._trace_keep_thr = float(cfg.osd_trace_keep_slow_threshold)
+        self._pending_traces: deque[dict] = deque(maxlen=256)
         # op tracking (reference:src/common/TrackedOp.h OpTracker):
         # typed state transitions, bounded history, slow-op detection
         from ..common.op_tracker import OpTracker
@@ -638,6 +670,16 @@ class OSD(Dispatcher):
                 self.client_ledger, "window", max(0.1, float(v)))),
             ("osd_inject_op_delay", lambda _n, v: setattr(
                 self, "_inject_op_delay", float(v))),
+            ("osd_inject_op_delay_every", lambda _n, v: setattr(
+                self, "_inject_op_delay_every", int(v))),
+            # tail-sampling keep policy (ISSUE 18): the bench overhead
+            # capture disarms it on a RUNNING osd, and the slow
+            # threshold must track a live complaint-time change (0 =
+            # derived complaint/4, resolved at evaluation)
+            ("osd_trace_keep", lambda _n, v: setattr(
+                self, "_trace_keep", bool(v))),
+            ("osd_trace_keep_slow_threshold", lambda _n, v: setattr(
+                self, "_trace_keep_thr", float(v))),
         ]
         for _qk in QOS_CLASSES:
             for _qf, _qa in (("res", "reservation"), ("wgt", "weight"),
@@ -1300,13 +1342,55 @@ class OSD(Dispatcher):
 
     def _op_sampled(self, msg: messages.MOSDOp, internal: bool) -> bool:
         """1-in-``osd_op_trace_sample_every`` client ops get full
-        waterfall spans (ISSUE 12).  Internal peer-daemon ops never
-        sample: their originator's op owns the trace."""
+        waterfall spans (ISSUE 12); with the tail keep policy armed
+        (ISSUE 18) this draw is the BASELINE keep reason — the healthy-
+        median sample the anomaly-kept traces are compared against.
+        Internal peer-daemon ops never sample: their originator's op
+        owns the trace."""
         n = self._trace_sample_every
         if internal or n <= 0 or msg.trace is None:
             return False
         self._trace_sampled_n += 1
         return self._trace_sampled_n % n == 0
+
+    def _trace_keep_reason(self, msg: messages.MOSDOp, result: int,
+                           dt: float, sampled: bool) -> str | None:
+        """The tail-sampling keep decision (ISSUE 18), evaluated at op
+        COMPLETION when wall time, result and the launch record are
+        all known — the Dapper->Canopy decide-late pattern.  Returns
+        the keep reason (``slow``/``error``/``replay``/``baseline``)
+        or None (drop).  Reasons are checked most-severe first so the
+        perf breakdown attributes each kept trace to what actually
+        condemned it.  With ``osd_trace_keep`` off this never runs:
+        the caller falls back to pure head sampling (ISSUE 12)."""
+        thr = self._trace_keep_thr
+        if thr <= 0:
+            thr = float(self.config.osd_op_complaint_time) / 4.0
+        if thr > 0 and dt >= thr:
+            return "slow"
+        if result < 0:
+            # every error fold counts, the -EAGAIN retry class
+            # included: an op the client must replay is exactly the
+            # op whose waterfall the operator will want
+            return "error"
+        if self.ec_dispatch is not None:
+            # anomaly evidence from the launch that carried this trace
+            # (ops/device_trace.py FlightRecorder): an engine fault, a
+            # failover-served batch, or an accelerator that answered
+            # from ITS fallback — correct bytes, but a re-routed path
+            # worth a waterfall.  O(flight ring) per op; the ring is
+            # empty on pure-replicated paths.
+            try:
+                rec = self.ec_dispatch.flight.lookup(msg.trace)
+            except Exception:  # pragma: no cover - observability only
+                rec = None
+            if rec is not None and (
+                rec.get("error") or rec.get("origin")
+                or rec.get("served") == "fallback"
+                or rec.get("remote_served") == "fallback"
+            ):
+                return "replay"
+        return "baseline" if sampled else None
 
     def _waterfall_spans(self, conn: Connection, msg: messages.MOSDOp,
                          op) -> list[dict]:
@@ -1500,8 +1584,14 @@ class OSD(Dispatcher):
                 # SLO storm injector: burns the latency budget without
                 # touching execution — inside the measured window so
                 # op_latency and the ledger p99 both see it; raises
-                # SLO_BURN live, clears when the knob resets (ISSUE 16)
-                await asyncio.sleep(self._inject_op_delay)
+                # SLO_BURN live, clears when the knob resets (ISSUE 16).
+                # _every thins it to 1-in-N ops so the tail-sampling
+                # acceptance run can pin a ~1% slow tail (ISSUE 18)
+                self._inject_op_delay_n += 1
+                if (self._inject_op_delay_every <= 1
+                        or self._inject_op_delay_n
+                        % self._inject_op_delay_every == 0):
+                    await asyncio.sleep(self._inject_op_delay)
             try:
                 result, out, blobs = await self._execute_op(msg, conn)
             except asyncio.CancelledError:
@@ -1539,7 +1629,16 @@ class OSD(Dispatcher):
                 )
             op.mark("replied")
             spans_payload = None
-            if sampled:
+            keep = None
+            if not internal and msg.trace is not None:
+                if self._trace_keep:
+                    keep = self._trace_keep_reason(msg, result, dt, sampled)
+                elif sampled:
+                    # keep policy disarmed: pure head sampling, exactly
+                    # the pre-ISSUE-18 behaviour (and the tracing-off
+                    # arm of the bench overhead capture)
+                    keep = "baseline"
+            if keep is not None:
                 # best-effort by contract: a waterfall bug must never
                 # fail an op that executed fine
                 try:
@@ -1549,6 +1648,39 @@ class OSD(Dispatcher):
                         "%s: waterfall span build failed for tid=%s",
                         self.name, msg.tid,
                     )
+                else:
+                    ptr = self.perf.get("trace")
+                    ptr.inc("kept")
+                    ptr.inc("kept_" + keep)
+                    launch = None
+                    if self.ec_dispatch is not None:
+                        # launch-record linkage: the flight ring entry
+                        # that served this op, so `trace show` can name
+                        # the lane/engine behind a replay-kept trace
+                        try:
+                            rec = self.ec_dispatch.flight.lookup(msg.trace)
+                        except Exception:  # pragma: no cover
+                            rec = None
+                        if rec is not None:
+                            launch = {
+                                k: rec.get(k)
+                                for k in ("seq", "served", "origin",
+                                          "error", "remote_served")
+                                if rec.get(k) is not None
+                            }
+                    self._pending_traces.append({
+                        "trace": msg.trace,
+                        "client": msg.client,
+                        "pool": msg.pool,
+                        "klass": "client",
+                        "reason": keep,
+                        "wall_s": round(dt, 6),
+                        "result": result,
+                        "launch": launch,
+                        "t": time.time(),
+                    })
+            elif not internal and msg.trace is not None:
+                self.perf.get("trace").inc("dropped")
             conn.send(
                 messages.MOSDOpReply(
                     tid=msg.tid, result=result, epoch=self._epoch(), out=out,
@@ -4124,11 +4256,43 @@ class OSD(Dispatcher):
                         perf=self.perf.dump(),
                         store={"bytes_used": used},
                         ledger=self.client_ledger.series(),
+                        traces=self._drain_kept_traces(),
                     ))
                 except (ConnectionError, OSError):
                     self._mgr_conn = None  # mgr bouncing; retry next tick
         except asyncio.CancelledError:
             pass
+
+    def _drain_kept_traces(self) -> list[dict]:
+        """Assemble the keep-policy survivors into shippable waterfalls
+        for the mgr trace store (ISSUE 18).  Assembly runs HERE, at
+        report cadence rather than in the op path, for two reasons: it
+        amortizes the ring scan over the report interval, and it gives
+        the client's reply-side spans (reply_wire/reply_dispatch/total,
+        recorded when the reply lands) time to reach the shared ring in
+        single-process clusters — draining at op completion would ship
+        waterfalls that structurally miss their last hops."""
+        if not self._pending_traces:
+            return []
+        from ..common.tracing import op_waterfall
+
+        out: list[dict] = []
+        ptr = self.perf.get("trace")
+        while self._pending_traces:
+            meta = self._pending_traces.popleft()
+            try:
+                wf = op_waterfall(meta["trace"])
+            except Exception:  # pragma: no cover - observability only
+                logger.exception("%s: trace assembly failed for %s",
+                                 self.name, meta["trace"])
+                continue
+            # ring-eviction race: the spans aged out before this tick
+            # — ship the metadata anyway (reason/wall/client survive;
+            # the store renders an empty waterfall honestly)
+            wf.update(meta)
+            out.append(wf)
+            ptr.inc("shipped")
+        return out
 
     def _refresh_slow_ops(self) -> None:
         """Recompute the slow-request gauges from the live tracker (the

@@ -32,7 +32,8 @@ from ..rados.client import RadosClient, RadosError
 MGR_COMMANDS = {"status", "health", "df", "osd df", "pg dump",
                 "pg query", "pg ls", "metrics", "mgr module ls",
                 "metrics query", "metrics ls", "metrics range",
-                "metrics stats", "client ledger"}
+                "metrics stats", "client ledger",
+                "trace ls", "trace show", "trace top", "trace summary"}
 
 
 async def _mgr_command(client: RadosClient, cmd: dict):
@@ -80,6 +81,28 @@ def _print_status(out: dict) -> None:
     io = out["io"]
     print(f"  io:      {io['op_per_sec']:.0f} op/s, "
           f"{io['rd_bytes_sec']:.0f} B/s rd, {io['wr_bytes_sec']:.0f} B/s wr")
+
+
+def _print_trace(out: dict) -> None:
+    """`ceph trace show` plain renderer: one kept op's cross-daemon
+    waterfall, children indented under their parent hop."""
+    print(f"trace {out.get('trace')}  client={out.get('client')} "
+          f"pool={out.get('pool')} reason={out.get('reason')} "
+          f"wall={(out.get('wall_s') or 0) * 1e3:.3f}ms "
+          f"osd={out.get('osd')}")
+    if out.get("launch"):
+        print(f"  launch: {out['launch']}")
+    print(f"  {'HOP':<20} {'ENTITY':<12} {'START_MS':>9} "
+          f"{'DUR_MS':>9} {'UNC_US':>7}")
+    for s in out.get("hops") or []:
+        name = ("  " if s.get("parent") else "") + str(s.get("hop"))
+        unc = (s.get("uncertainty_s") or 0.0) * 1e6
+        print(f"  {name:<20} {str(s.get('entity')):<12} "
+              f"{(s.get('start_s') or 0.0) * 1e3:>9.3f} "
+              f"{(s.get('dur_s') or 0.0) * 1e3:>9.3f} {unc:>7.1f}")
+    print(f"  path_sum={(out.get('path_sum_s') or 0) * 1e3:.3f}ms "
+          f"dominant={out.get('dominant_hop')} "
+          f"max_unc={(out.get('max_uncertainty_s') or 0) * 1e6:.1f}us")
 
 
 def _fmt_log_entry(e: dict) -> str:
@@ -266,6 +289,14 @@ def main(argv=None) -> int:
         if len(words) == 3:
             extra["metric" if words[1] != "ls" else "pattern"] = \
                 words.pop()
+    # `ceph trace show <id>` / `ceph trace ls|top|summary [k=v...]`
+    # (ISSUE 18): trailing key=value words become params, like metrics
+    if words[:1] == ["trace"] and len(words) >= 2:
+        while len(words) > 2 and "=" in words[-1]:
+            k, _, v = words.pop().partition("=")
+            extra[k] = v
+        if words[:2] == ["trace", "show"] and len(words) == 3:
+            extra["trace"] = words.pop()
     # `ceph log last [n] [level]` (reference CLI shape)
     if words[:2] == ["log", "last"]:
         for w in words[2:]:
@@ -351,6 +382,29 @@ def main(argv=None) -> int:
             elif prefix == "log last" and isinstance(out, dict):
                 for e in out.get("entries", []):
                     print(_fmt_log_entry(e))
+            elif (prefix in ("trace ls", "trace top")
+                  and isinstance(out, dict)):
+                print(f"{'TRACE':<14} {'CLIENT':<12} {'POOL':>4} "
+                      f"{'REASON':<8} {'DOMINANT':<16} {'WALL_MS':>9}")
+                for r in out.get("traces", []):
+                    print(f"{str(r.get('trace')):<14} "
+                          f"{str(r.get('client')):<12} "
+                          f"{str(r.get('pool')):>4} "
+                          f"{str(r.get('reason')):<8} "
+                          f"{str(r.get('dominant_hop')):<16} "
+                          f"{(r.get('wall_s') or 0) * 1e3:>9.3f}")
+            elif prefix == "trace show" and isinstance(out, dict):
+                _print_trace(out)
+            elif prefix == "trace summary" and isinstance(out, dict):
+                print(f"{out.get('traces', 0)} kept traces; reasons: "
+                      + ", ".join(f"{k}={v}" for k, v in sorted(
+                          (out.get("reasons") or {}).items())))
+                print(f"{'DOMINANT_HOP':<18} {'COUNT':>6} "
+                      f"{'SUM_MS':>10} {'MAX_MS':>10}")
+                for h in out.get("dominant_hops", []):
+                    print(f"{h['hop']:<18} {h['count']:>6} "
+                          f"{h['wall_sum_s'] * 1e3:>10.3f} "
+                          f"{h['wall_max_s'] * 1e3:>10.3f}")
             elif isinstance(out, str):
                 print(out, end="")
             elif out is None:

@@ -77,6 +77,8 @@ METRIC_ALIASES = {"stack_e2e_gbps": "stack_e2e.stack_e2e_gbps",
                   # spellings of the promoted IOPS tail metric resolve
                   "smallops_op_p99": "smallops.op_p99_ms",
                   "smallops.op_p99": "smallops.op_p99_ms",
+                  "smallops_trace_overhead_share":
+                      "smallops.trace_overhead_share",
                   "churn_protection": "churn.protection",
                   "churn_recovery_gbps": "churn.recovery_gbps"}
 
@@ -114,6 +116,12 @@ METRIC_ALIASES = {"stack_e2e_gbps": "stack_e2e.stack_e2e_gbps",
 # slack (a sub-ms absolute wobble on a contended CI host must not read
 # as a 2x relative regression).  Both clean-skip (exit 0) until two
 # rounds carry the capture.
+# smallops.trace_overhead_share (ISSUE 18) is the tail-sampling tax:
+# 1 - (ops/sec keep-policy-armed / ops/sec tracing-off) from the same
+# waterfall cluster — LOWER_IS_BETTER with the additive share slack,
+# same shape as header_share, so always-on decide-late tracing can
+# never silently regress the PR-13 IOPS win.  Clean-skips (exit 0)
+# until two rounds carry the capture.
 # churn.protection (ISSUE 15) is the live-storm client protection
 # factor — fifo's storm-vs-quiescent p99 blowup over mclock's under
 # the SAME OSD-kill/recovery storm (a real MiniCluster cycle per
@@ -135,6 +143,7 @@ METRIC_DEFAULT_THRESHOLDS = {"mesh.scaling_efficiency": 0.8,
                              "smallops.header_share": 0.8,
                              "smallops.ops_per_sec": 0.5,
                              "smallops.op_p99_ms": 0.5,
+                             "smallops.trace_overhead_share": 0.8,
                              "churn.protection": 0.4,
                              "churn.recovery_gbps": 0.5}
 
@@ -148,9 +157,11 @@ METRIC_DEFAULT_THRESHOLDS = {"mesh.scaling_efficiency": 0.8,
 # (best + slack) / (current + slack), regression when ratio <
 # threshold.
 LOWER_IS_BETTER = {"mesh.ici_share", "smallops.header_share",
-                   "smallops.op_p99_ms"}
+                   "smallops.op_p99_ms",
+                   "smallops.trace_overhead_share"}
 _SLACKS = {"mesh.ici_share": 0.1, "smallops.header_share": 0.1,
-           "smallops.op_p99_ms": 0.5}
+           "smallops.op_p99_ms": 0.5,
+           "smallops.trace_overhead_share": 0.1}
 _SHARE_SLACK = 0.1  # fallback for LOWER_IS_BETTER metrics not in _SLACKS
 
 

@@ -19,6 +19,9 @@ Panes:
   continuously).
 - **accel** — per-accelerator occupancy: queue depth, rpc rate, and
   service time.
+- **traces** — the slowest tail-sampled keeps in the window (``trace
+  top``, ISSUE 18): trace id, client, keep reason, dominant hop, wall
+  — the ids feed straight into ``ceph trace show <id>``.
 
 Usage:
   python tools/ceph_top.py -m MON               # live, 2s refresh
@@ -121,6 +124,10 @@ async def collect_frame(client: RadosClient, window: float) -> dict:
     svc = await q("accel.service_time", "avg")
     frame["accels"] = accels
     frame["accel_service_time_s"] = (svc or {}).get("value", 0.0)
+    top = await _mgr_cmd(client, {
+        "prefix": "trace top", "n": 10, "window": window,
+    })
+    frame["traces"] = (top or {}).get("traces", [])
     return frame
 
 
@@ -183,6 +190,18 @@ def render_frame(frame: dict) -> str:
             f"{'service_time':>20} "
             f"{frame.get('accel_service_time_s', 0) * 1000:.2f} ms"
         )
+    traces = frame.get("traces", [])
+    if traces:
+        lines += ["", f"{'TRACE':>14} {'CLIENT':>12} {'REASON':>8} "
+                      f"{'DOMINANT':>16} {'WALLMS':>9}"]
+        for t in traces[:10]:
+            lines.append(
+                f"{str(t.get('trace')):>14} "
+                f"{str(t.get('client')):>12} "
+                f"{str(t.get('reason')):>8} "
+                f"{str(t.get('dominant_hop')):>16} "
+                f"{(t.get('wall_s') or 0) * 1000:>9.3f}"
+            )
     return "\n".join(lines)
 
 

@@ -1,7 +1,7 @@
 #!/usr/bin/env python3
 """Static perf-counter consistency pass (CI gate).
 
-Three checks over the ``ceph_tpu`` package's ASTs:
+Six checks over the ``ceph_tpu`` package's ASTs:
 
 1. **Unregistered keys.** Every
    ``perf.get(...).inc/set/observe/time/hist("key")`` call site must
@@ -41,7 +41,18 @@ Three checks over the ``ceph_tpu`` package's ASTs:
    label without the annotation fails here, which is the point: the
    bound must be argued, not assumed.
 
-5. **Unregistered config keys.** Every literal config option the code
+5. **Span hop-name manifest drift.** Every literal hop name recorded
+   into the waterfall vocabulary — ``record_span("hop", ...)`` /
+   ``feed_hop("hop", ...)`` call sites and the ``STACK_HOPS`` tuple —
+   must appear in ``common/hop_manifest.json``, and every manifest
+   entry must be backed by one of those sites: each hop lazily
+   registers a ``stack.lat_<hop>`` histogram the mgr flattens into
+   ``ceph_stack_lat_*`` prometheus series, so the manifest IS the
+   series-cardinality bound.  A new hop lands as a reviewable manifest
+   diff or CI fails.  Only runs when the scanned package carries the
+   manifest (fixture trees without one have nothing to validate).
+
+6. **Unregistered config keys.** Every literal config option the code
    reads — ``cfg.get("osd_op_queue")``, ``config.set("name", v)``,
    ``cfg.observe("name", cb)``, and plain attribute reads like
    ``self.config.osd_op_complaint_time`` — must name an option the
@@ -76,6 +87,7 @@ clean, 1 with a per-site report otherwise.
 from __future__ import annotations
 
 import ast
+import json
 import pathlib
 import re
 import sys
@@ -167,6 +179,9 @@ class _FileScan(ast.NodeVisitor):
         # prometheus label sites: (label, lineno, end_lineno) per
         # f-string part ending `label="` right before an interpolation
         self.label_sites: list[tuple[str, int, int]] = []
+        # waterfall hop vocabulary sites: literal record_span/feed_hop
+        # first args and STACK_HOPS tuple elements, (hop, line)
+        self.hop_sites: list[tuple[str, int]] = []
 
     def _perfish(self, expr: ast.AST) -> bool:
         """Is this receiver a PerfCounters? Either its dotted form
@@ -197,6 +212,15 @@ class _FileScan(ast.NodeVisitor):
         return self.aliases.get(src.split(".", 1)[0])
 
     def visit_Assign(self, node: ast.Assign) -> None:
+        # STACK_HOPS = ("client_serialize", ...): the canonical hop
+        # vocabulary — every element belongs to the hop manifest
+        if any(isinstance(t, ast.Name) and t.id == "STACK_HOPS"
+               for t in node.targets) \
+                and isinstance(node.value, (ast.Tuple, ast.List)):
+            for el in node.value.elts:
+                if isinstance(el, ast.Constant) \
+                        and isinstance(el.value, str):
+                    self.hop_sites.append((el.value, node.lineno))
         # X = <perfish>.create("...") / .get("...") / PerfCounters(...)
         # / <anything>.perf  — X then receives counter mutations; the
         # subsystem rides along when the source names it literally
@@ -241,6 +265,15 @@ class _FileScan(ast.NodeVisitor):
             key = _literal_first_arg(node)
             if key is not None:
                 self.config_registered.append(key)
+        # hop vocabulary call sites (bare or module-qualified); the
+        # def statements themselves are not Calls so never match
+        fname = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None
+        )
+        if fname in ("record_span", "feed_hop"):
+            hop = _literal_first_arg(node)
+            if hop is not None:
+                self.hop_sites.append((hop, node.lineno))
         self.generic_visit(node)
 
     def visit_JoinedStr(self, node: ast.JoinedStr) -> None:
@@ -283,6 +316,7 @@ def check(package_dir: str | pathlib.Path) -> list[str]:
     conf_regs: set[str] = set()
     conf_used: list[tuple[pathlib.Path, str, int, str]] = []
     label_problems: list[str] = []
+    hop_sites: list[tuple[pathlib.Path, str, int]] = []
     for path in sorted(package_dir.rglob("*.py")):
         try:
             src_text = path.read_text()
@@ -299,6 +333,7 @@ def check(package_dir: str | pathlib.Path) -> list[str]:
         conf_used.extend(
             (path, k, ln, src) for k, ln, src in scan.config_used
         )
+        hop_sites.extend((path, h, ln) for h, ln in scan.hop_sites)
         # cardinality lint: exposition text is built in the mgr tree
         if scan.label_sites and "mgr" in path.parts:
             lines = src_text.splitlines()
@@ -359,6 +394,27 @@ def check(package_dir: str | pathlib.Path) -> list[str]:
                     f"{path}:{line}: {src} references config option "
                     f"{key!r} but no Option registers it"
                 )
+    # span hop-name manifest drift (ISSUE 18): both directions, only
+    # when the scanned tree commits a manifest to validate against
+    manifest_path = package_dir / "common" / "hop_manifest.json"
+    if manifest_path.exists():
+        manifest = set(json.loads(manifest_path.read_text())["hops"])
+        seen: set[str] = set()
+        for path, hop, line in hop_sites:
+            seen.add(hop)
+            if hop not in manifest:
+                problems.append(
+                    f"{path}:{line}: span hop {hop!r} is not listed in "
+                    f"{manifest_path.name} — a new hop is a new "
+                    f"ceph_stack_lat_* prometheus series family and "
+                    f"must land as a manifest diff"
+                )
+        for hop in sorted(manifest - seen):
+            problems.append(
+                f"{manifest_path}: manifest hop {hop!r} has no "
+                f"record_span/feed_hop call site or STACK_HOPS entry — "
+                f"remove it or record it"
+            )
     problems.extend(label_problems)
     return problems
 
