@@ -92,6 +92,19 @@ OPTIONS: dict[str, Option] = _opts(
            "bursts without ever delaying a lone ack (the EC "
            "dispatcher's adaptive-window discipline applied to "
            "replies).  <=1 disables (live via observer)"),
+    Option("ms_op_batch_max", int, 16,
+           "multi-op request frame bound (the Objecter-parity batch "
+           "path, ROADMAP item 1a): the messenger writer loop packs "
+           "up to this many consecutive READY batchable requests "
+           "(BATCH_OPS message classes — client MOSDOps, blobs "
+           "included via per-member blob tables) to one peer into a "
+           "single batch frame, one binary header + crc + syscall "
+           "amortized over N ops.  Same flush-on-idle discipline as "
+           "ms_reply_coalesce_max: an empty send queue ships "
+           "immediately, so batching amortizes the client "
+           "aggregator's per-tick bursts (striper fan-out, "
+           "object_cacher writeback) without delaying a lone op.  "
+           "<=1 disables (live via observer)"),
     Option("ms_clock_sync_interval", float, 5.0,
            "per-peer monotonic clock-offset re-estimation period (s): "
            "the messenger runs an NTP-style MClockSync exchange at "

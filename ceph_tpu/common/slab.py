@@ -14,14 +14,17 @@ pool **miss** is a real frame-path allocation and feeds
 ``stack.frame_allocs`` — the PR-12 baseline counter this pool drives
 flat.
 
-Scope (deliberate): the pool covers every buffer the frame layer
-itself creates — send-side header+crc scratch, sub-KiB control-frame
-assembly, coalesced ack-batch assembly.  **Receive** buffers stay
-owned by asyncio's StreamReader: inbound frames are handed out as
-zero-copy views (PR 6) whose lifetime is unbounded (a read reply's
-blob lives as long as the caller keeps it), so recycling them would
-need a refcount on every downstream view — the role buffer::raw's
-refcount plays in the reference, played here by Python's own GC.
+Scope: the pool covers every buffer the frame layer itself creates
+on the SEND side — header+crc scratch, sub-KiB control-frame
+assembly, batch-frame assembly.  **Receive** buffers have their own
+mirror-image pool (common/recv_pool.py, ISSUE 19): inbound frames
+land directly in pooled ``RecvBlock`` slots via the messenger's
+``BufferedProtocol``, and the unbounded-lifetime problem this
+paragraph once punted to Python's GC (a read reply's blob lives as
+long as the caller keeps it) is solved the way buffer::raw solves it
+in the reference — a refcount on the block (view export probing + a
+bounded quarantine), so downstream views pin the block and the last
+one to die recycles it.
 
 Thread-safe: one process-global pool (:func:`frame_slab`) is shared by
 every in-process messenger plus the EC dispatcher's worker threads,

@@ -109,6 +109,21 @@ def stack_perf():
                  .add_gauge("slab_bytes_held",
                             "bytes parked in the slab pool's bounded "
                             "free lists")
+                 .add_counter("recv_allocs",
+                              "receive-buffer allocation events — "
+                              "recv-pool misses (cold pool / oversize "
+                              "frame); flat in steady state now that "
+                              "inbound frames land in pooled blocks "
+                              "(common/recv_pool.py), the last "
+                              "allocating hop retired")
+                 .add_counter("recv_slab_hits",
+                              "inbound frames served from the recv "
+                              "pool's free lists (allocation-free "
+                              "receives)")
+                 .add_gauge("recv_bytes_held",
+                            "bytes parked in the recv pool's bounded "
+                            "free lists (quarantined still-referenced "
+                            "blocks excluded: their views own them)")
                  .add_counter("sampled_ops",
                               "client ops that got full waterfall "
                               "spans (1-in-osd_op_trace_sample_every)"))
@@ -212,6 +227,28 @@ def note_slab_miss(held_bytes: int) -> None:
 def note_slab_held(held_bytes: int) -> None:
     """Free-list byte gauge refresh on a slab release."""
     stack_perf().set("slab_bytes_held", held_bytes)
+
+
+def note_recv_hit(n: int = 1) -> None:
+    """Pooled receive-block checkouts (allocation-free frame reads),
+    flushed in batches from the pool's plain-int tally like
+    note_slab_hit."""
+    stack_perf().inc("recv_slab_hits", n)
+
+
+def note_recv_miss(held_bytes: int) -> None:
+    """One receive checkout that had to allocate — a real frame-path
+    allocation, ALSO counted into ``frame_allocs`` (the
+    flat-in-steady-state pin now covers both directions)."""
+    pc = stack_perf()
+    pc.inc("recv_allocs")
+    pc.inc("frame_allocs")
+    pc.set("recv_bytes_held", held_bytes)
+
+
+def note_recv_held(held_bytes: int) -> None:
+    """Free-list byte gauge refresh on a recv-block release."""
+    stack_perf().set("recv_bytes_held", held_bytes)
 
 
 def feed_hop(hop: str, seconds: float) -> None:

@@ -39,12 +39,24 @@ assignment — steady-state frame encode allocates nothing
 same block (the old messenger control-frame join, now pool-backed):
 heartbeats/acks cost one segment, one write, zero allocations.
 
-**Batch frames** (flags BATCH) carry N blob-free sub-messages under one
-header+crc — the coalesced-ack path: the OSD writer loop packs
-consecutive ready ``MOSDOpReply``-class acks (``COALESCE`` subclasses)
-into one frame, one syscall.  Each sub-entry is
-``[u16 type_id][u16 flags][u16 trace_len][u32 tail_len][trace][tail]``;
-``blob_count`` holds the sub-message count.
+**Batch frames** (flags BATCH) carry N sub-messages under one
+header+crc; ``blob_count`` holds the sub-message count.  Two sub-entry
+layouts, selected by the frame-level BATCH_BLOBS flag and pinned in
+msg/wire_manifest.json:
+
+- blob-free (the coalesced-ack path, byte-frozen since PR 13): the OSD
+  writer loop packs consecutive ready ``MOSDOpReply``-class acks
+  (``COALESCE`` subclasses) into one frame, one syscall.  Each
+  sub-entry is ``[u16 type_id][u16 flags][u16 trace_len][u32 tail_len]
+  [trace][tail]``.
+- blob-carrying (flags BATCH|BATCH_BLOBS — the multi-op REQUEST path,
+  the Objecter's op-per-target aggregation on the wire): each
+  sub-entry grows a blob table, ``[u16 type_id][u16 flags]
+  [u16 trace_len][u32 tail_len][u16 blob_count][u32 blob_len x count]
+  [trace][tail]``, and every member's blobs ride AFTER the entry
+  table, concatenated in member order — so the metadata region still
+  packs into one slab block and the payload views still ship vectored,
+  exactly like a single-message frame.
 
 Zero-copy contract (the bufferlist discipline, reference:src/include/
 buffer.h): blobs are **borrowed views**, never copied —
@@ -90,11 +102,17 @@ FLAG_TRACED = 0x1
 FLAG_TAIL_BIN = 0x2
 FLAG_TAIL_JSON = 0x4
 FLAG_BATCH = 0x8
+# batch members carry blobs: extended sub-entries with a per-member
+# blob table (the multi-op request frame; see the module docstring)
+FLAG_BATCH_BLOBS = 0x10
 
 # magic, type_id, flags, seq, sent, blob_count, trace_len, tail_len
 _FIXED = struct.Struct("<4sHHQdHHI")
 # batch sub-entry: type_id, flags, trace_len, tail_len
 _SUB = struct.Struct("<HHHI")
+# extended batch sub-entry (BATCH_BLOBS): + blob_count (u32 blob
+# lengths follow the fixed part, before the trace/tail bytes)
+_SUBX = struct.Struct("<HHHIH")
 _CRC = struct.Struct("<I")
 # the marshal wire format version (2 = the portable, frozen layout)
 _MARSHAL_VER = 2
@@ -168,6 +186,10 @@ class Message:
 
     ``COALESCE = True`` marks blob-free ack types the messenger writer
     loop may pack into one batch frame (ms_reply_coalesce_max).
+    ``BATCH_OPS = True`` marks REQUEST types the writer loop may pack
+    the same way blobs and all (ms_op_batch_max) — the frame grows
+    per-member blob tables (FLAG_BATCH_BLOBS) and the payload views
+    still ship vectored, never joined.
     """
 
     TYPE = ""
@@ -181,6 +203,10 @@ class Message:
     _FIELDS_SINGLE = False
     _PLAIN_BUILD = True
     COALESCE = False
+    BATCH_OPS = False
+    # decode metadata: True on members that arrived in a batch frame
+    # (the OSD's QoS intake surfaces batch-member admission from it)
+    from_batch = False
 
     def __init_subclass__(cls, **kw: Any):
         super().__init_subclass__(**kw)
@@ -440,21 +466,25 @@ def encode_frame_segments(msg: Message, seq: int = 0) -> tuple[list, int,
 
 def encode_batch_frame(msgs: list[Message], seq: int = 0) -> tuple[
         list, int, Any]:
-    """N blob-free messages under ONE header+crc (the coalesced-ack
-    frame): ``(segments, total, release)`` — always a single slab
-    segment.  ``seq`` is the first member's sequence number; members
-    occupy seq..seq+N-1 in order.  Callers guarantee every message is
-    blob-free (the writer loop checks COALESCE + not blobs)."""
+    """N messages under ONE header+crc: ``(segments, total, release)``.
+    ``seq`` is the first member's sequence number; members occupy
+    seq..seq+N-1 in order.
+
+    Blob-free members (the coalesced-ack path) keep the PR-13
+    byte-frozen compact sub-entries and come back as a single slab
+    segment.  Any member with blobs switches the WHOLE frame to the
+    extended layout (FLAG_BATCH_BLOBS: per-member blob tables, blobs
+    concatenated after the entry table in member order) — the multi-op
+    request frame.  Payload views ship vectored like
+    :func:`encode_frame_segments` (small frames still gather into the
+    slab block); the zero-copy contract is identical."""
     _t0 = time.perf_counter()
     sent = 0.0
-    parts: list[tuple[int, int, bytes, bytes]] = []
-    entries_len = 0
+    parts: list[tuple[int, int, bytes, bytes, list[int], list]] = []
     any_traced = False
+    any_blobs = False
+    blob_total = 0
     for m in msgs:
-        if m.blobs:
-            raise ValueError(
-                f"{type(m).__name__}: blob-carrying messages cannot "
-                f"ride a batch frame")
         sflags = 0
         trace_b = b""
         if m.trace is not None:
@@ -463,9 +493,24 @@ def encode_batch_frame(msgs: list[Message], seq: int = 0) -> tuple[
             any_traced = True
         tail, tflag = _pack_tail(m)
         sflags |= tflag
-        parts.append((m.TYPE_ID, sflags, trace_b, tail))
-        entries_len += _SUB.size + len(trace_b) + len(tail)
-    flags = FLAG_BATCH
+        lens: list[int] = []
+        blob_segs: list = []
+        for b in m.blobs:
+            if type(b) is bytes:  # dominant blob shape: no cast walk
+                n = len(b)
+                lens.append(n)
+                blob_total += n
+                blob_segs.append((b,) if n else ())
+                continue
+            segs_b = [s for s in _segments_of(b) if len(s)]
+            n = sum(len(s) for s in segs_b)
+            lens.append(n)
+            blob_total += n
+            blob_segs.append(segs_b)
+        if lens:
+            any_blobs = True
+        parts.append((m.TYPE_ID, sflags, trace_b, tail, lens, blob_segs))
+    flags = FLAG_BATCH | (FLAG_BATCH_BLOBS if any_blobs else 0)
     if any_traced:
         flags |= FLAG_TRACED
         # one shared send stamp: the members leave the socket together
@@ -473,23 +518,61 @@ def encode_batch_frame(msgs: list[Message], seq: int = 0) -> tuple[
         for m in msgs:
             if m.trace is not None:
                 m.sent = sent
-    total = _FIXED.size + entries_len + 4
-    slab = frame_slab().checkout(total)
+    sub_size = _SUBX.size if any_blobs else _SUB.size
+    entries_len = sum(
+        sub_size + 4 * len(lens) + len(trace_b) + len(tail)
+        for _tid, _sf, trace_b, tail, lens, _bs in parts
+    ) if any_blobs else sum(
+        sub_size + len(trace_b) + len(tail)
+        for _tid, _sf, trace_b, tail, _l, _bs in parts
+    )
+    head_len = _FIXED.size + entries_len
+    total = head_len + blob_total + 4
+    small = total <= SMALL_FRAME_MAX or not blob_total
+    slab = frame_slab().checkout(total if small else head_len + 4)
     buf = slab.data
     _FIXED.pack_into(buf, 0, MAGIC, TYPE_ID_BATCH, flags, seq, sent,
                      len(msgs), 0, entries_len)
     off = _FIXED.size
-    for tid, sflags, trace_b, tail in parts:
-        _SUB.pack_into(buf, off, tid, sflags, len(trace_b), len(tail))
-        off += _SUB.size
+    for tid, sflags, trace_b, tail, lens, _bs in parts:
+        if any_blobs:
+            _SUBX.pack_into(buf, off, tid, sflags, len(trace_b),
+                            len(tail), len(lens))
+            off += _SUBX.size
+            if lens:
+                _lens_struct(len(lens)).pack_into(buf, off, *lens)
+                off += 4 * len(lens)
+        else:
+            _SUB.pack_into(buf, off, tid, sflags, len(trace_b),
+                           len(tail))
+            off += _SUB.size
         buf[off:off + len(trace_b)] = trace_b
         off += len(trace_b)
         buf[off:off + len(tail)] = tail
         off += len(tail)
     note_header_encode(time.perf_counter() - _t0)
-    crc = native.crc32c_view(CRC_SEED, memoryview(buf), off)
-    _CRC.pack_into(buf, off, crc)
-    return [slab.view(total)], total, slab.release
+    if small:
+        # acks and sub-KiB op runs gather into the one pooled block:
+        # one segment, one crc pass, no allocation
+        for _tid, _sf, _tr, _tl, _lens, blob_segs in parts:
+            for segs_b in blob_segs:
+                for s in segs_b:
+                    n = len(s)
+                    buf[off:off + n] = s
+                    off += n
+        crc = native.crc32c_view(CRC_SEED, memoryview(buf), off)
+        _CRC.pack_into(buf, off, crc)
+        return [slab.view(total)], total, slab.release
+    crc = native.crc32c_view(CRC_SEED, memoryview(buf), head_len)
+    segs: list = [slab.view(head_len)]
+    for _tid, _sf, _tr, _tl, _lens, blob_segs in parts:
+        for segs_b in blob_segs:
+            for s in segs_b:
+                segs.append(s)
+                crc = native.crc32c_view(crc, s)
+    _CRC.pack_into(buf, head_len, crc)
+    segs.append(slab.view(4, start=head_len))
+    return segs, total, slab.release
 
 
 def encode_frame(msg: Message, seq: int = 0) -> bytes:
@@ -549,18 +632,38 @@ def decode_frame_msgs(frame: bytes | bytearray | memoryview) -> tuple[
     if flags & FLAG_BATCH:
         if type_id != TYPE_ID_BATCH:
             raise BadFrame(f"batch flag on type id {type_id}")
-        if trace_len or _FIXED.size + tail_len != body.nbytes:
+        ext = bool(flags & FLAG_BATCH_BLOBS)
+        # blob-free batches fill the body exactly with entries; the
+        # extended layout appends the members' blobs after the table
+        entries_end = _FIXED.size + tail_len
+        if trace_len or (entries_end != body.nbytes if not ext
+                         else entries_end > body.nbytes):
             raise BadFrame("batch frame length mismatch")
         msgs: list[Message] = []
         off = _FIXED.size
+        blob_off = entries_end
         for _i in range(nblob):  # blob_count = sub-message count
-            try:
-                stid, sflags, strace_len, stail_len = _SUB.unpack_from(
-                    body, off)
-            except struct.error as e:
-                raise BadFrame(f"truncated batch entry: {e}") from e
-            off += _SUB.size
-            if off + strace_len + stail_len > body.nbytes:
+            slens: tuple[int, ...] = ()
+            if ext:
+                try:
+                    (stid, sflags, strace_len, stail_len,
+                     snblob) = _SUBX.unpack_from(body, off)
+                except struct.error as e:
+                    raise BadFrame(f"truncated batch entry: {e}") from e
+                off += _SUBX.size
+                if snblob:
+                    if off + 4 * snblob > entries_end:
+                        raise BadFrame("batch entry overruns frame")
+                    slens = struct.unpack_from(f"<{snblob}I", body, off)
+                    off += 4 * snblob
+            else:
+                try:
+                    stid, sflags, strace_len, stail_len = \
+                        _SUB.unpack_from(body, off)
+                except struct.error as e:
+                    raise BadFrame(f"truncated batch entry: {e}") from e
+                off += _SUB.size
+            if off + strace_len + stail_len > entries_end:
                 raise BadFrame("batch entry overruns frame")
             cls = _REGISTRY.get(stid)
             if cls is None:
@@ -572,12 +675,19 @@ def decode_frame_msgs(frame: bytes | bytearray | memoryview) -> tuple[
                 except UnicodeDecodeError as e:
                     raise BadFrame(f"bad trace id: {e}") from e
             off += strace_len
-            m = _build(cls, body[off:off + stail_len], sflags, [])
+            blobs = []
+            for n in slens:
+                if blob_off + n > body.nbytes:
+                    raise BadFrame("batch blob length mismatch")
+                blobs.append(body[blob_off:blob_off + n])
+                blob_off += n
+            m = _build(cls, body[off:off + stail_len], sflags, blobs)
             off += stail_len
             m.trace = trace
             m.sent = sent if (sflags & FLAG_TRACED) else None
+            m.from_batch = True
             msgs.append(m)
-        if off != body.nbytes:
+        if off != entries_end or blob_off != body.nbytes:
             raise BadFrame("batch entries do not fill the frame")
         if not msgs:
             raise BadFrame("empty batch frame")

@@ -325,6 +325,11 @@ class MOSDOp(Message):
 
     TYPE = "osd_op"
     TYPE_ID = 50
+    # client ops may ride multi-op batch frames (ms_op_batch_max): the
+    # writer loop packs consecutive ready MOSDOps to one OSD into a
+    # single frame with per-member blob tables (FLAG_BATCH_BLOBS) —
+    # the Objecter's op-per-target aggregation at the wire layer
+    BATCH_OPS = True
     FIELDS = ("tid", "epoch", "pool", "oid", "ops", "snapc", "snapid",
               "stamps", "client")
 

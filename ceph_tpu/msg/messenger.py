@@ -19,9 +19,11 @@ import json
 import logging
 import struct
 import time
+from collections import deque
 from typing import Optional
 
 from ..common.clocksync import ClockTable, clock_table
+from ..common.recv_pool import recv_pool
 from ..common.tracing import current_trace, new_trace_id
 from .message import (
     BadFrame,
@@ -45,18 +47,276 @@ class Dispatcher:
         """Peer closed / connection failed (reference ms_handle_reset)."""
 
 
+class _FrameChannel(asyncio.BufferedProtocol):
+    """The pooled receive path (ROADMAP item 1b): one transport-level
+    protocol playing both StreamReader and StreamWriter for a
+    connection, with inbound frame bodies landing DIRECTLY in
+    recv-pool blocks (common/recv_pool.py).
+
+    The old StreamReader path allocated twice per frame
+    (``readexactly`` built fresh ``bytes`` for prefix and body — the
+    last allocating hop after PR 13 made the send side pool-backed).
+    Here the event loop's ``recv_into`` writes into pooled memory:
+
+    - **line mode** (the JSON banner/auth handshake): bytes stage
+      through a small scratch into ``_line_buf`` for ``readline()``.
+    - **frame mode**: a 4-byte prefix stages into fixed scratch, then
+      ``get_buffer`` returns the checked-out block's remaining window
+      — the socket fills the frame body in place, zero copies, zero
+      allocations on a pool hit.  Completed frames queue for
+      ``read_frame()``; past ``MAX_QUEUED`` the transport pauses
+      reading (TCP backpressure, the StreamReader flow-control analog
+      — the dispatch throttle still bounds in-flight decoded bytes).
+
+    Write side: ``write``/``writelines`` pass through to the
+    transport; ``drain()`` awaits the ``pause_writing`` /
+    ``resume_writing`` flow-control event, so the writer loop's slab
+    release discipline is unchanged.
+
+    Mode switch feeds any bytes that arrived coalesced behind the last
+    handshake line straight into the frame state machine — nothing on
+    the wire is lost or reordered.
+    """
+
+    # completed-but-unconsumed frame bound before pausing the socket
+    MAX_QUEUED = 32
+    # hard cap on a claimed frame length: a corrupt/hostile prefix must
+    # not make us allocate gigabytes before the crc check can fail it
+    MAX_FRAME = 1 << 28
+    _LINE_SCRATCH = 8192
+
+    def __init__(self, on_connected=None):
+        self.transport: asyncio.Transport | None = None
+        self._on_connected = on_connected
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._mode = "line"
+        self._line_buf = bytearray()
+        self._line_scratch = bytearray(self._LINE_SCRATCH)
+        self._prefix = bytearray(_LEN.size)
+        self._pfx_have = 0
+        self._blk = None          # RecvBlock being filled
+        self._body_mv: memoryview | None = None
+        self._need = 0
+        self._have = 0
+        self._frames: deque = deque()  # (blk | None, body memoryview, n)
+        self._waiter: asyncio.Future | None = None
+        self._eof = False
+        self._conn_lost = False
+        self._exc: BaseException | None = None
+        self._paused = False
+        self._can_write = asyncio.Event()
+        self._can_write.set()
+        self._closed_fut: asyncio.Future | None = None
+
+    # -- protocol callbacks (event-loop context, all synchronous) ----------
+    def connection_made(self, transport) -> None:
+        self.transport = transport
+        self._loop = asyncio.get_running_loop()
+        self._closed_fut = self._loop.create_future()
+        if self._on_connected is not None:
+            self._on_connected(self)
+
+    def get_buffer(self, sizehint: int):
+        if self._mode == "line":
+            return memoryview(self._line_scratch)
+        if self._pfx_have < _LEN.size:
+            return memoryview(self._prefix)[self._pfx_have:]
+        # the pooled block's unfilled window: recv_into targets the
+        # frame body directly — no staging buffer, no copy
+        return self._body_mv[self._have:]
+
+    def buffer_updated(self, nbytes: int) -> None:
+        if self._mode == "line":
+            self._line_buf += self._line_scratch[:nbytes]
+            self._wake()
+            return
+        if self._pfx_have < _LEN.size:
+            self._pfx_have += nbytes
+            if self._pfx_have == _LEN.size:
+                self._begin_body()
+            return
+        self._have += nbytes
+        if self._have >= self._need:
+            self._finish_body()
+
+    def _begin_body(self) -> None:
+        (n,) = _LEN.unpack(self._prefix)
+        if n > self.MAX_FRAME:
+            self._exc = BadFrame(f"frame length {n} exceeds cap")
+            self._wake()
+            if self.transport is not None:
+                self.transport.abort()
+            return
+        self._need = n
+        self._have = 0
+        if n == 0:
+            # zero-length frame: complete immediately (decode raises
+            # BadFrame upstream); returning an empty get_buffer would
+            # spin the loop
+            self._frames.append((None, memoryview(b""), 0))
+            self._pfx_have = 0
+            self._wake()
+            return
+        self._blk = recv_pool().checkout(n)
+        self._body_mv = self._blk.view(n)
+
+    def _finish_body(self) -> None:
+        blk, mv, n = self._blk, self._body_mv, self._need
+        self._blk = None
+        self._body_mv = None
+        self._pfx_have = 0
+        self._frames.append((blk, mv, n))
+        if len(self._frames) >= self.MAX_QUEUED and not self._paused:
+            self._paused = True
+            try:
+                self.transport.pause_reading()
+            # swallow-ok: a closing transport needs no backpressure
+            except (RuntimeError, AttributeError):
+                pass
+        self._wake()
+
+    def eof_received(self) -> bool:
+        self._eof = True
+        self._wake()
+        return False  # close the transport; connection_lost follows
+
+    def connection_lost(self, exc) -> None:
+        self._conn_lost = True
+        self._eof = True
+        if exc is not None and self._exc is None:
+            self._exc = exc
+        # drop OUR staging view before releasing the half-filled block,
+        # so the pool's export probe sees only downstream holders
+        self._body_mv = None
+        if self._blk is not None:
+            self._blk.release()
+            self._blk = None
+        self._can_write.set()
+        if self._closed_fut is not None and not self._closed_fut.done():
+            self._closed_fut.set_result(None)
+        self._wake()
+
+    def pause_writing(self) -> None:
+        self._can_write.clear()
+
+    def resume_writing(self) -> None:
+        self._can_write.set()
+
+    def _wake(self) -> None:
+        w = self._waiter
+        if w is not None and not w.done():
+            w.set_result(None)
+
+    async def _wait(self) -> None:
+        w = self._loop.create_future()
+        self._waiter = w
+        try:
+            await w
+        finally:
+            self._waiter = None
+
+    # -- reader surface ----------------------------------------------------
+    async def readline(self) -> bytes:
+        """One handshake line (line mode only; EOF returns what's
+        buffered, empty at a clean close — StreamReader semantics)."""
+        while True:
+            i = self._line_buf.find(b"\n")
+            if i >= 0:
+                line = bytes(self._line_buf[:i + 1])  # copy-ok: handshake line, cold path
+                del self._line_buf[:i + 1]
+                return line
+            if self._eof:
+                line = bytes(self._line_buf)  # copy-ok: handshake EOF drain, cold path
+                self._line_buf.clear()
+                return line
+            await self._wait()
+
+    def set_frame_mode(self) -> None:
+        """Handshake done: subsequent bytes are length-prefixed frames.
+        Bytes already received behind the final handshake line replay
+        through the same state machine (a one-time bounded copy)."""
+        self._mode = "frame"
+        leftover = bytes(self._line_buf)  # copy-ok: one-time mode-switch drain
+        self._line_buf.clear()
+        off, total = 0, len(leftover)
+        while off < total:
+            if self._pfx_have < _LEN.size:
+                take = min(_LEN.size - self._pfx_have, total - off)
+                self._prefix[self._pfx_have:self._pfx_have + take] = \
+                    leftover[off:off + take]
+                self._pfx_have += take
+                off += take
+                if self._pfx_have == _LEN.size:
+                    self._begin_body()
+                continue
+            take = min(self._need - self._have, total - off)
+            self._body_mv[self._have:self._have + take] = \
+                leftover[off:off + take]
+            self._have += take
+            off += take
+            if self._have >= self._need:
+                self._finish_body()
+
+    async def read_frame(self):
+        """``(block, body_view, nbytes)`` for the next complete frame.
+        The caller owns the pair: release the view, then the block,
+        once dispatch is done (decoded blob views defer the recycle via
+        the pool's quarantine, never block it)."""
+        while True:
+            if self._frames:
+                item = self._frames.popleft()
+                if self._paused and len(self._frames) < self.MAX_QUEUED // 2:
+                    self._paused = False
+                    try:
+                        self.transport.resume_reading()
+                    # swallow-ok: a dead transport cannot resume; EOF ends the loop
+                    except (RuntimeError, AttributeError):
+                        pass
+                return item
+            if self._exc is not None:
+                raise self._exc
+            if self._eof:
+                raise asyncio.IncompleteReadError(b"", _LEN.size)
+            await self._wait()
+
+    # -- writer surface ----------------------------------------------------
+    def write(self, data) -> None:
+        if not self._conn_lost:
+            self.transport.write(data)
+
+    def writelines(self, segs) -> None:
+        if not self._conn_lost:
+            self.transport.writelines(segs)
+
+    async def drain(self) -> None:
+        if self._conn_lost:
+            raise ConnectionResetError("connection lost")
+        await self._can_write.wait()
+        if self._conn_lost:
+            raise ConnectionResetError("connection lost")
+
+    def close(self) -> None:
+        if self.transport is not None and not self._conn_lost:
+            self.transport.close()
+
+    async def wait_closed(self) -> None:
+        if self._closed_fut is not None:
+            await self._closed_fut
+
+
 class Connection:
     """One ordered, crc-checked message stream to a peer."""
 
     def __init__(
         self,
         messenger: "AsyncMessenger",
-        reader: asyncio.StreamReader,
-        writer: asyncio.StreamWriter,
+        channel: "_FrameChannel",
     ):
         self.messenger = messenger
-        self._reader = reader
-        self._writer = writer
+        # one _FrameChannel plays reader AND writer: inbound frames
+        # come out of it as pooled blocks, outbound segments go in
+        # vectored (see the class docstring)
+        self._channel = channel
         self.peer_name: str = "?"
         self.peer_addr: str = ""
         self.authenticated = True  # False only on a mon awaiting MAuth
@@ -121,10 +381,16 @@ class Connection:
         self._sendq.put_nowait(msg)
 
     def _coalescible(self, msg: Message) -> bool:
-        """Batch-frame eligible: a COALESCE ack class with no blobs
+        """Ack-batch eligible: a COALESCE ack class with no blobs
         (read replies carry payload views and stay on the vectored
         path)."""
         return type(msg).COALESCE and not msg.blobs
+
+    def _op_batchable(self, msg: Message) -> bool:
+        """Multi-op request-frame eligible (the Objecter-parity path,
+        ms_op_batch_max): BATCH_OPS request classes — blobs ride along
+        via the frame's per-member blob tables (FLAG_BATCH_BLOBS)."""
+        return type(msg).BATCH_OPS
 
     async def _writer_loop(self) -> None:
         # slab release discipline: a frame's scratch block recycles
@@ -145,24 +411,35 @@ class Connection:
                 if item is None:
                     break
                 perf = self.messenger.perf
-                # coalesced acks (the EC dispatcher's adaptive-window
-                # idea applied to replies): consecutive ALREADY-READY
-                # eligible acks — and only those — pack into one batch
-                # frame, one header+crc+syscall over N.  An empty queue
-                # flushes immediately (zero added latency); a
-                # non-eligible message flushes the run and carries over
-                # (send order is never reordered).
+                # batched frames (the EC dispatcher's adaptive-window
+                # idea applied at the wire): consecutive ALREADY-READY
+                # eligible messages of the same run kind — and only
+                # those — pack into one batch frame, one
+                # header+crc+syscall over N.  Two run kinds: blob-free
+                # COALESCE acks (ms_reply_coalesce_max, PR 13) and
+                # BATCH_OPS requests blobs-and-all (ms_op_batch_max —
+                # the client aggregator's per-tick op bursts land here
+                # adjacent, so striper fan-out / cacher flushes ship as
+                # multi-op frames).  An empty queue flushes immediately
+                # (zero added latency); a non-eligible message flushes
+                # the run and carries over (send order never reorders).
                 batch = None
+                pred = None
                 cmax = self.messenger.reply_coalesce_max
+                omax = self.messenger.op_batch_max
                 if cmax > 1 and self._coalescible(item):
+                    pred, limit, kind = self._coalescible, cmax, "ack"
+                elif omax > 1 and self._op_batchable(item):
+                    pred, limit, kind = self._op_batchable, omax, "op"
+                if pred is not None:
                     batch = [item]
-                    while len(batch) < cmax:
+                    while len(batch) < limit:
                         try:
                             nxt = self._sendq.get_nowait()
                         # swallow-ok: empty queue IS the flush-on-idle signal
                         except asyncio.QueueEmpty:
                             break
-                        if nxt is None or not self._coalescible(nxt):
+                        if nxt is None or not pred(nxt):
                             carry = nxt
                             break
                         batch.append(nxt)
@@ -172,8 +449,12 @@ class Connection:
                         self._send_seq += len(batch)
                         segs, total, release = encode_batch_frame(
                             batch, seq0)
-                        perf.inc("send_coalesced", len(batch))
-                        perf.inc("coalesced_frames")
+                        if kind == "ack":
+                            perf.inc("send_coalesced", len(batch))
+                            perf.inc("coalesced_frames")
+                        else:
+                            perf.inc("batched_ops", len(batch))
+                            perf.inc("batch_frames")
                     else:
                         self._send_seq += 1
                         segs, total, release = encode_frame_segments(
@@ -185,7 +466,7 @@ class Connection:
                         self.messenger.name, type(item).__name__,
                         self.peer_name,
                     )
-                    self._writer.transport.abort()
+                    self._channel.transport.abort()
                     break
                 perf.inc("bytes_send", total)
                 perf.hist("send_bytes_histogram", total)
@@ -204,7 +485,7 @@ class Connection:
                         "(mid-vectored-write)",
                         self.messenger.name, self.peer_name,
                     )
-                    self._writer.write(_LEN.pack(total))
+                    self._channel.write(_LEN.pack(total))
                     budget = max(1, total // 2)
                     partial = []
                     for seg in segs:
@@ -214,22 +495,22 @@ class Connection:
                         budget -= take
                         if budget <= 0:
                             break
-                    self._writer.writelines(partial)
+                    self._channel.writelines(partial)
                     try:
-                        await self._writer.drain()
+                        await self._channel.drain()
                     finally:
-                        self._writer.transport.abort()
+                        self._channel.transport.abort()
                     break
                 # vectored write: length prefix + every frame segment
                 # handed to the transport as-is — the payload views are
                 # coalesced (if at all) only at the socket boundary,
                 # never joined in the messenger
-                self._writer.write(_LEN.pack(total))
+                self._channel.write(_LEN.pack(total))
                 if len(segs) == 1:
-                    self._writer.write(segs[0])
+                    self._channel.write(segs[0])
                 else:
-                    self._writer.writelines(segs)
-                await self._writer.drain()
+                    self._channel.writelines(segs)
+                await self._channel.drain()
                 pending_release.append(release)
                 if self._transport_empty():
                     for rel in pending_release:
@@ -261,7 +542,7 @@ class Connection:
         """True iff the transport holds no un-sent bytes (slab blocks
         are safe to recycle)."""
         try:
-            return self._writer.transport.get_write_buffer_size() == 0
+            return self._channel.transport.get_write_buffer_size() == 0
         # swallow-ok: closed/foreign transport — treat as NOT drained, drop the slabs
         except Exception:
             return False
@@ -270,69 +551,50 @@ class Connection:
         throttle = self.messenger.dispatch_throttle
         try:
             while True:
-                hdr = await self._reader.readexactly(_LEN.size)
-                (n,) = _LEN.unpack(hdr)
-                if self.messenger._inject_failure():
-                    # receive-side injection: drop the link with a frame
-                    # half-read (reference injects on both directions)
-                    logger.info(
-                        "%s: INJECTING socket failure from %s (mid-read)",
-                        self.messenger.name, self.peer_name,
-                    )
-                    self._writer.transport.abort()
-                    break
-                # the dispatch throttle bounds in-flight inbound bytes:
-                # waiting HERE exerts TCP backpressure on the peer
-                # (reference:Messenger policy throttler semantics)
-                await throttle.acquire(n)
-                perf = self.messenger.perf
-                perf.set("dispatch_queue_bytes", throttle.current)
+                # the channel hands back a COMPLETE frame in a pooled
+                # block (no per-frame allocation on a pool hit); socket
+                # backpressure moved into the channel's queued-frame
+                # pause/resume — the dispatch throttle below still
+                # bounds in-flight decoded bytes
+                blk, body, n = await self._channel.read_frame()
                 try:
-                    frame = await self._reader.readexactly(n)
-                    t_rx = time.monotonic()
-                    # one frame may carry N coalesced acks (batch
-                    # frames); ordered delivery = frame order, then
-                    # member order within the frame
-                    msgs, _seq = decode_frame_msgs(frame)
-                    perf.inc("msg_recv", len(msgs))
-                    perf.inc("bytes_recv", n)
-                    self.messenger._maybe_clock_probe(self)
-                    frame_dt = 0.0
-                    for msg in msgs:
-                        # receive stamp (op waterfall): taken at frame
-                        # read, local clock — with the header's send
-                        # stamp and the peer clock offset this IS the
-                        # wire hop
-                        msg.recv_ts = t_rx
-                        # restore the sender's trace context for this
-                        # dispatch (and every task it spawns): the id
-                        # minted at the client follows the op across
-                        # daemons
-                        current_trace.set(msg.trace)
-                        try:
-                            t0 = time.perf_counter()
-                            try:
-                                await self.messenger._dispatch(self, msg)
-                            finally:
-                                dt = time.perf_counter() - t0
-                                frame_dt += dt
-                                perf.observe("dispatch_latency", dt)
-                        # swallow-ok: logged handler bug must not tear down the peer link
-                        except Exception:
-                            logger.exception(
-                                "%s: dispatcher failed on %s from %s",
-                                self.messenger.name, msg.TYPE,
-                                self.peer_name,
-                            )
-                        finally:
-                            current_trace.set(None)
-                    # byte-bucketed ONCE per frame (a 16-ack batch
-                    # must not book its bytes 16x); the per-message
-                    # handler wall rides dispatch_latency above
-                    perf.hist("dispatch_histogram", n, frame_dt)
-                finally:
-                    throttle.release(n)
+                    if self.messenger._inject_failure():
+                        # receive-side injection: drop the link with a
+                        # frame on the floor (reference injects on both
+                        # directions)
+                        logger.info(
+                            "%s: INJECTING socket failure from %s "
+                            "(frame dropped)",
+                            self.messenger.name, self.peer_name,
+                        )
+                        self._channel.transport.abort()
+                        break
+                    await throttle.acquire(n)
+                    perf = self.messenger.perf
                     perf.set("dispatch_queue_bytes", throttle.current)
+                    try:
+                        t_rx = time.monotonic()
+                        # one frame may carry N coalesced acks or
+                        # batched ops; ordered delivery = frame order,
+                        # then member order within the frame.  Blob
+                        # views decode as slices of the pooled block.
+                        msgs, _seq = decode_frame_msgs(body)
+                        perf.inc("msg_recv", len(msgs))
+                        perf.inc("bytes_recv", n)
+                        self.messenger._maybe_clock_probe(self)
+                        frame_dt = 0.0
+                        await self._dispatch_frame(msgs, t_rx, n, perf)
+                    finally:
+                        throttle.release(n)
+                        perf.set("dispatch_queue_bytes", throttle.current)
+                finally:
+                    # lifetime discipline: drop the reader's OWN view,
+                    # then release — blob views still held downstream
+                    # (op tasks, client read(copy=False)) quarantine
+                    # the block; the pool recycles it when they die
+                    body.release()
+                    if blk is not None:
+                        blk.release()
         # swallow-ok: peer went away — _handle_reset below reports it
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
@@ -344,14 +606,47 @@ class Connection:
             await self.close()
             self.messenger._handle_reset(self)
 
+    async def _dispatch_frame(self, msgs, t_rx, n, perf) -> None:
+        frame_dt = 0.0
+        for msg in msgs:
+            # receive stamp (op waterfall): taken at frame read, local
+            # clock — with the header's send stamp and the peer clock
+            # offset this IS the wire hop
+            msg.recv_ts = t_rx
+            # restore the sender's trace context for this dispatch (and
+            # every task it spawns): the id minted at the client
+            # follows the op across daemons
+            current_trace.set(msg.trace)
+            try:
+                t0 = time.perf_counter()
+                try:
+                    await self.messenger._dispatch(self, msg)
+                finally:
+                    dt = time.perf_counter() - t0
+                    frame_dt += dt
+                    perf.observe("dispatch_latency", dt)
+            # swallow-ok: logged handler bug must not tear down the peer link
+            except Exception:
+                logger.exception(
+                    "%s: dispatcher failed on %s from %s",
+                    self.messenger.name, msg.TYPE,
+                    self.peer_name,
+                )
+            finally:
+                current_trace.set(None)
+        # byte-bucketed ONCE per frame (a 16-ack batch must not book
+        # its bytes 16x); the per-message handler wall rides
+        # dispatch_latency above
+        perf.hist("dispatch_histogram", n, frame_dt)
+
     async def close(self) -> None:
         if self._closed:
             return
         self._closed = True
         self._sendq.put_nowait(None)
         try:
-            self._writer.close()
-            await self._writer.wait_closed()
+            self._channel.close()
+            await self._channel.wait_closed()
         # swallow-ok: already-dead transport on close — nothing to report
         except (ConnectionError, OSError):
             pass
@@ -391,6 +686,13 @@ class AsyncMessenger:
         # coalescing only ever amortizes, never delays).  <=1 disables.
         # The ms_reply_coalesce_max option overrides via apply_config.
         self.reply_coalesce_max = 16
+        # op-batch bound (the request-direction twin, ROADMAP item 1a):
+        # the writer loop packs up to this many consecutive READY
+        # BATCH_OPS messages — blobs ride along in the extended batch
+        # layout — into one multi-op frame.  The client's op aggregator
+        # (rados/client.py) is what makes consecutive READY ops common.
+        # <=1 disables.  The ms_op_batch_max option overrides.
+        self.op_batch_max = 16
         self._server: asyncio.AbstractServer | None = None
         self._conns: dict[str, Connection] = {}  # outbound, keyed by peer addr
         self._pending: dict[str, asyncio.Future] = {}  # in-flight connects
@@ -435,6 +737,13 @@ class AsyncMessenger:
          .add_counter("coalesced_frames",
                       "batch frames written (one header+crc+syscall "
                       "amortized over send_coalesced members)")
+         .add_counter("batched_ops",
+                      "ops that rode a shared multi-op request frame "
+                      "(the request-direction twin of send_coalesced)")
+         .add_counter("batch_frames",
+                      "multi-op request frames written (one "
+                      "header+crc+syscall amortized over batched_ops "
+                      "members)")
          .add_gauge("dispatch_queue_bytes",
                     "inbound bytes held by the dispatch throttle")
          .add_gauge("clock_sync_uncertainty",
@@ -465,6 +774,7 @@ class AsyncMessenger:
         self.inject_socket_failures = cfg.ms_inject_socket_failures
         self.clock_sync_interval = cfg.ms_clock_sync_interval
         self.reply_coalesce_max = cfg.ms_reply_coalesce_max
+        self.op_batch_max = cfg.ms_op_batch_max
 
     def _inject_failure(self) -> bool:
         n = self.inject_socket_failures
@@ -473,10 +783,18 @@ class AsyncMessenger:
     # -- lifecycle
     async def bind(self, host: str = "127.0.0.1", port: int = 0) -> str:
         """Listen; returns the bound "host:port" address."""
-        self._server = await asyncio.start_server(self._accept, host, port)
+        loop = asyncio.get_running_loop()
+        self._server = await loop.create_server(
+            lambda: _FrameChannel(on_connected=self._on_inbound),
+            host, port)
         h, p = self._server.sockets[0].getsockname()[:2]
         self.addr = f"{h}:{p}"
         return self.addr
+
+    def _on_inbound(self, ch: _FrameChannel) -> None:
+        # connection_made context (synchronous): hand the handshake to
+        # a task so the event loop keeps accepting
+        asyncio.ensure_future(self._accept(ch))
 
     async def shutdown(self) -> None:
         self._stopped = True
@@ -505,16 +823,14 @@ class AsyncMessenger:
         self._conns.clear()
 
     # -- connections
-    async def _accept(
-        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
-    ) -> None:
+    async def _accept(self, ch: _FrameChannel) -> None:
         if self._stopped:
-            writer.close()
+            ch.close()
             return
-        conn = Connection(self, reader, writer)
+        conn = Connection(self, ch)
         try:
             banner = json.loads(  # wire-ok: banner handshake, line-based
-                (await reader.readline()).decode())
+                (await ch.readline()).decode())
             conn.peer_name = banner["entity"]
             conn.peer_addr = banner.get("addr", "")
             if self.auth is not None and self.auth.require:
@@ -530,12 +846,12 @@ class AsyncMessenger:
                     from ..auth import new_secret
 
                     nonce = new_secret()
-                    writer.write(  # wire-ok: auth challenge, handshake line
+                    ch.write(  # wire-ok: auth challenge, handshake line
                         json.dumps({"challenge": nonce}).encode() + b"\n"
                     )
-                    await writer.drain()
+                    await ch.drain()
                     answer = json.loads(  # wire-ok: auth proof, handshake line
-                        (await reader.readline()).decode())
+                        (await ch.readline()).decode())
                     if not isinstance(answer, dict):
                         answer = {}
                     entity = self.auth.verify(
@@ -551,21 +867,25 @@ class AsyncMessenger:
                         # gates everything else on conn.authenticated
                         conn.authenticated = False
                     else:
-                        writer.write(  # wire-ok: auth rejection, handshake line
+                        ch.write(  # wire-ok: auth rejection, handshake line
                             json.dumps({"error": "auth failed"}).encode()
                             + b"\n"
                         )
-                        await writer.drain()
-                        writer.close()
+                        await ch.drain()
+                        ch.close()
                         return
-            writer.write(  # wire-ok: banner handshake, line-based
+            ch.write(  # wire-ok: banner handshake, line-based
                 json.dumps({"entity": self.name, "addr": self.addr}).encode() + b"\n"
             )
-            await writer.drain()
+            await ch.drain()
         # swallow-ok: malformed/failed handshake — closing the conn is the reply
         except (ValueError, KeyError, TypeError, ConnectionError, OSError):
-            writer.close()
+            ch.close()
             return
+        # handshake done: everything after the dialer's last line is
+        # length-prefixed frames (bytes already coalesced behind it
+        # replay through the frame state machine)
+        ch.set_frame_mode()
         self.perf.inc("conns_accepted")
         self._start(conn)
 
@@ -619,11 +939,13 @@ class AsyncMessenger:
 
     async def _dial(self, addr: str, peer_name: str) -> Connection:
         host, port = addr.rsplit(":", 1)
-        writer = None
+        ch: _FrameChannel | None = None
         try:
             async with asyncio.timeout(self.connect_timeout):
-                reader, writer = await asyncio.open_connection(host, int(port))
-                conn = Connection(self, reader, writer)
+                loop = asyncio.get_running_loop()
+                _tr, ch = await loop.create_connection(
+                    _FrameChannel, host, int(port))
+                conn = Connection(self, ch)
                 conn.peer_addr = addr
                 conn.peer_name = peer_name
                 out_banner = {"entity": self.name, "addr": self.addr}
@@ -632,9 +954,9 @@ class AsyncMessenger:
                     if authz is not None:
                         out_banner["authorizer"] = authz
                 # wire-ok: banner handshake, line-based
-                writer.write(json.dumps(out_banner).encode() + b"\n")
-                await writer.drain()
-                line = await reader.readline()
+                ch.write(json.dumps(out_banner).encode() + b"\n")
+                await ch.drain()
+                line = await ch.readline()
                 if not line:
                     # peer died between accept and banner: a transient
                     # reset, not a protocol error — must hit the retry loop
@@ -654,11 +976,11 @@ class AsyncMessenger:
                         self.auth.prove(probe["challenge"])
                         if self.auth is not None else None
                     )
-                    writer.write(  # wire-ok: auth proof, handshake line
+                    ch.write(  # wire-ok: auth proof, handshake line
                         json.dumps({"proof": proof}).encode() + b"\n"
                     )
-                    await writer.drain()
-                    line = await reader.readline()
+                    await ch.drain()
+                    line = await ch.readline()
                     if not line:
                         raise ConnectionResetError(
                             f"{addr}: peer closed during auth challenge"
@@ -679,9 +1001,13 @@ class AsyncMessenger:
                         f"{addr}: bad handshake banner: {e!r}"
                     ) from e
         except BaseException:
-            if writer is not None:
-                writer.close()  # a half-done handshake must not leak the fd
+            if ch is not None:
+                ch.close()  # a half-done handshake must not leak the fd
             raise
+        # the acceptor may already be sending frames (its _start fires a
+        # clock probe right after its banner); replay anything coalesced
+        # behind the banner line into the frame state machine
+        ch.set_frame_mode()
         self.perf.inc("conns_opened")
         self._conns[addr] = conn
         self._start(conn)
@@ -693,7 +1019,7 @@ class AsyncMessenger:
             # would otherwise register AFTER the teardown snapshot and keep
             # the server's wait_closed() blocked forever
             conn._closed = True
-            conn._writer.close()
+            conn._channel.close()
             return
         self._all.add(conn)
         conn._tasks = [

@@ -1574,6 +1574,11 @@ class OSD(Dispatcher):
         try:
             if not internal:
                 op.mark("queued_for_qos")
+                if msg.from_batch:
+                    # arrived inside a multi-op request frame: tally
+                    # BEFORE admit so dump_op_pq_state shows the
+                    # batched share even while members sit queued
+                    self.scheduler.note_batch_member("client")
                 await self.scheduler.admit("client")
                 granted = True
             op.mark("dequeued")

@@ -124,7 +124,8 @@ class _Waiter:
 class _ClassState:
     __slots__ = ("spec", "queue", "r_tag", "p_tag", "l_tag", "pace_tag",
                  "admitted", "deferred", "preempted", "paced",
-                 "pace_calls", "win_served", "wait_sum", "wait_max")
+                 "pace_calls", "win_served", "wait_sum", "wait_max",
+                 "batch_members")
 
     def __init__(self, spec: QosSpec):
         self.spec = spec
@@ -147,6 +148,10 @@ class _ClassState:
         self.win_served = 0.0  # cost granted in the current share window
         self.wait_sum = 0.0
         self.wait_max = 0.0
+        self.batch_members = 0  # admissions that arrived inside a
+        # multi-op request frame (msg.from_batch) — the OSD-side proof
+        # the client aggregator's bursts survive to QoS intake in
+        # member order, not just onto the wire
 
 
 class OpScheduler:
@@ -227,6 +232,13 @@ class OpScheduler:
                     w.fut.set_result(None)
 
     # -- admission -----------------------------------------------------------
+
+    def note_batch_member(self, klass: str) -> None:
+        """Tally an admission whose message rode a multi-op request
+        frame (decode set ``from_batch``); called by the op intake
+        next to ``admit`` so ``dump_op_pq_state`` can show how much of
+        the admitted load arrived pre-batched."""
+        self._state[klass].batch_members += 1
 
     async def admit(self, klass: str, cost: float = 1.0) -> float:
         """Wait for a grant; returns the queue wait in seconds.  The
@@ -394,6 +406,9 @@ class OpScheduler:
                           if st.spec.limit > 0 else None),
                 },
                 "admitted": st.admitted,
+                # of those, how many arrived inside a multi-op request
+                # frame (client aggregator + writer-loop op batching)
+                "batch_members": st.batch_members,
                 "deferred": st.deferred,
                 "preempted": st.preempted,
                 "paced": st.paced,

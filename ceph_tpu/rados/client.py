@@ -20,6 +20,8 @@ import logging
 import time
 from typing import Any
 
+from ..common.perf_counters import PerfCounters
+from ..common.tracing import current_trace, new_trace_id
 from ..msg import AsyncMessenger, Connection, Dispatcher, messages
 from ..msg.message import Message
 from ..osd.osdmap import OSDMap
@@ -70,6 +72,59 @@ class RadosError(OSError):
         self.code = code
 
 
+class _OpAggregator:
+    """Objecter-parity op aggregation (the request-direction half of
+    ROADMAP item 1a).
+
+    Ops submitted within one event-loop tick to the SAME target OSD
+    stage here and flush as one burst into that connection's send
+    queue.  The burst is what makes them ADJACENT when the writer
+    loop's multi-op batcher (messenger ms_op_batch_max) drains the
+    queue — adjacency is the entire batching precondition, and without
+    staging each ``conn.send`` wakes the writer loop which happily
+    ships one-op frames.  The producers that make bursts common are
+    the striper's extent fan-out and the object cacher's writeback
+    flush (both ``asyncio.gather`` over ``operate``); a lone op pays
+    one ``call_soon`` hop (same tick, no sleep), not a delay — the
+    reference Objecter's session submit queue has the same
+    flush-on-next-tick shape.
+
+    Trace stamping happens in ``submit`` (the caller's context is
+    still active there); the flush callback runs in whichever context
+    scheduled it first, which must never decide another op's trace id.
+    """
+
+    def __init__(self, client: "RadosClient"):
+        self._client = client
+        self._staged: dict[Connection, list[Message]] = {}
+        self._flush_scheduled = False
+
+    def submit(self, conn: Connection, msg: Message) -> None:
+        if msg.trace is None:
+            msg.trace = (current_trace.get()
+                         or new_trace_id(self._client.name))
+        q = self._staged.get(conn)
+        if q is None:
+            self._staged[conn] = q = []
+        q.append(msg)
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            asyncio.get_running_loop().call_soon(self._flush)
+
+    def _flush(self) -> None:
+        self._flush_scheduled = False
+        staged, self._staged = self._staged, {}
+        perf = self._client.perf
+        for conn, msgs in staged.items():
+            for m in msgs:
+                conn.send(m)
+            # frames-on-the-wire is the messenger's number
+            # (msgr.batched_ops/batch_frames); this one is the
+            # CLIENT-side burst width the aggregator achieved per
+            # target — the knob the op_batch_max packer feeds on
+            perf.observe("ops_per_frame", len(msgs))
+
+
 class RadosClient(Dispatcher):
     """Cluster handle: mon session + map + op submission."""
 
@@ -101,6 +156,13 @@ class RadosClient(Dispatcher):
         self._sub_conn: Connection | None = None  # map subscription feed
         self._shutdown = False
         self._tasks: set[asyncio.Task] = set()
+        # client-side observability (Objecter parity): how wide the op
+        # aggregator's per-target bursts actually are
+        self.perf = PerfCounters("client").add_avg(
+            "ops_per_frame",
+            "ops staged per target OSD per aggregator flush (burst "
+            "width the wire-level op batcher packs from)")
+        self._op_agg = _OpAggregator(self)
         # watches: cookie -> {pool, oid, callback, conn} (linger state)
         self._watches: dict[str, dict] = {}
         self._watch_cookie = itertools.count(1)
@@ -530,7 +592,9 @@ class RadosClient(Dispatcher):
                     stamps={"submit": round(t_submit, 9)},
                     client=self.client_id,
                 )
-                conn.send(m)
+                # via the aggregator, not conn.send: concurrent ops to
+                # this OSD in the same tick ship as ONE multi-op frame
+                self._op_agg.submit(conn, m)
                 async with asyncio.timeout(op_timeout):
                     reply = await fut
             except PermissionError as e:

@@ -1,0 +1,198 @@
+"""Receive-pool lifetime suite (the pooled receive path, ROADMAP 1b).
+
+What must hold, per the contract in ceph_tpu/common/recv_pool.py:
+
+- checkout/release recycles blocks (identity reuse, allocation-free
+  steady state), bounded free lists, oversize never pooled, release
+  idempotent;
+- a ``memoryview`` held past release QUARANTINES the block (data stays
+  intact — recycling a referenced block would be silent corruption)
+  and the block returns to the free lists only after the last view
+  dies;
+- end to end: a client ``read(copy=False)`` view held across further
+  traffic keeps its frame bytes intact while the pool keeps recycling
+  around it;
+- the acceptance pin: a live 1-OSD cluster serving 1000 4 KiB writes
+  in steady state adds ZERO ``stack.recv_allocs`` — every inbound
+  frame lands in a pooled block — while ``recv_slab_hits`` grows and
+  ``recv_bytes_held`` stays bounded.
+"""
+
+import asyncio
+
+from ceph_tpu.common import stack_ledger
+from ceph_tpu.common.recv_pool import RecvBlock, RecvPool, recv_pool
+
+
+def run(coro):
+    asyncio.run(coro)
+
+
+class TestRecvPoolUnit:
+    def test_checkout_release_reuses_block(self):
+        pool = RecvPool()
+        blk = pool.checkout(1000)
+        assert blk.cap == 4096  # smallest class that fits
+        blk.buf[:4] = b"abcd"
+        blk.release()
+        blk2 = pool.checkout(2000)
+        assert blk2 is blk  # identity reuse: allocation-free
+        assert pool.stats()["free"][4096] == 0
+
+    def test_class_ladder_and_oversize(self):
+        pool = RecvPool()
+        assert pool.checkout(4096).cap == 4096
+        assert pool.checkout(4097).cap == 16384
+        assert pool.checkout(1 << 20).cap == 1 << 20
+        big = pool.checkout((1 << 20) + 1)
+        assert big.cap == (1 << 20) + 1  # exact, not a class
+        big.release()  # oversize: dropped, never pooled
+        assert all(n == 0 for n in pool.stats()["free"].values())
+
+    def test_release_idempotent(self):
+        pool = RecvPool()
+        blk = pool.checkout(100)
+        blk.release()
+        blk.release()  # second release must not double-insert
+        assert pool.stats()["free"][4096] == 1
+
+    def test_free_list_bounds(self):
+        pool = RecvPool(per_class=2, max_held_bytes=1 << 30)
+        blocks = [pool.checkout(100) for _ in range(5)]
+        for b in blocks:
+            b.release()
+        st = pool.stats()
+        assert st["free"][4096] == 2  # count cap
+        assert st["held_bytes"] == 2 * 4096
+        pool2 = RecvPool(per_class=64, max_held_bytes=8192)
+        blocks = [pool2.checkout(100) for _ in range(5)]
+        for b in blocks:
+            b.release()
+        assert pool2.stats()["held_bytes"] <= 8192  # byte cap
+
+    def test_held_view_quarantines_then_recycles(self):
+        """The lifetime pin: a view held past release keeps the block
+        un-recycled (its bytes stay intact under further pool churn);
+        dropping the view lets the next pool operation recycle it."""
+        pool = RecvPool()
+        blk = pool.checkout(64)
+        blk.buf[:5] = b"hello"
+        view = blk.view(5)
+        blk.release()
+        st = pool.stats()
+        assert st["quarantined"] == 1
+        assert st["free"][4096] == 0  # NOT on the free list
+        # churn the pool: the quarantined block must never be handed out
+        for _ in range(8):
+            other = pool.checkout(64)
+            assert other is not blk
+            other.buf[:5] = b"XXXXX"
+            other.release()
+        assert bytes(view) == b"hello"  # bytes intact throughout
+        view.release()
+        pool.checkout(64).release()  # any traffic sweeps
+        st = pool.stats()
+        assert st["quarantined"] == 0
+        assert blk in pool._free[4096]  # recycled at last-view death
+
+    def test_quarantine_bound_drops_to_gc(self):
+        pool = RecvPool(quarantine_max=3)
+        views = []
+        for i in range(6):
+            b = pool.checkout(64)
+            b.buf[:1] = bytes([i])
+            views.append(b.view(1))
+            b.release()
+        assert pool.stats()["quarantined"] <= 3
+        # evicted blocks stay valid: the views own their bytearrays
+        for i, v in enumerate(views):
+            assert v[0] == i
+
+    def test_counters_fed(self):
+        stack_ledger.reset_stack()
+        pool = RecvPool()
+        blk = pool.checkout(100)  # miss
+        blk.release()
+        for _ in range(3):
+            pool.checkout(100).release()  # hits (tally flushed on put)
+        pc = stack_ledger.stack_perf()
+        assert int(pc.get("recv_allocs")) == 1
+        assert int(pc.get("recv_slab_hits")) == 3
+        assert int(pc.get("frame_allocs")) >= 1  # miss also books here
+        assert int(pc.get("recv_bytes_held")) == 4096
+
+
+class TestRecvPoolLive:
+    def test_read_view_survives_pool_churn(self):
+        """End to end: a client read(copy=False) view points into a
+        pooled receive block; holding it across 64 further ops (the
+        pool recycling the whole time) must never corrupt it."""
+        from ceph_tpu.rados.cluster import MiniCluster
+
+        async def main():
+            async with MiniCluster(n_osds=1) as c:
+                cl = await c.client()
+                await cl.create_pool("rv", "replicated", size=1)
+                io = cl.io_ctx("rv")
+                payload = bytes(range(256)) * 8  # 2 KiB
+                await io.write_full("held", payload)
+                view = await io.read("held", copy=False)
+                assert bytes(view) == payload
+                for i in range(64):
+                    await io.write_full(f"churn{i}", payload)
+                    got = await io.read(f"churn{i}")
+                    assert got == payload
+                assert bytes(view) == payload  # still intact
+                view.release()
+
+        run(main())
+
+    def test_recv_allocs_flat_over_1k_op_steady_state(self):
+        """The acceptance pin (receive-side twin of the frame_allocs
+        pin in test_wire_protocol.py): 1000 4 KiB writes in steady
+        state add ZERO recv_allocs — every inbound frame (op at the
+        OSD, ack at the client) lands in a pooled block — while
+        recv_slab_hits grows by at least one per frame and
+        recv_bytes_held stays bounded by the pool cap."""
+        from ceph_tpu.common.recv_pool import MAX_HELD_BYTES
+        from ceph_tpu.rados.cluster import MiniCluster
+
+        async def main():
+            async with MiniCluster(
+                n_osds=1,
+                config_overrides={
+                    # keep the window steady-state: no mgr report tick
+                    # mid-window (its one-off jumbo perf tail is
+                    # legitimate warmup, not steady state)
+                    "osd_mgr_report_interval": 3600.0,
+                },
+            ) as c:
+                cl = await c.client()
+                await cl.create_pool("flat", "replicated", size=1)
+                payload = bytes(range(256)) * 16  # 4 KiB
+                for i in range(32):
+                    await cl.operate("flat", f"w{i}",
+                                     [{"op": "writefull", "data": 0}],
+                                     [payload])
+                pc = stack_ledger.stack_perf()
+                recv_pool().stats()  # settle
+                a0 = int(pc.get("recv_allocs"))
+                h0 = int(pc.get("recv_slab_hits"))
+                ok = 0
+                for i in range(1000):
+                    r = await cl.operate("flat", f"o{i}",
+                                         [{"op": "writefull", "data": 0}],
+                                         [payload])
+                    ok += 1 if r.result == 0 else 0
+                # flush the hit tally through one more pool op
+                recv_pool().checkout(64).release()
+                assert ok == 1000
+                grew = int(pc.get("recv_allocs")) - a0
+                assert grew == 0, f"recv_allocs grew by {grew}"
+                # every op is >=2 inbound frames total (op at the OSD,
+                # ack at the client); all pool-served
+                assert int(pc.get("recv_slab_hits")) - h0 >= 2000
+                held = int(pc.get("recv_bytes_held"))
+                assert 0 <= held <= MAX_HELD_BYTES
+
+        run(main())

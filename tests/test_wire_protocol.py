@@ -539,6 +539,171 @@ class TestReplyCoalescing:
         run(main())
 
 
+class TestOpBatchFrames:
+    """Multi-op REQUEST batch frames (ISSUE 19): the extended
+    sub-entry layout (FLAG_BATCH_BLOBS), member blobs concatenated
+    after the entry table, ordered roundtrip with ``from_batch`` set,
+    and the same corruption containment the ack path pins."""
+
+    def _ops(self, n=3, blob_sizes=(64, 4096, 0)):
+        msgs = []
+        for i in range(n):
+            m = messages.MOSDOp(
+                tid=i, epoch=1, pool=1, oid=f"o{i}",
+                ops=[{"op": "writefull", "data": 0}],
+                snapc=None, snapid=None,
+                stamps={"submit": 1.0}, client=7)
+            sz = blob_sizes[i % len(blob_sizes)]
+            if sz:
+                m.blobs = [bytes([65 + i]) * sz]
+            msgs.append(m)
+        return msgs
+
+    def test_extended_layout_pin(self):
+        """The byte layout the manifest's ``batch_frame`` object pins:
+        header blob_count = member count, FLAG_BATCH|FLAG_BATCH_BLOBS,
+        tail_len = entries-region length, _SUBX entries with per-member
+        u32 blob-length tables, blobs after the table in member
+        order."""
+        msgs = self._ops()
+        segs, total, rel = encode_batch_frame(msgs, 7)
+        frame = _flat(segs)
+        rel()
+        assert len(frame) == total
+        (magic, tid, flags, seq, _sent, blob_count, trace_len,
+         tail_len) = msgmod._FIXED.unpack_from(frame, 0)
+        assert magic == msgmod.MAGIC
+        assert tid == msgmod.TYPE_ID_BATCH
+        assert flags & msgmod.FLAG_BATCH
+        assert flags & msgmod.FLAG_BATCH_BLOBS
+        assert seq == 7 and blob_count == 3 and trace_len == 0
+        # walk the extended entry table by hand
+        off = msgmod._FIXED.size
+        entries_end = off + tail_len
+        blob_lens = []
+        for m in msgs:
+            (styp, _sfl, strace, stail, sblobs) = \
+                msgmod._SUBX.unpack_from(frame, off)
+            off += msgmod._SUBX.size
+            assert styp == messages.MOSDOp.TYPE_ID
+            assert sblobs == len(m.blobs)
+            lens = struct.unpack_from(f"<{sblobs}I", frame, off)
+            off += 4 * sblobs
+            assert list(lens) == [len(b) for b in m.blobs]
+            blob_lens.extend(lens)
+            off += strace + stail
+        assert off == entries_end
+        # member blobs sit AFTER the entry table, in member order
+        assert frame[entries_end:entries_end + 64] == b"A" * 64
+        assert frame[entries_end + 64:entries_end + 64 + 4096] == b"B" * 4096
+        assert entries_end + sum(blob_lens) == len(frame) - 4
+        # and the decode contract: order, fields, blobs, from_batch
+        outs, seq2 = decode_frame_msgs(frame)
+        assert seq2 == 7
+        assert [o.tid for o in outs] == [0, 1, 2]
+        assert all(o.from_batch for o in outs)
+        assert bytes(outs[0].blobs[0]) == b"A" * 64
+        assert bytes(outs[1].blobs[0]) == b"B" * 4096
+        assert outs[2].blobs == []
+        assert [o.oid for o in outs] == ["o0", "o1", "o2"]
+
+    def test_blob_free_batch_stays_compact(self):
+        """The PR-13 ack-batch format is untouched: no blob on any
+        member -> no FLAG_BATCH_BLOBS, compact _SUB entries, one slab
+        segment (byte-compatible with pre-ISSUE-19 peers)."""
+        acks = [messages.MOSDOpReply(tid=i, result=0, epoch=1)
+                for i in range(4)]
+        segs, _t, rel = encode_batch_frame(acks, 1)
+        assert len(segs) == 1  # always gathered
+        frame = _flat(segs)
+        rel()
+        (_m, _tid, flags, _s, _st, bc, _tr, tail_len) = \
+            msgmod._FIXED.unpack_from(frame, 0)
+        assert not (flags & msgmod.FLAG_BATCH_BLOBS)
+        assert bc == 4
+        # compact layout: the entries region runs to the crc (no blob
+        # section), and each entry is a _SUB header
+        assert msgmod._FIXED.size + tail_len == len(frame) - 4
+        (styp, *_rest) = msgmod._SUB.unpack_from(frame, msgmod._FIXED.size)
+        assert styp == messages.MOSDOpReply.TYPE_ID
+        outs, _ = decode_frame_msgs(frame)
+        assert all(o.from_batch for o in outs)
+
+    def test_truncation_at_every_boundary_is_badframe(self):
+        segs, _t, rel = encode_batch_frame(
+            self._ops(blob_sizes=(64, 32, 0)), 1)
+        frame = _flat(segs)
+        rel()
+        for k in range(len(frame)):
+            with pytest.raises(BadFrame):
+                decode_frame_msgs(frame[:k])
+
+    def test_random_corruption_never_escapes_badframe(self):
+        """The fuzz pin extended to multi-op request frames: bit flips
+        anywhere — header, entry table, blob-length tables, blob
+        bytes, crc — either decode to the same bytes (a flip the crc
+        catches first never gets that far) or raise BadFrame; nothing
+        else may escape."""
+        segs, _t, rel = encode_batch_frame(self._ops(), 3)
+        frame = _flat(segs)
+        rel()
+        rng = random.Random(1919)
+        for _ in range(400):
+            ba = bytearray(frame)
+            for _flip in range(rng.randrange(1, 4)):
+                ba[rng.randrange(len(ba))] ^= 1 << rng.randrange(8)
+            try:
+                decode_frame_msgs(bytes(ba))
+            except BadFrame:
+                pass  # the only acceptable failure mode
+
+    def test_live_op_burst_batches_in_order(self):
+        """Same-tick MOSDOp sends to one peer ship as multi-op frames
+        (op_batch_max) and dispatch in send order with from_batch
+        set — the wire half of the client aggregator contract."""
+
+        async def main():
+            sink = _Sink()
+            srv = AsyncMessenger("osd.0", sink)
+            await srv.bind()
+            cli = AsyncMessenger("client.1", _Sink())
+            conn = await cli.connect(srv.addr, "osd.0")
+            for m in self._ops(n=10, blob_sizes=(256,)):
+                conn.send(m)
+            await _wait(lambda: len(sink.got) >= 10)
+            ops = [m for m in sink.got if isinstance(m, messages.MOSDOp)]
+            assert [o.tid for o in ops] == list(range(10))
+            assert all(o.from_batch for o in ops)
+            assert all(bytes(o.blobs[0]) == bytes([65 + o.tid]) * 256
+                       for o in ops)
+            assert cli.perf.get("batch_frames") >= 1
+            assert cli.perf.get("batched_ops") >= 10
+            await cli.shutdown()
+            await srv.shutdown()
+
+        run(main())
+
+    def test_op_batch_max_1_disables_batching(self):
+        async def main():
+            sink = _Sink()
+            srv = AsyncMessenger("osd.0", sink)
+            await srv.bind()
+            cli = AsyncMessenger("client.1", _Sink())
+            cli.op_batch_max = 1
+            conn = await cli.connect(srv.addr, "osd.0")
+            for m in self._ops(n=6, blob_sizes=(64,)):
+                conn.send(m)
+            await _wait(lambda: len(sink.got) >= 6)
+            assert cli.perf.get("batch_frames") == 0
+            ops = [m for m in sink.got if isinstance(m, messages.MOSDOp)]
+            assert [o.tid for o in ops] == list(range(6))
+            assert not any(o.from_batch for o in ops)
+            await cli.shutdown()
+            await srv.shutdown()
+
+        run(main())
+
+
 class TestLiveClusterAllocsFlat:
     def test_frame_allocs_flat_over_1k_op_steady_state(self):
         """The acceptance pin: a live 1-OSD cluster serving 1000 4KiB

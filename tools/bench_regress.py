@@ -79,6 +79,12 @@ METRIC_ALIASES = {"stack_e2e_gbps": "stack_e2e.stack_e2e_gbps",
                   "smallops.op_p99": "smallops.op_p99_ms",
                   "smallops_trace_overhead_share":
                       "smallops.trace_overhead_share",
+                  # the ProcCluster (real-multiprocess) smallops rate
+                  # rides the final line under smallops.proc — its own
+                  # dotted path, so the cross-process number is never
+                  # compared against the loopback one
+                  "smallops_proc_ops_per_sec":
+                      "smallops.proc.ops_per_sec",
                   "churn_protection": "churn.protection",
                   "churn_recovery_gbps": "churn.recovery_gbps"}
 
@@ -122,6 +128,14 @@ METRIC_ALIASES = {"stack_e2e_gbps": "stack_e2e.stack_e2e_gbps",
 # same shape as header_share, so always-on decide-late tracing can
 # never silently regress the PR-13 IOPS win.  Clean-skips (exit 0)
 # until two rounds carry the capture.
+# smallops.proc.ops_per_sec (ISSUE 19) is the multi-host truth pass:
+# the same pipelined smallops round against a real-multiprocess
+# ProcCluster (TCP between OSD processes, hop re-rank off the mgr's
+# kept-trace store).  A throughput with the standard 2x jitter budget
+# — and deliberately a SEPARATE dotted path from the loopback
+# smallops.ops_per_sec, so the two regimes gate independently and a
+# loopback-only win can never mask a cross-process regression.
+# Clean-skips (exit 0) until two rounds carry the proc record.
 # churn.protection (ISSUE 15) is the live-storm client protection
 # factor — fifo's storm-vs-quiescent p99 blowup over mclock's under
 # the SAME OSD-kill/recovery storm (a real MiniCluster cycle per
@@ -144,6 +158,7 @@ METRIC_DEFAULT_THRESHOLDS = {"mesh.scaling_efficiency": 0.8,
                              "smallops.ops_per_sec": 0.5,
                              "smallops.op_p99_ms": 0.5,
                              "smallops.trace_overhead_share": 0.8,
+                             "smallops.proc.ops_per_sec": 0.5,
                              "churn.protection": 0.4,
                              "churn.recovery_gbps": 0.5}
 

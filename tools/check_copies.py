@@ -49,6 +49,10 @@ HOT_PATHS = (
     # fill via pack_into/slice assignment — a bytes()/join creeping in
     # would re-materialize exactly what the pool exists to recycle
     "ceph_tpu/common/slab.py",
+    # the receive pool (ISSUE 19): inbound frames land in pooled
+    # blocks via recv_into and decode as views — a copy here would
+    # undo the pooled receive path the module exists to provide
+    "ceph_tpu/common/recv_pool.py",
 )
 
 ANNOTATION = "# copy-ok:"

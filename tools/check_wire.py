@@ -29,6 +29,14 @@ registry against the committed manifest ``ceph_tpu/msg/wire_manifest
   an entry breaks every peer, and appending one must show up in the
   manifest diff.  A pinned class whose FIELDS tuple diverges from the
   manifest fails in either direction; update both in the same diff.
+- The BATCH-FRAME LAYOUT is pinned by the manifest's ``batch_frame``
+  object (ISSUE 19): the fixed header struct format, the frame flag
+  values, and both sub-entry struct formats — compact (``_SUB``,
+  blob-free ack coalescing) and extended (``_SUBX``, multi-op request
+  frames under ``FLAG_BATCH_BLOBS``).  These module-level constants in
+  message.py are byte layout exactly like type ids; silent drift in
+  any of them breaks every peer mid-upgrade, so the manifest diff is
+  the review.
 
 And the reason the binary header exists at all: JSON must not creep
 back onto the frame hot path.  ``json.dumps``/``json.loads`` calls in
@@ -128,6 +136,34 @@ def _class_fields(cls: ast.ClassDef) -> list[str] | None:
     return None
 
 
+def _module_wire_consts(tree: ast.Module) -> dict:
+    """Module-level wire-layout constants from message.py: literal int
+    assignments (``FLAG_* = 0x10``, ``TYPE_ID_BATCH = 1``) and struct
+    format strings (``_SUB = struct.Struct("<HHHI")``).  Non-literal
+    values map to NON_LITERAL — a layout laundered through a name must
+    not silently pass the pin."""
+    out: dict = {}
+    for stmt in tree.body:
+        if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)):
+            continue
+        name, value = stmt.targets[0].id, stmt.value
+        if isinstance(value, ast.Constant):
+            out[name] = value.value
+        elif (isinstance(value, ast.Call)
+              and isinstance(value.func, ast.Attribute)
+              and isinstance(value.func.value, ast.Name)
+              and value.func.value.id == "struct"
+              and value.func.attr == "Struct"
+              and len(value.args) == 1
+              and isinstance(value.args[0], ast.Constant)
+              and isinstance(value.args[0].value, str)):
+            out[name] = value.args[0].value
+        else:
+            out[name] = NON_LITERAL
+    return out
+
+
 def _annotated(lines: list[str], lineno: int, end_lineno: int) -> str | None:
     for ln in range(lineno - 1, end_lineno + 1):
         if 1 <= ln <= len(lines):
@@ -211,6 +247,7 @@ def check(root: pathlib.Path) -> list[str]:
         field_tails = dict(manifest.get("field_tails", {}))
     except (OSError, ValueError) as e:
         problems.append(f"{MANIFEST}: unreadable: {e}")
+        manifest = None
         mtypes, retired, json_tails, field_tails = {}, [], set(), {}
     if code_types:  # skip cross-checks if extraction already failed hard
         for tname, tid in sorted(code_types.items()):
@@ -279,6 +316,69 @@ def check(root: pathlib.Path) -> list[str]:
                     f"code {got} — reorder/rename/remove breaks every "
                     f"peer; update both in the same diff (appending a "
                     f"trailing field is the only compatible change)")
+
+    # -- 2b. batch-frame layout pin (struct formats + flag values)
+    batch_pin = manifest.get("batch_frame") if isinstance(
+        manifest, dict) else None
+    msg_rel = "ceph_tpu/msg/message.py"
+    msg_path = root / msg_rel
+    if batch_pin and msg_path.exists():
+        try:
+            consts = _module_wire_consts(ast.parse(msg_path.read_text()))
+        except (OSError, SyntaxError) as e:
+            consts = {}
+            problems.append(f"{msg_rel}: unparseable: {e}")
+        pins = [
+            ("type_id", "TYPE_ID_BATCH"),
+            ("fixed_header", "_FIXED"),
+            ("sub_entry", "_SUB"),
+            ("sub_entry_blobs", "_SUBX"),
+        ]
+        for mkey, cname in pins:
+            want = batch_pin.get(mkey)
+            got = consts.get(cname)
+            if want is None:
+                problems.append(
+                    f"{MANIFEST}: 'batch_frame' is missing {mkey!r} — "
+                    f"the layout pin must stay complete")
+            elif got is NON_LITERAL or got is None:
+                problems.append(
+                    f"{msg_rel}: {cname} is absent or non-literal — "
+                    f"batch-frame layout is wire protocol and must be "
+                    f"a pinned literal")
+            elif got != want:
+                problems.append(
+                    f"{MANIFEST}: batch_frame.{mkey} diverges: "
+                    f"manifest {want!r} vs code {cname}={got!r} — "
+                    f"byte layout is wire protocol; update both in "
+                    f"the same diff")
+        want_flags = dict(batch_pin.get("flags", {}))
+        code_flags = {k: v for k, v in consts.items()
+                      if k.startswith("FLAG_")}
+        for fname, want in sorted(want_flags.items()):
+            got = code_flags.get(fname)
+            if got is NON_LITERAL or not isinstance(got, int):
+                problems.append(
+                    f"{msg_rel}: pinned frame flag {fname} is absent "
+                    f"or non-literal")
+            elif got != int(want):
+                problems.append(
+                    f"{MANIFEST}: batch_frame.flags.{fname} diverges: "
+                    f"manifest {want} vs code {got} — flag values are "
+                    f"wire protocol")
+        for fname in sorted(code_flags):
+            if fname not in want_flags:
+                problems.append(
+                    f"{msg_rel}: frame flag {fname} is not pinned in "
+                    f"the manifest's batch_frame.flags — append it "
+                    f"(the manifest diff IS the reviewable wire "
+                    f"change)")
+    elif (batch_pin is None and isinstance(manifest, dict)
+          and msg_path.exists() and code_types):
+        problems.append(
+            f"{MANIFEST}: no 'batch_frame' layout pin — the batch "
+            f"sub-entry structs and frame flags are wire protocol "
+            f"(ISSUE 19) and must be pinned")
 
     # -- 3. JSON off the frame hot path
     for rel in JSON_BAN_FILES:
